@@ -1,0 +1,53 @@
+"""multiverso_tpu — a TPU-native parameter-server framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of Multiverso
+(github.com/StillKeepTry/Multiverso, mounted read-only at /root/reference):
+sharded parameter tables (array / matrix / sparse matrix / KV), asynchronous
+and BSP-synchronous Get/Add semantics, server-side optimizers (SGD / momentum /
+AdaGrad / FTRL), model-averaging allreduce, checkpointing, Python table
+handlers and framework param-manager hooks, and the two reference
+applications (WordEmbedding, LogisticRegression).
+
+Architecture (see SURVEY.md §7): tables are sharded ``jax.Array``s in HBM over
+a device mesh; Get/Add lower to XLA collectives over ICI/DCN; updaters are
+jitted/Pallas kernels on local shards; the reference's actor/MPI machinery has
+no equivalent code because the SPMD model subsumes it.
+"""
+
+from multiverso_tpu.api import (
+    MV_Aggregate,
+    MV_Barrier,
+    MV_Init,
+    MV_NetBind,
+    MV_NetConnect,
+    MV_NumServers,
+    MV_NumWorkers,
+    MV_Rank,
+    MV_ServerId,
+    MV_SetFlag,
+    MV_ShutDown,
+    MV_Size,
+    MV_WorkerId,
+)
+from multiverso_tpu.runtime import Runtime, runtime
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MV_Aggregate",
+    "MV_Barrier",
+    "MV_Init",
+    "MV_NetBind",
+    "MV_NetConnect",
+    "MV_NumServers",
+    "MV_NumWorkers",
+    "MV_Rank",
+    "MV_ServerId",
+    "MV_SetFlag",
+    "MV_ShutDown",
+    "MV_Size",
+    "MV_WorkerId",
+    "Runtime",
+    "runtime",
+    "__version__",
+]

@@ -1,0 +1,84 @@
+"""Public ``MV_*`` API surface.
+
+Parity with the reference public API (ref: include/multiverso/multiverso.h:9-65,
+src/multiverso.cpp:11-78). ``MV_NetBind`` / ``MV_NetConnect`` have no TPU
+equivalent (XLA owns the fabric; ref: multiverso.h:47-65) and raise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from multiverso_tpu.runtime import runtime
+from multiverso_tpu.utils.configure import SetCMDFlag
+from multiverso_tpu.utils.log import Log
+
+__all__ = [
+    "MV_Init",
+    "MV_ShutDown",
+    "MV_Barrier",
+    "MV_Rank",
+    "MV_Size",
+    "MV_NumWorkers",
+    "MV_NumServers",
+    "MV_WorkerId",
+    "MV_ServerId",
+    "MV_SetFlag",
+    "MV_Aggregate",
+    "MV_NetBind",
+    "MV_NetConnect",
+]
+
+
+def MV_Init(argv: Optional[Sequence[str]] = None, **kwargs: Any) -> List[str]:
+    """Start the runtime (ref: src/multiverso.cpp:11-16). Returns leftover argv."""
+    return runtime().start(argv=argv, **kwargs)
+
+
+def MV_ShutDown(finalize: bool = True) -> None:
+    runtime().shut_down(finalize)
+
+
+def MV_Barrier() -> None:
+    runtime().barrier()
+
+
+def MV_Rank() -> int:
+    return runtime().rank
+
+
+def MV_Size() -> int:
+    return runtime().size
+
+
+def MV_NumWorkers() -> int:
+    return runtime().num_workers
+
+
+def MV_NumServers() -> int:
+    return runtime().num_servers
+
+
+def MV_WorkerId() -> int:
+    return runtime().worker_id
+
+
+def MV_ServerId() -> int:
+    return runtime().server_id
+
+
+def MV_SetFlag(name: str, value: Any) -> None:
+    SetCMDFlag(name, value)
+
+
+def MV_Aggregate(per_worker: Any):
+    """Model-averaging allreduce over the worker axis (ref: src/multiverso.cpp:53-56)."""
+    return runtime().aggregate(per_worker)
+
+
+def MV_NetBind(rank: int, endpoint: str) -> None:
+    Log.Fatal("MV_NetBind has no TPU equivalent: XLA owns the mesh fabric")
+
+
+def MV_NetConnect(ranks: Sequence[int], endpoints: Sequence[str]) -> None:
+    Log.Fatal("MV_NetConnect has no TPU equivalent: XLA owns the mesh fabric")

@@ -1,0 +1,30 @@
+"""Parallelism layer: mesh construction, sharding specs, collectives, sync policy.
+
+Replaces the reference's entire communication stack (SURVEY.md §2.2 —
+NetInterface / MPINetWrapper / ZMQNetWrapper / AllreduceEngine): XLA
+collectives over ICI/DCN are the transport, the mesh is the topology.
+"""
+
+from multiverso_tpu.parallel.mesh import (
+    SHARD_AXIS,
+    WORKER_AXIS,
+    build_mesh,
+    num_shards,
+    num_workers,
+    replicated_sharding,
+    shard_axis_name,
+    table_sharding,
+    worker_sharding,
+)
+
+__all__ = [
+    "SHARD_AXIS",
+    "WORKER_AXIS",
+    "build_mesh",
+    "num_shards",
+    "num_workers",
+    "replicated_sharding",
+    "shard_axis_name",
+    "table_sharding",
+    "worker_sharding",
+]

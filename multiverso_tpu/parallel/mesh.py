@@ -1,0 +1,121 @@
+"""Device-mesh construction and sharding helpers.
+
+This is where the reference's process topology collapses into a TPU device
+mesh. In the reference each MPI rank is simultaneously a *worker* and a
+*server* (role ALL — ref: include/multiverso/node.h:6-27, src/zoo.cpp:23-35);
+tables are sharded across servers and every worker talks to every server over
+MPI/ZMQ (SURVEY.md §2.2). On TPU:
+
+* one mesh axis, ``worker``, is the data-parallel axis — one "worker" per
+  device (or per device-row of a 2-D mesh);
+* table shards live in HBM along the ``shard`` axis — the "servers". By
+  default there is no separate shard axis: the mesh is 1-D and tables shard
+  along ``worker`` itself, which is exactly the reference's role-ALL layout
+  (every node hosts a table shard *and* trains);
+* Get/Add lower to XLA collectives over ICI (all_gather / reduce_scatter /
+  psum) instead of point-to-point messages — the entire net/ layer of the
+  reference (NetInterface, MPINetWrapper, ZMQNetWrapper, AllreduceEngine —
+  SURVEY.md §2.2) has no code here: XLA owns topology and transport.
+
+A separate ``shard`` axis (2-D mesh) gives the reference's worker!=server
+configurations (``-ps_role`` splits) and is what larger models use to combine
+data parallelism with sharded tables.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "WORKER_AXIS",
+    "SHARD_AXIS",
+    "build_mesh",
+    "shard_axis_name",
+    "num_workers",
+    "num_shards",
+    "table_sharding",
+    "worker_sharding",
+    "replicated_sharding",
+]
+
+WORKER_AXIS = "worker"
+SHARD_AXIS = "shard"
+
+
+def build_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    num_workers: Optional[int] = None,
+    num_shards: Optional[int] = None,
+) -> Mesh:
+    """Build the framework mesh.
+
+    Default (no arguments): 1-D mesh over all local devices with axis
+    ``worker`` — the role-ALL layout where table shards and data shards
+    coincide per device. With ``num_shards > 1`` a 2-D
+    ``(worker, shard)`` mesh is built; tables shard along ``shard`` and
+    replicate along ``worker``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if num_workers is None and num_shards is None:
+        return Mesh(np.asarray(devices), (WORKER_AXIS,))
+    if num_shards in (None, 1):
+        if num_workers not in (None, n):
+            raise ValueError(
+                f"num_workers={num_workers} does not cover all {n} devices; "
+                "pass an explicit devices list to use a subset"
+            )
+        return Mesh(np.asarray(devices), (WORKER_AXIS,))
+    if num_workers is None:
+        if n % num_shards:
+            raise ValueError(f"{n} devices not divisible by num_shards={num_shards}")
+        num_workers = n // num_shards
+    if num_workers * num_shards != n:
+        raise ValueError(
+            f"num_workers({num_workers}) * num_shards({num_shards}) != devices({n})"
+        )
+    grid = np.asarray(devices).reshape(num_workers, num_shards)
+    return Mesh(grid, (WORKER_AXIS, SHARD_AXIS))
+
+
+def shard_axis_name(mesh: Mesh) -> str:
+    """Axis tables shard along: ``shard`` if present else ``worker`` (role ALL)."""
+    return SHARD_AXIS if SHARD_AXIS in mesh.axis_names else WORKER_AXIS
+
+
+def num_workers(mesh: Mesh) -> int:
+    return int(mesh.shape[WORKER_AXIS])
+
+
+def num_shards(mesh: Mesh) -> int:
+    return int(mesh.shape[shard_axis_name(mesh)])
+
+
+def table_sharding(mesh: Mesh, ndim: int, shard_dim: int = 0) -> NamedSharding:
+    """Sharding for table storage: dim ``shard_dim`` split across servers.
+
+    ArrayTable shards its single dim contiguously (ref:
+    src/table/array_table.cpp:98-108); MatrixTable shards rows (ref:
+    src/table/matrix_table.cpp:24-45). Both are 'dim 0 over the shard axis'.
+    """
+    spec = [None] * ndim
+    spec[shard_dim] = shard_axis_name(mesh)
+    return NamedSharding(mesh, P(*spec))
+
+
+def worker_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Per-worker data: dim 0 is the worker dim (one slice per worker)."""
+    spec = [None] * ndim
+    spec[0] = WORKER_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,235 @@
+"""Process-wide runtime — the TPU-native equivalent of the reference ``Zoo``.
+
+In the reference, ``Zoo`` (ref: include/multiverso/zoo.h:19-85,
+src/zoo.cpp:41-187) owns the actor threads, initialises MPI/ZMQ, runs a
+registration handshake with the rank-0 ``Controller`` (assigning dense
+worker/server ids), and implements ``Barrier()`` as a request/reply round trip
+to rank 0. On TPU, every piece of that machinery is replaced by the SPMD
+programming model:
+
+* **registration / controller** — device ids come from the mesh; on multi-host
+  deployments ``jax.distributed.initialize`` performs the rendezvous that the
+  Controller handshake performed (ref: src/controller.cpp:12-104).
+* **actors / communicator** — there are no mailbox threads; table ops are
+  asynchronously-dispatched XLA computations and a ``jax.Array`` is the
+  future that ``Waiter`` used to be (ref: src/communicator.cpp:39-105).
+* **barrier** — a genuine device-side collective (psum over the whole mesh)
+  plus, multi-host, a process-level sync (ref: src/zoo.cpp:164-176).
+* **roles** — the reference bit-ors WORKER|SERVER per process
+  (``-ps_role``, src/zoo.cpp:23-35). The TPU-native layout is role ALL by
+  construction: every device holds a table shard and computes. A 2-D
+  ``(worker, shard)`` mesh expresses worker!=server counts; a dedicated
+  parameter-only device set is intentionally not supported (documented
+  deviation — it would waste MXUs).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_bool,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    GetFlag,
+    ParseCMDFlags,
+)
+from multiverso_tpu.utils.log import CHECK, FatalError, Log
+
+__all__ = ["Runtime", "runtime"]
+
+# Flag parity with the reference Zoo/Server (ref: src/zoo.cpp:23-25,
+# src/server.cpp:20-21). ``ps_role`` is accepted but only 'all' maps onto SPMD
+# hardware (see module docstring).
+MV_DEFINE_string("ps_role", "all", "role of this node (reference parity; 'all' on TPU)")
+MV_DEFINE_bool("ma", False, "model-averaging mode: no tables, MV_Aggregate only")
+MV_DEFINE_bool("sync", False, "BSP-synchronous update application")
+MV_DEFINE_int("num_shards", 0, "table shard axis size (0 = role ALL 1-D mesh)")
+MV_DEFINE_bool("multihost", False, "call jax.distributed.initialize() at start")
+
+
+class Runtime:
+    """Singleton runtime (``Zoo`` equivalent). Use ``runtime()`` accessor."""
+
+    _instance: Optional["Runtime"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.mesh: Optional[Mesh] = None
+        self._started = False
+        self._tables: List[Any] = []
+        self._barrier_fn = None
+        self._barrier_input = None
+
+    # ------------------------------------------------------------------ setup
+
+    @classmethod
+    def instance(cls) -> "Runtime":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = Runtime()
+            return cls._instance
+
+    def start(
+        self,
+        argv: Optional[Sequence[str]] = None,
+        mesh: Optional[Mesh] = None,
+        num_shards: Optional[int] = None,
+    ) -> List[str]:
+        """Bring up the runtime (``MV_Init`` body — ref: src/multiverso.cpp:11).
+
+        Returns the compacted argv (flags consumed), like ``ParseCMDFlags``.
+        """
+        remaining = ParseCMDFlags(argv)
+        if self._started:
+            return remaining
+        if GetFlag("multihost"):
+            jax.distributed.initialize()
+        if mesh is None:
+            flag_shards = num_shards if num_shards is not None else GetFlag("num_shards")
+            mesh = mesh_lib.build_mesh(num_shards=flag_shards or None)
+        self.mesh = mesh
+        self._started = True
+        self._build_barrier()
+        self.barrier()
+        Log.Info(
+            "multiverso_tpu runtime started: %d device(s), %d worker(s), %d shard(s), sync=%s",
+            len(self.mesh.devices.flatten()),
+            self.num_workers,
+            self.num_servers,
+            GetFlag("sync"),
+        )
+        return remaining
+
+    def shut_down(self, finalize: bool = True) -> None:
+        """``MV_ShutDown`` (ref: src/multiverso.cpp:24-33). ``finalize=False``
+        keeps the runtime alive across test suites, like the reference keeps
+        MPI alive (SURVEY.md §4 note on ``MV_ShutDown(false)``)."""
+        if not self._started:
+            return
+        self.barrier()
+        self._tables.clear()
+        if finalize:
+            self.mesh = None
+            self._barrier_fn = None
+            self._barrier_input = None
+            self._started = False
+
+    # ------------------------------------------------------------ identity
+
+    def _require_started(self) -> Mesh:
+        if not self._started or self.mesh is None:
+            raise FatalError("multiverso_tpu runtime not started; call MV_Init first")
+        return self.mesh
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    @property
+    def rank(self) -> int:
+        """Host process rank (reference: MPI rank — multi-host only >0)."""
+        return jax.process_index()
+
+    @property
+    def size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def num_workers(self) -> int:
+        return mesh_lib.num_workers(self._require_started())
+
+    @property
+    def num_servers(self) -> int:
+        return mesh_lib.num_shards(self._require_started())
+
+    @property
+    def worker_id(self) -> int:
+        """First worker id driven by this host process (single-controller: 0)."""
+        return self.rank * (self.num_workers // max(self.size, 1))
+
+    @property
+    def server_id(self) -> int:
+        return self.rank * (self.num_servers // max(self.size, 1))
+
+    # ------------------------------------------------------------ collectives
+
+    def _build_barrier(self) -> None:
+        mesh = self.mesh
+        assert mesh is not None
+        ndev = len(mesh.devices.flatten())
+        spec = P(mesh.axis_names)  # all axes collapsed onto dim 0
+        self._barrier_input = jax.device_put(
+            np.ones((ndev,), np.int32), NamedSharding(mesh, spec)
+        )
+        self._barrier_fn = jax.jit(
+            lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+        )
+
+    def barrier(self) -> None:
+        """Device-collective barrier (``MV_Barrier`` — ref: src/zoo.cpp:164-176).
+
+        Runs an all-reduce over the full mesh and blocks the host on the
+        result; multi-host additionally syncs processes.
+        """
+        self._require_started()
+        if self.size > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("multiverso_tpu_barrier")
+        out = self._barrier_fn(self._barrier_input)
+        jax.block_until_ready(out)
+        ndev = len(self.mesh.devices.flatten())
+        CHECK(int(out) == ndev, "barrier allreduce mismatch")
+
+    def aggregate(self, per_worker: Any) -> np.ndarray:
+        """``MV_Aggregate`` — model-averaging allreduce (ref:
+        src/multiverso.cpp:53-56 → MPI_Allreduce SUM; SURVEY.md §3.5).
+
+        ``per_worker`` has shape ``(num_workers, ...)``; each slice is one
+        worker's contribution. Returns the elementwise sum, computed as a
+        sharded reduce over the worker axis (XLA lowers to an ICI
+        all-reduce), replicated to every device.
+        """
+        mesh = self._require_started()
+        arr = jnp.asarray(per_worker)
+        CHECK(
+            arr.ndim >= 1 and arr.shape[0] == self.num_workers,
+            f"aggregate expects leading dim == num_workers ({self.num_workers}), "
+            f"got shape {arr.shape}",
+        )
+        sharded = jax.device_put(arr, mesh_lib.worker_sharding(mesh, arr.ndim))
+        summed = jax.jit(
+            lambda x: jnp.sum(x, axis=0),
+            out_shardings=mesh_lib.replicated_sharding(mesh),
+        )(sharded)
+        return np.asarray(summed)
+
+    # ------------------------------------------------------------ tables
+
+    def register_table(self, table: Any) -> int:
+        """Assign the next dense table id (ref: src/zoo.cpp:178-187 —
+        consistent across ranks because creation order is identical)."""
+        self._require_started()
+        table_id = len(self._tables)
+        self._tables.append(table)
+        return table_id
+
+    def table(self, table_id: int) -> Any:
+        return self._tables[table_id]
+
+    @property
+    def tables(self) -> List[Any]:
+        return list(self._tables)
+
+
+def runtime() -> Runtime:
+    return Runtime.instance()

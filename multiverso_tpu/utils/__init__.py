@@ -1,0 +1,46 @@
+"""Utility layer: flags, logging, timing, instrumentation.
+
+TPU-native rebuild of the reference utility layer
+(ref: include/multiverso/util/, src/util/ — SURVEY.md §2.1/§2.5). The pieces
+the TPU runtime makes obsolete are intentionally absent:
+
+* ``MtQueue`` / ``Waiter`` / actor mailboxes — JAX's async dispatch already
+  gives every table op a future-like handle (``jax.Array`` +
+  ``block_until_ready``); there is no actor thread pool to feed.
+* ``Allocator`` / ``Blob`` — buffers live in HBM and are managed by the XLA
+  runtime allocator; host-side staging uses numpy.
+* ``net_util`` — no sockets; the mesh fabric is ICI/DCN owned by XLA.
+"""
+
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_bool,
+    MV_DEFINE_double,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    GetFlag,
+    ParseCMDFlags,
+    SetCMDFlag,
+)
+from multiverso_tpu.utils.dashboard import Dashboard, Monitor, monitor
+from multiverso_tpu.utils.log import CHECK, CHECK_NOTNULL, FatalError, Log, LogLevel, Logger
+from multiverso_tpu.utils.timer import Timer
+
+__all__ = [
+    "MV_DEFINE_bool",
+    "MV_DEFINE_double",
+    "MV_DEFINE_int",
+    "MV_DEFINE_string",
+    "GetFlag",
+    "ParseCMDFlags",
+    "SetCMDFlag",
+    "Dashboard",
+    "Monitor",
+    "monitor",
+    "CHECK",
+    "CHECK_NOTNULL",
+    "FatalError",
+    "Log",
+    "LogLevel",
+    "Logger",
+    "Timer",
+]

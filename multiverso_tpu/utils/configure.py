@@ -1,0 +1,148 @@
+"""Typed flag / configuration registry.
+
+TPU-native equivalent of the reference's gflags-clone
+(ref: include/multiverso/util/configure.h:13-114, src/util/configure.cpp:9-54).
+Semantics preserved:
+
+* typed flag declaration via ``MV_DEFINE_int/bool/string/double`` (one registry
+  per type in the reference; a single typed registry here),
+* ``ParseCMDFlags(argv)`` consumes ``-key=value`` entries and *compacts* the
+  argv, returning only the entries it did not recognise
+  (ref: src/util/configure.cpp:19-53),
+* programmatic override via ``SetCMDFlag`` / ``MV_SetFlag``
+  (ref: include/multiverso/multiverso.h:31-33).
+
+Unlike the reference there is no static-initialisation-order dance: flags are
+declared at import time of the defining module.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = [
+    "MV_DEFINE_int",
+    "MV_DEFINE_bool",
+    "MV_DEFINE_string",
+    "MV_DEFINE_double",
+    "ParseCMDFlags",
+    "GetFlag",
+    "SetCMDFlag",
+    "ResetFlagsToDefault",
+    "AllFlags",
+]
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name: str, default: Any, type_: type, help_: str):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type_
+        self.help = help_
+
+
+_lock = threading.Lock()
+_registry: Dict[str, _Flag] = {}
+
+
+def _define(name: str, default: Any, type_: type, help_: str) -> None:
+    with _lock:
+        existing = _registry.get(name)
+        if existing is not None:
+            if existing.type is not type_:
+                raise ValueError(
+                    f"flag {name!r} redefined with different type "
+                    f"({existing.type.__name__} vs {type_.__name__})"
+                )
+            return  # idempotent re-definition (module reloads)
+        _registry[name] = _Flag(name, default, type_, help_)
+
+
+def MV_DEFINE_int(name: str, default: int = 0, help: str = "") -> None:
+    _define(name, int(default), int, help)
+
+
+def MV_DEFINE_bool(name: str, default: bool = False, help: str = "") -> None:
+    _define(name, bool(default), bool, help)
+
+
+def MV_DEFINE_string(name: str, default: str = "", help: str = "") -> None:
+    _define(name, str(default), str, help)
+
+
+def MV_DEFINE_double(name: str, default: float = 0.0, help: str = "") -> None:
+    _define(name, float(default), float, help)
+
+
+def _coerce(flag: _Flag, raw: Any) -> Any:
+    if flag.type is bool:
+        if isinstance(raw, str):
+            low = raw.strip().lower()
+            if low in ("true", "1", "yes", "on"):
+                return True
+            if low in ("false", "0", "no", "off"):
+                return False
+            raise ValueError(f"cannot parse {raw!r} as bool for flag {flag.name!r}")
+        return bool(raw)
+    return flag.type(raw)
+
+
+def GetFlag(name: str, default: Optional[Any] = None) -> Any:
+    with _lock:
+        flag = _registry.get(name)
+        if flag is None:
+            if default is not None:
+                return default
+            raise KeyError(f"unknown flag {name!r}")
+        return flag.value
+
+
+def SetCMDFlag(name: str, value: Any) -> None:
+    """Programmatic flag override (ref: configure.h:86-90, multiverso.h:31-33)."""
+    with _lock:
+        flag = _registry.get(name)
+        if flag is None:
+            raise KeyError(f"unknown flag {name!r}")
+        flag.value = _coerce(flag, value)
+
+
+def ParseCMDFlags(argv: Optional[Sequence[str]]) -> List[str]:
+    """Consume ``-key=value`` entries; return the compacted remainder.
+
+    Mirrors the reference's argv-compacting parse loop
+    (ref: src/util/configure.cpp:19-53): entries that look like ``-key=value``
+    (or ``--key=value``) for a *registered* key are consumed; everything else
+    is passed through in order.
+    """
+    if argv is None:
+        return []
+    remaining: List[str] = []
+    for arg in argv:
+        consumed = False
+        if isinstance(arg, str) and arg.startswith("-") and "=" in arg:
+            body = arg.lstrip("-")
+            key, _, val = body.partition("=")
+            with _lock:
+                flag = _registry.get(key)
+                if flag is not None:
+                    flag.value = _coerce(flag, val)
+                    consumed = True
+        if not consumed:
+            remaining.append(arg)
+    return remaining
+
+
+def ResetFlagsToDefault() -> None:
+    """Restore every flag to its declared default (test isolation helper)."""
+    with _lock:
+        for flag in _registry.values():
+            flag.value = flag.default
+
+
+def AllFlags() -> Dict[str, Any]:
+    with _lock:
+        return {name: f.value for name, f in _registry.items()}

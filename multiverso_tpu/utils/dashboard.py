@@ -1,0 +1,99 @@
+"""Monitor / Dashboard instrumentation.
+
+TPU-native equivalent of the reference profiling dashboard
+(ref: include/multiverso/dashboard.h:16-74, src/dashboard.cpp). Semantics
+preserved: a process-wide name -> Monitor map where each Monitor accumulates
+{count, total elapsed ms}; ``MONITOR_BEGIN/END(name)`` macro pairs become the
+``monitor(name)`` context manager; ``Dashboard.Display()`` dumps everything.
+
+Extension over the reference: ``monitor(name, trace=True)`` additionally opens
+a ``jax.profiler.TraceAnnotation`` so the region shows up in TPU profiler
+traces alongside the host-side timing.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+from multiverso_tpu.utils.timer import Timer
+
+__all__ = ["Monitor", "Dashboard", "monitor"]
+
+
+class Monitor:
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.elapsed_ms = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, elapsed_ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.elapsed_ms += elapsed_ms
+
+    @property
+    def average_ms(self) -> float:
+        return self.elapsed_ms / self.count if self.count else 0.0
+
+    def info_string(self) -> str:
+        return (
+            f"[Monitor] {self.name}: count={self.count} "
+            f"total={self.elapsed_ms:.3f}ms avg={self.average_ms:.3f}ms"
+        )
+
+
+class Dashboard:
+    """Static name -> Monitor registry (ref: dashboard.h:16-40)."""
+
+    _lock = threading.Lock()
+    _monitors: Dict[str, Monitor] = {}
+
+    @classmethod
+    def get(cls, name: str) -> Monitor:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+            if mon is None:
+                mon = Monitor(name)
+                cls._monitors[name] = mon
+            return mon
+
+    @classmethod
+    def Display(cls) -> str:
+        with cls._lock:
+            lines = [m.info_string() for m in cls._monitors.values()]
+        out = "\n".join(lines)
+        if out:
+            print(out, flush=True)
+        return out
+
+    @classmethod
+    def Reset(cls) -> None:
+        with cls._lock:
+            cls._monitors.clear()
+
+
+@contextmanager
+def monitor(name: str, trace: bool = False) -> Iterator[Monitor]:
+    """MONITOR_BEGIN/END pair (ref: dashboard.h:61-74) as a context manager.
+
+    With ``trace=True`` the region is also annotated in the JAX profiler
+    timeline (device-side visibility; the host timing still lands in the
+    Dashboard).
+    """
+    mon = Dashboard.get(name)
+    timer = Timer()
+    ann = None
+    if trace:
+        import jax.profiler  # deferred: keep dashboard importable without jax
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    try:
+        yield mon
+    finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        mon.add(timer.elapse())

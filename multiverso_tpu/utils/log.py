@@ -1,0 +1,133 @@
+"""Leveled logger + CHECK asserts.
+
+TPU-native equivalent of the reference logger
+(ref: include/multiverso/util/log.h:9-142, src/util/log.cpp).
+Semantics preserved: Debug/Info/Error/Fatal levels with timestamped prefix,
+optional file sink, kill-on-fatal toggle (here: raise ``FatalError`` instead of
+``exit()`` so tests can assert on it), ``-logtostderr``-style control, and the
+``CHECK`` / ``CHECK_NOTNULL`` macros (ref: util/log.h:10-18).
+"""
+
+from __future__ import annotations
+
+import datetime
+import enum
+import io
+import sys
+import threading
+from typing import Any, Optional
+
+from multiverso_tpu.utils.configure import MV_DEFINE_bool, GetFlag
+
+__all__ = ["LogLevel", "Log", "Logger", "FatalError", "CHECK", "CHECK_NOTNULL"]
+
+MV_DEFINE_bool("logtostderr", False, "send log output to stderr instead of stdout")
+
+
+class LogLevel(enum.IntEnum):
+    Debug = 0
+    Info = 1
+    Error = 2
+    Fatal = 3
+
+
+class FatalError(RuntimeError):
+    """Raised by Log.Fatal / failed CHECK (the reference calls exit(1))."""
+
+
+class Logger:
+    """Instance logger; the module-level ``Log`` wraps a process singleton."""
+
+    def __init__(self, level: LogLevel = LogLevel.Info, file: Optional[str] = None):
+        self._level = level
+        self._lock = threading.Lock()
+        self._file: Optional[io.TextIOBase] = None
+        if file:
+            self.ResetLogFile(file)
+
+    def ResetLogLevel(self, level: LogLevel) -> None:
+        self._level = level
+
+    def ResetLogFile(self, filename: Optional[str]) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            if filename:
+                self._file = open(filename, "a")
+
+    def _write(self, level: LogLevel, fmt: str, *args: Any) -> None:
+        if level < self._level:
+            return
+        msg = (fmt % args) if args else fmt
+        stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+        line = f"[{level.name.upper()}] [{stamp}] {msg}"
+        with self._lock:
+            stream = sys.stderr if GetFlag("logtostderr") else sys.stdout
+            print(line, file=stream, flush=True)
+            if self._file is not None:
+                self._file.write(line + "\n")
+                self._file.flush()
+
+    def Debug(self, fmt: str, *args: Any) -> None:
+        self._write(LogLevel.Debug, fmt, *args)
+
+    def Info(self, fmt: str, *args: Any) -> None:
+        self._write(LogLevel.Info, fmt, *args)
+
+    def Error(self, fmt: str, *args: Any) -> None:
+        self._write(LogLevel.Error, fmt, *args)
+
+    def Fatal(self, fmt: str, *args: Any) -> None:
+        self._write(LogLevel.Fatal, fmt, *args)
+        raise FatalError((fmt % args) if args else fmt)
+
+
+class _LogSingleton:
+    """Static-style facade, mirroring the reference's ``Log`` static class."""
+
+    _logger = Logger()
+
+    @classmethod
+    def logger(cls) -> Logger:
+        return cls._logger
+
+    @classmethod
+    def ResetLogLevel(cls, level: LogLevel) -> None:
+        cls._logger.ResetLogLevel(level)
+
+    @classmethod
+    def ResetLogFile(cls, filename: Optional[str]) -> None:
+        cls._logger.ResetLogFile(filename)
+
+    @classmethod
+    def Debug(cls, fmt: str, *args: Any) -> None:
+        cls._logger.Debug(fmt, *args)
+
+    @classmethod
+    def Info(cls, fmt: str, *args: Any) -> None:
+        cls._logger.Info(fmt, *args)
+
+    @classmethod
+    def Error(cls, fmt: str, *args: Any) -> None:
+        cls._logger.Error(fmt, *args)
+
+    @classmethod
+    def Fatal(cls, fmt: str, *args: Any) -> None:
+        cls._logger.Fatal(fmt, *args)
+
+
+Log = _LogSingleton
+
+
+def CHECK(condition: Any, message: str = "CHECK failed") -> None:
+    """Fatal assert (ref: util/log.h:10-14)."""
+    if not condition:
+        Log.Fatal(message)
+
+
+def CHECK_NOTNULL(pointer: Any, name: str = "value") -> Any:
+    """Fatal assert on None (ref: util/log.h:15-18). Returns the value."""
+    if pointer is None:
+        Log.Fatal("%s must not be None", name)
+    return pointer

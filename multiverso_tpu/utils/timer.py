@@ -1,0 +1,28 @@
+"""Stopwatch timer (ref: include/multiverso/util/timer.h, src/timer.cpp)."""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["Timer"]
+
+
+class Timer:
+    """Monotonic stopwatch; elapsed() in milliseconds like the reference."""
+
+    def __init__(self):
+        self._start = time.monotonic()
+
+    def Start(self) -> None:
+        self._start = time.monotonic()
+
+    def elapse(self) -> float:
+        """Elapsed milliseconds since Start()/construction."""
+        return (time.monotonic() - self._start) * 1000.0
+
+    # pythonic aliases
+    start = Start
+    elapsed_ms = elapse
+
+    def elapsed_s(self) -> float:
+        return time.monotonic() - self._start

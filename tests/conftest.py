@@ -1,0 +1,53 @@
+"""Test fixtures.
+
+The reference simulates multi-node with ``mpirun -np N`` on one host
+(SURVEY.md §4); we simulate an N-device TPU pod with N fake CPU devices
+(``--xla_force_host_platform_device_count``) — env vars must be set before
+jax initialises, hence at conftest import time.
+
+``mv_env`` / ``sync_mv_env`` mirror the reference RAII fixtures
+``MultiversoEnv`` / ``SyncMultiversoEnv`` (ref:
+Test/unittests/multiverso_env.h:9-29): a *real* single-process cluster around
+each test, not a mock — here a real 8-device mesh with real XLA collectives.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment preloads jax at interpreter startup (site hook), so the env
+# var alone is too late — override the live config before any backend is built.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mv_env():
+    """Async-mode runtime around a test (ref: multiverso_env.h:9-19)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+
+    ResetFlagsToDefault()
+    mv.MV_Init()
+    yield mv
+    mv.MV_ShutDown(finalize=True)
+    ResetFlagsToDefault()
+
+
+@pytest.fixture
+def sync_mv_env():
+    """Sync(BSP)-mode runtime (ref: multiverso_env.h:21-29)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+
+    ResetFlagsToDefault()
+    mv.MV_Init(["-sync=true"])
+    yield mv
+    mv.MV_ShutDown(finalize=True)
+    ResetFlagsToDefault()

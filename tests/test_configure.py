@@ -1,0 +1,59 @@
+"""Flag registry tests (ref semantics: src/util/configure.cpp:9-54)."""
+
+import pytest
+
+from multiverso_tpu.utils import configure as cfg
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    cfg.ResetFlagsToDefault()
+    yield
+    cfg.ResetFlagsToDefault()
+
+
+def test_define_and_get():
+    cfg.MV_DEFINE_int("t_int", 7, "help")
+    cfg.MV_DEFINE_bool("t_bool", True, "help")
+    cfg.MV_DEFINE_string("t_str", "abc", "help")
+    cfg.MV_DEFINE_double("t_dbl", 1.5, "help")
+    assert cfg.GetFlag("t_int") == 7
+    assert cfg.GetFlag("t_bool") is True
+    assert cfg.GetFlag("t_str") == "abc"
+    assert cfg.GetFlag("t_dbl") == 1.5
+
+
+def test_parse_compacts_argv():
+    # the reference consumes -key=value entries and compacts argv
+    # (configure.cpp:19-53)
+    cfg.MV_DEFINE_int("t_workers", 0)
+    cfg.MV_DEFINE_bool("t_sync", False)
+    argv = ["prog", "-t_workers=4", "positional", "-t_sync=true", "-unknown=1"]
+    rest = cfg.ParseCMDFlags(argv)
+    assert rest == ["prog", "positional", "-unknown=1"]
+    assert cfg.GetFlag("t_workers") == 4
+    assert cfg.GetFlag("t_sync") is True
+
+
+def test_set_cmd_flag_coerces():
+    cfg.MV_DEFINE_bool("t_flag", False)
+    cfg.SetCMDFlag("t_flag", "true")
+    assert cfg.GetFlag("t_flag") is True
+    cfg.MV_DEFINE_double("t_lr", 0.0)
+    cfg.SetCMDFlag("t_lr", "0.05")
+    assert cfg.GetFlag("t_lr") == pytest.approx(0.05)
+
+
+def test_unknown_flag_raises():
+    with pytest.raises(KeyError):
+        cfg.GetFlag("no_such_flag")
+    with pytest.raises(KeyError):
+        cfg.SetCMDFlag("no_such_flag", 1)
+
+
+def test_redefine_same_type_is_idempotent():
+    cfg.MV_DEFINE_int("t_re", 3)
+    cfg.MV_DEFINE_int("t_re", 9)  # ignored, first definition wins
+    assert cfg.GetFlag("t_re") == 3
+    with pytest.raises(ValueError):
+        cfg.MV_DEFINE_string("t_re", "x")

@@ -1,0 +1,47 @@
+"""Logger / CHECK / Dashboard tests (ref: util/log.h, dashboard.h)."""
+
+import time
+
+import pytest
+
+from multiverso_tpu.utils.dashboard import Dashboard, monitor
+from multiverso_tpu.utils.log import CHECK, CHECK_NOTNULL, FatalError, Log, LogLevel, Logger
+
+
+def test_fatal_raises():
+    with pytest.raises(FatalError):
+        Log.Fatal("boom %d", 42)
+
+
+def test_check():
+    CHECK(True)
+    with pytest.raises(FatalError):
+        CHECK(False, "nope")
+    assert CHECK_NOTNULL(5) == 5
+    with pytest.raises(FatalError):
+        CHECK_NOTNULL(None)
+
+
+def test_logger_file_sink(tmp_path, capsys):
+    path = tmp_path / "log.txt"
+    logger = Logger(LogLevel.Info)
+    logger.ResetLogFile(str(path))
+    logger.Info("hello %s", "world")
+    logger.Debug("filtered")  # below level
+    logger.ResetLogFile(None)
+    text = path.read_text()
+    assert "hello world" in text
+    assert "filtered" not in text
+
+
+def test_monitor_accumulates():
+    Dashboard.Reset()
+    for _ in range(3):
+        with monitor("unit_test_region"):
+            time.sleep(0.001)
+    mon = Dashboard.get("unit_test_region")
+    assert mon.count == 3
+    assert mon.elapsed_ms >= 3 * 1.0
+    out = Dashboard.Display()
+    assert "unit_test_region" in out
+    Dashboard.Reset()

@@ -1,0 +1,64 @@
+"""Runtime (Zoo-equivalent) tests on the fake 8-device mesh.
+
+Ref parity: node/role bookkeeping (Test/unittests/test_node.cpp), barrier
+semantics (src/zoo.cpp:164-176), MV_Aggregate allreduce invariant
+(Test/test_allreduce.cpp:11-21 — sum of per-worker ones == num workers).
+"""
+
+import numpy as np
+import pytest
+
+
+def test_init_and_identity(mv_env):
+    mv = mv_env
+    assert mv.MV_Rank() == 0
+    assert mv.MV_Size() == 1
+    assert mv.MV_NumWorkers() == 8  # 8 fake devices, role ALL
+    assert mv.MV_NumServers() == 8
+    assert mv.MV_WorkerId() == 0
+    mv.MV_Barrier()  # must not deadlock/raise
+
+
+def test_aggregate_sum_invariant(mv_env):
+    # each worker contributes ones -> sum == num_workers (test_allreduce.cpp:11-21)
+    mv = mv_env
+    nw = mv.MV_NumWorkers()
+    out = mv.MV_Aggregate(np.ones((nw, 16), np.float32))
+    np.testing.assert_allclose(out, np.full((16,), nw, np.float32))
+
+
+def test_aggregate_distinct_contributions(mv_env):
+    mv = mv_env
+    nw = mv.MV_NumWorkers()
+    per_worker = np.arange(nw * 4, dtype=np.float32).reshape(nw, 4)
+    out = mv.MV_Aggregate(per_worker)
+    np.testing.assert_allclose(out, per_worker.sum(axis=0))
+
+
+def test_aggregate_shape_check(mv_env):
+    from multiverso_tpu.utils.log import FatalError
+
+    with pytest.raises(FatalError):
+        mv_env.MV_Aggregate(np.ones((3, 4), np.float32))  # wrong leading dim
+
+
+def test_two_d_mesh():
+    import multiverso_tpu as mv
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+
+    ResetFlagsToDefault()
+    mv.MV_Init(num_shards=2)
+    try:
+        assert mv.MV_NumWorkers() == 4
+        assert mv.MV_NumServers() == 2
+        mv.MV_Barrier()
+    finally:
+        mv.MV_ShutDown(finalize=True)
+        ResetFlagsToDefault()
+
+
+def test_netbind_raises(mv_env):
+    from multiverso_tpu.utils.log import FatalError
+
+    with pytest.raises(FatalError):
+        mv_env.MV_NetBind(0, "tcp://127.0.0.1:5555")
