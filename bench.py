@@ -1,0 +1,113 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Workload: the north-star metric (BASELINE.json) — WordEmbedding skip-gram
+negative-sampling training throughput per chip. V=100k vocab, dim=128, batch
+8192 pairs, 5 negatives (word2vec defaults scale).
+
+``value`` is training pairs/sec on the fused TPU-native step (each pair is
+one (center, context-or-negative-set) sample — the unit the reference's inner
+training loop processes per iteration; ref:
+Applications/WordEmbedding/src/wordembedding.cpp:120-166).
+
+``vs_baseline``: the reference publishes no absolute words/sec (BASELINE.md),
+so the baseline here is an in-repo emulation of the reference *architecture*
+on identical hardware: a host-driven parameter-server loop where every batch
+does table Get(rows) -> host -> compute -> Add(rows) round trips through the
+table API (the reference's §3.3/§3.4 hot path). vs_baseline = fused / PS-loop.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _bench_fused(cfg, steps=30, warmup=5, batch=8192):
+    from multiverso_tpu.models.wordembedding.skipgram import init_params, make_batch, make_sgd_step
+
+    params = init_params(cfg)
+    step = jax.jit(make_sgd_step(cfg), donate_argnums=(0,))
+    rng = np.random.RandomState(0)
+    centers, outputs, _ = make_batch(rng, cfg, batch)
+    centers, outputs = jnp.asarray(centers), jnp.asarray(outputs)
+    lr = jnp.float32(0.025)
+    for _ in range(warmup):
+        params, loss = step(params, centers, outputs, None, lr)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, loss = step(params, centers, outputs, None, lr)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
+    """Reference-architecture emulation: per-batch Get/Add through the table
+    API with host staging (the MPI-PS data path without the network)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.skipgram import make_batch
+    from multiverso_tpu.tables import MatrixTableOption
+
+    t_in = mv.MV_CreateTable(
+        MatrixTableOption(num_row=cfg.vocab_size, num_col=cfg.dim,
+                          init_uniform=(-0.5 / cfg.dim, 0.5 / cfg.dim))
+    )
+    t_out = mv.MV_CreateTable(MatrixTableOption(num_row=cfg.vocab_size, num_col=cfg.dim))
+    rng = np.random.RandomState(0)
+    centers, outputs, _ = make_batch(rng, cfg, batch)
+    flat_out = outputs.reshape(-1)
+    lr = 0.025
+
+    def one_step():
+        vin = t_in.get_rows(centers)  # PS round trip 1
+        vout = t_out.get_rows(flat_out).reshape(batch, -1, cfg.dim)  # round trip 2
+        logits = np.einsum("bd,bkd->bk", vin, vout)
+        labels = np.zeros_like(logits)
+        labels[:, 0] = 1.0
+        g = (1.0 / (1.0 + np.exp(-logits)) - labels) / batch
+        d_vin = np.einsum("bk,bkd->bd", g, vout)
+        d_vout = g[..., None] * vin[:, None, :]
+        t_in.add_rows(centers, lr * d_vin, _sgd)  # PS round trip 3
+        t_out.add_rows(flat_out, lr * d_vout.reshape(-1, cfg.dim), _sgd)
+        t_in.wait()
+        t_out.wait()
+
+    from multiverso_tpu.updaters import AddOption
+
+    _sgd = AddOption()
+    for _ in range(warmup):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_step()
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.skipgram import SkipGramConfig
+
+    mv.MV_Init(["-updater_type=sgd"])
+    cfg = SkipGramConfig(vocab_size=100_000, dim=128, negatives=5)
+    fused = _bench_fused(cfg)
+    ps = _bench_ps_loop(cfg)
+    print(
+        json.dumps(
+            {
+                "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
+                "value": round(fused, 1),
+                "unit": "pairs/sec",
+                "vs_baseline": round(fused / ps, 3),
+            }
+        )
+    )
+    mv.MV_ShutDown()
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ no equivalent code because the SPMD model subsumes it.
 from multiverso_tpu.api import (
     MV_Aggregate,
     MV_Barrier,
+    MV_CreateTable,
     MV_Init,
     MV_NetBind,
     MV_NetConnect,
@@ -36,6 +37,7 @@ __version__ = "0.1.0"
 __all__ = [
     "MV_Aggregate",
     "MV_Barrier",
+    "MV_CreateTable",
     "MV_Init",
     "MV_NetBind",
     "MV_NetConnect",

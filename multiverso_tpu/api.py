@@ -14,6 +14,7 @@ from multiverso_tpu.utils.configure import SetCMDFlag
 from multiverso_tpu.utils.log import Log
 
 __all__ = [
+    "MV_CreateTable",
     "MV_Init",
     "MV_ShutDown",
     "MV_Barrier",
@@ -74,6 +75,14 @@ def MV_SetFlag(name: str, value: Any) -> None:
 def MV_Aggregate(per_worker: Any):
     """Model-averaging allreduce over the worker axis (ref: src/multiverso.cpp:53-56)."""
     return runtime().aggregate(per_worker)
+
+
+def MV_CreateTable(option):
+    """Create a sharded table from its option record (ref:
+    include/multiverso/multiverso.h:35-41)."""
+    from multiverso_tpu.tables.base import create_table
+
+    return create_table(option)
 
 
 def MV_NetBind(rank: int, endpoint: str) -> None:

@@ -1,0 +1,2 @@
+"""Model/application layer: the reference's two applications rebuilt TPU-first
+(WordEmbedding — SURVEY.md §2.7; LogisticRegression — SURVEY.md §2.7)."""

@@ -1,0 +1,18 @@
+"""Distributed word2vec (WordEmbedding application).
+
+TPU-first rebuild of Applications/WordEmbedding (ref: SURVEY.md §2.7): the
+reference trains per-window scalar loops over locally-cached rows
+(ref: Applications/WordEmbedding/src/wordembedding.cpp:57-166); here training
+is a batched jitted SPMD step — row gathers from sharded embedding tables,
+one MXU matmul per batch for the dot products, closed-form gradients, and
+scatter-add updates.
+"""
+
+from multiverso_tpu.models.wordembedding.skipgram import (
+    SkipGramConfig,
+    init_params,
+    loss_fn,
+    make_sgd_step,
+)
+
+__all__ = ["SkipGramConfig", "init_params", "loss_fn", "make_sgd_step"]

@@ -51,7 +51,11 @@ __all__ = ["Runtime", "runtime"]
 # hardware (see module docstring).
 MV_DEFINE_string("ps_role", "all", "role of this node (reference parity; 'all' on TPU)")
 MV_DEFINE_bool("ma", False, "model-averaging mode: no tables, MV_Aggregate only")
-MV_DEFINE_bool("sync", False, "BSP-synchronous update application")
+# NOTE: under a single-controller SPMD program, core table Get/Add are issued
+# in program order, so the reference's sync(BSP)-vs-async distinction is
+# deterministic by construction; the flag gates the *staleness* features
+# (pipeline double-buffer gets, sync_frequency batching) in the handler layer.
+MV_DEFINE_bool("sync", False, "BSP-synchronous update application (see note above)")
 MV_DEFINE_int("num_shards", 0, "table shard axis size (0 = role ALL 1-D mesh)")
 MV_DEFINE_bool("multihost", False, "call jax.distributed.initialize() at start")
 
@@ -68,6 +72,7 @@ class Runtime:
         self._tables: List[Any] = []
         self._barrier_fn = None
         self._barrier_input = None
+        self._aggregate_fn = None
 
     # ------------------------------------------------------------------ setup
 
@@ -90,6 +95,11 @@ class Runtime:
         """
         remaining = ParseCMDFlags(argv)
         if self._started:
+            if mesh is not None or num_shards not in (None, 0):
+                Log.Fatal(
+                    "runtime already started; MV_ShutDown(finalize=True) before "
+                    "re-initialising with a different mesh"
+                )
             return remaining
         if GetFlag("multihost"):
             jax.distributed.initialize()
@@ -121,6 +131,7 @@ class Runtime:
             self.mesh = None
             self._barrier_fn = None
             self._barrier_input = None
+            self._aggregate_fn = None
             self._started = False
 
     # ------------------------------------------------------------ identity
@@ -173,6 +184,11 @@ class Runtime:
         self._barrier_fn = jax.jit(
             lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
         )
+        # cached once so repeated MV_Aggregate calls hit the jit cache
+        self._aggregate_fn = jax.jit(
+            lambda x: jnp.sum(x, axis=0),
+            out_shardings=mesh_lib.replicated_sharding(mesh),
+        )
 
     def barrier(self) -> None:
         """Device-collective barrier (``MV_Barrier`` — ref: src/zoo.cpp:164-176).
@@ -207,11 +223,7 @@ class Runtime:
             f"got shape {arr.shape}",
         )
         sharded = jax.device_put(arr, mesh_lib.worker_sharding(mesh, arr.ndim))
-        summed = jax.jit(
-            lambda x: jnp.sum(x, axis=0),
-            out_shardings=mesh_lib.replicated_sharding(mesh),
-        )(sharded)
-        return np.asarray(summed)
+        return np.asarray(self._aggregate_fn(sharded))
 
     # ------------------------------------------------------------ tables
 

@@ -1,0 +1,20 @@
+"""Table layer: sharded parameter stores with PS Get/Add semantics.
+
+Rebuilds the reference table layer (SURVEY.md §2.3) on sharded jax.Arrays:
+ArrayTable (1-D), MatrixTable (2-D row-sharded), SparseMatrixTable
+(delta-tracking), KVTable (hash-sharded).
+"""
+
+from multiverso_tpu.tables.array_table import ArrayTable, ArrayTableOption
+from multiverso_tpu.tables.base import DenseTable, TableOption, create_table
+from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
+
+__all__ = [
+    "ArrayTable",
+    "ArrayTableOption",
+    "DenseTable",
+    "MatrixTable",
+    "MatrixTableOption",
+    "TableOption",
+    "create_table",
+]

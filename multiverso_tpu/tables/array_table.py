@@ -1,0 +1,46 @@
+"""1-D dense array table.
+
+TPU-native rebuild of the reference ArrayTable
+(ref: include/multiverso/table/array_table.h:13-73,
+src/table/array_table.cpp): a 1-D ``T[]`` sharded contiguously across servers;
+worker Get always fetches the whole table (the reference's key=-1 protocol —
+ref: array_table.cpp:88-95), Add sends a whole-size delta. Here: storage is a
+``jax.Array`` sharded over the shard axis; Get is one all-gather; Add is one
+reduce-scatter + updater program (see tables/base.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+from multiverso_tpu.tables.base import DenseTable, TableOption, register_table_type
+
+__all__ = ["ArrayTableOption", "ArrayTable"]
+
+
+@dataclasses.dataclass
+class ArrayTableOption(TableOption):
+    """Ref: ArrayTableOption<T>{size} (array_table.h:62-73) + dtype/updater
+    selection that the reference takes from template params and flags."""
+
+    size: int
+    dtype: Any = "float32"
+    updater_type: Optional[str] = None
+    init_value: Optional[np.ndarray] = None
+    name: str = "array_table"
+
+
+@register_table_type(ArrayTableOption)
+class ArrayTable(DenseTable):
+    def __init__(self, option: ArrayTableOption):
+        super().__init__(
+            shape=(option.size,),
+            dtype=option.dtype,
+            updater_type=option.updater_type,
+            init_value=option.init_value,
+            name=option.name,
+        )
+        self.size = option.size

@@ -1,0 +1,282 @@
+"""Table base: sharded storage + collective get/add programs + factory.
+
+The reference splits a table into a client half (``WorkerTable`` — assigns
+msg ids, partitions requests across servers, waits on replies; ref:
+include/multiverso/table_interface.h:24-56) and a storage half
+(``ServerTable`` — applies updates via the updater; ref:
+table_interface.h:61-75). On TPU both halves are one object: storage is a
+``jax.Array`` sharded over the mesh's shard axis, and a Get/Add is a single
+jitted SPMD program in which XLA plays the roles of Partition (sharding
+propagation), the network (ICI collectives), and the server loop (the fused
+updater epilogue):
+
+* ``get``    -> all-gather of the shards (out_shardings=replicated)
+* ``add``    -> reduce-scatter of per-worker deltas + in-shard updater apply
+* async ops  -> JAX async dispatch; a ``jax.Array`` is the Waiter
+  (``wait`` == ``block_until_ready`` — ref: util/waiter.h:9-33).
+
+Dim-0 is padded up to a multiple of the shard count so every device holds an
+equal chunk (the reference gives the remainder to the last server — ref:
+src/table/array_table.cpp:98-108; equal padded chunks are the TPU-friendly
+variant, invisible through the API).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.runtime import runtime
+from multiverso_tpu.updaters import AddOption, make_updater
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["TableOption", "DenseTable", "register_table_type", "create_table"]
+
+
+class TableOption:
+    """Base option record (``DEFINE_TABLE_TYPE`` analog — ref:
+    table_interface.h:77-80 binds Option -> (Worker, Server) types)."""
+
+    table_class: Type["DenseTable"]
+
+
+_TABLE_TYPES: Dict[type, type] = {}
+
+
+def register_table_type(option_cls: type):
+    """Bind an option class to a table class (factory registration)."""
+
+    def deco(table_cls: type):
+        _TABLE_TYPES[option_cls] = table_cls
+        return table_cls
+
+    return deco
+
+
+def create_table(option: TableOption):
+    """``MV_CreateTable`` body (ref: include/multiverso/multiverso.h:35-41,
+    src/table_factory.cpp:8-22): construct storage + handle, register for a
+    dense table id, barrier so ids are consistent."""
+    rt = runtime()
+    table_cls = _TABLE_TYPES.get(type(option))
+    if table_cls is None:
+        Log.Fatal("no table type registered for option %s", type(option).__name__)
+    table = table_cls(option)
+    table.table_id = rt.register_table(table)
+    rt.barrier()
+    return table
+
+
+def _ceil_to(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+class DenseTable:
+    """Dense storage sharded along dim 0; shared machinery for Array/Matrix."""
+
+    def __init__(
+        self,
+        shape: Tuple[int, ...],
+        dtype: Any = jnp.float32,
+        updater_type: Optional[str] = None,
+        init_value: Optional[np.ndarray] = None,
+        name: str = "table",
+    ):
+        rt = runtime()
+        mesh = rt.mesh
+        CHECK(mesh is not None, "runtime not started; call MV_Init first")
+        self.name = name
+        self.table_id = -1
+        self.mesh = mesh
+        self.dtype = jnp.dtype(dtype)
+        self.shape = tuple(int(s) for s in shape)
+        self.num_shards = mesh_lib.num_shards(mesh)
+        self.num_workers = mesh_lib.num_workers(mesh)
+        self._padded0 = _ceil_to(self.shape[0], self.num_shards)
+        self._pshape = (self._padded0,) + self.shape[1:]
+        self._sharding = mesh_lib.table_sharding(mesh, len(self._pshape))
+        self._replicated = mesh_lib.replicated_sharding(mesh)
+        self.updater = make_updater(updater_type, self.dtype)
+
+        if init_value is None:
+            init = np.zeros(self._pshape, self.dtype)
+        else:
+            init_value = np.asarray(init_value, self.dtype)
+            CHECK(
+                init_value.shape == self.shape,
+                f"init_value shape {init_value.shape} != table shape {self.shape}",
+            )
+            pad = [(0, self._padded0 - self.shape[0])] + [(0, 0)] * (len(self.shape) - 1)
+            init = np.pad(init_value, pad)
+        self.storage = jax.device_put(init, self._sharding)
+        self.state = {
+            k: jax.device_put(v, self._state_sharding(v))
+            for k, v in self.updater.init_state(self._pshape, self.num_workers, self.dtype).items()
+        }
+        self._compiled: Dict[str, Any] = {}
+
+    # ----------------------------------------------------------- sharding
+
+    def _state_sharding(self, arr: jnp.ndarray) -> NamedSharding:
+        """Updater slots shard with the table; per-worker slots (extra leading
+        num_workers dim, e.g. AdaGrad g²) shard their table dim (dim 1)."""
+        if arr.ndim == len(self._pshape) + 1:
+            return mesh_lib.table_sharding(self.mesh, arr.ndim, shard_dim=1)
+        return mesh_lib.table_sharding(self.mesh, arr.ndim, shard_dim=0)
+
+    def shard_ranges(self) -> List[Tuple[int, int]]:
+        """Logical [begin, end) owned per shard — the ``Partition`` layout
+        (ref: array_table.cpp:11-19; unit-tested like
+        Test/unittests/test_array.cpp:44-77)."""
+        chunk = self._padded0 // self.num_shards
+        out = []
+        for s in range(self.num_shards):
+            begin = min(s * chunk, self.shape[0])
+            end = min((s + 1) * chunk, self.shape[0])
+            out.append((begin, end))
+        return out
+
+    # ----------------------------------------------------------- get path
+
+    def _get_fn(self):
+        fn = self._compiled.get("get")
+        if fn is None:
+            n = self.shape[0]
+            access = self.updater.access
+
+            def run(storage):
+                return access(storage)[:n]
+
+            fn = jax.jit(run, out_shardings=self._replicated)
+            self._compiled["get"] = fn
+        return fn
+
+    def get_async(self) -> jax.Array:
+        """Dispatch the all-gather; returned array is the future
+        (``WorkerTable::GetAsync`` — ref: src/table.cpp:41-59)."""
+        return self._get_fn()(self.storage)
+
+    def get(self) -> np.ndarray:
+        """Blocking whole-table Get (``WorkerTable::Get`` = Wait(GetAsync) —
+        ref: src/table.cpp:27-32)."""
+        return np.asarray(self.get_async())
+
+    # ----------------------------------------------------------- add path
+
+    def _pad0(self, arr: jnp.ndarray, axis: int) -> jnp.ndarray:
+        extra = self._padded0 - self.shape[0]
+        if extra == 0:
+            return arr
+        pad = [(0, 0)] * arr.ndim
+        pad[axis] = (0, extra)
+        return jnp.pad(arr, pad)
+
+    def _add_single_fn(self):
+        fn = self._compiled.get("add1")
+        if fn is None:
+            updater = self.updater
+            pad0 = self._pad0
+
+            def run(storage, state, delta, worker_id, opt):
+                delta = pad0(delta.astype(storage.dtype), 0)
+                return updater.apply(storage, delta, state, worker_id, opt)
+
+            fn = jax.jit(
+                run,
+                out_shardings=(self._sharding, {k: self._state_sharding(v) for k, v in self.state.items()}),
+                donate_argnums=(0, 1),
+            )
+            self._compiled["add1"] = fn
+        return fn
+
+    def _add_per_worker_fn(self):
+        fn = self._compiled.get("addW")
+        if fn is None:
+            updater = self.updater
+            pad0 = self._pad0
+            mesh = self.mesh
+            shard_axis = mesh_lib.shard_axis_name(mesh)
+            nw = self.num_workers
+            ndim = len(self._pshape)
+
+            def run(storage, state, deltas, opt):
+                deltas = pad0(deltas.astype(storage.dtype), 1)
+                if updater.linear:
+                    # one fused update with the worker-summed delta; XLA lowers
+                    # sum-over-worker-dim + sharded consumer to reduce-scatter
+                    return updater.apply(storage, jnp.sum(deltas, axis=0), state, 0, opt)
+                # non-linear: apply per worker sequentially (the reference
+                # server applies each worker's Add as its own Update call).
+                # Reshard deltas so each scan step slices locally (all-to-all
+                # once instead of a gather per step).
+                spec = [None] * (ndim + 1)
+                spec[1] = shard_axis
+                deltas = jax.lax.with_sharding_constraint(
+                    deltas, NamedSharding(mesh, P(*spec))
+                )
+
+                def body(carry, w):
+                    data, st = carry
+                    data, st = updater.apply(data, deltas[w], st, w, opt)
+                    return (data, st), None
+
+                (storage, state), _ = jax.lax.scan(
+                    body, (storage, state), jnp.arange(nw)
+                )
+                return storage, state
+
+            fn = jax.jit(
+                run,
+                out_shardings=(self._sharding, {k: self._state_sharding(v) for k, v in self.state.items()}),
+                donate_argnums=(0, 1),
+            )
+            self._compiled["addW"] = fn
+        return fn
+
+    def add(self, delta, option: Optional[AddOption] = None) -> None:
+        """One logical Add (a single worker's request — ref:
+        src/worker.cpp:30-57 fan-out; here one fused SPMD program).
+        Asynchronous like the reference's AddAsync: host returns immediately,
+        ``wait()`` blocks."""
+        option = option or AddOption()
+        delta = jnp.asarray(delta)
+        CHECK(
+            tuple(delta.shape) == self.shape,
+            f"add delta shape {delta.shape} != table shape {self.shape}",
+        )
+        self.storage, self.state = self._add_single_fn()(
+            self.storage,
+            self.state,
+            delta,
+            jnp.int32(option.worker_id),
+            option.scalars(),
+        )
+
+    def add_per_worker(self, deltas, option: Optional[AddOption] = None) -> None:
+        """All workers' Adds for one round in a single SPMD program — the
+        data-parallel hot path (deltas shape ``(num_workers, *table_shape)``,
+        one slice per worker, sharded over the worker axis)."""
+        option = option or AddOption()
+        deltas = jnp.asarray(deltas)
+        CHECK(
+            tuple(deltas.shape) == (self.num_workers,) + self.shape,
+            f"add_per_worker expects {(self.num_workers,) + self.shape}, got {deltas.shape}",
+        )
+        deltas = jax.device_put(deltas, mesh_lib.worker_sharding(self.mesh, deltas.ndim))
+        self.storage, self.state = self._add_per_worker_fn()(
+            self.storage, self.state, deltas, option.scalars()
+        )
+
+    # ----------------------------------------------------------- waiting
+
+    def wait(self) -> None:
+        """Block until all dispatched ops on this table committed
+        (``WorkerTable::Wait`` — ref: src/table.cpp:84-97)."""
+        jax.block_until_ready((self.storage, self.state))

@@ -1,0 +1,28 @@
+"""Server-side updaters (optimizers) applied to table shards.
+
+TPU-native equivalent of the reference updater layer
+(ref: include/multiverso/updater/*, src/updater/updater.cpp — SURVEY.md §2.4).
+In the reference, updaters run inside ``ServerTable::ProcessAdd`` on the
+server's chunk, per incoming worker Add message, optionally parallelised with
+OpenMP (ref: updater.cpp:24-31). Here they are pure jnp element-wise functions
+applied to the local shard inside the table's jitted add program — XLA fuses
+them into the reduce-scatter epilogue, and the shard axis replaces OpenMP.
+
+Update-vs-sum semantics: the reference server applies each worker's Add as a
+separate ``Update`` call. For *linear* updaters (default ``+=``, SGD) that is
+equivalent to one update with the worker-summed delta, so the add path uses a
+single fused reduce-scatter. Non-linear updaters (momentum, AdaGrad) are
+applied per worker, sequentially in worker-id order, inside one jitted
+``lax.scan`` — deterministic where the reference's async arrival order was
+not (documented strengthening).
+"""
+
+from multiverso_tpu.updaters.updater import (
+    AddOption,
+    GetOption,
+    Updater,
+    available_updaters,
+    make_updater,
+)
+
+__all__ = ["AddOption", "GetOption", "Updater", "available_updaters", "make_updater"]

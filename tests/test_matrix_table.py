@@ -1,0 +1,125 @@
+"""MatrixTable tests.
+
+Ports the reference matrix workload by invariant
+(Test/test_matrix_table.cpp:9-99): per iteration, a whole-table Add of
+``delta[i*C+j] = i*C+j+1`` plus a row Add on rows {0,1,3,7} of the same
+values; after ``count`` iterations with ``W`` workers:
+``data[i][j] == (i*C+j+1) * count * W * (2 if i in rows else 1)``.
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.updaters import AddOption
+
+
+def _mk(mv, rows=8, cols=16, **kw):
+    return mv.MV_CreateTable(MatrixTableOption(num_row=rows, num_col=cols, **kw))
+
+
+def test_whole_table_roundtrip(mv_env):
+    t = _mk(mv_env, 5, 7)
+    delta = np.arange(35, dtype=np.float32).reshape(5, 7)
+    t.add(delta)
+    np.testing.assert_allclose(t.get(), delta)
+
+
+def test_row_get(mv_env):
+    init = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    t = _mk(mv_env, 8, 4, init_value=init)
+    got = t.get_rows([1, 3, 6])
+    np.testing.assert_allclose(got, init[[1, 3, 6]])
+
+
+def test_row_add_linear_with_duplicates(mv_env):
+    t = _mk(mv_env, 6, 3)
+    deltas = np.ones((3, 3), np.float32)
+    t.add_rows([2, 2, 5], deltas)  # duplicates accumulate on linear path
+    expect = np.zeros((6, 3), np.float32)
+    expect[2] = 2.0
+    expect[5] = 1.0
+    np.testing.assert_allclose(t.get(), expect)
+
+
+def test_reference_matrix_invariant(sync_mv_env):
+    """test_matrix_table.cpp:38-92 ported (scaled down: 11x36 ints, 5 iters)."""
+    mv = sync_mv_env
+    num_row, num_col = 11, 36
+    nw = mv.MV_NumWorkers()
+    t = _mk(mv, num_row, num_col, dtype="int32")
+    delta = (np.arange(num_row * num_col, dtype=np.int32) + 1).reshape(num_row, num_col)
+    v = [0, 1, 3, 7]
+    iters = 5
+    for count in range(1, iters + 1):
+        t.add_per_worker(np.tile(delta, (nw, 1, 1)))
+        row_deltas = np.tile(delta[v], (nw, 1, 1))
+        row_ids = np.tile(np.asarray(v, np.int32), (nw, 1))
+        t.add_rows_per_worker(row_ids, row_deltas)
+        data = t.get()
+        expected = delta * count * nw
+        expected[v] += delta[v] * count * nw
+        np.testing.assert_array_equal(data, expected)
+
+
+def test_row_add_momentum_touches_only_given_rows(mv_env):
+    t = _mk(mv_env, 6, 2, updater_type="momentum_sgd")
+    m = 0.5
+    opt = AddOption(momentum=m)
+    d = np.full((1, 2), 1.0, np.float32)
+    t.add_rows([2], d, opt)
+    t.add_rows([2], d, opt)
+    # numpy model: smooth=(1-m)d then m*smooth+(1-m)d, applied only to row 2
+    s1 = (1 - m) * 1.0
+    s2 = m * s1 + (1 - m) * 1.0
+    expect = np.zeros((6, 2), np.float32)
+    expect[2] = -(s1 + s2)
+    np.testing.assert_allclose(t.get(), expect, rtol=1e-6)
+
+
+def test_row_add_adagrad_per_worker_state(mv_env):
+    t = _mk(mv_env, 4, 2, updater_type="adagrad")
+    lr, rho, eps = 0.1, 0.05, 1e-6
+    d = np.full((1, 2), 0.2, np.float32)
+    t.add_rows([1], d, AddOption(worker_id=0, learning_rate=lr, rho=rho))
+    t.add_rows([1], d, AddOption(worker_id=1, learning_rate=lr, rho=rho))
+    grad = 0.2 / lr
+    g2 = grad * grad  # each worker's accumulator sees one update
+    step = rho * grad / np.sqrt(g2 + eps)
+    expect = np.zeros((4, 2), np.float32)
+    expect[1] = -2 * step
+    np.testing.assert_allclose(t.get(), expect, rtol=1e-4)
+
+
+def test_stateful_duplicate_rows_rejected(mv_env):
+    from multiverso_tpu.utils.log import FatalError
+
+    t = _mk(mv_env, 4, 2, updater_type="momentum_sgd")
+    with pytest.raises(FatalError):
+        t.add_rows([1, 1], np.ones((2, 2), np.float32))
+
+
+def test_uniform_init(mv_env):
+    t = _mk(mv_env, 16, 8, init_uniform=(-0.5, 0.5), seed=3)
+    data = t.get()
+    assert data.shape == (16, 8)
+    assert (data >= -0.5).all() and (data < 0.5).all()
+    assert np.abs(data).sum() > 0  # actually random, not zeros
+
+
+def test_row_shard_ranges_cover(mv_env):
+    t = _mk(mv_env, 11, 4)
+    ranges = t.shard_ranges()
+    assert sum(e - b for b, e in ranges) == 11
+
+
+def test_out_of_range_row_ids_rejected(mv_env):
+    from multiverso_tpu.utils.log import FatalError
+
+    t = _mk(mv_env, 4, 2)
+    with pytest.raises(FatalError):
+        t.get_rows([7])
+    with pytest.raises(FatalError):
+        t.get_rows([-1])
+    with pytest.raises(FatalError):
+        t.add_rows([4], np.ones((1, 2), np.float32))

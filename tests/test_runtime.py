@@ -62,3 +62,10 @@ def test_netbind_raises(mv_env):
 
     with pytest.raises(FatalError):
         mv_env.MV_NetBind(0, "tcp://127.0.0.1:5555")
+
+
+def test_reinit_with_different_mesh_rejected(mv_env):
+    from multiverso_tpu.utils.log import FatalError
+
+    with pytest.raises(FatalError):
+        mv_env.MV_Init(num_shards=2)  # already started with a 1-D mesh
