@@ -1,0 +1,60 @@
+"""Python binding layer — reference ``binding/python/multiverso`` parity.
+
+The reference exposes ``multiverso.init/barrier/shutdown`` plus numpy-facing
+table handlers over a ctypes-loaded C library
+(ref: binding/python/multiverso/api.py:12-75, tables.py:38-165). Here the
+core *is* Python, so the handlers wrap the table layer directly; the flat
+C ABI for other languages lives in native/ (the dependency direction is
+inverted relative to the reference — SURVEY.md §7 hard parts).
+"""
+
+from multiverso_tpu.api import (
+    MV_Barrier as barrier,
+    MV_Init,
+    MV_NumServers,
+    MV_NumWorkers,
+    MV_Rank,
+    MV_ShutDown,
+    MV_WorkerId,
+)
+from multiverso_tpu.binding.tables import ArrayTableHandler, MatrixTableHandler
+
+__all__ = [
+    "init",
+    "shutdown",
+    "barrier",
+    "workers_num",
+    "worker_id",
+    "server_num",
+    "is_master_worker",
+    "ArrayTableHandler",
+    "MatrixTableHandler",
+]
+
+
+def init(sync: bool = False, **kwargs) -> None:
+    """ref: api.py:12-34 — builds ``-sync=true`` style argv."""
+    argv = [f"-sync={'true' if sync else 'false'}"]
+    argv += [f"-{k}={v}" for k, v in kwargs.items()]
+    MV_Init(argv)
+
+
+def shutdown(finalize: bool = True) -> None:
+    MV_ShutDown(finalize)
+
+
+def workers_num() -> int:
+    return MV_NumWorkers()
+
+
+def worker_id() -> int:
+    return MV_WorkerId()
+
+
+def server_num() -> int:
+    return MV_NumServers()
+
+
+def is_master_worker() -> bool:
+    """ref: api.py — the rank-0 worker owns initialisation."""
+    return MV_Rank() == 0

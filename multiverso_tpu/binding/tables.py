@@ -1,0 +1,98 @@
+"""NumPy-facing table handlers (ref: binding/python/multiverso/tables.py).
+
+Reference semantics preserved:
+
+* ``init_value`` is applied by a *synchronous Add* from the master worker
+  (others add zeros) so that the value is committed when the constructor
+  returns (ref: tables.py:50-57, 100-107). Single-controller: one sync add.
+* ``add(data, sync=False)`` — async by default, ``sync=True`` blocks
+  (ref: tables.py:69-81).
+* ``MatrixTableHandler.get/add`` accept an optional row-id list
+  (ref: tables.py:109-165).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption, create_table
+from multiverso_tpu.updaters import AddOption
+
+__all__ = ["ArrayTableHandler", "MatrixTableHandler"]
+
+
+class ArrayTableHandler:
+    """Sync a 1-D float32 value (ref: tables.py:38-81)."""
+
+    def __init__(self, size: int, init_value: Optional[np.ndarray] = None):
+        self._size = int(size)
+        self._table = create_table(ArrayTableOption(size=self._size))
+        if init_value is not None:
+            from multiverso_tpu.binding import is_master_worker
+
+            data = np.asarray(init_value, np.float32).reshape(-1)
+            if is_master_worker():
+                self.add(data, sync=True)
+            else:  # pragma: no cover - multihost only
+                self.add(np.zeros_like(data), sync=True)
+
+    @property
+    def table(self):
+        return self._table
+
+    def get(self) -> np.ndarray:
+        return self._table.get()
+
+    def add(self, data, sync: bool = False, option: Optional[AddOption] = None) -> None:
+        data = np.asarray(data, np.float32).reshape(-1)
+        assert data.size == self._size, f"add size {data.size} != {self._size}"
+        self._table.add(data, option)
+        if sync:
+            self._table.wait()
+
+
+class MatrixTableHandler:
+    """Sync a 2-D float32 value, whole or by rows (ref: tables.py:84-165)."""
+
+    def __init__(
+        self, num_row: int, num_col: int, init_value: Optional[np.ndarray] = None
+    ):
+        self._num_row, self._num_col = int(num_row), int(num_col)
+        self._table = create_table(
+            MatrixTableOption(num_row=self._num_row, num_col=self._num_col)
+        )
+        if init_value is not None:
+            from multiverso_tpu.binding import is_master_worker
+
+            data = np.asarray(init_value, np.float32).reshape(self._num_row, self._num_col)
+            if is_master_worker():
+                self.add(data, sync=True)
+            else:  # pragma: no cover - multihost only
+                self.add(np.zeros_like(data), sync=True)
+
+    @property
+    def table(self):
+        return self._table
+
+    def get(self, row_ids: Optional[Sequence[int]] = None) -> np.ndarray:
+        if row_ids is None:
+            return self._table.get()
+        return self._table.get_rows(np.asarray(row_ids, np.int32))
+
+    def add(
+        self,
+        data,
+        row_ids: Optional[Sequence[int]] = None,
+        sync: bool = False,
+        option: Optional[AddOption] = None,
+    ) -> None:
+        data = np.asarray(data, np.float32)
+        if row_ids is None:
+            self._table.add(data.reshape(self._num_row, self._num_col), option)
+        else:
+            ids = np.asarray(row_ids, np.int32)
+            self._table.add_rows(ids, data.reshape(len(ids), self._num_col), option)
+        if sync:
+            self._table.wait()

@@ -1,0 +1,18 @@
+"""Framework integration hooks — reference ``theano_ext`` family parity
+(ref: binding/python/multiverso/theano_ext/**), rebuilt for today's stacks:
+pytree/flax param managers and a torch module manager, plus the periodic-sync
+callback the Keras extension provided."""
+
+from multiverso_tpu.ext.param_manager import (
+    MVModelParamManager,
+    PeriodicSync,
+    PytreeParamManager,
+    TorchParamManager,
+)
+
+__all__ = [
+    "MVModelParamManager",
+    "PeriodicSync",
+    "PytreeParamManager",
+    "TorchParamManager",
+]

@@ -1,0 +1,161 @@
+"""Model-parameter managers: flatten a model's params into ONE ArrayTable.
+
+Reference semantics (ref: binding/python/multiverso/theano_ext/
+param_manager.py:9-82, sharedvar.py:12-102):
+
+* construction flattens every parameter into a single float32 vector, creates
+  an ArrayTable initialised with it (master's value wins), barriers, then
+  pulls the table back into the model — so all workers start identical;
+* ``sync_all_param()`` pushes ``current - last_synced`` as a delta, pulls the
+  latest table value, and writes it back into the model (ASGD model sync);
+* the Keras extension's ``MVCallback`` synced on_batch_end
+  (ref: theano_ext/keras_ext/callbacks.py:21-39) — generalised here as
+  ``PeriodicSync``.
+
+Two concrete managers: ``PytreeParamManager`` (any jax pytree — flax/optax
+state included) and ``TorchParamManager`` (torch.nn.Module, CPU tensors).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import numpy as np
+
+from multiverso_tpu.api import MV_Barrier
+from multiverso_tpu.binding.tables import ArrayTableHandler
+
+__all__ = [
+    "MVModelParamManager",
+    "PytreeParamManager",
+    "TorchParamManager",
+    "PeriodicSync",
+]
+
+
+class MVModelParamManager:
+    """Abstract manager (ref: param_manager.py:9-82). Subclasses implement
+    get_all_param_values / set_all_param_values."""
+
+    def __init__(self, model: Any):
+        self.model = model
+        self.shapes: List[tuple] = []
+        self.sizes: List[int] = []
+        flat_parts = []
+        for arr in self.get_all_param_values():
+            arr = np.asarray(arr, np.float32)
+            self.shapes.append(arr.shape)
+            self.sizes.append(arr.size)
+            flat_parts.append(arr.reshape(-1))
+        self.all_param_list = (
+            np.concatenate(flat_parts) if flat_parts else np.zeros(0, np.float32)
+        )
+        self.tbh = ArrayTableHandler(
+            len(self.all_param_list), init_value=self.all_param_list
+        )
+        MV_Barrier()  # make sure the initial values have taken effect
+        self.all_param_list = self.tbh.get()
+        self._set_all_param_to_model()
+
+    # -- subclass contract -------------------------------------------------
+
+    def get_all_param_values(self) -> Sequence[np.ndarray]:
+        raise NotImplementedError
+
+    def set_all_param_values(self, params: Sequence[np.ndarray]) -> None:
+        raise NotImplementedError
+
+    # -- sync --------------------------------------------------------------
+
+    def _set_all_param_to_model(self) -> None:
+        n = 0
+        params = []
+        for shape, size in zip(self.shapes, self.sizes):
+            params.append(self.all_param_list[n : n + size].reshape(shape))
+            n += size
+        self.set_all_param_values(params)
+
+    def sync_all_param(self) -> None:
+        """Push local delta, pull the merged value (ref: param_manager.py:71-82)."""
+        cur = np.concatenate(
+            [np.asarray(a, np.float32).reshape(-1) for a in self.get_all_param_values()]
+        ) if self.sizes else np.zeros(0, np.float32)
+        self.tbh.add(cur - self.all_param_list)
+        self.all_param_list = self.tbh.get()
+        self._set_all_param_to_model()
+
+
+class PytreeParamManager(MVModelParamManager):
+    """Manager over any jax pytree (flax params / optax state / plain dicts).
+    ``manager.params`` holds the live tree; sync writes pulled values back."""
+
+    def __init__(self, tree: Any):
+        import jax
+
+        self._treedef = None
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        self._leaves = [np.asarray(l) for l in leaves]
+        # the transport table is float32 (reference limitation —
+        # param_manager.py:30-33); preserve each leaf's dtype on write-back
+        self._dtypes = [l.dtype for l in self._leaves]
+        self._treedef = treedef
+        super().__init__(model=None)
+
+    @property
+    def params(self) -> Any:
+        import jax
+
+        return jax.tree_util.tree_unflatten(self._treedef, list(self._leaves))
+
+    @params.setter
+    def params(self, tree: Any) -> None:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        assert treedef == self._treedef, "pytree structure changed"
+        self._leaves = [np.asarray(l) for l in leaves]
+
+    def get_all_param_values(self) -> Sequence[np.ndarray]:
+        return list(self._leaves)
+
+    def set_all_param_values(self, params: Sequence[np.ndarray]) -> None:
+        self._leaves = [
+            np.asarray(p).astype(dt) for p, dt in zip(params, self._dtypes)
+        ]
+
+
+class TorchParamManager(MVModelParamManager):
+    """Manager over a torch.nn.Module (CPU) — the torch/lua-binding analog
+    (ref: binding/lua/* table handlers used the same delta-push protocol)."""
+
+    def get_all_param_values(self) -> Sequence[np.ndarray]:
+        return [
+            p.detach().cpu().numpy().astype(np.float32)
+            for p in self.model.parameters()
+        ]
+
+    def set_all_param_values(self, params: Sequence[np.ndarray]) -> None:
+        import torch
+
+        with torch.no_grad():
+            for p, v in zip(self.model.parameters(), params):
+                p.copy_(torch.from_numpy(np.asarray(v)).to(p.dtype))
+
+
+class PeriodicSync:
+    """Sync every N steps (ref: keras_ext/callbacks.py:21-39 MVCallback
+    synced every batch; N generalises the LogReg ``sync_frequency`` knob)."""
+
+    def __init__(self, manager: MVModelParamManager, every: int = 1):
+        assert every >= 1
+        self.manager = manager
+        self.every = every
+        self._step = 0
+
+    def step(self) -> bool:
+        """Call once per training batch; returns True when a sync happened."""
+        self._step += 1
+        if self._step % self.every == 0:
+            self.manager.sync_all_param()
+            return True
+        return False

@@ -1,0 +1,15 @@
+"""I/O layer: URI-dispatched streams + sharded checkpointing
+(ref: include/multiverso/io/, src/io/ — SURVEY.md §2.5 I/O streams;
+checkpoint semantics — SURVEY.md §5 checkpoint/resume)."""
+
+from multiverso_tpu.io.streams import LocalStream, Stream, StreamFactory, TextReader
+from multiverso_tpu.io.checkpoint import restore_tables, save_tables
+
+__all__ = [
+    "LocalStream",
+    "Stream",
+    "StreamFactory",
+    "TextReader",
+    "restore_tables",
+    "save_tables",
+]

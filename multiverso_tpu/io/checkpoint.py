@@ -1,0 +1,93 @@
+"""Sharded checkpoint / resume for the table store.
+
+The reference defines per-table ``Serializable::Store/Load(Stream*)`` hooks
+(ref: include/multiverso/table_interface.h:61-75) implemented as raw storage
+dumps (ref: src/table/array_table.cpp:144-151, matrix_table.cpp:457-464), but
+no core driver calls them (SURVEY.md §5) — apps roll their own. The TPU build
+promotes checkpointing to a first-class subsystem:
+
+* ``DenseTable.store/load`` (in tables/base.py) — single-file Stream-based
+  dump/restore, Store/Load parity, including the reference LogReg's
+  Load-as-Add mode (worker-0 delta injection — ref:
+  Applications/LogisticRegression/src/model/ps_model.cpp:113-168);
+* ``save_tables``/``restore_tables`` (here) — orbax-backed sharded
+  checkpoint of every registered table's storage + optimizer slots: each
+  device writes its own HBM shard, restore re-shards onto the live mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from multiverso_tpu.runtime import runtime
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["save_tables", "restore_tables"]
+
+
+def _dense_tables(tables: Optional[List[Any]]) -> List[Any]:
+    from multiverso_tpu.tables.base import DenseTable
+
+    if tables is None:
+        tables = runtime().tables
+    return [t for t in tables if isinstance(t, DenseTable)]
+
+
+def _tree_of(tables: List[Any]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for t in tables:
+        tree[f"table_{t.table_id}"] = {"storage": t.storage, "state": dict(t.state)}
+    return tree
+
+
+def save_tables(directory: str, tables: Optional[List[Any]] = None) -> str:
+    """Write a sharded checkpoint of all (dense) registered tables. KV tables
+    save alongside as npz (their index is host metadata). Returns the path."""
+    import orbax.checkpoint as ocp
+
+    from multiverso_tpu.tables.kv_table import KVTable
+
+    directory = os.path.abspath(directory)
+    dense = _dense_tables(tables)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(directory, "tables"), _tree_of(dense), force=True)
+    ckptr.wait_until_finished()
+    all_tables = tables if tables is not None else runtime().tables
+    for t in all_tables:
+        if isinstance(t, KVTable):
+            t.store(os.path.join(directory, f"kv_{t.table_id}.npz"))
+    Log.Info("checkpoint saved: %s (%d dense tables)", directory, len(dense))
+    return directory
+
+
+def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:
+    """Restore a checkpoint into the live (already-created) tables: creation
+    order defines table ids, exactly like the reference's registration
+    protocol, so shapes/updaters must match."""
+    import orbax.checkpoint as ocp
+
+    from multiverso_tpu.tables.kv_table import KVTable
+
+    directory = os.path.abspath(directory)
+    dense = _dense_tables(tables)
+    target = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        _tree_of(dense),
+    )
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.join(directory, "tables"), target)
+    for t in dense:
+        entry = restored[f"table_{t.table_id}"]
+        t.storage = entry["storage"]
+        t.state = dict(entry["state"])
+    all_tables = tables if tables is not None else runtime().tables
+    for t in all_tables:
+        if isinstance(t, KVTable):
+            path = os.path.join(directory, f"kv_{t.table_id}.npz")
+            if os.path.exists(path):
+                t.load(path)
+    Log.Info("checkpoint restored: %s (%d dense tables)", directory, len(dense))

@@ -7,14 +7,23 @@ ArrayTable (1-D), MatrixTable (2-D row-sharded), SparseMatrixTable
 
 from multiverso_tpu.tables.array_table import ArrayTable, ArrayTableOption
 from multiverso_tpu.tables.base import DenseTable, TableOption, create_table
+from multiverso_tpu.tables.kv_table import KVTable, KVTableOption
 from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
+from multiverso_tpu.tables.sparse_matrix_table import (
+    SparseMatrixTable,
+    SparseMatrixTableOption,
+)
 
 __all__ = [
     "ArrayTable",
     "ArrayTableOption",
     "DenseTable",
+    "KVTable",
+    "KVTableOption",
     "MatrixTable",
     "MatrixTableOption",
+    "SparseMatrixTable",
+    "SparseMatrixTableOption",
     "TableOption",
     "create_table",
 ]

@@ -280,3 +280,83 @@ class DenseTable:
         """Block until all dispatched ops on this table committed
         (``WorkerTable::Wait`` — ref: src/table.cpp:84-97)."""
         jax.block_until_ready((self.storage, self.state))
+
+    # ----------------------------------------------------------- checkpoint
+
+    def _state_logical(self) -> Dict[str, np.ndarray]:
+        """Updater slots with padding stripped (dim 0, or dim 1 for
+        per-worker slots)."""
+        out = {}
+        n = self.shape[0]
+        for k, v in self.state.items():
+            arr = np.asarray(v)
+            out[k] = arr[:, :n] if arr.ndim == len(self._pshape) + 1 else arr[:n]
+        return out
+
+    def store(self, uri_or_stream) -> None:
+        """``Serializable::Store`` parity (ref: table_interface.h:61-75;
+        array_table.cpp:144-151 dumps raw storage — we also dump optimizer
+        slots, which the reference loses on restart)."""
+        import io as _pyio
+
+        from multiverso_tpu.io.streams import as_stream
+
+        stream, owned = as_stream(uri_or_stream, "w")
+        buf = _pyio.BytesIO()
+        np.savez(buf, storage=self.get(), **{f"state_{k}": v for k, v in self._state_logical().items()})
+        stream.Write(buf.getvalue())
+        stream.Flush()
+        if owned:
+            stream.Close()
+
+    def load(self, uri_or_stream, as_add: bool = False) -> None:
+        """``Serializable::Load`` parity. ``as_add=True`` reproduces the
+        reference LogReg restore protocol — inject the stored model as a
+        delta Add from worker 0 instead of overwriting (ref:
+        Applications/LogisticRegression/src/model/ps_model.cpp:113-168) —
+        useful when other workers may have live updates in flight. Only
+        meaningful for linear updaters (the reference uses it on its
+        default-updater LR table); stateful updaters would scale/steer the
+        injected delta, so it is rejected for them."""
+        import io as _pyio
+
+        from multiverso_tpu.io.streams import as_stream
+
+        stream, owned = as_stream(uri_or_stream, "r")
+        data = np.load(_pyio.BytesIO(stream.Read(-1)), allow_pickle=False)
+        if owned:
+            stream.Close()
+        stored = data["storage"]
+        CHECK(
+            stored.shape == self.shape,
+            f"checkpoint shape {stored.shape} != table shape {self.shape}",
+        )
+        if as_add:
+            CHECK(
+                self.updater.linear,
+                "load(as_add=True) requires a linear updater (default/sgd); "
+                f"table uses {self.updater.name!r}",
+            )
+            current = self.get()
+            delta = stored - current
+            if self.updater.delta_sign == -1:
+                delta = -delta
+            self.add(delta)
+            return
+        pad = [(0, self._padded0 - self.shape[0])] + [(0, 0)] * (len(self.shape) - 1)
+        self.storage = jax.device_put(
+            np.pad(stored.astype(self.dtype), pad), self._sharding
+        )
+        for k in list(self.state.keys()):
+            key = f"state_{k}"
+            if key not in data:
+                continue
+            arr = np.asarray(data[key])
+            full = np.asarray(self.state[k])
+            if arr.ndim == len(self._pshape) + 1:
+                full = full.copy()
+                full[:, : self.shape[0]] = arr
+            else:
+                full = full.copy()
+                full[: self.shape[0]] = arr
+            self.state[k] = jax.device_put(full, self._state_sharding(full))

@@ -1,0 +1,48 @@
+"""Sparse wire/storage compression.
+
+TPU-native equivalent of the reference SparseFilter
+(ref: include/multiverso/util/quantization_util.h:10-158): per-blob, if more
+than half the entries are zero, rewrite as (index, value) pairs plus a size
+header; ``FilterIn`` compresses, ``FilterOut`` restores. On TPU there is no
+wire between workers and servers, so this is used for checkpoint/export
+compaction and for the C-API/IPC boundary. (The reference's declared-but-empty
+``OneBitsFilter`` — quantization_util.h:160-161 — is intentionally absent.)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import numpy as np
+
+__all__ = ["SparseFilter"]
+
+Dense = np.ndarray
+Compressed = Tuple[str, tuple, np.ndarray, np.ndarray]  # ("sparse", shape, idx, vals)
+
+
+class SparseFilter:
+    """Compress arrays that are >50% zeros into (idx, val) pairs."""
+
+    @staticmethod
+    def filter_in(arr: np.ndarray) -> Union[Dense, Compressed]:
+        arr = np.asarray(arr)
+        flat = arr.reshape(-1)
+        nz = np.flatnonzero(flat)
+        if nz.size * 2 >= flat.size:  # not sparse enough — pass through
+            return arr
+        return ("sparse", arr.shape, nz.astype(np.int64), flat[nz].copy())
+
+    @staticmethod
+    def filter_out(data: Union[Dense, Compressed]) -> np.ndarray:
+        if isinstance(data, np.ndarray):
+            return data
+        tag, shape, idx, vals = data
+        assert tag == "sparse"
+        flat = np.zeros(int(np.prod(shape)), vals.dtype)
+        flat[idx] = vals
+        return flat.reshape(shape)
+
+    # reference-style aliases
+    FilterIn = filter_in
+    FilterOut = filter_out

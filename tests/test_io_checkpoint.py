@@ -1,0 +1,116 @@
+"""Stream / TextReader / checkpoint tests (ref: io layer §2.5; checkpoint
+Store/Load semantics §5 incl. Load-as-Add parity)."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.io.streams import StreamFactory, TextReader
+from multiverso_tpu.utils.log import FatalError
+
+
+def test_local_stream_roundtrip(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    s = StreamFactory.GetStream(f"file://{path}", "w")
+    s.Write(b"hello\x00world")
+    s.Close()
+    r = StreamFactory.GetStream(path, "r")  # schemeless -> file
+    assert r.Read(-1) == b"hello\x00world"
+    r.Close()
+
+
+def test_hdfs_not_built(tmp_path):
+    with pytest.raises(FatalError):
+        StreamFactory.GetStream("hdfs://nn/x", "r")
+
+
+def test_text_reader_lines(tmp_path):
+    path = tmp_path / "corpus.txt"
+    path.write_text("the quick\nbrown fox\n\nlast-no-newline")
+    reader = TextReader(str(path))
+    assert list(reader) == ["the quick", "brown fox", "", "last-no-newline"]
+
+
+def test_table_store_load_roundtrip(mv_env, tmp_path):
+    from multiverso_tpu.tables import MatrixTableOption
+    from multiverso_tpu.updaters import AddOption
+
+    t = mv_env.MV_CreateTable(
+        MatrixTableOption(num_row=9, num_col=4, updater_type="momentum_sgd")
+    )
+    t.add(np.ones((9, 4), np.float32), AddOption(momentum=0.5))
+    path = str(tmp_path / "table.ckpt")
+    t.store(path)
+
+    t2 = mv_env.MV_CreateTable(
+        MatrixTableOption(num_row=9, num_col=4, updater_type="momentum_sgd")
+    )
+    t2.load(path)
+    np.testing.assert_allclose(t2.get(), t.get())
+    # optimizer slots restored too: next momentum step must match
+    t.add(np.ones((9, 4), np.float32), AddOption(momentum=0.5))
+    t2.add(np.ones((9, 4), np.float32), AddOption(momentum=0.5))
+    np.testing.assert_allclose(t2.get(), t.get())
+
+
+def test_load_as_add(mv_env, tmp_path):
+    from multiverso_tpu.tables import ArrayTableOption
+
+    t = mv_env.MV_CreateTable(ArrayTableOption(size=6))
+    t.add(np.full(6, 3.0, np.float32))
+    path = str(tmp_path / "a.ckpt")
+    t.store(path)
+
+    t2 = mv_env.MV_CreateTable(ArrayTableOption(size=6))
+    t2.add(np.full(6, 1.0, np.float32))  # live updates already present
+    t2.load(path, as_add=True)  # worker-0 delta injection
+    np.testing.assert_allclose(t2.get(), np.full(6, 3.0, np.float32))
+
+
+def test_shape_mismatch_rejected(mv_env, tmp_path):
+    from multiverso_tpu.tables import ArrayTableOption
+
+    t = mv_env.MV_CreateTable(ArrayTableOption(size=6))
+    path = str(tmp_path / "a.ckpt")
+    t.store(path)
+    t2 = mv_env.MV_CreateTable(ArrayTableOption(size=7))
+    with pytest.raises(FatalError):
+        t2.load(path)
+
+
+def test_sharded_checkpoint_all_tables(mv_env, tmp_path):
+    from multiverso_tpu.io import restore_tables, save_tables
+    from multiverso_tpu.tables import ArrayTableOption, KVTableOption, MatrixTableOption
+    from multiverso_tpu.updaters import AddOption
+
+    a = mv_env.MV_CreateTable(ArrayTableOption(size=10))
+    m = mv_env.MV_CreateTable(
+        MatrixTableOption(num_row=5, num_col=3, updater_type="adagrad")
+    )
+    kv = mv_env.MV_CreateTable(KVTableOption())
+    a.add(np.arange(10, dtype=np.float32))
+    m.add_rows([1, 2], np.ones((2, 3), np.float32), AddOption(learning_rate=0.1))
+    kv.add([11, 22], [1.0, 2.0])
+
+    ckpt = str(tmp_path / "ckpt")
+    save_tables(ckpt)
+
+    snap_a, snap_m = a.get(), m.get()
+    # trash the live state, then restore
+    a.add(np.full(10, 99.0, np.float32))
+    m.add(np.full((5, 3), 7.0, np.float32))
+    kv.add([11], [100.0])
+    restore_tables(ckpt)
+    np.testing.assert_allclose(a.get(), snap_a)
+    np.testing.assert_allclose(m.get(), snap_m)
+    np.testing.assert_allclose(kv.get([11, 22]), [1.0, 2.0])
+
+
+def test_load_as_add_rejected_for_stateful_updater(mv_env, tmp_path):
+    from multiverso_tpu.tables import ArrayTableOption
+
+    t = mv_env.MV_CreateTable(ArrayTableOption(size=4, updater_type="momentum_sgd"))
+    path = str(tmp_path / "m.ckpt")
+    t.store(path)
+    t2 = mv_env.MV_CreateTable(ArrayTableOption(size=4, updater_type="momentum_sgd"))
+    with pytest.raises(FatalError):
+        t2.load(path, as_add=True)

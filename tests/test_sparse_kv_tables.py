@@ -1,0 +1,154 @@
+"""SparseMatrixTable delta-tracking + KVTable tests.
+
+Ref invariants: sparse get/add staleness protocol
+(src/table/sparse_matrix_table.cpp:184-258) and KV hash-table += / get
+semantics (include/multiverso/table/kv_table.h:18-124, exercised like
+Test/unittests/test_kv.cpp).
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.tables import KVTableOption, SparseMatrixTableOption
+from multiverso_tpu.updaters import AddOption, GetOption
+from multiverso_tpu.utils.quantization import SparseFilter
+
+
+def _mk_sparse(mv, rows=10, cols=4, **kw):
+    return mv.MV_CreateTable(SparseMatrixTableOption(num_row=rows, num_col=cols, **kw))
+
+
+def test_first_get_returns_all_rows(mv_env):
+    t = _mk_sparse(mv_env)
+    ids, rows = t.get_sparse(option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(ids, np.arange(10))
+    assert rows.shape == (10, 4)
+
+
+def test_add_marks_stale_for_others_not_adder(mv_env):
+    t = _mk_sparse(mv_env)
+    # drain initial staleness for workers 0 and 1
+    t.get_sparse(option=GetOption(worker_id=0))
+    t.get_sparse(option=GetOption(worker_id=1))
+    # worker 0 adds rows {2, 5}
+    t.add_rows([2, 5], np.ones((2, 4), np.float32), AddOption(worker_id=0))
+    # worker 1 sees exactly those rows stale
+    ids, rows = t.get_sparse(option=GetOption(worker_id=1))
+    np.testing.assert_array_equal(ids, [2, 5])
+    np.testing.assert_allclose(rows, np.ones((2, 4), np.float32))
+    # worker 0 (the adder) sees nothing stale -> reference quirk: row 0 returned
+    ids0, _ = t.get_sparse(option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(ids0, [0])
+
+
+def test_get_marks_fresh(mv_env):
+    t = _mk_sparse(mv_env)
+    t.get_sparse(option=GetOption(worker_id=0))
+    t.add_rows([3], np.ones((1, 4), np.float32), AddOption(worker_id=1))
+    ids, _ = t.get_sparse(option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(ids, [3])
+    ids2, _ = t.get_sparse(option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(ids2, [0])  # nothing stale anymore
+
+
+def test_worker_minus_one_reads_all_without_state_change(mv_env):
+    t = _mk_sparse(mv_env)
+    ids, rows = t.get_sparse(option=GetOption(worker_id=-1))
+    assert ids.shape == (10,)
+    # state untouched: worker 0's first get still returns everything
+    ids0, _ = t.get_sparse(option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(ids0, np.arange(10))
+
+
+def test_get_subset_filtering(mv_env):
+    t = _mk_sparse(mv_env)
+    t.get_sparse(option=GetOption(worker_id=0))
+    t.add_rows([1, 4, 7], np.ones((3, 4), np.float32), AddOption(worker_id=1))
+    ids, _ = t.get_sparse(row_ids=[0, 1, 2, 7], option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(ids, [1, 7])  # stale ∩ requested
+
+
+def test_pipeline_doubles_views(mv_env):
+    t = _mk_sparse(mv_env, is_pipeline=True)
+    assert t.num_views == 2 * mv_env.MV_NumWorkers()
+    ids, _ = t.get_sparse(option=GetOption(worker_id=t.num_views - 1))
+    assert ids.shape == (10,)
+
+
+def test_per_worker_add_staleness(mv_env):
+    t = _mk_sparse(mv_env)
+    nw = mv_env.MV_NumWorkers()
+    for w in range(nw):
+        t.get_sparse(option=GetOption(worker_id=w))
+    ids = np.tile(np.asarray([[2]], np.int32), (nw, 1))
+    t.add_rows_per_worker(ids, np.ones((nw, 1, 4), np.float32))
+    # every worker saw some other worker touch row 2
+    for w in range(nw):
+        got, _ = t.get_sparse(option=GetOption(worker_id=w))
+        np.testing.assert_array_equal(got, [2])
+
+
+# ----------------------------------------------------------------- KV table
+
+
+def test_kv_add_get_accumulates(mv_env):
+    t = mv_env.MV_CreateTable(KVTableOption(val_dtype="float32"))
+    t.add([5, 17, 99991], [1.0, 2.0, 3.0])
+    t.add([5, 99991], [0.5, 1.0])
+    np.testing.assert_allclose(t.get([5, 17, 99991]), [1.5, 2.0, 4.0])
+    assert t.raw()[5] == pytest.approx(1.5)  # local cached map refreshed
+
+
+def test_kv_unknown_key_reads_zero(mv_env):
+    t = mv_env.MV_CreateTable(KVTableOption())
+    t.add([1], [1.0])
+    np.testing.assert_allclose(t.get([1, 42]), [1.0, 0.0])
+
+
+def test_kv_capacity_growth(mv_env):
+    t = mv_env.MV_CreateTable(KVTableOption(init_capacity=8))
+    keys = np.arange(1000, dtype=np.int64) * 7919  # sparse key space
+    vals = np.ones(1000, np.float32)
+    t.add(keys, vals)
+    t.add(keys, vals)
+    got = t.get(keys)
+    np.testing.assert_allclose(got, 2 * vals)
+    ks, vs = t.items()
+    assert len(ks) == 1000
+    np.testing.assert_allclose(np.sort(vs), 2 * vals)
+
+
+def test_kv_int_values(mv_env):
+    t = mv_env.MV_CreateTable(KVTableOption(val_dtype="int64"))
+    t.add([3, 4], [10, 20])
+    t.add([3], [5])
+    np.testing.assert_array_equal(t.get([3, 4]), [15, 20])
+
+
+def test_kv_store_load(mv_env, tmp_path):
+    t = mv_env.MV_CreateTable(KVTableOption())
+    t.add([7, 8], [1.0, 2.0])
+    path = str(tmp_path / "kv.npz")
+    t.store(path)
+    t2 = mv_env.MV_CreateTable(KVTableOption())
+    t2.load(path)
+    np.testing.assert_allclose(t2.get([7, 8]), [1.0, 2.0])
+
+
+# -------------------------------------------------------------- SparseFilter
+
+
+def test_sparse_filter_roundtrip_sparse():
+    arr = np.zeros((8, 8), np.float32)
+    arr[1, 2] = 5.0
+    arr[7, 7] = -1.0
+    comp = SparseFilter.filter_in(arr)
+    assert not isinstance(comp, np.ndarray)  # compressed
+    np.testing.assert_array_equal(SparseFilter.filter_out(comp), arr)
+
+
+def test_sparse_filter_dense_passthrough():
+    arr = np.ones((4, 4), np.float32)
+    out = SparseFilter.filter_in(arr)
+    assert isinstance(out, np.ndarray)  # >50% nonzero: pass through
+    np.testing.assert_array_equal(SparseFilter.filter_out(out), arr)
