@@ -19,6 +19,7 @@ import numpy as np
 
 from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption, create_table
 from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils.log import CHECK
 
 __all__ = ["ArrayTableHandler", "MatrixTableHandler"]
 
@@ -47,7 +48,7 @@ class ArrayTableHandler:
 
     def add(self, data, sync: bool = False, option: Optional[AddOption] = None) -> None:
         data = np.asarray(data, np.float32).reshape(-1)
-        assert data.size == self._size, f"add size {data.size} != {self._size}"
+        CHECK(data.size == self._size, f"add size {data.size} != {self._size}")
         self._table.add(data, option)
         if sync:
             self._table.wait()

@@ -111,8 +111,10 @@ class PytreeParamManager(MVModelParamManager):
     def params(self, tree: Any) -> None:
         import jax
 
+        from multiverso_tpu.utils.log import CHECK
+
         leaves, treedef = jax.tree_util.tree_flatten(tree)
-        assert treedef == self._treedef, "pytree structure changed"
+        CHECK(treedef == self._treedef, "pytree structure changed")
         self._leaves = [np.asarray(l) for l in leaves]
 
     def get_all_param_values(self) -> Sequence[np.ndarray]:
@@ -147,7 +149,9 @@ class PeriodicSync:
     synced every batch; N generalises the LogReg ``sync_frequency`` knob)."""
 
     def __init__(self, manager: MVModelParamManager, every: int = 1):
-        assert every >= 1
+        from multiverso_tpu.utils.log import CHECK
+
+        CHECK(every >= 1, "PeriodicSync requires every >= 1")
         self.manager = manager
         self.every = every
         self._step = 0

@@ -24,7 +24,7 @@ import jax
 import numpy as np
 
 from multiverso_tpu.runtime import runtime
-from multiverso_tpu.utils.log import CHECK, Log
+from multiverso_tpu.utils.log import Log
 
 __all__ = ["save_tables", "restore_tables"]
 
@@ -52,10 +52,12 @@ def save_tables(directory: str, tables: Optional[List[Any]] = None) -> str:
     from multiverso_tpu.tables.kv_table import KVTable
 
     directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
     dense = _dense_tables(tables)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(directory, "tables"), _tree_of(dense), force=True)
-    ckptr.wait_until_finished()
+    if dense:  # orbax rejects an empty pytree (KV-only checkpoints)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(directory, "tables"), _tree_of(dense), force=True)
+        ckptr.wait_until_finished()
     all_tables = tables if tables is not None else runtime().tables
     for t in all_tables:
         if isinstance(t, KVTable):
@@ -74,16 +76,17 @@ def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:
 
     directory = os.path.abspath(directory)
     dense = _dense_tables(tables)
-    target = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
-        _tree_of(dense),
-    )
-    ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(os.path.join(directory, "tables"), target)
-    for t in dense:
-        entry = restored[f"table_{t.table_id}"]
-        t.storage = entry["storage"]
-        t.state = dict(entry["state"])
+    if dense:
+        target = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            _tree_of(dense),
+        )
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(os.path.join(directory, "tables"), target)
+        for t in dense:
+            entry = restored[f"table_{t.table_id}"]
+            t.storage = entry["storage"]
+            t.state = dict(entry["state"])
     all_tables = tables if tables is not None else runtime().tables
     for t in all_tables:
         if isinstance(t, KVTable):

@@ -12,7 +12,6 @@ buffered ``TextReader`` line reader (ref: io/io.h:105-132).
 from __future__ import annotations
 
 import io as _pyio
-import os
 from typing import Optional
 
 from multiverso_tpu.utils.log import CHECK, Log

@@ -1,0 +1,14 @@
+"""LogisticRegression application.
+
+TPU-first rebuild of Applications/LogisticRegression (ref: SURVEY.md §2.7):
+config-file driven LR/softmax/FTRL trainer; local mode (weights as device
+arrays) or PS mode (weights in sharded tables with sync_frequency /
+double-buffer pipelined pulls). The reference computes per-sample scalar
+loops (ref: src/objective/objective.cpp); here objectives are batched jitted
+functions — one MXU matmul per minibatch.
+"""
+
+from multiverso_tpu.models.logreg.config import Configure
+from multiverso_tpu.models.logreg.logreg import LogReg
+
+__all__ = ["Configure", "LogReg"]

@@ -1,0 +1,29 @@
+"""CLI entry — reference main.cpp parity
+(ref: Applications/LogisticRegression/src/main.cpp: ``logreg config_file``).
+
+Usage: python -m multiverso_tpu.models.logreg <config_file> [MV flags]
+"""
+
+import sys
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.logreg import LogReg
+from multiverso_tpu.utils.log import Log
+
+
+def main(argv):
+    rest = mv.MV_Init(argv)
+    args = [a for a in rest[1:] if not a.startswith("-")]
+    if not args:
+        Log.Error("usage: python -m multiverso_tpu.models.logreg <config_file>")
+        return 1
+    lr = LogReg(args[0])
+    lr.Train()
+    if lr.config.test_file:
+        lr.Test()
+    mv.MV_ShutDown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

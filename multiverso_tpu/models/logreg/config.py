@@ -1,0 +1,93 @@
+"""Config-file parser — reference ``logreg::Configure`` parity
+(ref: Applications/LogisticRegression/src/configure.h:9-103,
+configure.cpp): ``key=value`` lines, same option names and defaults; unknown
+keys are ignored with a log line; ``#`` comments allowed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from multiverso_tpu.io.streams import TextReader
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["Configure"]
+
+
+@dataclasses.dataclass
+class Configure:
+    # dimensions (ref: configure.h:20-22 — must be provided)
+    input_size: int = 0
+    output_size: int = 0
+    sparse: bool = False
+
+    train_epoch: int = 1
+    minibatch_size: int = 20
+    read_buffer_size: int = 2048
+    show_time_per_sample: int = 10000
+
+    regular_coef: float = 0.0005
+    learning_rate: float = 0.8
+    learning_rate_coef: float = 1e6
+
+    # FTRL (ref: configure.h:45-49)
+    alpha: float = 0.005
+    beta: float = 1.0
+    lambda1: float = 5.0
+    lambda2: float = 0.002
+
+    init_model_file: str = ""
+    train_file: str = "train.data"
+    reader_type: str = "default"  # default | weight | bsparse
+    test_file: str = ""
+    output_model_file: str = "logreg.model"
+    output_file: str = "logreg.output"
+
+    use_ps: bool = False
+    pipeline: bool = True
+    sync_frequency: int = 1
+
+    updater_type: str = "default"  # default | sgd | ftrl
+    objective_type: str = "default"  # default | ftrl | sigmoid | softmax
+    regular_type: str = "default"  # default | L1 | L2
+
+    @classmethod
+    def from_file(cls, config_file: str) -> "Configure":
+        cfg = cls()
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        reader = TextReader(config_file)
+        for line in reader:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, sep, value = line.partition("=")
+            if not sep:
+                continue
+            key, value = key.strip(), value.strip()
+            f = fields.get(key)
+            if f is None:
+                Log.Info("[Configure] unknown key %r ignored", key)
+                continue
+            if f.type in ("int", int):
+                setattr(cfg, key, int(value))
+            elif f.type in ("float", float):
+                setattr(cfg, key, float(value))
+            elif f.type in ("bool", bool):
+                setattr(cfg, key, value.lower() in ("true", "1", "yes"))
+            else:
+                setattr(cfg, key, value)
+        reader.Close()
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        CHECK(self.input_size > 0, "config must provide input_size > 0")
+        CHECK(self.output_size > 0, "config must provide output_size > 0")
+        if self.objective_type == "sigmoid":
+            CHECK(self.output_size == 1, "sigmoid objective requires output_size=1")
+        if self.objective_type == "softmax":
+            CHECK(self.output_size >= 2, "softmax objective requires output_size>=2")
+        if self.objective_type == "ftrl":
+            CHECK(self.output_size == 1, "ftrl objective requires output_size=1")
+            CHECK(self.sparse, "ftrl objective requires sparse input")

@@ -1,0 +1,93 @@
+"""LogReg driver — the reference ``LogReg<T>`` train/test/save loop
+(ref: Applications/LogisticRegression/src/logreg.h/.cpp:41-173):
+config-driven; async reader feeds minibatches; per-epoch test when
+``test_file`` is set; predictions written to ``output_file``; model saved to
+``output_model_file``; progress logged every ``show_time_per_sample``
+samples with samples/sec (ref: logreg.cpp:72-77).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+
+from multiverso_tpu.models.logreg.config import Configure
+from multiverso_tpu.models.logreg.model import Model
+from multiverso_tpu.models.logreg.reader import make_reader
+from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.timer import Timer
+
+__all__ = ["LogReg"]
+
+
+class LogReg:
+    def __init__(self, config: Union[str, Configure]):
+        if isinstance(config, str):
+            config = Configure.from_file(config)
+        config.validate()
+        self.config = config
+        self.model = Model.Get(config)
+        self.reader = make_reader(config)
+        if config.init_model_file:
+            self.model.load(config.init_model_file)
+
+    def Train(self) -> float:
+        """Run ``train_epoch`` epochs; returns the final epoch's mean loss."""
+        cfg = self.config
+        last_epoch_loss = 0.0
+        for epoch in range(cfg.train_epoch):
+            timer = Timer()
+            seen, since_log, losses = 0, 0, []
+            for batch in self.reader.async_batches(batch_size=cfg.minibatch_size):
+                losses.append(self.model.train_batch(batch))
+                seen += len(batch["y"])
+                since_log += len(batch["y"])
+                if since_log >= cfg.show_time_per_sample:
+                    rate = seen / max(timer.elapsed_s(), 1e-9)
+                    Log.Info(
+                        "[LogReg] epoch %d: %d samples, %.0f samples/s, loss %.5f",
+                        epoch, seen, rate, float(np.mean(losses[-50:])),
+                    )
+                    since_log = 0
+            last_epoch_loss = float(np.mean(losses)) if losses else 0.0
+            Log.Info(
+                "[LogReg] epoch %d done: %d samples in %.2fs, mean loss %.5f",
+                epoch, seen, timer.elapsed_s(), last_epoch_loss,
+            )
+            if cfg.test_file:
+                self.Test()
+        if cfg.output_model_file:
+            self.model.save(cfg.output_model_file)
+        return last_epoch_loss
+
+    def Test(self, output_file: Optional[str] = None) -> float:
+        """Accuracy over ``test_file``; writes per-sample scores to
+        ``output_file`` (ref: logreg.cpp:121-173)."""
+        cfg = self.config
+        files = [f for f in str(cfg.test_file).split(";") if f]
+        total, correct = 0, 0
+        out_lines = []
+        for batch in self.reader.iter_batches(
+            batch_size=cfg.minibatch_size, files=files
+        ):
+            scores, c = self.model.test_batch(batch)
+            correct += c
+            total += len(batch["y"])
+            for row in np.asarray(scores):
+                out_lines.append(" ".join(f"{v:.6f}" for v in np.atleast_1d(row)))
+        acc = correct / max(total, 1)
+        Log.Info("[LogReg] test: %d/%d correct (%.4f)", correct, total, acc)
+        path = output_file or cfg.output_file
+        if path:
+            from multiverso_tpu.io.streams import as_stream
+
+            stream, owned = as_stream(path, "w")
+            stream.Write(("\n".join(out_lines) + "\n").encode())
+            if owned:
+                stream.Close()
+        return acc
+
+    # reference-style aliases
+    SaveModel = lambda self, uri=None: self.model.save(uri or self.config.output_model_file)
+    LoadModel = lambda self, uri=None: self.model.load(uri or self.config.output_model_file)

@@ -1,0 +1,199 @@
+"""LogReg models: local and parameter-server mode.
+
+Reference semantics (ref: Applications/LogisticRegression/src/model/model.h:
+20-73, model.cpp; ps_model.h/.cpp):
+
+* ``Model::Get(config)`` factory → local model, or PS model when ``use_ps``
+  (ref: model.h:66-73); FTRL gets its own model (ftrl.py).
+* app-level updater scales the *delta before push* (ref: src/updater/
+  updater.cpp:52-70): ``default`` pushes the raw gradient, ``sgd`` multiplies
+  by a decaying learning rate ``lr = max(1e-3, lr0 − update_count /
+  (lr_coef · minibatch))`` (ref: updater.cpp:67-69).
+* PS mode: weights live in a table; push = AddAsync(delta), pull every
+  ``sync_frequency`` minibatches; ``pipeline`` overlaps the pull with compute
+  via a double buffer (ref: ps_model.cpp:232-271 GetPipelineTable).
+
+TPU layout: weights are stored **feature-major** — a (input_size,
+output_size) MatrixTable — so sparse minibatches update only the touched
+feature rows (= the reference's sparse-key pushes), while the jitted step
+uses the transposed (C, F) view.
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.models.logreg.objective import make_objective
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["Model", "LocalModel", "PSModel"]
+
+
+class _LrSchedule:
+    """ref: updater.cpp:45-70."""
+
+    def __init__(self, config):
+        self.kind = config.updater_type
+        CHECK(self.kind in ("default", "sgd", "ftrl"), f"bad updater_type {self.kind!r}")
+        self.lr0 = float(config.learning_rate)
+        self.coef = float(config.learning_rate_coef)
+        self.minibatch = int(config.minibatch_size)
+        self.count = 0
+
+    def next_lr(self) -> float:
+        if self.kind == "default":
+            return 1.0  # raw delta push (ref "simple minus updater")
+        self.count += 1
+        return max(1e-3, self.lr0 - self.count / (self.coef * self.minibatch))
+
+
+class Model:
+    """Factory (ref: model.h:66-73)."""
+
+    @staticmethod
+    def Get(config):
+        if config.updater_type == "ftrl" or config.objective_type == "ftrl":
+            from multiverso_tpu.models.logreg.ftrl import FTRLModel
+
+            return FTRLModel(config)
+        return PSModel(config) if config.use_ps else LocalModel(config)
+
+
+class LocalModel:
+    """Weights as device arrays; one jitted step per minibatch."""
+
+    def __init__(self, config):
+        self.config = config
+        self.objective = make_objective(config)
+        self.C, self.F = int(config.output_size), int(config.input_size)
+        self.W = jnp.zeros((self.C, self.F), jnp.float32)
+        self.schedule = _LrSchedule(config)
+        self._step_dense = jax.jit(self._grad_dense)
+        self._step_sparse = jax.jit(self._grad_sparse)
+
+    # gradient programs (shared with PSModel)
+    def _grad_dense(self, W, X, y):
+        return self.objective.loss_grad(W, X, y)
+
+    def _grad_sparse(self, W, idx, val, y):
+        return self.objective.loss_grad(W, (idx, val), y)
+
+    def _gradient(self, batch: Dict[str, Any]):
+        if "X" in batch:
+            return self._step_dense(self.W, jnp.asarray(batch["X"]), jnp.asarray(batch["y"]))
+        return self._step_sparse(
+            self.W,
+            jnp.asarray(batch["idx"]),
+            jnp.asarray(batch["val"]),
+            jnp.asarray(batch["y"]),
+        )
+
+    def train_batch(self, batch: Dict[str, Any]) -> float:
+        loss, grad = self._gradient(batch)
+        lr = self.schedule.next_lr()
+        self.W = self.W - lr * grad
+        return float(loss)
+
+    def predict(self, batch: Dict[str, Any]) -> np.ndarray:
+        X = batch["X"] if "X" in batch else (jnp.asarray(batch["idx"]), jnp.asarray(batch["val"]))
+        return np.asarray(self.objective.predict(self.W, X))
+
+    def test_batch(self, batch: Dict[str, Any]):
+        scores = self.predict(batch)
+        correct = np.asarray(
+            self.objective.correct(jnp.asarray(batch["y"]), jnp.asarray(scores))
+        )
+        return scores, int(correct.sum())
+
+    # -- persistence (binary model dump — ref model.cpp Store) -------------
+
+    def weights(self) -> np.ndarray:
+        return np.asarray(self.W)
+
+    def save(self, uri: str) -> None:
+        from multiverso_tpu.io.streams import as_stream
+
+        stream, owned = as_stream(uri, "w")
+        buf = _pyio.BytesIO()
+        np.savez(buf, W=self.weights())
+        stream.Write(buf.getvalue())
+        if owned:
+            stream.Close()
+
+    def load(self, uri: str) -> None:
+        from multiverso_tpu.io.streams import as_stream
+
+        stream, owned = as_stream(uri, "r")
+        data = np.load(_pyio.BytesIO(stream.Read(-1)), allow_pickle=False)
+        if owned:
+            stream.Close()
+        W = data["W"]
+        CHECK(W.shape == (self.C, self.F), f"model shape {W.shape} != {(self.C, self.F)}")
+        self.set_weights(W)
+
+    def set_weights(self, W: np.ndarray) -> None:
+        self.W = jnp.asarray(W, jnp.float32)
+
+
+class PSModel(LocalModel):
+    """Weights in a sharded table; delta push per minibatch, pull every
+    ``sync_frequency`` batches, optional pipelined (double-buffered) pull."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        from multiverso_tpu.runtime import runtime
+        from multiverso_tpu.tables import MatrixTableOption, create_table
+
+        CHECK(runtime().started, "use_ps=true requires MV_Init first")
+        # feature-major table: rows = features, cols = classes
+        self.table = create_table(
+            MatrixTableOption(num_row=self.F, num_col=self.C, name="logreg_weights")
+        )
+        self._since_pull = 0
+        self._pipeline_buf = None
+        if config.pipeline:
+            from multiverso_tpu.utils.async_buffer import ASyncBuffer
+
+            self._pipeline_buf = ASyncBuffer(self.table.get_async)
+
+    def _pull(self) -> None:
+        if self._pipeline_buf is not None:
+            table_fm = np.asarray(self._pipeline_buf.Get())  # (F, C), prefetched
+        else:
+            table_fm = self.table.get()
+        self.W = jnp.asarray(table_fm.T)  # class-major view for the step
+
+    def train_batch(self, batch: Dict[str, Any]) -> float:
+        loss, grad = self._gradient(batch)  # grad: (C, F)
+        lr = self.schedule.next_lr()
+        delta_fm = np.asarray(lr * grad).T  # (F, C) feature-major
+        if "keys" in batch and len(batch["keys"]) and len(batch["keys"]) < self.F:
+            keys = np.asarray(batch["keys"], np.int32)
+            self.table.add_rows(keys, -delta_fm[keys])  # sparse push
+        else:
+            self.table.add(-delta_fm)
+        # apply locally too so we keep training between pulls
+        self.W = self.W - lr * grad
+        self._since_pull += 1
+        if self._since_pull >= self.config.sync_frequency:
+            self._pull()
+            self._since_pull = 0
+        return float(loss)
+
+    def save(self, uri: str) -> None:
+        # ref ps_model Store: pull whole model first (ps_model.cpp:96-111)
+        self.W = jnp.asarray(self.table.get().T)
+        super().save(uri)
+
+    def load(self, uri: str) -> None:
+        """Load-as-Add from worker 0 (ref: ps_model.cpp:113-168)."""
+        super().load(uri)
+        current = self.table.get()
+        self.table.add(np.asarray(self.W).T - current)
+        self.table.wait()

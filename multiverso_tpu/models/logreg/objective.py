@@ -1,0 +1,135 @@
+"""Batched objectives + regularizers.
+
+Reference semantics (ref: Applications/LogisticRegression/src/objective/
+objective.cpp, sigmoid_objective.h, softmax_objective.h; regular/l1_regular.h,
+l2_regular.h), vectorised over a minibatch:
+
+* **default (linear)**: predict = W·x per class; per-sample loss = squared
+  error vs one-hot (ref: objective.cpp:50-61); dL/dlogits = predict − onehot
+  (ref Diff: objective.cpp:42 "diff -= (label == i)").
+* **sigmoid**: output_size 1; p = σ(w·x); loss = −log p (label 1) /
+  −log(1−p) (label 0) (ref: objective.cpp:174-180); diff = p − label.
+* **softmax**: stable softmax (max-subtracted — ref:
+  objective.cpp:203-218); cross-entropy loss; diff = p − onehot.
+* **regular**: gradient += coef·sign(w) (L1) or coef·w (L2)
+  (ref: l1_regular.h/l2_regular.h Calculate), none by default.
+
+Gradients are w.r.t. the (output_size, input_size) weight matrix and are
+averaged over the minibatch. Dense input X is (B, F); sparse input is
+(idx (B,k) int32 padded with 0, val (B,k) — val 0 on padding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.utils.log import Log
+
+__all__ = ["make_objective", "Objective"]
+
+
+def _regular_grad(regular_type: str, coef: float):
+    if regular_type in ("default", "", "none", None):
+        return lambda w: jnp.zeros_like(w)
+    if regular_type.lower() == "l1":
+        return lambda w: coef * jnp.sign(w)
+    if regular_type.lower() == "l2":
+        return lambda w: coef * w
+    Log.Fatal("unknown regular_type %r", regular_type)
+
+
+class Objective:
+    """Batched objective: ``loss_grad(W, X, y)`` and ``predict(W, X)``.
+
+    ``W``: (C, F) weights. Dense ``X``: (B, F). Sparse: pass
+    ``X=(idx, val)``. ``y``: (B,) int labels.
+    """
+
+    def __init__(self, objective_type: str, output_size: int,
+                 regular_type: str = "default", regular_coef: float = 0.0):
+        self.objective_type = objective_type
+        self.output_size = output_size
+        self._reg = _regular_grad(regular_type, regular_coef)
+        if objective_type not in ("default", "sigmoid", "softmax"):
+            Log.Fatal("unknown objective_type %r", objective_type)
+
+    # -- shared pieces ----------------------------------------------------
+
+    def _logits(self, W, X):
+        if isinstance(X, tuple):
+            idx, val = X  # (B,k) feature ids, (B,k) values (0 on padding)
+            cols = W[:, idx]  # (C, B, k) gather
+            return jnp.einsum("cbk,bk->bc", cols, val)
+        return X @ W.T  # (B, C)
+
+    def _diff_and_loss(self, logits, y):
+        C = self.output_size
+        onehot = jax.nn.one_hot(y, C, dtype=logits.dtype) if C > 1 else None
+        if self.objective_type == "default":
+            target = onehot if C > 1 else (y == 1).astype(logits.dtype)[:, None]
+            diff = logits - target
+            per = jnp.sum(diff**2, axis=1)
+            if C > 1:
+                per = per / C  # ref: objective.cpp:60 divides by output_size
+            return diff, per
+        if self.objective_type == "sigmoid":
+            p = jax.nn.sigmoid(logits[:, 0])
+            target = (y == 1).astype(p.dtype)
+            eps = 1e-12
+            per = -(target * jnp.log(p + eps) + (1 - target) * jnp.log(1 - p + eps))
+            return (p - target)[:, None], per
+        # softmax
+        p = jax.nn.softmax(logits, axis=1)
+        eps = 1e-12
+        per = -jnp.log(p[jnp.arange(p.shape[0]), y] + eps)
+        return p - onehot, per
+
+    # -- public api -------------------------------------------------------
+
+    def loss_grad(self, W, X, y) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Returns (mean loss, dL/dW averaged over batch + regularization)."""
+        logits = self._logits(W, X)
+        diff, per = self._diff_and_loss(logits, y)
+        B = diff.shape[0]
+        if isinstance(X, tuple):
+            idx, val = X
+            contrib = diff[:, None, :] * val[..., None]  # (B, k, C)
+            grad = jnp.zeros_like(W.T).at[idx.reshape(-1)].add(
+                contrib.reshape(-1, diff.shape[1])
+            ).T / B
+        else:
+            grad = diff.T @ X / B  # (C, F)
+        return jnp.mean(per), grad + self._reg(W)
+
+    def predict(self, W, X) -> jnp.ndarray:
+        """Class scores/probabilities (ref Predict — ref: objective.cpp:114-120)."""
+        logits = self._logits(W, X)
+        if self.objective_type == "sigmoid":
+            return jax.nn.sigmoid(logits)
+        if self.objective_type == "softmax":
+            return jax.nn.softmax(logits, axis=1)
+        return logits
+
+    def correct(self, y, scores) -> jnp.ndarray:
+        """Per-sample correctness (ref Correct — ref: objective.cpp:123-140):
+        output_size 1 rounds the score; otherwise argmax."""
+        if self.output_size == 1:
+            return (jnp.round(scores[:, 0]) == (y == 1)).astype(jnp.int32)
+        return (jnp.argmax(scores, axis=1) == y).astype(jnp.int32)
+
+
+def make_objective(config) -> Objective:
+    """Factory (ref Objective::Get)."""
+    otype = config.objective_type
+    if otype == "ftrl":
+        # FTRL prediction/gradient lives in the FTRL model (ftrl.py)
+        otype = "sigmoid"
+    return Objective(
+        otype,
+        config.output_size,
+        regular_type=config.regular_type,
+        regular_coef=config.regular_coef,
+    )

@@ -131,4 +131,11 @@ class SparseMatrixTable(MatrixTable):
             # reference quirk: always reply at least row 0 (:255-257)
             stale = np.asarray([0], np.int32)
         self._up_to_date[w, stale] = True
-        return stale, self.get_rows(stale)
+        # pad the id vector to the next power of two (duplicating the last id)
+        # so varying stale-set sizes don't trigger a recompile per call
+        n = stale.size
+        padded_n = 1
+        while padded_n < n:
+            padded_n <<= 1
+        padded = np.pad(stale, (0, padded_n - n), mode="edge")
+        return stale, self.get_rows(padded)[:n]

@@ -1,0 +1,71 @@
+"""Double-buffered prefetcher.
+
+TPU-native equivalent of the reference ASyncBuffer
+(ref: include/multiverso/util/async_buffer.h:10-116): a background thread
+fills the idle buffer via ``fill_buffer_action`` while the caller consumes
+the ready one; ``Get()`` swaps. Used for pipelined model pulls
+(sync_frequency / pipeline mode — ref:
+Applications/LogisticRegression/src/model/ps_model.cpp:232-271) and block
+prefetch in WordEmbedding.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["ASyncBuffer"]
+
+
+class ASyncBuffer(Generic[T]):
+    """``fill_buffer_action()`` produces the next value; ``Get()`` returns the
+    ready value and kicks off the next fill in the background."""
+
+    def __init__(self, fill_buffer_action: Callable[[], T]):
+        self._fill = fill_buffer_action
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._value: Optional[T] = None
+        self._error: Optional[BaseException] = None
+        self._stopped = False
+        self._start_fill()
+
+    def _start_fill(self) -> None:
+        self._ready.clear()
+
+        def run():
+            try:
+                value = self._fill()
+                with self._lock:
+                    self._value = value
+            except BaseException as e:  # surfaced on next Get()
+                with self._lock:
+                    self._error = e
+            finally:
+                self._ready.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def Get(self) -> T:
+        """Block until the in-flight fill completes, return it, and start
+        prefetching the next one."""
+        if self._stopped:
+            raise RuntimeError("ASyncBuffer already stopped")
+        self._ready.wait()
+        with self._lock:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            value = self._value
+        self._start_fill()
+        return value
+
+    def Stop(self) -> None:
+        self._stopped = True
+        self._thread.join(timeout=5)
+
+    get = Get
+    stop = Stop

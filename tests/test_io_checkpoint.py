@@ -114,3 +114,16 @@ def test_load_as_add_rejected_for_stateful_updater(mv_env, tmp_path):
     t2 = mv_env.MV_CreateTable(ArrayTableOption(size=4, updater_type="momentum_sgd"))
     with pytest.raises(FatalError):
         t2.load(path, as_add=True)
+
+
+def test_kv_only_checkpoint(mv_env, tmp_path):
+    from multiverso_tpu.io import restore_tables, save_tables
+    from multiverso_tpu.tables import KVTableOption
+
+    kv = mv_env.MV_CreateTable(KVTableOption())
+    kv.add([1, 2], [1.0, 2.0])
+    ckpt = str(tmp_path / "kvonly")
+    save_tables(ckpt)  # must not crash with no dense tables
+    kv.add([1], [50.0])
+    restore_tables(ckpt)
+    np.testing.assert_allclose(kv.get([1, 2]), [1.0, 2.0])

@@ -1,0 +1,289 @@
+"""LogisticRegression app tests: config parity, reader formats, objective
+math, local/PS/FTRL training, end-to-end driver (MNIST-style synthetic)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.models.logreg.config import Configure
+from multiverso_tpu.models.logreg.objective import Objective
+from multiverso_tpu.models.logreg.reader import SampleReader
+from multiverso_tpu.utils.async_buffer import ASyncBuffer
+
+
+# ----------------------------------------------------------------- config
+
+
+def test_configure_parse(tmp_path):
+    path = tmp_path / "lr.config"
+    path.write_text(
+        "# comment\n"
+        "input_size=100\noutput_size=10\nobjective_type=softmax\n"
+        "minibatch_size=32\nlearning_rate=0.5\nuse_ps=true\nsparse=false\n"
+        "unknown_key=zzz\n"
+    )
+    cfg = Configure.from_file(str(path))
+    assert cfg.input_size == 100 and cfg.output_size == 10
+    assert cfg.objective_type == "softmax"
+    assert cfg.minibatch_size == 32 and cfg.learning_rate == 0.5
+    assert cfg.use_ps is True
+
+
+def test_configure_validation(tmp_path):
+    from multiverso_tpu.utils.log import FatalError
+
+    with pytest.raises(FatalError):
+        Configure(input_size=0, output_size=1).validate()
+    with pytest.raises(FatalError):
+        Configure(input_size=5, output_size=3, objective_type="sigmoid").validate()
+
+
+# ----------------------------------------------------------------- readers
+
+
+def test_default_reader_dense(tmp_path):
+    f = tmp_path / "train.txt"
+    f.write_text("1 0.5 0.25 0\n0 1 2 3\n")
+    cfg = Configure(input_size=3, output_size=1, train_file=str(f))
+    batches = list(SampleReader(cfg).iter_batches(batch_size=2))
+    assert len(batches) == 1
+    np.testing.assert_allclose(batches[0]["X"], [[0.5, 0.25, 0], [1, 2, 3]])
+    np.testing.assert_array_equal(batches[0]["y"], [1, 0])
+
+
+def test_default_reader_sparse_and_touched_keys(tmp_path):
+    f = tmp_path / "train.txt"
+    f.write_text("1 3:1.5 7:2\n0 3:1\n")
+    cfg = Configure(input_size=10, output_size=1, sparse=True, train_file=str(f))
+    b = next(SampleReader(cfg).iter_batches(batch_size=2, max_keys=4))
+    np.testing.assert_array_equal(b["idx"][0][:2], [3, 7])
+    np.testing.assert_allclose(b["val"][0][:2], [1.5, 2.0])
+    np.testing.assert_array_equal(b["keys"], [3, 7])  # union of touched keys
+    assert b["val"][1][1] == 0  # padding
+
+
+def test_weight_reader(tmp_path):
+    f = tmp_path / "train.txt"
+    f.write_text("1:2.5 0.5 0.5\n")
+    cfg = Configure(
+        input_size=2, output_size=1, reader_type="weight", train_file=str(f)
+    )
+    b = next(SampleReader(cfg).iter_batches(batch_size=1))
+    assert b["weight"][0] == pytest.approx(2.5)
+    assert b["y"][0] == 1
+
+
+def test_bsparse_reader(tmp_path):
+    f = tmp_path / "train.bin"
+    with open(f, "wb") as fh:
+        # count(u64) label(i32) weight(f64) keys(u64)...
+        fh.write(struct.pack("<qid", 2, 1, 1.0))
+        fh.write(np.asarray([4, 9], "<i8").tobytes())
+        fh.write(struct.pack("<qid", 1, 0, 1.0))
+        fh.write(np.asarray([2], "<i8").tobytes())
+    cfg = Configure(
+        input_size=10, output_size=1, sparse=True, reader_type="bsparse",
+        train_file=str(f),
+    )
+    b = next(SampleReader(cfg).iter_batches(batch_size=2, max_keys=3))
+    np.testing.assert_array_equal(b["idx"][0][:2], [4, 9])
+    np.testing.assert_allclose(b["val"][0][:2], [1, 1])
+    np.testing.assert_array_equal(b["y"], [1, 0])
+
+
+def test_async_batches_match_sync(tmp_path):
+    f = tmp_path / "train.txt"
+    f.write_text("".join(f"{i % 2} {i} {i+1}\n" for i in range(57)))
+    cfg = Configure(input_size=2, output_size=1, train_file=str(f), minibatch_size=10)
+    r = SampleReader(cfg)
+    sync = list(r.iter_batches())
+    asy = list(r.async_batches())
+    assert len(sync) == len(asy) == 6
+    for a, b in zip(sync, asy):
+        np.testing.assert_allclose(a["X"], b["X"])
+
+
+def test_async_buffer_prefetch():
+    calls = []
+
+    def fill():
+        calls.append(1)
+        return len(calls)
+
+    buf = ASyncBuffer(fill)
+    assert buf.Get() == 1
+    assert buf.Get() == 2
+    buf.Stop()
+
+
+# ----------------------------------------------------------------- objective
+
+
+def test_sigmoid_objective_grad_matches_numpy():
+    rng = np.random.RandomState(0)
+    W = rng.randn(1, 5).astype(np.float32)
+    X = rng.randn(8, 5).astype(np.float32)
+    y = rng.randint(0, 2, 8).astype(np.int32)
+    obj = Objective("sigmoid", 1)
+    loss, grad = obj.loss_grad(W, X, y)
+    p = 1 / (1 + np.exp(-(X @ W.T)[:, 0]))
+    np.testing.assert_allclose(
+        float(loss),
+        -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)),
+        rtol=1e-4,
+    )
+    expect = ((p - y)[:, None] * X).mean(axis=0, keepdims=True)
+    np.testing.assert_allclose(np.asarray(grad), expect, rtol=1e-4)
+
+
+def test_softmax_objective_ce():
+    rng = np.random.RandomState(1)
+    W = rng.randn(3, 4).astype(np.float32)
+    X = rng.randn(6, 4).astype(np.float32)
+    y = rng.randint(0, 3, 6).astype(np.int32)
+    obj = Objective("softmax", 3)
+    loss, grad = obj.loss_grad(W, X, y)
+    logits = X @ W.T
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(
+        float(loss), -np.mean(np.log(p[np.arange(6), y])), rtol=1e-4
+    )
+    onehot = np.eye(3)[y]
+    np.testing.assert_allclose(
+        np.asarray(grad), (p - onehot).T @ X / 6, rtol=1e-3, atol=1e-6
+    )
+
+
+def test_sparse_dense_objective_agree():
+    rng = np.random.RandomState(2)
+    W = rng.randn(2, 6).astype(np.float32)
+    idx = np.asarray([[0, 3], [5, 1]], np.int32)
+    val = np.asarray([[1.0, 2.0], [0.5, 1.5]], np.float32)
+    y = np.asarray([0, 1], np.int32)
+    X = np.zeros((2, 6), np.float32)
+    for i in range(2):
+        X[i, idx[i]] = val[i]
+    obj = Objective("softmax", 2)
+    l_d, g_d = obj.loss_grad(W, X, y)
+    l_s, g_s = obj.loss_grad(W, (idx, val), y)
+    np.testing.assert_allclose(float(l_d), float(l_s), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_d), np.asarray(g_s), rtol=1e-4, atol=1e-6)
+
+
+def test_l2_regularization_added():
+    W = np.ones((1, 3), np.float32)
+    X = np.zeros((2, 3), np.float32)
+    y = np.zeros(2, np.int32)
+    plain = Objective("sigmoid", 1)
+    reg = Objective("sigmoid", 1, regular_type="L2", regular_coef=0.1)
+    _, g0 = plain.loss_grad(W, X, y)
+    _, g1 = reg.loss_grad(W, X, y)
+    np.testing.assert_allclose(np.asarray(g1 - g0), 0.1 * W, rtol=1e-5)
+
+
+# ----------------------------------------------------------------- training
+
+
+def _synthetic_dense(n=512, f=10, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    Wtrue = rng.randn(c, f)
+    X = rng.randn(n, f).astype(np.float32)
+    y = np.argmax(X @ Wtrue.T, axis=1).astype(np.int32)
+    return X, y
+
+
+def _write_dense(path, X, y):
+    with open(path, "w") as fh:
+        for xi, yi in zip(X, y):
+            fh.write(f"{yi} " + " ".join(f"{v:.6f}" for v in xi) + "\n")
+
+
+def test_local_softmax_end_to_end(tmp_path):
+    X, y = _synthetic_dense()
+    train = tmp_path / "train.txt"
+    _write_dense(train, X, y)
+    cfg = Configure(
+        input_size=10, output_size=3, objective_type="softmax",
+        updater_type="sgd", learning_rate=0.5, train_epoch=8,
+        minibatch_size=64, train_file=str(train), test_file=str(train),
+        output_model_file=str(tmp_path / "model.bin"),
+        output_file=str(tmp_path / "out.txt"),
+        show_time_per_sample=10**9,
+    )
+    from multiverso_tpu.models.logreg import LogReg
+
+    lr = LogReg(cfg)
+    lr.Train()
+    acc = lr.Test()
+    assert acc > 0.9, f"softmax LR failed to fit separable data: acc={acc}"
+    assert (tmp_path / "model.bin").exists()
+    assert (tmp_path / "out.txt").read_text().count("\n") == len(y)
+
+
+def test_ps_mode_matches_local_sync1(mv_env, tmp_path):
+    X, y = _synthetic_dense(n=128, f=6, c=2, seed=3)
+    train = tmp_path / "train.txt"
+    _write_dense(train, X, y)
+    common = dict(
+        input_size=6, output_size=2, objective_type="softmax",
+        updater_type="sgd", learning_rate=0.3, train_epoch=2,
+        minibatch_size=32, train_file=str(train), show_time_per_sample=10**9,
+        output_model_file="", output_file="",
+    )
+    from multiverso_tpu.models.logreg import LogReg
+
+    local = LogReg(Configure(**common))
+    local.Train()
+    ps = LogReg(Configure(use_ps=True, pipeline=False, sync_frequency=1, **common))
+    ps.Train()
+    np.testing.assert_allclose(
+        ps.model.weights(), local.model.weights(), rtol=1e-3, atol=1e-5
+    )
+
+
+def test_ftrl_trains(mv_env, tmp_path):
+    rng = np.random.RandomState(4)
+    n, f = 512, 50
+    keys = rng.randint(0, f, size=(n, 5))
+    wtrue = rng.randn(f)
+    y = (np.asarray([wtrue[k].sum() for k in keys]) > 0).astype(int)
+    train = tmp_path / "train.txt"
+    with open(train, "w") as fh:
+        for ki, yi in zip(keys, y):
+            fh.write(f"{yi} " + " ".join(f"{k}:1" for k in ki) + "\n")
+    cfg = Configure(
+        input_size=f, output_size=1, sparse=True, objective_type="ftrl",
+        updater_type="ftrl", train_epoch=6, minibatch_size=64,
+        alpha=0.1, beta=1.0, lambda1=0.01, lambda2=0.001,
+        train_file=str(train), test_file=str(train),
+        output_model_file="", output_file="", show_time_per_sample=10**9,
+        use_ps=True, pipeline=False,
+    )
+    from multiverso_tpu.models.logreg import LogReg
+
+    lr = LogReg(cfg)
+    lr.Train()
+    acc = lr.Test(output_file="")
+    assert acc > 0.8, f"FTRL failed to fit: acc={acc}"
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = _synthetic_dense(n=64, f=4, c=2, seed=5)
+    train = tmp_path / "train.txt"
+    _write_dense(train, X, y)
+    cfg = Configure(
+        input_size=4, output_size=2, objective_type="softmax",
+        updater_type="sgd", train_epoch=1, minibatch_size=16,
+        train_file=str(train), output_model_file=str(tmp_path / "m.bin"),
+        output_file="", show_time_per_sample=10**9,
+    )
+    from multiverso_tpu.models.logreg import LogReg
+
+    lr = LogReg(cfg)
+    lr.Train()
+    W = lr.model.weights()
+    cfg2 = Configure(**{**cfg.__dict__, "init_model_file": str(tmp_path / "m.bin")})
+    lr2 = LogReg(cfg2)
+    np.testing.assert_allclose(lr2.model.weights(), W)
