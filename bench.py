@@ -26,10 +26,14 @@ import jax.numpy as jnp
 
 
 def _bench_fused(cfg, steps=30, warmup=5, batch=8192):
-    from multiverso_tpu.models.wordembedding.skipgram import init_params, make_batch, make_sgd_step
+    from multiverso_tpu.models.wordembedding.skipgram import (
+        init_params,
+        make_batch,
+        make_train_step,
+    )
 
     params = init_params(cfg)
-    step = jax.jit(make_sgd_step(cfg), donate_argnums=(0,))
+    step = jax.jit(make_train_step(cfg), donate_argnums=(0,))
     rng = np.random.RandomState(0)
     centers, outputs, _ = make_batch(rng, cfg, batch)
     centers, outputs = jnp.asarray(centers), jnp.asarray(outputs)

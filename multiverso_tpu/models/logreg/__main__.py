@@ -18,9 +18,7 @@ def main(argv):
         Log.Error("usage: python -m multiverso_tpu.models.logreg <config_file>")
         return 1
     lr = LogReg(args[0])
-    lr.Train()
-    if lr.config.test_file:
-        lr.Test()
+    lr.Train()  # runs a per-epoch Test when test_file is configured
     mv.MV_ShutDown()
     return 0
 

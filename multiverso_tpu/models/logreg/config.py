@@ -48,6 +48,10 @@ class Configure:
     pipeline: bool = True
     sync_frequency: int = 1
 
+    # max nonzero features per sparse sample (fixed TPU batch shape); samples
+    # with more features are truncated with a logged warning
+    max_sparse_features: int = 128
+
     updater_type: str = "default"  # default | sgd | ftrl
     objective_type: str = "default"  # default | ftrl | sigmoid | softmax
     regular_type: str = "default"  # default | L1 | L2

@@ -161,7 +161,10 @@ class FTRLModel:
         zn = data["zn"]
         CHECK(zn.shape == (self.F, 2), f"ftrl state shape {zn.shape} != {(self.F, 2)}")
         if self.table is not None:
-            self.table.add(zn - self.table.get())
+            from multiverso_tpu.runtime import runtime
+
+            if runtime().rank == 0:  # worker-0 injection (ps_model.cpp:113-168)
+                self.table.add(zn - self.table.get())
             self.table.wait()
         else:
             self._zn = jnp.asarray(zn, jnp.float32)

@@ -192,8 +192,13 @@ class PSModel(LocalModel):
         super().save(uri)
 
     def load(self, uri: str) -> None:
-        """Load-as-Add from worker 0 (ref: ps_model.cpp:113-168)."""
+        """Load-as-Add from worker 0 only (ref: ps_model.cpp:113-168 gates
+        the injection on the first worker so N processes don't add N copies)."""
         super().load(uri)
-        current = self.table.get()
-        self.table.add(np.asarray(self.W).T - current)
+        from multiverso_tpu.runtime import runtime
+
+        if runtime().rank == 0:
+            current = self.table.get()
+            self.table.add(np.asarray(self.W).T - current)
         self.table.wait()
+        self.W = jnp.asarray(self.table.get().T)

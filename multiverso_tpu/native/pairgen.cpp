@@ -1,0 +1,116 @@
+// Native corpus batcher for WordEmbedding — the host-side hot path.
+//
+// TPU-native equivalent of the reference's per-thread sentence parsing
+// (ref: Applications/WordEmbedding/src/wordembedding.cpp ParseSentence/Parse,
+// reader.cpp tokenizer loops): where the reference interleaves scalar window
+// walks with training, here the generator runs on host CPU producing
+// fixed-shape int32 batches that feed the jitted TPU step, overlapped via the
+// ASyncBuffer prefetcher.
+//
+// Semantics preserved from word2vec/the reference:
+//   - per-center dynamic window shrink b ~ U[0, window) (effective window
+//     = window - b), matching wordembedding.cpp's window sampling;
+//   - frequency subsampling via per-word keep probabilities (computed in
+//     Python from the -sample flag formula — util.h:45-66);
+//   - sentence breaks (id < 0) are never crossed as centers or contexts.
+//
+// id stream: int32, -1 marks sentence boundaries. RNG: xorshift64 (seeded
+// per call) so a (seed, start) pair reproduces a batch exactly.
+
+#include <cstdint>
+
+namespace {
+
+inline uint64_t xorshift64(uint64_t* s) {
+  uint64_t x = *s;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  *s = x;
+  return x;
+}
+
+inline float uniform01(uint64_t* s) {
+  return static_cast<float>((xorshift64(s) >> 11) * (1.0 / 9007199254740992.0));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Skip-gram (center, context) pair generation.
+// Returns the number of pairs written (<= cap); *next_pos is the resume
+// position in the id stream (call again from there for the next batch).
+long long we_skipgram_pairs(const int32_t* ids, long long n, long long start,
+                            int window, const float* keep, uint64_t seed,
+                            int32_t* centers, int32_t* contexts,
+                            long long cap, long long* next_pos) {
+  uint64_t rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  long long out = 0;
+  long long pos = start;
+  for (; pos < n; ++pos) {
+    int32_t w = ids[pos];
+    if (w < 0) continue;  // sentence break
+    if (keep && uniform01(&rng) >= keep[w]) continue;  // subsampled out
+    if (out + 2 * static_cast<long long>(window) > cap) break;  // batch full
+    int b = window > 1 ? static_cast<int>(xorshift64(&rng) % window) : 0;
+    int eff = window - b;
+    // left side: stop at a sentence break, don't cross it
+    for (int off = -1; off >= -eff; --off) {
+      long long c = pos + off;
+      if (c < 0 || ids[c] < 0) break;
+      centers[out] = w;
+      contexts[out] = ids[c];
+      ++out;
+    }
+    // right side
+    for (int off = 1; off <= eff; ++off) {
+      long long c = pos + off;
+      if (c >= n || ids[c] < 0) break;
+      centers[out] = w;
+      contexts[out] = ids[c];
+      ++out;
+    }
+  }
+  *next_pos = pos;
+  return out;
+}
+
+// CBOW batch generation: one row per kept center word; context row padded
+// with -1 (the jitted step masks them).
+long long we_cbow_batch(const int32_t* ids, long long n, long long start,
+                        int window, const float* keep, uint64_t seed,
+                        int32_t* targets, int32_t* ctx, long long cap,
+                        long long* next_pos) {
+  uint64_t rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  const int w2 = 2 * window;
+  long long out = 0;
+  long long pos = start;
+  for (; pos < n && out < cap; ++pos) {
+    int32_t w = ids[pos];
+    if (w < 0) continue;
+    if (keep && uniform01(&rng) >= keep[w]) continue;
+    int b = window > 1 ? static_cast<int>(xorshift64(&rng) % window) : 0;
+    int eff = window - b;
+    int32_t* row = ctx + out * w2;
+    int k = 0;
+    for (int off = -1; off >= -eff; --off) {
+      long long c = pos + off;
+      if (c < 0 || ids[c] < 0) break;
+      row[k++] = ids[c];
+    }
+    for (int off = 1; off <= eff; ++off) {
+      long long c = pos + off;
+      if (c >= n || ids[c] < 0) break;
+      row[k++] = ids[c];
+    }
+    if (k == 0) continue;  // no usable context
+    for (; k < w2; ++k) row[k] = -1;
+    targets[out] = w;
+    ++out;
+  }
+  *next_pos = pos;
+  return out;
+}
+
+}  // extern "C"

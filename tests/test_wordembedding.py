@@ -1,0 +1,244 @@
+"""WordEmbedding app tests: dictionary, Huffman, sampler, pipeline, training
+modes (NS/HS x skip-gram/CBOW x sgd/adagrad), save/eval."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
+from multiverso_tpu.models.wordembedding.sampler import AliasSampler, subsample_keep_probs
+
+
+# ---------------------------------------------------------------- dictionary
+
+
+def test_dictionary_build_save_load(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("a a a b b c d d d d\n" * 3)
+    d = Dictionary.build([str(corpus)], min_count=3)
+    # d:12, a:9, b:6 kept; c:3 kept; descending frequency order
+    assert d.words[0] == "d" and d.words[1] == "a"
+    assert d.id_of("zzz") == -1
+    vocab = tmp_path / "v.txt"
+    d.save(str(vocab))
+    d2 = Dictionary.load(str(vocab))
+    assert d2.words == d.words
+    np.testing.assert_array_equal(d2.counts, d.counts)
+
+
+def test_dictionary_min_count_and_stopwords(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("the the the the cat cat cat dog\n")
+    d = Dictionary.build([str(corpus)], min_count=2, stopwords={"the"})
+    assert "the" not in d.word2id and "dog" not in d.word2id
+    assert d.words == ["cat"]
+
+
+def test_encode_corpus(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("x y z\ny z\n")
+    d = Dictionary.build([str(corpus)], min_count=1)
+    ids = d.encode_corpus([str(corpus)])
+    assert len(ids) == 5
+    assert set(ids.tolist()) == {0, 1, 2}
+
+
+# ------------------------------------------------------------------- huffman
+
+
+def test_huffman_codes_prefix_free_and_frequency_ordered():
+    counts = np.asarray([100, 50, 20, 10, 5, 1])
+    h = HuffmanEncoder(counts)
+    assert h.num_inner_nodes == 5
+    # frequent words get shorter codes
+    assert h.lengths[0] <= h.lengths[-1]
+    # prefix-free: no code is a prefix of another
+    codes = []
+    for w in range(6):
+        l = h.lengths[w]
+        codes.append(tuple(h.codes[w, :l].tolist()))
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert a != b[: len(a)], f"code {i} is a prefix of {j}"
+    # points are valid inner-node ids
+    for w in range(6):
+        l = h.lengths[w]
+        assert (h.points[w, :l] >= 0).all() and (h.points[w, :l] < 5).all()
+
+
+def test_huffman_paths_for_batch():
+    h = HuffmanEncoder(np.asarray([10, 8, 2, 1]))
+    points, codes, lengths = h.paths_for(np.asarray([0, 3]))
+    assert points.shape == codes.shape == (2, h.max_code_length)
+    assert lengths[0] <= lengths[1]
+
+
+# ------------------------------------------------------------------- sampler
+
+
+def test_alias_sampler_distribution():
+    counts = np.asarray([1000, 100, 10, 1])
+    s = AliasSampler(counts)
+    rng = np.random.RandomState(0)
+    draws = s.sample_np(rng, (200000,))
+    freq = np.bincount(draws, minlength=4) / 200000
+    expect = counts**0.75 / (counts**0.75).sum()
+    np.testing.assert_allclose(freq, expect, atol=0.01)
+
+
+def test_alias_sampler_device_matches_distribution():
+    import jax
+
+    counts = np.asarray([100, 50, 25, 5])
+    s = AliasSampler(counts)
+    draws = np.asarray(s.sample(jax.random.PRNGKey(0), (100000,)))
+    freq = np.bincount(draws, minlength=4) / 100000
+    expect = counts**0.75 / (counts**0.75).sum()
+    np.testing.assert_allclose(freq, expect, atol=0.02)
+
+
+def test_subsample_keep_probs():
+    counts = np.asarray([10**6, 100])
+    keep = subsample_keep_probs(counts, 1e-3)
+    assert keep[0] < 0.2 and keep[1] == 1.0  # frequent word downsampled
+    np.testing.assert_array_equal(subsample_keep_probs(counts, 0), [1, 1])
+
+
+# ------------------------------------------------------------------ pipeline
+
+
+def _toy_dict_and_ids(tmp_path, text):
+    corpus = tmp_path / "c.txt"
+    corpus.write_text(text)
+    d = Dictionary.build([str(corpus)], min_count=1)
+    ids = d.encode_corpus([str(corpus)])
+    return d, ids
+
+
+def test_pipeline_ns_shapes(tmp_path):
+    d, ids = _toy_dict_and_ids(tmp_path, "a b c d e f g h i j " * 50)
+    from multiverso_tpu.models.wordembedding.pipeline import BatchPipeline
+
+    pipe = BatchPipeline(
+        ids, window=3, batch_size=64, negatives=4, sampler=AliasSampler(d.counts)
+    )
+    batches = list(pipe.batches())
+    assert len(batches) >= 5
+    for b in batches:
+        assert b["centers"].shape == (64,)
+        assert b["outputs"].shape == (64, 5)
+        assert (b["outputs"] >= 0).all() and (b["outputs"] < len(d)).all()
+
+
+def test_pipeline_hs_shapes(tmp_path):
+    d, ids = _toy_dict_and_ids(tmp_path, "a b c d e f g h " * 40)
+    from multiverso_tpu.models.wordembedding.pipeline import BatchPipeline
+
+    h = HuffmanEncoder(d.counts)
+    pipe = BatchPipeline(ids, window=2, batch_size=32, huffman=h)
+    b = next(pipe.batches())
+    assert b["points"].shape == (32, h.max_code_length)
+    assert set(np.unique(b["codes"])).issubset({0, 1})
+    assert (b["lengths"] >= 1).all()
+
+
+def test_pipeline_cbow_shapes(tmp_path):
+    d, ids = _toy_dict_and_ids(tmp_path, "a b c d e f g h " * 40)
+    from multiverso_tpu.models.wordembedding.pipeline import BatchPipeline
+
+    pipe = BatchPipeline(
+        ids, window=3, batch_size=16, negatives=2, cbow=True,
+        sampler=AliasSampler(d.counts),
+    )
+    b = next(pipe.batches())
+    assert b["contexts"].shape == (16, 6)
+    assert b["outputs"].shape == (16, 3)
+    # padded slots are -1, real slots valid ids
+    ctx = b["contexts"]
+    assert ((ctx == -1) | ((ctx >= 0) & (ctx < len(d)))).all()
+
+
+# ------------------------------------------------------------------ training
+
+
+def _cluster_corpus(tmp_path, n_sentences=800, seed=0):
+    """Two word clusters that never co-occur: embeddings must separate them."""
+    rng = np.random.RandomState(seed)
+    a_words = [f"a{i}" for i in range(6)]
+    b_words = [f"b{i}" for i in range(6)]
+    lines = []
+    for _ in range(n_sentences):
+        group = a_words if rng.rand() < 0.5 else b_words
+        lines.append(" ".join(rng.choice(group, size=8)))
+    corpus = tmp_path / "clusters.txt"
+    corpus.write_text("\n".join(lines) + "\n")
+    return corpus
+
+
+def _intra_inter_sim(we):
+    emb = we.embeddings()
+    emb = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-12)
+    a_ids = [we.dict.id_of(w) for w in we.dict.words if w.startswith("a")]
+    b_ids = [we.dict.id_of(w) for w in we.dict.words if w.startswith("b")]
+    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
+    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+    return intra, inter
+
+
+@pytest.mark.parametrize("mode", ["ns", "hs", "cbow", "adagrad"])
+def test_training_separates_clusters(tmp_path, mode):
+    corpus = _cluster_corpus(tmp_path)
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+
+    cbow = mode == "cbow"
+    opt = WEOptions(
+        size=24,
+        train_file=str(corpus),
+        min_count=1,
+        window=4,
+        negative=4,
+        # the 12-word vocab repeats every row ~20x per batch, so the per-row
+        # mean gives ~1 effective step per batch — the tiny corpus needs many
+        # more passes than a real vocabulary would (CBOW more still)
+        epoch=30 if cbow else 15,
+        alpha=0.2 if cbow else 0.1,
+        sample=0.0,
+        batch_size=256,
+        is_pipeline=(mode == "ns"),  # exercise both paths
+        hs=(mode == "hs"),
+        cbow=cbow,
+        use_adagrad=(mode == "adagrad"),
+        output_file="",
+    )
+    we = WordEmbedding(opt)
+    we.train()
+    intra, inter = _intra_inter_sim(we)
+    assert intra > inter + 0.2, f"{mode}: intra {intra:.3f} vs inter {inter:.3f}"
+
+
+def test_save_and_eval_roundtrip(tmp_path):
+    corpus = _cluster_corpus(tmp_path, n_sentences=200)
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.eval import (
+        load_word2vec_text,
+        nearest,
+        similarity_spearman,
+    )
+
+    opt = WEOptions(
+        size=16, train_file=str(corpus), min_count=1, window=3, negative=3,
+        epoch=2, alpha=0.025, sample=0.0, batch_size=128,
+        output_file=str(tmp_path / "emb.txt"),
+    )
+    we = WordEmbedding(opt)
+    we.train()
+    words, emb = load_word2vec_text(str(tmp_path / "emb.txt"))
+    assert words == we.dict.words
+    np.testing.assert_allclose(emb, we.embeddings(), atol=1e-5)
+    nn = nearest(words, emb, "a0", k=3)
+    assert len(nn) == 3
+    rho, n = similarity_spearman(
+        words, emb, [("a0", "a1", 9.0), ("a0", "b0", 1.0), ("a1", "b1", 1.5)]
+    )
+    assert n == 3
