@@ -57,6 +57,13 @@ MV_DEFINE_bool("ma", False, "model-averaging mode: no tables, MV_Aggregate only"
 # (pipeline double-buffer gets, sync_frequency batching) in the handler layer.
 MV_DEFINE_bool("sync", False, "BSP-synchronous update application (see note above)")
 MV_DEFINE_int("num_shards", 0, "table shard axis size (0 = role ALL 1-D mesh)")
+# Straggler-mitigation knob. The reference *declares* this flag
+# (ref: src/server.cpp:21) but never reads it anywhere in the snapshot — a
+# vestige of a backup-worker feature. Declared here for flag parity; under a
+# single-controller SPMD program there are no stragglers to mitigate (every
+# worker's delta arrives in the same program), so it is accepted and ignored,
+# exactly like the reference.
+MV_DEFINE_int("backup_worker_ratio", 0, "ratio% of backup workers, set 20 means 20%")
 MV_DEFINE_bool("multihost", False, "call jax.distributed.initialize() at start")
 
 

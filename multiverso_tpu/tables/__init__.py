@@ -8,6 +8,7 @@ ArrayTable (1-D), MatrixTable (2-D row-sharded), SparseMatrixTable
 from multiverso_tpu.tables.array_table import ArrayTable, ArrayTableOption
 from multiverso_tpu.tables.base import DenseTable, TableOption, create_table
 from multiverso_tpu.tables.kv_table import KVTable, KVTableOption
+from multiverso_tpu.tables.matrix import Matrix, MatrixOption
 from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
 from multiverso_tpu.tables.sparse_matrix_table import (
     SparseMatrixTable,
@@ -20,6 +21,8 @@ __all__ = [
     "DenseTable",
     "KVTable",
     "KVTableOption",
+    "Matrix",
+    "MatrixOption",
     "MatrixTable",
     "MatrixTableOption",
     "SparseMatrixTable",

@@ -68,7 +68,7 @@ def create_table(option: TableOption):
     table_cls = _TABLE_TYPES.get(type(option))
     if table_cls is None:
         Log.Fatal("no table type registered for option %s", type(option).__name__)
-    table = table_cls(option)
+    table = table_cls(option)  # class or factory function (unified Matrix)
     table.table_id = rt.register_table(table)
     rt.barrier()
     return table
@@ -88,6 +88,7 @@ class DenseTable:
         updater_type: Optional[str] = None,
         init_value: Optional[np.ndarray] = None,
         name: str = "table",
+        worker_state_slots: Optional[int] = None,
     ):
         rt = runtime()
         mesh = rt.mesh
@@ -116,9 +117,15 @@ class DenseTable:
             pad = [(0, self._padded0 - self.shape[0])] + [(0, 0)] * (len(self.shape) - 1)
             init = np.pad(init_value, pad)
         self.storage = jax.device_put(init, self._sharding)
+        # per-worker updater slots are sized by *view* count: pipelined sparse
+        # tables double the views, and the reference doubles DCASGD slots the
+        # same way (ref: src/updater/updater.cpp:54 MV_CONFIG_is_pipelined)
+        self.worker_state_slots = int(worker_state_slots or self.num_workers)
         self.state = {
             k: jax.device_put(v, self._state_sharding(v))
-            for k, v in self.updater.init_state(self._pshape, self.num_workers, self.dtype).items()
+            for k, v in self.updater.init_state(
+                self._pshape, self.worker_state_slots, self.dtype, init=init
+            ).items()
         }
         self._compiled: Dict[str, Any] = {}
 
@@ -251,6 +258,7 @@ class DenseTable:
             tuple(delta.shape) == self.shape,
             f"add delta shape {delta.shape} != table shape {self.shape}",
         )
+        self._check_worker_slot(option.worker_id)
         self.storage, self.state = self._add_single_fn()(
             self.storage,
             self.state,
@@ -258,6 +266,16 @@ class DenseTable:
             jnp.int32(option.worker_id),
             option.scalars(),
         )
+
+    def _check_worker_slot(self, worker_id: int) -> None:
+        """Per-worker-state updaters index state by worker/view id; XLA
+        clamps out-of-range indices silently, so fail fast on the host."""
+        if self.updater.per_worker_state:
+            CHECK(
+                0 <= worker_id < self.worker_state_slots,
+                f"worker/view id {worker_id} out of range for "
+                f"{self.worker_state_slots} per-worker updater slots",
+            )
 
     def add_per_worker(self, deltas, option: Optional[AddOption] = None) -> None:
         """All workers' Adds for one round in a single SPMD program — the

@@ -1,0 +1,78 @@
+"""Unified Matrix table.
+
+TPU-native rebuild of the reference's newer merged dense+sparse matrix table
+(ref: include/multiverso/table/matrix.h:14-123, src/table/matrix.cpp): one
+option record ``MatrixOption{num_row, num_col, is_sparse, is_pipeline}``
+selecting the dense row-sharded path or the delta-tracking sparse path (which
+in the reference replicates the ``up_to_date_`` logic of SparseMatrixTable —
+matrix.cpp; here it *shares* it by construction, since both paths are the
+same sharded-array machinery).
+
+``Matrix(option)`` (and ``MV_CreateTable(MatrixOption(...))``) returns a
+``MatrixTable`` or ``SparseMatrixTable`` instance accordingly — the unified
+surface the reference exposes via ``MatrixWorker<T>``/``MatrixServer<T>``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.tables.base import TableOption, register_table_type
+from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
+from multiverso_tpu.tables.sparse_matrix_table import (
+    SparseMatrixTable,
+    SparseMatrixTableOption,
+)
+
+__all__ = ["MatrixOption", "Matrix"]
+
+
+@dataclasses.dataclass
+class MatrixOption(TableOption):
+    """Ref: MatrixOption{num_row, num_col, is_sparse, is_pipeline}
+    (matrix.h:14-123) plus dtype/updater/init selection."""
+
+    num_row: int
+    num_col: int
+    is_sparse: bool = False
+    is_pipeline: bool = False
+    dtype: Any = "float32"
+    updater_type: Optional[str] = None
+    init_value: Optional[np.ndarray] = None
+    init_uniform: Optional[Tuple[float, float]] = None
+    seed: int = 0
+    name: str = "matrix"
+
+
+@register_table_type(MatrixOption)
+def Matrix(option: MatrixOption):
+    """Factory: dense or sparse matrix table from one unified option."""
+    if option.is_sparse:
+        return SparseMatrixTable(
+            SparseMatrixTableOption(
+                num_row=option.num_row,
+                num_col=option.num_col,
+                dtype=option.dtype,
+                updater_type=option.updater_type,
+                init_value=option.init_value,
+                init_uniform=option.init_uniform,
+                seed=option.seed,
+                is_pipeline=option.is_pipeline,
+                name=option.name,
+            )
+        )
+    return MatrixTable(
+        MatrixTableOption(
+            num_row=option.num_row,
+            num_col=option.num_col,
+            dtype=option.dtype,
+            updater_type=option.updater_type,
+            init_value=option.init_value,
+            init_uniform=option.init_uniform,
+            seed=option.seed,
+            name=option.name,
+        )
+    )

@@ -57,6 +57,10 @@ class MatrixTableOption(TableOption):
     init_uniform: Optional[Tuple[float, float]] = None
     seed: int = 0
     name: str = "matrix_table"
+    # per-worker updater slot count override (pipelined sparse tables double
+    # their views; the reference doubles DCASGD slots the same way —
+    # ref: src/updater/updater.cpp:54)
+    worker_state_slots: Optional[int] = None
 
 
 @register_table_type(MatrixTableOption)
@@ -81,6 +85,7 @@ class MatrixTable(DenseTable):
             updater_type=option.updater_type,
             init_value=init_value,
             name=option.name,
+            worker_state_slots=option.worker_state_slots,
         )
         self.num_row = option.num_row
         self.num_col = option.num_col
@@ -183,6 +188,7 @@ class MatrixTable(DenseTable):
         ids = jnp.asarray(row_ids, jnp.int32)
         deltas = jnp.asarray(deltas)
         self._check_row_args(np.asarray(row_ids, np.int32), deltas.shape)
+        self._check_worker_slot(option.worker_id)
         self.storage, self.state = self._add_rows_fn()(
             self.storage,
             self.state,

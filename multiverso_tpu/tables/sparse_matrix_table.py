@@ -31,6 +31,7 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from multiverso_tpu.runtime import runtime
 from multiverso_tpu.tables.base import TableOption, register_table_type
 from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
 from multiverso_tpu.updaters import AddOption, GetOption
@@ -46,6 +47,8 @@ class SparseMatrixTableOption(TableOption):
     dtype: Any = "float32"
     updater_type: Optional[str] = None
     init_value: Optional[np.ndarray] = None
+    init_uniform: Optional[Tuple[float, float]] = None
+    seed: int = 0
     is_pipeline: bool = False
     name: str = "sparse_matrix_table"
 
@@ -53,6 +56,7 @@ class SparseMatrixTableOption(TableOption):
 @register_table_type(SparseMatrixTableOption)
 class SparseMatrixTable(MatrixTable):
     def __init__(self, option: SparseMatrixTableOption):
+        num_views = runtime().num_workers * (2 if option.is_pipeline else 1)
         super().__init__(
             MatrixTableOption(
                 num_row=option.num_row,
@@ -60,10 +64,13 @@ class SparseMatrixTable(MatrixTable):
                 dtype=option.dtype,
                 updater_type=option.updater_type,
                 init_value=option.init_value,
+                init_uniform=option.init_uniform,
+                seed=option.seed,
                 name=option.name,
+                worker_state_slots=num_views,
             )
         )
-        self.num_views = self.num_workers * (2 if option.is_pipeline else 1)
+        self.num_views = num_views
         # False == stale (matches the reference's zeroed up_to_date_)
         self._up_to_date = np.zeros((self.num_views, self.num_row), dtype=bool)
 

@@ -40,7 +40,9 @@ from multiverso_tpu.utils.log import Log
 __all__ = ["AddOption", "GetOption", "Updater", "make_updater", "available_updaters"]
 
 MV_DEFINE_string(
-    "updater_type", "default", "server-side updater: default|sgd|momentum_sgd|adagrad"
+    "updater_type",
+    "default",
+    "server-side updater: default|sgd|momentum_sgd|adagrad|dcasgd",
 )
 
 
@@ -91,7 +93,11 @@ class Updater:
     # lets row-sparse adds lower to one O(k) scatter instead of a full-table op
     delta_sign = 1
 
-    def init_state(self, shape: Tuple[int, ...], num_workers: int, dtype) -> State:
+    def init_state(
+        self, shape: Tuple[int, ...], num_workers: int, dtype, init=None
+    ) -> State:
+        """``init`` is the table's (padded) initial value, for updaters whose
+        state must start at the weights (DC-ASGD backups)."""
         return {}
 
     def scatter_apply(
@@ -138,7 +144,7 @@ class MomentumUpdater(Updater):
     name = "momentum_sgd"
     linear = False
 
-    def init_state(self, shape, num_workers, dtype):
+    def init_state(self, shape, num_workers, dtype, init=None):
         return {"smooth": jnp.zeros(shape, dtype)}
 
     def apply(self, data, delta, state, worker_id, opt):
@@ -153,7 +159,7 @@ class AdaGradUpdater(Updater):
     per_worker_state = True
     epsilon = 1e-6
 
-    def init_state(self, shape, num_workers, dtype):
+    def init_state(self, shape, num_workers, dtype, init=None):
         # per-worker accumulators, one row per worker, sharded with the table
         # (ref: adagrad_updater.h:19 — historic_g_sqr_[num_workers][size])
         return {"g2": jnp.zeros((num_workers,) + tuple(shape), dtype)}
@@ -167,8 +173,54 @@ class AdaGradUpdater(Updater):
         return data, {"g2": state["g2"].at[worker_id].set(g2_w)}
 
 
+class DCASGDUpdater(Updater):
+    """Delay-compensated ASGD (Zheng et al., ICML 2017).
+
+    The reference build system references a ``dcasgd`` updater
+    (ref: CMakeLists.txt:9 ``ENABLE_DCASGD``; src/updater/updater.cpp:7-9,53-55
+    expects ``updater/dcasgd/dcasgd_updater.h``) but the directory is empty in
+    the snapshot — a documented-but-absent feature. Implemented here from the
+    paper's update rule: for a delta pushed by worker ``m`` (computed against
+    the stale weights that worker last pulled),
+
+        grad   = delta / lr
+        data  -= lr * (grad + lambda * grad ⊙ grad ⊙ (data - backup[m]))
+        backup[m] = data            (the compensated post-update weights)
+
+    ``lambda`` rides the AddOption ``lambda_`` slot — the slot the reference
+    reserved for exactly this updater (ref: updater.h:10-70). The per-worker
+    backup layout (num_workers x shard) matches the per-worker AdaGrad
+    accumulator layout and is sharded with the table.
+    """
+
+    name = "dcasgd"
+    linear = False
+    per_worker_state = True
+
+    def init_state(self, shape, num_workers, dtype, init=None):
+        if init is None:
+            return {"backup": jnp.zeros((num_workers,) + tuple(shape), dtype)}
+        base = jnp.asarray(init, dtype)
+        return {"backup": jnp.broadcast_to(base, (num_workers,) + tuple(shape))}
+
+    def apply(self, data, delta, state, worker_id, opt):
+        lr = opt["learning_rate"].astype(data.dtype)
+        lam = opt["lambda_"].astype(data.dtype)
+        grad = delta / lr
+        backup = state["backup"][worker_id]
+        data = data - lr * (grad + lam * grad * grad * (data - backup))
+        return data, {"backup": state["backup"].at[worker_id].set(data)}
+
+
 _REGISTRY = {
-    u.name: u for u in (DefaultUpdater(), SGDUpdater(), MomentumUpdater(), AdaGradUpdater())
+    u.name: u
+    for u in (
+        DefaultUpdater(),
+        SGDUpdater(),
+        MomentumUpdater(),
+        AdaGradUpdater(),
+        DCASGDUpdater(),
+    )
 }
 
 

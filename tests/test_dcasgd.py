@@ -1,0 +1,68 @@
+"""DC-ASGD updater tests (Zheng et al., ICML 2017).
+
+The reference gates a dcasgd updater behind ENABLE_DCASGD but ships an empty
+directory (ref: src/updater/updater.cpp:53-55; include/multiverso/updater/
+dcasgd/ is empty) — this build implements the paper's rule for real.
+"""
+
+import numpy as np
+
+from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+from multiverso_tpu.updaters import AddOption
+
+
+def _expected_dcasgd(data, backup, delta, lr, lam):
+    grad = delta / lr
+    new = data - lr * (grad + lam * grad * grad * (data - backup))
+    return new
+
+
+def test_dcasgd_first_add_equals_sgd(mv_env):
+    """backup starts at the initial weights, so the first add from each
+    worker has zero compensation: pure sgd step."""
+    init = np.arange(1.0, 9.0, dtype=np.float32)
+    t = mv_env.MV_CreateTable(
+        ArrayTableOption(size=8, updater_type="dcasgd", init_value=init)
+    )
+    delta = np.full(8, 0.2, np.float32)
+    lr = 0.1
+    t.add(delta, AddOption(worker_id=0, learning_rate=lr, lambda_=0.5))
+    np.testing.assert_allclose(t.get(), init - delta / lr * lr, rtol=1e-6)
+
+
+def test_dcasgd_compensates_stale_worker(mv_env):
+    """After worker 0 moves the weights, worker 1's (stale) add is corrected
+    by lambda * g^2 * (data - backup[1]); verify against the formula."""
+    init = np.ones(6, np.float32)
+    t = mv_env.MV_CreateTable(
+        ArrayTableOption(size=6, updater_type="dcasgd", init_value=init)
+    )
+    lr, lam = 0.1, 0.5
+    d0 = np.full(6, 0.3, np.float32)
+    d1 = np.full(6, 0.4, np.float32)
+
+    # worker 0 add: backup[0] == backup[1] == init
+    t.add(d0, AddOption(worker_id=0, learning_rate=lr, lambda_=lam))
+    after0 = _expected_dcasgd(init, init, d0, lr, lam)
+    np.testing.assert_allclose(t.get(), after0, rtol=1e-5)
+
+    # worker 1's backup is still init (stale view)
+    t.add(d1, AddOption(worker_id=1, learning_rate=lr, lambda_=lam))
+    after1 = _expected_dcasgd(after0, init, d1, lr, lam)
+    np.testing.assert_allclose(t.get(), after1, rtol=1e-5)
+
+    # worker 1 again: its backup advanced to after1
+    t.add(d1, AddOption(worker_id=1, learning_rate=lr, lambda_=lam))
+    after2 = _expected_dcasgd(after1, after1, d1, lr, lam)
+    np.testing.assert_allclose(t.get(), after2, rtol=1e-5)
+
+
+def test_dcasgd_row_adds_leave_untouched_rows(mv_env):
+    t = mv_env.MV_CreateTable(
+        MatrixTableOption(num_row=5, num_col=3, updater_type="dcasgd")
+    )
+    d = np.ones((2, 3), np.float32) * 0.1
+    t.add_rows([1, 3], d, AddOption(worker_id=0, learning_rate=0.1, lambda_=0.1))
+    got = t.get()
+    assert np.all(got[[0, 2, 4]] == 0.0)
+    assert np.all(got[[1, 3]] != 0.0)

@@ -1,0 +1,89 @@
+"""Unified Matrix table tests (ref: include/multiverso/table/matrix.h:14-123).
+
+One MatrixOption drives both the dense and the sparse (delta-tracking) path,
+exactly as the reference's merged MatrixWorker/MatrixServer do.
+"""
+
+import numpy as np
+
+from multiverso_tpu.tables import Matrix, MatrixOption, MatrixTable, SparseMatrixTable
+from multiverso_tpu.updaters import AddOption, GetOption
+
+
+def test_dense_dispatch_and_roundtrip(mv_env):
+    t = mv_env.MV_CreateTable(MatrixOption(num_row=6, num_col=4))
+    assert isinstance(t, MatrixTable) and not isinstance(t, SparseMatrixTable)
+    delta = np.arange(24, dtype=np.float32).reshape(6, 4)
+    t.add(delta)
+    np.testing.assert_allclose(t.get(), delta)
+
+
+def test_sparse_dispatch_delta_tracking(mv_env):
+    t = mv_env.MV_CreateTable(
+        MatrixOption(num_row=8, num_col=3, is_sparse=True)
+    )
+    assert isinstance(t, SparseMatrixTable)
+    # first get: everything stale for worker 0
+    ids, rows = t.get_sparse(option=GetOption(worker_id=0))
+    assert ids.shape[0] == 8
+    # nothing stale now -> reference quirk: still returns row 0
+    ids, _ = t.get_sparse(option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(ids, [0])
+    # another worker's add dirties those rows for worker 0 only
+    t.add_rows([2, 5], np.ones((2, 3), np.float32), AddOption(worker_id=1))
+    ids, rows = t.get_sparse(option=GetOption(worker_id=0))
+    np.testing.assert_array_equal(np.sort(ids), [2, 5])
+    np.testing.assert_allclose(rows, np.ones((2, 3), np.float32))
+
+
+def test_sparse_pipeline_doubles_views(mv_env):
+    t = mv_env.MV_CreateTable(
+        MatrixOption(num_row=4, num_col=2, is_sparse=True, is_pipeline=True)
+    )
+    assert t.num_views == 2 * mv_env.MV_NumWorkers()
+
+
+def test_uniform_init_identical_across_paths(mv_env):
+    """The unified option must initialize identically for the same seed
+    whichever path it dispatches to."""
+    dense = mv_env.MV_CreateTable(
+        MatrixOption(num_row=16, num_col=8, init_uniform=(-0.5, 0.5), seed=3)
+    )
+    sparse = mv_env.MV_CreateTable(
+        MatrixOption(
+            num_row=16, num_col=8, is_sparse=True, init_uniform=(-0.5, 0.5), seed=3
+        )
+    )
+    v = dense.get()
+    assert v.min() >= -0.5 and v.max() <= 0.5 and np.abs(v).sum() > 0
+    np.testing.assert_array_equal(v, sparse.get())
+
+
+def test_pipeline_views_get_own_dcasgd_slots(mv_env):
+    """Pipelined sparse views double the per-worker updater slots (the
+    reference doubles DCASGD slots under is_pipelined —
+    ref: src/updater/updater.cpp:54); a view id >= num_workers must address
+    its own backup, not clamp onto another worker's."""
+    nw = mv_env.MV_NumWorkers()
+    t = mv_env.MV_CreateTable(
+        MatrixOption(
+            num_row=4,
+            num_col=2,
+            is_sparse=True,
+            is_pipeline=True,
+            updater_type="dcasgd",
+        )
+    )
+    assert t.worker_state_slots == 2 * nw
+    d = np.full((1, 2), 0.1, np.float32)
+    t.add_rows([1], d, AddOption(worker_id=2 * nw - 1, learning_rate=0.1))
+    backup = np.asarray(t.state["backup"])
+    # the highest view's backup advanced; untouched views' stayed zero
+    assert np.any(backup[2 * nw - 1, 1] != 0.0)
+    assert np.all(backup[0] == 0.0)
+    # out-of-range view id fails fast instead of clamping
+    import pytest
+    from multiverso_tpu.utils.log import FatalError
+
+    with pytest.raises(FatalError):
+        t.add_rows([1], d, AddOption(worker_id=2 * nw, learning_rate=0.1))
