@@ -161,10 +161,11 @@ class FTRLModel:
         zn = data["zn"]
         CHECK(zn.shape == (self.F, 2), f"ftrl state shape {zn.shape} != {(self.F, 2)}")
         if self.table is not None:
-            from multiverso_tpu.runtime import runtime
-
-            if runtime().rank == 0:  # worker-0 injection (ps_model.cpp:113-168)
-                self.table.add(zn - self.table.get())
+            # one logical SPMD Add, issued by every process (the reference's
+            # worker-0 gate — ps_model.cpp:113-168 — exists because its N
+            # processes would each add a copy; gating here would deadlock
+            # multihost collectives instead)
+            self.table.add(zn - self.table.get())
             self.table.wait()
         else:
             self._zn = jnp.asarray(zn, jnp.float32)

@@ -192,13 +192,13 @@ class PSModel(LocalModel):
         super().save(uri)
 
     def load(self, uri: str) -> None:
-        """Load-as-Add from worker 0 only (ref: ps_model.cpp:113-168 gates
-        the injection on the first worker so N processes don't add N copies)."""
+        """Load-as-Add (ref: ps_model.cpp:113-168). The reference gates the
+        injection on worker 0 because each of its N processes issues its own
+        Add; here the Add is ONE logical SPMD program, issued identically by
+        every process (multihost included — gating any process on rank would
+        deadlock the collectives), so it lands exactly once by construction."""
         super().load(uri)
-        from multiverso_tpu.runtime import runtime
-
-        if runtime().rank == 0:
-            current = self.table.get()
-            self.table.add(np.asarray(self.W).T - current)
+        current = self.table.get()
+        self.table.add(np.asarray(self.W).T - current)
         self.table.wait()
         self.W = jnp.asarray(self.table.get().T)

@@ -83,9 +83,22 @@ def similarity_spearman(
         return 0.0, 0
 
     def _ranks(v):
-        order = np.argsort(v)
+        # average ranks for ties (scipy rankdata semantics) — human scores
+        # have many exact ties, and arbitrary tie-breaking would make rho
+        # depend on pair order in the file
+        v = np.asarray(v)
+        order = np.argsort(v, kind="stable")
         ranks = np.empty(len(v))
-        ranks[order] = np.arange(len(v))
+        ranks[order] = np.arange(len(v), dtype=np.float64)
+        sv = v[order]
+        i = 0
+        while i < len(sv):
+            j = i
+            while j + 1 < len(sv) and sv[j + 1] == sv[i]:
+                j += 1
+            if j > i:
+                ranks[order[i : j + 1]] = (i + j) / 2.0
+            i = j + 1
         return ranks
 
     rx, ry = _ranks(np.asarray(xs)), _ranks(np.asarray(ys))

@@ -63,9 +63,13 @@ class BatchPipeline:
         B = self.batch_size
         while pos < n or sum(len(c) for c in pending_c) >= 1:
             if pos < n:
+                # fold the corpus position into the seed so each chunk's
+                # xorshift stream differs (a constant seed would restart the
+                # same subsample/window-shrink draws every ~batch)
+                chunk_seed = (seed + pos * 0x9E3779B97F4A7C15) & ((1 << 64) - 1) or 1
                 if self.cbow:
                     t, ctx, pos = cbow_batch(
-                        self.ids, pos, self.window, B, self.keep, seed
+                        self.ids, pos, self.window, B, self.keep, chunk_seed
                     )
                     if len(t) == 0 and pos >= n:
                         break
@@ -73,7 +77,7 @@ class BatchPipeline:
                     pending_x.append(ctx)
                 else:
                     c, x, pos = skipgram_pairs(
-                        self.ids, pos, self.window, 2 * B, self.keep, seed
+                        self.ids, pos, self.window, 2 * B, self.keep, chunk_seed
                     )
                     if len(c) == 0 and pos >= n:
                         break

@@ -30,7 +30,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SkipGramConfig", "init_params", "loss_fn", "make_sgd_step"]
+__all__ = [
+    "SkipGramConfig",
+    "init_params",
+    "loss_fn",
+    "make_sgd_step",
+    "make_train_step",
+    "init_adagrad_slots",
+    "make_batch",
+]
 
 
 @dataclasses.dataclass

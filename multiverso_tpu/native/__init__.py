@@ -31,14 +31,18 @@ def _build() -> Optional[str]:
     lib_path = os.path.join(out_dir, "libwe_pairgen.so")
     if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(_SRC):
         return lib_path
-    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-shared", _SRC, "-o", lib_path]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        Log.Info("[native] built %s", lib_path)
-        return lib_path
-    except (subprocess.SubprocessError, FileNotFoundError) as e:
-        Log.Error("[native] build failed (%s); using python fallback", e)
-        return None
+    base = ["g++", "-O3", "-fPIC", "-shared", _SRC, "-o", lib_path]
+    # try the host-tuned build first, then a portable one
+    for extra in (["-march=native"], []):
+        cmd = base[:2] + extra + base[2:]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            Log.Info("[native] built %s", lib_path)
+            return lib_path
+        except (subprocess.SubprocessError, FileNotFoundError) as e:
+            err = e
+    Log.Error("[native] build failed (%s); using python fallback", err)
+    return None
 
 
 def pairgen_lib() -> Optional[ctypes.CDLL]:
