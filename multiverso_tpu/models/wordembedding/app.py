@@ -6,7 +6,7 @@ and Readme.txt): flag-driven training of skip-gram/CBOW with negative
 sampling or hierarchical softmax, optional per-row AdaGrad, vocab build/load
 (-read_vocab / -save_vocab), subsampling (-sample), word2vec-format embedding
 save (-binary), words/sec logging, and the pipelined block loop
-(-is_pipeline) — here an ``ASyncBuffer`` prefetching host batches while the
+(-is_pipeline) — here a producer thread + native MtQueue prefetching host batches while the
 jitted TPU step runs.
 
 Two training paths:
@@ -34,7 +34,7 @@ import jax.numpy as jnp
 
 from multiverso_tpu.models.wordembedding.dictionary import Dictionary
 from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
-from multiverso_tpu.models.wordembedding.pipeline import BatchPipeline
+from multiverso_tpu.models.wordembedding.pipeline import BatchPipeline, PrefetchPipeline
 from multiverso_tpu.models.wordembedding.sampler import AliasSampler, subsample_keep_probs
 from multiverso_tpu.models.wordembedding.skipgram import (
     SkipGramConfig,
@@ -42,7 +42,6 @@ from multiverso_tpu.models.wordembedding.skipgram import (
     init_params,
     make_train_step,
 )
-from multiverso_tpu.utils.async_buffer import ASyncBuffer
 from multiverso_tpu.utils.configure import (
     MV_DEFINE_bool,
     MV_DEFINE_double,
@@ -215,15 +214,17 @@ class WordEmbedding:
         start = time.perf_counter()
         loss_dev = None  # device value; forced only at log points
         pairs_done = 0
+        # pipeline mode: producer thread + native MtQueue handoff (the
+        # reference's BlockQueue preload — distributed_wordembedding.cpp:33-56)
+        source = (
+            PrefetchPipeline(pipeline, depth=max(1, o.max_preload_data_size))
+            if o.is_pipeline
+            else pipeline
+        )
         for epoch in range(o.epoch):
-            it = pipeline.batches(epoch)
-            if o.is_pipeline:
-                buf = ASyncBuffer(lambda: next(it, None))
-                get = buf.Get
-            else:
-                get = lambda: next(it, None)
+            it = source.batches(epoch)
             while True:
-                batch = get()
+                batch = next(it, None)
                 if batch is None:
                     break
                 lr = self._lr(pairs_done / total_pairs_est)
@@ -236,8 +237,6 @@ class WordEmbedding:
                         "lr %.5f, loss %.4f",
                         epoch, pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
                     )
-            if o.is_pipeline:
-                buf.Stop()
         jax.block_until_ready(self.params)
         last_loss = float(loss_dev) if loss_dev is not None else 0.0
         self.words_trained = pairs_done

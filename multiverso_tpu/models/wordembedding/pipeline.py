@@ -5,13 +5,14 @@ Replaces the reference's DataBlock/BlockQueue/MemoryManager machinery
 distributed_wordembedding.cpp:33-56 preload loop): the native pair generator
 (multiverso_tpu/native) produces (center, context) pairs or CBOW rows; this
 module attaches negative samples (alias sampler) or Huffman paths (HS) and
-yields fixed-shape int32 batches. ``ASyncBuffer`` overlaps generation with
-device compute (the reference's ``is_pipeline`` mode —
-distributed_wordembedding.cpp:200-223).
+yields fixed-shape int32 batches. ``PrefetchPipeline`` overlaps generation
+with device compute via a producer thread + native MtQueue (the reference's
+``is_pipeline`` mode — distributed_wordembedding.cpp:200-223).
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -21,7 +22,7 @@ from multiverso_tpu.models.wordembedding.sampler import AliasSampler
 from multiverso_tpu.native import cbow_batch, skipgram_pairs
 from multiverso_tpu.utils.log import CHECK
 
-__all__ = ["BatchPipeline"]
+__all__ = ["BatchPipeline", "PrefetchPipeline"]
 
 
 class BatchPipeline:
@@ -130,3 +131,63 @@ class BatchPipeline:
             if self.cbow:
                 batch["centers"] = targets
         return batch
+
+
+class PrefetchPipeline:
+    """Depth-bounded producer/consumer over ``BatchPipeline.batches()``.
+
+    The reference's BlockQueue + preload cap (ref:
+    Applications/WordEmbedding/src/block_queue.cpp,
+    distributed_wordembedding.cpp:33-56): a producer thread generates batches
+    — the pair generation is native C++ with the GIL released — while the
+    consumer feeds the device. Handoff rides the native ``MtQueue``
+    (runtime.cpp); ``depth`` bounds in-flight batches like
+    ``-max_preload_data_size``.
+    """
+
+    def __init__(self, pipeline: BatchPipeline, depth: int = 4):
+        CHECK(depth >= 1, "prefetch depth must be >= 1")
+        self._pl = pipeline
+        self._depth = int(depth)
+
+    def batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        from multiverso_tpu.native.host_runtime import MtQueue
+
+        ready: MtQueue = MtQueue()
+        free: MtQueue = MtQueue()
+        slots: list = [None] * self._depth
+        error: list = []  # producer exception, re-raised in the consumer
+        for i in range(self._depth):
+            free.push(i)
+
+        def produce():
+            try:
+                for batch in self._pl.batches(epoch):
+                    ticket = free.pop()
+                    if ticket is None:  # consumer gone
+                        return
+                    slots[ticket] = batch
+                    if not ready.push(ticket):  # consumer tore down mid-epoch
+                        return
+            except BaseException as e:  # propagate, never truncate silently
+                error.append(e)
+            finally:
+                ready.exit()
+
+        th = threading.Thread(target=produce, daemon=True, name="mv-prefetch")
+        th.start()
+        try:
+            while True:
+                ticket = ready.pop()
+                if ticket is None:
+                    break
+                batch = slots[ticket]
+                slots[ticket] = None
+                yield batch
+                free.push(ticket)
+            if error:
+                raise error[0]
+        finally:
+            free.exit()
+            ready.exit()
+            th.join(timeout=10)

@@ -20,19 +20,20 @@ from multiverso_tpu.utils.log import Log
 __all__ = ["pairgen_lib", "skipgram_pairs", "cbow_batch", "have_native"]
 
 _THIS_DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_THIS_DIR, "pairgen.cpp")
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def _build() -> Optional[str]:
+def build_native_lib(src_name: str, lib_name: str) -> Optional[str]:
+    """Compile ``native/<src_name>`` into the gitignored ``_build/`` cache
+    (rebuilt when the source is newer). Host-tuned first, portable fallback."""
+    src = os.path.join(_THIS_DIR, src_name)
     out_dir = os.path.join(_THIS_DIR, "_build")
     os.makedirs(out_dir, exist_ok=True)
-    lib_path = os.path.join(out_dir, "libwe_pairgen.so")
-    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(_SRC):
+    lib_path = os.path.join(out_dir, lib_name)
+    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return lib_path
-    base = ["g++", "-O3", "-fPIC", "-shared", _SRC, "-o", lib_path]
-    # try the host-tuned build first, then a portable one
+    base = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", src, "-o", lib_path]
     for extra in (["-march=native"], []):
         cmd = base[:2] + extra + base[2:]
         try:
@@ -41,8 +42,12 @@ def _build() -> Optional[str]:
             return lib_path
         except (subprocess.SubprocessError, FileNotFoundError) as e:
             err = e
-    Log.Error("[native] build failed (%s); using python fallback", err)
+    Log.Error("[native] build of %s failed (%s); using python fallback", src_name, err)
     return None
+
+
+def _build() -> Optional[str]:
+    return build_native_lib("pairgen.cpp", "libwe_pairgen.so")
 
 
 def pairgen_lib() -> Optional[ctypes.CDLL]:

@@ -1,0 +1,234 @@
+"""ctypes surface over the native host-runtime library (``runtime.cpp``).
+
+``MtQueue`` / ``Waiter`` / ``BlobArena`` are C++ rebuilds of the reference's
+host-side primitives (ref: include/multiverso/util/mt_queue.h:19-146,
+util/waiter.h:9-33, util/allocator.h:14-61, blob.h:13-53). Their TPU-era job
+is the host data pipeline: ctypes releases the GIL during calls, so a native
+producer thread (pairgen, readers) and the device-feeder thread hand off
+buffers through ``MtQueue`` with real parallelism.
+
+Pure-Python fallbacks (``queue.Queue``-based) keep everything working when no
+compiler is present; ``have_native_runtime()`` reports which one you got.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue as _pyqueue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from multiverso_tpu.native import build_native_lib
+from multiverso_tpu.utils.log import CHECK
+
+__all__ = ["MtQueue", "Waiter", "BlobArena", "have_native_runtime"]
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        _TRIED = True
+        path = build_native_lib("runtime.cpp", "libmv_runtime.so")
+        if path:
+            lib = ctypes.CDLL(path)
+            u64, i64, i32, vp = (
+                ctypes.c_uint64,
+                ctypes.c_longlong,
+                ctypes.c_int,
+                ctypes.c_void_p,
+            )
+            for name, res, args in [
+                ("mvq_create", vp, []),
+                ("mvq_push", i32, [vp, u64]),
+                ("mvq_pop", i32, [vp, ctypes.POINTER(u64), i64]),
+                ("mvq_try_pop", i32, [vp, ctypes.POINTER(u64)]),
+                ("mvq_exit", None, [vp]),
+                ("mvq_size", i64, [vp]),
+                ("mvq_alive", i32, [vp]),
+                ("mvq_destroy", None, [vp]),
+                ("mvw_create", vp, [i32]),
+                ("mvw_wait", i32, [vp, i64]),
+                ("mvw_notify", None, [vp]),
+                ("mvw_reset", None, [vp, i32]),
+                ("mvw_destroy", None, [vp]),
+                ("mva_create", vp, [u64]),
+                ("mva_alloc", vp, [vp, u64]),
+                ("mva_ref", i32, [vp, vp]),
+                ("mva_unref", i32, [vp, vp]),
+                ("mva_bytes_allocated", u64, [vp]),
+                ("mva_destroy", None, [vp]),
+            ]:
+                fn = getattr(lib, name)
+                fn.restype = res
+                fn.argtypes = args
+            _LIB = lib
+    return _LIB
+
+
+def have_native_runtime() -> bool:
+    return _lib() is not None
+
+
+class MtQueue:
+    """Blocking MPMC queue of uint64 handles with ``exit()`` poison
+    (ref: mt_queue.h Push/Pop/TryPop/Exit/Alive contract)."""
+
+    def __init__(self):
+        lib = _lib()
+        self._lib = lib
+        if lib is not None:
+            self._q = lib.mvq_create()
+        else:
+            self._q = _pyqueue.Queue()
+            self._alive = True
+
+    def push(self, value: int) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.mvq_push(self._q, value))
+        if not self._alive:
+            return False
+        self._q.put(int(value))
+        return True
+
+    def pop(self, timeout_ms: int = -1) -> Optional[int]:
+        """Blocks; returns None on exit-and-drained or timeout."""
+        if self._lib is not None:
+            out = ctypes.c_uint64()
+            if self._lib.mvq_pop(self._q, ctypes.byref(out), timeout_ms):
+                return out.value
+            return None
+        timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+        deadline_step = 0.05
+        waited = 0.0
+        while True:
+            try:
+                return self._q.get(timeout=deadline_step)
+            except _pyqueue.Empty:
+                if not self._alive:
+                    return None
+                waited += deadline_step
+                if timeout is not None and waited >= timeout:
+                    return None
+
+    def try_pop(self) -> Optional[int]:
+        if self._lib is not None:
+            out = ctypes.c_uint64()
+            if self._lib.mvq_try_pop(self._q, ctypes.byref(out)):
+                return out.value
+            return None
+        try:
+            return self._q.get_nowait()
+        except _pyqueue.Empty:
+            return None
+
+    def exit(self) -> None:
+        if self._lib is not None:
+            self._lib.mvq_exit(self._q)
+        else:
+            self._alive = False
+
+    def size(self) -> int:
+        if self._lib is not None:
+            return self._lib.mvq_size(self._q)
+        return self._q.qsize()
+
+    def alive(self) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.mvq_alive(self._q))
+        return self._alive
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None:
+            self._lib.mvq_destroy(self._q)
+
+
+class Waiter:
+    """Counted-down latch (ref: waiter.h Wait/Notify/Reset)."""
+
+    def __init__(self, count: int = 1):
+        lib = _lib()
+        self._lib = lib
+        if lib is not None:
+            self._w = lib.mvw_create(count)
+        else:
+            self._count = count
+            self._cv = threading.Condition()
+
+    def wait(self, timeout_ms: int = -1) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.mvw_wait(self._w, timeout_ms))
+        with self._cv:
+            timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+            return self._cv.wait_for(lambda: self._count <= 0, timeout)
+
+    def notify(self) -> None:
+        if self._lib is not None:
+            self._lib.mvw_notify(self._w)
+        else:
+            with self._cv:
+                self._count -= 1
+                self._cv.notify_all()
+
+    def reset(self, count: int) -> None:
+        if self._lib is not None:
+            self._lib.mvw_reset(self._w, count)
+        else:
+            with self._cv:
+                self._count = count
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None:
+            self._lib.mvw_destroy(self._w)
+
+
+class BlobArena:
+    """Ref-counted aligned blocks recycled through size-class free lists
+    (SmartAllocator/Blob semantics). ``alloc`` returns a numpy uint8 view of
+    the block; ``addr(view)``/``ref``/``unref`` manage its lifetime across
+    threads without the GC in the loop."""
+
+    def __init__(self, alignment: int = 64):
+        lib = _lib()
+        CHECK(lib is not None, "BlobArena requires the native runtime (g++)")
+        self._lib = lib
+        self._a = lib.mva_create(alignment)
+
+    def alloc(self, size: int) -> np.ndarray:
+        p = self._lib.mva_alloc(self._a, size)
+        CHECK(p, "arena allocation failed")
+        return np.ctypeslib.as_array(
+            ctypes.cast(p, ctypes.POINTER(ctypes.c_uint8)), shape=(size,)
+        )
+
+    @staticmethod
+    def addr(view: np.ndarray) -> int:
+        return view.ctypes.data
+
+    def ref(self, view_or_addr) -> None:
+        ok = self._lib.mva_ref(self._a, ctypes.c_void_p(self._addr(view_or_addr)))
+        CHECK(ok, "ref of unknown arena block")
+
+    def unref(self, view_or_addr) -> int:
+        """Returns the remaining refcount; at 0 the block is recycled —
+        any numpy views into it must no longer be used."""
+        rc = self._lib.mva_unref(self._a, ctypes.c_void_p(self._addr(view_or_addr)))
+        CHECK(rc >= 0, "unref of unknown arena block")
+        return rc
+
+    def bytes_allocated(self) -> int:
+        return self._lib.mva_bytes_allocated(self._a)
+
+    @staticmethod
+    def _addr(view_or_addr) -> int:
+        if isinstance(view_or_addr, np.ndarray):
+            return view_or_addr.ctypes.data
+        return int(view_or_addr)
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None:
+            self._lib.mva_destroy(self._a)

@@ -1,0 +1,252 @@
+// Native host-runtime primitives: blocking MPMC queue, waiter latch,
+// ref-counted blob arena.
+//
+// TPU-native rebuild of the reference's C++ host-side runtime plumbing:
+//   - MtQueue<T>  (ref: include/multiverso/util/mt_queue.h:19-146) — the
+//     mutex+condvar blocking queue with Exit() poison that backs every actor
+//     mailbox and the WordEmbedding BlockQueue;
+//   - Waiter      (ref: include/multiverso/util/waiter.h:9-33) — the
+//     counted-down latch behind blocking table ops;
+//   - SmartAllocator/Blob (ref: include/multiverso/util/allocator.h:14-61,
+//     include/multiverso/blob.h:13-53) — aligned refcounted blocks recycled
+//     through size-class free lists.
+//
+// On TPU the actor mailboxes are gone (XLA owns dispatch), but the host data
+// pipeline is not: these primitives carry batch buffers from native producer
+// threads (pairgen/readers, GIL released) to the feeder thread. Handles are
+// opaque uint64 payloads; the queue never touches Python objects.
+//
+// C ABI only — consumed via ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------------- queue
+
+struct MtQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<uint64_t> items;
+  bool exited = false;
+
+  bool Push(uint64_t v) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (exited) return false;
+      items.push_back(v);
+    }
+    cv.notify_one();
+    return true;
+  }
+
+  // Blocks until an item or Exit. Returns false on exit-and-drained
+  // (mt_queue.h Pop contract: Exit() wakes everyone, Pop fails thereafter).
+  bool Pop(uint64_t* out, long long timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu);
+    auto ready = [&] { return !items.empty() || exited; };
+    if (timeout_ms < 0) {
+      cv.wait(lk, ready);
+    } else if (!cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready)) {
+      return false;  // timeout
+    }
+    if (items.empty()) return false;  // exited
+    *out = items.front();
+    items.pop_front();
+    return true;
+  }
+
+  bool TryPop(uint64_t* out) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (items.empty()) return false;
+    *out = items.front();
+    items.pop_front();
+    return true;
+  }
+
+  void Exit() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      exited = true;
+    }
+    cv.notify_all();
+  }
+
+  long long Size() {
+    std::lock_guard<std::mutex> lk(mu);
+    return static_cast<long long>(items.size());
+  }
+
+  bool Alive() {
+    std::lock_guard<std::mutex> lk(mu);
+    return !exited;
+  }
+};
+
+// ----------------------------------------------------------------- waiter
+
+struct Waiter {
+  std::mutex mu;
+  std::condition_variable cv;
+  int count;
+
+  explicit Waiter(int n) : count(n) {}
+
+  bool Wait(long long timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu);
+    auto done = [&] { return count <= 0; };
+    if (timeout_ms < 0) {
+      cv.wait(lk, done);
+      return true;
+    }
+    return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), done);
+  }
+
+  void Notify() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      --count;
+    }
+    cv.notify_all();
+  }
+
+  void Reset(int n) {
+    std::lock_guard<std::mutex> lk(mu);
+    count = n;
+  }
+};
+
+// ------------------------------------------------------------------ arena
+//
+// Size-class free-listed aligned blocks with refcount headers, recycled on
+// release (SmartAllocator semantics). Block layout: [header][payload]; the
+// handle given out is the payload address.
+
+struct BlockHeader {
+  std::atomic<int> refcount;
+  uint64_t size_class;
+};
+
+struct Arena {
+  std::mutex mu;
+  size_t alignment;
+  // size class -> free payload pointers
+  std::unordered_map<uint64_t, std::vector<void*>> free_lists;
+  // payload -> header (also serves as the live-block registry)
+  std::unordered_map<void*, BlockHeader*> headers;
+  size_t bytes_allocated = 0;  // cumulative malloc'd (not recycled) bytes
+
+  explicit Arena(size_t align) : alignment(align < 8 ? 8 : align) {}
+
+  ~Arena() {
+    for (auto& kv : headers) {
+      std::free(reinterpret_cast<char*>(kv.first) - header_pad());
+    }
+  }
+
+  size_t header_pad() const {
+    return (sizeof(BlockHeader) + alignment - 1) / alignment * alignment;
+  }
+
+  static uint64_t SizeClass(uint64_t n) {
+    // next power of two, floor 64 (allocator.h free-list keyed by size)
+    uint64_t c = 64;
+    while (c < n) c <<= 1;
+    return c;
+  }
+
+  void* Alloc(uint64_t n) {
+    const uint64_t cls = SizeClass(n);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      auto it = free_lists.find(cls);
+      if (it != free_lists.end() && !it->second.empty()) {
+        void* payload = it->second.back();
+        it->second.pop_back();
+        headers[payload]->refcount.store(1);
+        return payload;
+      }
+    }
+    const size_t pad = header_pad();
+    char* raw = static_cast<char*>(std::aligned_alloc(
+        alignment, (pad + cls + alignment - 1) / alignment * alignment));
+    if (!raw) return nullptr;
+    auto* hdr = reinterpret_cast<BlockHeader*>(raw);
+    hdr->refcount.store(1);
+    hdr->size_class = cls;
+    void* payload = raw + pad;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      headers[payload] = hdr;
+      bytes_allocated += cls;
+    }
+    return payload;
+  }
+
+  bool Ref(void* payload) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = headers.find(payload);
+    if (it == headers.end()) return false;
+    it->second->refcount.fetch_add(1);
+    return true;
+  }
+
+  // Returns the post-decrement refcount, or -1 on unknown pointer. At zero
+  // the block returns to its size-class free list (never to the OS).
+  int Unref(void* payload) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = headers.find(payload);
+    if (it == headers.end()) return -1;
+    int rc = it->second->refcount.fetch_sub(1) - 1;
+    if (rc == 0) free_lists[it->second->size_class].push_back(payload);
+    return rc;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// queue
+void* mvq_create() { return new MtQueue(); }
+int mvq_push(void* q, uint64_t v) { return static_cast<MtQueue*>(q)->Push(v); }
+int mvq_pop(void* q, uint64_t* out, long long timeout_ms) {
+  return static_cast<MtQueue*>(q)->Pop(out, timeout_ms);
+}
+int mvq_try_pop(void* q, uint64_t* out) {
+  return static_cast<MtQueue*>(q)->TryPop(out);
+}
+void mvq_exit(void* q) { static_cast<MtQueue*>(q)->Exit(); }
+long long mvq_size(void* q) { return static_cast<MtQueue*>(q)->Size(); }
+int mvq_alive(void* q) { return static_cast<MtQueue*>(q)->Alive(); }
+void mvq_destroy(void* q) { delete static_cast<MtQueue*>(q); }
+
+// waiter
+void* mvw_create(int count) { return new Waiter(count); }
+int mvw_wait(void* w, long long timeout_ms) {
+  return static_cast<Waiter*>(w)->Wait(timeout_ms);
+}
+void mvw_notify(void* w) { static_cast<Waiter*>(w)->Notify(); }
+void mvw_reset(void* w, int count) { static_cast<Waiter*>(w)->Reset(count); }
+void mvw_destroy(void* w) { delete static_cast<Waiter*>(w); }
+
+// arena
+void* mva_create(uint64_t alignment) { return new Arena(alignment); }
+void* mva_alloc(void* a, uint64_t size) { return static_cast<Arena*>(a)->Alloc(size); }
+int mva_ref(void* a, void* p) { return static_cast<Arena*>(a)->Ref(p); }
+int mva_unref(void* a, void* p) { return static_cast<Arena*>(a)->Unref(p); }
+uint64_t mva_bytes_allocated(void* a) {
+  return static_cast<Arena*>(a)->bytes_allocated;
+}
+void mva_destroy(void* a) { delete static_cast<Arena*>(a); }
+
+}  // extern "C"
