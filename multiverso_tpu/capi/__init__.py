@@ -1,0 +1,83 @@
+"""C API build + load helpers.
+
+``build_c_api()`` compiles ``c_api.cpp`` into ``libmultiverso_c.so`` (linked
+against libpython so plain C hosts can dlopen it); ``load_c_api()`` returns a
+ctypes handle with argtypes set — the in-process path the reference's Python
+binding used over its own C API (ref: binding/python/multiverso/utils.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import sysconfig
+from typing import Optional
+
+from multiverso_tpu.native import build_native_lib
+
+__all__ = ["build_c_api", "load_c_api"]
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _python_flags():
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var("VERSION")
+    return [f"-I{inc}"], [f"-L{libdir}", f"-Wl,-rpath,{libdir}", f"-lpython{ver}"]
+
+
+def build_c_api() -> Optional[str]:
+    cflags, ldflags = _python_flags()
+    return build_native_lib(
+        "c_api.cpp",
+        "libmultiverso_c.so",
+        src_dir=_THIS_DIR,
+        cflags=cflags,
+        ldflags=ldflags,
+        try_march_native=False,
+    )
+
+
+def load_c_api() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and dlopen the C API with typed signatures."""
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        _TRIED = True
+        path = build_c_api()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        i32, vp, f32p, i32p = (
+            ctypes.c_int,
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int),
+        )
+        sigs = {
+            "MV_Init": (None, [ctypes.POINTER(i32), ctypes.POINTER(ctypes.c_char_p)]),
+            "MV_ShutDown": (None, []),
+            "MV_Barrier": (None, []),
+            "MV_NumWorkers": (i32, []),
+            "MV_WorkerId": (i32, []),
+            "MV_ServerId": (i32, []),
+            "MV_NewArrayTable": (None, [i32, ctypes.POINTER(vp)]),
+            "MV_GetArrayTable": (None, [vp, f32p, i32]),
+            "MV_AddArrayTable": (None, [vp, f32p, i32]),
+            "MV_AddAsyncArrayTable": (None, [vp, f32p, i32]),
+            "MV_NewMatrixTable": (None, [i32, i32, ctypes.POINTER(vp)]),
+            "MV_GetMatrixTableAll": (None, [vp, f32p, i32]),
+            "MV_AddMatrixTableAll": (None, [vp, f32p, i32]),
+            "MV_AddAsyncMatrixTableAll": (None, [vp, f32p, i32]),
+            "MV_GetMatrixTableByRows": (None, [vp, f32p, i32, i32p, i32]),
+            "MV_AddMatrixTableByRows": (None, [vp, f32p, i32, i32p, i32]),
+            "MV_AddAsyncMatrixTableByRows": (None, [vp, f32p, i32, i32p, i32]),
+        }
+        for name, (res, args) in sigs.items():
+            fn = getattr(lib, name)
+            fn.restype = res
+            fn.argtypes = args
+        _LIB = lib
+    return _LIB

@@ -1,0 +1,199 @@
+// libmultiverso_c — flat C ABI over the TPU-native framework.
+//
+// ABI parity with the reference C API (ref: include/multiverso/c_api.h:14-54,
+// src/c_api.cpp:10-93): same function names and signatures, so foreign hosts
+// (C/C#/Lua ffi) that drove the reference drive this framework unchanged.
+//
+// The reference's dependency direction is inverted here (SURVEY.md §7): the
+// core is Python/JAX, so this cdylib *embeds* CPython and forwards each call
+// to multiverso_tpu.capi.capi_impl. Two hosting modes, both supported:
+//   1. loaded into an existing Python process (ctypes/ffi) — the interpreter
+//      is already live; every entry point just takes the GIL;
+//   2. loaded by a plain C/C++ program — the first call boots the
+//      interpreter (Py_InitializeEx) and then releases the GIL so any host
+//      thread may call in.
+//
+// Errors surface as the framework's FatalError; like the reference's
+// Log::Fatal they abort the process after printing the Python traceback.
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+
+#include "c_api.h"  // the ABI contract C hosts compile against
+
+namespace {
+
+PyObject* g_impl = nullptr;  // multiverso_tpu.capi.capi_impl module
+std::once_flag g_once;
+
+void EnsureRuntime() {
+  std::call_once(g_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // Release the GIL acquired by initialization so arbitrary host
+      // threads can enter through PyGILState_Ensure below.
+      PyEval_SaveThread();
+    }
+    PyGILState_STATE gs = PyGILState_Ensure();
+    g_impl = PyImport_ImportModule("multiverso_tpu.capi.capi_impl");
+    if (g_impl == nullptr) {
+      PyErr_Print();
+      std::fprintf(stderr,
+                   "[multiverso_c] cannot import multiverso_tpu.capi.capi_impl "
+                   "(is PYTHONPATH set to the repo root?)\n");
+      std::abort();
+    }
+    PyGILState_Release(gs);
+  });
+}
+
+// Call impl.<name>(args...) under the GIL; abort on Python exception
+// (Log::Fatal semantics — the reference C API has no error returns either).
+PyObject* Call(const char* name, const char* fmt, ...) {
+  EnsureRuntime();
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* fn = PyObject_GetAttrString(g_impl, name);
+  if (fn == nullptr) {
+    PyErr_Print();
+    std::abort();
+  }
+  va_list vargs;
+  va_start(vargs, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, vargs);
+  va_end(vargs);
+  PyObject* res = args ? PyObject_CallObject(fn, args) : nullptr;
+  Py_XDECREF(args);
+  Py_DECREF(fn);
+  if (res == nullptr) {
+    PyErr_Print();
+    std::fprintf(stderr, "[multiverso_c] %s failed\n", name);
+    std::abort();
+  }
+  PyGILState_Release(gs);
+  return res;  // caller owns; may be leaked for None results via CallVoid
+}
+
+void CallVoid(PyObject* res) {
+  PyGILState_STATE gs = PyGILState_Ensure();
+  Py_XDECREF(res);
+  PyGILState_Release(gs);
+}
+
+long AsLong(PyObject* res) {
+  PyGILState_STATE gs = PyGILState_Ensure();
+  long v = PyLong_AsLong(res);
+  Py_DECREF(res);
+  PyGILState_Release(gs);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+void MV_Init(int* argc, char* argv[]) {
+  EnsureRuntime();
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* list = PyList_New(0);
+  int n = (argc != nullptr) ? *argc : 0;
+  for (int i = 0; i < n; ++i) {
+    PyObject* s = PyUnicode_FromString(argv[i]);
+    PyList_Append(list, s);
+    Py_DECREF(s);
+  }
+  PyObject* res = PyObject_CallMethod(g_impl, "init", "(O)", list);
+  Py_DECREF(list);
+  if (res == nullptr) {
+    PyErr_Print();
+    std::abort();
+  }
+  Py_DECREF(res);
+  PyGILState_Release(gs);
+}
+
+void MV_ShutDown() { CallVoid(Call("shutdown", "()")); }
+
+void MV_Barrier() { CallVoid(Call("barrier", "()")); }
+
+int MV_NumWorkers() { return (int)AsLong(Call("num_workers", "()")); }
+
+int MV_WorkerId() { return (int)AsLong(Call("worker_id", "()")); }
+
+int MV_ServerId() { return (int)AsLong(Call("server_id", "()")); }
+
+// ---- Array table ----------------------------------------------------------
+
+void MV_NewArrayTable(int size, TableHandler* out) {
+  *out = (TableHandler)AsLong(Call("new_array_table", "(i)", size));
+}
+
+void MV_GetArrayTable(TableHandler handler, float* data, int size) {
+  CallVoid(Call("get_array_table", "(LLi)", (long long)(intptr_t)handler,
+                (long long)(intptr_t)data, size));
+}
+
+static void AddArray(TableHandler h, float* data, int size, int is_async) {
+  CallVoid(Call("add_array_table", "(LLii)", (long long)(intptr_t)h,
+                (long long)(intptr_t)data, size, is_async));
+}
+
+void MV_AddArrayTable(TableHandler handler, float* data, int size) {
+  AddArray(handler, data, size, 0);
+}
+
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size) {
+  AddArray(handler, data, size, 1);
+}
+
+// ---- Matrix table ---------------------------------------------------------
+
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
+  *out = (TableHandler)AsLong(Call("new_matrix_table", "(ii)", num_row, num_col));
+}
+
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size) {
+  CallVoid(Call("get_matrix_table_all", "(LLi)", (long long)(intptr_t)handler,
+                (long long)(intptr_t)data, size));
+}
+
+static void AddMatrixAll(TableHandler h, float* data, int size, int is_async) {
+  CallVoid(Call("add_matrix_table_all", "(LLii)", (long long)(intptr_t)h,
+                (long long)(intptr_t)data, size, is_async));
+}
+
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size) {
+  AddMatrixAll(handler, data, size, 0);
+}
+
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size) {
+  AddMatrixAll(handler, data, size, 1);
+}
+
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  CallVoid(Call("get_matrix_table_by_rows", "(LLiLi)",
+                (long long)(intptr_t)handler, (long long)(intptr_t)data, size,
+                (long long)(intptr_t)row_ids, row_ids_n));
+}
+
+static void AddMatrixRows(TableHandler h, float* data, int size, int* row_ids,
+                          int row_ids_n, int is_async) {
+  CallVoid(Call("add_matrix_table_by_rows", "(LLiLii)",
+                (long long)(intptr_t)h, (long long)(intptr_t)data, size,
+                (long long)(intptr_t)row_ids, row_ids_n, is_async));
+}
+
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  AddMatrixRows(handler, data, size, row_ids, row_ids_n, 0);
+}
+
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                  int row_ids[], int row_ids_n) {
+  AddMatrixRows(handler, data, size, row_ids, row_ids_n, 1);
+}
+
+}  // extern "C"

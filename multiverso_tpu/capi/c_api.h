@@ -1,0 +1,51 @@
+/* Flat C ABI of the TPU-native framework (libmultiverso_c.so).
+ *
+ * ABI-compatible with the reference Multiverso C API (ref:
+ * include/multiverso/c_api.h:14-54): the same function names and argument
+ * layouts, so existing foreign-language hosts relink against this library
+ * unchanged. Tables are float32; matrix data is row-major.
+ *
+ * The library embeds CPython on first use when loaded from a non-Python
+ * host; set PYTHONPATH so `multiverso_tpu` is importable.
+ */
+#ifndef MULTIVERSO_TPU_C_API_H_
+#define MULTIVERSO_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* TableHandler;
+
+/* Runtime bring-up / topology (ref: c_api.h MV_Init..MV_ServerId). */
+void MV_Init(int* argc, char* argv[]);
+void MV_ShutDown(void);
+void MV_Barrier(void);
+int MV_NumWorkers(void);
+int MV_WorkerId(void);
+int MV_ServerId(void);
+
+/* 1-D float array table: whole-table get/add, sync + async. */
+void MV_NewArrayTable(int size, TableHandler* out);
+void MV_GetArrayTable(TableHandler handler, float* data, int size);
+void MV_AddArrayTable(TableHandler handler, float* data, int size);
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+
+/* 2-D float matrix table: whole-table and row-set ops (`size` is the total
+ * float count of `data`; row-set ops take `row_ids_n` int32 row ids). */
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                  int row_ids[], int row_ids_n);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MULTIVERSO_TPU_C_API_H_ */

@@ -1,0 +1,135 @@
+"""Embedded-side implementation of the flat C API.
+
+``c_api.cpp`` (the ``libmultiverso_c.so`` cdylib) embeds CPython and calls
+the functions here with raw addresses + sizes; this module does the
+numpy/table work. The surface mirrors the reference C API
+(ref: include/multiverso/c_api.h:14-54, src/c_api.cpp:10-93): float
+ArrayTable and MatrixTable handles with whole-table and by-rows Get/Add,
+sync and async flavors.
+
+Handles are small ints into a process-global registry (the reference hands
+out raw ``WorkerTable*`` pointers; an index is the safer ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List
+
+import numpy as np
+
+from multiverso_tpu import api as mv_api
+from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+from multiverso_tpu.utils.log import CHECK
+
+_tables: Dict[int, object] = {}
+_next_handle: List[int] = [1]
+
+
+def _view_f32(addr: int, size: int) -> np.ndarray:
+    buf = (ctypes.c_float * size).from_address(addr)
+    return np.frombuffer(buf, dtype=np.float32)
+
+
+def _view_i32(addr: int, size: int) -> np.ndarray:
+    buf = (ctypes.c_int32 * size).from_address(addr)
+    return np.frombuffer(buf, dtype=np.int32)
+
+
+def init(args: List[str]) -> None:
+    mv_api.MV_Init(list(args))
+
+
+def shutdown() -> None:
+    for t in list(_tables.values()):
+        t.wait()
+    _tables.clear()
+    mv_api.MV_ShutDown()
+
+
+def barrier() -> None:
+    mv_api.MV_Barrier()
+
+
+def num_workers() -> int:
+    return mv_api.MV_NumWorkers()
+
+
+def worker_id() -> int:
+    return mv_api.MV_WorkerId()
+
+
+def server_id() -> int:
+    return mv_api.MV_ServerId()
+
+
+def _register(table) -> int:
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _tables[h] = table
+    return h
+
+
+def _table(handle: int):
+    t = _tables.get(handle)
+    CHECK(t is not None, f"bad table handle {handle}")
+    return t
+
+
+def new_array_table(size: int) -> int:
+    return _register(mv_api.MV_CreateTable(ArrayTableOption(size=size)))
+
+
+def get_array_table(handle: int, addr: int, size: int) -> None:
+    t = _table(handle)
+    out = _view_f32(addr, size)
+    got = t.get()
+    CHECK(got.size == size, f"get size {size} != table size {got.size}")
+    np.copyto(out, got)
+
+
+def add_array_table(handle: int, addr: int, size: int, is_async: bool) -> None:
+    t = _table(handle)
+    t.add(_view_f32(addr, size).copy())
+    if not is_async:
+        t.wait()
+
+
+def new_matrix_table(num_row: int, num_col: int) -> int:
+    return _register(
+        mv_api.MV_CreateTable(MatrixTableOption(num_row=num_row, num_col=num_col))
+    )
+
+
+def get_matrix_table_all(handle: int, addr: int, size: int) -> None:
+    t = _table(handle)
+    CHECK(size == t.num_row * t.num_col, f"size {size} != {t.num_row}x{t.num_col}")
+    np.copyto(_view_f32(addr, size), t.get().reshape(-1))
+
+
+def add_matrix_table_all(handle: int, addr: int, size: int, is_async: bool) -> None:
+    t = _table(handle)
+    CHECK(size == t.num_row * t.num_col, f"size {size} != {t.num_row}x{t.num_col}")
+    t.add(_view_f32(addr, size).copy().reshape(t.num_row, t.num_col))
+    if not is_async:
+        t.wait()
+
+
+def get_matrix_table_by_rows(
+    handle: int, addr: int, size: int, ids_addr: int, row_ids_n: int
+) -> None:
+    t = _table(handle)
+    ids = _view_i32(ids_addr, row_ids_n).copy()
+    CHECK(size == row_ids_n * t.num_col, f"size {size} != {row_ids_n}x{t.num_col}")
+    np.copyto(_view_f32(addr, size), t.get_rows(ids).reshape(-1))
+
+
+def add_matrix_table_by_rows(
+    handle: int, addr: int, size: int, ids_addr: int, row_ids_n: int, is_async: bool
+) -> None:
+    t = _table(handle)
+    ids = _view_i32(ids_addr, row_ids_n).copy()
+    CHECK(size == row_ids_n * t.num_col, f"size {size} != {row_ids_n}x{t.num_col}")
+    t.add_rows(ids, _view_f32(addr, size).copy().reshape(row_ids_n, t.num_col))
+    if not is_async:
+        t.wait()

@@ -24,25 +24,42 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def build_native_lib(src_name: str, lib_name: str) -> Optional[str]:
-    """Compile ``native/<src_name>`` into the gitignored ``_build/`` cache
+def build_native_lib(
+    src_name: str,
+    lib_name: str,
+    src_dir: Optional[str] = None,
+    cflags: Optional[list] = None,
+    ldflags: Optional[list] = None,
+    try_march_native: bool = True,
+) -> Optional[str]:
+    """Compile one C++ source into the gitignored ``native/_build/`` cache
     (rebuilt when the source is newer). Host-tuned first, portable fallback."""
-    src = os.path.join(_THIS_DIR, src_name)
+    src = os.path.join(src_dir or _THIS_DIR, src_name)
     out_dir = os.path.join(_THIS_DIR, "_build")
     os.makedirs(out_dir, exist_ok=True)
     lib_path = os.path.join(out_dir, lib_name)
     if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return lib_path
-    base = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread", src, "-o", lib_path]
-    for extra in (["-march=native"], []):
+    base = (
+        ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread"]
+        + (cflags or [])
+        + [src, "-o", lib_path]
+        + (ldflags or [])
+    )
+    variants = (["-march=native"], []) if try_march_native else ([],)
+    for extra in variants:
         cmd = base[:2] + extra + base[2:]
         try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            subprocess.run(cmd, check=True, capture_output=True, timeout=180)
             Log.Info("[native] built %s", lib_path)
             return lib_path
         except (subprocess.SubprocessError, FileNotFoundError) as e:
             err = e
-    Log.Error("[native] build of %s failed (%s); using python fallback", src_name, err)
+    detail = (getattr(err, "stderr", b"") or b"").decode(errors="replace")[:500]
+    Log.Error(
+        "[native] build of %s failed (%s %s); using python fallback",
+        src_name, err, detail,
+    )
     return None
 
 

@@ -110,7 +110,13 @@ class MtQueue:
                 return self._q.get(timeout=deadline_step)
             except _pyqueue.Empty:
                 if not self._alive:
-                    return None
+                    # exit-and-drained contract (native MtQueue::Pop drains
+                    # remaining items after Exit): one final non-blocking
+                    # check closes the put-then-exit race
+                    try:
+                        return self._q.get_nowait()
+                    except _pyqueue.Empty:
+                        return None
                 waited += deadline_step
                 if timeout is not None and waited >= timeout:
                     return None

@@ -245,7 +245,9 @@ void* mva_alloc(void* a, uint64_t size) { return static_cast<Arena*>(a)->Alloc(s
 int mva_ref(void* a, void* p) { return static_cast<Arena*>(a)->Ref(p); }
 int mva_unref(void* a, void* p) { return static_cast<Arena*>(a)->Unref(p); }
 uint64_t mva_bytes_allocated(void* a) {
-  return static_cast<Arena*>(a)->bytes_allocated;
+  Arena* arena = static_cast<Arena*>(a);
+  std::lock_guard<std::mutex> lk(arena->mu);
+  return arena->bytes_allocated;
 }
 void mva_destroy(void* a) { delete static_cast<Arena*>(a); }
 
