@@ -104,11 +104,54 @@ class SampleReader:
 
     # -- sample iteration -------------------------------------------------
 
+    _CHUNK = 4 << 20  # native-parse chunk size (bytes)
+
+    def _iter_file_native(self, uri: str, with_weight: bool) -> Iterator[Sample]:
+        """Chunked native C++ parse (textparse.cpp): CSR arrays per chunk,
+        zero per-token Python string work."""
+        from multiverso_tpu.native.textparse import parse_sparse_chunk
+
+        stream = StreamFactory.GetStream(uri, "r")
+        tail = b""
+        try:
+            while True:
+                data = stream.Read(self._CHUNK)
+                buf = tail + data
+                if not buf:
+                    break
+                if not data and not buf.endswith(b"\n"):
+                    buf += b"\n"  # final unterminated line
+                # buffers are sized from the chunk, so one call parses every
+                # complete line; consumed < len(buf) only leaves the
+                # incomplete trailing line for the next read
+                labels, weights, offsets, keys, values, consumed = (
+                    parse_sparse_chunk(buf, with_weight)
+                )
+                for i in range(len(labels)):
+                    a, b = offsets[i], offsets[i + 1]
+                    yield Sample(labels[i], weights[i], keys[a:b], values[a:b])
+                tail = buf[consumed:]
+                if not data:
+                    if tail:
+                        Log.Error(
+                            "[SampleReader] %d unparsed trailing bytes dropped",
+                            len(tail),
+                        )
+                    break
+        finally:
+            stream.Close()
+
     def _iter_file(self, uri: str) -> Iterator[Sample]:
         if self.reader_type == "bsparse":
             yield from _iter_bsparse(uri)
             return
         with_weight = self.reader_type == "weight"
+        if self.sparse:
+            from multiverso_tpu.native.textparse import have_native_textparse
+
+            if have_native_textparse():
+                yield from self._iter_file_native(uri, with_weight)
+                return
         reader = TextReader(uri)
         for line in reader:
             s = _parse_default_line(line, self.sparse, with_weight)

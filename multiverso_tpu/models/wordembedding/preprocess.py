@@ -1,0 +1,94 @@
+"""Vocabulary preprocessing CLI — ``python -m
+multiverso_tpu.models.wordembedding.preprocess -out vocab.txt corpus...``.
+
+Parity with the reference's standalone preprocessing tool (ref:
+Applications/WordEmbedding/preprocess/word_count.cpp + stopword list): counts
+whitespace tokens, filters by ``-min_count`` and an optional ``-stopwords``
+file, writes "word count" lines sorted by descending count — the format
+``Dictionary.load``/`-read_vocab`` consumes. Runs the native binary
+(word_count.cpp) when a compiler is available, else counts in Python.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from collections import Counter
+from typing import List, Optional, Sequence
+
+from multiverso_tpu.native import build_native_lib
+from multiverso_tpu.utils.log import Log
+
+__all__ = ["word_count", "main"]
+
+
+def _native_binary() -> Optional[str]:
+    return build_native_lib("word_count.cpp", "word_count", executable=True)
+
+
+def word_count(
+    inputs: Sequence[str],
+    out_path: str,
+    min_count: int = 5,
+    stopwords: Optional[str] = None,
+    force_python: bool = False,
+) -> None:
+    exe = None if force_python else _native_binary()
+    if exe is not None:
+        cmd = [exe, "-out", out_path, "-min_count", str(min_count)]
+        if stopwords:
+            cmd += ["-stopwords", stopwords]
+        cmd += list(inputs)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode == 0:
+            Log.Info("[word_count] %s", proc.stderr.strip())
+            return
+        Log.Error("[word_count] native tool failed (%s); python fallback",
+                  proc.stderr.strip())
+    stop = set()
+    if stopwords:
+        with open(stopwords) as f:
+            stop = {w for line in f for w in line.split()}
+    counts: Counter = Counter()
+    for path in inputs:
+        with open(path) as f:
+            for line in f:
+                counts.update(line.split())
+    kept = sorted(
+        ((w, c) for w, c in counts.items() if c >= min_count and w not in stop),
+        key=lambda kv: (-kv[1], kv[0]),
+    )
+    with open(out_path, "w") as f:
+        for w, c in kept:
+            f.write(f"{w} {c}\n")
+    Log.Info("[word_count] %d/%d words kept (min_count=%d)",
+             len(kept), len(counts), min_count)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    out, min_count, stop, inputs = "", 5, None, []
+    i = 0
+    while i < len(args):
+        if args[i] == "-out" and i + 1 < len(args):
+            out = args[i + 1]
+            i += 2
+        elif args[i] == "-min_count" and i + 1 < len(args):
+            min_count = int(args[i + 1])
+            i += 2
+        elif args[i] == "-stopwords" and i + 1 < len(args):
+            stop = args[i + 1]
+            i += 2
+        else:
+            inputs.append(args[i])
+            i += 1
+    if not out or not inputs:
+        print("usage: preprocess -out VOCAB [-min_count N] [-stopwords FILE] "
+              "CORPUS...", file=sys.stderr)
+        return 2
+    word_count(inputs, out, min_count=min_count, stopwords=stop)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,17 +31,22 @@ def build_native_lib(
     cflags: Optional[list] = None,
     ldflags: Optional[list] = None,
     try_march_native: bool = True,
+    executable: bool = False,
 ) -> Optional[str]:
     """Compile one C++ source into the gitignored ``native/_build/`` cache
-    (rebuilt when the source is newer). Host-tuned first, portable fallback."""
+    (rebuilt when the source is newer). Host-tuned first, portable fallback.
+    ``executable=True`` builds a standalone binary instead of a cdylib."""
     src = os.path.join(src_dir or _THIS_DIR, src_name)
     out_dir = os.path.join(_THIS_DIR, "_build")
     os.makedirs(out_dir, exist_ok=True)
     lib_path = os.path.join(out_dir, lib_name)
     if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return lib_path
+    link_mode = [] if executable else ["-fPIC", "-shared"]
     base = (
-        ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread"]
+        ["g++", "-O3", "-std=c++17"]
+        + link_mode
+        + ["-pthread"]
         + (cflags or [])
         + [src, "-o", lib_path]
         + (ldflags or [])

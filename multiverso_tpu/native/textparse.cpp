@@ -1,0 +1,170 @@
+// Native sample-text parser for LogisticRegression — the host-side ingest
+// hot path.
+//
+// TPU-native equivalent of the reference's background-thread text parsers
+// (ref: Applications/LogisticRegression/src/reader.cpp "default"/"weight"
+// parsers over reader.h:20-150): instead of per-line, per-token string
+// objects, one call scans a raw text chunk and emits CSR-layout arrays
+// (labels, weights, row offsets, keys, values) ready for numpy batching.
+//
+// Formats (ref: configure.h:56-68):
+//   default: "label k:v k:v ..."     (sparse libsvm; v omitted -> 1.0)
+//   weight:  "label:weight k:v ..."
+//
+// The chunk need not end on a line boundary: parsing stops at the last
+// complete line and *consumed says where to resume.
+
+#include <cstdint>
+#include <cstdlib>
+
+namespace {
+
+inline bool is_space(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+
+// Minimal fast float parse (plain decimals, the common case in LR corpora).
+// Exponent or other exotic forms re-parse via strtod on a bounded local
+// copy of the token, so parsing can never cross the line boundary (strtod
+// itself skips whitespace including '\n' and would otherwise eat the next
+// line's label). On no progress, *out == token_start and 0.0 is returned.
+inline double parse_float(const char* token_start, const char* end,
+                          const char** out) {
+  const char* p = token_start;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) neg = (*p++ == '-');
+  double v = 0.0;
+  bool any_digit = false;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10.0 + (*p++ - '0');
+    any_digit = true;
+  }
+  if (p < end && *p == '.') {
+    ++p;
+    double scale = 0.1;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v += (*p++ - '0') * scale;
+      scale *= 0.1;
+      any_digit = true;
+    }
+  }
+  if (any_digit && p < end && (*p == 'e' || *p == 'E')) {
+    // exponent: strtod on a NUL-terminated copy bounded by the token
+    char tmp[64];
+    const char* tok_end = token_start;
+    while (tok_end < end && !is_space(*tok_end) && *tok_end != '\n') ++tok_end;
+    size_t n = (size_t)(tok_end - token_start);
+    if (n >= sizeof(tmp)) n = sizeof(tmp) - 1;
+    for (size_t i = 0; i < n; ++i) tmp[i] = token_start[i];
+    tmp[n] = '\0';
+    char* after = nullptr;
+    v = std::strtod(tmp, &after);
+    *out = token_start + (after - tmp);
+    return v;
+  }
+  if (!any_digit) {
+    *out = token_start;  // no progress: caller decides (malformed token)
+    return 0.0;
+  }
+  *out = p;
+  return neg ? -v : v;
+}
+
+// Integer parse; on no digit, *out == start (no progress).
+inline long long parse_int(const char* start, const char* end,
+                           const char** out) {
+  const char* p = start;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) neg = (*p++ == '-');
+  long long v = 0;
+  bool any = false;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10 + (*p++ - '0');
+    any = true;
+  }
+  *out = any ? p : start;
+  return neg ? -v : v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse sparse sample lines from buf[0:len). Returns the number of samples
+// written (<= max_samples); stops early when max_samples or max_nnz would
+// overflow, or at the last complete line. *consumed = bytes of buf fully
+// parsed (resume offset). offsets has max_samples+1 slots; offsets[0]=0.
+long long lr_parse_sparse(const char* buf, long long len, int with_weight,
+                          int32_t* labels, float* weights, int64_t* offsets,
+                          int64_t* keys, float* values,
+                          long long max_samples, long long max_nnz,
+                          long long* consumed) {
+  long long ns = 0;
+  long long nnz = 0;
+  long long line_start = 0;
+  offsets[0] = 0;
+  while (line_start < len && ns < max_samples) {
+    // find end of line; incomplete trailing line (no '\n') is left for the
+    // next chunk unless this is the final flush (caller passes it again
+    // with the same data — we detect completeness only by '\n')
+    long long eol = line_start;
+    while (eol < len && buf[eol] != '\n') ++eol;
+    if (eol >= len) break;  // incomplete line: resume here next call
+
+    const char* p = buf + line_start;
+    const char* end = buf + eol;
+    while (p < end && is_space(*p)) ++p;
+    if (p >= end) {  // blank line
+      line_start = eol + 1;
+      continue;
+    }
+    // label [:weight] — label parsed as float then truncated, matching the
+    // Python fallback's int(float(tok)) (labels like "1.0" are legal)
+    const char* q;
+    double label_f = parse_float(p, end, &q);
+    bool bad_line = (q == p);
+    float weight = 1.0f;
+    if (!bad_line && with_weight && q < end && *q == ':') {
+      const char* w0 = q + 1;
+      weight = (float)parse_float(w0, end, &q);
+      if (q == w0) weight = 1.0f;  // empty weight -> default
+    }
+    p = q;
+    // features
+    long long row_nnz = 0;
+    bool overflow = false;
+    while (!bad_line) {
+      while (p < end && is_space(*p)) ++p;
+      if (p >= end) break;
+      long long k = parse_int(p, end, &q);
+      if (q == p) {  // unparseable token: drop the whole line
+        bad_line = true;
+        break;
+      }
+      float v = 1.0f;
+      if (q < end && *q == ':') {
+        const char* v0 = q + 1;
+        v = (float)parse_float(v0, end, &q);
+        if (q == v0) v = 1.0f;  // empty value ("k:") -> 1, like the fallback
+      }
+      p = q;
+      if (nnz + row_nnz >= max_nnz) {
+        overflow = true;
+        break;
+      }
+      keys[nnz + row_nnz] = k;
+      values[nnz + row_nnz] = v;
+      ++row_nnz;
+    }
+    if (overflow) break;  // whole line resumes next call (larger caps)
+    if (!bad_line) {
+      labels[ns] = (int32_t)label_f;
+      weights[ns] = weight;
+      nnz += row_nnz;
+      offsets[++ns] = nnz;
+    }  // bad_line: skipped entirely, but consumed advances — no spin
+    line_start = eol + 1;
+  }
+  *consumed = line_start;
+  return ns;
+}
+
+}  // extern "C"

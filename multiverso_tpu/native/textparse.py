@@ -1,0 +1,92 @@
+"""ctypes surface over the native sample-text parser (``textparse.cpp``).
+
+``parse_sparse_chunk`` scans one raw text chunk into CSR arrays — the
+LogisticRegression ingest hot path (ref: Applications/LogisticRegression/
+src/reader.cpp text parsers). ``have_native_textparse()`` reports whether
+the C++ path is live; callers fall back to the per-line Python parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.native import build_native_lib
+
+__all__ = ["have_native_textparse", "parse_sparse_chunk"]
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        _TRIED = True
+        path = build_native_lib("textparse.cpp", "libmv_textparse.so")
+        if path:
+            lib = ctypes.CDLL(path)
+            LL = ctypes.c_longlong
+            lib.lr_parse_sparse.restype = LL
+            lib.lr_parse_sparse.argtypes = [
+                ctypes.c_char_p, LL, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+                LL, LL, ctypes.POINTER(LL),
+            ]
+            _LIB = lib
+    return _LIB
+
+
+def have_native_textparse() -> bool:
+    return _lib() is not None
+
+
+def parse_sparse_chunk(
+    chunk: bytes,
+    with_weight: bool,
+    max_samples: Optional[int] = None,
+    max_nnz: Optional[int] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]]:
+    """Parse sparse sample lines from ``chunk``. Returns
+    ``(labels, weights, offsets, keys, values, consumed)`` in CSR layout
+    (``offsets`` has n+1 entries), or None when the native lib is absent.
+    ``consumed`` is the byte offset to resume from (last complete line).
+    Malformed lines are skipped (the pure-Python parser raises instead).
+
+    Output buffers are sized from the chunk itself by default (a sample or a
+    feature token each need >= 2 bytes of text), so a full chunk can always
+    parse in one call; results are compact copies, not views into oversized
+    scratch buffers."""
+    lib = _lib()
+    if lib is None:
+        return None
+    if max_samples is None:
+        max_samples = len(chunk) // 2 + 1
+    if max_nnz is None:
+        max_nnz = len(chunk) // 2 + 1
+    labels = np.empty(max_samples, np.int32)
+    weights = np.empty(max_samples, np.float32)
+    offsets = np.empty(max_samples + 1, np.int64)
+    keys = np.empty(max_nnz, np.int64)
+    values = np.empty(max_nnz, np.float32)
+    consumed = ctypes.c_longlong(0)
+    n = lib.lr_parse_sparse(
+        chunk, len(chunk), int(with_weight),
+        labels, weights, offsets, keys, values,
+        max_samples, max_nnz, ctypes.byref(consumed),
+    )
+    nnz = offsets[n]
+    return (
+        labels[:n].copy(),
+        weights[:n].copy(),
+        offsets[: n + 1].copy(),
+        keys[:nnz].copy(),
+        values[:nnz].copy(),
+        consumed.value,
+    )

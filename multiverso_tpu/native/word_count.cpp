@@ -1,0 +1,98 @@
+// word_count — standalone vocabulary builder (preprocessing tool).
+//
+// Native equivalent of the reference's WordEmbedding preprocessing binary
+// (ref: Applications/WordEmbedding/preprocess/word_count.cpp + stopword
+// list): streams whitespace-tokenized corpora, counts words, filters by
+// min_count and an optional stopword file, and writes "word count" lines
+// sorted by descending count — the vocab format Dictionary.load consumes.
+//
+// Usage: word_count -out VOCAB [-min_count N] [-stopwords FILE] CORPUS...
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+void CountStream(std::istream& in,
+                 std::unordered_map<std::string, uint64_t>* counts) {
+  std::string word;
+  while (in >> word) ++(*counts)[word];
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string out_path;
+  std::string stop_path;
+  uint64_t min_count = 5;
+  std::vector<std::string> inputs;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-out") == 0 && i + 1 < argc) {
+      out_path = argv[++i];
+    } else if (std::strcmp(argv[i], "-min_count") == 0 && i + 1 < argc) {
+      min_count = std::strtoull(argv[++i], nullptr, 10);
+    } else if (std::strcmp(argv[i], "-stopwords") == 0 && i + 1 < argc) {
+      stop_path = argv[++i];
+    } else {
+      inputs.push_back(argv[i]);
+    }
+  }
+  if (out_path.empty() || inputs.empty()) {
+    std::fprintf(stderr,
+                 "usage: word_count -out VOCAB [-min_count N] "
+                 "[-stopwords FILE] CORPUS...\n");
+    return 2;
+  }
+
+  std::unordered_set<std::string> stop;
+  if (!stop_path.empty()) {
+    std::ifstream sf(stop_path);
+    if (!sf) {
+      std::fprintf(stderr, "cannot open stopword file %s\n", stop_path.c_str());
+      return 1;
+    }
+    std::string w;
+    while (sf >> w) stop.insert(w);
+  }
+
+  std::unordered_map<std::string, uint64_t> counts;
+  for (const auto& path : inputs) {
+    std::ifstream f(path);
+    if (!f) {
+      std::fprintf(stderr, "cannot open corpus %s\n", path.c_str());
+      return 1;
+    }
+    CountStream(f, &counts);
+  }
+
+  std::vector<std::pair<std::string, uint64_t>> kept;
+  kept.reserve(counts.size());
+  for (auto& kv : counts) {
+    if (kv.second >= min_count && !stop.count(kv.first)) {
+      kept.emplace_back(std::move(kv.first), kv.second);
+    }
+  }
+  // descending count, ties by word for determinism
+  std::sort(kept.begin(), kept.end(), [](const auto& a, const auto& b) {
+    return a.second != b.second ? a.second > b.second : a.first < b.first;
+  });
+
+  std::ofstream out(out_path);
+  if (!out) {
+    std::fprintf(stderr, "cannot write %s\n", out_path.c_str());
+    return 1;
+  }
+  for (const auto& kv : kept) out << kv.first << ' ' << kv.second << '\n';
+  std::fprintf(stderr, "word_count: %zu/%zu words kept (min_count=%llu)\n",
+               kept.size(), counts.size(),
+               static_cast<unsigned long long>(min_count));
+  return 0;
+}

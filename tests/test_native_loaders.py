@@ -1,0 +1,152 @@
+"""Native data-loader tests: textparse.cpp CSR parser + word_count tool.
+
+The native parser must agree exactly with the per-line Python parser on
+every supported format (ref: Applications/LogisticRegression/src/reader.cpp
+"default"/"weight"; preprocess/word_count.cpp).
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.native.textparse import have_native_textparse, parse_sparse_chunk
+
+
+needs_native = pytest.mark.skipif(
+    not have_native_textparse(), reason="needs g++ native build"
+)
+
+
+@needs_native
+def test_parse_sparse_basic():
+    text = b"1 3:0.5 7:2 100:1.5\n0 2:1\n-1 5:0.25 9:4\n"
+    labels, weights, offsets, keys, values, consumed = parse_sparse_chunk(
+        text, False, 10, 100
+    )
+    np.testing.assert_array_equal(labels, [1, 0, -1])
+    np.testing.assert_array_equal(weights, [1, 1, 1])
+    np.testing.assert_array_equal(offsets, [0, 3, 4, 6])
+    np.testing.assert_array_equal(keys, [3, 7, 100, 2, 5, 9])
+    np.testing.assert_allclose(values, [0.5, 2, 1.5, 1, 0.25, 4])
+    assert consumed == len(text)
+
+
+@needs_native
+def test_parse_weight_format_and_bare_keys():
+    text = b"1:0.75 4:1 8\n0:2.5 3\n"
+    labels, weights, offsets, keys, values, consumed = parse_sparse_chunk(
+        text, True, 10, 100
+    )
+    np.testing.assert_array_equal(labels, [1, 0])
+    np.testing.assert_allclose(weights, [0.75, 2.5])
+    np.testing.assert_array_equal(keys, [4, 8, 3])
+    np.testing.assert_allclose(values, [1, 1, 1])  # bare keys -> value 1
+    assert consumed == len(text)
+
+
+@needs_native
+def test_parse_resumes_at_incomplete_line():
+    text = b"1 2:3\n0 4:5"  # second line unterminated
+    labels, _, _, keys, _, consumed = parse_sparse_chunk(text, False, 10, 100)
+    assert list(labels) == [1]
+    assert consumed == 6  # up to and including the first newline
+    # completing the line parses the rest
+    rest = text[consumed:] + b"\n"
+    labels2, _, _, keys2, _, c2 = parse_sparse_chunk(rest, False, 10, 100)
+    assert list(labels2) == [0]
+    np.testing.assert_array_equal(keys2, [4])
+
+
+@needs_native
+def test_parse_exponents_and_blank_lines():
+    text = b"\n1 2:1e-3 5:2.5E2\n   \n0 7:-0.5\n"
+    labels, _, _, keys, values, consumed = parse_sparse_chunk(text, False, 10, 100)
+    np.testing.assert_array_equal(labels, [1, 0])
+    np.testing.assert_allclose(values, [1e-3, 250.0, -0.5], rtol=1e-6)
+    assert consumed == len(text)
+
+
+@needs_native
+def test_parse_caps_respected():
+    text = b"1 1:1\n1 2:1\n1 3:1\n"
+    labels, _, _, _, _, consumed = parse_sparse_chunk(text, False, 2, 100)
+    assert len(labels) == 2
+    assert consumed == 12  # two lines of 6 bytes
+
+
+@needs_native
+def test_parse_float_label_and_empty_value():
+    """Regression: '1.0' labels must parse (int(float) semantics) and an
+    empty value 'k:' at end of line must yield 1.0, never the next line's
+    label via strtod crossing the newline."""
+    text = b"1.0 2:3\n0 4:5\n"
+    labels, _, _, keys, values, consumed = parse_sparse_chunk(text, False)
+    np.testing.assert_array_equal(labels, [1, 0])
+    assert consumed == len(text)
+
+    text = b"1 5:\n0 7:2\n"
+    labels, _, _, keys, values, consumed = parse_sparse_chunk(text, False)
+    np.testing.assert_array_equal(labels, [1, 0])
+    np.testing.assert_allclose(values, [1.0, 2.0])
+    assert consumed == len(text)
+
+
+@needs_native
+def test_parse_skips_malformed_lines_without_spinning():
+    """An unparseable token drops only its own line; parsing advances."""
+    text = b"1 2:3\ngarbage line here\n0 4:5\n"
+    labels, _, _, keys, _, consumed = parse_sparse_chunk(text, False)
+    np.testing.assert_array_equal(labels, [1, 0])
+    np.testing.assert_array_equal(keys, [2, 4])
+    assert consumed == len(text)
+
+
+def test_reader_native_matches_python(tmp_path):
+    """The reader must produce identical samples through the native chunked
+    path and the pure-Python path."""
+    from multiverso_tpu.models.logreg.config import Configure
+    from multiverso_tpu.models.logreg.reader import SampleReader
+
+    rng = np.random.RandomState(0)
+    path = tmp_path / "train.txt"
+    with open(path, "w") as f:
+        for i in range(500):
+            feats = rng.choice(1000, size=rng.randint(1, 12), replace=False)
+            toks = " ".join(f"{k}:{rng.rand():.4f}" for k in sorted(feats))
+            f.write(f"{rng.randint(0, 2)} {toks}\n")
+
+    cfg = Configure(train_file=str(path), input_size=1000, sparse=True)
+    r = SampleReader(cfg)
+    native_samples = list(r.iter_samples())
+
+    import multiverso_tpu.native.textparse as tp
+
+    real = tp.have_native_textparse
+    tp.have_native_textparse = lambda: False
+    try:
+        py_samples = list(SampleReader(cfg).iter_samples())
+    finally:
+        tp.have_native_textparse = real
+
+    assert len(native_samples) == len(py_samples) == 500
+    for a, b in zip(native_samples, py_samples):
+        assert a.label == b.label
+        np.testing.assert_array_equal(a.keys, b.keys)
+        np.testing.assert_allclose(a.values, b.values, rtol=1e-6)
+
+
+def test_word_count_tool(tmp_path):
+    from multiverso_tpu.models.wordembedding.preprocess import word_count
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("apple banana apple cherry the the the banana apple\n")
+    stop = tmp_path / "stop.txt"
+    stop.write_text("the\n")
+
+    for force_python in (False, True):
+        out = tmp_path / f"vocab_{force_python}.txt"
+        word_count(
+            [str(corpus)], str(out), min_count=2, stopwords=str(stop),
+            force_python=force_python,
+        )
+        lines = out.read_text().splitlines()
+        assert lines == ["apple 3", "banana 2"]
