@@ -40,6 +40,7 @@ from multiverso_tpu.models.wordembedding.skipgram import (
     SkipGramConfig,
     init_adagrad_slots,
     init_params,
+    make_superbatch_step,
     make_train_step,
 )
 from multiverso_tpu.utils.configure import (
@@ -76,6 +77,11 @@ MV_DEFINE_int("max_preload_data_size", 2, "prefetched batches (pipeline depth)")
 MV_DEFINE_bool("is_pipeline", True, "overlap batch generation with compute")
 MV_DEFINE_string("output_file", "embeddings.txt", "embedding output path")
 MV_DEFINE_int("batch_size", 4096, "pairs per training step (TPU batch)")
+MV_DEFINE_int("steps_per_call", 8, "microbatches scanned per device dispatch")
+MV_DEFINE_string(
+    "scale_mode", "row_mean",
+    "batched-update scaling: row_mean (safe) | raw (fast; see skipgram.py)",
+)
 MV_DEFINE_bool("use_ps", False, "train through parameter-server tables")
 
 
@@ -102,6 +108,8 @@ class WEOptions:
     is_pipeline: bool = True
     output_file: str = "embeddings.txt"
     batch_size: int = 4096
+    steps_per_call: int = 8
+    scale_mode: str = "row_mean"
     use_ps: bool = False
     seed: int = 1
 
@@ -152,7 +160,23 @@ class WordEmbedding:
         if options.use_adagrad:
             self.params.update(init_adagrad_slots(self.cfg, out_rows))
         self._step = jax.jit(
-            make_train_step(self.cfg, hs=options.hs, use_adagrad=options.use_adagrad),
+            make_train_step(
+                self.cfg,
+                hs=options.hs,
+                use_adagrad=options.use_adagrad,
+                scale_mode=options.scale_mode,
+            ),
+            donate_argnums=(0,),
+        )
+        # superbatch: scan over steps_per_call microbatches in one dispatch
+        # (dispatch latency amortization — see make_superbatch_step)
+        self._superstep = jax.jit(
+            make_superbatch_step(
+                self.cfg,
+                hs=options.hs,
+                use_adagrad=options.use_adagrad,
+                scale_mode=options.scale_mode,
+            ),
             donate_argnums=(0,),
         )
         self.words_trained = 0
@@ -191,6 +215,31 @@ class WordEmbedding:
             )
         return loss
 
+    def _run_superbatch(self, batches: list, lr: float) -> jax.Array:
+        """One scanned dispatch over a list of identically-shaped batches."""
+        o = self.opt
+        stack = lambda key: jnp.asarray(np.stack([b[key] for b in batches]))
+        ctx = (
+            None
+            if batches[0].get("contexts") is None
+            else stack("contexts")
+        )
+        if o.hs:
+            self.params, loss = self._superstep(
+                self.params,
+                stack("centers"),
+                stack("points"),
+                stack("codes"),
+                stack("lengths"),
+                ctx,
+                jnp.float32(lr),
+            )
+        else:
+            self.params, loss = self._superstep(
+                self.params, stack("centers"), stack("outputs"), ctx, jnp.float32(lr)
+            )
+        return loss
+
     def train(self, ids: Optional[np.ndarray] = None) -> float:
         """Train over the corpus; returns the last logged loss."""
         o = self.opt
@@ -221,16 +270,31 @@ class WordEmbedding:
             if o.is_pipeline
             else pipeline
         )
+        S = max(1, o.steps_per_call)
+        log_every = o.batch_size * max(64, S * 8)
         for epoch in range(o.epoch):
             it = source.batches(epoch)
-            while True:
-                batch = next(it, None)
-                if batch is None:
+            done = False
+            while not done:
+                # pack up to S microbatches into one scanned dispatch
+                group = []
+                while len(group) < S:
+                    batch = next(it, None)
+                    if batch is None:
+                        done = True
+                        break
+                    group.append(batch)
+                if not group:
                     break
                 lr = self._lr(pairs_done / total_pairs_est)
-                loss_dev = self._run_batch(batch, lr)
-                pairs_done += o.batch_size
-                if pairs_done % (o.batch_size * 64) == 0:
+                if len(group) == S:
+                    loss_dev = self._run_superbatch(group, lr)
+                else:  # epoch tail: step singly, avoids a per-length recompile
+                    for b in group:
+                        loss_dev = self._run_batch(b, lr)
+                prev = pairs_done
+                pairs_done += o.batch_size * len(group)
+                if pairs_done // log_every > prev // log_every:
                     rate = pairs_done / max(time.perf_counter() - start, 1e-9)
                     Log.Info(
                         "[WordEmbedding] epoch %d: %.1fM pairs, %.0fk pairs/s, "
