@@ -54,7 +54,12 @@ def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=32,
     lr = jnp.float32(0.025)
     for _ in range(warmup):
         params, loss = step(params, centers, outputs, None, lr)
-    float(jnp.sum(params["emb_in"][0]))  # close the async queue before timing
+    # fence via host readback: on the tunneled axon platform
+    # jax.block_until_ready() does not reliably wait until a value has been
+    # read back at least once, so an explicit device->host force is the only
+    # trustworthy queue fence (measured: block_until_ready returned in <1ms
+    # with ~10s of queued work outstanding)
+    float(jnp.sum(params["emb_in"][0]))
     t0 = time.perf_counter()
     for _ in range(calls):
         params, loss = step(params, centers, outputs, None, lr)

@@ -77,7 +77,7 @@ MV_DEFINE_int("max_preload_data_size", 2, "prefetched batches (pipeline depth)")
 MV_DEFINE_bool("is_pipeline", True, "overlap batch generation with compute")
 MV_DEFINE_string("output_file", "embeddings.txt", "embedding output path")
 MV_DEFINE_int("batch_size", 4096, "pairs per training step (TPU batch)")
-MV_DEFINE_int("steps_per_call", 8, "microbatches scanned per device dispatch")
+MV_DEFINE_int("steps_per_call", 32, "microbatches scanned per device dispatch")
 MV_DEFINE_string(
     "scale_mode", "row_mean",
     "batched-update scaling: row_mean (safe) | raw (fast; see skipgram.py)",
@@ -108,7 +108,7 @@ class WEOptions:
     is_pipeline: bool = True
     output_file: str = "embeddings.txt"
     batch_size: int = 4096
-    steps_per_call: int = 8
+    steps_per_call: int = 32
     scale_mode: str = "row_mean"
     use_ps: bool = False
     seed: int = 1

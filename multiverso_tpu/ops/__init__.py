@@ -1,0 +1,11 @@
+"""TPU op layer: scatter/gather building blocks and Pallas kernels.
+
+The compute primitives the tables and models are built from. XLA's native
+gather/scatter emitters are the default lowering; ``pallas_embed`` provides a
+hand-written fused kernel for the embedding hot path with measured tradeoffs
+(see its module docstring for the benchmark discussion).
+"""
+
+from multiverso_tpu.ops.scatter import scatter_add_rows, segment_combine_rows
+
+__all__ = ["scatter_add_rows", "segment_combine_rows"]

@@ -1,0 +1,124 @@
+"""Pallas fused embedding-gather + dot kernel (NS forward scoring).
+
+The skip-gram NS forward computes ``logits[b,k] = emb_in[centers[b]] ·
+emb_out[outputs[b,k]]`` (ref: the per-sample dot in
+Applications/WordEmbedding/src/wordembedding.cpp:120-166). The XLA lowering
+materialises both gathered row sets to HBM before the batched dot; this
+kernel keeps them in VMEM: per batch tile it DMAs the needed rows from the
+HBM-resident tables into scratch, computes the dots on-chip, and writes only
+the (TB, K) logits block.
+
+**Measured tradeoff (TPU v5e bench chip, V=100k, D=128, B=8192, K=6):**
+XLA reference (gather + einsum) 3.5 ms; this kernel 19.2 ms (numerics match
+to f32 reduction order, max abs diff ~1e-5). XLA's hardware-assisted gather
+moves ~70M rows/s; per-row Pallas DMAs carry a fixed issue cost that
+dominates at D=128 (57k row copies/call). The fused kernel wins the
+intermediate HBM traffic back but loses 5x to DMA issue overhead, so the
+default training path stays on XLA (see ops/scatter.py and
+models/wordembedding/skipgram.py); the kernel is the template for wider-row
+tables (D >= 512, where per-row DMA amortises) and runs everywhere via
+``interpret=True`` off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["ns_logits", "ns_logits_reference"]
+
+
+def ns_logits_reference(emb_in, emb_out, centers, outputs):
+    """XLA reference: gather + batched dot (the default lowering)."""
+    vin = emb_in[centers]
+    vout = emb_out[outputs]
+    return jnp.einsum("bd,bkd->bk", vin, vout)
+
+
+def _kernel(centers_ref, outputs_ref, emb_in_hbm, emb_out_hbm, logits_ref,
+            vin_buf, vout_buf, sem):
+    """One grid step = one batch tile of TB pairs.
+
+    centers_ref (B,) / outputs_ref (B*K,) flat: scalar-prefetched ids (SMEM;
+    kept 1-D — 2-D SMEM arrays pad the minor dim to the lane width and
+    overflow the ~1MB SMEM budget).
+    emb_in_hbm / emb_out_hbm: full tables, left in HBM (memory_space=ANY).
+    logits_ref: (TB, K) VMEM output block.
+    vin_buf (TB, D) / vout_buf (TB, K, D): VMEM gather scratch.
+    """
+    t = pl.program_id(0)
+    TB = vin_buf.shape[0]
+    K = vout_buf.shape[1]
+    base = t * TB
+
+    def gather_center(j, _):
+        c = centers_ref[base + j]
+        dma = pltpu.make_async_copy(
+            emb_in_hbm.at[pl.ds(c, 1), :], vin_buf.at[pl.ds(j, 1), :], sem
+        )
+        dma.start()
+        dma.wait()
+        return 0
+
+    jax.lax.fori_loop(0, TB, gather_center, 0)
+
+    def gather_out(j, _):
+        b = j // K
+        k = j % K
+        o = outputs_ref[(base + b) * K + k]  # flat (B*K,) SMEM layout
+        dma = pltpu.make_async_copy(
+            emb_out_hbm.at[pl.ds(o, 1), :], vout_buf.at[b, pl.ds(k, 1), :], sem
+        )
+        dma.start()
+        dma.wait()
+        return 0
+
+    jax.lax.fori_loop(0, TB * K, gather_out, 0)
+
+    vin = vin_buf[...]
+    vout = vout_buf[...]
+    logits_ref[...] = jnp.sum(vin[:, None, :] * vout, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def ns_logits(emb_in, emb_out, centers, outputs, *, tile: int = 256,
+              interpret: bool = False):
+    """Fused NS logits: (B,) centers x (B, K) outputs -> (B, K) dots.
+
+    ``B`` must be a multiple of ``tile``. ``interpret=True`` runs the kernel
+    in the Pallas interpreter (CPU tests / non-TPU backends)."""
+    B = centers.shape[0]
+    K = outputs.shape[1]
+    D = emb_in.shape[1]
+    assert B % tile == 0, f"batch {B} not a multiple of tile {tile}"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # centers, outputs
+        grid=(B // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # emb_in stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # emb_out stays in HBM
+        ],
+        out_specs=pl.BlockSpec(
+            (tile, K), lambda t, *_: (t, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tile, D), emb_in.dtype),
+            pltpu.VMEM((tile, K, D), emb_out.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, K), emb_in.dtype),
+        interpret=interpret,
+    )(
+        centers.astype(jnp.int32),
+        outputs.astype(jnp.int32).reshape(-1),
+        emb_in,
+        emb_out,
+    )

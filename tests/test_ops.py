@@ -1,0 +1,73 @@
+"""Op-layer tests: scatter primitives and the Pallas embedding kernel
+(interpret mode on the CPU test backend)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multiverso_tpu.ops import scatter_add_rows, segment_combine_rows
+from multiverso_tpu.ops.pallas_embed import ns_logits, ns_logits_reference
+
+
+def test_scatter_add_rows_duplicates_accumulate():
+    tab = jnp.zeros((6, 4), jnp.float32)
+    ids = jnp.asarray([1, 1, 5], jnp.int32)
+    rows = jnp.ones((3, 4), jnp.float32)
+    out = scatter_add_rows(tab, ids, rows)
+    np.testing.assert_allclose(np.asarray(out[1]), 2.0)
+    np.testing.assert_allclose(np.asarray(out[5]), 1.0)
+    np.testing.assert_allclose(np.asarray(out[0]), 0.0)
+
+
+def test_segment_combine_rows():
+    ids = jnp.asarray([7, 2, 7, 2, 9], jnp.int32)
+    rows = jnp.arange(20, dtype=jnp.float32).reshape(5, 4)
+    uniq, summed = segment_combine_rows(ids, rows)
+    u = np.asarray(uniq)
+    s = np.asarray(summed)
+    # sorted unique prefix, -1 padding after
+    assert list(u[:3]) == [2, 7, 9]
+    assert set(u[3:]) == {-1}
+    np.testing.assert_allclose(s[0], rows[1] + rows[3])  # id 2
+    np.testing.assert_allclose(s[1], rows[0] + rows[2])  # id 7
+    np.testing.assert_allclose(s[2], rows[4])  # id 9
+    np.testing.assert_allclose(s[3:], 0.0)
+
+
+def test_segment_combine_then_scatter_equals_plain():
+    rng = np.random.RandomState(0)
+    tab = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, 32, size=50).astype(np.int32))
+    rows = jnp.asarray(rng.randn(50, 8).astype(np.float32))
+    plain = scatter_add_rows(tab, ids, rows)
+    uniq, summed = segment_combine_rows(ids, rows)
+    combined = tab.at[uniq].add(
+        summed, mode="drop", indices_are_sorted=False, unique_indices=False
+    )
+    # -1 ids drop; uniq prefix is sorted so accumulate correctly
+    np.testing.assert_allclose(np.asarray(combined), np.asarray(plain), rtol=1e-5)
+
+
+def test_pallas_ns_logits_matches_reference():
+    rng = np.random.RandomState(1)
+    V, D, B, K = 64, 16, 8, 3
+    emb_in = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    emb_out = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    centers = jnp.asarray(rng.randint(0, V, size=B).astype(np.int32))
+    outputs = jnp.asarray(rng.randint(0, V, size=(B, K)).astype(np.int32))
+    ref = ns_logits_reference(emb_in, emb_out, centers, outputs)
+    got = ns_logits(emb_in, emb_out, centers, outputs, tile=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_pallas_ns_logits_duplicate_ids():
+    rng = np.random.RandomState(2)
+    V, D, B, K = 16, 8, 4, 2
+    emb_in = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    emb_out = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    centers = jnp.asarray([3, 3, 3, 3], jnp.int32)
+    outputs = jnp.asarray([[1, 1], [1, 2], [2, 2], [1, 1]], jnp.int32)
+    ref = ns_logits_reference(emb_in, emb_out, centers, outputs)
+    got = ns_logits(emb_in, emb_out, centers, outputs, tile=2, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
