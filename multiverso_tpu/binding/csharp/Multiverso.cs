@@ -1,0 +1,209 @@
+// C# binding for the TPU-native Multiverso framework.
+//
+// Mirrors the reference C++/CLI wrapper surface (ref:
+// binding/C#/MultiversoCLR/MultiversoCLR.h:13-46) as a portable .NET
+// P/Invoke binding over the flat C ABI (libmultiverso_c.so — see
+// multiverso_tpu/capi/c_api.h). Unlike the reference's Windows-only CLR
+// project this compiles anywhere .NET runs; tables are float32 (the C ABI's
+// element type; the reference CLR wrapper likewise marshalled through the
+// float C API for its eleType="float" path).
+//
+// NetBind/NetConnect have no TPU equivalent (XLA owns the mesh fabric) and
+// throw NotSupportedException, matching MV_NetBind/MV_NetConnect in the
+// Python API.
+
+using System;
+using System.Collections.Generic;
+using System.Runtime.InteropServices;
+
+namespace MultiversoTpu
+{
+    internal static class Native
+    {
+        private const string Lib = "multiverso_c"; // libmultiverso_c.so
+
+        [DllImport(Lib)] internal static extern void MV_Init(IntPtr argc, IntPtr argv);
+        [DllImport(Lib)] internal static extern void MV_ShutDown();
+        [DllImport(Lib)] internal static extern void MV_Barrier();
+        [DllImport(Lib)] internal static extern int MV_NumWorkers();
+        [DllImport(Lib)] internal static extern int MV_WorkerId();
+        [DllImport(Lib)] internal static extern int MV_ServerId();
+
+        [DllImport(Lib)] internal static extern void MV_NewArrayTable(int size, out IntPtr handler);
+        [DllImport(Lib)] internal static extern void MV_GetArrayTable(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddArrayTable(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddAsyncArrayTable(IntPtr handler, float[] data, int size);
+
+        [DllImport(Lib)] internal static extern void MV_NewMatrixTable(int numRow, int numCol, out IntPtr handler);
+        [DllImport(Lib)] internal static extern void MV_GetMatrixTableAll(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddMatrixTableAll(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddAsyncMatrixTableAll(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_GetMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+        [DllImport(Lib)] internal static extern void MV_AddMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+        [DllImport(Lib)] internal static extern void MV_AddAsyncMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+    }
+
+    /// <summary>1-D dense float table handle (ref CLR IWorkerTable analog).</summary>
+    public sealed class ArrayTableHandler
+    {
+        private readonly IntPtr _handler;
+        public int Size { get; }
+
+        public ArrayTableHandler(int size, float[] initValue = null)
+        {
+            Size = size;
+            Native.MV_NewArrayTable(size, out _handler);
+            if (initValue != null)
+            {
+                if (initValue.Length != size)
+                    throw new ArgumentException("initValue length must equal table size");
+                // master-init protocol: worker 0 adds the value, others zeros,
+                // so sync-mode per-round add accounting stays aligned.
+                var data = MultiversoWrapper.WorkerId() == 0 ? initValue : new float[size];
+                Native.MV_AddArrayTable(_handler, data, size);
+            }
+        }
+
+        public float[] Get()
+        {
+            var buf = new float[Size];
+            Native.MV_GetArrayTable(_handler, buf, Size);
+            return buf;
+        }
+
+        public void Add(float[] delta, bool sync = false)
+        {
+            if (delta.Length != Size)
+                throw new ArgumentException("delta length must equal table size");
+            if (sync) Native.MV_AddArrayTable(_handler, delta, Size);
+            else Native.MV_AddAsyncArrayTable(_handler, delta, Size);
+        }
+    }
+
+    /// <summary>2-D row-addressable float table handle.</summary>
+    public sealed class MatrixTableHandler
+    {
+        private readonly IntPtr _handler;
+        public int NumRow { get; }
+        public int NumCol { get; }
+
+        public MatrixTableHandler(int numRow, int numCol, float[] initValue = null)
+        {
+            NumRow = numRow;
+            NumCol = numCol;
+            Native.MV_NewMatrixTable(numRow, numCol, out _handler);
+            if (initValue != null)
+            {
+                if (initValue.Length != numRow * numCol)
+                    throw new ArgumentException("initValue must have NumRow*NumCol elements");
+                var data = MultiversoWrapper.WorkerId() == 0 ? initValue : new float[initValue.Length];
+                Native.MV_AddMatrixTableAll(_handler, data, data.Length);
+            }
+        }
+
+        public float[] Get()
+        {
+            var buf = new float[NumRow * NumCol];
+            Native.MV_GetMatrixTableAll(_handler, buf, buf.Length);
+            return buf;
+        }
+
+        public float[] Get(int[] rowIds)
+        {
+            var buf = new float[rowIds.Length * NumCol];
+            Native.MV_GetMatrixTableByRows(_handler, buf, buf.Length, rowIds, rowIds.Length);
+            return buf;
+        }
+
+        public void Add(float[] delta, bool sync = false)
+        {
+            if (delta.Length != NumRow * NumCol)
+                throw new ArgumentException("delta must have NumRow*NumCol elements");
+            if (sync) Native.MV_AddMatrixTableAll(_handler, delta, delta.Length);
+            else Native.MV_AddAsyncMatrixTableAll(_handler, delta, delta.Length);
+        }
+
+        public void Add(int[] rowIds, float[] delta, bool sync = false)
+        {
+            if (delta.Length != rowIds.Length * NumCol)
+                throw new ArgumentException("delta must have rowIds.Length*NumCol elements");
+            if (sync) Native.MV_AddMatrixTableByRows(_handler, delta, delta.Length, rowIds, rowIds.Length);
+            else Native.MV_AddAsyncMatrixTableByRows(_handler, delta, delta.Length, rowIds, rowIds.Length);
+        }
+    }
+
+    /// <summary>Static facade mirroring the reference MultiversoWrapper
+    /// (ref: MultiversoCLR.h:13-46): Init/Shutdown/Barrier/Rank/Size plus
+    /// table_id-indexed CreateTable/Get/Add over float tables.</summary>
+    public static class MultiversoWrapper
+    {
+        private static readonly List<MatrixTableHandler> Tables = new List<MatrixTableHandler>();
+
+        [DllImport("libc", SetLastError = true)]
+        private static extern int setenv(string name, string value, int overwrite);
+
+        private static void SetNativeEnv(string name, string value)
+        {
+            // Environment.SetEnvironmentVariable only updates the managed
+            // environment block on .NET Core/Linux; the embedded CPython
+            // reads the native environ, so set both.
+            Environment.SetEnvironmentVariable(name, value);
+            try { setenv(name, value, 1); } catch (EntryPointNotFoundException) { }
+        }
+
+        public static void Init(int numTables = 0, bool sync = false)
+        {
+            // flags travel via MULTIVERSO_ARGS (the embedded runtime parses
+            // them at MV_Init; the C ABI takes no argv from P/Invoke hosts)
+            if (sync)
+            {
+                var existing = Environment.GetEnvironmentVariable("MULTIVERSO_ARGS");
+                var args = string.IsNullOrEmpty(existing) ? "-sync=true"
+                                                          : existing + " -sync=true";
+                SetNativeEnv("MULTIVERSO_ARGS", args);
+            }
+            Native.MV_Init(IntPtr.Zero, IntPtr.Zero);
+        }
+
+        public static void Shutdown() => Native.MV_ShutDown();
+        public static void Barrier() => Native.MV_Barrier();
+        public static int Rank() => Native.MV_WorkerId();
+        public static int Size() => Native.MV_NumWorkers();
+        public static int WorkerId() => Native.MV_WorkerId();
+        public static int ServerId() => Native.MV_ServerId();
+
+        public static void CreateTable(int tableId, int rows, int cols, string eleType = "float")
+        {
+            if (eleType != "float")
+                throw new NotSupportedException("the C ABI exposes float32 tables");
+            while (Tables.Count <= tableId) Tables.Add(null);
+            Tables[tableId] = new MatrixTableHandler(rows, cols);
+        }
+
+        public static void CreateTables(int[] rows, int[] cols, string[] eleTypes)
+        {
+            for (int i = 0; i < rows.Length; i++)
+                CreateTable(i, rows[i], cols[i], eleTypes[i]);
+        }
+
+        public static void Get(int tableId, float[] value) =>
+            Array.Copy(Tables[tableId].Get(), value, value.Length);
+
+        public static void Get(int tableId, int rowId, float[] value) =>
+            Array.Copy(Tables[tableId].Get(new[] { rowId }), value, value.Length);
+
+        public static void Add(int tableId, float[] update) =>
+            Tables[tableId].Add(update, sync: true);
+
+        public static void Add(int tableId, int rowId, float[] value) =>
+            Tables[tableId].Add(new[] { rowId }, value, sync: true);
+
+        public static bool NetBind(int rank, string endpoint) =>
+            throw new NotSupportedException("NetBind has no TPU equivalent: XLA owns the mesh fabric");
+
+        public static bool NetConnect(int[] ranks, string[] endpoints) =>
+            throw new NotSupportedException("NetConnect has no TPU equivalent: XLA owns the mesh fabric");
+
+        public static void NetFinalize() { }
+    }
+}

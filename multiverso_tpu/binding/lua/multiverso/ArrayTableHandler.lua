@@ -1,0 +1,56 @@
+--- 1-D dense float table handle (ref: binding/lua/ArrayTableHandler.lua).
+
+local ffi = require 'ffi'
+local util = require 'multiverso.util'
+
+ffi.cdef[[
+    void MV_NewArrayTable(int size, TableHandler* out);
+    void MV_GetArrayTable(TableHandler handler, float* data, int size);
+    void MV_AddArrayTable(TableHandler handler, float* data, int size);
+    void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+]]
+
+local ArrayTableHandler = {}
+ArrayTableHandler.__index = ArrayTableHandler
+
+--- Create a table of `size` float32s. `init_value` (optional) follows the
+-- reference master-init protocol: worker 0 sync-adds the value, every other
+-- worker sync-adds zeros so the sync server's per-round add accounting stays
+-- aligned across workers (ref: ArrayTableHandler.lua:26-37).
+function ArrayTableHandler.new(size, init_value)
+    local mv = require 'multiverso'
+    local self = setmetatable({}, ArrayTableHandler)
+    self._size = size
+    self._handler = ffi.new('TableHandler[1]')
+    mv.libmv.MV_NewArrayTable(ffi.new('int', size), self._handler)
+    if init_value ~= nil then
+        local cdata, n = util.to_cdata(init_value)
+        assert(n == size, 'init_value length must equal table size')
+        if mv.worker_id() ~= 0 then
+            cdata = ffi.new('float[?]', n)  -- zeros
+        end
+        mv.libmv.MV_AddArrayTable(self._handler[0], cdata, n)
+    end
+    return self
+end
+
+function ArrayTableHandler:get()
+    local mv = require 'multiverso'
+    local cdata = ffi.new('float[?]', self._size)
+    mv.libmv.MV_GetArrayTable(self._handler[0], cdata, self._size)
+    return util.from_cdata(cdata, self._size)
+end
+
+--- Add `data` (delta). `sync=true` blocks until the update is applied.
+function ArrayTableHandler:add(data, sync)
+    local mv = require 'multiverso'
+    local cdata, n = util.to_cdata(data)
+    assert(n == self._size, 'delta length must equal table size')
+    if sync then
+        mv.libmv.MV_AddArrayTable(self._handler[0], cdata, n)
+    else
+        mv.libmv.MV_AddAsyncArrayTable(self._handler[0], cdata, n)
+    end
+end
+
+return ArrayTableHandler

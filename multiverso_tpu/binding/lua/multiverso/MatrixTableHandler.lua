@@ -1,0 +1,78 @@
+--- 2-D row-addressable float table handle (ref: binding/lua/MatrixTableHandler.lua).
+
+local ffi = require 'ffi'
+local util = require 'multiverso.util'
+
+ffi.cdef[[
+    void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+    void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                                 int row_ids[], int row_ids_n);
+    void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                                 int row_ids[], int row_ids_n);
+    void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                      int row_ids[], int row_ids_n);
+]]
+
+local MatrixTableHandler = {}
+MatrixTableHandler.__index = MatrixTableHandler
+
+function MatrixTableHandler.new(num_row, num_col, init_value)
+    local mv = require 'multiverso'
+    local self = setmetatable({}, MatrixTableHandler)
+    self._num_row, self._num_col = num_row, num_col
+    self._size = num_row * num_col
+    self._handler = ffi.new('TableHandler[1]')
+    mv.libmv.MV_NewMatrixTable(
+        ffi.new('int', num_row), ffi.new('int', num_col), self._handler)
+    if init_value ~= nil then
+        local cdata, n = util.to_cdata(init_value)
+        assert(n == self._size, 'init_value must have num_row*num_col elements')
+        if mv.worker_id() ~= 0 then
+            cdata = ffi.new('float[?]', n)  -- zeros, keeps sync rounds aligned
+        end
+        mv.libmv.MV_AddMatrixTableAll(self._handler[0], cdata, n)
+    end
+    return self
+end
+
+--- Get the whole table (row_ids == nil) or a set of rows (1-based Lua array
+-- of 0-based row ids, matching the reference's C-side indexing).
+function MatrixTableHandler:get(row_ids)
+    local mv = require 'multiverso'
+    if row_ids == nil then
+        local cdata = ffi.new('float[?]', self._size)
+        mv.libmv.MV_GetMatrixTableAll(self._handler[0], cdata, self._size)
+        return util.from_cdata(cdata, self._num_row, self._num_col)
+    end
+    local ids, n = util.to_cdata(row_ids, 'int')
+    local cdata = ffi.new('float[?]', n * self._num_col)
+    mv.libmv.MV_GetMatrixTableByRows(
+        self._handler[0], cdata, n * self._num_col, ids, n)
+    return util.from_cdata(cdata, n, self._num_col)
+end
+
+function MatrixTableHandler:add(data, row_ids, sync)
+    local mv = require 'multiverso'
+    local cdata, n = util.to_cdata(data)
+    if row_ids == nil then
+        assert(n == self._size, 'delta must have num_row*num_col elements')
+        if sync then
+            mv.libmv.MV_AddMatrixTableAll(self._handler[0], cdata, n)
+        else
+            mv.libmv.MV_AddAsyncMatrixTableAll(self._handler[0], cdata, n)
+        end
+    else
+        local ids, nid = util.to_cdata(row_ids, 'int')
+        assert(n == nid * self._num_col, 'delta must have #row_ids*num_col elements')
+        if sync then
+            mv.libmv.MV_AddMatrixTableByRows(self._handler[0], cdata, n, ids, nid)
+        else
+            mv.libmv.MV_AddAsyncMatrixTableByRows(self._handler[0], cdata, n, ids, nid)
+        end
+    end
+end
+
+return MatrixTableHandler

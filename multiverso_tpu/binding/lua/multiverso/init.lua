@@ -1,0 +1,84 @@
+--- LuaJIT binding for the TPU-native Multiverso framework.
+--
+-- Mirrors the reference Lua/Torch binding surface (ref:
+-- binding/lua/init.lua:7-67) over the flat C ABI of libmultiverso_c.so
+-- (multiverso_tpu/capi/c_api.h). Unlike the reference it does NOT require
+-- torch: plain Lua number arrays work everywhere, and torch tensors are
+-- accepted transparently when torch is installed.
+--
+-- Library lookup order:
+--   1. MULTIVERSO_LIB environment variable (full path to libmultiverso_c.so)
+--   2. package.cpath search for "libmultiverso_c"
+--   3. plain ffi.load("multiverso_c") (system linker paths)
+
+local ffi = require 'ffi'
+
+local mv = {}
+
+ffi.cdef[[
+    typedef void* TableHandler;
+    void MV_Init(int* argc, char* argv[]);
+    void MV_ShutDown();
+    void MV_Barrier();
+    int MV_NumWorkers();
+    int MV_WorkerId();
+    int MV_ServerId();
+]]
+
+local function load_library()
+    local env = os.getenv('MULTIVERSO_LIB')
+    if env ~= nil and env ~= '' then
+        return ffi.load(env, true)
+    end
+    local path = package.searchpath and
+        package.searchpath('libmultiverso_c', package.cpath, '')
+    if path ~= nil then
+        return ffi.load(path, true)
+    end
+    local ok, lib = pcall(ffi.load, 'multiverso_c', true)
+    if ok then return lib end
+    error([[libmultiverso_c.so not found.
+Build it (python -m multiverso_tpu.capi) and point MULTIVERSO_LIB at it,
+or place it on package.cpath / the system linker path.]])
+end
+
+mv.libmv = load_library()
+
+mv.util = require 'multiverso.util'
+mv.ArrayTableHandler = require 'multiverso.ArrayTableHandler'
+mv.MatrixTableHandler = require 'multiverso.MatrixTableHandler'
+
+--- Start the runtime. `opts` may be a boolean (sync mode, reference
+-- signature) or a table of `-key=value` flag strings / key=value pairs.
+function mv.init(opts)
+    local args = { 'multiverso' }  -- argv[0] placeholder, consumed by parser
+    if type(opts) == 'boolean' then
+        if opts then args[#args + 1] = '-sync=true' end
+    elseif type(opts) == 'table' then
+        for k, v in pairs(opts) do
+            if type(k) == 'number' then
+                args[#args + 1] = tostring(v)
+            else
+                args[#args + 1] = string.format('-%s=%s', k, tostring(v))
+            end
+        end
+    end
+    local argc = ffi.new('int[1]', #args)
+    local argv = ffi.new('char*[?]', #args)
+    local keep = {}  -- anchor cdata until MV_Init returns
+    for i = 1, #args do
+        local buf = ffi.new('char[?]', #args[i] + 1)
+        ffi.copy(buf, args[i])
+        keep[i] = buf
+        argv[i - 1] = buf
+    end
+    mv.libmv.MV_Init(argc, argv)
+end
+
+function mv.barrier() mv.libmv.MV_Barrier() end
+function mv.shutdown() mv.libmv.MV_ShutDown() end
+function mv.num_workers() return mv.libmv.MV_NumWorkers() end
+function mv.worker_id() return mv.libmv.MV_WorkerId() end
+function mv.server_id() return mv.libmv.MV_ServerId() end
+
+return mv

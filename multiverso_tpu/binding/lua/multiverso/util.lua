@@ -1,0 +1,66 @@
+--- Data marshalling helpers for the Lua binding (ref: binding/lua/util.lua).
+--
+-- Converts between C arrays and whatever the host program uses: plain Lua
+-- number arrays always work; torch tensors are used when torch is loaded.
+
+local ffi = require 'ffi'
+
+local util = {}
+
+local has_torch, torch = pcall(require, 'torch')
+util.has_torch = has_torch
+
+local ctype_of = { float = 'float[?]', int = 'int[?]', double = 'double[?]' }
+
+--- Flatten `data` (Lua array, possibly nested one level, or torch tensor)
+-- into a freshly allocated C array of `data_type`. Returns cdata, length.
+function util.to_cdata(data, data_type)
+    data_type = data_type or 'float'
+    if has_torch and torch.isTensor(data) then
+        local t = data:contiguous():float()
+        local n = t:nElement()
+        local c = ffi.new(ctype_of[data_type], n)
+        ffi.copy(c, t:data(), n * ffi.sizeof(data_type))
+        return c, n
+    end
+    -- plain Lua table; allow one level of nesting (matrix as rows)
+    local flat = {}
+    for i = 1, #data do
+        local v = data[i]
+        if type(v) == 'table' then
+            for j = 1, #v do flat[#flat + 1] = v[j] end
+        else
+            flat[#flat + 1] = v
+        end
+    end
+    local c = ffi.new(ctype_of[data_type], #flat)
+    for i = 1, #flat do c[i - 1] = flat[i] end
+    return c, #flat
+end
+
+--- Convert a C array back to the host representation: a torch FloatTensor
+-- when torch is available, else a plain Lua array. `rows`/`cols` reshape
+-- (cols == nil -> 1-D of length rows).
+function util.from_cdata(cdata, rows, cols)
+    if has_torch then
+        local n = cols and rows * cols or rows
+        local t = torch.FloatTensor(n)
+        ffi.copy(t:data(), cdata, n * ffi.sizeof('float'))
+        if cols then return t:reshape(rows, cols) end
+        return t
+    end
+    if cols then
+        local out = {}
+        for r = 1, rows do
+            local row = {}
+            for c = 1, cols do row[c] = cdata[(r - 1) * cols + (c - 1)] end
+            out[r] = row
+        end
+        return out
+    end
+    local out = {}
+    for i = 1, rows do out[i] = cdata[i - 1] end
+    return out
+end
+
+return util

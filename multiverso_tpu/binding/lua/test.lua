@@ -1,0 +1,53 @@
+--- Lua binding self-test (ref: binding/lua/test.lua).
+--
+-- Run:  MULTIVERSO_LIB=/path/to/libmultiverso_c.so \
+--       luajit -e "package.path='multiverso_tpu/binding/lua/?.lua;'..
+--                  'multiverso_tpu/binding/lua/?/init.lua;'..package.path" test.lua
+--
+-- Asserts the reference's multi-worker arithmetic invariant: after `iters`
+-- rounds in which every worker adds `delta` once, each array slot holds
+-- iters * delta * num_workers (ref: Test/test_array_table.cpp:26-47 form).
+
+local mv = require 'multiverso'
+
+local function approx(a, b)
+    return math.abs(a - b) < 1e-4 * math.max(1, math.abs(b))
+end
+
+mv.init()
+local nw = mv.num_workers()
+print(('workers=%d worker_id=%d server_id=%d'):format(
+    nw, mv.worker_id(), mv.server_id()))
+
+-- Array table round trip
+local size, iters, delta = 64, 3, 2.5
+local at = mv.ArrayTableHandler.new(size)
+for i = 1, iters do
+    local d = {}
+    for k = 1, size do d[k] = delta end
+    at:add(d, true)
+    mv.barrier()
+end
+local got = at:get()
+local g1 = mv.util.has_torch and got[1] or got[1]
+assert(approx(tonumber(g1), iters * delta * nw),
+       ('array invariant: got %s want %s'):format(tonumber(g1), iters * delta * nw))
+
+-- Matrix table: whole-table and row-set ops
+local rows, cols = 10, 4
+local mt = mv.MatrixTableHandler.new(rows, cols)
+local all = {}
+for k = 1, rows * cols do all[k] = 1.0 end
+mt:add(all, nil, true)
+local m = mt:get()
+local m11 = mv.util.has_torch and m[1][1] or m[1][1]
+assert(approx(tonumber(m11), nw), 'matrix whole-table invariant')
+
+mt:add({ 9, 9, 9, 9 }, { 3 }, true)  -- row id 3 (0-based)
+local r = mt:get({ 3 })
+local r1 = mv.util.has_torch and r[1][1] or r[1][1]
+assert(approx(tonumber(r1), nw + 9 * nw), 'matrix row invariant')
+
+mv.barrier()
+mv.shutdown()
+print('lua binding test OK')

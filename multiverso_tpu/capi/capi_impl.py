@@ -37,7 +37,14 @@ def _view_i32(addr: int, size: int) -> np.ndarray:
 
 
 def init(args: List[str]) -> None:
-    mv_api.MV_Init(list(args))
+    # Foreign hosts that cannot construct argv (C# P/Invoke, JVM, plain C
+    # with MV_Init(0,0)) pass flags via the MULTIVERSO_ARGS env var instead
+    # (space-separated "-key=value" entries), appended after any real argv.
+    import os
+    import shlex
+
+    env_args = os.environ.get("MULTIVERSO_ARGS", "")
+    mv_api.MV_Init(list(args) + (shlex.split(env_args) if env_args else []))
 
 
 def shutdown() -> None:

@@ -33,14 +33,17 @@ def scatter_add_rows(
     *,
     indices_are_sorted: bool = False,
     unique_indices: bool = False,
+    mode: str | None = None,
 ) -> jnp.ndarray:
     """``table[row_ids] += rows`` with duplicate accumulation (the server-side
     Add semantics — ref: src/table/matrix_table.cpp:387-416 applies each
-    received row in sequence)."""
+    received row in sequence). ``mode='drop'`` discards out-of-range ids
+    (e.g. the -1 padding emitted by ``segment_combine_rows``)."""
     return table.at[row_ids].add(
         rows.astype(table.dtype),
         indices_are_sorted=indices_are_sorted,
         unique_indices=unique_indices,
+        mode=mode,
     )
 
 
@@ -53,6 +56,8 @@ def segment_combine_rows(
     masked consumer ignores them. Sorted output (``indices_are_sorted=True``
     holds for the scatter)."""
     n = row_ids.shape[0]
+    if n == 0:
+        return row_ids, rows
     order = jnp.argsort(row_ids)
     sids = row_ids[order]
     srows = rows[order]

@@ -1,0 +1,60 @@
+"""Lua binding test (ref: binding/lua/test.lua run via `make test`).
+
+Runs the binding's self-test under LuaJIT against libmultiverso_c.so.
+Skipped when no LuaJIT/Lua-with-ffi interpreter is on PATH (the binding is
+pure ffi source; nothing to test without an interpreter).
+"""
+
+import os
+import shutil
+import subprocess
+import sysconfig
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LUA_DIR = os.path.join(REPO, "multiverso_tpu", "binding", "lua")
+
+
+def _find_luajit():
+    for exe in ("luajit", "luajit-2.1", "lua"):
+        path = shutil.which(exe)
+        if path is None:
+            continue
+        try:  # plain lua only works if it ships the ffi module
+            ok = subprocess.run(
+                [path, "-e", "require 'ffi'"], capture_output=True, timeout=30
+            ).returncode == 0
+        except subprocess.SubprocessError:
+            ok = False
+        if ok:
+            return path
+    return None
+
+
+def test_lua_selftest():
+    lua = _find_luajit()
+    if lua is None:
+        pytest.skip("no LuaJIT (or lua with ffi) interpreter available")
+    from multiverso_tpu.capi import build_c_api
+
+    lib_path = build_c_api()
+    if lib_path is None:
+        pytest.skip("C API build failed")
+    site = sysconfig.get_paths()["purelib"]
+    env = dict(
+        os.environ,
+        MULTIVERSO_LIB=lib_path,
+        PYTHONPATH=os.pathsep.join([REPO, site]),
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    preamble = (
+        f"package.path='{LUA_DIR}/?.lua;{LUA_DIR}/?/init.lua;'..package.path"
+    )
+    proc = subprocess.run(
+        [lua, "-e", preamble, os.path.join(LUA_DIR, "test.lua")],
+        capture_output=True, timeout=600, env=env, text=True, cwd=LUA_DIR,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "lua binding test OK" in proc.stdout
