@@ -6,6 +6,21 @@ hand-written fused kernel for the embedding hot path with measured tradeoffs
 (see its module docstring for the benchmark discussion).
 """
 
+from multiverso_tpu.ops.ring_attention import (
+    attention_reference,
+    ring_attention,
+    ring_attention_local,
+    ulysses_attention,
+    ulysses_attention_local,
+)
 from multiverso_tpu.ops.scatter import scatter_add_rows, segment_combine_rows
 
-__all__ = ["scatter_add_rows", "segment_combine_rows"]
+__all__ = [
+    "scatter_add_rows",
+    "segment_combine_rows",
+    "attention_reference",
+    "ring_attention",
+    "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
+]

@@ -1,0 +1,252 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference predates transformers and has no attention anywhere
+(SURVEY.md §5 "Long-context"); its closest concepts are row-sharded model
+state and ring-structured collectives (the Bruck allgather rotates blocks
+around a ring — ref: src/net/allreduce_engine.cpp:79-117). This module is
+the long-context capability built on the same design stance: a sharded
+*sequence* axis is just another sharded dimension of the mesh, and the
+block rotation rides ICI via ``lax.ppermute`` instead of point-to-point
+sends.
+
+Two standard schemes, both SPMD under ``shard_map``:
+
+* **Ring attention** (blockwise, online-softmax): every device holds one
+  sequence block of Q, K, V. K/V blocks rotate around the ring; each step
+  computes one (Q-block x K-block) tile and folds it into a numerically
+  stable streaming softmax (running max ``m``, normalizer ``l``,
+  accumulator ``acc``). Peak memory per device is O(block^2) scores
+  instead of O(S^2); the ppermute of the next K/V block overlaps with the
+  current tile's compute under XLA's async collectives.
+
+* **Ulysses** (all-to-all head scatter): re-shard from sequence-sharded to
+  head-sharded with one ``all_to_all``, run dense local attention over the
+  full sequence on 1/n of the heads, and all-to-all back. Cheaper at
+  moderate S (two all-to-alls instead of n ppermutes) but requires
+  ``num_heads % n == 0``.
+
+Shapes follow the (batch, seq, heads, head_dim) convention. The public
+wrappers take global arrays + a mesh and shard_map internally; the ``_local``
+functions are the SPMD bodies for embedding in a larger pjit program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "attention_reference",
+    "ring_attention",
+    "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
+]
+
+_NEG_INF = float("-inf")
+
+
+def attention_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Dense single-device attention — the correctness oracle for the
+    parallel schemes. q,k,v: (B, S, H, D)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(Sk)[None, :] <= jnp.arange(Sq)[:, None]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _tile_update(m, l, acc, s, v, key_mask):
+    """Fold one (Q-block x K-block) score tile into the streaming softmax.
+
+    m:   (B, Q, H)    running row max
+    l:   (B, Q, H)    running normalizer
+    acc: (B, Q, H, D) running weighted-value sum
+    s:   (B, Q, H, K) this tile's scaled scores
+    key_mask: (B, Q, H, K) bool — True where the key is attendable
+    """
+    s = jnp.where(key_mask, s, _NEG_INF)
+    tile_max = jnp.max(s, axis=-1)  # -inf on fully-masked rows
+    m_new = jnp.maximum(m, tile_max)
+    # Fully-masked-so-far rows keep m == -inf; exp(-inf - -inf) is NaN, so
+    # gate both the tile probabilities and the correction factor explicitly.
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.where(key_mask, jnp.exp(s - safe_m[..., None]), 0.0)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l = l * corr + jnp.sum(p, axis=-1)
+    acc = acc * corr[..., None] + jnp.einsum(
+        "bqhk,bkhd->bqhd", p, v.astype(jnp.float32)
+    )
+    return m_new, l, acc
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """SPMD body: blockwise ring attention over ``axis_name``.
+
+    q, k, v are the *local* sequence blocks (B, S/n, H, D) of a
+    sequence-sharded global array. Returns the local block of the output.
+    Differentiable (the ring loop is a ``lax.scan``).
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = my * Sq + jnp.arange(Sq)  # global positions of local queries
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def tile(m, l, acc, k_blk, v_blk, src):
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = src * Sk + jnp.arange(Sk)
+            mask = k_pos[None, :] <= q_pos[:, None]  # (Sq, Sk)
+            mask = jnp.broadcast_to(mask[None, :, None, :], s.shape)
+        else:
+            mask = jnp.ones_like(s, bool)
+        return _tile_update(m, l, acc, s, v_blk, mask)
+
+    # Step 0 is the local block (src == my): no rotation needed before it,
+    # and folding it out of the scan means only n-1 ppermutes total (the
+    # final rotation's result would otherwise be computed and discarded).
+    m, l, acc = tile(
+        jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
+        jnp.zeros((B, Sq, H), jnp.float32),
+        jnp.zeros((B, Sq, H, D), jnp.float32),
+        k,
+        v,
+        my,
+    )
+
+    def body(carry, step):
+        m, l, acc, k_blk, v_blk = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # After `step` rotations each device holds the block that started on
+        # device (my - step) mod n.
+        src = (my - step) % n
+        if causal:
+            # A tile whose every key position is in the future contributes
+            # nothing — skip its FLOPs. The predicate varies per device but
+            # the branches are collective-free, so divergence is safe in
+            # manual (shard_map) mode. Covers Sq == Sk block layouts; with
+            # unequal blocks fall back to exact position comparison.
+            first_k = src * Sk
+            last_q = my * Sq + Sq - 1
+            m, l, acc = lax.cond(
+                first_k > last_q,
+                lambda m, l, acc, *_: (m, l, acc),
+                lambda m, l, acc, kb, vb, s: tile(m, l, acc, kb, vb, s),
+                m, l, acc, k_blk, v_blk, src,
+            )
+        else:
+            m, l, acc = tile(m, l, acc, k_blk, v_blk, src)
+        return (m, l, acc, k_blk, v_blk), ()
+
+    if n > 1:
+        (m, l, acc, _, _), _ = lax.scan(
+            body, (m, l, acc, k, v), jnp.arange(1, n)
+        )
+    out = acc / jnp.maximum(l, 1e-37)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """SPMD body: Ulysses all-to-all attention over ``axis_name``.
+
+    Local inputs are sequence blocks (B, S/n, H, D) with ``H % n == 0``.
+    One tiled all_to_all re-shards to (B, S, H/n, D), dense attention runs
+    on the full sequence for the local head group, and a second all_to_all
+    restores sequence sharding.
+    """
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
+    # (B, S/n, H, D) -> (B, S, H/n, D): split heads across the axis, gather seq
+    qh = a2a(q, split_axis=2, concat_axis=1)
+    kh = a2a(k, split_axis=2, concat_axis=1)
+    vh = a2a(v, split_axis=2, concat_axis=1)
+    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    # (B, S, H/n, D) -> (B, S/n, H, D)
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def _wrap(mesh: Mesh, seq_axis: str, local_fn, q, k, v, causal, scale):
+    n = int(mesh.shape[seq_axis])
+    for name, arr in (("q", q), ("k", k), ("v", v)):
+        if arr.shape[1] % n:
+            raise ValueError(
+                f"{name} seq len {arr.shape[1]} not divisible by {n} devices"
+            )
+    spec = P(None, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(local_fn, axis_name=seq_axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
+              jax.device_put(v, sharding))
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Global-array entry point: shards (B,S,H,D) inputs over ``seq_axis``
+    of ``mesh`` and runs blockwise ring attention."""
+    return _wrap(mesh, seq_axis, ring_attention_local, q, k, v, causal, scale)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Global-array entry point for Ulysses all-to-all attention. Requires
+    ``num_heads`` divisible by the ``seq_axis`` size."""
+    n = int(mesh.shape[seq_axis])
+    if q.shape[2] % n:
+        raise ValueError(f"num_heads {q.shape[2]} not divisible by {n} devices")
+    return _wrap(mesh, seq_axis, ulysses_attention_local, q, k, v, causal, scale)
