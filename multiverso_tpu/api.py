@@ -11,7 +11,6 @@ from typing import Any, List, Optional, Sequence
 
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.utils.configure import SetCMDFlag
-from multiverso_tpu.utils.log import Log
 
 __all__ = [
     "MV_CreateTable",
@@ -86,8 +85,20 @@ def MV_CreateTable(option):
 
 
 def MV_NetBind(rank: int, endpoint: str) -> None:
-    Log.Fatal("MV_NetBind has no TPU equivalent: XLA owns the mesh fabric")
+    """Declare this process's rank/endpoint before cluster wiring (ref:
+    include/multiverso/multiverso.h:47-56). TPU-native: records the identity
+    for the ``MV_NetConnect`` rendezvous — there is no socket to bind, XLA
+    owns the fabric once the cluster is formed."""
+    from multiverso_tpu.parallel import multihost
+
+    multihost.net_bind(rank, endpoint)
 
 
 def MV_NetConnect(ranks: Sequence[int], endpoints: Sequence[str]) -> None:
-    Log.Fatal("MV_NetConnect has no TPU equivalent: XLA owns the mesh fabric")
+    """Wire the cluster from an explicit endpoint list (ref:
+    include/multiverso/multiverso.h:57-65 — the CNTK-style ZMQ deployment).
+    TPU-native: rank 0's endpoint becomes the ``jax.distributed``
+    coordinator; call before ``MV_Init``."""
+    from multiverso_tpu.parallel import multihost
+
+    multihost.net_connect(ranks, endpoints)

@@ -80,15 +80,19 @@ def _tile_update(m, l, acc, s, v, key_mask):
     l:   (B, Q, H)    running normalizer
     acc: (B, Q, H, D) running weighted-value sum
     s:   (B, Q, H, K) this tile's scaled scores
-    key_mask: (B, Q, H, K) bool — True where the key is attendable
+    key_mask: (B, Q, H, K) bool, or None for an unmasked tile (skips the
+              two masked selects on the hot path)
     """
-    s = jnp.where(key_mask, s, _NEG_INF)
+    if key_mask is not None:
+        s = jnp.where(key_mask, s, _NEG_INF)
     tile_max = jnp.max(s, axis=-1)  # -inf on fully-masked rows
     m_new = jnp.maximum(m, tile_max)
     # Fully-masked-so-far rows keep m == -inf; exp(-inf - -inf) is NaN, so
     # gate both the tile probabilities and the correction factor explicitly.
     safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    p = jnp.where(key_mask, jnp.exp(s - safe_m[..., None]), 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    if key_mask is not None:
+        p = jnp.where(key_mask, p, 0.0)
     corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
     l = l * corr + jnp.sum(p, axis=-1)
     acc = acc * corr[..., None] + jnp.einsum(
@@ -129,7 +133,7 @@ def ring_attention_local(
             mask = k_pos[None, :] <= q_pos[:, None]  # (Sq, Sk)
             mask = jnp.broadcast_to(mask[None, :, None, :], s.shape)
         else:
-            mask = jnp.ones_like(s, bool)
+            mask = None  # unmasked tile: skip the masked selects entirely
         return _tile_update(m, l, acc, s, v_blk, mask)
 
     # Step 0 is the local block (src == my): no rotation needed before it,

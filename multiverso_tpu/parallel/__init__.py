@@ -5,6 +5,7 @@ NetInterface / MPINetWrapper / ZMQNetWrapper / AllreduceEngine): XLA
 collectives over ICI/DCN are the transport, the mesh is the topology.
 """
 
+from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.mesh import (
     SHARD_AXIS,
     WORKER_AXIS,
@@ -18,6 +19,7 @@ from multiverso_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "multihost",
     "SHARD_AXIS",
     "WORKER_AXIS",
     "build_mesh",

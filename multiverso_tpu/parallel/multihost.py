@@ -1,0 +1,299 @@
+"""Multi-host bootstrap: the reference's cluster-formation layer, TPU-native.
+
+The reference forms a cluster three ways (SURVEY.md §2.2): MPI launch
+(mpirun assigns ranks), a ZMQ machine file (`-machine_file` + `-port`, rank =
+index of the local IP in the file — ref: include/multiverso/net/zmq_net.h:
+23-109), or explicit endpoint wiring driven by the embedding application
+(``MV_NetBind``/``MV_NetConnect`` — ref: include/multiverso/multiverso.h:
+47-65). On TPU all three collapse into ``jax.distributed.initialize``: one
+coordinator address, N processes, and XLA owns every byte moved thereafter —
+ICI within a slice, DCN across slices. This module keeps the reference's
+*deployment surface* (machine file, explicit endpoints, programmatic args)
+as front-ends to that single rendezvous:
+
+* ``initialize(...)``            — programmatic (coordinator, N, process_id)
+* ``initialize_from_machine_file`` — the ZMQ machine-file flow: rank = line
+                                   index matching a local IP, coordinator =
+                                   line 0
+* ``MV_NetBind/MV_NetConnect``   — the CNTK-style explicit wiring, re-mapped
+                                   in api.py onto the same rendezvous
+
+plus the mesh/data plumbing a multi-host run needs:
+
+* ``build_multihost_mesh``  — hybrid ICI x DCN device mesh: the table shard
+  axis stays *inside* a slice (collectives ride ICI; SURVEY.md §2.2 "lay out
+  shardings so collectives ride ICI"), the worker/data axis spans DCN.
+* ``host_local_to_global`` / ``global_to_host_local`` — per-host input
+  batches -> one global sharded array and back (each host feeds its own
+  readers, exactly like each reference rank reads its own data blocks).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.utils.configure import MV_DEFINE_int, MV_DEFINE_string, GetFlag
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = [
+    "initialize",
+    "initialize_from_flags",
+    "initialize_from_machine_file",
+    "parse_machine_file",
+    "local_ips",
+    "build_multihost_mesh",
+    "host_local_to_global",
+    "global_to_host_local",
+    "process_index",
+    "process_count",
+]
+
+# Flag parity with the ZMQ backend (ref: zmq_net.h:20-21 declares
+# -machine_file and -port for rank discovery).
+MV_DEFINE_string("machine_file", "", "one host[:port] per line; line 0 is coordinator")
+MV_DEFINE_int("port", 55555, "coordinator port when machine_file lines lack one")
+MV_DEFINE_string("coordinator", "", "coordinator ip:port (overrides machine_file)")
+MV_DEFINE_int("process_id", -1, "this process's id (-1: infer from machine_file)")
+MV_DEFINE_int("num_processes", 0, "total processes (0: infer)")
+
+_initialized = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_ips() -> List[str]:
+    """Addresses of this host (ref: util/net_util.cpp GetLocalIPAddress —
+    used by the ZMQ backend to find this rank's line in the machine file)."""
+    ips = {"127.0.0.1", "localhost"}
+    try:
+        hostname = socket.gethostname()
+        ips.add(hostname)
+        for info in socket.getaddrinfo(hostname, None):
+            ips.add(info[4][0])
+    except OSError:
+        pass
+    return sorted(ips)
+
+
+def parse_machine_file(path: str, default_port: int) -> List[str]:
+    """Machine file -> ['host:port', ...]. Blank lines / '#' comments skipped
+    (ref: zmq_net.h machine-file reading)."""
+    endpoints = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.count(":") > 1 or line.startswith("["):
+                # Rank inference matches on the host part split at the last
+                # ':', which mis-parses IPv6 — fail loudly, not wrongly.
+                Log.Fatal(
+                    "IPv6 endpoints are not supported in the machine file "
+                    f"(got {line!r}); use IPv4 or a hostname"
+                )
+            endpoints.append(line if ":" in line else f"{line}:{default_port}")
+    return endpoints
+
+
+def _infer_process_id(endpoints: Sequence[str]) -> int:
+    mine = set(local_ips())
+    for i, ep in enumerate(endpoints):
+        if ep.rsplit(":", 1)[0] in mine:
+            return i
+    Log.Fatal(
+        "none of this host's addresses (%s) appear in the machine file", mine
+    )
+    return -1  # unreachable
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    auto: bool = False,
+) -> None:
+    """Run the cluster rendezvous (the reference Controller's registration
+    handshake — ref: src/controller.cpp:12-104 — performed by JAX's
+    distributed service). Safe to call in a single-process run: with no
+    coordinator and num_processes in (None, 0, 1) it is a no-op.
+    ``auto=True`` lets jax detect everything from the pod environment
+    (the ``-multihost`` flag path)."""
+    global _initialized
+    if _initialized:
+        Log.Info("multihost already initialized; skipping")
+        return
+    if not auto:
+        if coordinator_address is None and num_processes in (None, 0, 1):
+            return  # single-process: nothing to rendezvous
+        if num_processes == 1:
+            Log.Info("single-process cluster; skipping distributed rendezvous")
+            return
+    # num_processes=None with a coordinator: jax infers the count from the
+    # TPU pod environment.
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    Log.Info(
+        "multihost rendezvous complete: process %d/%d, %d global device(s)",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+
+
+def initialize_from_machine_file(
+    path: str, default_port: int = 55555, process_id: Optional[int] = None
+) -> Tuple[int, int]:
+    """The ZMQ deployment flow: rank = index of a local IP in the file,
+    coordinator = line 0 (ref: zmq_net.h:63-109 rank-by-local-IP matching).
+    Returns (process_id, num_processes)."""
+    endpoints = parse_machine_file(path, default_port)
+    CHECK(len(endpoints) > 0, f"machine file {path} lists no hosts")
+    pid = _infer_process_id(endpoints) if process_id is None else process_id
+    initialize(
+        coordinator_address=endpoints[0],
+        num_processes=len(endpoints),
+        process_id=pid,
+    )
+    return pid, len(endpoints)
+
+
+def initialize_from_flags() -> None:
+    """Flag-driven bootstrap used by ``MV_Init``: honours ``-coordinator`` /
+    ``-process_id`` / ``-num_processes``, else ``-machine_file`` + ``-port``,
+    else single-process no-op."""
+    coordinator = GetFlag("coordinator")
+    machine_file = GetFlag("machine_file")
+    if coordinator:
+        pid = GetFlag("process_id")
+        initialize(
+            coordinator_address=coordinator,
+            num_processes=GetFlag("num_processes") or None,
+            process_id=None if pid < 0 else pid,
+        )
+    elif machine_file:
+        pid = GetFlag("process_id")
+        initialize_from_machine_file(
+            machine_file, GetFlag("port"), None if pid < 0 else pid
+        )
+
+
+_bound: Optional[Tuple[int, str]] = None
+
+
+def net_bind(rank: int, endpoint: str) -> None:
+    """``MV_NetBind`` semantics (ref: multiverso.h:47-56 — declare this
+    process's rank and endpoint before wiring the cluster). On TPU this
+    records the identity used by the next ``net_connect`` rendezvous."""
+    global _bound
+    _bound = (int(rank), endpoint)
+
+
+def net_connect(ranks: Sequence[int], endpoints: Sequence[str]) -> None:
+    """``MV_NetConnect`` semantics (ref: multiverso.h:57-65 — hand the full
+    cluster endpoint list to every process). On TPU the list *is* the
+    cluster: rank 0's endpoint becomes the coordinator and the rendezvous
+    replaces the ZMQ DEALER mesh. Requires a prior ``net_bind`` (or a
+    single-entry list for single-process runs)."""
+    CHECK(len(ranks) == len(endpoints), "ranks/endpoints length mismatch")
+    order = sorted(range(len(ranks)), key=lambda i: ranks[i])
+    eps = [endpoints[i] for i in order]
+    if len(eps) <= 1:
+        return
+    CHECK(_bound is not None, "MV_NetConnect requires a prior MV_NetBind")
+    CHECK(
+        _bound[0] in set(ranks),
+        f"bound rank {_bound[0]} not in MV_NetConnect ranks {list(ranks)}",
+    )
+    # jax process ids are dense [0, n); the reference allows arbitrary rank
+    # labels, so map the bound rank to its position in sorted order.
+    pid = sorted(ranks).index(_bound[0])
+    initialize(coordinator_address=eps[0], num_processes=len(eps), process_id=pid)
+
+
+def build_multihost_mesh(
+    num_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(worker, shard) mesh spanning every process.
+
+    The shard ("server") axis is laid out over devices *within* a process's
+    slice so table Get/Add collectives (all-gather / reduce-scatter over
+    ``shard``) ride ICI; the worker (data) axis spans processes, so only the
+    gradient/model-averaging all-reduce crosses DCN. This is the TPU analog
+    of the reference's every-node-is-worker-and-server layout (ref:
+    src/zoo.cpp:23-35) with the table traffic kept off the slow network.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    CHECK(n % max(num_shards, 1) == 0, f"{n} devices not divisible by {num_shards}")
+    per_proc = n // max(jax.process_count(), 1)
+    if num_shards > 1 and per_proc and per_proc % num_shards != 0:
+        # Covers both num_shards > per_proc and non-dividing cases: either
+        # way some shard group straddles a process boundary.
+        Log.Info(
+            "num_shards=%d does not divide per-process device count %d: some "
+            "table shard groups will span DCN (works, but Get/Add "
+            "collectives leave ICI — prefer a num_shards that divides %d)",
+            num_shards,
+            per_proc,
+            per_proc,
+        )
+    # jax.devices() orders by process then local id, so reshaping
+    # (workers, shards) with shards as the fastest-varying dim keeps each
+    # shard group within one process whenever num_shards <= per_proc.
+    if num_shards <= 1:
+        return Mesh(np.asarray(devices), (mesh_lib.WORKER_AXIS,))
+    grid = np.asarray(devices).reshape(n // num_shards, num_shards)
+    return Mesh(grid, (mesh_lib.WORKER_AXIS, mesh_lib.SHARD_AXIS))
+
+
+def host_local_to_global(mesh: Mesh, spec: P, host_local: np.ndarray) -> jax.Array:
+    """Per-host input batch -> one global sharded array.
+
+    Each process passes its *own* slice (e.g. the data blocks its readers
+    produced — the reference's per-rank data loading, ref:
+    Applications/WordEmbedding/src/distributed_wordembedding.cpp:152-154);
+    the result is the concatenated global array sharded by ``spec``.
+    Single-process: equivalent to ``jax.device_put``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(np.asarray(host_local), NamedSharding(mesh, spec))
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(host_local), mesh, spec
+    )
+
+
+def global_to_host_local(global_array: jax.Array, spec: Optional[P] = None):
+    """Global sharded array -> this host's local slice (numpy). The inverse
+    data-plane helper, used when saving shards or inspecting local state."""
+    if jax.process_count() == 1:
+        return np.asarray(global_array)
+    from jax.experimental import multihost_utils
+
+    mesh = global_array.sharding.mesh  # type: ignore[union-attr]
+    if spec is None:
+        spec = global_array.sharding.spec  # type: ignore[union-attr]
+    return multihost_utils.global_array_to_host_local_array(
+        global_array, mesh, spec
+    )

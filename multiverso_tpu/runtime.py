@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.parallel import multihost  # registers -machine_file/-coordinator flags
 from multiverso_tpu.utils.configure import (
     MV_DEFINE_bool,
     MV_DEFINE_int,
@@ -109,10 +110,19 @@ class Runtime:
                 )
             return remaining
         if GetFlag("multihost"):
-            jax.distributed.initialize()
+            # pod-environment auto-detection, tracked by the multihost module
+            # so later explicit rendezvous calls see it as already done
+            multihost.initialize(auto=True)
+        else:
+            # -coordinator / -machine_file driven rendezvous (no-op when
+            # neither flag is set — single-process run)
+            multihost.initialize_from_flags()
         if mesh is None:
             flag_shards = num_shards if num_shards is not None else GetFlag("num_shards")
-            mesh = mesh_lib.build_mesh(num_shards=flag_shards or None)
+            if jax.process_count() > 1:
+                mesh = multihost.build_multihost_mesh(num_shards=flag_shards or 1)
+            else:
+                mesh = mesh_lib.build_mesh(num_shards=flag_shards or None)
         self.mesh = mesh
         self._started = True
         self._build_barrier()

@@ -1,0 +1,126 @@
+"""Multi-host bootstrap logic on one host.
+
+Real multi-process rendezvous needs N processes (the driver's multi-chip
+dryrun and a real pod cover execution); what is testable on one host is the
+deployment-surface logic the reference exercises via its machine file and
+MV_NetBind/MV_NetConnect paths (ref: include/multiverso/net/zmq_net.h:23-109,
+include/multiverso/multiverso.h:47-65): file parsing, rank inference by
+local IP, single-process no-op behavior, argument validation, and the
+hybrid mesh / host-local data plumbing on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import multiverso_tpu as mv
+from multiverso_tpu.parallel import multihost
+from multiverso_tpu.utils.log import FatalError
+
+
+def test_parse_machine_file(tmp_path):
+    f = tmp_path / "machines"
+    f.write_text("# cluster\nhost-a\nhost-b:7777\n\nhost-c\n")
+    eps = multihost.parse_machine_file(str(f), 5555)
+    assert eps == ["host-a:5555", "host-b:7777", "host-c:5555"]
+
+
+def test_infer_process_id_local(tmp_path):
+    """This host's line index becomes the rank (ZMQ rank-by-local-IP)."""
+    f = tmp_path / "machines"
+    f.write_text("10.0.0.99\n127.0.0.1\n10.0.0.98\n")
+    eps = multihost.parse_machine_file(str(f), 5555)
+    assert multihost._infer_process_id(eps) == 1
+
+
+def test_infer_process_id_absent_fatal(tmp_path):
+    f = tmp_path / "machines"
+    f.write_text("10.9.9.1\n10.9.9.2\n")
+    eps = multihost.parse_machine_file(str(f), 5555)
+    with pytest.raises(FatalError):
+        multihost._infer_process_id(eps)
+
+
+def test_initialize_single_process_noop():
+    multihost.initialize()  # no coordinator, no N: must not raise
+    multihost.initialize(coordinator_address="127.0.0.1:5555", num_processes=1)
+    assert jax.process_count() == 1
+
+
+def test_machine_file_single_host_noop(tmp_path):
+    f = tmp_path / "machines"
+    f.write_text("127.0.0.1\n")
+    pid, n = multihost.initialize_from_machine_file(str(f))
+    assert (pid, n) == (0, 1)
+
+
+def test_net_bind_connect_single_noop():
+    mv.MV_NetBind(0, "127.0.0.1:5555")
+    mv.MV_NetConnect([0], ["127.0.0.1:5555"])  # single entry: no rendezvous
+    with pytest.raises(FatalError):
+        mv.MV_NetConnect([0, 1], ["127.0.0.1:5555"])  # length mismatch
+
+
+def test_net_connect_rank_mapping(monkeypatch):
+    """Arbitrary rank labels map to dense jax process ids by sorted position
+    (the reference allows any rank labels; jax requires [0, n))."""
+    calls = {}
+
+    def fake_init(coordinator_address, num_processes, process_id, auto=False):
+        calls.update(
+            coordinator=coordinator_address, n=num_processes, pid=process_id
+        )
+
+    monkeypatch.setattr(multihost, "initialize", fake_init)
+    monkeypatch.setattr(multihost, "_bound", None)
+    mv.MV_NetBind(5, "10.0.0.2:7000")
+    mv.MV_NetConnect([5, 1], ["10.0.0.2:7000", "10.0.0.1:7000"])
+    assert calls == {"coordinator": "10.0.0.1:7000", "n": 2, "pid": 1}
+    # bound rank absent from the connect list must fail loudly
+    mv.MV_NetBind(9, "10.0.0.3:7000")
+    with pytest.raises(FatalError):
+        mv.MV_NetConnect([5, 1], ["10.0.0.2:7000", "10.0.0.1:7000"])
+
+
+def test_machine_file_ipv6_rejected(tmp_path):
+    f = tmp_path / "machines"
+    f.write_text("::1\n")
+    with pytest.raises(FatalError):
+        multihost.parse_machine_file(str(f), 5555)
+
+
+def test_build_multihost_mesh_shapes():
+    m1 = multihost.build_multihost_mesh(num_shards=1)
+    assert m1.axis_names == ("worker",) and m1.shape["worker"] == 8
+    m2 = multihost.build_multihost_mesh(num_shards=2)
+    assert dict(m2.shape) == {"worker": 4, "shard": 2}
+    with pytest.raises(FatalError):
+        multihost.build_multihost_mesh(num_shards=3)
+
+
+def test_host_local_global_round_trip():
+    mesh = multihost.build_multihost_mesh(num_shards=1)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    g = multihost.host_local_to_global(mesh, P("worker", None), x)
+    assert g.shape == (8, 4)
+    back = multihost.global_to_host_local(g)
+    np.testing.assert_array_equal(back, x)
+
+
+def test_mv_init_with_machine_file_flag(tmp_path):
+    """Flag-driven bootstrap through MV_Init: single-host machine file
+    degenerates to a normal single-process start."""
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+
+    f = tmp_path / "machines"
+    f.write_text("127.0.0.1\n")
+    ResetFlagsToDefault()
+    mv.MV_Init([f"-machine_file={f}"])
+    try:
+        assert mv.MV_Size() == 1
+        assert mv.MV_NumWorkers() == 8
+    finally:
+        mv.MV_ShutDown(finalize=True)
+        ResetFlagsToDefault()

@@ -57,11 +57,11 @@ def test_two_d_mesh():
         ResetFlagsToDefault()
 
 
-def test_netbind_raises(mv_env):
-    from multiverso_tpu.utils.log import FatalError
-
-    with pytest.raises(FatalError):
-        mv_env.MV_NetBind(0, "tcp://127.0.0.1:5555")
+def test_netbind_records_identity(mv_env):
+    """MV_NetBind/MV_NetConnect are the explicit cluster-wiring front-end to
+    the jax.distributed rendezvous (single-entry connect: no-op)."""
+    mv_env.MV_NetBind(0, "tcp://127.0.0.1:5555")
+    mv_env.MV_NetConnect([0], ["tcp://127.0.0.1:5555"])
 
 
 def test_reinit_with_different_mesh_rejected(mv_env):
