@@ -8,9 +8,9 @@
 // element type; the reference CLR wrapper likewise marshalled through the
 // float C API for its eleType="float" path).
 //
-// NetBind/NetConnect have no TPU equivalent (XLA owns the mesh fabric) and
-// throw NotSupportedException, matching MV_NetBind/MV_NetConnect in the
-// Python API.
+// NetBind/NetConnect front the jax.distributed cluster rendezvous (rank 0's
+// endpoint becomes the coordinator), matching MV_NetBind/MV_NetConnect in
+// the Python API; call both before Init on multi-host deployments.
 
 using System;
 using System.Collections.Generic;
@@ -28,6 +28,12 @@ namespace MultiversoTpu
         [DllImport(Lib)] internal static extern int MV_NumWorkers();
         [DllImport(Lib)] internal static extern int MV_WorkerId();
         [DllImport(Lib)] internal static extern int MV_ServerId();
+        [DllImport(Lib)] internal static extern void MV_NetBind(
+            int rank, [MarshalAs(UnmanagedType.LPStr)] string endpoint);
+        [DllImport(Lib)] internal static extern void MV_NetConnect(
+            int[] ranks,
+            [In, MarshalAs(UnmanagedType.LPArray, ArraySubType = UnmanagedType.LPStr)] string[] endpoints,
+            int n);
 
         [DllImport(Lib)] internal static extern void MV_NewArrayTable(int size, out IntPtr handler);
         [DllImport(Lib)] internal static extern void MV_GetArrayTable(IntPtr handler, float[] data, int size);
@@ -198,11 +204,19 @@ namespace MultiversoTpu
         public static void Add(int tableId, int rowId, float[] value) =>
             Tables[tableId].Add(new[] { rowId }, value, sync: true);
 
-        public static bool NetBind(int rank, string endpoint) =>
-            throw new NotSupportedException("NetBind has no TPU equivalent: XLA owns the mesh fabric");
+        public static bool NetBind(int rank, string endpoint)
+        {
+            Native.MV_NetBind(rank, endpoint);
+            return true;
+        }
 
-        public static bool NetConnect(int[] ranks, string[] endpoints) =>
-            throw new NotSupportedException("NetConnect has no TPU equivalent: XLA owns the mesh fabric");
+        public static bool NetConnect(int[] ranks, string[] endpoints)
+        {
+            if (ranks.Length != endpoints.Length)
+                throw new ArgumentException("ranks/endpoints length mismatch");
+            Native.MV_NetConnect(ranks, endpoints, ranks.Length);
+            return true;
+        }
 
         public static void NetFinalize() { }
     }

@@ -124,6 +124,35 @@ int MV_WorkerId() { return (int)AsLong(Call("worker_id", "()")); }
 
 int MV_ServerId() { return (int)AsLong(Call("server_id", "()")); }
 
+void MV_NetBind(int rank, const char* endpoint) {
+  CallVoid(Call("net_bind", "(is)", rank, endpoint));
+}
+
+void MV_NetConnect(const int* ranks, const char** endpoints, int n) {
+  EnsureRuntime();
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* rank_list = PyList_New(0);
+  PyObject* ep_list = PyList_New(0);
+  for (int i = 0; i < n; ++i) {
+    PyObject* r = PyLong_FromLong(ranks[i]);
+    PyObject* e = PyUnicode_FromString(endpoints[i]);
+    PyList_Append(rank_list, r);
+    PyList_Append(ep_list, e);
+    Py_DECREF(r);
+    Py_DECREF(e);
+  }
+  PyObject* res =
+      PyObject_CallMethod(g_impl, "net_connect", "(OO)", rank_list, ep_list);
+  Py_DECREF(rank_list);
+  Py_DECREF(ep_list);
+  if (res == nullptr) {
+    PyErr_Print();
+    std::abort();
+  }
+  Py_DECREF(res);
+  PyGILState_Release(gs);
+}
+
 // ---- Array table ----------------------------------------------------------
 
 void MV_NewArrayTable(int size, TableHandler* out) {

@@ -25,6 +25,12 @@ int MV_NumWorkers(void);
 int MV_WorkerId(void);
 int MV_ServerId(void);
 
+/* Explicit cluster wiring (ref: the CLR wrapper's NetBind/NetConnect —
+ * binding/C#/MultiversoCLR/MultiversoCLR.h:13-46). On TPU these front the
+ * jax.distributed rendezvous; call both before MV_Init. */
+void MV_NetBind(int rank, const char* endpoint);
+void MV_NetConnect(const int* ranks, const char** endpoints, int n);
+
 /* 1-D float array table: whole-table get/add, sync + async. */
 void MV_NewArrayTable(int size, TableHandler* out);
 void MV_GetArrayTable(TableHandler handler, float* data, int size);

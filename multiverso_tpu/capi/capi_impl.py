@@ -70,6 +70,14 @@ def server_id() -> int:
     return mv_api.MV_ServerId()
 
 
+def net_bind(rank: int, endpoint: str) -> None:
+    mv_api.MV_NetBind(rank, endpoint)
+
+
+def net_connect(ranks: List[int], endpoints: List[str]) -> None:
+    mv_api.MV_NetConnect(ranks, endpoints)
+
+
 def _register(table) -> int:
     h = _next_handle[0]
     _next_handle[0] += 1

@@ -5,7 +5,7 @@ NetInterface / MPINetWrapper / ZMQNetWrapper / AllreduceEngine): XLA
 collectives over ICI/DCN are the transport, the mesh is the topology.
 """
 
-from multiverso_tpu.parallel import multihost
+from multiverso_tpu.parallel import collectives, multihost
 from multiverso_tpu.parallel.mesh import (
     SHARD_AXIS,
     WORKER_AXIS,
@@ -19,6 +19,7 @@ from multiverso_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "collectives",
     "multihost",
     "SHARD_AXIS",
     "WORKER_AXIS",

@@ -74,6 +74,12 @@ def process_count() -> int:
     return jax.process_count()
 
 
+def _strip_scheme(endpoint: str) -> str:
+    """'tcp://host:port' -> 'host:port'. The reference API deals in ZMQ
+    endpoints; jax's gRPC rendezvous wants a bare address."""
+    return endpoint.split("://", 1)[1] if "://" in endpoint else endpoint
+
+
 def local_ips() -> List[str]:
     """Addresses of this host (ref: util/net_util.cpp GetLocalIPAddress —
     used by the ZMQ backend to find this rank's line in the machine file)."""
@@ -83,6 +89,18 @@ def local_ips() -> List[str]:
         ips.add(hostname)
         for info in socket.getaddrinfo(hostname, None):
             ips.add(info[4][0])
+    except OSError:
+        pass
+    # getaddrinfo(gethostname()) commonly resolves to loopback (127.0.1.1 on
+    # Debian-family hosts); the routing trick finds the primary NIC address
+    # without sending a packet.
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            ips.add(s.getsockname()[0])
+        finally:
+            s.close()
     except OSError:
         pass
     return sorted(ips)
@@ -110,8 +128,18 @@ def parse_machine_file(path: str, default_port: int) -> List[str]:
 
 def _infer_process_id(endpoints: Sequence[str]) -> int:
     mine = set(local_ips())
-    for i, ep in enumerate(endpoints):
-        if ep.rsplit(":", 1)[0] in mine:
+    hosts = [_strip_scheme(ep).rsplit(":", 1)[0] for ep in endpoints]
+    for i, host in enumerate(hosts):
+        if host in mine:
+            return i
+    # Second pass: a machine file may list FQDNs/aliases that differ from
+    # gethostname() — resolve each entry and match addresses.
+    for i, host in enumerate(hosts):
+        try:
+            resolved = {info[4][0] for info in socket.getaddrinfo(host, None)}
+        except OSError:
+            continue
+        if resolved & mine:
             return i
     Log.Fatal(
         "none of this host's addresses (%s) appear in the machine file", mine
@@ -167,7 +195,7 @@ def initialize_from_machine_file(
     CHECK(len(endpoints) > 0, f"machine file {path} lists no hosts")
     pid = _infer_process_id(endpoints) if process_id is None else process_id
     initialize(
-        coordinator_address=endpoints[0],
+        coordinator_address=_strip_scheme(endpoints[0]),
         num_processes=len(endpoints),
         process_id=pid,
     )
@@ -180,17 +208,23 @@ def initialize_from_flags() -> None:
     else single-process no-op."""
     coordinator = GetFlag("coordinator")
     machine_file = GetFlag("machine_file")
+    pid = GetFlag("process_id")
     if coordinator:
-        pid = GetFlag("process_id")
         initialize(
-            coordinator_address=coordinator,
+            coordinator_address=_strip_scheme(coordinator),
             num_processes=GetFlag("num_processes") or None,
             process_id=None if pid < 0 else pid,
         )
     elif machine_file:
-        pid = GetFlag("process_id")
         initialize_from_machine_file(
             machine_file, GetFlag("port"), None if pid < 0 else pid
+        )
+    elif GetFlag("num_processes") > 1 or pid >= 0:
+        # -num_processes/-process_id without a coordinator source would
+        # silently train N independent single-process clusters.
+        Log.Fatal(
+            "-num_processes/-process_id set but no -coordinator or "
+            "-machine_file given; cannot rendezvous"
         )
 
 
@@ -224,7 +258,11 @@ def net_connect(ranks: Sequence[int], endpoints: Sequence[str]) -> None:
     # jax process ids are dense [0, n); the reference allows arbitrary rank
     # labels, so map the bound rank to its position in sorted order.
     pid = sorted(ranks).index(_bound[0])
-    initialize(coordinator_address=eps[0], num_processes=len(eps), process_id=pid)
+    initialize(
+        coordinator_address=_strip_scheme(eps[0]),
+        num_processes=len(eps),
+        process_id=pid,
+    )
 
 
 def build_multihost_mesh(
@@ -257,13 +295,12 @@ def build_multihost_mesh(
             per_proc,
             per_proc,
         )
-    # jax.devices() orders by process then local id, so reshaping
-    # (workers, shards) with shards as the fastest-varying dim keeps each
-    # shard group within one process whenever num_shards <= per_proc.
-    if num_shards <= 1:
-        return Mesh(np.asarray(devices), (mesh_lib.WORKER_AXIS,))
-    grid = np.asarray(devices).reshape(n // num_shards, num_shards)
-    return Mesh(grid, (mesh_lib.WORKER_AXIS, mesh_lib.SHARD_AXIS))
+    # jax.devices() orders by process then local id, so build_mesh's
+    # (workers, shards) reshape with shards fastest-varying keeps each shard
+    # group within one process whenever num_shards divides per_proc.
+    return mesh_lib.build_mesh(
+        devices=devices, num_shards=num_shards if num_shards > 1 else None
+    )
 
 
 def host_local_to_global(mesh: Mesh, spec: P, host_local: np.ndarray) -> jax.Array:

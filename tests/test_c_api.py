@@ -43,6 +43,15 @@ def test_topology(capi):
     capi.MV_Barrier()
 
 
+def test_net_bind_connect(capi):
+    """CLR-wrapper parity: explicit cluster wiring through the C ABI
+    (single-entry connect degenerates to a no-op rendezvous)."""
+    capi.MV_NetBind(0, b"tcp://127.0.0.1:5555")
+    ranks = (ctypes.c_int * 1)(0)
+    eps = (ctypes.c_char_p * 1)(b"tcp://127.0.0.1:5555")
+    capi.MV_NetConnect(ranks, eps, 1)
+
+
 def test_array_table_roundtrip(capi):
     h = ctypes.c_void_p()
     capi.MV_NewArrayTable(32, ctypes.byref(h))
