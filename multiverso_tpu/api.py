@@ -1,8 +1,11 @@
 """Public ``MV_*`` API surface.
 
 Parity with the reference public API (ref: include/multiverso/multiverso.h:9-65,
-src/multiverso.cpp:11-78). ``MV_NetBind`` / ``MV_NetConnect`` have no TPU
-equivalent (XLA owns the fabric; ref: multiverso.h:47-65) and raise.
+src/multiverso.cpp:11-78). ``MV_NetBind`` / ``MV_NetConnect`` (ref:
+multiverso.h:47-65, the ZMQ explicit-endpoint path) configure the multi-host
+rendezvous: call both before ``MV_Init`` and they seed
+``jax.distributed.initialize`` coordination instead of opening sockets
+directly (XLA owns the fabric).
 """
 
 from __future__ import annotations

@@ -269,7 +269,7 @@ def allgather(per_worker: Any, mesh: Optional[Mesh] = None) -> np.ndarray:
     CHECK(arr.shape[0] == nw, f"leading dim {arr.shape[0]} != num_workers {nw}")
 
     def body(x):
-        return allgather_local(x, mesh_lib.WORKER_AXIS, native=False)[None]
+        return allgather_local(x, mesh_lib.WORKER_AXIS, native=True)[None]
 
     out = _shard_map_worker(mesh, body)(arr)
     # every worker's slice now holds the full gather; slice 0 is the answer

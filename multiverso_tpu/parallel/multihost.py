@@ -129,6 +129,14 @@ def parse_machine_file(path: str, default_port: int) -> List[str]:
 def _infer_process_id(endpoints: Sequence[str]) -> int:
     mine = set(local_ips())
     hosts = [_strip_scheme(ep).rsplit(":", 1)[0] for ep in endpoints]
+    if len(set(hosts)) != len(hosts):
+        # Multiple processes per host can't be told apart by address — every
+        # one would infer the first matching index and rendezvous as rank 0.
+        Log.Fatal(
+            "machine file lists a host more than once (multi-process-per-host); "
+            "process rank cannot be inferred from addresses — pass an explicit "
+            "-process_id per process"
+        )
     for i, host in enumerate(hosts):
         if host in mine:
             return i

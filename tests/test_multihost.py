@@ -43,6 +43,16 @@ def test_infer_process_id_absent_fatal(tmp_path):
         multihost._infer_process_id(eps)
 
 
+def test_infer_process_id_duplicate_host_fatal(tmp_path):
+    """Two processes on one host (distinct ports) can't be told apart by
+    address — both would claim rank 0; must fail fast, not silently."""
+    f = tmp_path / "machines"
+    f.write_text("127.0.0.1:5555\n127.0.0.1:5556\n")
+    eps = multihost.parse_machine_file(str(f), 5555)
+    with pytest.raises(FatalError, match="process_id"):
+        multihost._infer_process_id(eps)
+
+
 def test_initialize_single_process_noop():
     multihost.initialize()  # no coordinator, no N: must not raise
     multihost.initialize(coordinator_address="127.0.0.1:5555", num_processes=1)
