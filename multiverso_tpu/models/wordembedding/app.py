@@ -40,6 +40,8 @@ from multiverso_tpu.models.wordembedding.skipgram import (
     SkipGramConfig,
     init_adagrad_slots,
     init_params,
+    make_sorted_superbatch_step,
+    make_sorted_train_step,
     make_superbatch_step,
     make_train_step,
 )
@@ -77,12 +79,16 @@ MV_DEFINE_int("max_preload_data_size", 2, "prefetched batches (pipeline depth)")
 MV_DEFINE_bool("is_pipeline", True, "overlap batch generation with compute")
 MV_DEFINE_string("output_file", "embeddings.txt", "embedding output path")
 MV_DEFINE_int("batch_size", 4096, "pairs per training step (TPU batch)")
-MV_DEFINE_int("steps_per_call", 32, "microbatches scanned per device dispatch")
+MV_DEFINE_int("steps_per_call", 64, "microbatches scanned per device dispatch")
 MV_DEFINE_string(
     "scale_mode", "row_mean",
     "batched-update scaling: row_mean (safe) | raw (fast; see skipgram.py)",
 )
 MV_DEFINE_bool("use_ps", False, "train through parameter-server tables")
+MV_DEFINE_bool(
+    "presort", True,
+    "host-presorted scatter ids (sorted-scatter device step; ~1.7x on TPU)",
+)
 
 
 @dataclasses.dataclass
@@ -108,9 +114,10 @@ class WEOptions:
     is_pipeline: bool = True
     output_file: str = "embeddings.txt"
     batch_size: int = 4096
-    steps_per_call: int = 32
+    steps_per_call: int = 64
     scale_mode: str = "row_mean"
     use_ps: bool = False
+    presort: bool = True
     seed: int = 1
 
     @classmethod
@@ -159,26 +166,42 @@ class WordEmbedding:
             self.params["emb_out"] = jnp.zeros((out_rows, options.size), jnp.float32)
         if options.use_adagrad:
             self.params.update(init_adagrad_slots(self.cfg, out_rows))
-        self._step = jax.jit(
-            make_train_step(
-                self.cfg,
-                hs=options.hs,
-                use_adagrad=options.use_adagrad,
-                scale_mode=options.scale_mode,
-            ),
-            donate_argnums=(0,),
-        )
-        # superbatch: scan over steps_per_call microbatches in one dispatch
-        # (dispatch latency amortization — see make_superbatch_step)
-        self._superstep = jax.jit(
-            make_superbatch_step(
-                self.cfg,
-                hs=options.hs,
-                use_adagrad=options.use_adagrad,
-                scale_mode=options.scale_mode,
-            ),
-            donate_argnums=(0,),
-        )
+        if options.presort:
+            # sorted-scatter path: scale_mode is baked into the host-side
+            # presort arrays, the device step is scale-mode agnostic
+            self._step = jax.jit(
+                make_sorted_train_step(
+                    self.cfg, hs=options.hs, use_adagrad=options.use_adagrad
+                ),
+                donate_argnums=(0,),
+            )
+            self._superstep = jax.jit(
+                make_sorted_superbatch_step(
+                    self.cfg, hs=options.hs, use_adagrad=options.use_adagrad
+                ),
+                donate_argnums=(0,),
+            )
+        else:
+            self._step = jax.jit(
+                make_train_step(
+                    self.cfg,
+                    hs=options.hs,
+                    use_adagrad=options.use_adagrad,
+                    scale_mode=options.scale_mode,
+                ),
+                donate_argnums=(0,),
+            )
+            # superbatch: scan over steps_per_call microbatches in one dispatch
+            # (dispatch latency amortization — see make_superbatch_step)
+            self._superstep = jax.jit(
+                make_superbatch_step(
+                    self.cfg,
+                    hs=options.hs,
+                    use_adagrad=options.use_adagrad,
+                    scale_mode=options.scale_mode,
+                ),
+                donate_argnums=(0,),
+            )
         self.words_trained = 0
 
     # ------------------------------------------------------------- training
@@ -194,6 +217,14 @@ class WordEmbedding:
         not force it per step (a host sync per step serialises the pipeline
         on the device-dispatch round trip)."""
         o = self.opt
+        if o.presort:
+            dev = {
+                k: jnp.asarray(v)
+                for k, v in batch.items()
+                if v is not None
+            }
+            self.params, loss = self._step(self.params, dev, jnp.float32(lr))
+            return loss
         ctx = None if batch.get("contexts") is None else jnp.asarray(batch["contexts"])
         if o.hs:
             self.params, loss = self._step(
@@ -219,6 +250,12 @@ class WordEmbedding:
         """One scanned dispatch over a list of identically-shaped batches."""
         o = self.opt
         stack = lambda key: jnp.asarray(np.stack([b[key] for b in batches]))
+        if o.presort:
+            dev = {
+                k: stack(k) for k, v in batches[0].items() if v is not None
+            }
+            self.params, loss = self._superstep(self.params, dev, jnp.float32(lr))
+            return loss
         ctx = (
             None
             if batches[0].get("contexts") is None
@@ -257,6 +294,8 @@ class WordEmbedding:
             sampler=self.sampler,
             huffman=self.huffman,
             seed=o.seed,
+            presort=o.presort,
+            scale_mode=o.scale_mode,
         )
         # E[pairs per word] = 2*E[effective window] = window + 1 (uniform shrink)
         total_pairs_est = max(len(ids) * (o.window + 1) * o.epoch, 1)

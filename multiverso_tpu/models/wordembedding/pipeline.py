@@ -37,6 +37,8 @@ class BatchPipeline:
         sampler: Optional[AliasSampler] = None,
         huffman: Optional[HuffmanEncoder] = None,
         seed: int = 1,
+        presort: bool = False,
+        scale_mode: str = "row_mean",
     ):
         CHECK(
             (sampler is None) != (huffman is None),
@@ -51,6 +53,8 @@ class BatchPipeline:
         self.sampler = sampler
         self.huffman = huffman
         self.seed = seed
+        self.presort = bool(presort)
+        self.scale_mode = scale_mode
         self._rng = np.random.RandomState(seed)
 
     def batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
@@ -130,6 +134,17 @@ class BatchPipeline:
             batch["outputs"] = np.concatenate([targets[:, None], negs], axis=1)
             if self.cbow:
                 batch["centers"] = targets
+        if self.presort:
+            # host-side sort metadata for the sorted-scatter device step —
+            # runs on the producer thread, overlapped with device compute
+            from multiverso_tpu.models.wordembedding.skipgram import presort_batch
+
+            batch = presort_batch(
+                batch,
+                hs=self.huffman is not None,
+                cbow=self.cbow,
+                scale_mode=self.scale_mode,
+            )
         return batch
 
 
