@@ -69,7 +69,11 @@ MV_DEFINE_int("window", 5, "context window")
 MV_DEFINE_double("sample", 1e-3, "subsampling threshold (0 = off)")
 MV_DEFINE_bool("hs", False, "hierarchical softmax instead of NS")
 MV_DEFINE_int("negative", 5, "negative samples per positive")
-MV_DEFINE_int("threads", 1, "host threads (reference parity; pipeline uses 1)")
+MV_DEFINE_int(
+    "threads", 1,
+    "parallel batch-producer threads (corpus is sharded per thread, "
+    "ref: trainer.cpp per-thread strided blocks)",
+)
 MV_DEFINE_int("min_count", 5, "drop words rarer than this")
 MV_DEFINE_bool("stopwords", False, "filter stopwords")
 MV_DEFINE_string("sw_file", "", "stopword list file")
@@ -105,6 +109,7 @@ class WEOptions:
     sample: float = 1e-3
     hs: bool = False
     negative: int = 5
+    threads: int = 1
     min_count: int = 5
     stopwords: bool = False
     sw_file: str = ""
@@ -284,19 +289,31 @@ class WordEmbedding:
             ids = self.dict.encode_corpus(o.train_file.split(";"))
         ids = np.ascontiguousarray(ids, np.int32)
         keep = subsample_keep_probs(self.dict.counts, o.sample)
-        pipeline = BatchPipeline(
-            ids,
-            window=o.window,
-            batch_size=o.batch_size,
-            negatives=o.negative,
-            cbow=o.cbow,
-            keep_probs=keep,
-            sampler=self.sampler,
-            huffman=self.huffman,
-            seed=o.seed,
-            presort=o.presort,
-            scale_mode=o.scale_mode,
-        )
+        def make_pipeline(shard_ids, seed):
+            return BatchPipeline(
+                shard_ids,
+                window=o.window,
+                batch_size=o.batch_size,
+                negatives=o.negative,
+                cbow=o.cbow,
+                keep_probs=keep,
+                sampler=self.sampler,
+                huffman=self.huffman,
+                seed=seed,
+                presort=o.presort,
+                scale_mode=o.scale_mode,
+            )
+
+        nthreads = max(1, int(getattr(o, "threads", 1)))
+        if nthreads > 1 and o.is_pipeline and len(ids) > nthreads * o.batch_size:
+            # per-thread corpus shards (ref: trainer.cpp:27-54 strided blocks)
+            bounds = np.linspace(0, len(ids), nthreads + 1).astype(np.int64)
+            pipeline = [
+                make_pipeline(ids[bounds[i]: bounds[i + 1]], o.seed + i)
+                for i in range(nthreads)
+            ]
+        else:
+            pipeline = make_pipeline(ids, o.seed)
         # E[pairs per word] = 2*E[effective window] = window + 1 (uniform shrink)
         total_pairs_est = max(len(ids) * (o.window + 1) * o.epoch, 1)
         start = time.perf_counter()

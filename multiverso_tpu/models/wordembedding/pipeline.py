@@ -112,6 +112,23 @@ class BatchPipeline:
 
     def _finalize(self, centers: np.ndarray, others: np.ndarray) -> Dict[str, np.ndarray]:
         """Attach negatives (NS) or Huffman paths (HS)."""
+        if self.presort and not self.cbow and self.huffman is None:
+            # fused native path: negatives + outputs + both presorts in one
+            # call (the single-core host hot path)
+            from multiverso_tpu.native import ns_finalize
+
+            res = ns_finalize(
+                centers,
+                others,
+                self.negatives,
+                self.sampler._prob_np,
+                self.sampler._alias_np,
+                seed=int(self._rng.randint(1, 1 << 62)),
+                raw_mode=self.scale_mode == "raw",
+            )
+            if res is not None:
+                res["centers"] = centers
+                return res
         batch: Dict[str, np.ndarray] = {}
         if self.cbow:
             batch["contexts"] = others  # (B, 2w), -1 padded
@@ -153,17 +170,23 @@ class PrefetchPipeline:
 
     The reference's BlockQueue + preload cap (ref:
     Applications/WordEmbedding/src/block_queue.cpp,
-    distributed_wordembedding.cpp:33-56): a producer thread generates batches
-    — the pair generation is native C++ with the GIL released — while the
-    consumer feeds the device. Handoff rides the native ``MtQueue``
-    (runtime.cpp); ``depth`` bounds in-flight batches like
-    ``-max_preload_data_size``.
+    distributed_wordembedding.cpp:33-56): producer threads generate batches
+    — the pair generation, negative sampling and presort are native C++ with
+    the GIL released — while the consumer feeds the device. Handoff rides
+    the native ``MtQueue`` (runtime.cpp); ``depth`` bounds in-flight batches
+    like ``-max_preload_data_size``.
+
+    Pass a list of pipelines (one per corpus shard) for parallel producers —
+    the reference's per-thread strided block iteration (ref:
+    Applications/WordEmbedding/src/trainer.cpp:27-54); batch order then
+    interleaves across shards (word2vec training is order-agnostic).
     """
 
-    def __init__(self, pipeline: BatchPipeline, depth: int = 4):
+    def __init__(self, pipeline, depth: int = 4):
         CHECK(depth >= 1, "prefetch depth must be >= 1")
-        self._pl = pipeline
-        self._depth = int(depth)
+        self._pls = list(pipeline) if isinstance(pipeline, (list, tuple)) else [pipeline]
+        CHECK(len(self._pls) >= 1, "need at least one pipeline")
+        self._depth = max(int(depth), len(self._pls))
 
     def batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         from multiverso_tpu.native.host_runtime import MtQueue
@@ -171,13 +194,15 @@ class PrefetchPipeline:
         ready: MtQueue = MtQueue()
         free: MtQueue = MtQueue()
         slots: list = [None] * self._depth
-        error: list = []  # producer exception, re-raised in the consumer
+        error: list = []  # producer exceptions, re-raised in the consumer
+        live = [len(self._pls)]
+        live_lock = threading.Lock()
         for i in range(self._depth):
             free.push(i)
 
-        def produce():
+        def produce(pl):
             try:
-                for batch in self._pl.batches(epoch):
+                for batch in pl.batches(epoch):
                     ticket = free.pop()
                     if ticket is None:  # consumer gone
                         return
@@ -187,12 +212,24 @@ class PrefetchPipeline:
             except BaseException as e:  # propagate, never truncate silently
                 error.append(e)
             finally:
-                ready.exit()
+                with live_lock:
+                    live[0] -= 1
+                    last = live[0] == 0
+                if last:
+                    ready.exit()
 
-        th = threading.Thread(target=produce, daemon=True, name="mv-prefetch")
-        th.start()
+        threads = [
+            threading.Thread(
+                target=produce, args=(pl,), daemon=True, name=f"mv-prefetch-{i}"
+            )
+            for i, pl in enumerate(self._pls)
+        ]
+        for th in threads:
+            th.start()
         try:
             while True:
+                if error:  # fail fast, not after the surviving shards drain
+                    raise error[0]
                 ticket = ready.pop()
                 if ticket is None:
                     break
@@ -205,4 +242,5 @@ class PrefetchPipeline:
         finally:
             free.exit()
             ready.exit()
-            th.join(timeout=10)
+            for th in threads:
+                th.join(timeout=10)

@@ -61,6 +61,8 @@ class AliasSampler:
         probs = (weights / weights.sum()).astype(np.float32)
         prob, alias = _build_alias(probs)
         self.vocab_size = len(counts)
+        self._prob_np = prob
+        self._alias_np = alias
         self._prob = jnp.asarray(prob)
         self._alias = jnp.asarray(alias)
 
@@ -77,9 +79,20 @@ class AliasSampler:
         return self._sample(key, tuple(shape))
 
     def sample_np(self, rng: np.random.RandomState, shape) -> np.ndarray:
-        """Host-side variant for the data pipeline."""
-        prob = np.asarray(self._prob)
-        alias = np.asarray(self._alias)
+        """Host-side variant for the data pipeline (native alias draws when
+        available; numpy over the cached host tables otherwise — a device
+        read-back per batch would serialise the pipeline on the
+        device-transfer round trip)."""
+        from multiverso_tpu.native import alias_sample
+
+        n = int(np.prod(shape))
+        out = alias_sample(
+            self._prob_np, self._alias_np, n, int(rng.randint(1, 1 << 62))
+        )
+        if out is not None:
+            return out.reshape(shape)
         idx = rng.randint(0, self.vocab_size, size=shape)
         u = rng.random_sample(shape)
-        return np.where(u < prob[idx], idx, alias[idx]).astype(np.int32)
+        return np.where(
+            u < self._prob_np[idx], idx, self._alias_np[idx]
+        ).astype(np.int32)

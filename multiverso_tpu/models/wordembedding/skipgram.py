@@ -391,6 +391,15 @@ def presort_updates(
     """
     assert scale_mode in ("row_mean", "raw"), scale_mode
     ids_flat = np.asarray(ids_flat).reshape(-1)
+    from multiverso_tpu.native import presort as native_presort
+
+    res = native_presort(
+        ids_flat,
+        None if weights is None else np.asarray(weights),
+        raw_mode=scale_mode == "raw",
+    )
+    if res is not None:
+        return res
     perm = np.argsort(ids_flat, kind="stable").astype(np.int32)
     sorted_ids = ids_flat[perm].astype(np.int32)
     if weights is None:

@@ -17,7 +17,15 @@ import numpy as np
 
 from multiverso_tpu.utils.log import Log
 
-__all__ = ["pairgen_lib", "skipgram_pairs", "cbow_batch", "have_native"]
+__all__ = [
+    "pairgen_lib",
+    "skipgram_pairs",
+    "cbow_batch",
+    "presort",
+    "ns_finalize",
+    "alias_sample",
+    "have_native",
+]
 
 _THIS_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB: Optional[ctypes.CDLL] = None
@@ -94,6 +102,17 @@ def pairgen_lib() -> Optional[ctypes.CDLL]:
             lib.we_cbow_batch.argtypes = [
                 I32P, LL, LL, ctypes.c_int, ctypes.c_void_p, U64,
                 I32P, I32P, LL, ctypes.POINTER(LL),
+            ]
+            lib.we_presort.restype = LL
+            lib.we_presort.argtypes = [
+                I32P, ctypes.c_void_p, LL, ctypes.c_int, I32P, I32P, F32P,
+            ]
+            lib.we_alias_sample.restype = LL
+            lib.we_alias_sample.argtypes = [F32P, I32P, LL, LL, U64, I32P]
+            lib.we_ns_finalize.restype = LL
+            lib.we_ns_finalize.argtypes = [
+                I32P, I32P, LL, ctypes.c_int, F32P, I32P, LL, U64,
+                ctypes.c_int, I32P, I32P, I32P, F32P, I32P, I32P, F32P,
             ]
             _LIB = lib
     return _LIB
@@ -255,3 +274,88 @@ def cbow_batch(
         return targets[:n], ctx[:n], next_pos.value
     n, pos = _py_cbow(ids, len(ids), start, window, keep, seed, targets, ctx, cap)
     return targets[:n], ctx[:n], pos
+
+
+def presort(
+    ids_flat: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+    raw_mode: bool = False,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Native stable counting-sort metadata (perm, sorted_ids, scale) for the
+    sorted-scatter step — O(N+V) vs numpy argsort's O(N log N). Returns None
+    when the native library is unavailable or ids contain negatives (callers
+    fall back to the numpy path in skipgram.presort_updates)."""
+    lib = pairgen_lib()
+    if lib is None:
+        return None
+    ids_flat = np.ascontiguousarray(ids_flat.reshape(-1), np.int32)
+    n = len(ids_flat)
+    if weights is not None:
+        weights = np.ascontiguousarray(weights.reshape(-1), np.float32)
+        wptr = weights.ctypes.data_as(ctypes.c_void_p)
+    else:
+        wptr = None
+    perm = np.empty(n, np.int32)
+    sorted_ids = np.empty(n, np.int32)
+    scale = np.empty(n, np.float32)
+    rc = lib.we_presort(ids_flat, wptr, n, int(raw_mode), perm, sorted_ids, scale)
+    if rc != 0:
+        return None
+    return perm, sorted_ids, scale
+
+
+def ns_finalize(
+    centers: np.ndarray,
+    targets: np.ndarray,
+    negatives: int,
+    prob: np.ndarray,
+    alias: np.ndarray,
+    seed: int,
+    raw_mode: bool = False,
+) -> Optional[dict]:
+    """One-call NS batch finalize: outputs [target|negs] + presort metadata
+    for both embedding tables (input rows = centers, output rows = outputs).
+    Returns the batch-dict fields, or None when the native library is
+    unavailable."""
+    lib = pairgen_lib()
+    if lib is None:
+        return None
+    centers = np.ascontiguousarray(centers, np.int32)
+    targets = np.ascontiguousarray(targets, np.int32)
+    prob = np.ascontiguousarray(prob, np.float32)
+    alias = np.ascontiguousarray(alias, np.int32)
+    b = len(targets)
+    k1 = 1 + negatives
+    outputs = np.empty((b, k1), np.int32)
+    in_perm = np.empty(b, np.int32)
+    in_sort = np.empty(b, np.int32)
+    in_scale = np.empty(b, np.float32)
+    out_perm = np.empty(b * k1, np.int32)
+    out_sort = np.empty(b * k1, np.int32)
+    out_scale = np.empty(b * k1, np.float32)
+    rc = lib.we_ns_finalize(
+        centers, targets, b, negatives, prob, alias, len(prob), seed or 1,
+        int(raw_mode), outputs.reshape(-1), in_perm, in_sort, in_scale,
+        out_perm, out_sort, out_scale,
+    )
+    if rc != 0:
+        return None
+    return {
+        "outputs": outputs,
+        "in_perm": in_perm, "in_sort": in_sort, "in_scale": in_scale,
+        "out_perm": out_perm, "out_sort": out_sort, "out_scale": out_scale,
+    }
+
+
+def alias_sample(
+    prob: np.ndarray, alias: np.ndarray, n: int, seed: int
+) -> Optional[np.ndarray]:
+    """Native alias-method draws (vocab = len(prob)); None without the lib."""
+    lib = pairgen_lib()
+    if lib is None:
+        return None
+    prob = np.ascontiguousarray(prob, np.float32)
+    alias = np.ascontiguousarray(alias, np.int32)
+    out = np.empty(n, np.int32)
+    lib.we_alias_sample(prob, alias, len(prob), n, seed or 1, out)
+    return out

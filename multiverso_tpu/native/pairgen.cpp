@@ -17,7 +17,9 @@
 // id stream: int32, -1 marks sentence boundaries. RNG: xorshift64 (seeded
 // per call) so a (seed, start) pair reproduces a batch exactly.
 
+#include <cstddef>
 #include <cstdint>
+#include <vector>
 
 namespace {
 
@@ -111,6 +113,92 @@ long long we_cbow_batch(const int32_t* ids, long long n, long long start,
   }
   *next_pos = pos;
   return out;
+}
+
+// Alias-method negative sampling (unigram^0.75 tables built in Python —
+// sampler._build_alias): out[i] = idx if u < prob[idx] else alias[idx].
+// Replaces the numpy sample_np hot loop in the batch producer.
+long long we_alias_sample(const float* prob, const int32_t* alias,
+                          long long vocab, long long n, uint64_t seed,
+                          int32_t* out) {
+  uint64_t rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  for (long long i = 0; i < n; ++i) {
+    const int32_t idx = static_cast<int32_t>(xorshift64(&rng) % vocab);
+    out[i] = (uniform01(&rng) < prob[idx]) ? idx : alias[idx];
+  }
+  return n;
+}
+
+// Sort metadata for the sorted-scatter device step (skipgram.presort_updates
+// semantics): stable counting sort over row ids — O(N + V) vs numpy's
+// O(N log N) argsort — plus weighted per-row counts for row-mean scaling.
+// scale[j] (sorted order) = w/1 (raw_mode) or w / weighted_count(row).
+// Returns 0, or -1 if any id is negative.
+long long we_presort(const int32_t* ids, const float* weights, long long n,
+                     int raw_mode, int32_t* perm_out, int32_t* sorted_out,
+                     float* scale_out) {
+  int32_t max_id = 0;
+  for (long long j = 0; j < n; ++j) {
+    if (ids[j] < 0) return -1;
+    if (ids[j] > max_id) max_id = ids[j];
+  }
+  // counting sort is O(N + V); when the id range dwarfs the batch (huge
+  // vocab, small batch) it loses to the caller's O(N log N) numpy fallback
+  // and would pin V-sized thread_local buffers — decline instead
+  if (static_cast<long long>(max_id) > 32 * n) return -1;
+  static thread_local std::vector<long long> offsets;
+  static thread_local std::vector<double> wcnt;
+  offsets.assign(static_cast<size_t>(max_id) + 2, 0);
+  for (long long j = 0; j < n; ++j) offsets[ids[j] + 1]++;
+  for (long long v = 1; v <= max_id + 1; ++v) offsets[v] += offsets[v - 1];
+  if (!raw_mode) {
+    wcnt.assign(static_cast<size_t>(max_id) + 1, 0.0);
+    for (long long j = 0; j < n; ++j)
+      wcnt[ids[j]] += weights ? weights[j] : 1.0;
+  }
+  for (long long j = 0; j < n; ++j) {
+    const int32_t id = ids[j];
+    const long long pos = offsets[id]++;
+    perm_out[pos] = static_cast<int32_t>(j);
+    sorted_out[pos] = id;
+    const double w = weights ? weights[j] : 1.0;
+    if (raw_mode) {
+      scale_out[pos] = static_cast<float>(w);
+    } else {
+      const double c = wcnt[id];
+      scale_out[pos] = static_cast<float>(w / (c > 1.0 ? c : 1.0));
+    }
+  }
+  return 0;
+}
+
+// Whole-batch NS finalize in one call (the single-core host hot path):
+// negatives via alias draws, outputs assembly [target | negs], and presort
+// metadata for both tables. Equivalent to sampler.sample_np + concatenate +
+// 2x we_presort, without the per-step Python/ctypes round trips.
+long long we_ns_finalize(const int32_t* centers, const int32_t* targets,
+                         long long b, int negatives, const float* prob,
+                         const int32_t* alias, long long vocab, uint64_t seed,
+                         int raw_mode,
+                         int32_t* outputs,  // (b * (1+negatives))
+                         int32_t* in_perm, int32_t* in_sort, float* in_scale,
+                         int32_t* out_perm, int32_t* out_sort,
+                         float* out_scale) {
+  const int k1 = 1 + negatives;
+  uint64_t rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  for (long long i = 0; i < b; ++i) {
+    int32_t* row = outputs + i * k1;
+    row[0] = targets[i];
+    for (int k = 1; k < k1; ++k) {
+      const int32_t idx = static_cast<int32_t>(xorshift64(&rng) % vocab);
+      row[k] = (uniform01(&rng) < prob[idx]) ? idx : alias[idx];
+    }
+  }
+  // input table rows = the center words; output table rows = target+negs
+  if (we_presort(centers, nullptr, b, raw_mode, in_perm, in_sort, in_scale) != 0)
+    return -1;
+  return we_presort(outputs, nullptr, b * k1, raw_mode, out_perm, out_sort,
+                    out_scale);
 }
 
 }  // extern "C"
