@@ -1,0 +1,68 @@
+"""Parameterized table perf harness — the reference's perf smoke
+(ref: Test/test_matrix_perf.cpp:32-80: a num_row x num_col matrix table swept
+with Get-whole-table / Add-to-p%-of-rows / Get-row-subset phases, worker-id
+stamped AddOptions, wall-clock per phase). Not part of CI; run manually:
+
+    python benchmarks/table_perf.py [-rows=1000000] [-cols=50] [-iters=10]
+
+Prints one JSON line per phase: {"phase": ..., "ms_per_op": ..., "GB_s": ...}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import multiverso_tpu as mv  # noqa: E402
+from multiverso_tpu.tables import MatrixTableOption  # noqa: E402
+from multiverso_tpu.updaters import AddOption  # noqa: E402
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_int  # noqa: E402
+
+MV_DEFINE_int("rows", 1_000_000, "table rows")
+MV_DEFINE_int("cols", 50, "table cols")
+MV_DEFINE_int("iters", 10, "timed iterations per phase")
+MV_DEFINE_int("percent", 10, "percent of rows touched by row ops")
+
+
+def timed(fn, iters):
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    mv.MV_Init(sys.argv)
+    rows, cols = GetFlag("rows"), GetFlag("cols")
+    iters, pct = GetFlag("iters"), GetFlag("percent")
+    table = mv.MV_CreateTable(MatrixTableOption(num_row=rows, num_col=cols))
+    rng = np.random.RandomState(0)
+    n_touch = max(1, rows * pct // 100)
+    ids = np.unique(rng.randint(0, rows, size=n_touch)).astype(np.int32)
+    deltas = rng.randn(len(ids), cols).astype(np.float32)
+    opt = AddOption()
+    opt.worker_id = mv.MV_WorkerId()
+    table_bytes = rows * cols * 4
+    row_bytes = len(ids) * cols * 4
+
+    phases = [
+        ("get_whole_table", lambda: table.get(), table_bytes),
+        ("add_rows_%d%%" % pct, lambda: table.add_rows(ids, deltas, opt), row_bytes),
+        ("get_rows_%d%%" % pct, lambda: table.get_rows(ids), row_bytes),
+    ]
+    for name, fn, nbytes in phases:
+        ms = timed(fn, iters)
+        print(json.dumps({
+            "phase": name,
+            "ms_per_op": round(ms, 3),
+            "GB_s": round(nbytes / (ms / 1e3) / 1e9, 2),
+        }))
+    mv.MV_ShutDown()
+
+
+if __name__ == "__main__":
+    main()

@@ -186,7 +186,9 @@ class PrefetchPipeline:
         CHECK(depth >= 1, "prefetch depth must be >= 1")
         self._pls = list(pipeline) if isinstance(pipeline, (list, tuple)) else [pipeline]
         CHECK(len(self._pls) >= 1, "need at least one pipeline")
-        self._depth = max(int(depth), len(self._pls))
+        # depth is the user's in-flight-batch memory cap; producers beyond
+        # it simply block in free.pop() until tickets recycle
+        self._depth = int(depth)
 
     def batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         from multiverso_tpu.native.host_runtime import MtQueue

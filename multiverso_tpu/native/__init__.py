@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -30,6 +31,7 @@ __all__ = [
 _THIS_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+_BUILD_LOCK = threading.Lock()
 
 
 def build_native_lib(
@@ -82,7 +84,11 @@ def _build() -> Optional[str]:
 
 def pairgen_lib() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
-    if _LIB is None and not _TRIED:
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _BUILD_LOCK:  # parallel producers race the first lazy build
+        if _LIB is not None or _TRIED:
+            return _LIB
         _TRIED = True
         path = _build()
         if path:
@@ -320,6 +326,8 @@ def ns_finalize(
     lib = pairgen_lib()
     if lib is None:
         return None
+    if len(prob) > 32 * len(targets):
+        return None  # counting-sort decline threshold; skip the allocations
     centers = np.ascontiguousarray(centers, np.int32)
     targets = np.ascontiguousarray(targets, np.int32)
     prob = np.ascontiguousarray(prob, np.float32)

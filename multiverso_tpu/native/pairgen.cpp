@@ -185,6 +185,13 @@ long long we_ns_finalize(const int32_t* centers, const int32_t* targets,
                          int32_t* out_perm, int32_t* out_sort,
                          float* out_scale) {
   const int k1 = 1 + negatives;
+  // the centers presort (n = b) is the tightest decline threshold and the
+  // negatives draw from the full vocab — check before doing any work so a
+  // declining call is ~free (the caller redoes everything in numpy)
+  if (vocab > 32 * b) return -1;
+  // input table rows = the center words; output table rows = target+negs
+  if (we_presort(centers, nullptr, b, raw_mode, in_perm, in_sort, in_scale) != 0)
+    return -1;
   uint64_t rng = seed ? seed : 0x9E3779B97F4A7C15ULL;
   for (long long i = 0; i < b; ++i) {
     int32_t* row = outputs + i * k1;
@@ -194,9 +201,6 @@ long long we_ns_finalize(const int32_t* centers, const int32_t* targets,
       row[k] = (uniform01(&rng) < prob[idx]) ? idx : alias[idx];
     }
   }
-  // input table rows = the center words; output table rows = target+negs
-  if (we_presort(centers, nullptr, b, raw_mode, in_perm, in_sort, in_scale) != 0)
-    return -1;
   return we_presort(outputs, nullptr, b * k1, raw_mode, out_perm, out_sort,
                     out_scale);
 }
