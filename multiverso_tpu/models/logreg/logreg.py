@@ -38,19 +38,30 @@ class LogReg:
         last_epoch_loss = 0.0
         for epoch in range(cfg.train_epoch):
             timer = Timer()
-            seen, since_log, losses = 0, 0, []
+            seen, since_log = 0, 0
+            # loss stays a device value between log points (forcing it per
+            # batch would serialise training on the dispatch round trip);
+            # accumulate sums and sync once per show_time_per_sample window
+            ep_sum, ep_n, win_sum, win_n = 0.0, 0, 0.0, 0
             for batch in self.reader.async_batches(batch_size=cfg.minibatch_size):
-                losses.append(self.model.train_batch(batch))
+                loss = self.model.train_batch(batch)
+                win_sum = win_sum + loss
+                win_n += 1
                 seen += len(batch["y"])
                 since_log += len(batch["y"])
                 if since_log >= cfg.show_time_per_sample:
                     rate = seen / max(timer.elapsed_s(), 1e-9)
+                    w = float(win_sum)  # the one device sync per log window
                     Log.Info(
                         "[LogReg] epoch %d: %d samples, %.0f samples/s, loss %.5f",
-                        epoch, seen, rate, float(np.mean(losses[-50:])),
+                        epoch, seen, rate, w / win_n,
                     )
+                    ep_sum, ep_n = ep_sum + w, ep_n + win_n
+                    win_sum, win_n = 0.0, 0
                     since_log = 0
-            last_epoch_loss = float(np.mean(losses)) if losses else 0.0
+            if win_n:
+                ep_sum, ep_n = ep_sum + float(win_sum), ep_n + win_n
+            last_epoch_loss = ep_sum / ep_n if ep_n else 0.0
             Log.Info(
                 "[LogReg] epoch %d done: %d samples in %.2fs, mean loss %.5f",
                 epoch, seen, timer.elapsed_s(), last_epoch_loss,

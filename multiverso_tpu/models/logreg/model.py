@@ -76,6 +76,11 @@ class LocalModel:
         self.schedule = _LrSchedule(config)
         self._step_dense = jax.jit(self._grad_dense)
         self._step_sparse = jax.jit(self._grad_sparse)
+        # fused SGD steps: weights donated, loss returned on device — the
+        # local training loop never syncs per batch (a host read-back per
+        # minibatch serialises everything on the dispatch round trip)
+        self._fused_dense = jax.jit(self._sgd_dense, donate_argnums=(0,))
+        self._fused_sparse = jax.jit(self._sgd_sparse, donate_argnums=(0,))
 
     # gradient programs (shared with PSModel)
     def _grad_dense(self, W, X, y):
@@ -83,6 +88,14 @@ class LocalModel:
 
     def _grad_sparse(self, W, idx, val, y):
         return self.objective.loss_grad(W, (idx, val), y)
+
+    def _sgd_dense(self, W, X, y, lr):
+        loss, grad = self._grad_dense(W, X, y)
+        return W - lr * grad, loss
+
+    def _sgd_sparse(self, W, idx, val, y, lr):
+        loss, grad = self._grad_sparse(W, idx, val, y)
+        return W - lr * grad, loss
 
     def _gradient(self, batch: Dict[str, Any]):
         if "X" in batch:
@@ -94,11 +107,23 @@ class LocalModel:
             jnp.asarray(batch["y"]),
         )
 
-    def train_batch(self, batch: Dict[str, Any]) -> float:
-        loss, grad = self._gradient(batch)
-        lr = self.schedule.next_lr()
-        self.W = self.W - lr * grad
-        return float(loss)
+    def train_batch(self, batch: Dict[str, Any]):
+        """One fused SGD step; returns the *device* loss scalar — callers
+        force it only at log points (ref: logreg.cpp's show_time cadence)."""
+        lr = jnp.float32(self.schedule.next_lr())
+        if "X" in batch:
+            self.W, loss = self._fused_dense(
+                self.W, jnp.asarray(batch["X"]), jnp.asarray(batch["y"]), lr
+            )
+        else:
+            self.W, loss = self._fused_sparse(
+                self.W,
+                jnp.asarray(batch["idx"]),
+                jnp.asarray(batch["val"]),
+                jnp.asarray(batch["y"]),
+                lr,
+            )
+        return loss
 
     def predict(self, batch: Dict[str, Any]) -> np.ndarray:
         X = batch["X"] if "X" in batch else (jnp.asarray(batch["idx"]), jnp.asarray(batch["val"]))
