@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "/root/repo/multiverso_tpu/native/_build/libmultiverso_c.pdb"
+  "/root/repo/multiverso_tpu/native/_build/libmultiverso_c.so"
+  "CMakeFiles/multiverso_c.dir/multiverso_tpu/capi/c_api.cpp.o"
+  "CMakeFiles/multiverso_c.dir/multiverso_tpu/capi/c_api.cpp.o.d"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/multiverso_c.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,305 @@
+CMakeFiles/multiverso_c.dir/multiverso_tpu/capi/c_api.cpp.o: \
+ /root/repo/multiverso_tpu/capi/c_api.cpp /usr/include/stdc-predef.h \
+ /usr/local/include/python3.12/Python.h \
+ /usr/local/include/python3.12/patchlevel.h \
+ /usr/local/include/python3.12/pyconfig.h \
+ /usr/local/include/python3.12/pymacconfig.h /usr/include/c++/12/stdlib.h \
+ /usr/include/c++/12/cstdlib \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/c++config.h \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/os_defines.h \
+ /usr/include/features.h /usr/include/features-time64.h \
+ /usr/include/x86_64-linux-gnu/bits/wordsize.h \
+ /usr/include/x86_64-linux-gnu/bits/timesize.h \
+ /usr/include/x86_64-linux-gnu/sys/cdefs.h \
+ /usr/include/x86_64-linux-gnu/bits/long-double.h \
+ /usr/include/x86_64-linux-gnu/gnu/stubs.h \
+ /usr/include/x86_64-linux-gnu/gnu/stubs-64.h \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/cpu_defines.h \
+ /usr/include/c++/12/pstl/pstl_config.h /usr/include/stdlib.h \
+ /usr/include/x86_64-linux-gnu/bits/libc-header-start.h \
+ /usr/lib/gcc/x86_64-linux-gnu/12/include/stddef.h \
+ /usr/include/x86_64-linux-gnu/bits/waitflags.h \
+ /usr/include/x86_64-linux-gnu/bits/waitstatus.h \
+ /usr/include/x86_64-linux-gnu/bits/floatn.h \
+ /usr/include/x86_64-linux-gnu/bits/floatn-common.h \
+ /usr/include/x86_64-linux-gnu/bits/types/locale_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/__locale_t.h \
+ /usr/include/x86_64-linux-gnu/sys/types.h \
+ /usr/include/x86_64-linux-gnu/bits/types.h \
+ /usr/include/x86_64-linux-gnu/bits/typesizes.h \
+ /usr/include/x86_64-linux-gnu/bits/time64.h \
+ /usr/include/x86_64-linux-gnu/bits/types/clock_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/clockid_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/time_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/timer_t.h \
+ /usr/include/x86_64-linux-gnu/bits/stdint-intn.h /usr/include/endian.h \
+ /usr/include/x86_64-linux-gnu/bits/endian.h \
+ /usr/include/x86_64-linux-gnu/bits/endianness.h \
+ /usr/include/x86_64-linux-gnu/bits/byteswap.h \
+ /usr/include/x86_64-linux-gnu/bits/uintn-identity.h \
+ /usr/include/x86_64-linux-gnu/sys/select.h \
+ /usr/include/x86_64-linux-gnu/bits/select.h \
+ /usr/include/x86_64-linux-gnu/bits/types/sigset_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/__sigset_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_timeval.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_timespec.h \
+ /usr/include/x86_64-linux-gnu/bits/pthreadtypes.h \
+ /usr/include/x86_64-linux-gnu/bits/thread-shared-types.h \
+ /usr/include/x86_64-linux-gnu/bits/pthreadtypes-arch.h \
+ /usr/include/x86_64-linux-gnu/bits/atomic_wide_counter.h \
+ /usr/include/x86_64-linux-gnu/bits/struct_mutex.h \
+ /usr/include/x86_64-linux-gnu/bits/struct_rwlock.h /usr/include/alloca.h \
+ /usr/include/x86_64-linux-gnu/bits/stdlib-bsearch.h \
+ /usr/include/x86_64-linux-gnu/bits/stdlib-float.h \
+ /usr/include/c++/12/bits/std_abs.h /usr/include/stdio.h \
+ /usr/lib/gcc/x86_64-linux-gnu/12/include/stdarg.h \
+ /usr/include/x86_64-linux-gnu/bits/types/__fpos_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/__mbstate_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/__fpos64_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/__FILE.h \
+ /usr/include/x86_64-linux-gnu/bits/types/FILE.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_FILE.h \
+ /usr/include/x86_64-linux-gnu/bits/types/cookie_io_functions_t.h \
+ /usr/include/x86_64-linux-gnu/bits/stdio_lim.h \
+ /usr/include/x86_64-linux-gnu/bits/stdio.h /usr/include/errno.h \
+ /usr/include/x86_64-linux-gnu/bits/errno.h /usr/include/linux/errno.h \
+ /usr/include/x86_64-linux-gnu/asm/errno.h \
+ /usr/include/asm-generic/errno.h /usr/include/asm-generic/errno-base.h \
+ /usr/include/x86_64-linux-gnu/bits/types/error_t.h /usr/include/string.h \
+ /usr/include/strings.h /usr/include/unistd.h \
+ /usr/include/x86_64-linux-gnu/bits/posix_opt.h \
+ /usr/include/x86_64-linux-gnu/bits/environments.h \
+ /usr/include/x86_64-linux-gnu/bits/confname.h \
+ /usr/include/x86_64-linux-gnu/bits/getopt_posix.h \
+ /usr/include/x86_64-linux-gnu/bits/getopt_core.h \
+ /usr/include/x86_64-linux-gnu/bits/unistd_ext.h \
+ /usr/include/linux/close_range.h /usr/include/assert.h \
+ /usr/include/wchar.h /usr/include/x86_64-linux-gnu/bits/wchar.h \
+ /usr/include/x86_64-linux-gnu/bits/types/wint_t.h \
+ /usr/include/x86_64-linux-gnu/bits/types/mbstate_t.h \
+ /usr/local/include/python3.12/pyport.h /usr/include/inttypes.h \
+ /usr/lib/gcc/x86_64-linux-gnu/12/include/stdint.h /usr/include/stdint.h \
+ /usr/include/x86_64-linux-gnu/bits/stdint-uintn.h \
+ /usr/lib/gcc/x86_64-linux-gnu/12/include/limits.h \
+ /usr/lib/gcc/x86_64-linux-gnu/12/include/syslimits.h \
+ /usr/include/limits.h /usr/include/x86_64-linux-gnu/bits/posix1_lim.h \
+ /usr/include/x86_64-linux-gnu/bits/local_lim.h \
+ /usr/include/linux/limits.h \
+ /usr/include/x86_64-linux-gnu/bits/pthread_stack_min-dynamic.h \
+ /usr/include/x86_64-linux-gnu/bits/posix2_lim.h \
+ /usr/include/x86_64-linux-gnu/bits/xopen_lim.h \
+ /usr/include/x86_64-linux-gnu/bits/uio_lim.h /usr/include/c++/12/math.h \
+ /usr/include/c++/12/cmath /usr/include/c++/12/bits/cpp_type_traits.h \
+ /usr/include/c++/12/ext/type_traits.h /usr/include/math.h \
+ /usr/include/x86_64-linux-gnu/bits/math-vector.h \
+ /usr/include/x86_64-linux-gnu/bits/libm-simd-decl-stubs.h \
+ /usr/include/x86_64-linux-gnu/bits/flt-eval-method.h \
+ /usr/include/x86_64-linux-gnu/bits/fp-logb.h \
+ /usr/include/x86_64-linux-gnu/bits/fp-fast.h \
+ /usr/include/x86_64-linux-gnu/bits/mathcalls-helper-functions.h \
+ /usr/include/x86_64-linux-gnu/bits/mathcalls.h \
+ /usr/include/x86_64-linux-gnu/bits/mathcalls-narrow.h \
+ /usr/include/x86_64-linux-gnu/bits/iscanonical.h \
+ /usr/include/c++/12/bits/specfun.h \
+ /usr/include/c++/12/bits/stl_algobase.h \
+ /usr/include/c++/12/bits/functexcept.h \
+ /usr/include/c++/12/bits/exception_defines.h \
+ /usr/include/c++/12/ext/numeric_traits.h \
+ /usr/include/c++/12/bits/stl_pair.h /usr/include/c++/12/type_traits \
+ /usr/include/c++/12/bits/move.h /usr/include/c++/12/bits/utility.h \
+ /usr/include/c++/12/bits/stl_iterator_base_types.h \
+ /usr/include/c++/12/bits/stl_iterator_base_funcs.h \
+ /usr/include/c++/12/bits/concept_check.h \
+ /usr/include/c++/12/debug/assertions.h \
+ /usr/include/c++/12/bits/stl_iterator.h \
+ /usr/include/c++/12/bits/ptr_traits.h /usr/include/c++/12/debug/debug.h \
+ /usr/include/c++/12/bits/predefined_ops.h /usr/include/c++/12/limits \
+ /usr/include/c++/12/tr1/gamma.tcc \
+ /usr/include/c++/12/tr1/special_function_util.h \
+ /usr/include/c++/12/tr1/bessel_function.tcc \
+ /usr/include/c++/12/tr1/beta_function.tcc \
+ /usr/include/c++/12/tr1/ell_integral.tcc \
+ /usr/include/c++/12/tr1/exp_integral.tcc \
+ /usr/include/c++/12/tr1/hypergeometric.tcc \
+ /usr/include/c++/12/tr1/legendre_function.tcc \
+ /usr/include/c++/12/tr1/modified_bessel_func.tcc \
+ /usr/include/c++/12/tr1/poly_hermite.tcc \
+ /usr/include/c++/12/tr1/poly_laguerre.tcc \
+ /usr/include/c++/12/tr1/riemann_zeta.tcc \
+ /usr/include/x86_64-linux-gnu/sys/time.h /usr/include/time.h \
+ /usr/include/x86_64-linux-gnu/bits/time.h \
+ /usr/include/x86_64-linux-gnu/bits/timex.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_tm.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_itimerspec.h \
+ /usr/include/x86_64-linux-gnu/sys/stat.h \
+ /usr/include/x86_64-linux-gnu/bits/stat.h \
+ /usr/include/x86_64-linux-gnu/bits/struct_stat.h \
+ /usr/include/x86_64-linux-gnu/bits/statx.h /usr/include/linux/stat.h \
+ /usr/include/linux/types.h /usr/include/x86_64-linux-gnu/asm/types.h \
+ /usr/include/asm-generic/types.h /usr/include/asm-generic/int-ll64.h \
+ /usr/include/x86_64-linux-gnu/asm/bitsperlong.h \
+ /usr/include/asm-generic/bitsperlong.h /usr/include/linux/posix_types.h \
+ /usr/include/linux/stddef.h \
+ /usr/include/x86_64-linux-gnu/asm/posix_types.h \
+ /usr/include/x86_64-linux-gnu/asm/posix_types_64.h \
+ /usr/include/asm-generic/posix_types.h \
+ /usr/include/x86_64-linux-gnu/bits/statx-generic.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_statx_timestamp.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_statx.h \
+ /usr/local/include/python3.12/exports.h \
+ /usr/local/include/python3.12/pymacro.h \
+ /usr/local/include/python3.12/pymath.h \
+ /usr/local/include/python3.12/pymem.h \
+ /usr/local/include/python3.12/cpython/pymem.h \
+ /usr/local/include/python3.12/pytypedefs.h \
+ /usr/local/include/python3.12/pybuffer.h \
+ /usr/local/include/python3.12/object.h \
+ /usr/local/include/python3.12/pystats.h \
+ /usr/local/include/python3.12/cpython/object.h \
+ /usr/local/include/python3.12/objimpl.h \
+ /usr/local/include/python3.12/cpython/objimpl.h \
+ /usr/local/include/python3.12/typeslots.h \
+ /usr/local/include/python3.12/pyhash.h \
+ /usr/local/include/python3.12/cpython/pydebug.h \
+ /usr/local/include/python3.12/bytearrayobject.h \
+ /usr/local/include/python3.12/cpython/bytearrayobject.h \
+ /usr/local/include/python3.12/bytesobject.h \
+ /usr/local/include/python3.12/cpython/bytesobject.h \
+ /usr/local/include/python3.12/unicodeobject.h /usr/include/ctype.h \
+ /usr/local/include/python3.12/cpython/unicodeobject.h \
+ /usr/local/include/python3.12/cpython/initconfig.h \
+ /usr/local/include/python3.12/pystate.h \
+ /usr/local/include/python3.12/cpython/pystate.h \
+ /usr/local/include/python3.12/pyerrors.h \
+ /usr/local/include/python3.12/cpython/pyerrors.h \
+ /usr/local/include/python3.12/longobject.h \
+ /usr/local/include/python3.12/cpython/longobject.h \
+ /usr/local/include/python3.12/cpython/longintrepr.h \
+ /usr/local/include/python3.12/boolobject.h \
+ /usr/local/include/python3.12/floatobject.h \
+ /usr/local/include/python3.12/cpython/floatobject.h \
+ /usr/local/include/python3.12/complexobject.h \
+ /usr/local/include/python3.12/cpython/complexobject.h \
+ /usr/local/include/python3.12/rangeobject.h \
+ /usr/local/include/python3.12/memoryobject.h \
+ /usr/local/include/python3.12/cpython/memoryobject.h \
+ /usr/local/include/python3.12/tupleobject.h \
+ /usr/local/include/python3.12/cpython/tupleobject.h \
+ /usr/local/include/python3.12/listobject.h \
+ /usr/local/include/python3.12/cpython/listobject.h \
+ /usr/local/include/python3.12/dictobject.h \
+ /usr/local/include/python3.12/cpython/dictobject.h \
+ /usr/local/include/python3.12/cpython/odictobject.h \
+ /usr/local/include/python3.12/enumobject.h \
+ /usr/local/include/python3.12/setobject.h \
+ /usr/local/include/python3.12/cpython/setobject.h \
+ /usr/local/include/python3.12/methodobject.h \
+ /usr/local/include/python3.12/cpython/methodobject.h \
+ /usr/local/include/python3.12/moduleobject.h \
+ /usr/local/include/python3.12/cpython/funcobject.h \
+ /usr/local/include/python3.12/cpython/classobject.h \
+ /usr/local/include/python3.12/fileobject.h \
+ /usr/local/include/python3.12/cpython/fileobject.h \
+ /usr/local/include/python3.12/pycapsule.h \
+ /usr/local/include/python3.12/cpython/code.h \
+ /usr/local/include/python3.12/pyframe.h \
+ /usr/local/include/python3.12/cpython/pyframe.h \
+ /usr/local/include/python3.12/traceback.h \
+ /usr/local/include/python3.12/cpython/traceback.h \
+ /usr/local/include/python3.12/sliceobject.h \
+ /usr/local/include/python3.12/cpython/cellobject.h \
+ /usr/local/include/python3.12/iterobject.h \
+ /usr/local/include/python3.12/cpython/genobject.h \
+ /usr/local/include/python3.12/descrobject.h \
+ /usr/local/include/python3.12/cpython/descrobject.h \
+ /usr/local/include/python3.12/genericaliasobject.h \
+ /usr/local/include/python3.12/warnings.h \
+ /usr/local/include/python3.12/cpython/warnings.h \
+ /usr/local/include/python3.12/weakrefobject.h \
+ /usr/local/include/python3.12/cpython/weakrefobject.h \
+ /usr/local/include/python3.12/structseq.h \
+ /usr/local/include/python3.12/cpython/picklebufobject.h \
+ /usr/local/include/python3.12/cpython/pytime.h \
+ /usr/local/include/python3.12/codecs.h \
+ /usr/local/include/python3.12/pythread.h \
+ /usr/local/include/python3.12/cpython/pythread.h /usr/include/pthread.h \
+ /usr/include/sched.h /usr/include/x86_64-linux-gnu/bits/sched.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct_sched_param.h \
+ /usr/include/x86_64-linux-gnu/bits/cpu-set.h \
+ /usr/include/x86_64-linux-gnu/bits/setjmp.h \
+ /usr/include/x86_64-linux-gnu/bits/types/struct___jmp_buf_tag.h \
+ /usr/local/include/python3.12/cpython/context.h \
+ /usr/local/include/python3.12/modsupport.h \
+ /usr/local/include/python3.12/cpython/modsupport.h \
+ /usr/local/include/python3.12/compile.h \
+ /usr/local/include/python3.12/cpython/compile.h \
+ /usr/local/include/python3.12/pythonrun.h \
+ /usr/local/include/python3.12/cpython/pythonrun.h \
+ /usr/local/include/python3.12/pylifecycle.h \
+ /usr/local/include/python3.12/cpython/pylifecycle.h \
+ /usr/local/include/python3.12/ceval.h \
+ /usr/local/include/python3.12/cpython/ceval.h \
+ /usr/local/include/python3.12/sysmodule.h \
+ /usr/local/include/python3.12/cpython/sysmodule.h \
+ /usr/local/include/python3.12/osmodule.h \
+ /usr/local/include/python3.12/intrcheck.h \
+ /usr/local/include/python3.12/import.h \
+ /usr/local/include/python3.12/cpython/import.h \
+ /usr/local/include/python3.12/abstract.h \
+ /usr/local/include/python3.12/cpython/abstract.h \
+ /usr/local/include/python3.12/bltinmodule.h \
+ /usr/local/include/python3.12/cpython/pyctype.h \
+ /usr/local/include/python3.12/pystrtod.h \
+ /usr/local/include/python3.12/pystrcmp.h \
+ /usr/local/include/python3.12/fileutils.h \
+ /usr/local/include/python3.12/cpython/fileutils.h \
+ /usr/local/include/python3.12/cpython/pyfpe.h \
+ /usr/local/include/python3.12/tracemalloc.h /usr/include/c++/12/cstdio \
+ /usr/include/c++/12/mutex /usr/include/c++/12/tuple \
+ /usr/include/c++/12/bits/uses_allocator.h \
+ /usr/include/c++/12/bits/invoke.h /usr/include/c++/12/exception \
+ /usr/include/c++/12/bits/exception.h \
+ /usr/include/c++/12/bits/exception_ptr.h \
+ /usr/include/c++/12/bits/cxxabi_init_exception.h \
+ /usr/include/c++/12/typeinfo /usr/include/c++/12/bits/hash_bytes.h \
+ /usr/include/c++/12/new /usr/include/c++/12/bits/nested_exception.h \
+ /usr/include/c++/12/system_error \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/error_constants.h \
+ /usr/include/c++/12/cerrno /usr/include/c++/12/iosfwd \
+ /usr/include/c++/12/bits/stringfwd.h \
+ /usr/include/c++/12/bits/memoryfwd.h /usr/include/c++/12/bits/postypes.h \
+ /usr/include/c++/12/cwchar /usr/include/c++/12/stdexcept \
+ /usr/include/c++/12/string /usr/include/c++/12/bits/char_traits.h \
+ /usr/include/c++/12/cstdint /usr/include/c++/12/bits/allocator.h \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/c++allocator.h \
+ /usr/include/c++/12/bits/new_allocator.h \
+ /usr/include/c++/12/bits/localefwd.h \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/c++locale.h \
+ /usr/include/c++/12/clocale /usr/include/locale.h \
+ /usr/include/x86_64-linux-gnu/bits/locale.h /usr/include/c++/12/cctype \
+ /usr/include/c++/12/bits/ostream_insert.h \
+ /usr/include/c++/12/bits/cxxabi_forced.h \
+ /usr/include/c++/12/bits/stl_function.h \
+ /usr/include/c++/12/backward/binders.h \
+ /usr/include/c++/12/bits/refwrap.h \
+ /usr/include/c++/12/bits/range_access.h \
+ /usr/include/c++/12/initializer_list \
+ /usr/include/c++/12/bits/basic_string.h \
+ /usr/include/c++/12/ext/alloc_traits.h \
+ /usr/include/c++/12/bits/alloc_traits.h \
+ /usr/include/c++/12/bits/stl_construct.h /usr/include/c++/12/string_view \
+ /usr/include/c++/12/bits/functional_hash.h \
+ /usr/include/c++/12/bits/string_view.tcc \
+ /usr/include/c++/12/ext/string_conversions.h \
+ /usr/include/c++/12/bits/charconv.h \
+ /usr/include/c++/12/bits/basic_string.tcc \
+ /usr/include/c++/12/bits/chrono.h /usr/include/c++/12/ratio \
+ /usr/include/c++/12/ctime /usr/include/c++/12/bits/parse_numbers.h \
+ /usr/include/c++/12/bits/std_mutex.h \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/gthr.h \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/gthr-default.h \
+ /usr/include/c++/12/bits/unique_lock.h \
+ /usr/include/c++/12/ext/atomicity.h \
+ /usr/include/x86_64-linux-gnu/c++/12/bits/atomic_word.h \
+ /usr/include/x86_64-linux-gnu/sys/single_threaded.h \
+ /root/repo/multiverso_tpu/capi/c_api.h

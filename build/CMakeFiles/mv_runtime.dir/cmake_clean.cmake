@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "/root/repo/multiverso_tpu/native/_build/libmv_runtime.pdb"
+  "/root/repo/multiverso_tpu/native/_build/libmv_runtime.so"
+  "CMakeFiles/mv_runtime.dir/multiverso_tpu/native/runtime.cpp.o"
+  "CMakeFiles/mv_runtime.dir/multiverso_tpu/native/runtime.cpp.o.d"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/mv_runtime.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "/root/repo/multiverso_tpu/native/_build/libmv_textparse.pdb"
+  "/root/repo/multiverso_tpu/native/_build/libmv_textparse.so"
+  "CMakeFiles/mv_textparse.dir/multiverso_tpu/native/textparse.cpp.o"
+  "CMakeFiles/mv_textparse.dir/multiverso_tpu/native/textparse.cpp.o.d"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/mv_textparse.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

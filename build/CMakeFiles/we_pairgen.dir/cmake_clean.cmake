@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "/root/repo/multiverso_tpu/native/_build/libwe_pairgen.pdb"
+  "/root/repo/multiverso_tpu/native/_build/libwe_pairgen.so"
+  "CMakeFiles/we_pairgen.dir/multiverso_tpu/native/pairgen.cpp.o"
+  "CMakeFiles/we_pairgen.dir/multiverso_tpu/native/pairgen.cpp.o.d"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/we_pairgen.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

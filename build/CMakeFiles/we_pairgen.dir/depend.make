@@ -1,0 +1,2 @@
+# Empty dependencies file for we_pairgen.
+# This may be replaced when dependencies are built.

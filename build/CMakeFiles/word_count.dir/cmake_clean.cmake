@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "/root/repo/multiverso_tpu/native/_build/word_count"
+  "/root/repo/multiverso_tpu/native/_build/word_count.pdb"
+  "CMakeFiles/word_count.dir/multiverso_tpu/native/word_count.cpp.o"
+  "CMakeFiles/word_count.dir/multiverso_tpu/native/word_count.cpp.o.d"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/word_count.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

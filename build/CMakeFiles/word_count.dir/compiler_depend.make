@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for word_count.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# CI entry point (the reference's Travis/Docker test sequence —
+# .travis.yml / deploy/docker/Dockerfile:101-112 — adapted to this repo):
+# build native components offline, run the pytest suite on the fake
+# 8-device CPU mesh, validate the multi-chip sharding dryrun, and
+# smoke-check the driver entry points.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build (cmake) =="
+cmake -S . -B build >/dev/null
+cmake --build build --parallel
+
+echo "== unit + integration tests (8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== multi-chip dryrun (8 virtual devices) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== single-chip entry compile check =="
+python - <<'EOF'
+import jax, __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn)(*args)
+print("entry OK")
+EOF
+
+echo "CI OK"

@@ -35,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.updaters import AddOption, make_updater
+from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import CHECK, Log
 
 __all__ = ["TableOption", "DenseTable", "register_table_type", "create_table"]
@@ -172,8 +173,10 @@ class DenseTable:
 
     def get(self) -> np.ndarray:
         """Blocking whole-table Get (``WorkerTable::Get`` = Wait(GetAsync) —
-        ref: src/table.cpp:27-32)."""
-        return np.asarray(self.get_async())
+        ref: src/table.cpp:27-32). Instrumented like the reference's
+        WORKER_GET_PROCESS_TIME monitor (ref: worker.cpp:31)."""
+        with monitor("table.get"):
+            return np.asarray(self.get_async())
 
     # ----------------------------------------------------------- add path
 
@@ -259,13 +262,15 @@ class DenseTable:
             f"add delta shape {delta.shape} != table shape {self.shape}",
         )
         self._check_worker_slot(option.worker_id)
-        self.storage, self.state = self._add_single_fn()(
-            self.storage,
-            self.state,
-            delta,
-            jnp.int32(option.worker_id),
-            option.scalars(),
-        )
+        with monitor("table.add"):  # dispatch latency only: the add is async
+            # (wait() blocks); ref instrumented site: worker.cpp:50
+            self.storage, self.state = self._add_single_fn()(
+                self.storage,
+                self.state,
+                delta,
+                jnp.int32(option.worker_id),
+                option.scalars(),
+            )
 
     def _check_worker_slot(self, worker_id: int) -> None:
         """Per-worker-state updaters index state by worker/view id; XLA

@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.tables.base import DenseTable, TableOption, register_table_type
 from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import CHECK
 
 __all__ = ["MatrixTableOption", "MatrixTable"]
@@ -122,7 +123,8 @@ class MatrixTable(DenseTable):
 
     def get_rows(self, row_ids) -> np.ndarray:
         """Row-set Get (ref: matrix_table.cpp:79-124 row-id vector path)."""
-        return np.asarray(self.get_rows_async(row_ids))
+        with monitor("table.get_rows"):  # ref: worker.cpp:31 monitor site
+            return np.asarray(self.get_rows_async(row_ids))
 
     # ------------------------------------------------------------- row add
 
@@ -189,14 +191,16 @@ class MatrixTable(DenseTable):
         deltas = jnp.asarray(deltas)
         self._check_row_args(np.asarray(row_ids, np.int32), deltas.shape)
         self._check_worker_slot(option.worker_id)
-        self.storage, self.state = self._add_rows_fn()(
-            self.storage,
-            self.state,
-            ids,
-            deltas,
-            jnp.int32(option.worker_id),
-            option.scalars(),
-        )
+        with monitor("table.add_rows"):  # dispatch latency only (async add);
+            # ref instrumented site: server.cpp:37
+            self.storage, self.state = self._add_rows_fn()(
+                self.storage,
+                self.state,
+                ids,
+                deltas,
+                jnp.int32(option.worker_id),
+                option.scalars(),
+            )
 
     # ----------------------------------------------------- per-worker rows
 

@@ -45,3 +45,23 @@ def test_monitor_accumulates():
     out = Dashboard.Display()
     assert "unit_test_region" in out
     Dashboard.Reset()
+
+
+def test_table_ops_are_instrumented(mv_env):
+    """Table Get/Add land in the Dashboard (ref: the reference instruments
+    worker/server request processing — worker.cpp:31-50, server.cpp:37-57)."""
+    import numpy as np
+
+    from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+
+    Dashboard.Reset()
+    t = mv_env.MV_CreateTable(ArrayTableOption(size=8))
+    t.add(np.ones(8, np.float32))
+    t.get()
+    m = mv_env.MV_CreateTable(MatrixTableOption(num_row=6, num_col=4))
+    m.add_rows(np.array([1, 3], np.int32), np.ones((2, 4), np.float32))
+    m.get_rows(np.array([1, 3], np.int32))
+    shown = Dashboard.Display()
+    for name in ("table.get", "table.add", "table.get_rows", "table.add_rows"):
+        assert name in shown, f"missing monitor {name}"
+    Dashboard.Reset()
