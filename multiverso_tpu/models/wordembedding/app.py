@@ -284,29 +284,41 @@ class WordEmbedding:
             ),
             donate_argnums=(0,),
         )
-        # epoch = one corpus worth of center draws; expected pairs match the
-        # host walk's (window+1)/2 per position x 2 directions
+        # epoch target = the host walk's pair count: E[2*eff] = window+1
+        # accepted pairs per kept position. Rejected draws (markers,
+        # subsampling, beyond-shrink offsets) are NOT trained pairs —
+        # progress tracks the step's accepted-pair count, synced at log
+        # points only (acceptance per draw is ~(window+1)/(2*window), hence
+        # est_calls at 2x the draw budget).
         total_pairs = max(len(ids) * (o.window + 1) * o.epoch, 1)
         per_call = o.batch_size * S
-        calls = max(1, total_pairs // per_call)
+        est_calls = max(1, 2 * total_pairs // per_call)
+        max_calls = 20 * est_calls  # bound: degenerate corpora reject ~all
         key = jax.random.PRNGKey(o.seed)
         start = time.perf_counter()
         loss_dev = None
-        log_every = max(1, calls // 20)
-        for i in range(calls):
-            lr = self._lr(i / calls)
+        accepted_dev = jnp.float32(0.0)
+        pairs_done = 0
+        calls = 0
+        log_every = max(1, est_calls // 20)
+        while pairs_done < total_pairs and calls < max_calls:
+            lr = self._lr(pairs_done / total_pairs)
             key, sub = jax.random.split(key)
-            self.params, loss_dev = superstep(self.params, sub, jnp.float32(lr))
-            if (i + 1) % log_every == 0:
-                done = (i + 1) * per_call
-                rate = done / max(time.perf_counter() - start, 1e-9)
+            self.params, (loss_dev, acc) = superstep(
+                self.params, sub, jnp.float32(lr)
+            )
+            accepted_dev = accepted_dev + acc
+            calls += 1
+            if calls % log_every == 0:
+                pairs_done = int(float(accepted_dev))  # one sync per window
+                rate = pairs_done / max(time.perf_counter() - start, 1e-9)
                 Log.Info(
                     "[WordEmbedding] device-pipeline: %.1fM pairs, %.0fk "
                     "pairs/s, lr %.5f, loss %.4f",
-                    done / 1e6, rate / 1e3, lr, float(loss_dev),
+                    pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
                 )
         jax.block_until_ready(self.params)
-        self.words_trained = calls * per_call
+        self.words_trained = int(float(accepted_dev))
         rate = self.words_trained / max(time.perf_counter() - start, 1e-9)
         Log.Info(
             "[WordEmbedding] device-pipeline done: %.1fM pairs in %.1fs (%.0fk pairs/s)",

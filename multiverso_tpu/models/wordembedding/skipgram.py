@@ -566,9 +566,12 @@ def make_ondevice_batch_fn(
     negative table draws) with fixed-shape vector ops:
 
     * centers drawn at uniform-random corpus positions (word2vec quality is
-      position-order agnostic; an epoch = corpus-size worth of draws);
-    * per-pair dynamic window shrink b ~ U[1, window] and a uniform offset
-      in [-b, b] \\ {0} — matching the expected-window distribution;
+      position-order agnostic; an epoch = a corpus worth of *accepted*
+      pairs, which the caller tracks via the returned weights);
+    * per-pair dynamic window shrink b ~ U[1, window]; the offset magnitude
+      is drawn uniform over the full window and weight-rejected beyond b,
+      reproducing word2vec's emit-all-offsets pair distribution
+      (frequency at distance d proportional to P(b >= d)) exactly;
     * pairs rejected (weight 0, shapes static) when either end is a
       sentence marker or fails subsampling. Windows that *cross* a sentence
       boundary marker are only rejected when the sampled endpoint lands on
@@ -589,19 +592,18 @@ def make_ondevice_batch_fn(
         p = jax.random.randint(ks[0], (batch,), 0, n_corpus)
         c = corpus[p]
         eff = jax.random.randint(ks[1], (batch,), 1, window + 1)
-        # offset magnitude uniform in [1, eff] (word2vec's uniform pick
-        # inside the shrunk window)
-        mag = 1 + (
-            jax.random.uniform(ks[2], (batch,)) * eff.astype(jnp.float32)
-        ).astype(jnp.int32)
-        mag = jnp.minimum(mag, eff)  # guard the u == 1.0 edge
+        # word2vec emits EVERY offset in [-eff, eff], so pair frequency at
+        # distance d is proportional to P(eff >= d). Sampling the offset
+        # uniform over the full window and weight-rejecting draws beyond
+        # eff reproduces that distribution exactly.
+        mag = jax.random.randint(ks[2], (batch,), 1, window + 1)
         off = mag * jnp.where(
             jax.random.bernoulli(ks[3], 0.5, (batch,)), 1, -1
         )
         q = p + off
         qc = jnp.clip(q, 0, n_corpus - 1)
         t = corpus[qc]
-        valid = (c >= 0) & (t >= 0) & (q == qc)
+        valid = (mag <= eff) & (c >= 0) & (t >= 0) & (q == qc)
         cs = jnp.maximum(c, 0)
         ts = jnp.maximum(t, 0)
         if keep_probs is not None:
@@ -634,7 +636,10 @@ def make_ondevice_superbatch_step(
     weights are binary, so folding them into both the gradient and the
     scatter scale is idempotent.
 
-    Signature: ``(params, key, lr) -> (params, mean_loss)``.
+    Signature: ``(params, key, lr) -> (params, (mean_loss, accepted_pairs))``
+    — ``accepted_pairs`` is the number of weight>0 pairs actually trained,
+    so callers can track real epoch progress (rejected draws are not
+    trained pairs).
     """
     assert not config.cbow, "device pipeline supports NS skip-gram only"
     assert scale_mode in ("row_mean", "raw"), scale_mode
@@ -666,11 +671,12 @@ def make_ondevice_superbatch_step(
             ip, isort, iscale = _presort(c, w)
             upd_i = d_vin[ip] * iscale[:, None]
             emb_in = emb_in.at[isort].add(-lr * upd_i, indices_are_sorted=True)
-            return {**params, "emb_in": emb_in, "emb_out": emb_out}, loss
+            new = {**params, "emb_in": emb_in, "emb_out": emb_out}
+            return new, (loss, jnp.sum(w))
 
         keys = jax.random.split(key, steps)
-        params, losses = jax.lax.scan(body, params, keys)
-        return params, jnp.mean(losses)
+        params, (losses, accepted) = jax.lax.scan(body, params, keys)
+        return params, (jnp.mean(losses), jnp.sum(accepted))
 
     return superstep
 
