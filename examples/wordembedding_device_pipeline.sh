@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Zero-host-traffic variant: corpus resident in HBM, sampling/negatives/
+# presort inside the jitted step. For hosts (or host<->device links) too
+# slow to feed the chip.
+exec python -m multiverso_tpu.models.wordembedding \
+    -train_file="${1:-corpus.txt}" \
+    -size=128 -window=5 -negative=5 -sample=1e-3 \
+    -alpha=0.025 -epoch=1 -min_count=5 \
+    -batch_size=8192 -steps_per_call=64 \
+    -device_pipeline=true \
+    -output_file=embeddings.txt

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Distributed word2vec example (flags mirror the reference's
+# example/run.bat). Train skip-gram with negative sampling on a text
+# corpus; writes word2vec-format embeddings.
+exec python -m multiverso_tpu.models.wordembedding \
+    -train_file="${1:-corpus.txt}" \
+    -size=128 -window=5 -negative=5 -sample=1e-3 \
+    -alpha=0.025 -epoch=1 -min_count=5 \
+    -batch_size=8192 -steps_per_call=64 \
+    -is_pipeline=true -threads=4 \
+    -output_file=embeddings.txt

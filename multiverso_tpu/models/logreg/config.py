@@ -26,6 +26,8 @@ class Configure:
     minibatch_size: int = 20
     read_buffer_size: int = 2048
     show_time_per_sample: int = 10000
+    # minibatches scanned per device dispatch (local models; superbatching)
+    steps_per_call: int = 8
 
     regular_coef: float = 0.0005
     learning_rate: float = 0.8

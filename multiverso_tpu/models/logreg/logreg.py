@@ -43,12 +43,36 @@ class LogReg:
             # batch would serialise training on the dispatch round trip);
             # accumulate sums and sync once per show_time_per_sample window
             ep_sum, ep_n, win_sum, win_n = 0.0, 0, 0.0, 0
+            # superbatch grouping: scan S same-shape minibatches per dispatch
+            # when the model supports it (local models; PS steps singly)
+            S = max(1, int(getattr(cfg, "steps_per_call", 8)))
+            can_fuse = hasattr(self.model, "train_superbatch") and S > 1
+            group: list = []
+
+            def flush(group):
+                if len(group) > 1 and can_fuse and all(
+                    g["y"].shape == group[0]["y"].shape for g in group
+                ):
+                    return self.model.train_superbatch(group), sum(
+                        len(g["y"]) for g in group
+                    )
+                total = 0
+                loss_sum = 0.0
+                for g in group:
+                    loss_sum = loss_sum + self.model.train_batch(g)
+                    total += len(g["y"])
+                return loss_sum / len(group), total
+
             for batch in self.reader.async_batches(batch_size=cfg.minibatch_size):
-                loss = self.model.train_batch(batch)
+                group.append(batch)
+                if len(group) < S:
+                    continue
+                loss, n_in_group = flush(group)
+                group = []
                 win_sum = win_sum + loss
                 win_n += 1
-                seen += len(batch["y"])
-                since_log += len(batch["y"])
+                seen += n_in_group
+                since_log += n_in_group
                 if since_log >= cfg.show_time_per_sample:
                     rate = seen / max(timer.elapsed_s(), 1e-9)
                     w = float(win_sum)  # the one device sync per log window
@@ -59,6 +83,11 @@ class LogReg:
                     ep_sum, ep_n = ep_sum + w, ep_n + win_n
                     win_sum, win_n = 0.0, 0
                     since_log = 0
+            if group:  # epoch tail: whatever is left of the last group
+                loss, n_in_group = flush(group)
+                win_sum = win_sum + loss
+                win_n += 1
+                seen += n_in_group
             if win_n:
                 ep_sum, ep_n = ep_sum + float(win_sum), ep_n + win_n
             last_epoch_loss = ep_sum / ep_n if ep_n else 0.0

@@ -81,6 +81,8 @@ class LocalModel:
         # minibatch serialises everything on the dispatch round trip)
         self._fused_dense = jax.jit(self._sgd_dense, donate_argnums=(0,))
         self._fused_sparse = jax.jit(self._sgd_sparse, donate_argnums=(0,))
+        self._fused_dense_scan = jax.jit(self._scan_dense, donate_argnums=(0,))
+        self._fused_sparse_scan = jax.jit(self._scan_sparse, donate_argnums=(0,))
 
     # gradient programs (shared with PSModel)
     def _grad_dense(self, W, X, y):
@@ -106,6 +108,44 @@ class LocalModel:
             jnp.asarray(batch["val"]),
             jnp.asarray(batch["y"]),
         )
+
+    def train_superbatch(self, batches):
+        """Scan over identically-shaped minibatches in ONE dispatch
+        (superbatching — amortizes dispatch latency exactly like the
+        WordEmbedding steps_per_call path). Returns the device mean loss.
+        PS-mode models override: their per-batch delta push is the PS
+        protocol and cannot be fused."""
+        lrs = jnp.asarray(
+            [self.schedule.next_lr() for _ in batches], jnp.float32
+        )
+        if "X" in batches[0]:
+            Xs = jnp.asarray(np.stack([b["X"] for b in batches]))
+            ys = jnp.asarray(np.stack([b["y"] for b in batches]))
+            self.W, loss = self._fused_dense_scan(self.W, Xs, ys, lrs)
+        else:
+            idx = jnp.asarray(np.stack([b["idx"] for b in batches]))
+            val = jnp.asarray(np.stack([b["val"] for b in batches]))
+            ys = jnp.asarray(np.stack([b["y"] for b in batches]))
+            self.W, loss = self._fused_sparse_scan(self.W, idx, val, ys, lrs)
+        return loss
+
+    def _scan_dense(self, W, Xs, ys, lrs):
+        def body(W, xs):
+            X, y, lr = xs
+            loss, grad = self._grad_dense(W, X, y)
+            return W - lr * grad, loss
+
+        W, losses = jax.lax.scan(body, W, (Xs, ys, lrs))
+        return W, jnp.mean(losses)
+
+    def _scan_sparse(self, W, idx, val, ys, lrs):
+        def body(W, xs):
+            i, v, y, lr = xs
+            loss, grad = self._grad_sparse(W, i, v, y)
+            return W - lr * grad, loss
+
+        W, losses = jax.lax.scan(body, W, (idx, val, ys, lrs))
+        return W, jnp.mean(losses)
 
     def train_batch(self, batch: Dict[str, Any]):
         """One fused SGD step; returns the *device* loss scalar — callers
@@ -193,6 +233,13 @@ class PSModel(LocalModel):
         else:
             table_fm = self.table.get()
         self.W = jnp.asarray(table_fm.T)  # class-major view for the step
+
+    def train_superbatch(self, batches):
+        """PS mode cannot fuse across minibatches: each batch's delta push
+        through the table IS the protocol (ref: ps_model.cpp per-batch
+        AddAsync). Steps singly."""
+        losses = [self.train_batch(b) for b in batches]
+        return float(np.mean([float(l) for l in losses]))
 
     def train_batch(self, batch: Dict[str, Any]) -> float:
         loss, grad = self._gradient(batch)  # grad: (C, F)

@@ -285,12 +285,13 @@ class WordEmbedding:
             donate_argnums=(0,),
         )
         # epoch target = the host walk's pair count: E[2*eff] = window+1
-        # accepted pairs per kept position. Rejected draws (markers,
-        # subsampling, beyond-shrink offsets) are NOT trained pairs —
-        # progress tracks the step's accepted-pair count, synced at log
-        # points only (acceptance per draw is ~(window+1)/(2*window), hence
-        # est_calls at 2x the draw budget).
-        total_pairs = max(len(ids) * (o.window + 1) * o.epoch, 1)
+        # pairs per KEPT, non-marker position (markers emit nothing; a
+        # subsampled-out center emits nothing). Rejected draws are NOT
+        # trained pairs — progress tracks the step's accepted-pair count,
+        # synced at log points only.
+        valid = ids >= 0
+        kept = float(keep[ids[valid]].sum()) if o.sample > 0 else float(valid.sum())
+        total_pairs = max(int(kept * (o.window + 1) * o.epoch), 1)
         per_call = o.batch_size * S
         est_calls = max(1, 2 * total_pairs // per_call)
         max_calls = 20 * est_calls  # bound: degenerate corpora reject ~all
@@ -317,6 +318,13 @@ class WordEmbedding:
                     "pairs/s, lr %.5f, loss %.4f",
                     pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
                 )
+        if calls >= max_calls and pairs_done < total_pairs:
+            Log.Error(
+                "[WordEmbedding] device-pipeline hit the %d-call bound at "
+                "%.1fM/%.1fM pairs — corpus rejects nearly every draw; "
+                "epoch truncated",
+                max_calls, pairs_done / 1e6, total_pairs / 1e6,
+            )
         jax.block_until_ready(self.params)
         self.words_trained = int(float(accepted_dev))
         rate = self.words_trained / max(time.perf_counter() - start, 1e-9)

@@ -287,3 +287,27 @@ def test_model_save_load_roundtrip(tmp_path):
     cfg2 = Configure(**{**cfg.__dict__, "init_model_file": str(tmp_path / "m.bin")})
     lr2 = LogReg(cfg2)
     np.testing.assert_allclose(lr2.model.weights(), W)
+
+
+def test_local_superbatch_matches_single_steps(mv_env):
+    """train_superbatch (scan) == stepping the same batches singly."""
+    import jax.numpy as jnp
+
+    from multiverso_tpu.models.logreg.config import Configure
+    from multiverso_tpu.models.logreg.model import Model
+
+    rng = np.random.RandomState(0)
+    cfg = Configure(input_size=12, output_size=3, objective_type="softmax",
+                    learning_rate=0.1, minibatch_size=16)
+    batches = [
+        {"X": rng.randn(16, 12).astype(np.float32),
+         "y": rng.randint(0, 3, 16).astype(np.int32)}
+        for _ in range(6)
+    ]
+    m1 = Model.Get(cfg)
+    loss1 = m1.train_superbatch(batches)
+    m2 = Model.Get(cfg)
+    for b in batches:
+        last = m2.train_batch(b)
+    assert np.allclose(m1.weights(), m2.weights(), atol=1e-6)
+    assert np.isfinite(float(loss1))
