@@ -230,9 +230,14 @@ class PrefetchPipeline:
             th.start()
         try:
             while True:
-                if error:  # fail fast, not after the surviving shards drain
-                    raise error[0]
-                ticket = ready.pop()
+                # deliver batches already produced, then fail fast on a
+                # producer error (not after the surviving shards drain the
+                # whole epoch)
+                ticket = ready.try_pop()
+                if ticket is None:
+                    if error:
+                        raise error[0]
+                    ticket = ready.pop()
                 if ticket is None:
                     break
                 batch = slots[ticket]
