@@ -36,6 +36,25 @@ class LogReg:
         """Run ``train_epoch`` epochs; returns the final epoch's mean loss."""
         cfg = self.config
         last_epoch_loss = 0.0
+        # superbatch grouping: scan S same-shape minibatches per dispatch
+        # when the model supports it (local models; PS steps singly)
+        S = max(1, int(cfg.steps_per_call))
+        can_fuse = hasattr(self.model, "train_superbatch") and S > 1
+
+        def flush(group):
+            if len(group) > 1 and can_fuse and all(
+                g["y"].shape == group[0]["y"].shape for g in group
+            ):
+                return self.model.train_superbatch(group), sum(
+                    len(g["y"]) for g in group
+                )
+            total = 0
+            loss_sum = 0.0
+            for g in group:
+                loss_sum = loss_sum + self.model.train_batch(g)
+                total += len(g["y"])
+            return loss_sum / len(group), total
+
         for epoch in range(cfg.train_epoch):
             timer = Timer()
             seen, since_log = 0, 0
@@ -43,25 +62,7 @@ class LogReg:
             # batch would serialise training on the dispatch round trip);
             # accumulate sums and sync once per show_time_per_sample window
             ep_sum, ep_n, win_sum, win_n = 0.0, 0, 0.0, 0
-            # superbatch grouping: scan S same-shape minibatches per dispatch
-            # when the model supports it (local models; PS steps singly)
-            S = max(1, int(getattr(cfg, "steps_per_call", 8)))
-            can_fuse = hasattr(self.model, "train_superbatch") and S > 1
             group: list = []
-
-            def flush(group):
-                if len(group) > 1 and can_fuse and all(
-                    g["y"].shape == group[0]["y"].shape for g in group
-                ):
-                    return self.model.train_superbatch(group), sum(
-                        len(g["y"]) for g in group
-                    )
-                total = 0
-                loss_sum = 0.0
-                for g in group:
-                    loss_sum = loss_sum + self.model.train_batch(g)
-                    total += len(g["y"])
-                return loss_sum / len(group), total
 
             for batch in self.reader.async_batches(batch_size=cfg.minibatch_size):
                 group.append(batch)

@@ -177,42 +177,21 @@ class WordEmbedding:
             self.params["emb_out"] = jnp.zeros((out_rows, options.size), jnp.float32)
         if options.use_adagrad:
             self.params.update(init_adagrad_slots(self.cfg, out_rows))
+        kw = dict(hs=options.hs, use_adagrad=options.use_adagrad)
         if options.presort:
             # sorted-scatter path: scale_mode is baked into the host-side
             # presort arrays, the device step is scale-mode agnostic
-            self._step = jax.jit(
-                make_sorted_train_step(
-                    self.cfg, hs=options.hs, use_adagrad=options.use_adagrad
-                ),
-                donate_argnums=(0,),
-            )
-            self._superstep = jax.jit(
-                make_sorted_superbatch_step(
-                    self.cfg, hs=options.hs, use_adagrad=options.use_adagrad
-                ),
-                donate_argnums=(0,),
-            )
+            step_fn = make_sorted_train_step(self.cfg, **kw)
+            superstep_fn = make_sorted_superbatch_step(self.cfg, **kw)
         else:
-            self._step = jax.jit(
-                make_train_step(
-                    self.cfg,
-                    hs=options.hs,
-                    use_adagrad=options.use_adagrad,
-                    scale_mode=options.scale_mode,
-                ),
-                donate_argnums=(0,),
+            step_fn = make_train_step(self.cfg, scale_mode=options.scale_mode, **kw)
+            # superbatch: scan over steps_per_call microbatches in one
+            # dispatch (dispatch latency amortization)
+            superstep_fn = make_superbatch_step(
+                self.cfg, scale_mode=options.scale_mode, **kw
             )
-            # superbatch: scan over steps_per_call microbatches in one dispatch
-            # (dispatch latency amortization — see make_superbatch_step)
-            self._superstep = jax.jit(
-                make_superbatch_step(
-                    self.cfg,
-                    hs=options.hs,
-                    use_adagrad=options.use_adagrad,
-                    scale_mode=options.scale_mode,
-                ),
-                donate_argnums=(0,),
-            )
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        self._superstep = jax.jit(superstep_fn, donate_argnums=(0,))
         self.words_trained = 0
 
     # ------------------------------------------------------------- training
