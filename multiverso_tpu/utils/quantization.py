@@ -1,21 +1,27 @@
-"""Sparse wire/storage compression.
+"""Sparse / 1-bit wire and storage compression.
 
 TPU-native equivalent of the reference SparseFilter
 (ref: include/multiverso/util/quantization_util.h:10-158): per-blob, if more
 than half the entries are zero, rewrite as (index, value) pairs plus a size
 header; ``FilterIn`` compresses, ``FilterOut`` restores. On TPU there is no
 wire between workers and servers, so this is used for checkpoint/export
-compaction and for the C-API/IPC boundary. (The reference's declared-but-empty
-``OneBitsFilter`` — quantization_util.h:160-161 — is intentionally absent.)
+compaction and for the C-API/IPC boundary.
+
+``OneBitsFilter`` implements the filter the reference declares but leaves
+empty (quantization_util.h:160-161): 1-bit SGD gradient compression — each
+entry reduced to its sign, scaled by the mean absolute value of its sign
+class, with the quantization error fed back into the next round (Seide et
+al.'s error-feedback scheme, the standard completion of the reference's
+stub). 32x smaller payloads for delta pushes over DCN/IPC.
 """
 
 from __future__ import annotations
 
-from typing import Tuple, Union
+from typing import Optional, Tuple, Union
 
 import numpy as np
 
-__all__ = ["SparseFilter"]
+__all__ = ["SparseFilter", "OneBitsFilter"]
 
 Dense = np.ndarray
 Compressed = Tuple[str, tuple, np.ndarray, np.ndarray]  # ("sparse", shape, idx, vals)
@@ -42,6 +48,53 @@ class SparseFilter:
         flat = np.zeros(int(np.prod(shape)), vals.dtype)
         flat[idx] = vals
         return flat.reshape(shape)
+
+    # reference-style aliases
+    FilterIn = filter_in
+    FilterOut = filter_out
+
+
+OneBit = Tuple[str, tuple, np.ndarray, float, float]  # ("1bit", shape, bits, pos_scale, neg_scale)
+
+
+class OneBitsFilter:
+    """1-bit gradient compression with error feedback.
+
+    Stateful per stream: construct one filter per delta stream (e.g. per
+    table); ``filter_in`` adds the carried quantization residual before
+    quantizing and retains the new residual, so the long-run updates are
+    unbiased. ``filter_out`` is stateless decompression.
+    """
+
+    def __init__(self):
+        self._residual: Optional[np.ndarray] = None
+
+    def filter_in(self, arr: np.ndarray) -> OneBit:
+        arr = np.asarray(arr, np.float32)
+        if self._residual is None:
+            self._residual = np.zeros_like(arr)
+        if self._residual.shape != arr.shape:
+            raise ValueError(
+                f"OneBitsFilter stream shape changed: {self._residual.shape} "
+                f"-> {arr.shape}; use one filter per delta stream"
+            )
+        x = arr + self._residual
+        pos = x >= 0
+        # per-sign-class mean magnitude minimizes L2 quantization error
+        pos_scale = float(x[pos].mean()) if pos.any() else 0.0
+        neg_scale = float(x[~pos].mean()) if (~pos).any() else 0.0
+        deq = np.where(pos, pos_scale, neg_scale).astype(np.float32)
+        self._residual = x - deq
+        bits = np.packbits(pos.reshape(-1))
+        return ("1bit", arr.shape, bits, pos_scale, neg_scale)
+
+    @staticmethod
+    def filter_out(data: OneBit) -> np.ndarray:
+        tag, shape, bits, pos_scale, neg_scale = data
+        assert tag == "1bit"
+        n = int(np.prod(shape))
+        pos = np.unpackbits(bits)[:n].astype(bool)
+        return np.where(pos, np.float32(pos_scale), np.float32(neg_scale)).reshape(shape)
 
     # reference-style aliases
     FilterIn = filter_in

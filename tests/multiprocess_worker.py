@@ -1,0 +1,59 @@
+"""Worker program for the real multi-process integration test
+(tests/test_multiprocess_e2e.py). Each process runs the SAME logical SPMD
+program — the reference's `mpirun -np N ./multiverso.test array` analog
+(ref: Test/test_array_table.cpp:11-47).
+
+argv: <process_id> <num_processes> <coordinator addr:port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import ArrayTableOption
+
+    mv.MV_Init(
+        [
+            "prog",
+            f"-coordinator={coord}",
+            f"-process_id={pid}",
+            f"-num_processes={nproc}",
+        ]
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    nw = mv.MV_NumWorkers()
+
+    # the reference integration invariant: iters x adds_per_iter x delta,
+    # identical Get on every process afterwards
+    table = mv.MV_CreateTable(ArrayTableOption(size=23))
+    delta = np.arange(23, dtype=np.float32)
+    iters, adds_per_iter = 3, 3
+    for _ in range(iters * adds_per_iter):
+        table.add(delta)
+    got = table.get()
+    expect = delta * iters * adds_per_iter
+    assert np.allclose(got, expect), (got[:4], expect[:4])
+
+    agg = mv.MV_Aggregate(np.ones((nw, 5), np.float32))
+    assert np.allclose(agg, nw), agg
+    mv.MV_Barrier()
+    mv.MV_ShutDown()
+    print(f"WORKER_OK pid={pid} nw={nw} devs={len(jax.devices())}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -152,3 +152,28 @@ def test_sparse_filter_dense_passthrough():
     out = SparseFilter.filter_in(arr)
     assert isinstance(out, np.ndarray)  # >50% nonzero: pass through
     np.testing.assert_array_equal(SparseFilter.filter_out(out), arr)
+
+
+def test_one_bits_filter_error_feedback():
+    """1-bit compression (the reference's declared-but-empty OneBitsFilter,
+    quantization_util.h:160-161): sign+scale quantization whose residual
+    carry makes the accumulated stream unbiased."""
+    from multiverso_tpu.utils.quantization import OneBitsFilter
+
+    rng = np.random.RandomState(0)
+    f = OneBitsFilter()
+    total_true = np.zeros(256, np.float32)
+    total_deq = np.zeros(256, np.float32)
+    for _ in range(200):
+        g = rng.randn(256).astype(np.float32)
+        total_true += g
+        comp = f.filter_in(g)
+        deq = OneBitsFilter.filter_out(comp)
+        assert deq.shape == g.shape
+        total_deq += deq
+    # error feedback: accumulated dequantized stream tracks the true sum to
+    # within the one-step residual bound (~mean |g| per entry)
+    err = np.abs(total_deq - total_true)
+    assert err.max() < 4.0, err.max()  # vs ~40 if bias accumulated
+    # payload is 1 bit/entry + 2 scales
+    assert comp[2].nbytes == 256 // 8
