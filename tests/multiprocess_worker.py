@@ -3,7 +3,7 @@
 program — the reference's `mpirun -np N ./multiverso.test array` analog
 (ref: Test/test_array_table.cpp:11-47).
 
-argv: <process_id> <num_processes> <coordinator addr:port>
+argv: <process_id> <num_processes> <coordinator addr:port> [extra flags...]
 """
 
 import os
@@ -33,6 +33,7 @@ def main():
             f"-process_id={pid}",
             f"-num_processes={nproc}",
         ]
+        + sys.argv[4:]
     )
     assert jax.process_count() == nproc, jax.process_count()
     nw = mv.MV_NumWorkers()

@@ -23,15 +23,20 @@ def _free_port() -> int:
 
 
 def test_two_process_cluster_table_invariants():
+    # NOTE: no -sync parametrization: under a single-controller SPMD program
+    # sync-vs-async is deterministic by construction (runtime.py flag note),
+    # so the runs would be byte-identical; the worker accepts extra flags
+    # for manual experiments
     port = _free_port()
     coord = f"127.0.0.1:{port}"
+    extra = []
     procs = [
         subprocess.Popen(
             [
                 sys.executable,
                 os.path.join(_REPO, "tests", "multiprocess_worker.py"),
                 str(i), "2", coord,
-            ],
+            ] + extra,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             cwd=_REPO,
