@@ -1,0 +1,81 @@
+"""Distributed neural-net training through the table API — the reference's
+flagship integration pattern (ref: binding/python/docs/BENCHMARK.md trained
+CIFAR ResNet via the Theano/Lasagne param manager; theano_ext/
+param_manager.py flattens all model params into ONE ArrayTable and syncs a
+delta every batch via the Keras MVCallback).
+
+Here: a flax MLP on synthetic data, params flattened into an ArrayTable via
+PytreeParamManager, ASGD-style delta sync after every optimizer step
+(PeriodicSync(n=1) == the MVCallback's on_batch_end). Under a multi-process
+cluster each process trains its own shard of the data and the table merges
+deltas — the Multiverso ASGD recipe.
+
+Run:  python examples/flax_mlp_asgd.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import multiverso_tpu as mv
+from multiverso_tpu.ext.param_manager import PeriodicSync, PytreeParamManager
+
+
+def main():
+    import flax.linen as nn
+    import optax
+
+    mv.MV_Init(sys.argv)
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            return nn.Dense(10)(x)
+
+    rng = np.random.RandomState(jax.process_index())
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32)))
+    tx = optax.sgd(0.05)
+    opt_state = tx.init(params)
+
+    manager = PytreeParamManager(params)  # params now live in an ArrayTable
+    params = manager.params
+    syncer = PeriodicSync(manager, every=1)  # MVCallback.on_batch_end parity
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            onehot = jax.nn.one_hot(y, 10)
+            return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    import os
+
+    n_steps = int(os.environ.get("FLAX_EXAMPLE_STEPS", 200))
+    # the task (W_true) is SHARED — fixed seed; only the data stream is
+    # per-process (each worker trains on its own shard of the same problem)
+    W_true = np.random.RandomState(7).randn(32, 10).astype(np.float32)
+    for i in range(n_steps):
+        x = rng.randn(256, 32).astype(np.float32)
+        y = np.argmax(x @ W_true, axis=1).astype(np.int32)
+        params, opt_state, loss = step(params, opt_state, x, y)
+        manager.params = params      # local update...
+        syncer.step()                # ...delta-merged through the table
+        params = manager.params
+        if (i + 1) % 50 == 0:
+            print(f"step {i+1}: loss {float(loss):.4f}", flush=True)
+    mv.MV_ShutDown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""The shipped examples must actually run (a broken example is worse than
+no example). Heavier ones are exercised with reduced step counts."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_flax_param_manager_example_runs():
+    env = dict(os.environ, FLAX_EXAMPLE_STEPS="15",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "flax_mlp_asgd.py")],
+        capture_output=True, timeout=240, cwd=_REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+
+
+def test_logreg_example_configs_parse():
+    from multiverso_tpu.models.logreg.config import Configure
+
+    mnist = Configure.from_file(os.path.join(_REPO, "examples", "logreg_mnist.config"))
+    assert mnist.objective_type == "softmax" and mnist.input_size == 784
+    ftrl = Configure.from_file(
+        os.path.join(_REPO, "examples", "logreg_ftrl_sparse.config")
+    )
+    assert ftrl.sparse and ftrl.updater_type == "ftrl"

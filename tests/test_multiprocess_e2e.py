@@ -29,14 +29,13 @@ def test_two_process_cluster_table_invariants():
     # for manual experiments
     port = _free_port()
     coord = f"127.0.0.1:{port}"
-    extra = []
     procs = [
         subprocess.Popen(
             [
                 sys.executable,
                 os.path.join(_REPO, "tests", "multiprocess_worker.py"),
                 str(i), "2", coord,
-            ] + extra,
+            ],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             cwd=_REPO,
