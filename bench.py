@@ -117,18 +117,21 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=64,
     )
     params = init_params(cfg)
     key = jax.random.PRNGKey(0)
-    accepted = jnp.float32(0.0)
     for _ in range(warmup):
         key, sub = jax.random.split(key)
         params, (loss, acc) = step(params, sub, jnp.float32(0.025))
     float(loss)  # queue fence (see _bench_fused)
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        key, sub = jax.random.split(key)
-        params, (loss, acc) = step(params, sub, jnp.float32(0.025))
-        accepted = accepted + acc
-    total = float(accepted)  # host force closes the timing
-    return total / (time.perf_counter() - t0)
+    best = 0.0
+    for _ in range(3):  # best-of-3 (see _bench_fused)
+        accepted = jnp.float32(0.0)
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            key, sub = jax.random.split(key)
+            params, (loss, acc) = step(params, sub, jnp.float32(0.025))
+            accepted = accepted + acc
+        total = float(accepted)  # host force closes the timing
+        best = max(best, total / (time.perf_counter() - t0))
+    return best
 
 
 def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):

@@ -13,9 +13,10 @@ deltas — the Multiverso ASGD recipe.
 Run:  python examples/flax_mlp_asgd.py
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -58,8 +59,6 @@ def main():
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
-
-    import os
 
     n_steps = int(os.environ.get("FLAX_EXAMPLE_STEPS", 200))
     # the task (W_true) is SHARED — fixed seed; only the data stream is

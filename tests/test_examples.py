@@ -5,10 +5,14 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_flax_param_manager_example_runs():
+    pytest.importorskip("flax")
+    pytest.importorskip("optax")
     env = dict(os.environ, FLAX_EXAMPLE_STEPS="15",
                JAX_PLATFORMS="cpu")
     out = subprocess.run(
