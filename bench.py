@@ -98,6 +98,7 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=64,
     secondary metric in ACCEPTED pairs/sec (rejected draws aren't trained)."""
     from multiverso_tpu.models.wordembedding.sampler import AliasSampler
     from multiverso_tpu.models.wordembedding.skipgram import (
+        build_negative_lut,
         init_params,
         make_ondevice_superbatch_step,
     )
@@ -110,7 +111,7 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=64,
     )
     step = jax.jit(
         make_ondevice_superbatch_step(
-            cfg, jnp.asarray(corpus), None, sampler._prob, sampler._alias,
+            cfg, jnp.asarray(corpus), None, build_negative_lut(sampler.probs),
             batch=batch, steps=scan_steps,
         ),
         donate_argnums=(0,),

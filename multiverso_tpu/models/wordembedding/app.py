@@ -244,6 +244,7 @@ class WordEmbedding:
         answer was the pipeline thread; here there is nothing to overlap).
         NS skip-gram only."""
         from multiverso_tpu.models.wordembedding.skipgram import (
+            build_negative_lut,
             make_ondevice_superbatch_step,
         )
 
@@ -258,7 +259,7 @@ class WordEmbedding:
         superstep = jax.jit(
             make_ondevice_superbatch_step(
                 self.cfg, corpus, keep_dev,
-                self.sampler._prob, self.sampler._alias,
+                build_negative_lut(self.sampler.probs),
                 batch=o.batch_size, steps=S, scale_mode=o.scale_mode,
             ),
             donate_argnums=(0,),

@@ -61,6 +61,7 @@ class AliasSampler:
         probs = (weights / weights.sum()).astype(np.float32)
         prob, alias = _build_alias(probs)
         self.vocab_size = len(counts)
+        self.probs = probs  # normalized unigram^power (LUT building)
         self._prob_np = prob
         self._alias_np = alias
         self._prob = jnp.asarray(prob)

@@ -525,22 +525,13 @@ def make_sorted_superbatch_step(
     return superstep
 
 
-def device_presort(ids: jnp.ndarray, weights: jnp.ndarray):
-    """On-device analog of ``presort_updates``: argsort + run-length weighted
-    counts (cummax/cummin over segment boundaries — no scatter, no
-    searchsorted). Returns (perm, sorted_ids, scale) with row-mean scaling.
-
-    Used by the fully device-resident pipeline where ids are generated on
-    device and a host round trip would defeat the point. ~0.7ms/49k ids on
-    v5e — slower than the host counting sort overlapped in the producer
-    thread, so the host path stays the default when host/link bandwidth
-    allows."""
+def _run_length_scale(i2: jnp.ndarray, w2: jnp.ndarray) -> jnp.ndarray:
+    """Row-mean scale over an ALREADY-SORTED id block: per-contribution
+    ``w / weighted_count(row)`` via run-length weighted counts (cummax /
+    cummin over segment boundaries — no scatter, no searchsorted)."""
     from jax import lax
 
-    n = ids.shape[0]
-    order = jnp.argsort(ids)
-    i2 = ids[order]
-    w2 = weights[order]
+    n = i2.shape[0]
     idx = jnp.arange(n)
     boundary = i2[1:] != i2[:-1]
     seg_start = jnp.concatenate([jnp.ones((1,), bool), boundary])
@@ -549,15 +540,43 @@ def device_presort(ids: jnp.ndarray, weights: jnp.ndarray):
     end_idx = lax.cummin(jnp.where(seg_end, idx, n - 1), reverse=True)
     cs = jnp.cumsum(w2)
     wsum = cs[end_idx] - cs[start_idx] + w2[start_idx]
-    return order, i2, w2 / jnp.maximum(wsum, 1.0)
+    return w2 / jnp.maximum(wsum, 1.0)
+
+
+def device_presort(ids: jnp.ndarray, weights: jnp.ndarray):
+    """On-device analog of ``presort_updates``: argsort + run-length weighted
+    counts. Returns (perm, sorted_ids, scale) with row-mean scaling.
+
+    Used by the fully device-resident pipeline where ids are generated on
+    device and a host round trip would defeat the point. ~0.7ms/49k ids on
+    v5e — slower than the host counting sort overlapped in the producer
+    thread, so the host path stays the default when host/link bandwidth
+    allows."""
+    order = jnp.argsort(ids)
+    i2 = ids[order]
+    w2 = weights[order]
+    return order, i2, _run_length_scale(i2, w2)
+
+
+def build_negative_lut(probs: np.ndarray, table_bits: int = 22) -> jnp.ndarray:
+    """Quantized inverse-CDF negative table — the TPU-resident form of
+    word2vec's classic sized negative table (the reference's app draws
+    negatives from a precomputed table indexed by a random int; ref:
+    Applications/WordEmbedding/src/util.h:45-66 unigram^3/4 table).
+    2^table_bits int32 entries (default 16 MB in HBM)."""
+    q = 1 << table_bits
+    cdf = np.cumsum(np.asarray(probs, np.float64))
+    cdf /= cdf[-1]
+    return jnp.asarray(
+        np.searchsorted(cdf, (np.arange(q) + 0.5) / q).astype(np.int32)
+    )
 
 
 def make_ondevice_batch_fn(
     config: SkipGramConfig,
     corpus: jnp.ndarray,  # (n,) int32, -1 = sentence boundary
     keep_probs: Optional[jnp.ndarray],  # (V,) subsample keep prob or None
-    prob: jnp.ndarray,  # (V,) alias-method prob table
-    alias: jnp.ndarray,  # (V,) alias table
+    neg_lut: jnp.ndarray,  # (Q,) quantized inverse-CDF negative table
     batch: int,
 ):
     """Device-side skip-gram batch generation: the whole data pipeline as a
@@ -578,17 +597,24 @@ def make_ondevice_batch_fn(
       the marker itself — a documented approximation (the reference walks
       sentences explicitly; with sentences >> window the difference is a
       vanishing fraction of pairs);
-    * negatives by alias draws against unigram^0.75 (same tables as the
-      host sampler).
+    * negatives drawn PRE-SORTED: exponential-spacing sorted uniforms
+      mapped through the monotone quantized inverse-CDF ``neg_lut``
+      (word2vec's own negative-table quantization) — so the dominant
+      scatter needs no on-device argsort and no permutation; negatives are
+      iid, so assigning the sorted block to (pair, slot) positions in order
+      is distribution-identical.
 
-    Returns ``key -> (centers (B,), outputs (B,1+K), weights (B,))``.
+    Returns ``key -> (centers (B,), outputs (B,1+K), weights (B,))`` with
+    ``outputs[:, 1:]`` flat-sorted in column-major order
+    (``negs.T.reshape(-1)`` is sorted).
     """
     n_corpus = corpus.shape[0]
     K = config.negatives
     window = config.window
+    q_size = neg_lut.shape[0]
 
     def sample(key):
-        ks = jax.random.split(key, 7)
+        ks = jax.random.split(key, 6)
         p = jax.random.randint(ks[0], (batch,), 0, n_corpus)
         c = corpus[p]
         eff = jax.random.randint(ks[1], (batch,), 1, window + 1)
@@ -600,18 +626,28 @@ def make_ondevice_batch_fn(
         off = mag * jnp.where(
             jax.random.bernoulli(ks[3], 0.5, (batch,)), 1, -1
         )
-        q = p + off
-        qc = jnp.clip(q, 0, n_corpus - 1)
+        qpos = p + off
+        qc = jnp.clip(qpos, 0, n_corpus - 1)
         t = corpus[qc]
-        valid = (mag <= eff) & (c >= 0) & (t >= 0) & (q == qc)
+        valid = (mag <= eff) & (c >= 0) & (t >= 0) & (qpos == qc)
         cs = jnp.maximum(c, 0)
         ts = jnp.maximum(t, 0)
         if keep_probs is not None:
             u = jax.random.uniform(ks[4], (batch, 2))
             valid = valid & (u[:, 0] < keep_probs[cs]) & (u[:, 1] < keep_probs[ts])
-        ridx = jax.random.randint(ks[5], (batch, K), 0, prob.shape[0])
-        ru = jax.random.uniform(ks[6], (batch, K))
-        negs = jnp.where(ru < prob[ridx], ridx, alias[ridx])
+        # sorted uniforms without a sort: normalized exponential spacings
+        e = -jnp.log(jax.random.uniform(ks[5], (batch * K + 1,), minval=1e-20))
+        su = jnp.cumsum(e)
+        u01 = su[: batch * K] / su[batch * K]
+        idx = jnp.minimum((u01 * q_size).astype(jnp.int32), q_size - 1)
+        flat_sorted = neg_lut[idx]
+        # stride-by-batch assignment: pair b's K negatives are the order
+        # statistics at ranks {b, b+B, ..., b+(K-1)B} — one draw per
+        # quantile stratum (marginals exact, per-pair negatives distinct;
+        # contiguous rank chunks would hand each pair K near-copies of one
+        # word). Column-major reshape keeps the flat block sorted for the
+        # scatter.
+        negs = flat_sorted.reshape(K, batch).T
         outputs = jnp.concatenate([ts[:, None], negs], axis=1)
         return cs, outputs, valid.astype(jnp.float32)
 
@@ -622,8 +658,7 @@ def make_ondevice_superbatch_step(
     config: SkipGramConfig,
     corpus: jnp.ndarray,
     keep_probs: Optional[jnp.ndarray],
-    prob: jnp.ndarray,
-    alias: jnp.ndarray,
+    neg_lut: jnp.ndarray,
     batch: int,
     steps: int,
     scale_mode: str = "row_mean",
@@ -634,7 +669,12 @@ def make_ondevice_superbatch_step(
     NS skip-gram with plain SGD only (the flagship/benchmark config);
     ``scale_mode`` selects row-mean or raw update scaling. Rejected-pair
     weights are binary, so folding them into both the gradient and the
-    scatter scale is idempotent.
+    scatter scale is idempotent. Row-mean counts are taken per contribution
+    class (positives / negatives / centers scattered separately — the
+    sorted-negative block needs no argsort or permutation); a row appearing
+    in two classes within one microbatch takes one mean step per class
+    (documented deviation from the host path's joint count; weights are
+    over the same draws, so the long-run updates agree).
 
     Signature: ``(params, key, lr) -> (params, (mean_loss, accepted_pairs))``
     — ``accepted_pairs`` is the number of weight>0 pairs actually trained,
@@ -644,19 +684,18 @@ def make_ondevice_superbatch_step(
     assert not config.cbow, "device pipeline supports NS skip-gram only"
     assert scale_mode in ("row_mean", "raw"), scale_mode
     raw = scale_mode == "raw"
-    sample = make_ondevice_batch_fn(config, corpus, keep_probs, prob, alias, batch)
-    k1 = 1 + config.negatives
+    sample = make_ondevice_batch_fn(config, corpus, keep_probs, neg_lut, batch)
+    K = config.negatives
 
-    def _presort(ids, w):
-        if raw:
-            order = jnp.argsort(ids)
-            return order, ids[order], w[order]
-        return device_presort(ids, w)
+    def _scale_sorted(i2, w2):
+        """Row-mean (or raw) scale over an ALREADY-SORTED id block."""
+        return w2 if raw else _run_length_scale(i2, w2)
 
     def superstep(params, key, lr):
         def body(params, key):
             emb_in, emb_out = params["emb_in"], params["emb_out"]
             c, o, w = sample(key)
+            ts, negs = o[:, 0], o[:, 1:]
             vin = emb_in[c]
             vout = emb_out[o]
             logits = jnp.einsum("bd,bkd->bk", vin, vout)
@@ -665,12 +704,26 @@ def make_ondevice_superbatch_step(
             loss = jnp.sum(_bce_sum(logits, labels) * w) / n_valid
             g = (jax.nn.sigmoid(logits) - labels) * w[:, None]
             d_vin = jnp.einsum("bk,bkd->bd", g, vout)
-            op, osort, oscale = _presort(o.reshape(-1), jnp.repeat(w, k1))
-            upd_o = (g.reshape(-1)[op] * oscale)[:, None] * vin[op // k1]
-            emb_out = emb_out.at[osort].add(-lr * upd_o, indices_are_sorted=True)
-            ip, isort, iscale = _presort(c, w)
-            upd_i = d_vin[ip] * iscale[:, None]
-            emb_in = emb_in.at[isort].add(-lr * upd_i, indices_are_sorted=True)
+            # negatives block: column-major flatten is sorted by
+            # construction — scatter with no argsort and no permutation
+            # (sorted position j belongs to pair j % B, slot j // B)
+            nflat = negs.T.reshape(-1)
+            gneg = g[:, 1:].T.reshape(-1)
+            nsc = _scale_sorted(nflat, jnp.tile(w, K))
+            upd_n = (gneg * nsc)[:, None] * vin[jnp.arange(batch * K) % batch]
+            emb_out = emb_out.at[nflat].add(-lr * upd_n, indices_are_sorted=True)
+            # positives: small (B) argsort
+            operm = jnp.argsort(ts)
+            ts2 = ts[operm]
+            psc = _scale_sorted(ts2, w[operm])
+            upd_p = (g[:, 0][operm] * psc)[:, None] * vin[operm]
+            emb_out = emb_out.at[ts2].add(-lr * upd_p, indices_are_sorted=True)
+            # input table: small (B) argsort
+            iperm = jnp.argsort(c)
+            is2 = c[iperm]
+            isc = _scale_sorted(is2, w[iperm])
+            upd_i = d_vin[iperm] * isc[:, None]
+            emb_in = emb_in.at[is2].add(-lr * upd_i, indices_are_sorted=True)
             new = {**params, "emb_in": emb_in, "emb_out": emb_out}
             return new, (loss, jnp.sum(w))
 

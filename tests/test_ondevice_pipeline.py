@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from multiverso_tpu.models.wordembedding.sampler import AliasSampler
 from multiverso_tpu.models.wordembedding.skipgram import (
     SkipGramConfig,
+    build_negative_lut,
     device_presort,
     init_params,
     make_ondevice_batch_fn,
@@ -34,10 +35,9 @@ def test_device_presort_matches_numpy():
     assert np.allclose(np.asarray(sc), ref, atol=1e-6)
 
 
-def _toy_tables(V):
+def _toy_lut(V):
     counts = np.arange(1, V + 1, dtype=np.int64)
-    s = AliasSampler(counts)
-    return s._prob, s._alias
+    return build_negative_lut(AliasSampler(counts).probs, table_bits=16)
 
 
 def test_ondevice_batch_masks_boundaries_and_subsample():
@@ -47,14 +47,13 @@ def test_ondevice_batch_masks_boundaries_and_subsample():
     # center/target of 0 would prove a marker leaked through the mask
     corpus_np = 1 + (np.arange(200, dtype=np.int32) % (V - 1))
     corpus_np[::10] = -1  # sentence markers every 10 tokens
-    prob, alias = _toy_tables(V)
+    lut = _toy_lut(V)
     # keep prob 0 for word 7: any pair touching it must be masked out
     keep = np.ones(V, np.float32)
     keep[7] = 0.0
     fn = jax.jit(
         make_ondevice_batch_fn(
-            cfg, jnp.asarray(corpus_np), jnp.asarray(keep),
-            jnp.asarray(prob), jnp.asarray(alias), batch=512,
+            cfg, jnp.asarray(corpus_np), jnp.asarray(keep), lut, batch=512,
         )
     )
     c, o, w = fn(jax.random.PRNGKey(0))
@@ -82,11 +81,10 @@ def test_ondevice_offset_distribution_matches_word2vec():
     # so the offset of a live pair is recoverable from values
     n = 1 << 14
     corpus_np = (np.arange(n, dtype=np.int32) % V)
-    prob, alias = _toy_tables(V)
+    lut = _toy_lut(V)
     fn = jax.jit(
         make_ondevice_batch_fn(
-            cfg, jnp.asarray(corpus_np), None,
-            jnp.asarray(prob), jnp.asarray(alias), batch=1 << 15,
+            cfg, jnp.asarray(corpus_np), None, lut, batch=1 << 15,
         )
     )
     c, o, w = fn(jax.random.PRNGKey(3))
@@ -109,11 +107,9 @@ def test_ondevice_training_reduces_loss():
     p = rng.randint(0, V // 2, 2000) * 2
     base = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
     corpus = jnp.asarray(base.astype(np.int32))
-    prob, alias = _toy_tables(V)
     step = jax.jit(
         make_ondevice_superbatch_step(
-            cfg, corpus, None, jnp.asarray(prob), jnp.asarray(alias),
-            batch=256, steps=4,
+            cfg, corpus, None, _toy_lut(V), batch=256, steps=4,
         ),
         donate_argnums=(0,),
     )
@@ -186,15 +182,13 @@ def test_ondevice_step_shards_over_mesh():
         cfg = SkipGramConfig(vocab_size=V, dim=16, negatives=3, window=2)
         rng = np.random.RandomState(0)
         corpus = jnp.asarray(rng.randint(0, V, 4096).astype(np.int32))
-        prob, alias = _toy_tables(V)
         tab = mesh_lib.table_sharding(mesh, 2)
         params = {
             k: jax.device_put(v, tab) for k, v in init_params(cfg).items()
         }
         step = jax.jit(
             make_ondevice_superbatch_step(
-                cfg, corpus, None, jnp.asarray(prob), jnp.asarray(alias),
-                batch=64, steps=2,
+                cfg, corpus, None, _toy_lut(V), batch=64, steps=2,
             ),
             out_shardings=(
                 {"emb_in": tab, "emb_out": tab},
@@ -209,3 +203,30 @@ def test_ondevice_step_shards_over_mesh():
     finally:
         mv.MV_ShutDown(finalize=True)
         ResetFlagsToDefault()
+
+
+def test_ondevice_negatives_follow_unigram_power():
+    """LUT negatives approximate unigram^0.75 (word2vec's own quantized
+    negative-table scheme) and arrive flat-sorted (the no-argsort
+    contract the superstep's scatter relies on)."""
+    V = 32
+    cfg = SkipGramConfig(vocab_size=V, dim=8, negatives=4, window=2)
+    corpus = jnp.asarray((np.arange(4096) % V).astype(np.int32))
+    counts = np.arange(1, V + 1, dtype=np.int64)
+    s = AliasSampler(counts)
+    fn = jax.jit(
+        make_ondevice_batch_fn(
+            cfg, corpus, None, build_negative_lut(s.probs, table_bits=16),
+            batch=1 << 14,
+        )
+    )
+    _, o, _ = fn(jax.random.PRNGKey(5))
+    negs = np.asarray(o)[:, 1:]
+    flat = negs.T.reshape(-1)   # column-major flatten is the sorted order
+    assert np.all(np.diff(flat) >= 0), "negatives must be flat-sorted"
+    # per-pair negatives must be (mostly) distinct — contiguous rank chunks
+    # would hand each pair K near-copies of one word
+    distinct = np.mean([len(np.unique(row)) for row in negs[:512]])
+    assert distinct > 0.8 * negs.shape[1], distinct
+    freq = np.bincount(flat, minlength=V) / flat.size
+    assert np.all(np.abs(freq - s.probs) < 0.01), np.abs(freq - s.probs).max()
