@@ -236,6 +236,154 @@ class WordEmbedding:
             )
         return loss
 
+    # ---------------------------------------------------------- PS mode
+
+    def _ps_setup(self):
+        """Create the PS tables (ref: communicator.cpp:17-31
+        PrepareParameterTables — input matrix, output matrix; the
+        reference's AdaGrad g2 tables are not implemented in PS mode,
+        rejected below)."""
+        CHECK(not self.opt.use_adagrad,
+              "-use_ps does not support -use_adagrad (plain SGD blocks only)")
+        from multiverso_tpu.api import MV_CreateTable
+        from multiverso_tpu.tables import MatrixTableOption
+
+        V, D = self.cfg.vocab_size, self.opt.size
+        out_rows = int(self.params["emb_out"].shape[0])
+        scale = 0.5 / D
+        self._t_in = MV_CreateTable(MatrixTableOption(
+            num_row=V, num_col=D, init_uniform=(-scale, scale),
+            seed=self.cfg.seed, name="we_emb_in",
+        ))
+        self._t_out = MV_CreateTable(MatrixTableOption(
+            num_row=out_rows, num_col=D, name="we_emb_out",
+        ))
+        # delta-averaging divisor = concurrent delta-pushing clients (the
+        # reference divides by its per-PROCESS worker count —
+        # communicator.cpp AddDeltaParameter); mesh worker slices within
+        # one process are a single logical client
+        self._num_workers = max(jax.process_count(), 1)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Pad union sizes to power-of-two buckets: bounded recompiles."""
+        b = 1024
+        while b < n:
+            b *= 2
+        return b
+
+    def _run_superbatch_ps(self, batches: list, lr: float) -> jax.Array:
+        """One PS block (ref: the Communicator protocol —
+        communicator.cpp:117-155 RequestParameter pulls the block's vocab
+        subset, :157-249 AddDeltaParameter re-reads and pushes
+        (new - old)/num_workers): pull touched rows into a compact local
+        model, run the block's microbatches locally (sorted-scatter
+        superstep over remapped ids), push the averaged delta."""
+        from multiverso_tpu.models.wordembedding.skipgram import (
+            SkipGramConfig,
+            make_sorted_superbatch_step,
+            presort_batch,
+        )
+
+        o = self.opt
+        # block node sets (ref: data_block SetWeightIE input/output nodes)
+        uin = np.unique(np.concatenate([b["centers"] for b in batches]))
+        okey = "points" if o.hs else "outputs"
+        uout = np.unique(np.concatenate([b[okey].reshape(-1) for b in batches]))
+        if o.cbow:
+            ctx = np.concatenate([b["contexts"].reshape(-1) for b in batches])
+            uin = np.unique(np.concatenate([uin, np.maximum(ctx, 0)]))
+        ni, no = self._bucket(len(uin)), self._bucket(len(uout))
+        # RequestParameter: pull rows, pad to the bucket
+        Win = np.zeros((ni, o.size), np.float32)
+        Win[: len(uin)] = self._t_in.get_rows(uin)
+        Wout = np.zeros((no, o.size), np.float32)
+        Wout[: len(uout)] = self._t_out.get_rows(uout)
+        params = {"emb_in": jnp.asarray(Win), "emb_out": jnp.asarray(Wout)}
+        # remap ids into the compact local vocab + rebuild sort metadata
+        remapped = []
+        for b in batches:
+            rb = {"centers": np.searchsorted(uin, b["centers"]).astype(np.int32)}
+            if o.hs:
+                rb["points"] = np.searchsorted(uout, b["points"]).astype(np.int32)
+                rb["codes"], rb["lengths"] = b["codes"], b["lengths"]
+            else:
+                rb["outputs"] = np.searchsorted(uout, b["outputs"]).astype(np.int32)
+            if o.cbow:
+                cx = b["contexts"]
+                rb["contexts"] = np.where(
+                    cx >= 0, np.searchsorted(uin, np.maximum(cx, 0)), -1
+                ).astype(np.int32)
+            remapped.append(
+                presort_batch(rb, hs=o.hs, cbow=o.cbow, scale_mode=o.scale_mode)
+            )
+        key = (ni, no, len(batches))
+        step = self._ps_steps.get(key)
+        if step is None:
+            cfg = SkipGramConfig(
+                vocab_size=ni, dim=o.size, negatives=o.negative,
+                cbow=o.cbow, window=o.window,
+            )
+            step = jax.jit(
+                make_sorted_superbatch_step(cfg, hs=o.hs),
+                donate_argnums=(0,),
+            )
+            self._ps_steps[key] = step
+        xs = {
+            k: jnp.asarray(np.stack([b[k] for b in remapped]))
+            for k in remapped[0]
+            if remapped[0][k] is not None
+        }
+        new_params, loss = step(params, xs, jnp.float32(lr))
+        # AddDeltaParameter: (new - old) / num_workers back into the tables
+        din = (np.asarray(new_params["emb_in"])[: len(uin)] - Win[: len(uin)])
+        dout = (np.asarray(new_params["emb_out"])[: len(uout)] - Wout[: len(uout)])
+        self._t_in.add_rows(uin, din / self._num_workers)
+        self._t_out.add_rows(uout, dout / self._num_workers)
+        return loss
+
+    def _train_ps(self, source, total_pairs_est: float, start: float) -> float:
+        """PS-mode training loop: block = steps_per_call microbatches."""
+        o = self.opt
+        self._ps_setup()
+        self._ps_steps: Dict = {}
+        S = max(1, o.steps_per_call)
+        loss_dev = None
+        pairs_done = 0
+        log_every = o.batch_size * max(64, S * 8)
+        for epoch in range(o.epoch):
+            it = source.batches(epoch)
+            done = False
+            while not done:
+                group = []
+                while len(group) < S:
+                    batch = next(it, None)
+                    if batch is None:
+                        done = True
+                        break
+                    group.append(batch)
+                if not group:
+                    break
+                lr = self._lr(pairs_done / total_pairs_est)
+                loss_dev = self._run_superbatch_ps(group, lr)
+                prev = pairs_done
+                pairs_done += o.batch_size * len(group)
+                if pairs_done // log_every > prev // log_every:
+                    rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+                    Log.Info(
+                        "[WordEmbedding] PS epoch %d: %.1fM pairs, %.0fk pairs/s, "
+                        "lr %.5f, loss %.4f",
+                        epoch, pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
+                    )
+        # the trained model lives in the tables; refresh local params for
+        # save_embeddings (ref: SaveEmbedding batched row Gets)
+        self.params["emb_in"] = jnp.asarray(self._t_in.get())
+        self.params["emb_out"] = jnp.asarray(self._t_out.get())
+        self.words_trained = pairs_done
+        if o.output_file:
+            self.save_embeddings(o.output_file, binary=o.binary)
+        return float(loss_dev) if loss_dev is not None else 0.0
+
     def _train_ondevice(self, ids: np.ndarray, keep: Optional[np.ndarray]) -> float:
         """Fully device-resident training (-device_pipeline): the corpus is
         uploaded once; sampling, negatives, presort and updates run inside
@@ -354,8 +502,18 @@ class WordEmbedding:
             ids = self.dict.encode_corpus(o.train_file.split(";"))
         ids = np.ascontiguousarray(ids, np.int32)
         keep = subsample_keep_probs(self.dict.counts, o.sample)
+        CHECK(not (o.device_pipeline and o.use_ps),
+              "-device_pipeline and -use_ps are mutually exclusive "
+              "(fused HBM tables vs parameter-server tables)")
         if o.device_pipeline:
             return self._train_ondevice(ids, keep)
+        if o.use_ps and jax.process_count() > 1:
+            # each process is one PS client training its corpus shard (the
+            # reference's per-node data split; deltas average by
+            # process_count in _run_superbatch_ps)
+            bounds = np.linspace(0, len(ids), jax.process_count() + 1).astype(np.int64)
+            pi = jax.process_index()
+            ids = ids[bounds[pi]: bounds[pi + 1]]
         def make_pipeline(shard_ids, seed):
             return BatchPipeline(
                 shard_ids,
@@ -367,7 +525,9 @@ class WordEmbedding:
                 sampler=self.sampler,
                 huffman=self.huffman,
                 seed=seed,
-                presort=o.presort,
+                # PS blocks presort against REMAPPED compact ids inside
+                # _run_superbatch_ps; global-id presort here would be wasted
+                presort=o.presort and not o.use_ps,
                 scale_mode=o.scale_mode,
             )
 
@@ -393,6 +553,8 @@ class WordEmbedding:
             if o.is_pipeline
             else pipeline
         )
+        if o.use_ps:
+            return self._train_ps(source, total_pairs_est, start)
         S = max(1, o.steps_per_call)
         log_every = o.batch_size * max(64, S * 8)
         for epoch in range(o.epoch):

@@ -242,3 +242,32 @@ def test_save_and_eval_roundtrip(tmp_path):
         words, emb, [("a0", "a1", 9.0), ("a0", "b0", 1.0), ("a1", "b1", 1.5)]
     )
     assert n == 3
+
+
+def test_app_ps_mode_trains(mv_env):
+    """-use_ps: embeddings live in MatrixTables, blocks pull rows / train
+    locally / push (new-old)/num_workers deltas (ref: communicator.cpp
+    RequestParameter:117-155, AddDeltaParameter:157-249). Structured-pair
+    corpus: loss must drop well below the ln2*(K+1) no-signal floor."""
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+    rng = np.random.RandomState(0)
+    V = 200
+    p = rng.randint(0, V // 2, 8000) * 2
+    ids = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1).astype(np.int32)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(V)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(
+        np.bincount(np.maximum(ids, 0), minlength=V), 1
+    ).astype(np.int64)
+    opt = WEOptions(
+        size=16, negative=3, window=2, batch_size=512, steps_per_call=2,
+        epoch=4, sample=0, alpha=0.2, output_file="", use_ps=True,
+        is_pipeline=False,
+    )
+    we = WordEmbedding(opt, dictionary=d)
+    loss = we.train(ids=ids)
+    assert np.isfinite(loss)
+    assert loss < 2.0, f"PS mode failed to learn: {loss} (floor 2.77)"
