@@ -248,6 +248,13 @@ class Runtime:
         """Assign the next dense table id (ref: src/zoo.cpp:178-187 —
         consistent across ranks because creation order is identical)."""
         self._require_started()
+        # -ma mode skips the parameter server entirely (ref: zoo.cpp:49
+        # StartPS not called); tables cannot exist without it
+        if GetFlag("ma"):
+            Log.Fatal(
+                "cannot create tables in model-averaging mode (-ma=true); "
+                "use MV_Aggregate, or start without -ma"
+            )
         table_id = len(self._tables)
         self._tables.append(table)
         return table_id

@@ -69,3 +69,23 @@ def test_reinit_with_different_mesh_rejected(mv_env):
 
     with pytest.raises(FatalError):
         mv_env.MV_Init(num_shards=2)  # already started with a 1-D mesh
+
+
+def test_ma_mode_rejects_tables():
+    """-ma skips the parameter server (ref: zoo.cpp:49); table creation
+    must fail loudly, matching the reference's no-PS topology."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import ArrayTableOption
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+    from multiverso_tpu.utils.log import FatalError
+
+    ResetFlagsToDefault()
+    mv.MV_Init(["-ma=true"])
+    try:
+        agg = mv.MV_Aggregate(np.ones((mv.MV_NumWorkers(), 4), np.float32))
+        assert np.allclose(agg, mv.MV_NumWorkers())
+        with pytest.raises(FatalError, match="model-averaging"):
+            mv.MV_CreateTable(ArrayTableOption(size=4))
+    finally:
+        mv.MV_ShutDown(finalize=True)
+        ResetFlagsToDefault()
