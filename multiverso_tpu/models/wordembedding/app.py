@@ -16,9 +16,10 @@ Two training paths:
   collapses into the step's gathers/scatters).
 * **PS mode** (``-use_ps=true``): embeddings live in MatrixTables; each data
   block pulls the rows it needs, trains locally, and pushes
-  ``(new - old) / num_workers`` deltas — the reference Communicator protocol
+  ``(new - old)`` deltas — the reference Communicator protocol
   (ref: communicator.cpp:117-155 RequestParameter, :157-249
-  AddDeltaParameter), for multi-controller deployments.
+  AddDeltaParameter). Single-process only: per-block row unions are not
+  SPMD-consistent across processes (see the CHECK in ``_ps_setup``).
 """
 
 from __future__ import annotations
@@ -245,6 +246,18 @@ class WordEmbedding:
         rejected below)."""
         CHECK(not self.opt.use_adagrad,
               "-use_ps does not support -use_adagrad (plain SGD blocks only)")
+        # Multi-process PS-mode WE is rejected: tables are globally-sharded
+        # jax.Arrays, so every jitted get_rows/add_rows is a lockstep SPMD
+        # collective — but each process's blocks have different row unions,
+        # bucket shapes, and block counts (corpus shards differ), so the
+        # processes would issue DIFFERENT programs against the same global
+        # arrays: deadlock or silent divergence. A multi-process PS protocol
+        # needs a globally-agreed row union + fixed bucket shape per block
+        # round (host_local_to_global); until then, fail loudly.
+        CHECK(jax.process_count() == 1,
+              "-use_ps requires a single-process runtime (block row-unions "
+              "are not SPMD-consistent across processes); use the fused "
+              "path or -device_pipeline for multi-process runs")
         from multiverso_tpu.api import MV_CreateTable
         from multiverso_tpu.tables import MatrixTableOption
 
@@ -258,11 +271,11 @@ class WordEmbedding:
         self._t_out = MV_CreateTable(MatrixTableOption(
             num_row=out_rows, num_col=D, name="we_emb_out",
         ))
-        # delta-averaging divisor = concurrent delta-pushing clients (the
-        # reference divides by its per-PROCESS worker count —
-        # communicator.cpp AddDeltaParameter); mesh worker slices within
-        # one process are a single logical client
-        self._num_workers = max(jax.process_count(), 1)
+        # delta-averaging divisor = concurrent delta-pushing clients (ref:
+        # communicator.cpp AddDeltaParameter divides by its worker count).
+        # Constant 1 while the CHECK above pins PS mode to one process —
+        # mesh worker slices within the process are a single logical client.
+        self._num_workers = 1
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -429,23 +442,40 @@ class WordEmbedding:
         accepted_dev = jnp.float32(0.0)
         pairs_done = 0
         calls = 0
+        synced_calls = 0
+        # accepted pairs per call, refined at each sync; the initial value is
+        # the hard upper bound (every slot accepted), so the projection can
+        # only over-estimate progress — it forces an early sync, never an
+        # overshoot past total_pairs by a whole log window
+        ppc = float(per_call)
         log_every = max(1, est_calls // 20)
         while pairs_done < total_pairs and calls < max_calls:
-            lr = self._lr(pairs_done / total_pairs)
+            # smooth lr decay between host syncs: project progress from the
+            # measured accepted-rate instead of holding the last synced count
+            projected = pairs_done + ppc * (calls - synced_calls)
+            lr = self._lr(min(projected, total_pairs) / total_pairs)
             key, sub = jax.random.split(key)
             self.params, (loss_dev, acc) = superstep(
                 self.params, sub, jnp.float32(lr)
             )
             accepted_dev = accepted_dev + acc
             calls += 1
-            if calls % log_every == 0:
-                pairs_done = int(float(accepted_dev))  # one sync per window
-                rate = pairs_done / max(time.perf_counter() - start, 1e-9)
-                Log.Info(
-                    "[WordEmbedding] device-pipeline: %.1fM pairs, %.0fk "
-                    "pairs/s, lr %.5f, loss %.4f",
-                    pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
-                )
+            projected = pairs_done + ppc * (calls - synced_calls)
+            if calls % log_every == 0 or projected >= total_pairs:
+                # drain the device accumulator into an exact host count and
+                # reset it: a run-long float32 sum loses integer precision
+                # past 2^24 accepted pairs (one host sync per window either way)
+                pairs_done += int(float(accepted_dev))
+                accepted_dev = jnp.float32(0.0)
+                ppc = max(1.0, pairs_done / calls)
+                synced_calls = calls
+                if calls % log_every == 0:
+                    rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+                    Log.Info(
+                        "[WordEmbedding] device-pipeline: %.1fM pairs, %.0fk "
+                        "pairs/s, lr %.5f, loss %.4f",
+                        pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
+                    )
         if calls >= max_calls and pairs_done < total_pairs:
             Log.Error(
                 "[WordEmbedding] device-pipeline hit the %d-call bound at "
@@ -454,7 +484,8 @@ class WordEmbedding:
                 max_calls, pairs_done / 1e6, total_pairs / 1e6,
             )
         jax.block_until_ready(self.params)
-        self.words_trained = int(float(accepted_dev))
+        pairs_done += int(float(accepted_dev))  # drain the final window
+        self.words_trained = pairs_done
         rate = self.words_trained / max(time.perf_counter() - start, 1e-9)
         Log.Info(
             "[WordEmbedding] device-pipeline done: %.1fM pairs in %.1fs (%.0fk pairs/s)",
@@ -507,13 +538,6 @@ class WordEmbedding:
               "(fused HBM tables vs parameter-server tables)")
         if o.device_pipeline:
             return self._train_ondevice(ids, keep)
-        if o.use_ps and jax.process_count() > 1:
-            # each process is one PS client training its corpus shard (the
-            # reference's per-node data split; deltas average by
-            # process_count in _run_superbatch_ps)
-            bounds = np.linspace(0, len(ids), jax.process_count() + 1).astype(np.int64)
-            pi = jax.process_index()
-            ids = ids[bounds[pi]: bounds[pi + 1]]
         def make_pipeline(shard_ids, seed):
             return BatchPipeline(
                 shard_ids,

@@ -213,6 +213,10 @@ class PrefetchPipeline:
                         return
             except BaseException as e:  # propagate, never truncate silently
                 error.append(e)
+                # poison the ready queue NOW: the consumer's next pop fails
+                # fast instead of draining the surviving shards' whole epoch
+                # (at most `depth` already-queued batches are delivered first)
+                ready.exit()
             finally:
                 with live_lock:
                     live[0] -= 1

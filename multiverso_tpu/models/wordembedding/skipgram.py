@@ -600,9 +600,13 @@ def make_ondevice_batch_fn(
     * negatives drawn PRE-SORTED: exponential-spacing sorted uniforms
       mapped through the monotone quantized inverse-CDF ``neg_lut``
       (word2vec's own negative-table quantization) — so the dominant
-      scatter needs no on-device argsort and no permutation; negatives are
-      iid, so assigning the sorted block to (pair, slot) positions in order
-      is distribution-identical.
+      scatter needs no on-device argsort and no permutation. Because the
+      draws are iid and slot contents exchangeable, the BATCH-level negative
+      distribution (and hence the summed gradient's expectation) matches
+      unigram^3/4 exactly; per-slot marginals do not — slot b always
+      receives order statistics of ranks {b, b+B, ...}, biased toward low
+      (frequent) ids. A per-pair-iid guarantee would need the permutation
+      this path exists to avoid.
 
     Returns ``key -> (centers (B,), outputs (B,1+K), weights (B,))`` with
     ``outputs[:, 1:]`` flat-sorted in column-major order

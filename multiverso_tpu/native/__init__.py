@@ -365,5 +365,7 @@ def alias_sample(
     prob = np.ascontiguousarray(prob, np.float32)
     alias = np.ascontiguousarray(alias, np.int32)
     out = np.empty(n, np.int32)
-    lib.we_alias_sample(prob, alias, len(prob), n, seed or 1, out)
+    rc = lib.we_alias_sample(prob, alias, len(prob), n, seed or 1, out)
+    if rc != n:  # error convention parity with presort/ns_finalize wrappers
+        return None
     return out
