@@ -91,7 +91,7 @@ def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=64,
     return best
 
 
-def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=64,
+def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=128,
                     corpus_tokens=8_000_000):
     """Zero-host-traffic mode: corpus resident in HBM, sampling/negatives/
     presort inside the jitted step (-device_pipeline). Reported as a
@@ -111,8 +111,8 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=64,
     )
     step = jax.jit(
         make_ondevice_superbatch_step(
-            cfg, jnp.asarray(corpus), None, build_negative_lut(sampler.probs),
-            batch=batch, steps=scan_steps,
+            cfg, corpus, None, build_negative_lut(sampler.probs),
+            batch=batch, steps=scan_steps, neg_probs=sampler.probs,
         ),
         donate_argnums=(0,),
     )

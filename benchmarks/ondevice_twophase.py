@@ -1,0 +1,115 @@
+"""Experiment: two-phase on-device superstep — vmapped sampling for all S
+microbatches, then scan of the update step over precomputed arrays, vs the
+current interleaved sample-in-scan-body design.
+
+    python benchmarks/ondevice_twophase.py [B] [S]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from multiverso_tpu.models.wordembedding.sampler import AliasSampler
+    from multiverso_tpu.models.wordembedding.skipgram import (
+        SkipGramConfig, _run_length_scale, build_negative_lut, init_params,
+        make_ondevice_batch_fn, make_ondevice_superbatch_step,
+    )
+
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    S = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    cfg = SkipGramConfig(vocab_size=100_000, dim=128, negatives=5)
+    K = cfg.negatives
+    D = cfg.dim
+    rng = np.random.RandomState(0)
+    N = 8_000_000
+    corpus_np = rng.randint(0, cfg.vocab_size, N).astype(np.int32)
+    corpus_np[rng.randint(0, N, N // 20)] = -1
+    corpus = jnp.asarray(corpus_np)
+    sampler = AliasSampler(
+        np.bincount(corpus_np[corpus_np >= 0], minlength=cfg.vocab_size).astype(np.int64))
+    lut = build_negative_lut(sampler.probs)
+    key = jax.random.PRNGKey(0)
+    lr = jnp.float32(0.025)
+    pairs = B * S
+    sample = make_ondevice_batch_fn(cfg, corpus, None, lut, B)
+
+    def two_phase(params, key, lr):
+        keys = jax.random.split(key, S)
+        c, o, w = jax.vmap(sample)(keys)          # (S,B) (S,B,1+K) (S,B)
+        ts = o[:, :, 0]
+        # per-microbatch presort of centers and positives (negatives flat
+        # block is sorted by construction)
+        iperm = jnp.argsort(c, axis=1)
+        is2 = jnp.take_along_axis(c, iperm, axis=1)
+        wi = jnp.take_along_axis(w, iperm, axis=1)
+        isc = jax.vmap(_run_length_scale)(is2, wi)
+        operm = jnp.argsort(ts, axis=1)
+        ts2 = jnp.take_along_axis(ts, operm, axis=1)
+        wo = jnp.take_along_axis(w, operm, axis=1)
+        osc = jax.vmap(_run_length_scale)(ts2, wo)
+        nflat = jnp.swapaxes(o[:, :, 1:], 1, 2).reshape(S, B * K)
+        nsc = jax.vmap(_run_length_scale)(nflat, jnp.tile(w, (1, K)))
+
+        def body(params, xs):
+            emb_in, emb_out = params["emb_in"], params["emb_out"]
+            c, o, w, iperm, is2, isc, operm, ts2, osc, nflat, nsc = xs
+            vin = emb_in[c]
+            vout = emb_out[o]
+            logits = jnp.einsum("bd,bkd->bk", vin, vout)
+            labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+            n_valid = jnp.maximum(jnp.sum(w), 1.0)
+            ls = jnp.log1p(jnp.exp(-jnp.abs(logits))) + jnp.maximum(logits, 0) - logits * labels
+            loss = jnp.sum(jnp.sum(ls, axis=1) * w) / n_valid
+            g = (jax.nn.sigmoid(logits) - labels) * w[:, None]
+            d_vin = jnp.einsum("bk,bkd->bd", g, vout)
+            gneg = g[:, 1:].T.reshape(-1)
+            upd_n = (gneg * nsc)[:, None] * jnp.tile(vin, (K, 1))
+            emb_out = emb_out.at[nflat].add(-lr * upd_n, indices_are_sorted=True)
+            upd_p = (g[:, 0][operm] * osc)[:, None] * vin[operm]
+            emb_out = emb_out.at[ts2].add(-lr * upd_p, indices_are_sorted=True)
+            upd_i = d_vin[iperm] * isc[:, None]
+            emb_in = emb_in.at[is2].add(-lr * upd_i, indices_are_sorted=True)
+            new = {**params, "emb_in": emb_in, "emb_out": emb_out}
+            return new, (loss, jnp.sum(w))
+
+        params, (losses, acc) = jax.lax.scan(
+            body, params, (c, o, w, iperm, is2, isc, operm, ts2, osc, nflat, nsc))
+        return params, (jnp.mean(losses), jnp.sum(acc))
+
+    def bench(name, fn, params):
+        key = jax.random.PRNGKey(1)
+        for _ in range(2):
+            key, sub = jax.random.split(key)
+            params, (loss, acc) = fn(params, sub, lr)
+        float(loss)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            acc_t = jnp.float32(0)
+            for _ in range(5):
+                key, sub = jax.random.split(key)
+                params, (loss, acc) = fn(params, sub, lr)
+                acc_t = acc_t + acc
+            tot = float(acc_t)
+            dt = time.perf_counter() - t0
+            best = max(best, tot / dt)
+        print(f"{name:32s} accepted {best/1e6:.2f}M pairs/s  "
+              f"(raw {best / (tot/(5*pairs)) / 1e6:.2f}M)")
+        return params
+
+    cur = jax.jit(make_ondevice_superbatch_step(
+        cfg, corpus, None, lut, batch=B, steps=S), donate_argnums=(0,))
+    bench(f"current interleaved B={B} S={S}", cur, init_params(cfg))
+    tp = jax.jit(two_phase, donate_argnums=(0,))
+    bench(f"two-phase B={B} S={S}", tp, init_params(cfg))
+
+
+if __name__ == "__main__":
+    main()

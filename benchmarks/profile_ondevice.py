@@ -1,0 +1,152 @@
+"""Component profile of the on-device pipeline superstep (-device_pipeline).
+
+Times jitted scans of isolated pieces of make_ondevice_superbatch_step to
+find where the 8192-pair microbatch budget goes. Run on the real chip:
+
+    python benchmarks/profile_ondevice.py [B] [S]
+
+Timing closed by host read-back (block_until_ready unreliable on axon),
+best-of-3 interleaved (noisy shared box).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timed(name, fn, *args, calls=3, scale_pairs=None):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda x: float(jnp.sum(x)) if hasattr(x, "dtype") else x,
+                           out)
+    best = 1e30
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            out = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: float(jnp.sum(x)) if hasattr(x, "dtype") else x, out)
+        best = min(best, (time.perf_counter() - t0) / calls)
+    extra = ""
+    if scale_pairs:
+        extra = f"  ({scale_pairs / best / 1e6:.2f}M pairs/s)"
+    print(f"{name:46s} {best * 1e3:8.2f} ms/call{extra}")
+    return best
+
+
+def main():
+    from multiverso_tpu.models.wordembedding.sampler import AliasSampler
+    from multiverso_tpu.models.wordembedding.skipgram import (
+        SkipGramConfig, build_negative_lut, init_params,
+        make_ondevice_batch_fn, make_ondevice_superbatch_step,
+    )
+
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    S = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    cfg = SkipGramConfig(vocab_size=100_000, dim=128, negatives=5)
+    K = cfg.negatives
+    rng = np.random.RandomState(0)
+    N = 8_000_000
+    corpus_np = rng.randint(0, cfg.vocab_size, N).astype(np.int32)
+    corpus_np[rng.randint(0, N, N // 20)] = -1
+    corpus = jnp.asarray(corpus_np)
+    sampler = AliasSampler(
+        np.bincount(corpus_np[corpus_np >= 0], minlength=cfg.vocab_size).astype(np.int64))
+    lut = build_negative_lut(sampler.probs)
+    params = init_params(cfg)
+    key = jax.random.PRNGKey(0)
+    lr = jnp.float32(0.025)
+    pairs = B * S
+
+    # ---- full current step
+    full = jax.jit(make_ondevice_superbatch_step(
+        cfg, corpus, None, lut, batch=B, steps=S))
+    timed(f"full superstep B={B} S={S}", lambda: full(params, key, lr),
+          scale_pairs=pairs)
+
+    # ---- sampling only
+    sample = make_ondevice_batch_fn(cfg, corpus, None, lut, B)
+
+    @jax.jit
+    def sample_only(key):
+        def body(acc, k):
+            c, o, w = sample(k)
+            return acc + jnp.sum(c) + jnp.sum(o) + jnp.sum(w), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0), jax.random.split(key, S))
+        return acc
+    timed("  sampling only", sample_only, key, scale_pairs=pairs)
+
+    # ---- argsort cost (the two B-sized argsorts)
+    @jax.jit
+    def argsorts_only(key):
+        def body(acc, k):
+            c, o, w = sample(k)
+            p1 = jnp.argsort(o[:, 0])
+            p2 = jnp.argsort(c)
+            return acc + p1[0] + p2[0], None
+        acc, _ = jax.lax.scan(body, jnp.int32(0), jax.random.split(key, S))
+        return acc
+    timed("  sampling + 2x argsort(B)", argsorts_only, key, scale_pairs=pairs)
+
+    # ---- forward math only (gathers + einsums, no scatters)
+    @jax.jit
+    def fwd_only(params, key):
+        ein, eout = params["emb_in"], params["emb_out"]
+        def body(acc, k):
+            c, o, w = sample(k)
+            vin = ein[c]
+            vout = eout[o]
+            logits = jnp.einsum("bd,bkd->bk", vin, vout)
+            g = (jax.nn.sigmoid(logits)) * w[:, None]
+            d_vin = jnp.einsum("bk,bkd->bd", g, vout)
+            return acc + jnp.sum(d_vin), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0), jax.random.split(key, S))
+        return acc
+    timed("  sampling + fwd/bwd math (no scatter)", fwd_only, params, key,
+          scale_pairs=pairs)
+
+    # ---- scatters only (sorted negative block + 2 sorted B-blocks, no sort)
+    @jax.jit
+    def scatters_only(params, key):
+        ein, eout = params["emb_in"], params["emb_out"]
+        def body(carry, k):
+            ein, eout = carry
+            c, o, w = sample(k)
+            nflat = o[:, 1:].T.reshape(-1)
+            upd = jnp.ones((B * K, cfg.dim), jnp.float32)
+            eout = eout.at[nflat].add(upd, indices_are_sorted=True)
+            # pretend-sorted B scatters (cost of scatter w/o the sort)
+            ts = jnp.sort(o[:, 0])
+            cs = jnp.sort(c)
+            ub = jnp.ones((B, cfg.dim), jnp.float32)
+            eout = eout.at[ts].add(ub, indices_are_sorted=True)
+            ein = ein.at[cs].add(ub, indices_are_sorted=True)
+            return (ein, eout), None
+        (ein, eout), _ = jax.lax.scan(body, (ein, eout), jax.random.split(key, S))
+        return jnp.sum(ein[0]) + jnp.sum(eout[0])
+    timed("  sampling + sort+all scatters (no math)", scatters_only, params, key,
+          scale_pairs=pairs)
+
+    # ---- run_length_scale cost
+    from multiverso_tpu.models.wordembedding.skipgram import _run_length_scale
+
+    @jax.jit
+    def rls_only(key):
+        def body(acc, k):
+            c, o, w = sample(k)
+            nflat = o[:, 1:].T.reshape(-1)
+            s1 = _run_length_scale(nflat, jnp.tile(w, K))
+            s2 = _run_length_scale(jnp.sort(c), w)
+            return acc + jnp.sum(s1) + jnp.sum(s2), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0), jax.random.split(key, S))
+        return acc
+    timed("  sampling + run_length_scale (BK + B)", rls_only, key,
+          scale_pairs=pairs)
+
+
+if __name__ == "__main__":
+    main()

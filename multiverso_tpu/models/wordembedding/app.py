@@ -87,7 +87,9 @@ MV_DEFINE_int("batch_size", 4096, "pairs per training step (TPU batch)")
 MV_DEFINE_int("steps_per_call", 64, "microbatches scanned per device dispatch")
 MV_DEFINE_string(
     "scale_mode", "row_mean",
-    "batched-update scaling: row_mean (safe) | raw (fast; see skipgram.py)",
+    "batched-update scaling: row_mean (safe; expected-count tables in "
+    "-device_pipeline) | row_mean_exact (realized counts, device pipeline "
+    "only, slower) | raw (duplicates sum; see skipgram.py)",
 )
 MV_DEFINE_bool("use_ps", False, "train through parameter-server tables")
 MV_DEFINE_bool(
@@ -414,14 +416,15 @@ class WordEmbedding:
               "-device_pipeline supports NS skip-gram only")
         CHECK(not o.use_adagrad,
               "-device_pipeline does not support -use_adagrad (plain SGD only)")
-        corpus = jnp.asarray(ids)
-        keep_dev = None if o.sample <= 0 else jnp.asarray(keep)
         S = max(1, o.steps_per_call)
         superstep = jax.jit(
             make_ondevice_superbatch_step(
-                self.cfg, corpus, keep_dev,
+                # np arrays in: the builder derives host-side stats (valid-
+                # position index, expected-count scale tables) then uploads
+                self.cfg, ids, None if o.sample <= 0 else keep,
                 build_negative_lut(self.sampler.probs),
                 batch=o.batch_size, steps=S, scale_mode=o.scale_mode,
+                neg_probs=self.sampler.probs,
             ),
             donate_argnums=(0,),
         )
@@ -476,6 +479,8 @@ class WordEmbedding:
                         "pairs/s, lr %.5f, loss %.4f",
                         pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
                     )
+        jax.block_until_ready(self.params)
+        pairs_done += int(float(accepted_dev))  # drain the final window
         if calls >= max_calls and pairs_done < total_pairs:
             Log.Error(
                 "[WordEmbedding] device-pipeline hit the %d-call bound at "
@@ -483,8 +488,6 @@ class WordEmbedding:
                 "epoch truncated",
                 max_calls, pairs_done / 1e6, total_pairs / 1e6,
             )
-        jax.block_until_ready(self.params)
-        pairs_done += int(float(accepted_dev))  # drain the final window
         self.words_trained = pairs_done
         rate = self.words_trained / max(time.perf_counter() - start, 1e-9)
         Log.Info(
@@ -536,6 +539,10 @@ class WordEmbedding:
         CHECK(not (o.device_pipeline and o.use_ps),
               "-device_pipeline and -use_ps are mutually exclusive "
               "(fused HBM tables vs parameter-server tables)")
+        CHECK(o.scale_mode != "row_mean_exact" or o.device_pipeline,
+              "-scale_mode=row_mean_exact exists only for -device_pipeline "
+              "(the host presort path computes realized counts already — "
+              "use row_mean there)")
         if o.device_pipeline:
             return self._train_ondevice(ids, keep)
         def make_pipeline(shard_ids, seed):
