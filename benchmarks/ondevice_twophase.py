@@ -105,7 +105,8 @@ def main():
         return params
 
     cur = jax.jit(make_ondevice_superbatch_step(
-        cfg, corpus, None, lut, batch=B, steps=S), donate_argnums=(0,))
+        cfg, corpus_np, None, lut, batch=B, steps=S, neg_probs=sampler.probs),
+        donate_argnums=(0,))
     bench(f"current interleaved B={B} S={S}", cur, init_params(cfg))
     tp = jax.jit(two_phase, donate_argnums=(0,))
     bench(f"two-phase B={B} S={S}", tp, init_params(cfg))

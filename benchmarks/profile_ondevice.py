@@ -64,7 +64,7 @@ def main():
 
     # ---- full current step
     full = jax.jit(make_ondevice_superbatch_step(
-        cfg, corpus, None, lut, batch=B, steps=S))
+        cfg, corpus_np, None, lut, batch=B, steps=S, neg_probs=sampler.probs))
     timed(f"full superstep B={B} S={S}", lambda: full(params, key, lr),
           scale_pairs=pairs)
 

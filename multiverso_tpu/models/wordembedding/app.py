@@ -405,37 +405,54 @@ class WordEmbedding:
         one jitted program per superbatch — zero per-step host traffic. The
         TPU-native answer to slow host/link data paths (the reference's
         answer was the pipeline thread; here there is nothing to overlap).
-        NS skip-gram only."""
+
+        Mode coverage matches the reference's single training path
+        (ref: wordembedding.cpp:57-166): the NS+skip-gram+SGD flagship runs
+        the hand-tuned sorted-scatter step; CBOW / HS / AdaGrad route
+        through the generic device-resident step (same on-device sampling,
+        make_train_step math — slower, correctness-first)."""
         from multiverso_tpu.models.wordembedding.skipgram import (
             build_negative_lut,
+            make_ondevice_general_superbatch_step,
             make_ondevice_superbatch_step,
         )
 
         o = self.opt
-        CHECK(not o.hs and not o.cbow,
-              "-device_pipeline supports NS skip-gram only")
-        CHECK(not o.use_adagrad,
-              "-device_pipeline does not support -use_adagrad (plain SGD only)")
         S = max(1, o.steps_per_call)
-        superstep = jax.jit(
-            make_ondevice_superbatch_step(
-                # np arrays in: the builder derives host-side stats (valid-
-                # position index, expected-count scale tables) then uploads
-                self.cfg, ids, None if o.sample <= 0 else keep,
-                build_negative_lut(self.sampler.probs),
-                batch=o.batch_size, steps=S, scale_mode=o.scale_mode,
-                neg_probs=self.sampler.probs,
-            ),
-            donate_argnums=(0,),
-        )
-        # epoch target = the host walk's pair count: E[2*eff] = window+1
-        # pairs per KEPT, non-marker position (markers emit nothing; a
-        # subsampled-out center emits nothing). Rejected draws are NOT
-        # trained pairs — progress tracks the step's accepted-pair count,
-        # synced at log points only.
+        keep_in = None if o.sample <= 0 else keep
+        if o.hs or o.cbow or o.use_adagrad:
+            superstep = jax.jit(
+                make_ondevice_general_superbatch_step(
+                    self.cfg, ids, keep_in, batch=o.batch_size, steps=S,
+                    hs=o.hs, use_adagrad=o.use_adagrad, huffman=self.huffman,
+                    neg_lut=(
+                        None if o.hs else build_negative_lut(self.sampler.probs)
+                    ),
+                    scale_mode=o.scale_mode,
+                ),
+                donate_argnums=(0,),
+            )
+        else:
+            superstep = jax.jit(
+                make_ondevice_superbatch_step(
+                    # np arrays in: the builder derives host-side stats (valid-
+                    # position index, expected-count scale tables) then uploads
+                    self.cfg, ids, keep_in,
+                    build_negative_lut(self.sampler.probs),
+                    batch=o.batch_size, steps=S, scale_mode=o.scale_mode,
+                    neg_probs=self.sampler.probs,
+                ),
+                donate_argnums=(0,),
+            )
+        # epoch target = the host walk's sample count. Skip-gram: E[2*eff] =
+        # window+1 pairs per KEPT, non-marker position; CBOW: one window
+        # sample per kept position (markers emit nothing; a subsampled-out
+        # center emits nothing). Rejected draws are NOT trained samples —
+        # progress tracks the step's accepted count, synced at log points.
         valid = ids >= 0
         kept = float(keep[ids[valid]].sum()) if o.sample > 0 else float(valid.sum())
-        total_pairs = max(int(kept * (o.window + 1) * o.epoch), 1)
+        per_kept = 1 if o.cbow else (o.window + 1)
+        total_pairs = max(int(kept * per_kept * o.epoch), 1)
         per_call = o.batch_size * S
         est_calls = max(1, 2 * total_pairs // per_call)
         max_calls = 20 * est_calls  # bound: degenerate corpora reject ~all

@@ -41,6 +41,7 @@ __all__ = [
     "make_sorted_superbatch_step",
     "make_ondevice_batch_fn",
     "make_ondevice_superbatch_step",
+    "make_ondevice_general_superbatch_step",
     "device_presort",
     "presort_updates",
     "presort_batch",
@@ -126,7 +127,7 @@ def _hs_loss_and_grad(vin, vout, codes, lengths):
     ) * lmask
     loss = jnp.sum(per) / jnp.maximum(jnp.sum(lmask), 1.0)
     g = (jax.nn.sigmoid(logits) - labels) * lmask
-    return loss, g, lmask
+    return loss, g, lmask, per
 
 
 def loss_fn(
@@ -266,45 +267,71 @@ def make_train_step(
         if config.cbow:
             vin, mask, safe_ctx = _ctx_mean(params["emb_in"], contexts)
 
-            def bwd(params, d_vin, lr):
+            def bwd(params, d_vin, lr, pair_w=None):
                 denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
                 per_ctx = (d_vin / denom)[:, None, :] * mask[..., None]
+                w = mask if pair_w is None else mask * pair_w[:, None]
                 return _apply_in(
                     params,
                     safe_ctx.reshape(-1),
                     per_ctx.reshape(-1, per_ctx.shape[-1]),
                     lr,
-                    weights=mask.reshape(-1),
+                    weights=w.reshape(-1),
                 )
 
             return vin, bwd
         vin = params["emb_in"][centers]
 
-        def bwd(params, d_vin, lr):
-            return _apply_in(params, centers, d_vin, lr)
+        def bwd(params, d_vin, lr, pair_w=None):
+            return _apply_in(params, centers, d_vin, lr, weights=pair_w)
 
         return vin, bwd
 
     if not hs:
 
-        def ns_step(params, centers, outputs, contexts, lr):
+        def ns_step(params, centers, outputs, contexts, lr, pair_w=None):
+            """``pair_w`` (B,) optional 0/1 pair weights: rejected pairs
+            (device-pipeline sampling) contribute no loss, no gradient and
+            no row-mean count."""
             vin, bwd_in = _input_and_bwd(params, centers, contexts)
             vout = params["emb_out"][outputs]
-            loss, g = _ns_loss_and_grad(vin, vout)
+            if pair_w is None:
+                loss, g = _ns_loss_and_grad(vin, vout)
+                wout = None
+            else:
+                logits = jnp.einsum("bd,bkd->bk", vin, vout)
+                labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+                loss = jnp.sum(_bce_sum(logits, labels) * pair_w) / jnp.maximum(
+                    jnp.sum(pair_w), 1.0
+                )
+                g = (jax.nn.sigmoid(logits) - labels) * pair_w[:, None]
+                wout = jnp.repeat(pair_w, outputs.shape[1])
             d_vin = jnp.einsum("bk,bkd->bd", g, vout)
             d_vout = g[..., None] * vin[:, None, :]
             params = _apply_out(
-                params, outputs.reshape(-1), d_vout.reshape(-1, d_vout.shape[-1]), lr
+                params, outputs.reshape(-1), d_vout.reshape(-1, d_vout.shape[-1]),
+                lr, weights=wout,
             )
-            return bwd_in(params, d_vin, lr), loss
+            return bwd_in(params, d_vin, lr, pair_w), loss
 
         return ns_step
 
-    def hs_step(params, centers, points, codes, lengths, contexts, lr):
-        """Hierarchical softmax step (see _hs_loss_and_grad)."""
+    def hs_step(params, centers, points, codes, lengths, contexts, lr, pair_w=None):
+        """Hierarchical softmax step (see _hs_loss_and_grad); ``pair_w`` as
+        in ns_step."""
         vin, bwd_in = _input_and_bwd(params, centers, contexts)
         vout = params["emb_out"][points]  # (B, L, D) inner-node rows
-        loss, g, L_mask = _hs_loss_and_grad(vin, vout, codes, lengths)
+        loss, g, L_mask, per = _hs_loss_and_grad(vin, vout, codes, lengths)
+        if pair_w is not None:
+            g = g * pair_w[:, None]
+            wmask = L_mask * pair_w[:, None]
+            # weighted loss over live nodes of live pairs (``per`` is
+            # already length-masked)
+            loss = jnp.sum(per * pair_w[:, None]) / jnp.maximum(
+                jnp.sum(wmask), 1.0
+            )
+        else:
+            wmask = L_mask
         d_vin = jnp.einsum("bl,bld->bd", g, vout)
         d_vout = g[..., None] * vin[:, None, :]
         # masked slots have g=0 and weight 0: they don't touch inner node 0
@@ -313,9 +340,9 @@ def make_train_step(
             points.reshape(-1),
             d_vout.reshape(-1, d_vout.shape[-1]),
             lr,
-            weights=L_mask.reshape(-1),
+            weights=wmask.reshape(-1),
         )
-        return bwd_in(params, d_vin, lr), loss
+        return bwd_in(params, d_vin, lr, pair_w), loss
 
     return hs_step
 
@@ -480,7 +507,7 @@ def make_sorted_train_step(
         if hs:
             points, codes, lengths = batch["points"], batch["codes"], batch["lengths"]
             vout = emb_out[points]
-            loss, gmat, _ = _hs_loss_and_grad(vin, vout, codes, lengths)
+            loss, gmat, _, _ = _hs_loss_and_grad(vin, vout, codes, lengths)
             ncol = points.shape[1]
         else:
             outputs = batch["outputs"]
@@ -584,6 +611,65 @@ def _distance_lut(window: int) -> np.ndarray:
     )
 
 
+def _make_stratified_neg_fn(neg_lut: jnp.ndarray, batch: int, negatives: int):
+    """Sorted negative block drawn by stratified jittered uniforms with
+    EXACT integer stratum bounds, precomputed on host: stratum j covers
+    [lo_j, lo_{j+1}) with lo_j = j*Q//(BK), so idx_j = lo_j +
+    floor(u_j * span_j) < lo_{j+1} <= idx_{j+1} — the flat block is
+    monotone non-decreasing BY INTEGER ARITHMETIC. (A float32
+    (j + u_j) * Q/(BK) formulation can invert order near stratum
+    boundaries — ulp is 0.5 at 2^22 — silently violating an
+    indices_are_sorted scatter contract.) Returns ``key -> (B*K,) sorted
+    word ids``; flat position j belongs to pair j % B (stride-by-batch)."""
+    q_size = neg_lut.shape[0]
+    n = batch * negatives
+    lo_np = (np.arange(n + 1, dtype=np.int64) * q_size) // n
+    lo = jnp.asarray(lo_np[:-1].astype(np.int32))
+    span = jnp.asarray(np.diff(lo_np).astype(np.float32))
+
+    def draw(key):
+        u = jax.random.uniform(key, (n,))
+        return neg_lut[lo + (u * span).astype(jnp.int32)]
+
+    return draw
+
+
+def _make_sg_pair_fn(config: SkipGramConfig, corpus, keep_probs, batch: int):
+    """Shared skip-gram pair sampler: valid-position centers + exact
+    offset-distance contexts + accept weights. Single source of truth for
+    both on-device step builders. Returns ``key -> (c, ts, w)``."""
+    corpus_np = np.asarray(corpus)
+    n_corpus = corpus_np.shape[0]
+    corpus_dev = jnp.asarray(corpus)
+    valid_pos = jnp.asarray(np.flatnonzero(corpus_np >= 0).astype(np.int32))
+    n_valid = int(valid_pos.shape[0])
+    dlut_np = _distance_lut(config.window)
+    dist_lut = jnp.asarray(dlut_np)
+    T = int(dlut_np.shape[0])
+    keep_dev = None if keep_probs is None else jnp.asarray(keep_probs)
+
+    def pairs(key):
+        ks = jax.random.split(key, 3)
+        j = jax.random.randint(ks[0], (batch,), 0, n_valid)
+        p = valid_pos[j]
+        c = corpus_dev[p]  # >= 0 by construction of valid_pos
+        # one draw for (distance, direction): r in [0, 2T)
+        r = jax.random.randint(ks[1], (batch,), 0, 2 * T)
+        d = dist_lut[r % T]
+        off = jnp.where(r < T, d, -d)
+        qpos = p + off
+        qc = jnp.clip(qpos, 0, n_corpus - 1)
+        t = corpus_dev[qc]
+        valid = (t >= 0) & (qpos == qc)
+        ts = jnp.maximum(t, 0)
+        if keep_dev is not None:
+            u = jax.random.uniform(ks[2], (batch, 2))
+            valid = valid & (u[:, 0] < keep_dev[c]) & (u[:, 1] < keep_dev[ts])
+        return c, ts, valid.astype(jnp.float32)
+
+    return pairs
+
+
 def make_ondevice_batch_fn(
     config: SkipGramConfig,
     corpus,  # (n,) int32 np or jnp, -1 = sentence boundary
@@ -628,51 +714,16 @@ def make_ondevice_batch_fn(
     ``outputs[:, 1:]`` flat-sorted in column-major order
     (``negs.T.reshape(-1)`` is sorted).
     """
-    corpus_np = np.asarray(corpus)
-    n_corpus = corpus_np.shape[0]
     K = config.negatives
-    q_size = neg_lut.shape[0]
-    corpus_dev = jnp.asarray(corpus)
-    valid_pos = jnp.asarray(np.flatnonzero(corpus_np >= 0).astype(np.int32))
-    n_valid = int(valid_pos.shape[0])
-    dlut_np = _distance_lut(config.window)
-    dist_lut = jnp.asarray(dlut_np)
-    T = int(dlut_np.shape[0])
-    keep_dev = None if keep_probs is None else jnp.asarray(keep_probs)
-    lo_np = (np.arange(batch * K + 1, dtype=np.int64) * q_size) // (batch * K)
-    _stratum_lo = jnp.asarray(lo_np[:-1].astype(np.int32))
-    _stratum_span = jnp.asarray(np.diff(lo_np).astype(np.float32))
+    pairs = _make_sg_pair_fn(config, corpus, keep_probs, batch)
+    draw_negs = _make_stratified_neg_fn(neg_lut, batch, K)
 
     def sample(key):
-        ks = jax.random.split(key, 4)
-        j = jax.random.randint(ks[0], (batch,), 0, n_valid)
-        p = valid_pos[j]
-        c = corpus_dev[p]  # >= 0 by construction of valid_pos
-        # one draw for (distance, direction): r in [0, 2T)
-        r = jax.random.randint(ks[1], (batch,), 0, 2 * T)
-        d = dist_lut[r % T]
-        off = jnp.where(r < T, d, -d)
-        qpos = p + off
-        qc = jnp.clip(qpos, 0, n_corpus - 1)
-        t = corpus_dev[qc]
-        valid = (t >= 0) & (qpos == qc)
-        ts = jnp.maximum(t, 0)
-        if keep_dev is not None:
-            u = jax.random.uniform(ks[2], (batch, 2))
-            valid = valid & (u[:, 0] < keep_dev[c]) & (u[:, 1] < keep_dev[ts])
-        # stratified draw with EXACT integer stratum bounds, precomputed on
-        # host: stratum j covers [lo_j, lo_{j+1}) with lo_j = j*Q//(BK), so
-        # idx_j = lo_j + floor(u_j * span_j) < lo_{j+1} <= idx_{j+1} — the
-        # flat block is monotone non-decreasing BY INTEGER ARITHMETIC. (A
-        # float32 (j + u_j) * Q/(BK) formulation can invert order near
-        # stratum boundaries — ulp is 0.5 at 2^22 — silently violating the
-        # indices_are_sorted contract of the scatter below.)
-        u = jax.random.uniform(ks[3], (batch * K,))
-        idx = _stratum_lo + (u * _stratum_span).astype(jnp.int32)
-        flat_sorted = neg_lut[idx]
-        negs = flat_sorted.reshape(K, batch).T
+        k1, k2 = jax.random.split(key)
+        c, ts, w = pairs(k1)
+        negs = draw_negs(k2).reshape(K, batch).T
         outputs = jnp.concatenate([ts[:, None], negs], axis=1)
-        return c, outputs, valid.astype(jnp.float32)
+        return c, outputs, w
 
     return sample
 
@@ -805,6 +856,121 @@ def make_ondevice_superbatch_step(
             upd_i = d_vin[iperm] * isc[:, None]
             emb_in = emb_in.at[is2].add(-lr * upd_i, indices_are_sorted=True)
             new = {**params, "emb_in": emb_in, "emb_out": emb_out}
+            return new, (loss, jnp.sum(w))
+
+        keys = jax.random.split(key, steps)
+        params, (losses, accepted) = jax.lax.scan(body, params, keys)
+        return params, (jnp.mean(losses), jnp.sum(accepted))
+
+    return superstep
+
+
+def make_ondevice_general_superbatch_step(
+    config: SkipGramConfig,
+    corpus,
+    keep_probs,
+    batch: int,
+    steps: int,
+    hs: bool = False,
+    use_adagrad: bool = False,
+    huffman=None,
+    neg_lut: Optional[jnp.ndarray] = None,
+    scale_mode: str = "row_mean",
+):
+    """Device-resident training for the NON-flagship mode grid — CBOW,
+    hierarchical softmax, AdaGrad — matching the reference's uniform mode
+    coverage (ref: wordembedding.cpp:57-166 trains {sg,cbow} x {ns,hs} x
+    {sgd,adagrad} through one code path). Sampling runs on device exactly
+    like the flagship step (valid-position centers, exact distance
+    distribution for skip-gram, stratified sorted negatives for NS, shrunk
+    full windows for CBOW); the update math reuses ``make_train_step`` with
+    per-pair weights (realized-count row_mean / raw scaling, unsorted
+    scatters) — correctness-first, while the hand-tuned sorted-scatter
+    ``make_ondevice_superbatch_step`` remains the NS+skip-gram+SGD flagship.
+
+    HS needs ``huffman`` (padded (V, L) points/codes + lengths uploaded to
+    HBM, one gather per batch); NS needs ``neg_lut``.
+
+    Signature: ``(params, key, lr) -> (params, (mean_loss, accepted))`` —
+    ``accepted`` counts weight>0 training samples (pairs for skip-gram,
+    center windows for CBOW).
+    """
+    assert hs == (huffman is not None), "hs mode requires huffman tables"
+    assert hs or neg_lut is not None, "NS mode requires neg_lut"
+    W = config.window
+    K = config.negatives
+    if hs:
+        pts = jnp.asarray(huffman.points)
+        cds = jnp.asarray(huffman.codes.astype(np.int32))
+        lens = jnp.asarray(huffman.lengths)
+    else:
+        draw_negs = _make_stratified_neg_fn(neg_lut, batch, K)
+
+    if config.cbow:
+        corpus_np = np.asarray(corpus)
+        n_corpus = corpus_np.shape[0]
+        corpus_dev = jnp.asarray(corpus)
+        valid_pos = jnp.asarray(np.flatnonzero(corpus_np >= 0).astype(np.int32))
+        n_valid = int(valid_pos.shape[0])
+        keep_dev = None if keep_probs is None else jnp.asarray(keep_probs)
+
+        def sample(key):
+            """CBOW window sample: shrunk window b ~ U[1, W], CBOW uses ALL
+            tokens within b (ref: wordembedding.cpp ParseSentence CBOW
+            branch). -> (target, contexts (B,2W) -1-padded, w)."""
+            ks = jax.random.split(key, 4)
+            j = jax.random.randint(ks[0], (batch,), 0, n_valid)
+            p = valid_pos[j]
+            c = corpus_dev[p]
+            b = jax.random.randint(ks[1], (batch,), 1, W + 1)
+            offs = jnp.concatenate(
+                [jnp.arange(-W, 0), jnp.arange(1, W + 1)]
+            ).astype(jnp.int32)
+            qpos = p[:, None] + offs[None, :]
+            qc = jnp.clip(qpos, 0, n_corpus - 1)
+            t = corpus_dev[qc]  # (B, 2W)
+            m = (jnp.abs(offs)[None, :] <= b[:, None]) & (t >= 0) & (qpos == qc)
+            ts = jnp.maximum(t, 0)
+            w = jnp.ones((batch,), jnp.float32)
+            if keep_dev is not None:
+                u = jax.random.uniform(ks[2], (batch,))
+                w = (u < keep_dev[c]).astype(jnp.float32)
+                uc = jax.random.uniform(ks[3], (batch, 2 * W))
+                m = m & (uc < keep_dev[ts])
+            # a window with no live context trains nothing
+            w = w * (jnp.sum(m, axis=1) > 0)
+            contexts = jnp.where(m, ts, -1)
+            # CBOW: input = context mean, prediction target = center word
+            return c, c, contexts, w
+    else:
+        sg_pairs = _make_sg_pair_fn(config, corpus, keep_probs, batch)
+
+        def sample(key):
+            # skip-gram: input = center word, prediction target = context
+            c, ts, w = sg_pairs(key)
+            return c, ts, None, w
+
+    def draw_outputs(key, tgt):
+        """[target | K stratified negatives] (NS modes). Row-major flatten
+        is NOT sorted here — make_train_step scatters unsorted."""
+        negs = draw_negs(key).reshape(K, batch).T
+        return jnp.concatenate([tgt[:, None], negs], axis=1)
+
+    step = make_train_step(
+        config, hs=hs, use_adagrad=use_adagrad,
+        scale_mode="raw" if scale_mode == "raw" else "row_mean",
+    )
+
+    def superstep(params, key, lr):
+        def body(params, key):
+            k1, k2 = jax.random.split(key)
+            c, tgt, contexts, w = sample(k1)
+            if hs:
+                new, loss = step(
+                    params, c, pts[tgt], cds[tgt], lens[tgt], contexts, lr, w
+                )
+            else:
+                new, loss = step(params, c, draw_outputs(k2, tgt), contexts, lr, w)
             return new, (loss, jnp.sum(w))
 
         keys = jax.random.split(key, steps)
