@@ -25,8 +25,37 @@ import jax
 import jax.numpy as jnp
 
 
+def _zipf_counts(vocab_size):
+    """Zipf-Mandelbrot rank counts (shared shape with the synthetic corpus —
+    synth.zipf_probs), used to draw realistic skewed id batches."""
+    from multiverso_tpu.models.wordembedding.synth import zipf_probs
+
+    return np.maximum(zipf_probs(vocab_size) * 1e9, 1.0).astype(np.int64)
+
+
+def _skewed_batches(cfg, rng, scan_steps, batch):
+    """Centers ~ unigram (subsampled shape omitted: harsher duplicate load),
+    negatives ~ unigram^3/4 via the app's alias sampler — the real training
+    distribution (heavily duplicated hot rows in every gather/scatter),
+    vs. the uniform batches the round-1 bench used."""
+    from multiverso_tpu.models.wordembedding.sampler import AliasSampler
+
+    counts = _zipf_counts(cfg.vocab_size)
+    probs = counts / counts.sum()
+    centers = rng.choice(
+        cfg.vocab_size, size=(scan_steps, batch), p=probs
+    ).astype(np.int32)
+    sampler = AliasSampler(counts)
+    outputs = np.empty((scan_steps, batch, 1 + cfg.negatives), np.int32)
+    outputs[..., 0] = centers  # positive slot: same marginal as centers
+    outputs[..., 1:] = sampler.sample_np(
+        rng, (scan_steps, batch, cfg.negatives)
+    )
+    return centers, outputs
+
+
 def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=64,
-                 scale_mode="row_mean", presort=True):
+                 scale_mode="row_mean", presort=True, skewed=False):
     """Superbatch path: ``lax.scan`` over ``scan_steps`` microbatches per
     dispatch (no per-step host round trip). The headline runs the app's
     default training configuration (presorted scatter ids + row_mean
@@ -43,12 +72,15 @@ def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=64,
 
     params = init_params(cfg)
     rng = np.random.RandomState(0)
-    centers_np = rng.randint(
-        0, cfg.vocab_size, size=(scan_steps, batch)
-    ).astype(np.int32)
-    outputs_np = rng.randint(
-        0, cfg.vocab_size, size=(scan_steps, batch, 1 + cfg.negatives)
-    ).astype(np.int32)
+    if skewed:
+        centers_np, outputs_np = _skewed_batches(cfg, rng, scan_steps, batch)
+    else:
+        centers_np = rng.randint(
+            0, cfg.vocab_size, size=(scan_steps, batch)
+        ).astype(np.int32)
+        outputs_np = rng.randint(
+            0, cfg.vocab_size, size=(scan_steps, batch, 1 + cfg.negatives)
+        ).astype(np.int32)
     lr = jnp.float32(0.025)
     if presort:
         step = jax.jit(make_sorted_superbatch_step(cfg), donate_argnums=(0,))
@@ -135,6 +167,76 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=128,
     return best
 
 
+def _bench_e2e(dim=128, device_tokens=None, host_tokens=None):
+    """End-to-end app-level proof (the reference's KPI is words/sec through
+    the full training loop — ref: Applications/WordEmbedding/src/
+    trainer.cpp:44-48, distributed_wordembedding.cpp:109-127; the quality
+    bar is analogy accuracy — README.md:16).
+
+    Trains the real app (``WordEmbedding.train``) on a synthetic Zipf corpus
+    with planted analogy structure (synth.py) in BOTH modes:
+
+    * ``-device_pipeline`` — corpus in HBM, zero per-step host traffic; the
+      deployment-proof path on weak hosts;
+    * host pipeline (default fused path) — producer thread feeds presorted
+      batches over the host link; on this tunneled single-core bench host the
+      producer is the bottleneck, so this number is expected to sit well
+      below the device-leg figure (reported unfused, not hidden).
+
+    words/sec = corpus tokens walked per wall second (the reference's word
+    counter unit); pairs/sec = trained samples (the device-leg unit).
+    Corpus sizes scale via MV_BENCH_E2E_TOKENS / MV_BENCH_E2E_HOST_TOKENS.
+    """
+    import os
+
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.eval import analogy_accuracy
+    from multiverso_tpu.models.wordembedding.synth import SynthConfig, generate
+
+    device_tokens = device_tokens or int(
+        os.environ.get("MV_BENCH_E2E_TOKENS", 20_000_000)
+    )
+    host_tokens = host_tokens or int(
+        os.environ.get("MV_BENCH_E2E_HOST_TOKENS", 4_000_000)
+    )
+    ids, d, questions = generate(
+        SynthConfig(tokens=device_tokens, vocab_size=100_000, seed=11)
+    )
+    walked = int((ids >= 0).sum())
+    base = dict(
+        train_file="<synthetic>", size=dim, window=5, negative=5, epoch=1,
+        batch_size=8192, sample=1e-3, min_count=1, output_file="",
+    )
+    # --- device pipeline leg (full loop: upload, sampling, lr syncs) ---
+    opt = WEOptions(**base, steps_per_call=128, device_pipeline=True)
+    we = WordEmbedding(opt, dictionary=d)
+    t0 = time.perf_counter()
+    we.train(ids)
+    dt = time.perf_counter() - t0
+    dev_words = walked / dt
+    dev_pairs = we.words_trained / dt
+    acc, n_q = analogy_accuracy(d.words, we.embeddings(), questions)
+    # --- host pipeline leg (producer thread + presorted batches) ---
+    h_ids, h_d, _ = generate(
+        SynthConfig(tokens=host_tokens, vocab_size=100_000, seed=12)
+    )
+    h_walked = int((h_ids >= 0).sum())
+    opt = WEOptions(**base, steps_per_call=64, is_pipeline=True)
+    we = WordEmbedding(opt, dictionary=h_d)
+    t0 = time.perf_counter()
+    we.train(h_ids)
+    dt = time.perf_counter() - t0
+    return {
+        "e2e_words_per_sec": round(dev_words, 1),
+        "e2e_pairs_per_sec": round(dev_pairs, 1),
+        "e2e_host_words_per_sec": round(h_walked / dt, 1),
+        "e2e_host_pairs_per_sec": round(we.words_trained / dt, 1),
+        "analogy_acc": round(acc, 4),
+        "analogy_questions": n_q,
+        "e2e_tokens": walked,
+    }
+
+
 def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
     """Reference-architecture emulation: per-batch Get/Add through the table
     API with host staging (the MPI-PS data path without the network)."""
@@ -184,22 +286,28 @@ def main():
 
     mv.MV_Init(["-updater_type=sgd"])
     cfg = SkipGramConfig(vocab_size=100_000, dim=128, negatives=5)
-    fused = _bench_fused(cfg)  # the app's default training config
+    # headline: the app's default training config on REALISTIC skewed ids
+    # (centers ~ unigram, negatives ~ unigram^3/4 — duplicated hot rows).
+    # uniform-id legs keep their round-1 key names/semantics so rounds stay
+    # comparable, and vs_baseline divides same-distribution (uniform) legs —
+    # the architecture ratio, not the distribution change.
+    fused = _bench_fused(cfg, skewed=True)
+    fused_uniform = _bench_fused(cfg)
     fused_unsorted = _bench_fused(cfg, presort=False)
     ondevice = _bench_ondevice(cfg)
     ps = _bench_ps_loop(cfg)
-    print(
-        json.dumps(
-            {
-                "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
-                "value": round(fused, 1),
-                "unit": "pairs/sec",
-                "vs_baseline": round(fused / ps, 3),
-                "unsorted_value": round(fused_unsorted, 1),
-                "ondevice_pipeline_value": round(ondevice, 1),
-            }
-        )
-    )
+    e2e = _bench_e2e()
+    out = {
+        "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
+        "value": round(fused, 1),
+        "unit": "pairs/sec",
+        "vs_baseline": round(fused_uniform / ps, 3),
+        "uniform_ids_value": round(fused_uniform, 1),
+        "unsorted_value": round(fused_unsorted, 1),
+        "ondevice_pipeline_value": round(ondevice, 1),
+    }
+    out.update(e2e)
+    print(json.dumps(out))
     mv.MV_ShutDown()
 
 

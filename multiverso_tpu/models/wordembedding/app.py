@@ -99,7 +99,8 @@ MV_DEFINE_bool(
 MV_DEFINE_bool(
     "device_pipeline", False,
     "fully device-resident pipeline: corpus in HBM, sampling/negatives/"
-    "presort on device, zero per-step host traffic (NS skip-gram only)",
+    "presort on device, zero per-step host traffic (NS skip-gram runs the "
+    "tuned sorted-scatter step; CBOW/HS/AdaGrad use the general step)",
 )
 
 
@@ -149,6 +150,10 @@ class WordEmbedding:
             if options.read_vocab:
                 dictionary = Dictionary.load(options.read_vocab)
             else:
+                CHECK(not any(p.endswith(".npy")
+                              for p in options.train_file.split(";")),
+                      "-train_file=<ids>.npy (pre-encoded id stream, e.g. "
+                      "from models.wordembedding.synth) requires -read_vocab")
                 stop = None
                 if options.stopwords and options.sw_file:
                     stop = set(
@@ -550,7 +555,15 @@ class WordEmbedding:
         """Train over the corpus; returns the last logged loss."""
         o = self.opt
         if ids is None:
-            ids = self.dict.encode_corpus(o.train_file.split(";"))
+            # each path routes by its own suffix: .npy = pre-encoded id
+            # stream (synth.py / preprocess output), else tokenized text
+            chunks = []
+            for p in o.train_file.split(";"):
+                if p.endswith(".npy"):
+                    chunks.append(np.load(p))
+                else:
+                    chunks.append(self.dict.encode_corpus([p]))
+            ids = np.concatenate(chunks)
         ids = np.ascontiguousarray(ids, np.int32)
         keep = subsample_keep_probs(self.dict.counts, o.sample)
         CHECK(not (o.device_pipeline and o.use_ps),

@@ -1,0 +1,228 @@
+"""Synthetic Zipf corpus with planted analogy structure.
+
+The reference's quality bar is analogy-task parity against Google word2vec
+(ref: Applications/WordEmbedding/README.md:16, example/imges/*.png) — but the
+benchmark image has zero network egress, so no public corpus or question set
+is available. This module generates, offline and deterministically, a corpus
+whose *ground truth* forces the same linear-offset structure the analogy task
+measures:
+
+* **Filler text**: Zipf-ranked unigram draws (exponent ``zipf_s``, Mandelbrot
+  offset ``zipf_q`` — the standard natural-text shape), in sentences of
+  ``filler_len`` tokens. This reproduces the skewed id distribution the real
+  pipeline sees (frequency-sorted vocab ⇒ hot low ids in every gather).
+* **Analogy windows**: a factorized semantic model. Words ``w(i,j)`` carry a
+  latent (stem *i*, attribute *j*); each window is ``w(i,j)`` surrounded by
+  context tokens drawn from stem-contexts ``cs(i,·)`` and attribute-contexts
+  ``ca(j,·)``. Under skip-gram factorization the embedding of ``w(i,j)``
+  approaches ``u_i + v_j``, so the word2vec analogy protocol
+  ``w(i1,j2) - w(i1,j1) + w(i2,j1) ≈ w(i2,j2)`` holds iff training worked —
+  accuracy on the planted quadruples is a real quality signal, not a fit to
+  noise.
+
+Everything is vectorized numpy, chunked to bound memory: ~100M tokens/min on
+one core. Ids come out frequency-ranked (descending counts — the dictionary
+convention the samplers and subsampling tables assume), with ``-1`` sentence
+markers that both the native pair generator (native/pairgen.cpp:15) and the
+on-device sampler respect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import numpy as np
+
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+__all__ = [
+    "SynthConfig", "generate", "save_questions", "load_questions", "zipf_probs",
+]
+
+
+@dataclasses.dataclass
+class SynthConfig:
+    tokens: int = 20_000_000
+    vocab_size: int = 100_000          # total (filler + analogy words)
+    n_stems: int = 32                  # latent stem classes
+    n_attrs: int = 8                   # latent attribute classes
+    m_ctx: int = 2                     # context words per stem/attr class
+    analogy_frac: float = 0.25         # fraction of tokens in analogy windows
+    zipf_s: float = 1.05               # Zipf exponent for filler
+    zipf_q: float = 2.7                # Mandelbrot offset
+    filler_len: int = 20               # filler sentence length (incl. marker)
+    n_questions: int = 1000
+    seed: int = 1
+
+    @property
+    def n_pair(self) -> int:
+        return self.n_stems * self.n_attrs
+
+    @property
+    def n_analogy(self) -> int:
+        return self.n_pair + (self.n_stems + self.n_attrs) * self.m_ctx
+
+
+def zipf_probs(n: int, s: float = 1.05, q: float = 2.7) -> np.ndarray:
+    """Zipf-Mandelbrot rank probabilities — the frequency shape of natural
+    text. Shared by the filler generator here and the bench's skewed-id
+    batches (bench.py) so the two cannot silently diverge."""
+    ranks = np.arange(n, dtype=np.float64)
+    p = 1.0 / np.power(ranks + q, s)
+    return p / p.sum()
+
+
+def _zipf_cdf(cfg: SynthConfig, n_filler: int) -> np.ndarray:
+    cdf = np.cumsum(zipf_probs(n_filler, cfg.zipf_s, cfg.zipf_q))
+    cdf[-1] = 1.0
+    return cdf
+
+
+def _window_rows(cfg: SynthConfig, rng: np.random.RandomState, n: int, width: int):
+    """``n`` analogy windows as (n, width) rows padded with -2 (dropped after
+    interleave). Window layout: [ctx ctx W(i,j) ctx ctx -1]."""
+    rows = np.full((n, width), -2, np.int32)
+    i = rng.randint(cfg.n_stems, size=n)
+    j = rng.randint(cfg.n_attrs, size=n)
+    rows[:, 2] = (i * cfg.n_attrs + j).astype(np.int32)
+    sctx_base = cfg.n_pair
+    actx_base = cfg.n_pair + cfg.n_stems * cfg.m_ctx
+    for col in (0, 1, 3, 4):
+        k = rng.randint(cfg.m_ctx, size=n)
+        pick_stem = rng.random_sample(n) < 0.5
+        rows[:, col] = np.where(
+            pick_stem, sctx_base + i * cfg.m_ctx + k, actx_base + j * cfg.m_ctx + k
+        ).astype(np.int32)
+    rows[:, 5] = -1  # sentence marker: windows never bleed into filler
+    return rows
+
+
+def _filler_rows(cfg, rng, n: int, cdf: np.ndarray) -> np.ndarray:
+    rows = np.empty((n, cfg.filler_len), np.int32)
+    draws = np.searchsorted(cdf, rng.random_sample(n * (cfg.filler_len - 1)))
+    rows[:, :-1] = (cfg.n_analogy + draws).reshape(n, cfg.filler_len - 1)
+    rows[:, -1] = -1
+    return rows
+
+
+def generate(cfg: SynthConfig) -> Tuple[np.ndarray, Dictionary, List[Tuple[str, str, str, str]]]:
+    """Returns (ids with -1 markers, frequency-ranked Dictionary, questions)."""
+    assert cfg.vocab_size > cfg.n_analogy, "vocab_size must exceed analogy vocab"
+    n_filler = cfg.vocab_size - cfg.n_analogy
+    cdf = _zipf_cdf(cfg, n_filler)
+    rng = np.random.RandomState(cfg.seed)
+    win_tokens = 6
+    n_win_total = int(cfg.tokens * cfg.analogy_frac) // win_tokens
+    n_fs_total = max(1, (cfg.tokens - n_win_total * win_tokens) // cfg.filler_len)
+    # chunked generation: ~10M tokens per chunk bounds peak memory at ~200MB
+    chunk_tokens = 10_000_000
+    n_chunks = max(1, (cfg.tokens + chunk_tokens - 1) // chunk_tokens)
+    out = []
+    for c in range(n_chunks):
+        nw = n_win_total // n_chunks + (1 if c < n_win_total % n_chunks else 0)
+        nf = n_fs_total // n_chunks + (1 if c < n_fs_total % n_chunks else 0)
+        if nw == 0 and nf == 0:
+            continue
+        width = cfg.filler_len
+        rows = np.full((nw + nf, width), -2, np.int32)
+        if nw:
+            rows[:nw, :win_tokens] = _window_rows(cfg, rng, nw, win_tokens)
+        if nf:
+            rows[nw:] = _filler_rows(cfg, rng, nf, cdf)
+        rows = rows[rng.permutation(nw + nf)]  # interleave windows into text
+        flat = rows.reshape(-1)
+        out.append(flat[flat != -2])
+    ids = np.concatenate(out)
+    # frequency re-rank (dictionary convention: ids descend by count)
+    counts = np.bincount(ids[ids >= 0], minlength=cfg.n_analogy + n_filler)
+    order = np.argsort(-counts, kind="stable")
+    order = order[counts[order] > 0]
+    remap = np.full(len(counts), -1, np.int32)
+    remap[order] = np.arange(len(order), dtype=np.int32)
+    ids = np.where(ids >= 0, remap[np.maximum(ids, 0)], ids).astype(np.int32)
+
+    names = _names(cfg, n_filler)
+    d = Dictionary()
+    d.words = [names[o] for o in order]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = counts[order].astype(np.int64)
+
+    questions = _questions(cfg, np.random.RandomState(cfg.seed + 7))
+    return ids, d, questions
+
+
+def _names(cfg: SynthConfig, n_filler: int) -> List[str]:
+    names = [f"w{i}_{j}" for i in range(cfg.n_stems) for j in range(cfg.n_attrs)]
+    names += [f"cs{i}_{k}" for i in range(cfg.n_stems) for k in range(cfg.m_ctx)]
+    names += [f"ca{j}_{k}" for j in range(cfg.n_attrs) for k in range(cfg.m_ctx)]
+    names += [f"f{r}" for r in range(n_filler)]
+    return names
+
+
+def _questions(cfg, rng) -> List[Tuple[str, str, str, str]]:
+    """Planted quadruples: w(i1,j1) : w(i1,j2) :: w(i2,j1) : w(i2,j2)."""
+    qs = []
+    for _ in range(cfg.n_questions):
+        i1, i2 = rng.choice(cfg.n_stems, 2, replace=False)
+        j1, j2 = rng.choice(cfg.n_attrs, 2, replace=False)
+        qs.append((f"w{i1}_{j1}", f"w{i1}_{j2}", f"w{i2}_{j1}", f"w{i2}_{j2}"))
+    return qs
+
+
+def save_questions(path: str, questions: List[Tuple[str, str, str, str]]) -> None:
+    with open(path, "w") as f:
+        for q in questions:
+            f.write(" ".join(q) + "\n")
+
+
+def load_questions(path: str) -> List[Tuple[str, str, str, str]]:
+    out = []
+    for line in open(path):
+        parts = line.split()
+        if len(parts) == 4:
+            out.append(tuple(parts))
+    return out
+
+
+def main(argv=None) -> int:
+    """CLI: write corpus ids (.npy), vocab, and analogy questions to disk.
+
+    python -m multiverso_tpu.models.wordembedding.synth -tokens=100000000 \
+        -out=corpus.ids.npy -vocab_out=vocab.txt -questions_out=questions.txt
+    Train with: python -m multiverso_tpu.models.wordembedding \
+        -train_file=corpus.ids.npy -read_vocab=vocab.txt ...
+    """
+    import sys
+
+    from multiverso_tpu.utils.configure import (
+        MV_DEFINE_int, MV_DEFINE_string, GetFlag, ParseCMDFlags,
+    )
+
+    MV_DEFINE_int("tokens", 20_000_000, "corpus size in tokens")
+    MV_DEFINE_int("vocab", 100_000, "vocabulary size")
+    MV_DEFINE_int("synth_seed", 1, "generator seed")
+    MV_DEFINE_string("out", "corpus.ids.npy", "output id-stream path (.npy)")
+    MV_DEFINE_string("vocab_out", "vocab.txt", "vocab file (word count lines)")
+    MV_DEFINE_string("questions_out", "questions.txt", "analogy questions path")
+    ParseCMDFlags(list(argv if argv is not None else sys.argv))
+    cfg = SynthConfig(
+        tokens=GetFlag("tokens"), vocab_size=GetFlag("vocab"),
+        seed=GetFlag("synth_seed"),
+    )
+    ids, d, questions = generate(cfg)
+    np.save(GetFlag("out"), ids)
+    d.save(GetFlag("vocab_out"))
+    save_questions(GetFlag("questions_out"), questions)
+    print(
+        f"wrote {len(ids)} ids -> {GetFlag('out')}, vocab {len(d)} -> "
+        f"{GetFlag('vocab_out')}, {len(questions)} questions -> "
+        f"{GetFlag('questions_out')}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv))

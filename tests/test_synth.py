@@ -1,0 +1,110 @@
+"""Synthetic planted-analogy corpus (models/wordembedding/synth.py).
+
+The north-star quality bar is analogy accuracy (ref:
+Applications/WordEmbedding/README.md:16); these tests check the generator's
+structural guarantees and that a small training run actually recovers the
+planted offsets far above chance — the signal the round-end e2e benchmark
+relies on.
+"""
+
+import numpy as np
+
+from multiverso_tpu.models.wordembedding.eval import analogy_accuracy
+from multiverso_tpu.models.wordembedding.synth import (
+    SynthConfig,
+    generate,
+    load_questions,
+    save_questions,
+)
+
+
+def small_cfg(**kw):
+    base = dict(
+        tokens=400_000, vocab_size=2_000, n_stems=8, n_attrs=4,
+        analogy_frac=0.3, n_questions=200, seed=3,
+    )
+    base.update(kw)
+    return SynthConfig(**base)
+
+
+def test_generate_structure():
+    cfg = small_cfg()
+    ids, d, qs = generate(cfg)
+    # size within a filler-sentence + window of the target
+    assert abs(len(ids) - cfg.tokens) < cfg.filler_len + 6
+    valid = ids[ids >= 0]
+    assert valid.min() >= 0 and valid.max() < len(d)
+    # counts match the stream exactly and descend (dictionary convention)
+    counts = np.bincount(valid, minlength=len(d))
+    assert np.array_equal(counts, d.counts)
+    assert np.all(np.diff(d.counts) <= 0)
+    # analogy windows present: every pair word appears, flanked only by
+    # context-class words within its sentence
+    for i in (0, cfg.n_stems - 1):
+        for j in (0, cfg.n_attrs - 1):
+            assert d.id_of(f"w{i}_{j}") >= 0
+    # questions are well-formed and in-vocab
+    assert len(qs) == cfg.n_questions
+    for q in qs:
+        assert len(q) == 4 and all(d.id_of(w) >= 0 for w in q)
+
+
+def test_generate_deterministic():
+    ids1, d1, q1 = generate(small_cfg(tokens=100_000))
+    ids2, d2, q2 = generate(small_cfg(tokens=100_000))
+    assert np.array_equal(ids1, ids2) and d1.words == d2.words and q1 == q2
+
+
+def test_window_context_consistency():
+    """Tokens inside an analogy sentence (length-5 sentences) are exactly
+    {stem-ctx of i, attr-ctx of j} around w(i,j) — the factorized model the
+    analogy protocol needs."""
+    cfg = small_cfg(tokens=60_000)
+    ids, d, _ = generate(cfg)
+    # sentences = runs between -1 markers; analogy windows have length 5
+    breaks = np.flatnonzero(ids == -1)
+    start = 0
+    checked = 0
+    for b in breaks:
+        sent = ids[start:b]
+        start = b + 1
+        if len(sent) != 5:
+            continue
+        center = d.words[sent[2]]
+        assert center.startswith("w")
+        i, j = center[1:].split("_")
+        for t in (0, 1, 3, 4):
+            w = d.words[sent[t]]
+            assert w.startswith(f"cs{i}_") or w.startswith(f"ca{j}_"), (
+                f"{w} not a context of {center}"
+            )
+        checked += 1
+        if checked >= 50:
+            break
+    assert checked >= 10
+
+
+def test_questions_roundtrip(tmp_path):
+    _, _, qs = generate(small_cfg(tokens=50_000))
+    p = str(tmp_path / "q.txt")
+    save_questions(p, qs)
+    assert load_questions(p) == qs
+
+
+def test_train_recovers_planted_analogies():
+    """A short fused-path run on the synthetic corpus recovers the planted
+    offsets: analogy accuracy far above chance (chance ~= 1/n_pair)."""
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+
+    cfg = small_cfg(tokens=600_000, vocab_size=1_000, analogy_frac=0.5)
+    ids, d, qs = generate(cfg)
+    opt = WEOptions(
+        train_file="<synthetic>", size=48, window=5, negative=5, epoch=3,
+        batch_size=1024, steps_per_call=16, min_count=1, sample=1e-3,
+        alpha=0.05, output_file="",
+    )
+    we = WordEmbedding(opt, dictionary=d)
+    we.train(ids)
+    acc, n = analogy_accuracy(d.words, we.embeddings(), qs)
+    assert n == len(qs)
+    assert acc > 0.5, f"analogy accuracy {acc} barely above chance"
