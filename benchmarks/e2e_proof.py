@@ -1,0 +1,85 @@
+"""North-star end-to-end proof run (VERDICT round-1 item #1).
+
+Trains the real WordEmbedding app on a >=100M-token synthetic Zipf corpus
+with planted analogy structure (synth.py) on the real chip, in BOTH modes:
+
+* ``-device_pipeline`` — corpus resident in HBM, zero per-step host traffic;
+* host pipeline — producer thread feeds presorted batches over the host link
+  (the deployment shape of the reference's ``is_pipeline`` block loop).
+
+Reports the reference's app-level KPI (words/sec through the full loop —
+ref: Applications/WordEmbedding/src/trainer.cpp:44-48,
+distributed_wordembedding.cpp:109-127) and the quality bar (analogy accuracy
+— ref: Applications/WordEmbedding/README.md:16). Writes ``E2E_R{round}.json``
+at the repo root.
+
+Usage:  python benchmarks/e2e_proof.py [tokens] [round_tag]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(tokens: int, tag: str) -> dict:
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.eval import analogy_accuracy
+    from multiverso_tpu.models.wordembedding.synth import SynthConfig, generate
+
+    mv.MV_Init([])
+    t0 = time.perf_counter()
+    ids, d, questions = generate(
+        SynthConfig(tokens=tokens, vocab_size=100_000, seed=11)
+    )
+    gen_s = time.perf_counter() - t0
+    walked = int((ids >= 0).sum())
+    print(f"[e2e] generated {len(ids)} ids ({walked} words) in {gen_s:.1f}s",
+          flush=True)
+    base = dict(
+        train_file="<synthetic>", size=128, window=5, negative=5, epoch=1,
+        batch_size=8192, sample=1e-3, min_count=1, output_file="",
+    )
+    out = {
+        "tokens": walked,
+        "vocab": len(d),
+        "corpus_gen_sec": round(gen_s, 1),
+        "modes": {},
+    }
+    for mode, extra in (
+        ("device_pipeline", dict(steps_per_call=128, device_pipeline=True)),
+        ("host_pipeline", dict(steps_per_call=64, is_pipeline=True)),
+    ):
+        opt = WEOptions(**base, **extra)
+        we = WordEmbedding(opt, dictionary=d)
+        t0 = time.perf_counter()
+        we.train(ids)
+        dt = time.perf_counter() - t0
+        acc, n_q = analogy_accuracy(d.words, we.embeddings(), questions)
+        out["modes"][mode] = {
+            "wall_sec": round(dt, 1),
+            "words_per_sec": round(walked / dt, 1),
+            "pairs_per_sec": round(we.words_trained / dt, 1),
+            "pairs_trained": int(we.words_trained),
+            "analogy_acc": round(acc, 4),
+            "analogy_questions": n_q,
+        }
+        print(f"[e2e] {mode}: {json.dumps(out['modes'][mode])}", flush=True)
+    mv.MV_ShutDown()
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), f"E2E_{tag}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"[e2e] wrote {path}", flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    tokens = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000_000
+    tag = sys.argv[2] if len(sys.argv) > 2 else "r02"
+    run(tokens, tag)

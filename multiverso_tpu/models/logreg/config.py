@@ -88,7 +88,15 @@ class Configure:
         return cfg
 
     def validate(self) -> None:
-        CHECK(self.input_size > 0, "config must provide input_size > 0")
+        if ((self.objective_type == "ftrl" or self.updater_type == "ftrl")
+                and self.sparse):  # matches Model.Get's FTRL selection
+            # input_size=0 => unbounded hashed u64 feature keys: FTRL state
+            # lives in the hash-indexed KV store (ref: the reference's FTRL
+            # hopscotch table needs no dimension bound either —
+            # util/ftrl_sparse_table.h:12-88, hopscotch_hash.h)
+            CHECK(self.input_size >= 0, "input_size must be >= 0")
+        else:
+            CHECK(self.input_size > 0, "config must provide input_size > 0")
         CHECK(self.output_size > 0, "config must provide output_size > 0")
         if self.objective_type == "sigmoid":
             CHECK(self.output_size == 1, "sigmoid objective requires output_size=1")

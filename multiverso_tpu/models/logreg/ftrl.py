@@ -17,9 +17,17 @@ pushed as (dz, dn) pairs that servers accumulate with ``+=`` (the reference's
 FTRL gradient wire format — data_type.h:34-53).
 
 TPU layout: the reference stores (z, n) in a hopscotch hash keyed by feature
-id (ref: util/hopscotch_hash.h); with a known ``input_size`` the TPU-native
-store is a dense (input_size, 2) row-sharded MatrixTable — O(1) row addressing,
-MXU-friendly, and sparse pushes touch only the batch's feature rows.
+id (ref: util/hopscotch_hash.h). Two stores, chosen by ``input_size``:
+
+* ``input_size > 0`` — dense (input_size, 2) row-sharded MatrixTable: O(1)
+  row addressing, MXU-friendly, sparse pushes touch only the batch's rows.
+* ``input_size == 0`` — **unbounded hashed u64 keys** (the reference's CTR
+  deployment shape: bsparse readers emit raw 64-bit feature hashes with no
+  dimension bound — reader.h bsparse format, LogisticRegression/README.md:5).
+  State lives in a KV table with ``val_dim=2``: a native batched hash index
+  (native/kv_index.cpp — the hopscotch analog) resolves each minibatch's
+  keys to dense HBM slots in one call; values grow by capacity doubling.
+
 Documented deviation: within a minibatch, per-feature gradients are
 aggregated before the state update (batched FTRL) instead of strictly
 per-sample sequential application.
@@ -45,12 +53,29 @@ class FTRLModel:
         CHECK(config.sparse, "FTRL requires sparse input")
         CHECK(config.output_size == 1, "FTRL is binary (output_size=1)")
         self.F = int(config.input_size)
+        self.hashed = self.F == 0  # unbounded u64 feature keys
         self.alpha = float(config.alpha)
         self.beta = float(config.beta)
         self.l1 = float(config.lambda1)
         self.l2 = float(config.lambda2)
         self.use_ps = bool(config.use_ps)
-        if self.use_ps:
+        self.kv = None
+        if self.hashed:
+            from multiverso_tpu.runtime import runtime
+            from multiverso_tpu.tables import KVTableOption, create_table
+
+            CHECK(runtime().started,
+                  "input_size=0 (hashed FTRL) requires MV_Init first")
+            CHECK(jax.process_count() == 1 or not self.use_ps,
+                  "hashed FTRL's key->slot index is process-local host "
+                  "state; multi-process use_ps would silently diverge — "
+                  "use a dense input_size for multi-process PS runs")
+            self.kv = create_table(KVTableOption(
+                val_dim=2, init_capacity=1 << 16, name="ftrl_zn_kv",
+                cache_local=False,  # unbounded keys: no host raw() mirror
+            ))
+            self.table = None
+        elif self.use_ps:
             from multiverso_tpu.runtime import runtime
             from multiverso_tpu.tables import MatrixTableOption, create_table
 
@@ -94,7 +119,9 @@ class FTRLModel:
 
     def _gather_rows(self, idx: np.ndarray) -> jnp.ndarray:
         flat = idx.reshape(-1)
-        if self.table is not None:
+        if self.kv is not None:
+            rows = self.kv.get(flat)  # unknown keys read (0, 0) = fresh state
+        elif self.table is not None:
             rows = self.table.get_rows(flat)
         else:
             rows = np.asarray(self._zn)[flat]
@@ -103,15 +130,21 @@ class FTRLModel:
     def _push(self, idx: np.ndarray, dz: np.ndarray, dn: np.ndarray) -> None:
         flat = idx.reshape(-1)
         deltas = np.stack([np.asarray(dz).reshape(-1), np.asarray(dn).reshape(-1)], axis=1)
-        if self.table is not None:
+        if self.kv is not None:
+            self.kv.add(flat, deltas)  # += accumulate, dups allowed
+        elif self.table is not None:
             self.table.add_rows(flat, deltas)  # += accumulate, dups allowed
         else:
             self._zn = self._zn.at[flat].add(jnp.asarray(deltas))
 
     # -- model api --------------------------------------------------------
 
+    def _idx(self, batch: Dict[str, Any]) -> np.ndarray:
+        # hashed mode keeps raw 64-bit feature keys; dense mode indexes rows
+        return np.asarray(batch["idx"], np.int64 if self.hashed else np.int32)
+
     def train_batch(self, batch: Dict[str, Any]) -> float:
-        idx = np.asarray(batch["idx"], np.int32)
+        idx = self._idx(batch)
         val = jnp.asarray(batch["val"])
         zn_rows = self._gather_rows(idx)
         loss, dz, dn = self._step(zn_rows, val, jnp.asarray(batch["y"]))
@@ -120,7 +153,7 @@ class FTRLModel:
         return float(loss)
 
     def predict(self, batch: Dict[str, Any]) -> np.ndarray:
-        idx = np.asarray(batch["idx"], np.int32)
+        idx = self._idx(batch)
         zn_rows = self._gather_rows(idx)
         p = self._predict(zn_rows, jnp.asarray(batch["val"]))
         return np.asarray(p)[:, None]
@@ -133,14 +166,26 @@ class FTRLModel:
         return scores, correct
 
     def weights(self) -> np.ndarray:
+        CHECK(not self.hashed,
+              "hashed FTRL has no dense weight vector; use hashed_weights()")
         zn = self.table.get() if self.table is not None else np.asarray(self._zn)
         return np.asarray(self._w_from_zn(jnp.asarray(zn[:, 0]), jnp.asarray(zn[:, 1])))
+
+    def hashed_weights(self):
+        """(keys, w) for every feature seen so far (hashed mode)."""
+        CHECK(self.hashed, "hashed_weights() requires input_size=0")
+        keys, zn = self.kv.items()
+        w = self._w_from_zn(jnp.asarray(zn[:, 0]), jnp.asarray(zn[:, 1]))
+        return keys, np.asarray(w)
 
     def save(self, uri: str) -> None:
         import io as _pyio
 
         from multiverso_tpu.io.streams import as_stream
 
+        if self.hashed:
+            self.kv.store(uri)  # (keys, zn) pairs — no dimension bound
+            return
         zn = self.table.get() if self.table is not None else np.asarray(self._zn)
         stream, owned = as_stream(uri, "w")
         buf = _pyio.BytesIO()
@@ -154,6 +199,9 @@ class FTRLModel:
 
         from multiverso_tpu.io.streams import as_stream
 
+        if self.hashed:
+            self.kv.load(uri)
+            return
         stream, owned = as_stream(uri, "r")
         data = np.load(_pyio.BytesIO(stream.Read(-1)), allow_pickle=False)
         if owned:

@@ -172,7 +172,9 @@ class SampleReader:
         if not self.sparse:
             X = np.stack([s.dense for s in samples]).astype(np.float32)
             return {"X": X, "y": y, "weight": w}
-        idx = np.zeros((B, max_keys), np.int32)
+        # int64: bsparse feature keys are raw 64-bit hashes (hashed FTRL);
+        # dense-dimension models narrow to int32 themselves
+        idx = np.zeros((B, max_keys), np.int64)
         val = np.zeros((B, max_keys), np.float32)
         touched = set()
         for i, s in enumerate(samples):

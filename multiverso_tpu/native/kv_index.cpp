@@ -1,0 +1,111 @@
+// kv_index.cpp — batched u64 key -> dense slot resolution for KV tables.
+//
+// TPU-native replacement for the reference's per-key host hash walks
+// (ref: include/multiverso/table/kv_table.h:48-65 unordered_map lookups;
+// Applications/LogisticRegression/src/util/hopscotch_hash.h:1-385 hopscotch
+// table backing the FTRL sparse store). The device side keeps values in one
+// sharded HBM array addressed by *dense slots*; this index is the host
+// control plane mapping arbitrary 64-bit feature ids to those slots, batched
+// (one C call per minibatch instead of one dict lookup per key).
+//
+// Open addressing, linear probing, power-of-two capacity, splitmix64 hash
+// finalizer, grow at 70% load. Dense slot ids are assigned in first-seen
+// order and never move (rehash relocates hash cells, not slots), so device
+// arrays only ever append.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct KvIndex {
+  std::vector<uint64_t> cell_key;  // hash cells
+  std::vector<int64_t> cell_slot;  // -1 = empty
+  std::vector<uint64_t> dense;     // slot -> key, insertion order
+  uint64_t mask = 0;
+
+  explicit KvIndex(int64_t initial) {
+    uint64_t cap = 64;
+    while ((int64_t)cap < initial * 2) cap <<= 1;
+    cell_key.assign(cap, 0);
+    cell_slot.assign(cap, -1);
+    mask = cap - 1;
+  }
+
+  static uint64_t hash(uint64_t x) {  // splitmix64 finalizer
+    x += 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return x ^ (x >> 31);
+  }
+
+  void grow() {
+    uint64_t ncap = (mask + 1) << 1;
+    std::vector<uint64_t> nk(ncap, 0);
+    std::vector<int64_t> ns(ncap, -1);
+    uint64_t nmask = ncap - 1;
+    for (uint64_t i = 0; i <= mask; ++i) {
+      if (cell_slot[i] < 0) continue;
+      uint64_t j = hash(cell_key[i]) & nmask;
+      while (ns[j] >= 0) j = (j + 1) & nmask;
+      nk[j] = cell_key[i];
+      ns[j] = cell_slot[i];
+    }
+    cell_key.swap(nk);
+    cell_slot.swap(ns);
+    mask = nmask;
+  }
+
+  // slot for key; creates if absent and create!=0, else -1
+  int64_t resolve1(uint64_t key, int create) {
+    uint64_t j = hash(key) & mask;
+    while (true) {
+      int64_t s = cell_slot[j];
+      if (s < 0) {
+        if (!create) return -1;
+        int64_t slot = (int64_t)dense.size();
+        cell_key[j] = key;
+        cell_slot[j] = slot;
+        dense.push_back(key);
+        if (dense.size() * 10 > (mask + 1) * 7) grow();
+        return slot;
+      }
+      if (cell_key[j] == key) return s;
+      j = (j + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mv_kv_index_new(int64_t initial_capacity) {
+  return new KvIndex(initial_capacity < 1 ? 1 : initial_capacity);
+}
+
+void mv_kv_index_free(void* h) { delete (KvIndex*)h; }
+
+int64_t mv_kv_index_size(void* h) {
+  return (int64_t)((KvIndex*)h)->dense.size();
+}
+
+// Batched resolve: out_slots[i] = slot of keys[i] (-1 if absent and !create).
+// Returns the number of newly created slots.
+int64_t mv_kv_index_resolve(void* h, const uint64_t* keys, int64_t n,
+                            int create, int64_t* out_slots) {
+  KvIndex* ix = (KvIndex*)h;
+  int64_t before = (int64_t)ix->dense.size();
+  for (int64_t i = 0; i < n; ++i) out_slots[i] = ix->resolve1(keys[i], create);
+  return (int64_t)ix->dense.size() - before;
+}
+
+// Dump keys in slot order (caller allocates size() entries). Returns count.
+int64_t mv_kv_index_keys(void* h, uint64_t* out) {
+  KvIndex* ix = (KvIndex*)h;
+  std::memcpy(out, ix->dense.data(), ix->dense.size() * sizeof(uint64_t));
+  return (int64_t)ix->dense.size();
+}
+
+}  // extern "C"

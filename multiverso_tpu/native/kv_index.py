@@ -1,0 +1,201 @@
+"""Batched u64 key -> dense slot index (ctypes over kv_index.cpp).
+
+Host control plane for hash-keyed tables: the KV table and the unbounded-key
+FTRL store resolve whole minibatches of 64-bit feature ids to dense HBM slots
+in one native call (ref: the per-key unordered_map / hopscotch walks —
+include/multiverso/table/kv_table.h:48-65,
+Applications/LogisticRegression/src/util/hopscotch_hash.h). A vectorised
+numpy fallback (open addressing with batched probe rounds) keeps the module
+working without a compiler — still orders of magnitude faster than a
+per-key Python dict walk.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+from multiverso_tpu.native import build_native_lib
+
+__all__ = ["KVIndex"]
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        path = build_native_lib("kv_index.cpp", "libmv_kv_index.so")
+        if path:
+            lib = ctypes.CDLL(path)
+            LL = ctypes.c_longlong
+            U64P = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+            I64P = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            lib.mv_kv_index_new.restype = ctypes.c_void_p
+            lib.mv_kv_index_new.argtypes = [LL]
+            lib.mv_kv_index_free.argtypes = [ctypes.c_void_p]
+            lib.mv_kv_index_size.restype = LL
+            lib.mv_kv_index_size.argtypes = [ctypes.c_void_p]
+            lib.mv_kv_index_resolve.restype = LL
+            lib.mv_kv_index_resolve.argtypes = [
+                ctypes.c_void_p, U64P, LL, ctypes.c_int, I64P,
+            ]
+            lib.mv_kv_index_keys.restype = LL
+            lib.mv_kv_index_keys.argtypes = [ctypes.c_void_p, U64P]
+            _LIB = lib
+        return _LIB
+
+
+class _NumpyIndex:
+    """Vectorised open-addressing fallback: batched probe rounds resolve a
+    whole key array per numpy pass (no per-key Python loop)."""
+
+    def __init__(self, initial: int):
+        cap = 64
+        while cap < initial * 2:
+            cap <<= 1
+        self._cell_key = np.zeros(cap, np.uint64)
+        self._cell_slot = np.full(cap, -1, np.int64)
+        self._dense: list = []  # slot -> key
+
+    @staticmethod
+    def _hash(x: np.ndarray) -> np.ndarray:
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+    def __len__(self) -> int:
+        return len(self._dense)
+
+    def _grow(self) -> None:
+        old_k, old_s = self._cell_key, self._cell_slot
+        cap = len(old_k) << 1
+        self._cell_key = np.zeros(cap, np.uint64)
+        self._cell_slot = np.full(cap, -1, np.int64)
+        live = old_s >= 0
+        self._insert_cells(old_k[live], old_s[live])
+
+    def _insert_cells(self, keys: np.ndarray, slots: np.ndarray) -> None:
+        mask = np.uint64(len(self._cell_key) - 1)
+        j = self._hash(keys) & mask
+        pending = np.arange(len(keys))
+        while len(pending):
+            empty = self._cell_slot[j] < 0
+            # place one pending key per distinct empty cell per round
+            # (np.unique keeps the first occurrence per cell index)
+            cells, first = np.unique(j[empty], return_index=True)
+            pick = np.flatnonzero(empty)[first]
+            self._cell_key[cells] = keys[pick]
+            self._cell_slot[cells] = slots[pick]
+            placed = np.zeros(len(pending), bool)
+            placed[pick] = True
+            pending = pending[~placed]
+            keys, j = keys[~placed], j[~placed]
+            slots = slots[~placed]
+            j = (j + np.uint64(1)) & mask  # collided or occupied: step on
+        # note: duplicate keys are the caller's responsibility (resolve dedups)
+
+    def resolve(self, keys: np.ndarray, create: bool) -> np.ndarray:
+        out = np.full(len(keys), -1, np.int64)
+        mask = np.uint64(len(self._cell_key) - 1)
+        j = self._hash(keys) & mask
+        pending = np.arange(len(keys))
+        while len(pending):
+            ck = self._cell_key[j]
+            cs = self._cell_slot[j]
+            hit = (cs >= 0) & (ck == keys[pending])
+            out[pending[hit]] = cs[hit]
+            miss_empty = cs < 0  # key absent
+            if not create:
+                done = hit | miss_empty
+            else:
+                absent = pending[miss_empty]
+                if len(absent):
+                    # assign dense slots in first-seen order (dedup batch)
+                    uk, first = np.unique(keys[absent], return_index=True)
+                    order = np.argsort(first, kind="stable")
+                    base = len(self._dense)
+                    slot_of = {}
+                    for t, ui in enumerate(order):
+                        slot_of[int(uk[ui])] = base + t
+                        self._dense.append(uk[ui])
+                    new_slots = np.asarray(
+                        [slot_of[int(k)] for k in keys[absent]], np.int64
+                    )
+                    out[absent] = new_slots
+                    # grow BEFORE inserting: a batch larger than the free
+                    # cells would otherwise probe a full table forever
+                    while len(self._dense) * 10 > len(self._cell_key) * 7:
+                        self._grow()
+                    self._insert_cells(uk, new_slots[first])
+                done = hit | miss_empty
+            pending = pending[~done]
+            if len(self._cell_key) - 1 != int(mask):
+                # table grew mid-resolve: cells moved, restart the probe walk
+                # for the still-pending keys against the new layout
+                mask = np.uint64(len(self._cell_key) - 1)
+                j = self._hash(keys[pending]) & mask
+            else:
+                j = (j[~done] + np.uint64(1)) & mask
+        return out
+
+    def keys(self) -> np.ndarray:
+        return np.asarray(self._dense, np.uint64)
+
+
+class KVIndex:
+    """key(u64) -> dense slot, batched. Slots are assigned in first-seen
+    order and never move; device value arrays only ever append."""
+
+    def __init__(self, initial_capacity: int = 1024):
+        lib = _lib()
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.mv_kv_index_new(int(initial_capacity))
+        else:
+            self._np = _NumpyIndex(int(initial_capacity))
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and getattr(self, "_h", None):
+            self._lib.mv_kv_index_free(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.mv_kv_index_size(self._h))
+        return len(self._np)
+
+    def resolve(self, keys, create: bool = False) -> np.ndarray:
+        """Slots for ``keys`` (any integer dtype, viewed as u64); -1 for
+        unknown keys when ``create`` is False. One native call per batch."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1))
+        if keys.dtype != np.uint64:
+            keys = keys.astype(np.int64).view(np.uint64)
+        if self._lib is not None:
+            out = np.empty(len(keys), np.int64)
+            self._lib.mv_kv_index_resolve(
+                self._h, keys, len(keys), 1 if create else 0, out
+            )
+            return out
+        return self._np.resolve(keys, create)
+
+    def keys(self) -> np.ndarray:
+        """All keys in dense-slot order (uint64 view)."""
+        if self._lib is not None:
+            n = len(self)
+            out = np.empty(n, np.uint64)
+            if n:
+                self._lib.mv_kv_index_keys(self._h, out)
+            return out
+        return self._np.keys()

@@ -9,27 +9,32 @@ keeps a local cached map ``raw()`` refreshed by Get replies
 
 TPU-native split (SURVEY.md §7 step 4 — the riskiest fidelity/perf tradeoff,
 resolved the way the reference itself does it): the *hash index* is host-side
-control metadata (the reference's unordered_map also lives in host RAM), a
-dict mapping key -> dense slot; the *values* live in HBM as one sharded
-1-D array, so accumulation is an O(batch) device scatter-add and the value
-store scales across the mesh. Capacity grows by doubling; batch sizes are
-bucketed to powers of two to bound recompiles (padding adds zero to slot 0,
-which is harmless for ``+=``).
+control metadata (the reference's unordered_map also lives in host RAM) —
+a native batched open-addressing index (native/kv_index.cpp, the analog of
+the reference's hopscotch hash — Applications/LogisticRegression/src/util/
+hopscotch_hash.h) resolving whole key batches to dense slots in one call;
+the *values* live in HBM as one sharded array, so accumulation is an
+O(batch) device scatter-add and the value store scales across the mesh.
+Capacity grows by doubling; batch sizes are bucketed to powers of two to
+bound recompiles (padding adds zero to slot 0, which is harmless for ``+=``).
 
-Improvement over the reference: ``Store``/``Load`` work (the reference
-Log::Fatal's — ref: kv_table.h:108-114).
+Beyond the reference: ``Store``/``Load`` work (the reference Log::Fatal's —
+ref: kv_table.h:108-114), and values may be fixed-width vectors
+(``val_dim > 1``) — the unbounded-key FTRL (z, n) state store
+(ref: util/ftrl_sparse_table.h:12-88) rides this.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from multiverso_tpu.native.kv_index import KVIndex
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.tables.base import TableOption, register_table_type
@@ -41,7 +46,12 @@ __all__ = ["KVTableOption", "KVTable"]
 @dataclasses.dataclass
 class KVTableOption(TableOption):
     val_dtype: Any = "float32"
+    val_dim: int = 1  # >1: fixed-width vector per key (e.g. FTRL (z, n))
     init_capacity: int = 1024
+    # mirror Get replies into the host-side raw() map (ref: kv_table.h:70-78).
+    # Turn off for unbounded-key hot paths (hashed FTRL): the mirror would
+    # retain one host entry per distinct key ever fetched.
+    cache_local: bool = True
     name: str = "kv_table"
 
 
@@ -61,63 +71,69 @@ class KVTable:
         self.name = option.name
         self.table_id = -1
         self.dtype = jnp.dtype(option.val_dtype)
+        self.val_dim = int(option.val_dim)
+        CHECK(self.val_dim >= 1, "val_dim must be >= 1")
         self.num_shards = mesh_lib.num_shards(self.mesh)
-        self._sharding = mesh_lib.table_sharding(self.mesh, 1)
+        ndim = 1 if self.val_dim == 1 else 2
+        self._sharding = mesh_lib.table_sharding(self.mesh, ndim)
         self._replicated = mesh_lib.replicated_sharding(self.mesh)
         self._capacity = _next_pow2(max(option.init_capacity, self.num_shards))
-        self._index: Dict[Any, int] = {}  # key -> dense slot (host control plane)
+        self._index = KVIndex(self._capacity)  # key -> dense slot (host)
+        self._key_dtype = np.dtype(np.int64)
         self._values = jax.device_put(
-            np.zeros(self._capacity, self.dtype), self._sharding
+            np.zeros(self._shape(self._capacity), self.dtype), self._sharding
         )
         self._local: Dict[Any, Any] = {}  # worker-side cached map (ref raw())
+        self._cache_local = bool(option.cache_local)
         self._scatter_fn = None
         self._gather_fn = None
 
     # ------------------------------------------------------------ internals
+
+    def _shape(self, cap: int):
+        return (cap,) if self.val_dim == 1 else (cap, self.val_dim)
 
     def _grow(self, needed: int) -> None:
         new_cap = self._capacity
         while new_cap < needed:
             new_cap <<= 1
         host = np.asarray(self._values)
-        host = np.pad(host, (0, new_cap - self._capacity))
+        pad = [(0, new_cap - self._capacity)] + [(0, 0)] * (host.ndim - 1)
+        host = np.pad(host, pad)
         self._capacity = new_cap
         self._values = jax.device_put(host, self._sharding)
         self._scatter_fn = None  # capacity change => new shapes
         self._gather_fn = None
 
-    def _slots_for(self, keys: np.ndarray, create: bool) -> np.ndarray:
-        slots = np.empty(len(keys), np.int32)
-        for i, k in enumerate(keys):
-            k = k.item() if hasattr(k, "item") else k
-            slot = self._index.get(k)
-            if slot is None:
-                if not create:
-                    slot = -1
-                else:
-                    slot = len(self._index)
-                    self._index[k] = slot
-            slots[i] = slot
-        if create and len(self._index) > self._capacity:
-            self._grow(len(self._index))
-        return slots
+    def _check_keys(self, keys) -> np.ndarray:
+        keys = np.asarray(keys).reshape(-1)
+        CHECK(keys.dtype.kind in "iu",
+              f"KV keys must be integers (got {keys.dtype}); the reference "
+              "KVTable is templated on integral keys (kv_table.h:18)")
+        return keys
 
     def _pad(self, arr: np.ndarray, fill=0) -> np.ndarray:
         n = _next_pow2(max(len(arr), 1))
         if n == len(arr):
             return arr
-        return np.pad(arr, (0, n - len(arr)), constant_values=fill)
+        pad = [(0, n - len(arr))] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, pad, constant_values=fill)
 
     # ------------------------------------------------------------ table ops
 
     def add(self, keys, vals) -> None:
-        """Server ``+=`` per key (ref: kv_table.h:96-103)."""
-        keys = np.asarray(keys).reshape(-1)
-        vals = np.asarray(vals, self.dtype).reshape(-1)
-        CHECK(keys.shape == vals.shape, "keys and vals must have equal length")
-        slots = self._slots_for(keys, create=True)
-        # padding adds 0.0 to slot 0 — a no-op for +=
-        slots_p = jnp.asarray(self._pad(slots, fill=0))
+        """Server ``+=`` per key (ref: kv_table.h:96-103); duplicate keys in
+        one batch accumulate."""
+        keys = self._check_keys(keys)
+        vals = np.asarray(vals, self.dtype)
+        vals = vals.reshape((-1,) if self.val_dim == 1 else (-1, self.val_dim))
+        CHECK(len(keys) == len(vals), "keys and vals must have equal length")
+        self._key_dtype = keys.dtype
+        slots = self._index.resolve(keys, create=True)
+        if len(self._index) > self._capacity:
+            self._grow(len(self._index))
+        # padding adds 0 to slot 0 — a no-op for +=
+        slots_p = jnp.asarray(self._pad(slots.astype(np.int32), fill=0))
         vals_p = jnp.asarray(self._pad(vals, fill=0))
         if self._scatter_fn is None:
             self._scatter_fn = jax.jit(
@@ -131,8 +147,8 @@ class KVTable:
         """Values for a key set; refreshes the local cached map
         (ref: kv_table.h:70-78 ProcessReplyGet assigns into raw()).
         Unknown keys read as 0 (the reference's operator[] default)."""
-        keys = np.asarray(keys).reshape(-1)
-        slots = self._slots_for(keys, create=False)
+        keys = self._check_keys(keys)
+        slots = self._index.resolve(keys, create=False)
         safe = np.where(slots >= 0, slots, 0).astype(np.int32)
         if self._gather_fn is None:
             self._gather_fn = jax.jit(
@@ -140,9 +156,14 @@ class KVTable:
             )
         vals = np.asarray(self._gather_fn(self._values, jnp.asarray(self._pad(safe))))
         vals = vals[: len(keys)]
-        vals = np.where(slots >= 0, vals, np.zeros_like(vals))
-        for k, v in zip(keys, vals):
-            self._local[k.item() if hasattr(k, "item") else k] = v
+        miss = slots < 0
+        if miss.any():
+            vals = np.where(
+                miss if self.val_dim == 1 else miss[:, None],
+                np.zeros_like(vals), vals,
+            )
+        if self._cache_local:
+            self._local.update(zip(keys.tolist(), vals))
         return vals
 
     def raw(self) -> Dict[Any, Any]:
@@ -151,12 +172,15 @@ class KVTable:
 
     def items(self) -> Tuple[np.ndarray, np.ndarray]:
         """All (key, value) pairs currently stored server-side."""
-        if not self._index:
-            return np.asarray([]), np.asarray([], self.dtype)
-        keys = np.asarray(list(self._index.keys()))
-        slots = np.asarray(list(self._index.values()), np.int32)
+        n = len(self._index)
+        if n == 0:
+            return (np.asarray([], self._key_dtype),
+                    np.zeros(self._shape(0), self.dtype))
+        keys = self._index.keys().view(np.int64)
+        if keys.dtype != self._key_dtype:
+            keys = keys.astype(self._key_dtype)
         host = np.asarray(self._values)
-        return keys, host[slots]
+        return keys, host[:n]
 
     def wait(self) -> None:
         jax.block_until_ready(self._values)
@@ -164,8 +188,7 @@ class KVTable:
     # ------------------------------------------------------------ checkpoint
 
     def store(self, uri_or_stream) -> None:
-        """Works (the reference Log::Fatal's — ref: kv_table.h:108-114).
-        Keys must be a homogeneous numeric/string set (no pickling)."""
+        """Works (the reference Log::Fatal's — ref: kv_table.h:108-114)."""
         import io as _pyio
 
         from multiverso_tpu.io.streams import as_stream
@@ -189,10 +212,10 @@ class KVTable:
         if owned:
             stream.Close()
         keys, vals = data["keys"], data["vals"]
-        self._index.clear()
+        self._index = KVIndex(self._capacity)
         self._local.clear()
         self._values = jax.device_put(
-            np.zeros(self._capacity, self.dtype), self._sharding
+            np.zeros(self._shape(self._capacity), self.dtype), self._sharding
         )
         if len(keys):
             self.add(keys, vals)

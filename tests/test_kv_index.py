@@ -1,0 +1,84 @@
+"""Batched u64 key->slot index (native/kv_index.cpp + numpy fallback).
+
+The reference resolves keys one unordered_map/hopscotch probe at a time
+(ref: include/multiverso/table/kv_table.h:48-65,
+Applications/LogisticRegression/src/util/hopscotch_hash.h); the TPU build
+batches a whole minibatch per call. Both backends must agree exactly, and
+the VERDICT round-1 bar is >=100k key-resolutions/s.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.native import kv_index as ki
+
+
+@pytest.fixture(params=["native", "numpy"])
+def index_cls(request, monkeypatch):
+    if request.param == "native":
+        if ki._lib() is None:
+            pytest.skip("native kv_index unavailable")
+    else:
+        monkeypatch.setattr(ki, "_LIB", None)
+        monkeypatch.setattr(ki, "_TRIED", True)
+    return ki.KVIndex
+
+
+def test_resolve_create_and_lookup(index_cls):
+    ix = index_cls(16)
+    keys = np.asarray([5, -7, 2**62, 5, 0, -7], np.int64)
+    s = ix.resolve(keys, create=True)
+    # first-seen dense slot order, duplicates share slots
+    np.testing.assert_array_equal(s, [0, 1, 2, 0, 3, 1])
+    assert len(ix) == 4
+    np.testing.assert_array_equal(ix.resolve(keys, create=False), s)
+    assert ix.resolve(np.asarray([123456789], np.int64))[0] == -1
+    np.testing.assert_array_equal(
+        ix.keys().view(np.int64), [5, -7, 2**62, 0]
+    )
+
+
+def test_growth_random_u64(index_cls):
+    """Keys vastly exceeding the initial capacity (the unbounded-CTR shape)."""
+    ix = index_cls(8)
+    rng = np.random.RandomState(0)
+    keys = rng.randint(-2**63, 2**63 - 1, size=30_000, dtype=np.int64)
+    s1 = ix.resolve(keys, create=True)
+    assert len(ix) == len(np.unique(keys))
+    np.testing.assert_array_equal(ix.resolve(keys, create=False), s1)
+    # slots are dense 0..n-1
+    assert s1.min() == 0 and s1.max() == len(ix) - 1
+    # incremental second batch keeps old slots stable
+    more = rng.randint(-2**63, 2**63 - 1, size=10_000, dtype=np.int64)
+    ix.resolve(more, create=True)
+    np.testing.assert_array_equal(ix.resolve(keys, create=False), s1)
+
+
+def test_backends_agree():
+    if ki._lib() is None:
+        pytest.skip("native kv_index unavailable")
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 1 << 48, size=5_000, dtype=np.int64)
+    a = ki.KVIndex(4)
+    slots_a = a.resolve(keys, create=True)
+    b = ki.KVIndex.__new__(ki.KVIndex)
+    b._lib = None
+    b._np = ki._NumpyIndex(4)
+    slots_b = b.resolve(keys, create=True)
+    np.testing.assert_array_equal(slots_a, slots_b)
+    np.testing.assert_array_equal(a.keys(), b.keys())
+
+
+def test_throughput_bar(index_cls):
+    """VERDICT #3 'done' bar: >=100k key-resolutions/s (the native path runs
+    ~10M/s; the bar keeps the test meaningful on any fallback)."""
+    ix = index_cls(1024)
+    rng = np.random.RandomState(1)
+    keys = rng.randint(0, 2**63 - 1, size=200_000, dtype=np.int64)
+    t0 = time.perf_counter()
+    ix.resolve(keys, create=True)
+    ix.resolve(keys, create=False)
+    rate = 2 * len(keys) / (time.perf_counter() - t0)
+    assert rate >= 100_000, f"{rate:.0f} key-resolutions/s below the bar"

@@ -311,3 +311,49 @@ def test_local_superbatch_matches_single_steps(mv_env):
         last = m2.train_batch(b)
     assert np.allclose(m1.weights(), m2.weights(), atol=1e-6)
     assert np.isfinite(float(loss1))
+
+
+def test_ftrl_hashed_unbounded_keys(mv_env, tmp_path):
+    """input_size=0: FTRL state on raw 64-bit hashed feature keys with no
+    dimension bound (ref: the hopscotch-backed FTRL sparse table —
+    Applications/LogisticRegression/src/util/ftrl_sparse_table.h:12-88,
+    hopscotch_hash.h; the 4TB Bing-Ads CTR deployment shape, README.md:5).
+    Keys are drawn from the full u64 space, vastly exceeding the KV store's
+    initial capacity."""
+    rng = np.random.RandomState(9)
+    f = 60
+    feat_keys = rng.randint(0, 2**63 - 1, size=f, dtype=np.int64)
+    wtrue = rng.randn(f)
+    n = 512
+    picks = rng.randint(0, f, size=(n, 5))
+    y = (np.asarray([wtrue[p].sum() for p in picks]) > 0).astype(int)
+    train = tmp_path / "train.txt"
+    with open(train, "w") as fh:
+        for pi, yi in zip(picks, y):
+            fh.write(f"{yi} " + " ".join(f"{feat_keys[k]}:1" for k in pi) + "\n")
+    cfg = Configure(
+        input_size=0, output_size=1, sparse=True, objective_type="ftrl",
+        updater_type="ftrl", train_epoch=6, minibatch_size=64,
+        alpha=0.1, beta=1.0, lambda1=0.01, lambda2=0.001,
+        train_file=str(train), test_file=str(train),
+        output_model_file="", output_file="", show_time_per_sample=10**9,
+        use_ps=False, pipeline=False,
+    )
+    from multiverso_tpu.models.logreg import LogReg
+
+    lr = LogReg(cfg)
+    lr.Train()
+    acc = lr.Test(output_file="")
+    assert acc > 0.8, f"hashed FTRL failed to fit: acc={acc}"
+    # state store: only seen keys (+ the padding key 0) exist
+    keys, w = lr.model.hashed_weights()
+    assert set(np.asarray(keys).tolist()) <= set(feat_keys.tolist()) | {0}
+    assert len(keys) >= f - 5
+    # save/load roundtrip preserves predictions
+    p = str(tmp_path / "ftrl_hashed.npz")
+    lr.model.save(p)
+    cfg2 = Configure(**{**cfg.__dict__, "train_epoch": 0})
+    lr2 = LogReg(cfg2)
+    lr2.model.load(p)
+    acc2 = lr2.Test(output_file="")
+    assert acc2 == acc

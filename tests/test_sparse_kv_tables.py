@@ -177,3 +177,28 @@ def test_one_bits_filter_error_feedback():
     assert err.max() < 4.0, err.max()  # vs ~40 if bias accumulated
     # payload is 1 bit/entry + 2 scales
     assert comp[2].nbytes == 256 // 8
+
+
+def test_kv_vector_values(mv_env):
+    """val_dim>1: fixed-width vector per key (the FTRL (z, n) store shape)."""
+    t = mv_env.MV_CreateTable(KVTableOption(val_dim=2, init_capacity=8))
+    keys = np.asarray([9, 2**61, -5], np.int64)
+    t.add(keys, np.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]))
+    t.add(keys[:1], np.asarray([[0.5, 0.5]]))
+    got = t.get(np.asarray([9, 2**61, -5, 777], np.int64))
+    np.testing.assert_allclose(
+        got, [[1.5, 2.5], [3.0, 4.0], [5.0, 6.0], [0.0, 0.0]]
+    )
+    ks, vs = t.items()
+    assert vs.shape == (3, 2)
+    np.testing.assert_array_equal(ks, keys)
+
+
+def test_kv_vector_store_load(mv_env, tmp_path):
+    t = mv_env.MV_CreateTable(KVTableOption(val_dim=3))
+    t.add([11, 22], [[1, 2, 3], [4, 5, 6]])
+    p = str(tmp_path / "kvv.npz")
+    t.store(p)
+    t2 = mv_env.MV_CreateTable(KVTableOption(val_dim=3))
+    t2.load(p)
+    np.testing.assert_allclose(t2.get([22, 11]), [[4, 5, 6], [1, 2, 3]])
