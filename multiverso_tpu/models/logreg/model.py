@@ -221,17 +221,15 @@ class PSModel(LocalModel):
             MatrixTableOption(num_row=self.F, num_col=self.C, name="logreg_weights")
         )
         self._since_pull = 0
-        self._pipeline_buf = None
-        if config.pipeline:
-            from multiverso_tpu.utils.async_buffer import ASyncBuffer
-
-            self._pipeline_buf = ASyncBuffer(self.table.get_async)
+        self._pipeline = bool(config.pipeline)
 
     def _pull(self) -> None:
-        if self._pipeline_buf is not None:
-            table_fm = np.asarray(self._pipeline_buf.Get())  # (F, C), prefetched
-        else:
-            table_fm = self.table.get()
+        # pipelined pulls serve bounded-stale state in async mode and exact
+        # state under -sync=true (BSP forbids stale reads); the mode rule
+        # lives in one place — DenseTable.get_pipelined
+        table_fm = (
+            self.table.get_pipelined() if self._pipeline else self.table.get()
+        )
         self.W = jnp.asarray(table_fm.T)  # class-major view for the step
 
     def train_superbatch(self, batches):

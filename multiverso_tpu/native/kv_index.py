@@ -106,7 +106,8 @@ class _NumpyIndex:
             j = (j + np.uint64(1)) & mask  # collided or occupied: step on
         # note: duplicate keys are the caller's responsibility (resolve dedups)
 
-    def resolve(self, keys: np.ndarray, create: bool) -> np.ndarray:
+    def _lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Probe-only batch lookup: -1 for absent keys."""
         out = np.full(len(keys), -1, np.int64)
         mask = np.uint64(len(self._cell_key) - 1)
         j = self._hash(keys) & mask
@@ -116,38 +117,33 @@ class _NumpyIndex:
             cs = self._cell_slot[j]
             hit = (cs >= 0) & (ck == keys[pending])
             out[pending[hit]] = cs[hit]
-            miss_empty = cs < 0  # key absent
-            if not create:
-                done = hit | miss_empty
-            else:
-                absent = pending[miss_empty]
-                if len(absent):
-                    # assign dense slots in first-seen order (dedup batch)
-                    uk, first = np.unique(keys[absent], return_index=True)
-                    order = np.argsort(first, kind="stable")
-                    base = len(self._dense)
-                    slot_of = {}
-                    for t, ui in enumerate(order):
-                        slot_of[int(uk[ui])] = base + t
-                        self._dense.append(uk[ui])
-                    new_slots = np.asarray(
-                        [slot_of[int(k)] for k in keys[absent]], np.int64
-                    )
-                    out[absent] = new_slots
-                    # grow BEFORE inserting: a batch larger than the free
-                    # cells would otherwise probe a full table forever
-                    while len(self._dense) * 10 > len(self._cell_key) * 7:
-                        self._grow()
-                    self._insert_cells(uk, new_slots[first])
-                done = hit | miss_empty
+            done = hit | (cs < 0)  # found, or empty cell => absent
             pending = pending[~done]
-            if len(self._cell_key) - 1 != int(mask):
-                # table grew mid-resolve: cells moved, restart the probe walk
-                # for the still-pending keys against the new layout
-                mask = np.uint64(len(self._cell_key) - 1)
-                j = self._hash(keys[pending]) & mask
-            else:
-                j = (j[~done] + np.uint64(1)) & mask
+            j = (j[~done] + np.uint64(1)) & mask
+        return out
+
+    def resolve(self, keys: np.ndarray, create: bool) -> np.ndarray:
+        # lookup first, then create ALL missing keys in first-seen array
+        # order — the exact slot-order contract of the native backend (a
+        # probe-round discovery order would depend on hash collisions)
+        out = self._lookup(keys)
+        if not create:
+            return out
+        missing = out < 0
+        if missing.any():
+            pos = np.flatnonzero(missing)
+            uk, first = np.unique(keys[pos], return_index=True)
+            order = np.argsort(pos[first], kind="stable")  # first-seen order
+            base = len(self._dense)
+            new_slots_sorted = np.empty(len(uk), np.int64)  # aligned with uk
+            new_slots_sorted[order] = base + np.arange(len(uk))
+            self._dense.extend(uk[order])
+            # grow BEFORE inserting: a batch larger than the free cells
+            # would otherwise probe a full table forever
+            while len(self._dense) * 10 > len(self._cell_key) * 7:
+                self._grow()
+            self._insert_cells(uk, new_slots_sorted)
+            out[pos] = new_slots_sorted[np.searchsorted(uk, keys[pos])]
         return out
 
     def keys(self) -> np.ndarray:

@@ -52,10 +52,15 @@ __all__ = ["Runtime", "runtime"]
 # hardware (see module docstring).
 MV_DEFINE_string("ps_role", "all", "role of this node (reference parity; 'all' on TPU)")
 MV_DEFINE_bool("ma", False, "model-averaging mode: no tables, MV_Aggregate only")
-# NOTE: under a single-controller SPMD program, core table Get/Add are issued
-# in program order, so the reference's sync(BSP)-vs-async distinction is
-# deterministic by construction; the flag gates the *staleness* features
-# (pipeline double-buffer gets, sync_frequency batching) in the handler layer.
+# Under a single-controller SPMD program, core table Get/Add are issued in
+# program order, so *exact* Get/Add are deterministic either way. The flag's
+# observable semantics live in the bounded-staleness read path:
+# -sync=false (async PS): ``get_pipelined()`` serves the double-buffered
+#   snapshot — reads lag commits by one pull round (the reference's
+#   ASyncBuffer/GetPipelineTable behavior, ps_model.cpp:232-271);
+# -sync=true (BSP): pipelined reads degrade to exact Gets — the sync
+#   server's contract that every worker's i-th read reflects the complete
+#   round (ref: src/server.cpp:61-222 vector clocks).
 MV_DEFINE_bool("sync", False, "BSP-synchronous update application (see note above)")
 MV_DEFINE_int("num_shards", 0, "table shard axis size (0 = role ALL 1-D mesh)")
 # Straggler-mitigation knob. The reference *declares* this flag

@@ -129,6 +129,7 @@ class DenseTable:
             ).items()
         }
         self._compiled: Dict[str, Any] = {}
+        self._stale_buf = None  # get_pipelined double buffer
 
     # ----------------------------------------------------------- sharding
 
@@ -177,6 +178,34 @@ class DenseTable:
         WORKER_GET_PROCESS_TIME monitor (ref: worker.cpp:31)."""
         with monitor("table.get"):
             return np.asarray(self.get_async())
+
+    def get_pipelined(self) -> np.ndarray:
+        """Bounded-staleness read — the observable async-PS semantics.
+
+        Under ``-sync=false`` (async mode) this is the double-buffered pull
+        of the reference's pipeline path (ref: util/async_buffer.h:10-116;
+        Applications/LogisticRegression/src/model/ps_model.cpp:232-271
+        GetPipelineTable): it returns the snapshot captured at the *previous*
+        pipelined read and dispatches the capture of the current state for
+        the next one — reads lag commits by exactly one pull round, and the
+        capture overlaps with the caller's compute (the pipelining win).
+
+        Under ``-sync=true`` it degrades to an exact ``get()``: the BSP
+        contract is that every worker's i-th read reflects the complete
+        round (ref: src/server.cpp:61-67 — the sync server's guarantee), so
+        a stale buffer would violate the mode's semantics.
+        """
+        from multiverso_tpu.utils.configure import GetFlag
+
+        if GetFlag("sync"):
+            self._stale_buf = None
+            return self.get()
+        prev = self._stale_buf
+        # capture now (async dispatch), serve it at the NEXT call
+        self._stale_buf = self.get_async()
+        if prev is None:
+            prev = self._stale_buf  # first pull is fresh (ASyncBuffer:Get)
+        return np.asarray(prev)
 
     # ----------------------------------------------------------- add path
 

@@ -107,6 +107,8 @@ class KVTable:
 
     def _check_keys(self, keys) -> np.ndarray:
         keys = np.asarray(keys).reshape(-1)
+        if len(keys) == 0:  # empty batch: no-op (dtype of [] is float64)
+            return keys.astype(np.int64)
         CHECK(keys.dtype.kind in "iu",
               f"KV keys must be integers (got {keys.dtype}); the reference "
               "KVTable is templated on integral keys (kv_table.h:18)")
