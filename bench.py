@@ -123,7 +123,7 @@ def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=64,
     return best
 
 
-def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=128,
+def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=256,
                     corpus_tokens=8_000_000):
     """Zero-host-traffic mode: corpus resident in HBM, sampling/negatives/
     presort inside the jitted step (-device_pipeline). Reported as a
@@ -132,6 +132,7 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=128,
     from multiverso_tpu.models.wordembedding.skipgram import (
         build_negative_lut,
         init_params,
+        make_ondevice_data,
         make_ondevice_superbatch_step,
     )
 
@@ -142,17 +143,18 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=128,
         np.bincount(corpus[corpus >= 0], minlength=cfg.vocab_size).astype(np.int64)
     )
     step = jax.jit(
-        make_ondevice_superbatch_step(
-            cfg, corpus, None, build_negative_lut(sampler.probs),
-            batch=batch, steps=scan_steps, neg_probs=sampler.probs,
-        ),
+        make_ondevice_superbatch_step(cfg, batch=batch, steps=scan_steps),
         donate_argnums=(0,),
+    )
+    data = make_ondevice_data(
+        cfg, corpus, None, build_negative_lut(sampler.probs),
+        batch=batch, neg_probs=sampler.probs,
     )
     params = init_params(cfg)
     key = jax.random.PRNGKey(0)
     for _ in range(warmup):
         key, sub = jax.random.split(key)
-        params, (loss, acc) = step(params, sub, jnp.float32(0.025))
+        params, (loss, acc) = step(params, data, sub, jnp.float32(0.025))
     float(loss)  # queue fence (see _bench_fused)
     best = 0.0
     for _ in range(3):  # best-of-3 (see _bench_fused)
@@ -160,7 +162,7 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=128,
         t0 = time.perf_counter()
         for _ in range(calls):
             key, sub = jax.random.split(key)
-            params, (loss, acc) = step(params, sub, jnp.float32(0.025))
+            params, (loss, acc) = step(params, data, sub, jnp.float32(0.025))
             accepted = accepted + acc
         total = float(accepted)  # host force closes the timing
         best = max(best, total / (time.perf_counter() - t0))
@@ -194,7 +196,7 @@ def _bench_e2e(dim=128, device_tokens=None, host_tokens=None):
     from multiverso_tpu.models.wordembedding.synth import SynthConfig, generate
 
     device_tokens = device_tokens or int(
-        os.environ.get("MV_BENCH_E2E_TOKENS", 20_000_000)
+        os.environ.get("MV_BENCH_E2E_TOKENS", 40_000_000)
     )
     host_tokens = host_tokens or int(
         os.environ.get("MV_BENCH_E2E_HOST_TOKENS", 4_000_000)
@@ -208,7 +210,7 @@ def _bench_e2e(dim=128, device_tokens=None, host_tokens=None):
         batch_size=8192, sample=1e-3, min_count=1, output_file="",
     )
     # --- device pipeline leg (full loop: upload, sampling, lr syncs) ---
-    opt = WEOptions(**base, steps_per_call=128, device_pipeline=True)
+    opt = WEOptions(**base, steps_per_call=256, device_pipeline=True)
     we = WordEmbedding(opt, dictionary=d)
     t0 = time.perf_counter()
     we.train(ids)
@@ -301,6 +303,10 @@ def main():
         "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
         "value": round(fused, 1),
         "unit": "pairs/sec",
+        # distribution tag: 'value' measures skewed-Zipf id batches since
+        # round 2 (round 1 measured uniform ids — that leg continues as
+        # uniform_ids_value); cross-round tooling must not conflate them
+        "value_distribution": "zipf_skewed",
         "vs_baseline": round(fused_uniform / ps, 3),
         "uniform_ids_value": round(fused_uniform, 1),
         "unsorted_value": round(fused_unsorted, 1),

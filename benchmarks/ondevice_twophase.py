@@ -19,7 +19,8 @@ def main():
     from multiverso_tpu.models.wordembedding.sampler import AliasSampler
     from multiverso_tpu.models.wordembedding.skipgram import (
         SkipGramConfig, _run_length_scale, build_negative_lut, init_params,
-        make_ondevice_batch_fn, make_ondevice_superbatch_step,
+        make_ondevice_batch_fn, make_ondevice_data,
+        make_ondevice_superbatch_step,
     )
 
     B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
@@ -38,11 +39,13 @@ def main():
     key = jax.random.PRNGKey(0)
     lr = jnp.float32(0.025)
     pairs = B * S
-    sample = make_ondevice_batch_fn(cfg, corpus, None, lut, B)
+    sample = make_ondevice_batch_fn(cfg, B)
+    data = make_ondevice_data(cfg, corpus_np, None, lut, batch=B,
+                              neg_probs=sampler.probs)
 
-    def two_phase(params, key, lr):
+    def two_phase(params, data, key, lr):
         keys = jax.random.split(key, S)
-        c, o, w = jax.vmap(sample)(keys)          # (S,B) (S,B,1+K) (S,B)
+        c, o, w = jax.vmap(lambda k: sample(data, k))(keys)  # (S,B) (S,B,1+K) (S,B)
         ts = o[:, :, 0]
         # per-microbatch presort of centers and positives (negatives flat
         # block is sorted by construction)
@@ -104,12 +107,13 @@ def main():
               f"(raw {best / (tot/(5*pairs)) / 1e6:.2f}M)")
         return params
 
-    cur = jax.jit(make_ondevice_superbatch_step(
-        cfg, corpus_np, None, lut, batch=B, steps=S, neg_probs=sampler.probs),
-        donate_argnums=(0,))
-    bench(f"current interleaved B={B} S={S}", cur, init_params(cfg))
+    cur = jax.jit(make_ondevice_superbatch_step(cfg, batch=B, steps=S),
+                  donate_argnums=(0,))
+    bench(f"current interleaved B={B} S={S}",
+          lambda p, k, lr: cur(p, data, k, lr), init_params(cfg))
     tp = jax.jit(two_phase, donate_argnums=(0,))
-    bench(f"two-phase B={B} S={S}", tp, init_params(cfg))
+    bench(f"two-phase B={B} S={S}",
+          lambda p, k, lr: tp(p, data, k, lr), init_params(cfg))
 
 
 if __name__ == "__main__":

@@ -42,7 +42,8 @@ def main():
     from multiverso_tpu.models.wordembedding.sampler import AliasSampler
     from multiverso_tpu.models.wordembedding.skipgram import (
         SkipGramConfig, build_negative_lut, init_params,
-        make_ondevice_batch_fn, make_ondevice_superbatch_step,
+        make_ondevice_batch_fn, make_ondevice_data,
+        make_ondevice_superbatch_step,
     )
 
     B = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
@@ -63,41 +64,43 @@ def main():
     pairs = B * S
 
     # ---- full current step
-    full = jax.jit(make_ondevice_superbatch_step(
-        cfg, corpus_np, None, lut, batch=B, steps=S, neg_probs=sampler.probs))
-    timed(f"full superstep B={B} S={S}", lambda: full(params, key, lr),
+    data = make_ondevice_data(cfg, corpus_np, None, lut, batch=B,
+                              neg_probs=sampler.probs)
+    full = jax.jit(make_ondevice_superbatch_step(cfg, batch=B, steps=S))
+    timed(f"full superstep B={B} S={S}", lambda: full(params, data, key, lr),
           scale_pairs=pairs)
 
     # ---- sampling only
-    sample = make_ondevice_batch_fn(cfg, corpus, None, lut, B)
+    sample = make_ondevice_batch_fn(cfg, B)
 
     @jax.jit
-    def sample_only(key):
+    def sample_only(data, key):
         def body(acc, k):
-            c, o, w = sample(k)
+            c, o, w = sample(data, k)
             return acc + jnp.sum(c) + jnp.sum(o) + jnp.sum(w), None
         acc, _ = jax.lax.scan(body, jnp.float32(0), jax.random.split(key, S))
         return acc
-    timed("  sampling only", sample_only, key, scale_pairs=pairs)
+    timed("  sampling only", sample_only, data, key, scale_pairs=pairs)
 
     # ---- argsort cost (the two B-sized argsorts)
     @jax.jit
-    def argsorts_only(key):
+    def argsorts_only(data, key):
         def body(acc, k):
-            c, o, w = sample(k)
+            c, o, w = sample(data, k)
             p1 = jnp.argsort(o[:, 0])
             p2 = jnp.argsort(c)
             return acc + p1[0] + p2[0], None
         acc, _ = jax.lax.scan(body, jnp.int32(0), jax.random.split(key, S))
         return acc
-    timed("  sampling + 2x argsort(B)", argsorts_only, key, scale_pairs=pairs)
+    timed("  sampling + 2x argsort(B)", argsorts_only, data, key,
+          scale_pairs=pairs)
 
     # ---- forward math only (gathers + einsums, no scatters)
     @jax.jit
-    def fwd_only(params, key):
+    def fwd_only(params, data, key):
         ein, eout = params["emb_in"], params["emb_out"]
         def body(acc, k):
-            c, o, w = sample(k)
+            c, o, w = sample(data, k)
             vin = ein[c]
             vout = eout[o]
             logits = jnp.einsum("bd,bkd->bk", vin, vout)
@@ -106,16 +109,16 @@ def main():
             return acc + jnp.sum(d_vin), None
         acc, _ = jax.lax.scan(body, jnp.float32(0), jax.random.split(key, S))
         return acc
-    timed("  sampling + fwd/bwd math (no scatter)", fwd_only, params, key,
+    timed("  sampling + fwd/bwd math (no scatter)", fwd_only, params, data, key,
           scale_pairs=pairs)
 
     # ---- scatters only (sorted negative block + 2 sorted B-blocks, no sort)
     @jax.jit
-    def scatters_only(params, key):
+    def scatters_only(params, data, key):
         ein, eout = params["emb_in"], params["emb_out"]
         def body(carry, k):
             ein, eout = carry
-            c, o, w = sample(k)
+            c, o, w = sample(data, k)
             nflat = o[:, 1:].T.reshape(-1)
             upd = jnp.ones((B * K, cfg.dim), jnp.float32)
             eout = eout.at[nflat].add(upd, indices_are_sorted=True)
@@ -128,23 +131,23 @@ def main():
             return (ein, eout), None
         (ein, eout), _ = jax.lax.scan(body, (ein, eout), jax.random.split(key, S))
         return jnp.sum(ein[0]) + jnp.sum(eout[0])
-    timed("  sampling + sort+all scatters (no math)", scatters_only, params, key,
-          scale_pairs=pairs)
+    timed("  sampling + sort+all scatters (no math)", scatters_only, params,
+          data, key, scale_pairs=pairs)
 
     # ---- run_length_scale cost
     from multiverso_tpu.models.wordembedding.skipgram import _run_length_scale
 
     @jax.jit
-    def rls_only(key):
+    def rls_only(data, key):
         def body(acc, k):
-            c, o, w = sample(k)
+            c, o, w = sample(data, k)
             nflat = o[:, 1:].T.reshape(-1)
             s1 = _run_length_scale(nflat, jnp.tile(w, K))
             s2 = _run_length_scale(jnp.sort(c), w)
             return acc + jnp.sum(s1) + jnp.sum(s2), None
         acc, _ = jax.lax.scan(body, jnp.float32(0), jax.random.split(key, S))
         return acc
-    timed("  sampling + run_length_scale (BK + B)", rls_only, key,
+    timed("  sampling + run_length_scale (BK + B)", rls_only, data, key,
           scale_pairs=pairs)
 
 

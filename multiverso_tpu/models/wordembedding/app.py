@@ -406,10 +406,21 @@ class WordEmbedding:
 
     def _train_ondevice(self, ids: np.ndarray, keep: Optional[np.ndarray]) -> float:
         """Fully device-resident training (-device_pipeline): the corpus is
-        uploaded once; sampling, negatives, presort and updates run inside
-        one jitted program per superbatch — zero per-step host traffic. The
-        TPU-native answer to slow host/link data paths (the reference's
-        answer was the pipeline thread; here there is nothing to overlap).
+        uploaded once per epoch; sampling, negatives, presort and updates run
+        inside one jitted program per superbatch — zero per-step host
+        traffic. The TPU-native answer to slow host/link data paths (the
+        reference's answer was the pipeline thread; here there is nothing to
+        overlap).
+
+        Subsampling runs on HOST, per epoch, by dropping tokens from the
+        stream before windowing — word2vec's actual semantics (the reference
+        removes subsampled words while loading the sentence, so windows span
+        the dropped positions; ref: wordembedding.cpp ParseSentence) — and
+        it keeps rejected draws from burning device batch slots (the
+        round-2 on-device keep gate cost ~1/3 of all slots on a Zipf corpus
+        at -sample=1e-3; see benchmarks/E2E_GAP.md). The compacted corpus is
+        padded back to the full corpus length and the valid-position index
+        to a fixed size, so every epoch reuses ONE compiled program.
 
         Mode coverage matches the reference's single training path
         (ref: wordembedding.cpp:57-166): the NS+skip-gram+SGD flagship runs
@@ -419,97 +430,150 @@ class WordEmbedding:
         from multiverso_tpu.models.wordembedding.skipgram import (
             build_negative_lut,
             make_ondevice_general_superbatch_step,
+            make_ondevice_prepare_fn,
+            make_ondevice_statics,
             make_ondevice_superbatch_step,
         )
 
         o = self.opt
         S = max(1, o.steps_per_call)
-        keep_in = None if o.sample <= 0 else keep
         if o.hs or o.cbow or o.use_adagrad:
             superstep = jax.jit(
                 make_ondevice_general_superbatch_step(
-                    self.cfg, ids, keep_in, batch=o.batch_size, steps=S,
-                    hs=o.hs, use_adagrad=o.use_adagrad, huffman=self.huffman,
-                    neg_lut=(
-                        None if o.hs else build_negative_lut(self.sampler.probs)
-                    ),
-                    scale_mode=o.scale_mode,
+                    self.cfg, batch=o.batch_size, steps=S, hs=o.hs,
+                    use_adagrad=o.use_adagrad, scale_mode=o.scale_mode,
                 ),
                 donate_argnums=(0,),
             )
         else:
             superstep = jax.jit(
                 make_ondevice_superbatch_step(
-                    # np arrays in: the builder derives host-side stats (valid-
-                    # position index, expected-count scale tables) then uploads
-                    self.cfg, ids, keep_in,
-                    build_negative_lut(self.sampler.probs),
-                    batch=o.batch_size, steps=S, scale_mode=o.scale_mode,
-                    neg_probs=self.sampler.probs,
+                    self.cfg, batch=o.batch_size, steps=S,
+                    scale_mode=o.scale_mode,
                 ),
                 donate_argnums=(0,),
             )
-        # epoch target = the host walk's sample count. Skip-gram: E[2*eff] =
-        # window+1 pairs per KEPT, non-marker position; CBOW: one window
-        # sample per kept position (markers emit nothing; a subsampled-out
-        # center emits nothing). Rejected draws are NOT trained samples —
-        # progress tracks the step's accepted count, synced at log points.
-        valid = ids >= 0
-        kept = float(keep[ids[valid]].sum()) if o.sample > 0 else float(valid.sum())
-        per_kept = 1 if o.cbow else (o.window + 1)
-        total_pairs = max(int(kept * per_kept * o.epoch), 1)
-        per_call = o.batch_size * S
-        est_calls = max(1, 2 * total_pairs // per_call)
-        max_calls = 20 * est_calls  # bound: degenerate corpora reject ~all
-        key = jax.random.PRNGKey(o.seed)
+        flagship = not (o.hs or o.cbow or o.use_adagrad)
+        neg_lut = None if o.hs else build_negative_lut(self.sampler.probs)
         start = time.perf_counter()
+        t_phase = start
+        # one-time uploads: raw ids, LUTs/Huffman tables, keep probs, p34
+        ids_dev = jnp.asarray(ids)
+        statics = make_ondevice_statics(
+            self.cfg, neg_lut, batch=o.batch_size, huffman=self.huffman,
+        )
+        scale_tables = flagship and o.scale_mode == "row_mean"
+        p34_dev = (
+            jnp.asarray(self.sampler.probs.astype(np.float32))
+            if scale_tables else None
+        )
+        keep_dev = jnp.asarray(keep.astype(np.float32)) if o.sample > 0 else None
+        prepare = jax.jit(
+            make_ondevice_prepare_fn(
+                self.cfg, o.batch_size, subsample=o.sample > 0,
+                scale_tables=scale_tables,
+            )
+        )
+        prep_key = jax.random.PRNGKey(o.seed ^ 0x5EED5)
+        t2 = time.perf_counter()
+        Log.Info(
+            "[WordEmbedding] device-pipeline startup: setup+uploads %.1fs",
+            t2 - t_phase,
+        )
+
+        def epoch_data(epoch: int):
+            """Fresh on-device subsample draw -> compacted corpus + data
+            pytree (identical shapes every epoch: no recompiles, no
+            re-uploads; one n_valid scalar readback)."""
+            dyn = prepare(
+                ids_dev, keep_dev, p34_dev,
+                jax.random.fold_in(prep_key, epoch),
+            )
+            return {**statics, **dyn}, int(dyn["n_valid"])
+
+        # epoch target = the host walk's sample count over the COMPACTED
+        # stream. Skip-gram: E[2*eff] = window+1 pairs per kept position;
+        # CBOW: one window sample per kept position. Rejected draws (context
+        # on a marker / off the end — subsampling no longer rejects) are NOT
+        # trained samples — progress tracks the step's accepted count,
+        # synced at log points.
+        per_kept = 1 if o.cbow else (o.window + 1)
+        per_call = o.batch_size * S
+        key = jax.random.PRNGKey(o.seed)
         loss_dev = None
-        accepted_dev = jnp.float32(0.0)
         pairs_done = 0
         calls = 0
-        synced_calls = 0
-        # accepted pairs per call, refined at each sync; the initial value is
-        # the hard upper bound (every slot accepted), so the projection can
-        # only over-estimate progress — it forces an early sync, never an
-        # overshoot past total_pairs by a whole log window
-        ppc = float(per_call)
-        log_every = max(1, est_calls // 20)
-        while pairs_done < total_pairs and calls < max_calls:
-            # smooth lr decay between host syncs: project progress from the
-            # measured accepted-rate instead of holding the last synced count
-            projected = pairs_done + ppc * (calls - synced_calls)
-            lr = self._lr(min(projected, total_pairs) / total_pairs)
-            key, sub = jax.random.split(key)
-            self.params, (loss_dev, acc) = superstep(
-                self.params, sub, jnp.float32(lr)
-            )
-            accepted_dev = accepted_dev + acc
-            calls += 1
-            projected = pairs_done + ppc * (calls - synced_calls)
-            if calls % log_every == 0 or projected >= total_pairs:
-                # drain the device accumulator into an exact host count and
-                # reset it: a run-long float32 sum loses integer precision
-                # past 2^24 accepted pairs (one host sync per window either way)
-                pairs_done += int(float(accepted_dev))
-                accepted_dev = jnp.float32(0.0)
-                ppc = max(1.0, pairs_done / calls)
-                synced_calls = calls
-                if calls % log_every == 0:
-                    rate = pairs_done / max(time.perf_counter() - start, 1e-9)
-                    Log.Info(
-                        "[WordEmbedding] device-pipeline: %.1fM pairs, %.0fk "
-                        "pairs/s, lr %.5f, loss %.4f",
-                        pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
-                    )
+        data, n_valid = epoch_data(0)
+        Log.Info(
+            "[WordEmbedding] device-pipeline startup: first epoch-prepare "
+            "(incl. compile) +%.1fs (total %.1fs)",
+            time.perf_counter() - t2, time.perf_counter() - start,
+        )
+        total_pairs = max(1, n_valid * per_kept * o.epoch)
+        # each host sync (accepted-count drain) costs a full tunnel round
+        # trip + pipeline drain (~0.2s measured — benchmarks/E2E_GAP.md):
+        # syncing every call caps the loop at 2.0M pairs/s vs 3.0M at an
+        # 8-call cadence and 3.16M unsynced, so the drain/log window is
+        # floored at 16 calls
+        log_every = max(16, (total_pairs // per_call) // 20)
+        for epoch in range(o.epoch):
+            if epoch > 0:
+                data, n_valid = epoch_data(epoch)
+            epoch_target = max(1, n_valid * per_kept)
+            epoch_done = 0
+            accepted_dev = jnp.float32(0.0)
+            epoch_calls0 = calls
+            synced_calls = calls
+            # accepted pairs per call, refined at each sync; the initial
+            # value is the hard upper bound (every slot accepted), so the
+            # projection can only over-estimate progress — it forces an
+            # early sync, never an overshoot by a whole log window
+            ppc = float(per_call)
+            est_calls = max(1, epoch_target // per_call)
+            max_calls = epoch_calls0 + 20 * est_calls
+            while epoch_done < epoch_target and calls < max_calls:
+                # smooth lr decay between host syncs: project progress from
+                # the measured accepted-rate instead of holding the last
+                # synced count
+                projected = pairs_done + ppc * (calls - synced_calls)
+                lr = self._lr(min(projected, total_pairs) / total_pairs)
+                key, sub = jax.random.split(key)
+                self.params, (loss_dev, acc) = superstep(
+                    self.params, data, sub, jnp.float32(lr)
+                )
+                accepted_dev = accepted_dev + acc
+                calls += 1
+                proj_epoch = epoch_done + ppc * (calls - synced_calls)
+                if calls % log_every == 0 or proj_epoch >= epoch_target:
+                    # drain the device accumulator into an exact host count
+                    # and reset it: a run-long float32 sum loses integer
+                    # precision past 2^24 accepted pairs (one host sync per
+                    # window either way)
+                    got = int(float(accepted_dev))
+                    accepted_dev = jnp.float32(0.0)
+                    epoch_done += got
+                    pairs_done += got
+                    ppc = max(1.0, epoch_done / max(calls - epoch_calls0, 1))
+                    synced_calls = calls
+                    if calls % log_every == 0:
+                        rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+                        Log.Info(
+                            "[WordEmbedding] device-pipeline: %.1fM pairs, "
+                            "%.0fk pairs/s, lr %.5f, loss %.4f",
+                            pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
+                        )
+            if calls != synced_calls:  # drain the epoch tail (if undrained)
+                got = int(float(accepted_dev))
+                epoch_done += got
+                pairs_done += got
+            if calls >= max_calls and epoch_done < epoch_target:
+                Log.Error(
+                    "[WordEmbedding] device-pipeline hit the %d-call bound at "
+                    "%.1fM/%.1fM epoch pairs — corpus rejects nearly every "
+                    "draw; epoch truncated",
+                    max_calls, epoch_done / 1e6, epoch_target / 1e6,
+                )
         jax.block_until_ready(self.params)
-        pairs_done += int(float(accepted_dev))  # drain the final window
-        if calls >= max_calls and pairs_done < total_pairs:
-            Log.Error(
-                "[WordEmbedding] device-pipeline hit the %d-call bound at "
-                "%.1fM/%.1fM pairs — corpus rejects nearly every draw; "
-                "epoch truncated",
-                max_calls, pairs_done / 1e6, total_pairs / 1e6,
-            )
         self.words_trained = pairs_done
         rate = self.words_trained / max(time.perf_counter() - start, 1e-9)
         Log.Info(

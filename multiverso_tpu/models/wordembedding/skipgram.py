@@ -40,6 +40,9 @@ __all__ = [
     "make_sorted_train_step",
     "make_sorted_superbatch_step",
     "make_ondevice_batch_fn",
+    "make_ondevice_data",
+    "make_ondevice_prepare_fn",
+    "make_ondevice_statics",
     "make_ondevice_superbatch_step",
     "make_ondevice_general_superbatch_step",
     "device_presort",
@@ -560,7 +563,7 @@ def _run_length_scale(i2: jnp.ndarray, w2: jnp.ndarray) -> jnp.ndarray:
     (both touch the array O(1) times; this one has fewer scan passes)."""
     n = i2.shape[0]
     boundary = i2[1:] != i2[:-1]
-    seg_start = jnp.concatenate([jnp.ones((1,), bool), boundary])
+    seg_start = jnp.concatenate([np.ones((1,), bool), boundary])
     seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
     sums = jnp.zeros((n,), w2.dtype).at[seg_id].add(w2, indices_are_sorted=True)
     return w2 / jnp.maximum(sums[seg_id], 1.0)
@@ -611,7 +614,7 @@ def _distance_lut(window: int) -> np.ndarray:
     )
 
 
-def _make_stratified_neg_fn(neg_lut: jnp.ndarray, batch: int, negatives: int):
+def _make_stratified_neg_fn(batch: int, negatives: int):
     """Sorted negative block drawn by stratified jittered uniforms with
     EXACT integer stratum bounds, precomputed on host: stratum j covers
     [lo_j, lo_{j+1}) with lo_j = j*Q//(BK), so idx_j = lo_j +
@@ -619,66 +622,244 @@ def _make_stratified_neg_fn(neg_lut: jnp.ndarray, batch: int, negatives: int):
     monotone non-decreasing BY INTEGER ARITHMETIC. (A float32
     (j + u_j) * Q/(BK) formulation can invert order near stratum
     boundaries — ulp is 0.5 at 2^22 — silently violating an
-    indices_are_sorted scatter contract.) Returns ``key -> (B*K,) sorted
-    word ids``; flat position j belongs to pair j % B (stride-by-batch)."""
-    q_size = neg_lut.shape[0]
+    indices_are_sorted scatter contract.) Returns ``(data, key) ->
+    (B*K,) sorted word ids``; flat position j belongs to pair j % B
+    (stride-by-batch). The LUT and the lo/span stratum tables all arrive
+    in the data pytree as traced ARGUMENTS: device-array constants cost a
+    device->host readback per constant at lowering (seconds each on the
+    tunneled backend — see make_ondevice_data)."""
     n = batch * negatives
-    lo_np = (np.arange(n + 1, dtype=np.int64) * q_size) // n
-    lo = jnp.asarray(lo_np[:-1].astype(np.int32))
-    span = jnp.asarray(np.diff(lo_np).astype(np.float32))
 
-    def draw(key):
+    def draw(data, key):
         u = jax.random.uniform(key, (n,))
-        return neg_lut[lo + (u * span).astype(jnp.int32)]
+        idx = data["neg_lo"] + (u * data["neg_span"]).astype(jnp.int32)
+        return data["neg_lut"][idx]
 
     return draw
 
 
-def _make_sg_pair_fn(config: SkipGramConfig, corpus, keep_probs, batch: int):
+def make_ondevice_data(
+    config: SkipGramConfig,
+    corpus,  # (n,) int32, -1 = sentence boundary / tail padding
+    keep_probs=None,  # (V,) subsample keep prob or None (host-compacted)
+    neg_lut: Optional[jnp.ndarray] = None,  # quantized inverse-CDF table
+    *,
+    batch: int,
+    scale_mode: str = "row_mean",
+    neg_probs: Optional[np.ndarray] = None,
+    huffman=None,
+) -> Dict[str, jnp.ndarray]:
+    """Device-resident data pytree for the on-device step builders.
+
+    The large arrays (corpus, valid-position index, negative LUT, scale
+    tables, Huffman tables) are handed to the jitted step as buffer
+    ARGUMENTS, never closure constants: closed-over arrays are inlined
+    into the lowered HLO as literals, and on the tunneled TPU backend an
+    8M-token corpus costs 33s of lower+compile that way vs 3.2s as
+    arguments (measured; see benchmarks/E2E_GAP.md). The pytree STRUCTURE
+    (which keys exist) is static per compile; the shapes are static too,
+    so per-epoch data rebuilds reuse one executable.
+
+    The sampler draws center indices in ``[0, n_valid)`` where ``n_valid``
+    is a DEVICE SCALAR in the pytree (``jax.random.randint`` takes traced
+    bounds), so ``valid_pos`` may carry garbage past ``n_valid`` — which
+    is how ``make_ondevice_prepare_fn`` keeps per-epoch re-subsampled
+    corpora of varying kept length on one static shape (no recompiles).
+
+    ``scale_mode='row_mean'`` (with a neg LUT) additionally builds the
+    expected-count inverse tables for the flagship sorted-scatter step:
+    centers/positives lambda = batch * unigram * keep * (accept-rate);
+    negatives lambda = batch*K * unigram^3/4. ``neg_probs`` (e.g.
+    ``AliasSampler.probs``) avoids reading the LUT back over the link.
+    """
+    corpus_np = np.asarray(corpus, np.int32)
+    valid = np.flatnonzero(corpus_np >= 0).astype(np.int32)
+    assert valid.size > 0, "corpus has no non-marker tokens"
+    data: Dict[str, jnp.ndarray] = {
+        "corpus": jnp.asarray(corpus_np),
+        "valid_pos": jnp.asarray(valid),
+        "n_valid": jnp.asarray(np.int32(valid.size)),
+    }
+    data.update(
+        make_ondevice_statics(config, neg_lut, batch=batch, huffman=huffman)
+    )
+    if keep_probs is not None:
+        data["keep"] = jnp.asarray(np.asarray(keep_probs, np.float32))
+    if scale_mode == "row_mean" and neg_lut is not None:
+        V, K = config.vocab_size, config.negatives
+        valid_np = corpus_np[corpus_np >= 0]
+        p_uni = (
+            np.bincount(valid_np, minlength=V).astype(np.float64)
+            / max(valid_np.size, 1)
+        )
+        keep_np = (
+            np.ones(V, np.float64)
+            if keep_probs is None
+            else np.asarray(keep_probs, np.float64)
+        )
+        a = valid_np.size / max(corpus_np.size, 1)  # P(context not a marker)
+        kbar = float(np.sum(p_uni * keep_np))  # P(random token kept)
+        lam_io = batch * p_uni * keep_np * (a * kbar)
+        if neg_probs is not None:
+            p34 = np.asarray(neg_probs, np.float64)
+        else:
+            p34 = (
+                np.bincount(np.asarray(neg_lut), minlength=V).astype(np.float64)
+                / np.asarray(neg_lut).shape[0]
+            )
+        lam_neg = batch * K * p34 * (a * kbar * kbar)
+        data["inv_io"] = jnp.asarray(
+            (1.0 / np.maximum(lam_io, 1.0)).astype(np.float32)
+        )
+        data["inv_neg"] = jnp.asarray(
+            (1.0 / np.maximum(lam_neg, 1.0)).astype(np.float32)
+        )
+    return data
+
+
+def make_ondevice_statics(
+    config: SkipGramConfig,
+    neg_lut: Optional[jnp.ndarray] = None,
+    *,
+    batch: int,
+    huffman=None,
+) -> Dict[str, jnp.ndarray]:
+    """Distribution-static device tables shared by every epoch's data
+    pytree: the offset-distance LUT, the negative LUT + its stratified-draw
+    stratum tables (see ``_make_stratified_neg_fn``), and the Huffman
+    point/code tables for HS. Uploaded once; merge with the per-epoch
+    dynamic entries (``make_ondevice_prepare_fn``)."""
+    s: Dict[str, jnp.ndarray] = {
+        "dist_lut": jnp.asarray(_distance_lut(config.window)),
+    }
+    if neg_lut is not None:
+        s["neg_lut"] = jnp.asarray(neg_lut)
+        n = batch * config.negatives
+        q_size = int(np.asarray(neg_lut).shape[0])
+        lo_np = (np.arange(n + 1, dtype=np.int64) * q_size) // n
+        s["neg_lo"] = jnp.asarray(lo_np[:-1].astype(np.int32))
+        s["neg_span"] = jnp.asarray(np.diff(lo_np).astype(np.float32))
+    if huffman is not None:
+        s["pts"] = jnp.asarray(huffman.points)
+        s["cds"] = jnp.asarray(huffman.codes.astype(np.int32))
+        s["lens"] = jnp.asarray(huffman.lengths)
+    return s
+
+
+def make_ondevice_prepare_fn(
+    config: SkipGramConfig,
+    batch: int,
+    *,
+    subsample: bool,
+    scale_tables: bool = True,
+):
+    """Per-epoch on-device data preparation for the device pipeline.
+
+    The raw id stream uploads ONCE; each epoch this jitted program redraws
+    the subsample, compacts the stream (word2vec removes subsampled words
+    from the sentence BEFORE windowing — ref: wordembedding.cpp
+    ParseSentence), rebuilds the valid-position index, and recomputes the
+    expected-count scale tables — all on device. Per-epoch host traffic is
+    one scalar readback (``n_valid``, for the epoch target). This matters
+    on weak/tunneled hosts: the measured host->device link here moves
+    ~12 MB/s, so re-uploading a compacted 100M-token corpus would cost
+    ~35s/epoch (benchmarks/E2E_GAP.md).
+
+    Compaction is a stable partition: ``pos = cumsum(kept) - 1`` scatters
+    kept tokens (markers included) to their new positions; dropped slots
+    scatter out of bounds (``mode='drop'``) leaving the -1 tail padding.
+    The valid-position index gets the kept non-marker positions the same
+    way; its tail is garbage, which is fine because the samplers draw
+    indices in ``[0, n_valid)`` with ``n_valid`` a traced device scalar.
+
+    Returns ``prepare(ids_raw, keep, p34, key) -> dyn`` where ``dyn`` has
+    corpus / valid_pos / n_valid (+ inv_io / inv_neg when
+    ``scale_tables``); merge as ``{**statics, **dyn}`` with the
+    distribution-static entries from ``make_ondevice_data`` (dist_lut,
+    neg_lut, neg_lo, neg_span, Huffman tables). ``p34`` is the static
+    unigram^3/4 mass vector (negatives are drawn from the full-corpus
+    distribution every epoch, matching the reference's fixed negative
+    table); pass None with ``scale_tables=False``. ``keep`` is ignored
+    (pass None) when ``subsample`` is False.
+    """
+    V, K = config.vocab_size, config.negatives
+
+    def prepare(ids_raw, keep, p34, key):
+        P = ids_raw.shape[0]
+        is_tok = ids_raw >= 0
+        if subsample:
+            u = jax.random.uniform(key, (P,))
+            kept = (~is_tok) | (u < keep[jnp.maximum(ids_raw, 0)])
+        else:
+            kept = jnp.ones((P,), bool)
+        pos = jnp.cumsum(kept.astype(jnp.int32)) - 1
+        idx = jnp.where(kept, pos, P)
+        corpus = jnp.full((P,), -1, jnp.int32).at[idx].set(ids_raw, mode="drop")
+        validm = kept & is_tok
+        vcnt = jnp.cumsum(validm.astype(jnp.int32)) - 1
+        vidx = jnp.where(validm, vcnt, P)
+        valid_pos = jnp.zeros((P,), jnp.int32).at[vidx].set(pos, mode="drop")
+        n_valid = jnp.sum(validm.astype(jnp.int32))
+        dyn = {"corpus": corpus, "valid_pos": valid_pos, "n_valid": n_valid}
+        if scale_tables:
+            cnt = jnp.zeros((V,), jnp.float32).at[jnp.maximum(ids_raw, 0)].add(
+                validm.astype(jnp.float32)
+            )
+            nv = jnp.maximum(n_valid.astype(jnp.float32), 1.0)
+            # contexts land inside the kept prefix [0, pos[-1]+1), not the
+            # raw length P — dividing by P would deflate the acceptance rate
+            # by the dropped fraction whenever subsampling is on
+            n_kept = jnp.maximum((pos[-1] + 1).astype(jnp.float32), 1.0)
+            a = nv / n_kept  # P(context position holds a token)
+            lam_io = batch * (cnt / nv) * a
+            dyn["inv_io"] = 1.0 / jnp.maximum(lam_io, 1.0)
+            lam_neg = batch * K * p34 * a
+            dyn["inv_neg"] = 1.0 / jnp.maximum(lam_neg, 1.0)
+        return dyn
+
+    return prepare
+
+
+def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
     """Shared skip-gram pair sampler: valid-position centers + exact
     offset-distance contexts + accept weights. Single source of truth for
-    both on-device step builders. Returns ``key -> (c, ts, w)``."""
-    corpus_np = np.asarray(corpus)
-    n_corpus = corpus_np.shape[0]
-    corpus_dev = jnp.asarray(corpus)
-    valid_pos = jnp.asarray(np.flatnonzero(corpus_np >= 0).astype(np.int32))
-    n_valid = int(valid_pos.shape[0])
-    dlut_np = _distance_lut(config.window)
-    dist_lut = jnp.asarray(dlut_np)
-    T = int(dlut_np.shape[0])
-    keep_dev = None if keep_probs is None else jnp.asarray(keep_probs)
+    both on-device step builders. Returns ``(data, key) -> (c, ts, w)``;
+    ``data`` is a ``make_ondevice_data`` pytree (the subsample keep gate
+    applies iff the pytree carries a ``keep`` table — pytree structure is
+    static at trace time)."""
+    T = int(_distance_lut(config.window).shape[0])
 
-    def pairs(key):
+    def pairs(data, key):
+        corpus = data["corpus"]
+        valid_pos = data["valid_pos"]
+        n_corpus = corpus.shape[0]
         ks = jax.random.split(key, 3)
-        j = jax.random.randint(ks[0], (batch,), 0, n_valid)
+        # n_valid is a device scalar (traced bound): valid_pos may be
+        # zero-padded past it for shape stability across epochs
+        j = jax.random.randint(ks[0], (batch,), 0, data["n_valid"])
         p = valid_pos[j]
-        c = corpus_dev[p]  # >= 0 by construction of valid_pos
+        c = corpus[p]  # >= 0 by construction of valid_pos
         # one draw for (distance, direction): r in [0, 2T)
         r = jax.random.randint(ks[1], (batch,), 0, 2 * T)
-        d = dist_lut[r % T]
+        d = data["dist_lut"][r % T]
         off = jnp.where(r < T, d, -d)
         qpos = p + off
         qc = jnp.clip(qpos, 0, n_corpus - 1)
-        t = corpus_dev[qc]
+        t = corpus[qc]
         valid = (t >= 0) & (qpos == qc)
         ts = jnp.maximum(t, 0)
-        if keep_dev is not None:
+        if "keep" in data:
             u = jax.random.uniform(ks[2], (batch, 2))
-            valid = valid & (u[:, 0] < keep_dev[c]) & (u[:, 1] < keep_dev[ts])
+            valid = valid & (u[:, 0] < data["keep"][c]) & (u[:, 1] < data["keep"][ts])
         return c, ts, valid.astype(jnp.float32)
 
     return pairs
 
 
-def make_ondevice_batch_fn(
-    config: SkipGramConfig,
-    corpus,  # (n,) int32 np or jnp, -1 = sentence boundary
-    keep_probs,  # (V,) subsample keep prob (np or jnp) or None
-    neg_lut: jnp.ndarray,  # (Q,) quantized inverse-CDF negative table
-    batch: int,
-):
+def make_ondevice_batch_fn(config: SkipGramConfig, batch: int):
     """Device-side skip-gram batch generation: the whole data pipeline as a
-    jitted function of a PRNG key. Replaces the host corpus walk (ref:
+    jitted function of a ``make_ondevice_data`` pytree and a PRNG key.
+    Replaces the host corpus walk (ref:
     Applications/WordEmbedding/src/wordembedding.cpp ParseSentence windows +
     negative table draws) with fixed-shape vector ops:
 
@@ -710,18 +891,18 @@ def make_ondevice_batch_fn(
       j belongs to pair j % B) — contiguous rank chunks would hand each
       pair K near-copies of one word.
 
-    Returns ``key -> (centers (B,), outputs (B,1+K), weights (B,))`` with
-    ``outputs[:, 1:]`` flat-sorted in column-major order
+    Returns ``(data, key) -> (centers (B,), outputs (B,1+K), weights (B,))``
+    with ``outputs[:, 1:]`` flat-sorted in column-major order
     (``negs.T.reshape(-1)`` is sorted).
     """
     K = config.negatives
-    pairs = _make_sg_pair_fn(config, corpus, keep_probs, batch)
-    draw_negs = _make_stratified_neg_fn(neg_lut, batch, K)
+    pairs = _make_sg_pair_fn(config, batch)
+    draw_negs = _make_stratified_neg_fn(batch, K)
 
-    def sample(key):
+    def sample(data, key):
         k1, k2 = jax.random.split(key)
-        c, ts, w = pairs(k1)
-        negs = draw_negs(k2).reshape(K, batch).T
+        c, ts, w = pairs(data, k1)
+        negs = draw_negs(data, k2).reshape(K, batch).T
         outputs = jnp.concatenate([ts[:, None], negs], axis=1)
         return c, outputs, w
 
@@ -730,17 +911,15 @@ def make_ondevice_batch_fn(
 
 def make_ondevice_superbatch_step(
     config: SkipGramConfig,
-    corpus,
-    keep_probs,
-    neg_lut: jnp.ndarray,
+    *,
     batch: int,
     steps: int,
     scale_mode: str = "row_mean",
-    neg_probs: Optional[np.ndarray] = None,
 ):
     """Fully device-resident training: corpus, sampling, presort and the
     sorted-scatter updates all inside ONE jitted program — zero per-step
-    host traffic (the host supplies a PRNG key and the learning rate).
+    host traffic (the host supplies the ``make_ondevice_data`` pytree, a
+    PRNG key and the learning rate).
     NS skip-gram with plain SGD only (the flagship/benchmark config).
 
     ``scale_mode``:
@@ -771,58 +950,37 @@ def make_ondevice_superbatch_step(
     path's joint count; weights are over the same draws, so the long-run
     updates agree).
 
-    Signature: ``(params, key, lr) -> (params, (mean_loss, accepted_pairs))``
-    — ``accepted_pairs`` is the number of weight>0 pairs actually trained,
-    so callers can track real epoch progress (rejected draws are not
-    trained pairs).
+    Signature: ``(params, data, key, lr) ->
+    (params, (mean_loss, accepted_pairs))`` — ``accepted_pairs`` is the
+    number of weight>0 pairs actually trained, so callers can track real
+    epoch progress (rejected draws are not trained pairs). ``data`` comes
+    from ``make_ondevice_data`` (same ``batch``/``scale_mode``); swapping
+    in a same-shaped pytree (per-epoch re-subsampled corpus) reuses the
+    compiled program.
     """
     assert not config.cbow, "device pipeline supports NS skip-gram only"
     assert scale_mode in ("row_mean", "row_mean_exact", "raw"), scale_mode
-    sample = make_ondevice_batch_fn(config, corpus, keep_probs, neg_lut, batch)
+    sample = make_ondevice_batch_fn(config, batch)
     K = config.negatives
-    V = config.vocab_size
 
-    if scale_mode == "row_mean":
-        # expected weighted duplicate counts per word (host, build time)
-        corpus_np = np.asarray(corpus)
-        valid_np = corpus_np[corpus_np >= 0]
-        p_uni = (
-            np.bincount(valid_np, minlength=V).astype(np.float64)
-            / max(valid_np.size, 1)
-        )
-        keep_np = (
-            np.ones(V, np.float64)
-            if keep_probs is None
-            else np.asarray(keep_probs, np.float64)
-        )
-        a = valid_np.size / max(corpus_np.size, 1)  # P(context not a marker)
-        kbar = float(np.sum(p_uni * keep_np))  # P(random token kept)
-        lam_io = batch * p_uni * keep_np * (a * kbar)
-        if neg_probs is not None:
-            # caller-supplied unigram^3/4 masses (e.g. AliasSampler.probs)
-            # — avoids reading the 16 MB device LUT back over the link
-            p34 = np.asarray(neg_probs, np.float64)
-        else:
-            p34 = (
-                np.bincount(np.asarray(neg_lut), minlength=V).astype(np.float64)
-                / neg_lut.shape[0]
+    def superstep(params, data, key, lr):
+        if scale_mode == "row_mean":
+            assert "inv_io" in data and "inv_neg" in data, (
+                "row_mean needs the expected-count tables — build data via "
+                "make_ondevice_data(..., scale_mode='row_mean')"
             )
-        lam_neg = batch * K * p34 * (a * kbar * kbar)
-        inv_io = jnp.asarray((1.0 / np.maximum(lam_io, 1.0)).astype(np.float32))
-        inv_neg = jnp.asarray((1.0 / np.maximum(lam_neg, 1.0)).astype(np.float32))
 
-    def _scale(ids_sorted, w_in_order, kind):
-        if scale_mode == "raw":
-            return w_in_order
-        if scale_mode == "row_mean_exact":
-            return _run_length_scale(ids_sorted, w_in_order)
-        table = inv_neg if kind == "neg" else inv_io
-        return w_in_order * table[ids_sorted]
+        def _scale(ids_sorted, w_in_order, kind):
+            if scale_mode == "raw":
+                return w_in_order
+            if scale_mode == "row_mean_exact":
+                return _run_length_scale(ids_sorted, w_in_order)
+            table = data["inv_neg"] if kind == "neg" else data["inv_io"]
+            return w_in_order * table[ids_sorted]
 
-    def superstep(params, key, lr):
         def body(params, key):
             emb_in, emb_out = params["emb_in"], params["emb_out"]
-            c, o, w = sample(key)
+            c, o, w = sample(data, key)
             ts, negs = o[:, 0], o[:, 1:]
             vin = emb_in[c]
             vout = emb_out[o]
@@ -867,14 +1025,11 @@ def make_ondevice_superbatch_step(
 
 def make_ondevice_general_superbatch_step(
     config: SkipGramConfig,
-    corpus,
-    keep_probs,
+    *,
     batch: int,
     steps: int,
     hs: bool = False,
     use_adagrad: bool = False,
-    huffman=None,
-    neg_lut: Optional[jnp.ndarray] = None,
     scale_mode: str = "row_mean",
 ):
     """Device-resident training for the NON-flagship mode grid — CBOW,
@@ -888,72 +1043,68 @@ def make_ondevice_general_superbatch_step(
     scatters) — correctness-first, while the hand-tuned sorted-scatter
     ``make_ondevice_superbatch_step`` remains the NS+skip-gram+SGD flagship.
 
-    HS needs ``huffman`` (padded (V, L) points/codes + lengths uploaded to
-    HBM, one gather per batch); NS needs ``neg_lut``.
+    HS needs Huffman tables in the data pytree (padded (V, L) points/codes
+    + lengths, one gather per batch — pass ``huffman=`` to
+    ``make_ondevice_data``); NS needs ``neg_lut`` there.
 
-    Signature: ``(params, key, lr) -> (params, (mean_loss, accepted))`` —
-    ``accepted`` counts weight>0 training samples (pairs for skip-gram,
-    center windows for CBOW).
+    Signature: ``(params, data, key, lr) -> (params, (mean_loss,
+    accepted))`` — ``accepted`` counts weight>0 training samples (pairs
+    for skip-gram, center windows for CBOW). ``data`` comes from
+    ``make_ondevice_data`` (large arrays as traced buffers, not closure
+    constants — see there).
     """
-    assert hs == (huffman is not None), "hs mode requires huffman tables"
-    assert hs or neg_lut is not None, "NS mode requires neg_lut"
     W = config.window
     K = config.negatives
-    if hs:
-        pts = jnp.asarray(huffman.points)
-        cds = jnp.asarray(huffman.codes.astype(np.int32))
-        lens = jnp.asarray(huffman.lengths)
-    else:
-        draw_negs = _make_stratified_neg_fn(neg_lut, batch, K)
+    if not hs:
+        draw_negs = _make_stratified_neg_fn(batch, K)
 
     if config.cbow:
-        corpus_np = np.asarray(corpus)
-        n_corpus = corpus_np.shape[0]
-        corpus_dev = jnp.asarray(corpus)
-        valid_pos = jnp.asarray(np.flatnonzero(corpus_np >= 0).astype(np.int32))
-        n_valid = int(valid_pos.shape[0])
-        keep_dev = None if keep_probs is None else jnp.asarray(keep_probs)
 
-        def sample(key):
+        def sample(data, key):
             """CBOW window sample: shrunk window b ~ U[1, W], CBOW uses ALL
             tokens within b (ref: wordembedding.cpp ParseSentence CBOW
             branch). -> (target, contexts (B,2W) -1-padded, w)."""
+            corpus = data["corpus"]
+            valid_pos = data["valid_pos"]
+            n_corpus = corpus.shape[0]
             ks = jax.random.split(key, 4)
-            j = jax.random.randint(ks[0], (batch,), 0, n_valid)
+            j = jax.random.randint(ks[0], (batch,), 0, data["n_valid"])
             p = valid_pos[j]
-            c = corpus_dev[p]
+            c = corpus[p]
             b = jax.random.randint(ks[1], (batch,), 1, W + 1)
-            offs = jnp.concatenate(
-                [jnp.arange(-W, 0), jnp.arange(1, W + 1)]
-            ).astype(jnp.int32)
+            # np constant (not eager jnp): device-array constants cost a
+            # readback round trip each at lowering on the tunneled backend
+            offs = np.concatenate(
+                [np.arange(-W, 0), np.arange(1, W + 1)]
+            ).astype(np.int32)
             qpos = p[:, None] + offs[None, :]
             qc = jnp.clip(qpos, 0, n_corpus - 1)
-            t = corpus_dev[qc]  # (B, 2W)
+            t = corpus[qc]  # (B, 2W)
             m = (jnp.abs(offs)[None, :] <= b[:, None]) & (t >= 0) & (qpos == qc)
             ts = jnp.maximum(t, 0)
             w = jnp.ones((batch,), jnp.float32)
-            if keep_dev is not None:
+            if "keep" in data:
                 u = jax.random.uniform(ks[2], (batch,))
-                w = (u < keep_dev[c]).astype(jnp.float32)
+                w = (u < data["keep"][c]).astype(jnp.float32)
                 uc = jax.random.uniform(ks[3], (batch, 2 * W))
-                m = m & (uc < keep_dev[ts])
+                m = m & (uc < data["keep"][ts])
             # a window with no live context trains nothing
             w = w * (jnp.sum(m, axis=1) > 0)
             contexts = jnp.where(m, ts, -1)
             # CBOW: input = context mean, prediction target = center word
             return c, c, contexts, w
     else:
-        sg_pairs = _make_sg_pair_fn(config, corpus, keep_probs, batch)
+        sg_pairs = _make_sg_pair_fn(config, batch)
 
-        def sample(key):
+        def sample(data, key):
             # skip-gram: input = center word, prediction target = context
-            c, ts, w = sg_pairs(key)
+            c, ts, w = sg_pairs(data, key)
             return c, ts, None, w
 
-    def draw_outputs(key, tgt):
+    def draw_outputs(data, key, tgt):
         """[target | K stratified negatives] (NS modes). Row-major flatten
         is NOT sorted here — make_train_step scatters unsorted."""
-        negs = draw_negs(key).reshape(K, batch).T
+        negs = draw_negs(data, key).reshape(K, batch).T
         return jnp.concatenate([tgt[:, None], negs], axis=1)
 
     step = make_train_step(
@@ -961,16 +1112,28 @@ def make_ondevice_general_superbatch_step(
         scale_mode="raw" if scale_mode == "raw" else "row_mean",
     )
 
-    def superstep(params, key, lr):
+    def superstep(params, data, key, lr):
+        if hs:
+            assert "pts" in data, (
+                "hs mode needs Huffman tables — make_ondevice_data(huffman=...)"
+            )
+        else:
+            assert "neg_lut" in data, (
+                "NS mode needs neg_lut — make_ondevice_data(..., neg_lut)"
+            )
+
         def body(params, key):
             k1, k2 = jax.random.split(key)
-            c, tgt, contexts, w = sample(k1)
+            c, tgt, contexts, w = sample(data, k1)
             if hs:
                 new, loss = step(
-                    params, c, pts[tgt], cds[tgt], lens[tgt], contexts, lr, w
+                    params, c, data["pts"][tgt], data["cds"][tgt],
+                    data["lens"][tgt], contexts, lr, w,
                 )
             else:
-                new, loss = step(params, c, draw_outputs(k2, tgt), contexts, lr, w)
+                new, loss = step(
+                    params, c, draw_outputs(data, k2, tgt), contexts, lr, w
+                )
             return new, (loss, jnp.sum(w))
 
         keys = jax.random.split(key, steps)

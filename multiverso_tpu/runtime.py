@@ -73,6 +73,41 @@ MV_DEFINE_int("backup_worker_ratio", 0, "ratio% of backup workers, set 20 means 
 MV_DEFINE_bool("multihost", False, "call jax.distributed.initialize() at start")
 
 
+_compilation_cache_enabled = False
+
+
+def _enable_compilation_cache() -> None:
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    XLA compiles are expensive on TPU — 10-30s per program on the tunneled
+    bench host (remote compiler), measured in benchmarks/E2E_GAP.md — and
+    identical across process restarts, so every CLI entry point caches
+    them on disk by default. ``MV_JAX_CACHE_DIR`` overrides the location
+    (empty string disables); the default lives next to the package so
+    repeated runs from one checkout share it. Cache hits cut the
+    WordEmbedding device-pipeline first-call cost from ~30s to ~2s
+    (same-process jit cache still applies on top)."""
+    global _compilation_cache_enabled
+    if _compilation_cache_enabled:
+        return
+    _compilation_cache_enabled = True
+    import os
+
+    path = os.environ.get("MV_JAX_CACHE_DIR")
+    if path == "":
+        return
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # cache is an optimisation, never a hard failure
+        Log.Info("compilation cache disabled: %s", e)
+
+
 class Runtime:
     """Singleton runtime (``Zoo`` equivalent). Use ``runtime()`` accessor."""
 
@@ -107,6 +142,7 @@ class Runtime:
         Returns the compacted argv (flags consumed), like ``ParseCMDFlags``.
         """
         remaining = ParseCMDFlags(argv)
+        _enable_compilation_cache()
         if self._started:
             if mesh is not None or num_shards not in (None, 0):
                 Log.Fatal(

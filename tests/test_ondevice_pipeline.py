@@ -20,6 +20,7 @@ from multiverso_tpu.models.wordembedding.skipgram import (
     init_adagrad_slots,
     init_params,
     make_ondevice_batch_fn,
+    make_ondevice_data,
     make_ondevice_general_superbatch_step,
     make_ondevice_superbatch_step,
 )
@@ -54,12 +55,9 @@ def test_ondevice_batch_masks_boundaries_and_subsample():
     # keep prob 0 for word 7: any pair touching it must be masked out
     keep = np.ones(V, np.float32)
     keep[7] = 0.0
-    fn = jax.jit(
-        make_ondevice_batch_fn(
-            cfg, jnp.asarray(corpus_np), jnp.asarray(keep), lut, batch=512,
-        )
-    )
-    c, o, w = fn(jax.random.PRNGKey(0))
+    fn = jax.jit(make_ondevice_batch_fn(cfg, batch=512))
+    data = make_ondevice_data(cfg, corpus_np, keep, lut, batch=512)
+    c, o, w = fn(data, jax.random.PRNGKey(0))
     c, o, w = np.asarray(c), np.asarray(o), np.asarray(w)
     assert c.shape == (512,) and o.shape == (512, 4) and w.shape == (512,)
     assert c.min() >= 0 and o.min() >= 0  # markers clamped, masked by w
@@ -85,12 +83,9 @@ def test_ondevice_offset_distribution_matches_word2vec():
     n = 1 << 14
     corpus_np = (np.arange(n, dtype=np.int32) % V)
     lut = _toy_lut(V)
-    fn = jax.jit(
-        make_ondevice_batch_fn(
-            cfg, jnp.asarray(corpus_np), None, lut, batch=1 << 15,
-        )
-    )
-    c, o, w = fn(jax.random.PRNGKey(3))
+    fn = jax.jit(make_ondevice_batch_fn(cfg, batch=1 << 15))
+    data = make_ondevice_data(cfg, corpus_np, None, lut, batch=1 << 15)
+    c, o, w = fn(data, jax.random.PRNGKey(3))
     c, t, w = np.asarray(c), np.asarray(o)[:, 0], np.asarray(w)
     live = w > 0
     d = np.abs(((t[live] - c[live] + V // 2) % V) - V // 2)
@@ -109,19 +104,18 @@ def test_ondevice_training_reduces_loss():
     # context of each word is its partner
     p = rng.randint(0, V // 2, 2000) * 2
     base = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
-    corpus = jnp.asarray(base.astype(np.int32))
+    corpus = base.astype(np.int32)
     step = jax.jit(
-        make_ondevice_superbatch_step(
-            cfg, corpus, None, _toy_lut(V), batch=256, steps=4,
-        ),
+        make_ondevice_superbatch_step(cfg, batch=256, steps=4),
         donate_argnums=(0,),
     )
+    data = make_ondevice_data(cfg, corpus, None, _toy_lut(V), batch=256)
     params = init_params(cfg)
     key = jax.random.PRNGKey(1)
     losses = []
     for i in range(60):
         key, sub = jax.random.split(key)
-        params, (loss, acc) = step(params, sub, jnp.float32(0.1))
+        params, (loss, acc) = step(params, data, sub, jnp.float32(0.1))
         assert 0 < float(acc) <= 256 * 4
         losses.append(float(loss))
     assert np.isfinite(losses).all()
@@ -156,10 +150,12 @@ def test_ondevice_general_modes_train(mode):
     )
     step = jax.jit(
         make_ondevice_general_superbatch_step(
-            cfg, base, None, batch=256, steps=4, hs=hs, use_adagrad=adagrad,
-            huffman=huff, neg_lut=None if hs else _toy_lut(V),
+            cfg, batch=256, steps=4, hs=hs, use_adagrad=adagrad,
         ),
         donate_argnums=(0,),
+    )
+    data = make_ondevice_data(
+        cfg, base, None, None if hs else _toy_lut(V), batch=256, huffman=huff,
     )
     params = init_params(cfg)
     out_rows = huff.num_inner_nodes if hs else None
@@ -171,7 +167,7 @@ def test_ondevice_general_modes_train(mode):
     losses = []
     for _ in range(40):
         key, sub = jax.random.split(key)
-        params, (loss, acc) = step(params, sub, jnp.float32(0.1))
+        params, (loss, acc) = step(params, data, sub, jnp.float32(0.1))
         assert 0 < float(acc) <= 256 * 4
         losses.append(float(loss))
     assert np.isfinite(losses).all()
@@ -262,22 +258,21 @@ def test_ondevice_step_shards_over_mesh():
         V = 128
         cfg = SkipGramConfig(vocab_size=V, dim=16, negatives=3, window=2)
         rng = np.random.RandomState(0)
-        corpus = jnp.asarray(rng.randint(0, V, 4096).astype(np.int32))
+        corpus = rng.randint(0, V, 4096).astype(np.int32)
         tab = mesh_lib.table_sharding(mesh, 2)
         params = {
             k: jax.device_put(v, tab) for k, v in init_params(cfg).items()
         }
         step = jax.jit(
-            make_ondevice_superbatch_step(
-                cfg, corpus, None, _toy_lut(V), batch=64, steps=2,
-            ),
+            make_ondevice_superbatch_step(cfg, batch=64, steps=2),
             out_shardings=(
                 {"emb_in": tab, "emb_out": tab},
                 mesh_lib.replicated_sharding(mesh),
             ),
             donate_argnums=(0,),
         )
-        params, (loss, acc) = step(params, jax.random.PRNGKey(0), jnp.float32(0.05))
+        data = make_ondevice_data(cfg, corpus, None, _toy_lut(V), batch=64)
+        params, (loss, acc) = step(params, data, jax.random.PRNGKey(0), jnp.float32(0.05))
         jax.block_until_ready(params)
         assert np.isfinite(float(loss)) and float(acc) > 0
         assert params["emb_in"].sharding == tab
@@ -295,13 +290,12 @@ def test_ondevice_negatives_follow_unigram_power():
     corpus = jnp.asarray((np.arange(4096) % V).astype(np.int32))
     counts = np.arange(1, V + 1, dtype=np.int64)
     s = AliasSampler(counts)
-    fn = jax.jit(
-        make_ondevice_batch_fn(
-            cfg, corpus, None, build_negative_lut(s.probs, table_bits=16),
-            batch=1 << 14,
-        )
+    fn = jax.jit(make_ondevice_batch_fn(cfg, batch=1 << 14))
+    data = make_ondevice_data(
+        cfg, corpus, None, build_negative_lut(s.probs, table_bits=16),
+        batch=1 << 14,
     )
-    _, o, _ = fn(jax.random.PRNGKey(5))
+    _, o, _ = fn(data, jax.random.PRNGKey(5))
     negs = np.asarray(o)[:, 1:]
     flat = negs.T.reshape(-1)   # column-major flatten is the sorted order
     assert np.all(np.diff(flat) >= 0), "negatives must be flat-sorted"
