@@ -12,7 +12,7 @@ cmake -S . -B build >/dev/null
 cmake --build build --parallel
 
 echo "== unit + integration tests (8-device CPU mesh) =="
-python -m pytest tests/ -q
+MV_BENCH_ASSERTS=1 python -m pytest tests/ -q
 
 echo "== multi-chip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -131,7 +131,15 @@ class FTRLModel:
         flat = idx.reshape(-1)
         deltas = np.stack([np.asarray(dz).reshape(-1), np.asarray(dn).reshape(-1)], axis=1)
         if self.kv is not None:
-            self.kv.add(flat, deltas)  # += accumulate, dups allowed
+            # batch padding slots carry exactly (0, 0): drop all-zero deltas
+            # so the pad key (0) never materialises as a spurious KV entry
+            # in hashed_weights()/saved models (+= 0 is a no-op anyway; a
+            # genuine hash-0 feature with a real gradient still lands)
+            live = deltas.any(axis=1)
+            if not live.all():
+                flat, deltas = flat[live], deltas[live]
+            if len(flat):
+                self.kv.add(flat, deltas)  # += accumulate, dups allowed
         elif self.table is not None:
             self.table.add_rows(flat, deltas)  # += accumulate, dups allowed
         else:

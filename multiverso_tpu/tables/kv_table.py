@@ -106,12 +106,21 @@ class KVTable:
         self._gather_fn = None
 
     def _check_keys(self, keys) -> np.ndarray:
+        """Integer keys only — an API break vs the pre-round-2 dict-based
+        index, which also took strings/floats. The native batched index
+        (kv_index.cpp) is what makes hashed-FTRL-scale key resolution
+        possible; a checkpoint written by the old dict index with string
+        keys will fail here with the message below rather than load
+        corrupted."""
         keys = np.asarray(keys).reshape(-1)
         if len(keys) == 0:  # empty batch: no-op (dtype of [] is float64)
             return keys.astype(np.int64)
         CHECK(keys.dtype.kind in "iu",
               f"KV keys must be integers (got {keys.dtype}); the reference "
-              "KVTable is templated on integral keys (kv_table.h:18)")
+              "KVTable is templated on integral keys (kv_table.h:18). "
+              "String/object keys from a pre-native-index checkpoint are no "
+              "longer supported — re-key them to integers (e.g. hash) "
+              "before load()")
         return keys
 
     def _pad(self, arr: np.ndarray, fill=0) -> np.ndarray:
@@ -130,7 +139,14 @@ class KVTable:
         vals = np.asarray(vals, self.dtype)
         vals = vals.reshape((-1,) if self.val_dim == 1 else (-1, self.val_dim))
         CHECK(len(keys) == len(vals), "keys and vals must have equal length")
-        self._key_dtype = keys.dtype
+        # only WIDEN the tracked key dtype: a later int32 add must not make
+        # items()/store() truncate previously-added 64-bit keys. int64+uint64
+        # promote to float64 in numpy; pin that case to uint64 (the FTRL key
+        # space).
+        promoted = np.promote_types(self._key_dtype, keys.dtype)
+        self._key_dtype = (
+            np.dtype(np.uint64) if promoted.kind == "f" else promoted
+        )
         slots = self._index.resolve(keys, create=True)
         if len(self._index) > self._capacity:
             self._grow(len(self._index))

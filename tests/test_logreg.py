@@ -345,9 +345,12 @@ def test_ftrl_hashed_unbounded_keys(mv_env, tmp_path):
     lr.Train()
     acc = lr.Test(output_file="")
     assert acc > 0.8, f"hashed FTRL failed to fit: acc={acc}"
-    # state store: only seen keys (+ the padding key 0) exist
+    # state store: only SEEN keys exist — the batch padding key 0 must not
+    # materialise as a spurious entry (ADVICE r02: it would alias any
+    # genuine feature whose hash is 0 in hashed_weights()/saved models)
     keys, w = lr.model.hashed_weights()
-    assert set(np.asarray(keys).tolist()) <= set(feat_keys.tolist()) | {0}
+    assert set(np.asarray(keys).tolist()) <= set(feat_keys.tolist())
+    assert 0 not in set(np.asarray(keys).tolist())
     assert len(keys) >= f - 5
     # save/load roundtrip preserves predictions
     p = str(tmp_path / "ftrl_hashed.npz")

@@ -118,6 +118,24 @@ def test_kv_capacity_growth(mv_env):
     np.testing.assert_allclose(np.sort(vs), 2 * vals)
 
 
+def test_kv_key_dtype_only_widens(mv_env):
+    """ADVICE r02: an int32-keyed add after a 64-bit one must not narrow the
+    tracked key dtype — items()/store() would silently truncate large keys
+    in checkpoints."""
+    t = mv_env.MV_CreateTable(KVTableOption())
+    big = np.array([2**40 + 3], dtype=np.int64)
+    t.add(big, [1.0])
+    t.add(np.array([7], dtype=np.int32), [2.0])
+    ks, _ = t.items()
+    assert ks.dtype == np.int64
+    assert 2**40 + 3 in set(ks.tolist())
+    # uint64 + int64 pins to uint64 (numpy would promote to float64)
+    t.add(np.array([2**63 + 5], dtype=np.uint64), [3.0])
+    ks, _ = t.items()
+    assert ks.dtype == np.uint64
+    assert 2**63 + 5 in set(ks.tolist())
+
+
 def test_kv_int_values(mv_env):
     t = mv_env.MV_CreateTable(KVTableOption(val_dtype="int64"))
     t.add([3, 4], [10, 20])
