@@ -80,6 +80,13 @@ class FTRLModel:
             from multiverso_tpu.tables import MatrixTableOption, create_table
 
             CHECK(runtime().started, "use_ps=true requires MV_Init first")
+            # per-batch gathers/pushes are per-rank row sets; the lockstep
+            # bucket protocol (see app._run_superbatch_ps) is not wired into
+            # the LogReg batch loop yet — fail loudly instead of deadlocking
+            CHECK(jax.process_count() == 1,
+                  "dense FTRL use_ps is single-process for now: per-batch "
+                  "row sets are not lockstep across ranks (WordEmbedding's "
+                  "-use_ps implements the cross-process bucket protocol)")
             self.table = create_table(
                 MatrixTableOption(num_row=self.F, num_col=2, name="ftrl_zn")
             )

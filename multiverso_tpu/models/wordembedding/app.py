@@ -248,23 +248,10 @@ class WordEmbedding:
 
     def _ps_setup(self):
         """Create the PS tables (ref: communicator.cpp:17-31
-        PrepareParameterTables — input matrix, output matrix; the
-        reference's AdaGrad g2 tables are not implemented in PS mode,
-        rejected below)."""
-        CHECK(not self.opt.use_adagrad,
-              "-use_ps does not support -use_adagrad (plain SGD blocks only)")
-        # Multi-process PS-mode WE is rejected: tables are globally-sharded
-        # jax.Arrays, so every jitted get_rows/add_rows is a lockstep SPMD
-        # collective — but each process's blocks have different row unions,
-        # bucket shapes, and block counts (corpus shards differ), so the
-        # processes would issue DIFFERENT programs against the same global
-        # arrays: deadlock or silent divergence. A multi-process PS protocol
-        # needs a globally-agreed row union + fixed bucket shape per block
-        # round (host_local_to_global); until then, fail loudly.
-        CHECK(jax.process_count() == 1,
-              "-use_ps requires a single-process runtime (block row-unions "
-              "are not SPMD-consistent across processes); use the fused "
-              "path or -device_pipeline for multi-process runs")
+        PrepareParameterTables — input matrix, output matrix, and with
+        -use_adagrad the two g2 accumulator tables; plus the word-count
+        table that coordinates the global lr decay,
+        distributed_wordembedding.cpp:82-127)."""
         from multiverso_tpu.api import MV_CreateTable
         from multiverso_tpu.tables import MatrixTableOption
 
@@ -280,9 +267,69 @@ class WordEmbedding:
         ))
         # delta-averaging divisor = concurrent delta-pushing clients (ref:
         # communicator.cpp AddDeltaParameter divides by its worker count).
-        # Constant 1 while the CHECK above pins PS mode to one process —
-        # mesh worker slices within the process are a single logical client.
-        self._num_workers = 1
+        # One client per PROCESS: mesh worker slices within a process are a
+        # single logical client; each process trains its own corpus shard
+        # and pushes one averaged delta per round.
+        self._num_workers = jax.process_count()
+        # AdaGrad g2 accumulator tables (plain += like the reference's —
+        # the AdaGrad math runs worker-side on the pulled block; the g2
+        # deltas are averaged by the same divisor so identical blocks on
+        # every rank reproduce the single-client rounds exactly)
+        self._t_g2_in = self._t_g2_out = None
+        if self.opt.use_adagrad:
+            self._t_g2_in = MV_CreateTable(MatrixTableOption(
+                num_row=V, num_col=D, name="we_g2_in",
+            ))
+            self._t_g2_out = MV_CreateTable(MatrixTableOption(
+                num_row=out_rows, num_col=D, name="we_g2_out",
+            ))
+        # shared word(pair)-count table driving the lr schedule: one row per
+        # client; the global trained-pair count is the table sum, so every
+        # rank decays its lr identically (ref: the word-count KV table,
+        # distributed_wordembedding.cpp:82-127). Rows pad to this process's
+        # worker-axis extent (add_rows_local bucket rule).
+        nproc = jax.process_count()
+        # int32 count: exact up to 2^31 pairs (a float32 table would corrupt
+        # counts past 2^24); one row per client, global count = table sum
+        self._t_wc = MV_CreateTable(MatrixTableOption(
+            num_row=nproc, num_col=1, dtype="int32", name="we_word_count",
+        ))
+        self._wc_bucket = max(1, self._t_wc.num_workers // nproc)
+        self._ps_global_pairs = 0
+
+    def _wc_push_and_read(self, inc: int) -> int:
+        """Add this client's trained-pair increment and read back the global
+        count — one collective round every rank joins together (the
+        reference's AddWordCount/GetWordCount pair,
+        distributed_wordembedding.cpp:92-127)."""
+        lw = self._wc_bucket
+        ids = np.full(lw, jax.process_index(), np.int64)
+        deltas = np.zeros((lw, 1), np.int32)
+        deltas[0, 0] = inc
+        self._t_wc.add_rows_local(ids, deltas)
+        return int(np.asarray(self._t_wc.get()).sum())
+
+    def _ps_round_meta(self, have: int, ni: int, no: int):
+        """Per-round cross-process agreement (the fix the round-2 CHECK
+        sketched): every process contributes its block's union sizes, ranks
+        agree on the padded power-of-two bucket, and the round's pull/push
+        then runs as ONE identical SPMD program on every rank
+        (get_rows_local/add_rows_local stack the per-process buckets along
+        the worker axis). Returns (any_rank_has_data, bucket_in,
+        bucket_out); one tiny host allgather per round, single-process
+        short-circuits."""
+        if jax.process_count() == 1:
+            return have > 0, self._bucket(max(ni, 1)), self._bucket(max(no, 1))
+        from jax.experimental import multihost_utils
+
+        meta = multihost_utils.process_allgather(
+            np.asarray([have, ni, no], np.int64)
+        ).reshape(-1, 3)
+        return (
+            bool(meta[:, 0].any()),
+            self._bucket(max(int(meta[:, 1].max()), 1)),
+            self._bucket(max(int(meta[:, 2].max()), 1)),
+        )
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -292,13 +339,20 @@ class WordEmbedding:
             b *= 2
         return b
 
-    def _run_superbatch_ps(self, batches: list, lr: float) -> jax.Array:
-        """One PS block (ref: the Communicator protocol —
+    def _run_superbatch_ps(self, batches: list, lr: float):
+        """One PS block round (ref: the Communicator protocol —
         communicator.cpp:117-155 RequestParameter pulls the block's vocab
         subset, :157-249 AddDeltaParameter re-reads and pushes
         (new - old)/num_workers): pull touched rows into a compact local
         model, run the block's microbatches locally (sorted-scatter
-        superstep over remapped ids), push the averaged delta."""
+        superstep over remapped ids), push the averaged delta.
+
+        Multi-process: each rank's union pads to a cross-rank-agreed
+        bucket (``_ps_round_meta``); the pull/push are the stacked SPMD
+        programs ``get_rows_local``/``add_rows_local``. A rank whose
+        corpus shard ran dry joins with an empty block (zero deltas) until
+        every rank is done — rounds stay lockstep. Returns
+        ``(any_rank_had_data, loss_or_None)``."""
         from multiverso_tpu.models.wordembedding.skipgram import (
             SkipGramConfig,
             make_sorted_superbatch_step,
@@ -307,19 +361,56 @@ class WordEmbedding:
 
         o = self.opt
         # block node sets (ref: data_block SetWeightIE input/output nodes)
-        uin = np.unique(np.concatenate([b["centers"] for b in batches]))
-        okey = "points" if o.hs else "outputs"
-        uout = np.unique(np.concatenate([b[okey].reshape(-1) for b in batches]))
-        if o.cbow:
-            ctx = np.concatenate([b["contexts"].reshape(-1) for b in batches])
-            uin = np.unique(np.concatenate([uin, np.maximum(ctx, 0)]))
-        ni, no = self._bucket(len(uin)), self._bucket(len(uout))
-        # RequestParameter: pull rows, pad to the bucket
-        Win = np.zeros((ni, o.size), np.float32)
-        Win[: len(uin)] = self._t_in.get_rows(uin)
-        Wout = np.zeros((no, o.size), np.float32)
-        Wout[: len(uout)] = self._t_out.get_rows(uout)
+        if batches:
+            uin = np.unique(np.concatenate([b["centers"] for b in batches]))
+            okey = "points" if o.hs else "outputs"
+            uout = np.unique(
+                np.concatenate([b[okey].reshape(-1) for b in batches])
+            )
+            if o.cbow:
+                ctx = np.concatenate(
+                    [b["contexts"].reshape(-1) for b in batches]
+                )
+                uin = np.unique(np.concatenate([uin, np.maximum(ctx, 0)]))
+        else:
+            uin = np.zeros(0, np.int64)
+            uout = np.zeros(0, np.int64)
+        any_data, ni, no = self._ps_round_meta(len(batches), len(uin), len(uout))
+        if not any_data:
+            return False, None
+        # RequestParameter: pull the padded bucket (pad id 0; padding rows
+        # zeroed below so the local model matches the pre-bucket semantics)
+        ids_in = np.zeros(ni, np.int64)
+        ids_in[: len(uin)] = uin
+        ids_out = np.zeros(no, np.int64)
+        ids_out[: len(uout)] = uout
+        Win = np.asarray(self._t_in.get_rows_local(ids_in), np.float32).copy()
+        Win[len(uin):] = 0.0
+        Wout = np.asarray(self._t_out.get_rows_local(ids_out), np.float32).copy()
+        Wout[len(uout):] = 0.0
+        if o.use_adagrad:
+            G2in = np.asarray(
+                self._t_g2_in.get_rows_local(ids_in), np.float32
+            ).copy()
+            G2in[len(uin):] = 0.0
+            G2out = np.asarray(
+                self._t_g2_out.get_rows_local(ids_out), np.float32
+            ).copy()
+            G2out[len(uout):] = 0.0
+        if not batches:
+            # dry rank: participate in the pull/push collectives only
+            zin = np.zeros((ni, o.size), np.float32)
+            zout = np.zeros((no, o.size), np.float32)
+            self._t_in.add_rows_local(ids_in, zin)
+            self._t_out.add_rows_local(ids_out, zout)
+            if o.use_adagrad:
+                self._t_g2_in.add_rows_local(ids_in, zin)
+                self._t_g2_out.add_rows_local(ids_out, zout)
+            return True, None
         params = {"emb_in": jnp.asarray(Win), "emb_out": jnp.asarray(Wout)}
+        if o.use_adagrad:
+            params["g2_in"] = jnp.asarray(G2in)
+            params["g2_out"] = jnp.asarray(G2out)
         # remap ids into the compact local vocab + rebuild sort metadata
         remapped = []
         for b in batches:
@@ -345,7 +436,9 @@ class WordEmbedding:
                 cbow=o.cbow, window=o.window,
             )
             step = jax.jit(
-                make_sorted_superbatch_step(cfg, hs=o.hs),
+                make_sorted_superbatch_step(
+                    cfg, hs=o.hs, use_adagrad=o.use_adagrad
+                ),
                 donate_argnums=(0,),
             )
             self._ps_steps[key] = step
@@ -356,36 +449,70 @@ class WordEmbedding:
         }
         new_params, loss = step(params, xs, jnp.float32(lr))
         # AddDeltaParameter: (new - old) / num_workers back into the tables
-        din = (np.asarray(new_params["emb_in"])[: len(uin)] - Win[: len(uin)])
-        dout = (np.asarray(new_params["emb_out"])[: len(uout)] - Wout[: len(uout)])
-        self._t_in.add_rows(uin, din / self._num_workers)
-        self._t_out.add_rows(uout, dout / self._num_workers)
-        return loss
+        # (full padded bucket; padding rows start 0 and train nothing, so
+        # their delta is exactly 0)
+        din = np.asarray(new_params["emb_in"]) - Win
+        din[len(uin):] = 0.0
+        dout = np.asarray(new_params["emb_out"]) - Wout
+        dout[len(uout):] = 0.0
+        self._t_in.add_rows_local(ids_in, din / self._num_workers)
+        self._t_out.add_rows_local(ids_out, dout / self._num_workers)
+        if o.use_adagrad:
+            dg_in = np.asarray(new_params["g2_in"]) - G2in
+            dg_in[len(uin):] = 0.0
+            dg_out = np.asarray(new_params["g2_out"]) - G2out
+            dg_out[len(uout):] = 0.0
+            self._t_g2_in.add_rows_local(ids_in, dg_in / self._num_workers)
+            self._t_g2_out.add_rows_local(ids_out, dg_out / self._num_workers)
+        return True, loss
 
     def _train_ps(self, source, total_pairs_est: float, start: float) -> float:
         """PS-mode training loop: block = steps_per_call microbatches."""
         o = self.opt
         self._ps_setup()
         self._ps_steps: Dict = {}
+        self._ps_lr_trace: list = []  # per-round lr (tests assert ranks agree)
         S = max(1, o.steps_per_call)
         loss_dev = None
         pairs_done = 0
+        # the lr decays on the GLOBAL trained-pair count from the shared
+        # word-count table, so every rank's schedule is identical (ref:
+        # distributed_wordembedding.cpp:92-127; round-2 gap item 4)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            total_global = float(
+                multihost_utils.process_allgather(
+                    np.asarray([total_pairs_est], np.float64)
+                ).sum()
+            )
+        else:
+            total_global = float(total_pairs_est)
         log_every = o.batch_size * max(64, S * 8)
         for epoch in range(o.epoch):
             it = source.batches(epoch)
             done = False
-            while not done:
+            while True:
                 group = []
-                while len(group) < S:
-                    batch = next(it, None)
-                    if batch is None:
-                        done = True
-                        break
-                    group.append(batch)
-                if not group:
+                if not done:
+                    while len(group) < S:
+                        batch = next(it, None)
+                        if batch is None:
+                            done = True
+                            break
+                        group.append(batch)
+                lr = self._lr(self._ps_global_pairs / total_global)
+                # every rank joins the round while ANY rank has data (dry
+                # ranks push zero deltas — lockstep SPMD rounds)
+                any_data, loss = self._run_superbatch_ps(group, lr)
+                if not any_data:
                     break
-                lr = self._lr(pairs_done / total_pairs_est)
-                loss_dev = self._run_superbatch_ps(group, lr)
+                self._ps_lr_trace.append(lr)
+                self._ps_global_pairs = self._wc_push_and_read(
+                    o.batch_size * len(group)
+                )
+                if loss is not None:
+                    loss_dev = loss
                 prev = pairs_done
                 pairs_done += o.batch_size * len(group)
                 if pairs_done // log_every > prev // log_every:

@@ -202,6 +202,103 @@ class MatrixTable(DenseTable):
                 option.scalars(),
             )
 
+    # ------------------------------------------------- per-process row ops
+
+    def _local_rows_prep(self, row_ids) -> Tuple[np.ndarray, Any]:
+        """Validate a process-local id vector and lift it to the global
+        stacked array (processes concatenate along the worker axis)."""
+        from multiverso_tpu.parallel import multihost
+
+        ids = np.asarray(row_ids, np.int32)
+        CHECK(ids.ndim == 1, "row_ids must be 1-D")
+        self._check_ids_in_range(ids)
+        CHECK(
+            ids.shape[0] % (self.num_workers // jax.process_count() or 1) == 0,
+            f"per-process row bucket ({ids.shape[0]}) must divide evenly "
+            "over this process's worker-axis extent",
+        )
+        ids_g = multihost.host_local_to_global(
+            self.mesh, P(mesh_lib.WORKER_AXIS), ids
+        )
+        return ids, ids_g
+
+    def get_rows_local(self, row_ids) -> np.ndarray:
+        """Row-set Get where EVERY process passes its own (equally-sized,
+        padded) id bucket — the multi-process PS pull. One SPMD gather runs
+        over the per-process concatenation; each process reads back the rows
+        for ITS ids. This is the cross-process form of the reference's
+        RequestParameter row pull (ref:
+        Applications/WordEmbedding/src/communicator.cpp:117-155 — each rank
+        requests its block's vocabulary subset), with the fixed bucket
+        making the program identical on all ranks (SPMD lockstep).
+        Single-process: identical to ``get_rows``."""
+        if jax.process_count() == 1:
+            return self.get_rows(row_ids)
+        from multiverso_tpu.parallel import multihost
+
+        _, ids_g = self._local_rows_prep(row_ids)
+        fn = self._compiled.get("get_rows_local")
+        if fn is None:
+            access = self.updater.access
+
+            def run(storage, ids):
+                return jnp.take(access(storage), ids, axis=0)
+
+            fn = jax.jit(
+                run, out_shardings=mesh_lib.worker_sharding(self.mesh, 2)
+            )
+            self._compiled["get_rows_local"] = fn
+        with monitor("table.get_rows"):
+            rows_g = fn(self.storage, ids_g)
+            return np.asarray(
+                multihost.global_to_host_local(rows_g, P(mesh_lib.WORKER_AXIS))
+            )
+
+    def add_rows_local(self, row_ids, deltas) -> None:
+        """Row-set Add where every process pushes its own (equally-sized)
+        bucket of deltas; contributions for the same row accumulate across
+        processes inside one SPMD scatter — the cross-process form of the
+        reference's AddDeltaParameter (ref: communicator.cpp:157-249; the
+        caller divides by the client count, as the reference does). Padding
+        convention: id 0 with an all-zero delta row. Linear updaters only —
+        duplicate ids across processes are inherent to the protocol, and
+        the reference's PS deployment runs its weight/g2 tables on the
+        default (+=) updater too (worker-side AdaGrad math). No AddOption
+        parameter: linear row scatters take no updater scalars (same as the
+        linear branch of ``add_rows``).
+        Single-process: identical to ``add_rows``."""
+        if jax.process_count() == 1:
+            return self.add_rows(row_ids, deltas)
+        from multiverso_tpu.parallel import multihost
+
+        CHECK(
+            self.updater.linear,
+            "add_rows_local requires a linear updater (cross-process row "
+            f"sets duplicate ids); table uses {self.updater.name!r}",
+        )
+        ids, ids_g = self._local_rows_prep(row_ids)
+        deltas = np.asarray(deltas, self.dtype)
+        CHECK(
+            tuple(deltas.shape) == (ids.shape[0], self.num_col),
+            f"row deltas shape {deltas.shape} != ({ids.shape[0]}, {self.num_col})",
+        )
+        deltas_g = multihost.host_local_to_global(
+            self.mesh, P(mesh_lib.WORKER_AXIS, None), deltas
+        )
+        fn = self._compiled.get("add_rows_local")
+        if fn is None:
+            updater = self.updater
+
+            def run(storage, ids, ds):
+                return updater.scatter_apply(storage, ids, ds.astype(storage.dtype))
+
+            fn = jax.jit(
+                run, out_shardings=self._sharding, donate_argnums=(0,)
+            )
+            self._compiled["add_rows_local"] = fn
+        with monitor("table.add_rows"):
+            self.storage = fn(self.storage, ids_g, deltas_g)
+
     # ----------------------------------------------------- per-worker rows
 
     def _add_rows_per_worker_fn(self):

@@ -108,6 +108,21 @@ class SparseMatrixTable(MatrixTable):
         for w in range(ids.shape[0]):
             self._mark_stale(w, ids[w])
 
+    def add_rows_local(self, row_ids, deltas) -> None:
+        import jax
+
+        # the dirty bitmaps are host-local per process: a rank cannot mark
+        # other ranks' row sets stale, so the cross-process bucket path
+        # would silently serve stale reads — reject it (the PS protocol
+        # uses plain MatrixTables)
+        CHECK(
+            jax.process_count() == 1,
+            "SparseMatrixTable.add_rows_local is single-process only: each "
+            "rank's dirty bitmaps cannot see other ranks' row sets; use a "
+            "MatrixTable for the cross-process bucket protocol",
+        )
+        super().add_rows_local(row_ids, deltas)  # -> add_rows (marks stale)
+
     # ------------------------------------------------------------ sparse get
 
     def get_sparse(

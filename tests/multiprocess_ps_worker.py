@@ -1,0 +1,87 @@
+"""Worker for the multi-process PS-mode WordEmbedding test
+(tests/test_multiprocess_e2e.py::test_two_process_ps_wordembedding*).
+
+Each process trains PS-mode WE (`-use_ps`) against the shared tables using
+the cross-process block protocol (app._run_superbatch_ps: per-round union
+agreement + stacked get_rows_local/add_rows_local) — the reference's
+N-node Communicator deployment (ref:
+Applications/WordEmbedding/src/communicator.cpp:117-249).
+
+argv: <pid> <nproc> <coord> <corpus.npy> <out.npy> <mode: same|shard>
+
+mode=same : every rank trains the FULL corpus (identical blocks). With
+            delta averaging by num_workers this must reproduce the
+            single-process PS run bit-for-bit up to reduction order — the
+            exactness probe the driver checks against a golden run.
+mode=shard: rank0 takes 60% of the corpus, rank1 40% (unequal block
+            counts force dry-rank lockstep rounds at the tail).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    corpus_path, out_path, mode = sys.argv[4], sys.argv[5], sys.argv[6]
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+    mv.MV_Init(
+        [
+            "prog",
+            f"-coordinator={coord}",
+            f"-process_id={pid}",
+            f"-num_processes={nproc}",
+        ]
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+
+    ids = np.load(corpus_path)
+    # identical vocab on every rank (the reference broadcasts the dictionary)
+    d = Dictionary()
+    V = int(ids.max()) + 1
+    d.words = [f"w{i}" for i in range(V)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.bincount(ids[ids >= 0], minlength=V).astype(np.int64)
+
+    if mode == "shard":
+        # uneven shards (weights nproc..1): block counts differ per rank,
+        # forcing dry-rank lockstep rounds at the tail
+        wts = np.arange(nproc, 0, -1, dtype=np.float64)
+        cuts = np.floor(np.cumsum(wts / wts.sum()) * len(ids)).astype(int)[:-1]
+        ids = np.split(ids, cuts)[pid]
+
+    opt = WEOptions(
+        size=16, negative=3, window=2, batch_size=128, steps_per_call=2,
+        epoch=1, sample=0, min_count=0, output_file="", use_ps=True,
+        is_pipeline=False, train_file="unused",
+    )
+    we = WordEmbedding(opt, dictionary=d)
+    loss = we.train(ids=ids)
+    assert np.isfinite(loss), loss
+    np.save(out_path, we.embeddings())
+    mv.MV_Barrier()
+    mv.MV_ShutDown()
+    trace = ",".join(f"{v:.8f}" for v in we._ps_lr_trace)
+    print(
+        f"WORKER_OK pid={pid} pairs={we.words_trained} "
+        f"global={we._ps_global_pairs} lr_trace={trace}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -51,9 +51,54 @@ def main():
 
     agg = mv.MV_Aggregate(np.ones((nw, 5), np.float32))
     assert np.allclose(agg, nw), agg
+
+    # --- matrix table: per-process row sets (the PS protocol's data plane)
+    # (ref: Test/test_matrix_table.cpp under mpirun — row adds/gets agree
+    # across ranks; here each rank owns a distinct row bucket)
+    from multiverso_tpu.tables import MatrixTableOption
+
+    local_w = len(jax.local_devices())
+    K = 2 * local_w  # per-process bucket must split over local workers
+    mt = mv.MV_CreateTable(MatrixTableOption(num_row=K * nproc + 3, num_col=5))
+    my_ids = np.arange(K, dtype=np.int64) + pid * K
+    mt.add_rows_local(my_ids, np.full((K, 5), float(pid + 1), np.float32))
+    mt.wait()
+    mine = mt.get_rows_local(my_ids)
+    assert np.allclose(mine, pid + 1), mine
+    full = mt.get()
+    for q in range(nproc):
+        assert np.allclose(full[q * K: (q + 1) * K], q + 1), (q, full)
+    assert np.allclose(full[K * nproc:], 0.0)
+    # overlapping ids accumulate across ranks (AddDeltaParameter semantics)
+    shared = np.arange(K, dtype=np.int64)
+    mt.add_rows_local(shared, np.ones((K, 5), np.float32))
+    mt.wait()
+    assert np.allclose(mt.get()[:K], 1 + nproc), mt.get()[:K]
+
+    # --- sparse matrix: identical SPMD op sequence stays consistent
+    from multiverso_tpu.tables import SparseMatrixTableOption
+
+    st = mv.MV_CreateTable(SparseMatrixTableOption(num_row=11, num_col=3))
+    st.add_rows(np.array([1, 4]), np.ones((2, 3), np.float32))
+    st.wait()
+    stale = st.stale_rows(0)
+    assert set(np.asarray(stale).tolist()) >= {1, 4}, stale
+    assert np.allclose(st.get()[4], 1.0)
+
+    # --- KV table: deterministic host index + sharded values agree
+    from multiverso_tpu.tables import KVTableOption
+
+    kv = mv.MV_CreateTable(KVTableOption())
+    kv.add(np.array([3, 2**40 + 1], np.int64), [1.0, 2.0])
+    kv.add(np.array([3], np.int64), [0.5])
+    np.testing.assert_allclose(kv.get(np.array([3, 2**40 + 1], np.int64)), [1.5, 2.0])
+
     mv.MV_Barrier()
     mv.MV_ShutDown()
-    print(f"WORKER_OK pid={pid} nw={nw} devs={len(jax.devices())}", flush=True)
+    print(
+        f"WORKER_OK pid={pid} nw={nw} devs={len(jax.devices())} lw={local_w}",
+        flush=True,
+    )
 
 
 if __name__ == "__main__":

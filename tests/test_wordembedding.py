@@ -244,10 +244,13 @@ def test_save_and_eval_roundtrip(tmp_path):
     assert n == 3
 
 
-def test_app_ps_mode_trains(mv_env):
+@pytest.mark.parametrize("adagrad", [False, True])
+def test_app_ps_mode_trains(mv_env, adagrad):
     """-use_ps: embeddings live in MatrixTables, blocks pull rows / train
     locally / push (new-old)/num_workers deltas (ref: communicator.cpp
-    RequestParameter:117-155, AddDeltaParameter:157-249). Structured-pair
+    RequestParameter:117-155, AddDeltaParameter:157-249). With
+    -use_adagrad the two g2 accumulator tables ride the same protocol
+    (ref: communicator.cpp:17-31; round-2 gap item 7). Structured-pair
     corpus: loss must drop well below the ln2*(K+1) no-signal floor."""
     from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
     from multiverso_tpu.models.wordembedding.dictionary import Dictionary
@@ -265,9 +268,13 @@ def test_app_ps_mode_trains(mv_env):
     opt = WEOptions(
         size=16, negative=3, window=2, batch_size=512, steps_per_call=2,
         epoch=4, sample=0, alpha=0.2, output_file="", use_ps=True,
-        is_pipeline=False,
+        is_pipeline=False, use_adagrad=adagrad,
     )
     we = WordEmbedding(opt, dictionary=d)
     loss = we.train(ids=ids)
     assert np.isfinite(loss)
     assert loss < 2.0, f"PS mode failed to learn: {loss} (floor 2.77)"
+    if adagrad:
+        # the g2 tables accumulated squared gradients for touched rows
+        g2 = we._t_g2_in.get()
+        assert g2.max() > 0 and np.isfinite(g2).all()
