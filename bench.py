@@ -239,6 +239,101 @@ def _bench_e2e(dim=128, device_tokens=None, host_tokens=None):
     }
 
 
+def _bench_multidevice(ns=(1, 8)):
+    """Sharded-training scaling shape on the virtual CPU mesh (the only
+    multi-device fabric this bench host exposes — one real TPU chip).
+
+    Weak scaling: per-worker batch is fixed, tables shard over the shard
+    axis, the batch shards over the worker axis (exactly the
+    dryrun_multichip/pod layout). READ WITH benchmarks/MULTIDEVICE.md:
+    virtual CPU devices run XLA collectives over serialized host memcpys,
+    so the ratio measures the fabric, not the design — it is recorded to
+    keep the sharded path's perf on the books (and to catch regressions
+    in its collective volume), not as an ICI prediction. CPU absolute
+    throughput is not comparable to the TPU legs. Runs in subprocesses
+    because the parent process owns the axon TPU backend."""
+    import subprocess
+    import sys
+
+    code = r"""
+import os, sys, json, time
+n = int(sys.argv[1])
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+sys.path.insert(0, sys.argv[2])
+import multiverso_tpu as mv
+from jax.sharding import NamedSharding, PartitionSpec as P
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.models.wordembedding.skipgram import (
+    SkipGramConfig, init_params, make_batch, make_sorted_superbatch_step,
+    presort_batch)
+mesh = mesh_lib.build_mesh(devices=jax.devices()[:n],
+                           num_shards=2 if n > 1 else 1)
+mv.MV_Init(mesh=mesh)
+nw = mv.MV_NumWorkers()
+cfg = SkipGramConfig(vocab_size=20_000, dim=128, negatives=5)
+tab = mesh_lib.table_sharding(mesh, 2)
+rep = mesh_lib.replicated_sharding(mesh)
+params = {k: jax.device_put(v, tab) for k, v in init_params(cfg).items()}
+B, S = 8192 * nw, 4  # weak scaling: fixed per-worker batch
+rng = np.random.RandomState(0)
+mbs = []
+for s in range(S):
+    c, o, _ = make_batch(rng, cfg, B)
+    mbs.append(presort_batch({"centers": c, "outputs": o}))
+xs = {}
+for k in mbs[0]:
+    stacked = jnp.asarray(np.stack([b[k] for b in mbs]))
+    spec = P(None, "worker") if stacked.ndim >= 2 else P(None)
+    xs[k] = jax.device_put(stacked, NamedSharding(mesh, spec))
+step = jax.jit(make_sorted_superbatch_step(cfg),
+               out_shardings=({"emb_in": tab, "emb_out": tab}, rep),
+               donate_argnums=(0,))
+lr = jnp.float32(0.025)
+for _ in range(2):
+    params, loss = step(params, xs, lr)
+float(loss)
+best = 0.0
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(4):
+        params, loss = step(params, xs, lr)
+    float(loss)
+    best = max(best, B * S * 4 / (time.perf_counter() - t0))
+print(json.dumps({"n": n, "pairs_per_sec": round(best, 1)}))
+mv.MV_ShutDown()
+"""
+    out = {}
+    for n in ns:
+        r = subprocess.run(
+            [sys.executable, "-c", code, str(n), "."],
+            capture_output=True, text=True, timeout=600,
+        )
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+        try:
+            out[n] = json.loads(line)["pairs_per_sec"]
+        except Exception:
+            # a crash of the sharded step is a regression this leg exists
+            # to catch — surface it instead of silently reporting null
+            print(
+                f"multi-device leg FAILED (n={n}, rc={r.returncode}):\n"
+                f"{r.stderr[-2000:]}",
+                file=sys.stderr,
+            )
+            out[n] = None
+    fields = {
+        f"multi_device_cpu{n}_pairs_per_sec": v for n, v in out.items()
+    }
+    if all(out.get(n) for n in ns) and out[ns[0]]:
+        fields["multi_device_weak_scaling_x"] = round(
+            out[ns[-1]] / out[ns[0]], 2
+        )
+    return fields
+
+
 def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
     """Reference-architecture emulation: per-batch Get/Add through the table
     API with host staging (the MPI-PS data path without the network)."""
@@ -298,6 +393,7 @@ def main():
     fused_unsorted = _bench_fused(cfg, presort=False)
     ondevice = _bench_ondevice(cfg)
     ps = _bench_ps_loop(cfg)
+    multidev = _bench_multidevice()
     e2e = _bench_e2e()
     out = {
         "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
@@ -312,6 +408,7 @@ def main():
         "unsorted_value": round(fused_unsorted, 1),
         "ondevice_pipeline_value": round(ondevice, 1),
     }
+    out.update(multidev)
     out.update(e2e)
     print(json.dumps(out))
     mv.MV_ShutDown()

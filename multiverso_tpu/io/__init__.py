@@ -2,10 +2,17 @@
 (ref: include/multiverso/io/, src/io/ — SURVEY.md §2.5 I/O streams;
 checkpoint semantics — SURVEY.md §5 checkpoint/resume)."""
 
-from multiverso_tpu.io.streams import LocalStream, Stream, StreamFactory, TextReader
+from multiverso_tpu.io.streams import (
+    ArrowFsStream,
+    LocalStream,
+    Stream,
+    StreamFactory,
+    TextReader,
+)
 from multiverso_tpu.io.checkpoint import restore_tables, save_tables
 
 __all__ = [
+    "ArrowFsStream",
     "LocalStream",
     "Stream",
     "StreamFactory",

@@ -3,20 +3,31 @@
 TPU-native equivalent of the reference I/O layer
 (ref: include/multiverso/io/io.h:63-132, src/io/io.cpp:8-21): a
 ``StreamFactory.GetStream(uri, mode)`` that dispatches on URI scheme
-(``file://`` default; the reference's ``hdfs://`` is compile-gated behind
-``MULTIVERSO_USE_HDFS`` — here it raises with the same not-built message
-shape), a ``LocalStream`` fopen wrapper (ref: io/local_stream.h), and a
-buffered ``TextReader`` line reader (ref: io/io.h:105-132).
+(``file://`` default), a ``LocalStream`` fopen wrapper (ref:
+io/local_stream.h), remote schemes (``hdfs://``, ``gs://``, ``s3://``,
+...) over ``pyarrow.fs`` (the TPU-native analog of the reference's
+libhdfs wrapper — ref: src/io/hdfs_stream.cpp,
+include/multiverso/io/hdfs_stream.h — runtime-gated on the pyarrow
+driver being loadable, where the reference compile-gates behind
+``MULTIVERSO_USE_HDFS``), and a buffered ``TextReader`` line reader
+(ref: io/io.h:105-132). ``StreamFactory.register_scheme`` lets
+deployments plug custom backends (and tests mock remote schemes).
 """
 
 from __future__ import annotations
 
 import io as _pyio
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 from multiverso_tpu.utils.log import CHECK, Log
 
-__all__ = ["Stream", "LocalStream", "StreamFactory", "TextReader"]
+__all__ = [
+    "Stream",
+    "LocalStream",
+    "ArrowFsStream",
+    "StreamFactory",
+    "TextReader",
+]
 
 
 class Stream:
@@ -80,19 +91,108 @@ class LocalStream(Stream):
             self._f = None
 
 
-class StreamFactory:
-    """URI scheme dispatch (ref: src/io/io.cpp:8-21)."""
+class ArrowFsStream(Stream):
+    """Remote filesystem stream over ``pyarrow.fs`` — hdfs:// (libhdfs),
+    gs://, s3:// and friends (ref: the reference's HDFSStream libhdfs
+    wrapper, src/io/hdfs_stream.cpp:24-180: open-by-mode, Read/Write/
+    Flush/Close over the C API; pyarrow's FileSystem.from_uri plays the
+    hdfsConnect role here and extends the same dispatch to cloud stores).
 
-    @staticmethod
-    def GetStream(uri: str, mode: str = "r") -> Stream:
+    The scheme's native driver loads at runtime (libhdfs needs a Hadoop
+    install + CLASSPATH, S3/GCS need their pyarrow extensions): a missing
+    driver fails loudly at open — the moral equivalent of the reference's
+    ``MULTIVERSO_USE_HDFS`` compile gate, moved to runtime so one wheel
+    serves every deployment."""
+
+    def __init__(self, uri: str, mode: str = "r"):
+        CHECK(mode in ("r", "w", "a", "rb", "wb", "ab"), f"bad stream mode {mode!r}")
+        self._path = uri
+        self._f = None
+        try:
+            from pyarrow import fs as pafs
+        except Exception as e:  # pragma: no cover - pyarrow is in the image
+            Log.Fatal(
+                "remote stream %r needs pyarrow.fs (not importable: %s) — "
+                "the runtime analog of the reference's MULTIVERSO_USE_HDFS "
+                "gate", uri, e,
+            )
+        try:
+            filesystem, path = pafs.FileSystem.from_uri(uri)
+            if mode.startswith("r"):
+                self._f = filesystem.open_input_stream(path)
+            elif mode.startswith("w"):
+                self._f = filesystem.open_output_stream(path)
+            else:
+                self._f = filesystem.open_append_stream(path)
+        except Exception as e:
+            Log.Error("ArrowFsStream: cannot open %s (%s): %s",
+                      uri, mode, e)
+            self._f = None
+
+    def Write(self, data: bytes) -> int:
+        CHECK(self._f is not None, f"stream {self._path} not open")
+        self._f.write(data)
+        return len(data)
+
+    def Read(self, size: int = -1) -> bytes:
+        CHECK(self._f is not None, f"stream {self._path} not open")
+        if size is None or size < 0:
+            chunks = []
+            while True:
+                c = self._f.read(1 << 20)
+                if not c:
+                    return b"".join(chunks)
+                chunks.append(c)
+        return self._f.read(size)
+
+    def Good(self) -> bool:
+        return self._f is not None and not self._f.closed
+
+    def Flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def Close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+#: pyarrow-routed remote schemes (hdfs via libhdfs; viewfs rides the same
+#: driver — ref: hdfs_stream.cpp handles both; cloud stores via arrow's
+#: S3/GCS extensions)
+_ARROW_SCHEMES = ("hdfs", "viewfs", "gs", "gcs", "s3", "s3a", "abfs")
+
+
+class StreamFactory:
+    """URI scheme dispatch (ref: src/io/io.cpp:8-21) with a runtime
+    handler registry for custom/mocked backends."""
+
+    _handlers: Dict[str, Callable[[str, str], Stream]] = {}
+
+    @classmethod
+    def register_scheme(
+        cls, scheme: str, factory: Optional[Callable[[str, str], Stream]]
+    ) -> None:
+        """Install (or with ``None`` remove) a handler for a URI scheme;
+        handlers take (uri, mode) and win over the built-in dispatch."""
+        if factory is None:
+            cls._handlers.pop(scheme, None)
+        else:
+            cls._handlers[scheme] = factory
+
+    @classmethod
+    def GetStream(cls, uri: str, mode: str = "r") -> Stream:
         scheme, sep, rest = uri.partition("://")
         if not sep:
             scheme, rest = "file", uri
+        handler = cls._handlers.get(scheme)
+        if handler is not None:
+            return handler(uri, mode)
         if scheme == "file":
             return LocalStream(rest, mode)
-        if scheme == "hdfs":
-            Log.Fatal("hdfs:// support is not built in (reference gates it "
-                      "behind MULTIVERSO_USE_HDFS)")
+        if scheme in _ARROW_SCHEMES:
+            return ArrowFsStream(uri, mode)
         Log.Fatal("unknown stream scheme %r in %r", scheme, uri)
         raise AssertionError  # unreachable (Fatal raises)
 
