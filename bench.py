@@ -55,12 +55,13 @@ def _skewed_batches(cfg, rng, scan_steps, batch):
 
 
 def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=64,
-                 scale_mode="row_mean", presort=True, skewed=False):
+                 scale_mode="raw", presort=True, skewed=False):
     """Superbatch path: ``lax.scan`` over ``scan_steps`` microbatches per
     dispatch (no per-step host round trip). The headline runs the app's
-    default training configuration (presorted scatter ids + row_mean
-    scaling — the app's producer thread precomputes the sort metadata, so
-    it is excluded from device timing here just as in real training).
+    default training configuration (presorted scatter ids + raw
+    word2vec-accumulate scaling since round 3, benchmarks/QUALITY.md — the
+    app's producer thread precomputes the sort metadata, so it is excluded
+    from device timing here just as in real training).
     Timing is closed by forcing device values to host, so
     queued-but-unfinished work cannot inflate the number."""
     from multiverso_tpu.models.wordembedding.skipgram import (
@@ -306,10 +307,13 @@ for _ in range(3):
 print(json.dumps({"n": n, "pairs_per_sec": round(best, 1)}))
 mv.MV_ShutDown()
 """
+    import os
+
+    repo = os.path.dirname(os.path.abspath(__file__))
     out = {}
     for n in ns:
         r = subprocess.run(
-            [sys.executable, "-c", code, str(n), "."],
+            [sys.executable, "-c", code, str(n), repo],
             capture_output=True, text=True, timeout=600,
         )
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
@@ -332,6 +336,96 @@ mv.MV_ShutDown()
             out[ns[-1]] / out[ns[0]], 2
         )
     return fields
+
+
+def _bench_quality():
+    """Quality proof on a natural-shaped corpus at scale (round-2 VERDICT
+    item 2): a 100M-token log-linear topic corpus with NO planted windows
+    (synth_natural.py — co-occurrence emerges from latent geometry), scored
+    on analogy + similarity-spearman exams derived from the latents, with
+    PARITY measured against an independently implemented SGNS trainer
+    (benchmarks/torch_sgns.py, torch CPU) on the SAME corpus — the quality
+    number is no longer the corpus generator grading itself.
+
+    Two sub-legs:
+
+    * **scale**: our framework trains the FULL corpus (1 epoch, ~4.8
+      pairs/token) — analogy/spearman at 60M+ tokens;
+    * **parity (equal data)**: both systems train the SAME ~10M-token
+      slice for one epoch with the same vocabulary/counts — the
+      apples-to-apples quality comparison (the torch reference runs
+      ~200k pairs/s on this host vs our ~2-3M, so equal-wall-clock would
+      just measure speed, which the throughput legs already do).
+
+    Sizes via MV_BENCH_QUALITY_TOKENS / MV_BENCH_QUALITY_SLICE_TOKENS;
+    MV_BENCH_QUALITY=0 skips the leg.
+    """
+    import os
+    import sys as _sys
+
+    if os.environ.get("MV_BENCH_QUALITY", "1") == "0":
+        return {}
+    try:  # fail fast: a missing torch after the 60M training run would
+        import torch  # noqa: F401  # discard every other leg's metrics
+    except Exception:
+        print("quality leg skipped: torch not importable", file=_sys.stderr)
+        return {"quality_skipped": "no torch"}
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "benchmarks"))
+    from torch_sgns import train_sgns
+
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.eval import (
+        analogy_accuracy,
+        similarity_spearman,
+    )
+    from multiverso_tpu.models.wordembedding.synth_natural import (
+        NaturalConfig,
+        generate_natural,
+    )
+
+    tokens = int(os.environ.get("MV_BENCH_QUALITY_TOKENS", 60_000_000))
+    slice_tokens = int(
+        os.environ.get("MV_BENCH_QUALITY_SLICE_TOKENS", 10_000_000)
+    )
+    ncfg = NaturalConfig(tokens=tokens, vocab_size=50_000)
+    ids, d, qs, sims = generate_natural(ncfg)
+    counts = np.asarray(d.counts)
+
+    def train_ours(stream):
+        opt = WEOptions(
+            train_file="<synthetic>", size=128, window=5, negative=5,
+            epoch=1, batch_size=8192, sample=1e-3, min_count=1,
+            output_file="", steps_per_call=256, device_pipeline=True,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        t0 = time.perf_counter()
+        we.train(stream)
+        rate = we.words_trained / max(time.perf_counter() - t0, 1e-9)
+        acc, nq = analogy_accuracy(d.words, we.embeddings(), qs)
+        rho, npair = similarity_spearman(d.words, we.embeddings(), sims)
+        return acc, rho, rate, nq, npair
+
+    acc_full, rho_full, rate_full, nq, npair = train_ours(ids)
+    sl = ids[:slice_tokens]
+    acc_o, rho_o, rate_o, _, _ = train_ours(sl)
+    ref_emb, ref_rate = train_sgns(sl, len(d), counts, epochs=1)
+    acc_r, _ = analogy_accuracy(d.words, ref_emb, qs)
+    rho_r, _ = similarity_spearman(d.words, ref_emb, sims)
+    return {
+        "quality_tokens": int((ids >= 0).sum()),
+        "quality_analogy_ours_full": round(acc_full, 4),
+        "quality_spearman_ours_full": round(rho_full, 4),
+        "quality_slice_tokens": int((sl >= 0).sum()),
+        "quality_analogy_ours": round(acc_o, 4),
+        "quality_analogy_torch_ref": round(acc_r, 4),
+        "quality_spearman_ours": round(rho_o, 4),
+        "quality_spearman_torch_ref": round(rho_r, 4),
+        "quality_questions": nq,
+        "quality_sim_pairs": npair,
+        "quality_ours_pairs_per_sec": round(rate_full, 1),
+        "quality_ref_pairs_per_sec": round(ref_rate, 1),
+    }
 
 
 def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
@@ -395,6 +489,7 @@ def main():
     ps = _bench_ps_loop(cfg)
     multidev = _bench_multidevice()
     e2e = _bench_e2e()
+    quality = _bench_quality()
     out = {
         "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
         "value": round(fused, 1),
@@ -410,6 +505,7 @@ def main():
     }
     out.update(multidev)
     out.update(e2e)
+    out.update(quality)
     print(json.dumps(out))
     mv.MV_ShutDown()
 

@@ -1,0 +1,139 @@
+"""Independent SGNS reference trainer (torch CPU) for quality parity runs.
+
+A clean-room implementation of classic word2vec skip-gram negative
+sampling — subsampling, shrunk windows, unigram^3/4 negatives, linear lr
+decay — sharing NO code with multiverso_tpu's training paths (different
+library, different batching, different sampling machinery). bench.py
+trains it on the same natural-shaped corpus as the framework and compares
+analogy / similarity-spearman scores: the round-2 VERDICT's demand for a
+quality number that is not the corpus generator grading itself (item 2).
+
+Vectorized minibatch form of the classic algorithm: gather rows, batched
+sigmoid gradients, scatter-add via index_add_ (duplicates accumulate, the
+sequential-SGD semantics word2vec has).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _subsample(ids: np.ndarray, counts: np.ndarray, sample: float,
+               rng: np.random.RandomState) -> np.ndarray:
+    if sample <= 0:
+        return ids
+    total = counts.sum()
+    f = counts / max(total, 1)
+    keep = np.minimum(1.0, np.sqrt(sample / np.maximum(f, 1e-12))
+                      + sample / np.maximum(f, 1e-12))
+    u = rng.random_sample(len(ids))
+    m = (ids < 0) | (u < keep[np.maximum(ids, 0)])
+    return ids[m]
+
+
+def _pairs_for_chunk(ids: np.ndarray, window: int,
+                     rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
+    """All (center, context) pairs of one compacted chunk with per-position
+    shrunk windows b ~ U[1, W] (emit every offset in [-b, b])."""
+    n = len(ids)
+    b = rng.randint(1, window + 1, n)
+    # sentence id per position: pairs must never span a -1 marker (word2vec
+    # windows live within one sentence)
+    sent = np.cumsum(ids < 0)
+    cs, ts = [], []
+    for d in range(1, window + 1):
+        live = b >= d
+        # forward offset +d
+        c = ids[:-d][live[:-d]]
+        t = ids[d:][live[:-d]]
+        same = sent[:-d][live[:-d]] == sent[d:][live[:-d]]
+        ok = (c >= 0) & (t >= 0) & same
+        cs.append(c[ok]); ts.append(t[ok])
+        # backward offset -d (same pair set mirrored; word2vec emits both)
+        cs.append(t[ok]); ts.append(c[ok])
+    return np.concatenate(cs), np.concatenate(ts)
+
+
+def train_sgns(
+    ids: np.ndarray,
+    vocab_size: int,
+    counts: np.ndarray,
+    dim: int = 128,
+    window: int = 5,
+    negatives: int = 5,
+    alpha: float = 0.025,
+    epochs: int = 1,
+    batch: int = 8192,
+    sample: float = 1e-3,
+    seed: int = 1,
+    max_pairs: Optional[int] = None,
+    log_every_s: float = 30.0,
+) -> Tuple[np.ndarray, float]:
+    """Returns (input embeddings (V, dim), trained pairs/sec)."""
+    import torch
+
+    torch.manual_seed(seed)
+    rng = np.random.RandomState(seed)
+    V = vocab_size
+    Win = (torch.rand(V, dim) - 0.5) / dim
+    Wout = torch.zeros(V, dim)
+    # unigram^0.75 negative table (inverse-CDF, word2vec's scheme)
+    p34 = np.power(np.maximum(counts, 1).astype(np.float64), 0.75)
+    cdf = np.cumsum(p34); cdf /= cdf[-1]
+
+    # pair budget for the lr schedule
+    n_tokens = int((ids >= 0).sum())
+    est_total = max(1, int(n_tokens * (window + 1) * epochs * 0.8))
+    if max_pairs is not None:
+        est_total = min(est_total, max_pairs)
+    done = 0
+    t0 = time.perf_counter()
+    t_log = t0
+    chunk_tokens = 2_000_000
+    for ep in range(epochs):
+        stream = _subsample(ids, counts, sample, rng)
+        for s0 in range(0, len(stream), chunk_tokens):
+            chunk = stream[s0: s0 + chunk_tokens]
+            c_np, t_np = _pairs_for_chunk(chunk, window, rng)
+            perm = rng.permutation(len(c_np))
+            c_np, t_np = c_np[perm], t_np[perm]
+            for b0 in range(0, len(c_np), batch):
+                c = torch.from_numpy(c_np[b0: b0 + batch].astype(np.int64))
+                t = torch.from_numpy(t_np[b0: b0 + batch].astype(np.int64))
+                B = len(c)
+                negs_np = np.searchsorted(
+                    cdf, rng.random_sample(B * negatives)
+                ).astype(np.int64).reshape(B, negatives)
+                outs = torch.cat(
+                    [t[:, None], torch.from_numpy(negs_np)], dim=1
+                )  # (B, 1+K)
+                lr = alpha * max(1e-4, 1.0 - done / est_total)
+                vin = Win[c]                     # (B, D)
+                vout = Wout[outs]                # (B, 1+K, D)
+                logits = torch.einsum("bd,bkd->bk", vin, vout)
+                labels = torch.zeros_like(logits)
+                labels[:, 0] = 1.0
+                g = torch.sigmoid(logits) - labels   # (B, 1+K)
+                d_vin = torch.einsum("bk,bkd->bd", g, vout)
+                d_vout = g[..., None] * vin[:, None, :]
+                Win.index_add_(0, c, -lr * d_vin)
+                Wout.index_add_(
+                    0, outs.reshape(-1), -lr * d_vout.reshape(-1, dim)
+                )
+                done += B
+                if max_pairs is not None and done >= max_pairs:
+                    rate = done / max(time.perf_counter() - t0, 1e-9)
+                    return Win.numpy(), rate
+                now = time.perf_counter()
+                if now - t_log > log_every_s:
+                    t_log = now
+                    print(
+                        f"[torch_sgns] {done/1e6:.1f}M pairs, "
+                        f"{done/(now-t0)/1e3:.0f}k pairs/s, lr {lr:.5f}",
+                        flush=True,
+                    )
+    rate = done / max(time.perf_counter() - t0, 1e-9)
+    return Win.numpy(), rate

