@@ -21,6 +21,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import jax
+
+# honor JAX_PLATFORMS=cpu even when a site hook pre-imported jax with a
+# hardware platform pinned (env alone is too late then — the test harness
+# and CI run this example on the virtual CPU backend)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 import multiverso_tpu as mv

@@ -66,10 +66,11 @@ class FTRLModel:
 
             CHECK(runtime().started,
                   "input_size=0 (hashed FTRL) requires MV_Init first")
-            CHECK(jax.process_count() == 1 or not self.use_ps,
-                  "hashed FTRL's key->slot index is process-local host "
-                  "state; multi-process use_ps would silently diverge — "
-                  "use a dense input_size for multi-process PS runs")
+            # multi-process: per-rank batches ride KVTable's lockstep
+            # get_local/add_local rounds (the index stays identical on
+            # every rank via the per-round key-union sync) — the
+            # reference's hash-sharded FTRL deployment shape
+            # (ftrl_sparse_table.h:12-88 over hopscotch servers)
             self.kv = create_table(KVTableOption(
                 val_dim=2, init_capacity=1 << 16, name="ftrl_zn_kv",
                 cache_local=False,  # unbounded keys: no host raw() mirror
@@ -127,7 +128,10 @@ class FTRLModel:
     def _gather_rows(self, idx: np.ndarray) -> jnp.ndarray:
         flat = idx.reshape(-1)
         if self.kv is not None:
-            rows = self.kv.get(flat)  # unknown keys read (0, 0) = fresh state
+            if jax.process_count() > 1:  # lockstep per-rank round
+                rows = self.kv.get_local(flat)
+            else:
+                rows = self.kv.get(flat)  # unknown keys read (0,0) = fresh
         elif self.table is not None:
             rows = self.table.get_rows(flat)
         else:
@@ -145,12 +149,37 @@ class FTRLModel:
             live = deltas.any(axis=1)
             if not live.all():
                 flat, deltas = flat[live], deltas[live]
-            if len(flat):
+            if jax.process_count() > 1:  # lockstep per-rank round
+                self.kv.add_local(flat, deltas)
+            elif len(flat):
                 self.kv.add(flat, deltas)  # += accumulate, dups allowed
         elif self.table is not None:
             self.table.add_rows(flat, deltas)  # += accumulate, dups allowed
         else:
             self._zn = self._zn.at[flat].add(jnp.asarray(deltas))
+
+    def join_round(self) -> bool:
+        """Dry-rank participation in one cross-process training round
+        (hashed multi-process only): joins the gather and push collectives
+        with empty batches. Returns True if any rank still had data (the
+        caller keeps joining), False when the round was globally dry."""
+        CHECK(self.kv is not None and jax.process_count() > 1,
+              "join_round is for hashed multi-process FTRL")
+        e = np.zeros(0, np.int64)
+        self.kv.get_local(e)  # collective #1 (mirrors train_batch's gather)
+        live = self.kv.last_round_had_data()
+        # collective #2 mirrors the push; when the round was globally dry
+        # its bucket round is a no-op on every rank alike
+        self.kv.add_local(e, np.zeros((0, 2), np.float32))
+        return live
+
+    def join_predict_round(self) -> bool:
+        """Dry-rank participation in one gather-only round (the Test loop's
+        analog of join_round). Returns False when globally dry."""
+        CHECK(self.kv is not None and jax.process_count() > 1,
+              "join_predict_round is for hashed multi-process FTRL")
+        self.kv.get_local(np.zeros(0, np.int64))
+        return self.kv.last_round_had_data()
 
     # -- model api --------------------------------------------------------
 

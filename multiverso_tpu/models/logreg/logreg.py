@@ -89,6 +89,16 @@ class LogReg:
                 win_sum = win_sum + loss
                 win_n += 1
                 seen += n_in_group
+            # multi-process hashed FTRL: every train_batch is a lockstep
+            # collective round; a rank whose reader drained early keeps
+            # joining rounds with empty batches until ALL ranks are done
+            # (mirrors the WordEmbedding PS dry-rank protocol)
+            if getattr(self.model, "kv", None) is not None:
+                import jax
+
+                if jax.process_count() > 1:
+                    while self.model.join_round():
+                        pass
             if win_n:
                 ep_sum, ep_n = ep_sum + float(win_sum), ep_n + win_n
             last_epoch_loss = ep_sum / ep_n if ep_n else 0.0
@@ -117,9 +127,24 @@ class LogReg:
             total += len(batch["y"])
             for row in np.asarray(scores):
                 out_lines.append(" ".join(f"{v:.6f}" for v in np.atleast_1d(row)))
+        # multi-process: test gathers are collectives too — drain with
+        # gather-only rounds until every rank's test shard is done
+        if getattr(self.model, "kv", None) is not None:
+            import jax
+
+            if jax.process_count() > 1:
+                while self.model.join_predict_round():
+                    pass
         acc = correct / max(total, 1)
         Log.Info("[LogReg] test: %d/%d correct (%.4f)", correct, total, acc)
         path = output_file or cfg.output_file
+        import jax
+
+        if path and jax.process_count() > 1:
+            # each rank scored only its own test shard: write per-rank
+            # files (the reference's per-node output convention) instead of
+            # racing every rank over one path
+            path = f"{path}.rank{jax.process_index()}"
         if path:
             from multiverso_tpu.io.streams import as_stream
 

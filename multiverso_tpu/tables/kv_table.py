@@ -87,6 +87,9 @@ class KVTable:
         self._cache_local = bool(option.cache_local)
         self._scatter_fn = None
         self._gather_fn = None
+        self._scatter_local_fn = None  # per-rank (worker-sharded) programs
+        self._gather_local_fn = None
+        self._last_round_any = False  # latched by _round_bucket
 
     # ------------------------------------------------------------ internals
 
@@ -97,13 +100,23 @@ class KVTable:
         new_cap = self._capacity
         while new_cap < needed:
             new_cap <<= 1
-        host = np.asarray(self._values)
-        pad = [(0, new_cap - self._capacity)] + [(0, 0)] * (host.ndim - 1)
-        host = np.pad(host, pad)
+        # device-side pad: works sharded AND multi-process (a host
+        # round-trip of a sharded global array would not be addressable
+        # cross-process; growth decisions are identical on every rank, so
+        # this is one lockstep SPMD program)
+        pad = [(0, new_cap - self._capacity)]
+        if self.val_dim > 1:
+            pad.append((0, 0))
+        self._values = jax.jit(
+            lambda v: jnp.pad(v, pad),
+            out_shardings=self._sharding,
+            donate_argnums=(0,),
+        )(self._values)
         self._capacity = new_cap
-        self._values = jax.device_put(host, self._sharding)
         self._scatter_fn = None  # capacity change => new shapes
         self._gather_fn = None
+        self._scatter_local_fn = None
+        self._gather_local_fn = None
 
     def _check_keys(self, keys) -> np.ndarray:
         """Integer keys only — an API break vs the pre-round-2 dict-based
@@ -189,7 +202,9 @@ class KVTable:
         return self._local
 
     def items(self) -> Tuple[np.ndarray, np.ndarray]:
-        """All (key, value) pairs currently stored server-side."""
+        """All (key, value) pairs currently stored server-side. SPMD
+        collective under multi-process (every rank calls; the values
+        all-gather to a replicated copy)."""
         n = len(self._index)
         if n == 0:
             return (np.asarray([], self._key_dtype),
@@ -197,8 +212,182 @@ class KVTable:
         keys = self._index.keys().view(np.int64)
         if keys.dtype != self._key_dtype:
             keys = keys.astype(self._key_dtype)
-        host = np.asarray(self._values)
+        if jax.process_count() == 1:
+            host = np.asarray(self._values)  # direct host copy, no replica
+        else:
+            # sharded global array: replicate (one SPMD all-gather every
+            # rank joins) before the host read
+            host = np.asarray(
+                jax.jit(lambda v: v, out_shardings=self._replicated)(
+                    self._values
+                )
+            )
         return keys, host[:n]
+
+    # ------------------------------------------- per-process key rounds
+
+    def _local_extent(self) -> int:
+        return max(1, mesh_lib.num_workers(self.mesh) // jax.process_count())
+
+    def last_round_had_data(self) -> bool:
+        """Whether the most recent get_local/add_local round saw keys on
+        ANY rank — the dry-rank drain signal (no extra collective; the flag
+        rides the round's own bucket allgather)."""
+        return self._last_round_any
+
+    def _round_bucket(self, n_own: int) -> Tuple[bool, int]:
+        """Cross-rank agreement on the padded key-bucket size for one
+        round. Returns (any_rank_has_keys, bucket); the flag is also
+        latched as ``_last_round_any`` so dry-rank drivers can learn
+        whether the round was globally dry WITHOUT issuing an extra
+        collective (collective counts must match across ranks)."""
+        from jax.experimental import multihost_utils
+
+        meta = multihost_utils.process_allgather(
+            np.asarray([n_own], np.int64)
+        )
+        m = int(np.asarray(meta).max())
+        self._last_round_any = m > 0
+        if m == 0:
+            return False, 0
+        return True, _next_pow2(max(m, self._local_extent()))
+
+    def _sync_union(self, keys: np.ndarray, bucket: int) -> None:
+        """Insert the UNION of every rank's key batch into this rank's
+        index, in rank order — the invariant that keeps the replicated
+        host indexes identical across ranks by induction (the reference
+        shards its unordered_map per server, kv_table.h:48-65; here the
+        VALUES shard over the mesh and the index replicates per host — a
+        documented deviation that trades host RAM for zero index
+        traffic on the hot path)."""
+        from jax.experimental import multihost_utils
+
+        padded = np.zeros(bucket, np.int64)
+        if len(keys):
+            # preserve uint64 bit patterns; widen narrow ints
+            padded[: len(keys)] = (
+                keys.view(np.int64) if keys.dtype.itemsize == 8
+                else keys.astype(np.int64)
+            )
+        # transport as two uint32 halves: process_allgather stages through
+        # jax, which TRUNCATES int64 to int32 under the default x64=off
+        # config — 64-bit keys must not lose their top halves. The header's
+        # second slot carries the rank's key-dtype class so every rank
+        # promotes its tracked _key_dtype from the UNION, keeping
+        # items()/store() key dtypes identical across ranks.
+        k64 = padded.view(np.uint64)
+        dt_code = 1 if keys.dtype == np.uint64 else 0
+        payload = np.concatenate([
+            np.asarray([len(keys), dt_code], np.uint32),
+            (k64 & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            (k64 >> np.uint64(32)).astype(np.uint32),
+        ])
+        gathered = np.asarray(
+            multihost_utils.process_allgather(payload)
+        ).reshape(jax.process_count(), 2 + 2 * bucket)
+        for r in range(jax.process_count()):
+            cnt = int(gathered[r, 0])
+            if cnt:
+                lo = gathered[r, 2: 2 + cnt].astype(np.uint64)
+                hi = gathered[r, 2 + bucket: 2 + bucket + cnt].astype(np.uint64)
+                self._index.resolve(
+                    ((hi << np.uint64(32)) | lo).view(np.int64), create=True
+                )
+        if gathered[:, 1].any():  # any rank contributed uint64 keys
+            self._key_dtype = np.dtype(np.uint64)
+        if len(self._index) > self._capacity:
+            self._grow(len(self._index))
+
+    def add_local(self, keys, vals) -> None:
+        """Per-rank Add: every process pushes its OWN key/value batch;
+        one lockstep SPMD scatter accumulates all ranks' contributions
+        (duplicate keys across ranks +=). Ranks with no data pass empty
+        batches and still join the collectives. The cross-process form of
+        the reference's hash-partitioned KV Add (kv_table.h:48-65,96-103).
+        Single-process: identical to ``add``."""
+        keys = self._check_keys(keys)
+        if jax.process_count() == 1:
+            return self.add(keys, vals)
+        from multiverso_tpu.parallel import multihost
+        from jax.sharding import PartitionSpec as P
+
+        vals = np.asarray(vals, self.dtype)
+        vals = vals.reshape((-1,) if self.val_dim == 1 else (-1, self.val_dim))
+        CHECK(len(keys) == len(vals), "keys and vals must have equal length")
+        any_data, bucket = self._round_bucket(len(keys))
+        if not any_data:
+            return
+        promoted = np.promote_types(self._key_dtype, keys.dtype)
+        self._key_dtype = (
+            np.dtype(np.uint64) if promoted.kind == "f" else promoted
+        )
+        self._sync_union(keys, bucket)
+        slots = np.zeros(bucket, np.int32)
+        if len(keys):
+            slots[: len(keys)] = self._index.resolve(keys, create=False)
+        vals_p = np.zeros(
+            (bucket,) if self.val_dim == 1 else (bucket, self.val_dim),
+            self.dtype,
+        )
+        vals_p[: len(vals)] = vals  # padding: slot 0 += 0, harmless
+        spec = P(mesh_lib.WORKER_AXIS) if self.val_dim == 1 else P(
+            mesh_lib.WORKER_AXIS, None
+        )
+        slots_g = multihost.host_local_to_global(
+            self.mesh, P(mesh_lib.WORKER_AXIS), slots
+        )
+        vals_g = multihost.host_local_to_global(self.mesh, spec, vals_p)
+        if self._scatter_local_fn is None:
+            self._scatter_local_fn = jax.jit(
+                lambda v, s, d: v.at[s].add(d),
+                out_shardings=self._sharding,
+                donate_argnums=(0,),
+            )
+        self._values = self._scatter_local_fn(self._values, slots_g, vals_g)
+
+    def get_local(self, keys) -> np.ndarray:
+        """Per-rank Get: every process reads its OWN key batch through one
+        lockstep SPMD gather (per-rank buckets stacked on the worker
+        axis). Unknown keys read 0, like ``get``. Ranks with no keys pass
+        an empty batch. Single-process: identical to ``get``."""
+        keys = self._check_keys(keys)
+        if jax.process_count() == 1:
+            return self.get(keys)
+        from multiverso_tpu.parallel import multihost
+        from jax.sharding import PartitionSpec as P
+
+        any_data, bucket = self._round_bucket(len(keys))
+        empty = np.zeros(self._shape(0), self.dtype)
+        if not any_data:
+            return empty
+        slots = self._index.resolve(keys, create=False) if len(keys) else (
+            np.zeros(0, np.int64)
+        )
+        miss = slots < 0
+        slots_p = np.zeros(bucket, np.int32)
+        slots_p[: len(keys)] = np.where(miss, 0, slots).astype(np.int32)
+        slots_g = multihost.host_local_to_global(
+            self.mesh, P(mesh_lib.WORKER_AXIS), slots_p
+        )
+        if self._gather_local_fn is None:
+            self._gather_local_fn = jax.jit(
+                lambda v, s: v[s],
+                out_shardings=mesh_lib.worker_sharding(
+                    self.mesh, 1 if self.val_dim == 1 else 2
+                ),
+            )
+        rows_g = self._gather_local_fn(self._values, slots_g)
+        mine = np.asarray(multihost.global_to_host_local(
+            rows_g, P(mesh_lib.WORKER_AXIS) if self.val_dim == 1 else P(
+                mesh_lib.WORKER_AXIS, None
+            )
+        ))[: len(keys)]
+        if miss.any():
+            mine = np.where(
+                miss if self.val_dim == 1 else miss[:, None],
+                np.zeros_like(mine), mine,
+            )
+        return mine
 
     def wait(self) -> None:
         jax.block_until_ready(self._values)

@@ -1,0 +1,91 @@
+"""Worker for the multi-process KV-table / hashed-FTRL tests
+(tests/test_multiprocess_e2e.py::test_two_process_kv_and_hashed_ftrl).
+
+Covers the round-3 cross-process KV protocol: per-rank key batches ride
+lockstep get_local/add_local rounds, with the replicated host index kept
+identical on every rank by the per-round key-union sync — the reference's
+hash-sharded KV/FTRL deployment shape (ref: kv_table.h:48-65,
+ftrl_sparse_table.h:12-88).
+
+argv: <pid> <nproc> <coord> <train_file> <out.npz>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    train_file, out_path = sys.argv[4], sys.argv[5]
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import KVTableOption
+
+    mv.MV_Init(
+        [
+            "prog",
+            f"-coordinator={coord}",
+            f"-process_id={pid}",
+            f"-num_processes={nproc}",
+        ]
+    )
+
+    # --- KV local-round invariants
+    kv = mv.MV_CreateTable(KVTableOption(val_dim=1))
+    mine = np.arange(4, dtype=np.int64) + pid * 1000
+    kv.add_local(mine, np.full(4, float(pid + 1), np.float32))
+    got = kv.get_local(mine)
+    assert np.allclose(got, pid + 1), got
+    # shared key accumulates across ranks
+    kv.add_local(np.array([777], np.int64), np.array([1.0], np.float32))
+    # identical-op collective get sees every rank's state
+    assert np.allclose(kv.get(np.array([777], np.int64)), nproc)
+    other = np.arange(4, dtype=np.int64) + ((pid + 1) % nproc) * 1000
+    assert np.allclose(kv.get_local(other), (pid + 1) % nproc + 1)
+    # dry-rank round: only rank 0 contributes, everyone joins
+    kv.add_local(
+        np.array([555], np.int64) if pid == 0 else np.zeros(0, np.int64),
+        np.array([2.5], np.float32) if pid == 0 else np.zeros(0, np.float32),
+    )
+    assert np.allclose(kv.get(np.array([555], np.int64)), 2.5)
+    ks, _ = kv.items()
+    assert len(ks) == 4 * nproc + 2, len(ks)
+
+    # --- hashed FTRL cross-process training (disjoint key spaces)
+    from multiverso_tpu.models.logreg import LogReg
+    from multiverso_tpu.models.logreg.config import Configure
+
+    cfg = Configure(
+        input_size=0, output_size=1, sparse=True, objective_type="ftrl",
+        updater_type="ftrl", train_epoch=3, minibatch_size=64,
+        alpha=0.1, beta=1.0, lambda1=0.01, lambda2=0.001,
+        train_file=train_file, test_file=train_file,
+        output_model_file="", output_file="", show_time_per_sample=10**9,
+        use_ps=False, pipeline=False,
+    )
+    lr = LogReg(cfg)
+    lr.Train()
+    acc = lr.Test(output_file="")
+    keys, w = lr.model.hashed_weights()
+    zn_keys, zn_vals = lr.model.kv.items()
+    np.savez(
+        out_path, keys=np.asarray(keys, np.int64), w=np.asarray(w),
+        zn_keys=np.asarray(zn_keys, np.int64), zn_vals=np.asarray(zn_vals),
+    )
+    mv.MV_Barrier()
+    mv.MV_ShutDown()
+    print(f"WORKER_OK pid={pid} acc={acc:.3f} nkeys={len(keys)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,90 @@ def test_ps_wordembedding_sharded_corpus(tmp_path, nproc):
     assert all(f == sum(pairs) for f in finals), (finals, pairs)
 
 
+def _ftrl_rank_file(tmp_path, rank: int):
+    """Rank-disjoint hashed-FTRL training file: feature keys live in
+    rank-offset u64 ranges, so cross-rank state interference is zero and
+    per-rank exactness against a single-process run is well-defined."""
+    import numpy as np
+
+    rng = np.random.RandomState(100 + rank)
+    f = 40
+    feat = rng.randint(1, 2**40, size=f, dtype=np.int64) + rank * (2**50)
+    wtrue = rng.randn(f)
+    picks = rng.randint(0, f, size=(256, 5))
+    y = (np.asarray([wtrue[p].sum() for p in picks]) > 0).astype(int)
+    path = tmp_path / f"ftrl_train_{rank}.txt"
+    with open(path, "w") as fh:
+        for pi, yi in zip(picks, y):
+            fh.write(f"{yi} " + " ".join(f"{feat[k]}:1" for k in pi) + "\n")
+    return path
+
+
+def test_two_process_kv_and_hashed_ftrl(tmp_path):
+    """Round-3 cross-process KV protocol + hashed FTRL (the reference's
+    hash-sharded CTR deployment shape, round-2 weak item 3): per-rank
+    lockstep KV rounds, dry-rank joins, and 2-process hashed-FTRL training
+    whose per-rank state matches a single-process golden exactly
+    (disjoint key spaces => zero interference)."""
+    import numpy as np
+
+    files = [_ftrl_rank_file(tmp_path, r) for r in range(2)]
+    outs = [tmp_path / f"ftrl_{r}.npz" for r in range(2)]
+    _run_cluster(
+        "multiprocess_kv_worker.py",
+        lambda i: [files[i], outs[i]],
+        nproc=2,
+        timeout=300,
+    )
+    for r in range(2):
+        got = np.load(outs[r])
+        # golden: single-process run over the same rank file
+        golden = subprocess.run(
+            [
+                sys.executable, "-c",
+                f"""
+import os, sys
+sys.path.insert(0, {str(_REPO)!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.models.logreg import LogReg
+from multiverso_tpu.models.logreg.config import Configure
+mv.MV_Init(["prog"])
+cfg = Configure(input_size=0, output_size=1, sparse=True,
+                objective_type="ftrl", updater_type="ftrl", train_epoch=3,
+                minibatch_size=64, alpha=0.1, beta=1.0, lambda1=0.01,
+                lambda2=0.001, train_file={str(files[r])!r},
+                test_file={str(files[r])!r}, output_model_file="",
+                output_file="", show_time_per_sample=10**9,
+                use_ps=False, pipeline=False)
+lr = LogReg(cfg)
+lr.Train()
+keys, w = lr.model.hashed_weights()
+np.savez({str(tmp_path / f"golden_{r}.npz")!r},
+         keys=np.asarray(keys, np.int64), w=np.asarray(w))
+print("GOLDEN_OK")
+""",
+            ],
+            capture_output=True, cwd=_REPO, timeout=300,
+        )
+        assert golden.returncode == 0, (
+            golden.stdout.decode()[-2000:] + golden.stderr.decode()[-2000:]
+        )
+        gold = np.load(tmp_path / f"golden_{r}.npz")
+        # restrict the 2-process run's state to THIS rank's key space
+        lo, hi = r * (2**50), (r + 1) * (2**50)
+        sel = (got["keys"] >= lo) & (got["keys"] < hi)
+        mp_w = dict(zip(got["keys"][sel].tolist(), got["w"][sel].tolist()))
+        g_w = dict(zip(gold["keys"].tolist(), gold["w"].tolist()))
+        assert set(mp_w) == set(g_w), (len(mp_w), len(g_w))
+        for k, v in g_w.items():
+            assert abs(mp_w[k] - v) < 1e-5, (r, k, mp_w[k], v)
+        assert len(g_w) > 10
+
+
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_cluster_table_invariants(nproc):
     """Array + matrix (per-process row buckets) + sparse + KV invariants
