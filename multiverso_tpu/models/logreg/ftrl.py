@@ -60,6 +60,8 @@ class FTRLModel:
         self.l2 = float(config.lambda2)
         self.use_ps = bool(config.use_ps)
         self.kv = None
+        self.collective_rounds = False  # set for hashed mode below
+        self.collective_predict = False
         if self.hashed:
             from multiverso_tpu.runtime import runtime
             from multiverso_tpu.tables import KVTableOption, create_table
@@ -76,6 +78,8 @@ class FTRLModel:
                 cache_local=False,  # unbounded keys: no host raw() mirror
             ))
             self.table = None
+            self.collective_rounds = True   # every batch is a KV round
+            self.collective_predict = True  # test gathers are rounds too
         elif self.use_ps:
             from multiverso_tpu.runtime import runtime
             from multiverso_tpu.tables import MatrixTableOption, create_table
@@ -231,6 +235,8 @@ class FTRLModel:
             self.kv.store(uri)  # (keys, zn) pairs — no dimension bound
             return
         zn = self.table.get() if self.table is not None else np.asarray(self._zn)
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return  # one writer (the get above was the collective part)
         stream, owned = as_stream(uri, "w")
         buf = _pyio.BytesIO()
         np.savez(buf, zn=zn)

@@ -89,11 +89,12 @@ class LogReg:
                 win_sum = win_sum + loss
                 win_n += 1
                 seen += n_in_group
-            # multi-process hashed FTRL: every train_batch is a lockstep
-            # collective round; a rank whose reader drained early keeps
-            # joining rounds with empty batches until ALL ranks are done
-            # (mirrors the WordEmbedding PS dry-rank protocol)
-            if getattr(self.model, "kv", None) is not None:
+            # multi-process collective-round models (hashed FTRL, sparse
+            # PSModel): every train_batch is a lockstep round; a rank whose
+            # reader drained early keeps joining rounds with empty batches
+            # until ALL ranks are done (mirrors the WordEmbedding PS
+            # dry-rank protocol)
+            if getattr(self.model, "collective_rounds", False):
                 import jax
 
                 if jax.process_count() > 1:
@@ -127,9 +128,9 @@ class LogReg:
             total += len(batch["y"])
             for row in np.asarray(scores):
                 out_lines.append(" ".join(f"{v:.6f}" for v in np.atleast_1d(row)))
-        # multi-process: test gathers are collectives too — drain with
-        # gather-only rounds until every rank's test shard is done
-        if getattr(self.model, "kv", None) is not None:
+        # multi-process: models whose predictions gather through tables
+        # drain with gather-only rounds until every rank's shard is done
+        if getattr(self.model, "collective_predict", False):
             import jax
 
             if jax.process_count() > 1:

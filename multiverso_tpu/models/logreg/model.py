@@ -68,6 +68,11 @@ class Model:
 class LocalModel:
     """Weights as device arrays; one jitted step per minibatch."""
 
+    # multi-process lockstep-round capabilities (the LogReg driver drains
+    # ranks through join_round/join_predict_round only when set)
+    collective_rounds = False
+    collective_predict = False
+
     def __init__(self, config):
         self.config = config
         self.objective = make_objective(config)
@@ -184,6 +189,8 @@ class LocalModel:
     def save(self, uri: str) -> None:
         from multiverso_tpu.io.streams import as_stream
 
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return  # one writer (weights identical on every rank)
         stream, owned = as_stream(uri, "w")
         buf = _pyio.BytesIO()
         np.savez(buf, W=self.weights())
@@ -208,7 +215,17 @@ class LocalModel:
 
 class PSModel(LocalModel):
     """Weights in a sharded table; delta push per minibatch, pull every
-    ``sync_frequency`` batches, optional pipelined (double-buffered) pull."""
+    ``sync_frequency`` batches, optional pipelined (double-buffered) pull.
+
+    Multi-process (sparse input): every minibatch is a lockstep round —
+    ranks agree on a padded key bucket and push their deltas through one
+    stacked SPMD scatter (``add_rows_local``); the pull cadence counts
+    ROUNDS (identical on every rank, so the collective ``get`` stays
+    lockstep), and drained ranks keep joining with zero deltas
+    (``join_round``). The reference's N-worker deployment
+    (ps_model.cpp:12-67). Dense-input multi-process is rejected loudly
+    (per-rank full-delta adds need a per-client reduction path the sparse
+    protocol already provides)."""
 
     def __init__(self, config):
         super().__init__(config)
@@ -222,6 +239,7 @@ class PSModel(LocalModel):
         )
         self._since_pull = 0
         self._pipeline = bool(config.pipeline)
+        self.collective_rounds = jax.process_count() > 1
 
     def _pull(self) -> None:
         # pipelined pulls serve bounded-stale state in async mode and exact
@@ -239,10 +257,65 @@ class PSModel(LocalModel):
         losses = [self.train_batch(b) for b in batches]
         return float(np.mean([float(l) for l in losses]))
 
+    def _round_bucket(self, n: int):
+        """Cross-rank bucket agreement for one sparse-push round."""
+        from jax.experimental import multihost_utils
+
+        meta = multihost_utils.process_allgather(np.asarray([n], np.int32))
+        m = int(np.asarray(meta).max())
+        if m == 0:
+            return False, 0
+        lw = max(1, self.table.num_workers // jax.process_count())
+        b = lw
+        while b < m:
+            b <<= 1
+        return True, b
+
+    def _tick_pull(self) -> None:
+        """Round-counted pull cadence (ONE definition: ranks' collective
+        counts diverge silently if this logic forks)."""
+        self._since_pull += 1
+        if self._since_pull >= self.config.sync_frequency:
+            self._pull()
+            self._since_pull = 0
+
+    def _push_round(self, keys: np.ndarray, delta_rows: np.ndarray) -> bool:
+        """One lockstep push + round-counted pull (multi-process). Returns
+        False when the round was globally dry (nothing pushed anywhere)."""
+        any_data, bucket = self._round_bucket(len(keys))
+        if not any_data:
+            return False
+        ids = np.zeros(bucket, np.int64)
+        ids[: len(keys)] = keys
+        deltas = np.zeros((bucket, self.C), np.float32)
+        deltas[: len(keys)] = delta_rows
+        self.table.add_rows_local(ids, deltas)
+        self._tick_pull()
+        return True
+
+    def join_round(self) -> bool:
+        """Drained-rank participation in one training round. Returns False
+        when the round was globally dry (every rank finished)."""
+        return self._push_round(
+            np.zeros(0, np.int64), np.zeros((0, self.C), np.float32)
+        )
+
     def train_batch(self, batch: Dict[str, Any]) -> float:
         loss, grad = self._gradient(batch)  # grad: (C, F)
         lr = self.schedule.next_lr()
         delta_fm = np.asarray(lr * grad).T  # (F, C) feature-major
+        if self.collective_rounds:
+            # gate on key PRESENCE: a sparse batch may legitimately touch
+            # all F features (small vocab + big minibatch), and crashing
+            # one rank mid-epoch would hang the others in the allgather
+            CHECK("keys" in batch and len(batch["keys"]),
+                  "multi-process PS LogReg requires sparse batches (the "
+                  "lockstep round protocol pushes key buckets); dense X "
+                  "batches are single-process")
+            keys = np.asarray(batch["keys"], np.int64)
+            self._push_round(keys, -delta_fm[keys])
+            self.W = self.W - lr * grad
+            return float(loss)
         if "keys" in batch and len(batch["keys"]) and len(batch["keys"]) < self.F:
             keys = np.asarray(batch["keys"], np.int32)
             self.table.add_rows(keys, -delta_fm[keys])  # sparse push
@@ -250,10 +323,7 @@ class PSModel(LocalModel):
             self.table.add(-delta_fm)
         # apply locally too so we keep training between pulls
         self.W = self.W - lr * grad
-        self._since_pull += 1
-        if self._since_pull >= self.config.sync_frequency:
-            self._pull()
-            self._since_pull = 0
+        self._tick_pull()
         return float(loss)
 
     def save(self, uri: str) -> None:

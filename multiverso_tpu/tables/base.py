@@ -353,9 +353,13 @@ class DenseTable:
 
         from multiverso_tpu.io.streams import as_stream
 
+        storage = self.get()  # collective: every rank participates
+        state = self._state_logical()
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return  # one writer: ranks share the filesystem/path
         stream, owned = as_stream(uri_or_stream, "w")
         buf = _pyio.BytesIO()
-        np.savez(buf, storage=self.get(), **{f"state_{k}": v for k, v in self._state_logical().items()})
+        np.savez(buf, storage=storage, **{f"state_{k}": v for k, v in state.items()})
         stream.Write(buf.getvalue())
         stream.Flush()
         if owned:

@@ -90,6 +90,7 @@ class KVTable:
         self._scatter_local_fn = None  # per-rank (worker-sharded) programs
         self._gather_local_fn = None
         self._last_round_any = False  # latched by _round_bucket
+        self._replicate_fn = None  # cached items() all-gather program
 
     # ------------------------------------------------------------ internals
 
@@ -117,6 +118,7 @@ class KVTable:
         self._gather_fn = None
         self._scatter_local_fn = None
         self._gather_local_fn = None
+        self._replicate_fn = None
 
     def _check_keys(self, keys) -> np.ndarray:
         """Integer keys only — an API break vs the pre-round-2 dict-based
@@ -216,12 +218,13 @@ class KVTable:
             host = np.asarray(self._values)  # direct host copy, no replica
         else:
             # sharded global array: replicate (one SPMD all-gather every
-            # rank joins) before the host read
-            host = np.asarray(
-                jax.jit(lambda v: v, out_shardings=self._replicated)(
-                    self._values
+            # rank joins) before the host read; the jitted program is
+            # cached (a fresh lambda per call would recompile every time)
+            if self._replicate_fn is None:
+                self._replicate_fn = jax.jit(
+                    lambda v: v, out_shardings=self._replicated
                 )
-            )
+            host = np.asarray(self._replicate_fn(self._values))
         return keys, host[:n]
 
     # ------------------------------------------- per-process key rounds
@@ -400,7 +403,9 @@ class KVTable:
 
         from multiverso_tpu.io.streams import as_stream
 
-        keys, vals = self.items()
+        keys, vals = self.items()  # collective: every rank participates
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return  # one writer: ranks share the filesystem/path
         stream, owned = as_stream(uri_or_stream, "w")
         buf = _pyio.BytesIO()
         np.savez(buf, keys=keys, vals=vals)

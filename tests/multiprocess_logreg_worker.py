@@ -1,0 +1,63 @@
+"""Worker for the multi-process sparse PS-LogReg test
+(tests/test_multiprocess_e2e.py::test_two_process_ps_logreg).
+
+Each rank trains PSModel over the shared weight table with the round-3
+lockstep sparse-push protocol (bucketed add_rows_local rounds, round-
+counted pulls, dry-rank joins) — the reference's N-worker LogReg
+deployment (ref: Applications/LogisticRegression/src/model/ps_model.cpp:12-67).
+
+argv: <pid> <nproc> <coord> <train_file> <out.npz>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    train_file, out_path = sys.argv[4], sys.argv[5]
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.logreg import LogReg
+    from multiverso_tpu.models.logreg.config import Configure
+
+    mv.MV_Init(
+        [
+            "prog",
+            f"-coordinator={coord}",
+            f"-process_id={pid}",
+            f"-num_processes={nproc}",
+        ]
+    )
+    cfg = Configure(
+        input_size=200, output_size=1, sparse=True,
+        objective_type="sigmoid", updater_type="sgd",
+        learning_rate=0.1, learning_rate_coef=10000.0,
+        train_epoch=2, minibatch_size=32, sync_frequency=3,
+        train_file=train_file, test_file="",
+        output_model_file="", output_file="", show_time_per_sample=10**9,
+        use_ps=True, pipeline=False,
+    )
+    lr = LogReg(cfg)
+    loss = lr.Train()
+    assert np.isfinite(loss)
+    # final table state (collective get — every rank reads the same array)
+    W = lr.model.table.get()  # (F, C)
+    np.savez(out_path, W=W)
+    mv.MV_Barrier()
+    mv.MV_ShutDown()
+    print(f"WORKER_OK pid={pid} loss={loss:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
