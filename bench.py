@@ -384,9 +384,12 @@ def _bench_quality():
         generate_natural,
     )
 
-    tokens = int(os.environ.get("MV_BENCH_QUALITY_TOKENS", 60_000_000))
+    # sized so the whole leg stays ~6-8 min on the bench host (the torch
+    # slice leg dominates at ~100-200k pairs/s; QUALITY.md records a
+    # bigger 57M/9.5M run for the headline quality numbers)
+    tokens = int(os.environ.get("MV_BENCH_QUALITY_TOKENS", 40_000_000))
     slice_tokens = int(
-        os.environ.get("MV_BENCH_QUALITY_SLICE_TOKENS", 10_000_000)
+        os.environ.get("MV_BENCH_QUALITY_SLICE_TOKENS", 6_000_000)
     )
     ncfg = NaturalConfig(tokens=tokens, vocab_size=50_000)
     ids, d, qs, sims = generate_natural(ncfg)

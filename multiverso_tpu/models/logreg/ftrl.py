@@ -234,9 +234,13 @@ class FTRLModel:
         if self.hashed:
             self.kv.store(uri)  # (keys, zn) pairs — no dimension bound
             return
+        # non-hashed branches: dense PS is single-process by construction
+        # (CHECK in __init__) and local _zn is rank-local state — a
+        # rank-0-only write would silently drop other ranks' training
+        CHECK(jax.process_count() == 1,
+              "non-hashed FTRL state is process-local; multi-process "
+              "checkpoints require the hashed KV store (input_size=0)")
         zn = self.table.get() if self.table is not None else np.asarray(self._zn)
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            return  # one writer (the get above was the collective part)
         stream, owned = as_stream(uri, "w")
         buf = _pyio.BytesIO()
         np.savez(buf, zn=zn)

@@ -187,10 +187,18 @@ class LocalModel:
         return np.asarray(self.W)
 
     def save(self, uri: str) -> None:
+        # non-PS weights are RANK-LOCAL state: a rank-0-only write would
+        # silently discard every other rank's training — fail loudly
+        # (PSModel overrides: its pulled weights are globally agreed)
+        CHECK(jax.process_count() == 1,
+              "LocalModel.save under multi-process would keep only rank "
+              "0's independently-trained weights; use use_ps=true for "
+              "cross-process training with checkpoints")
+        self._write_weights(uri)
+
+    def _write_weights(self, uri: str) -> None:
         from multiverso_tpu.io.streams import as_stream
 
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            return  # one writer (weights identical on every rank)
         stream, owned = as_stream(uri, "w")
         buf = _pyio.BytesIO()
         np.savez(buf, W=self.weights())
@@ -257,20 +265,6 @@ class PSModel(LocalModel):
         losses = [self.train_batch(b) for b in batches]
         return float(np.mean([float(l) for l in losses]))
 
-    def _round_bucket(self, n: int):
-        """Cross-rank bucket agreement for one sparse-push round."""
-        from jax.experimental import multihost_utils
-
-        meta = multihost_utils.process_allgather(np.asarray([n], np.int32))
-        m = int(np.asarray(meta).max())
-        if m == 0:
-            return False, 0
-        lw = max(1, self.table.num_workers // jax.process_count())
-        b = lw
-        while b < m:
-            b <<= 1
-        return True, b
-
     def _tick_pull(self) -> None:
         """Round-counted pull cadence (ONE definition: ranks' collective
         counts diverge silently if this logic forks)."""
@@ -280,9 +274,13 @@ class PSModel(LocalModel):
             self._since_pull = 0
 
     def _push_round(self, keys: np.ndarray, delta_rows: np.ndarray) -> bool:
-        """One lockstep push + round-counted pull (multi-process). Returns
-        False when the round was globally dry (nothing pushed anywhere)."""
-        any_data, bucket = self._round_bucket(len(keys))
+        """One lockstep push (multi-process); the caller runs its local
+        apply and then _tick_pull, keeping the single-process order
+        push -> local apply -> pull (pulling first would hand back a table
+        that already contains this batch's delta and the local apply would
+        then double-step it). Returns False when the round was globally
+        dry (nothing pushed anywhere)."""
+        any_data, bucket = self.table.round_bucket(len(keys))
         if not any_data:
             return False
         ids = np.zeros(bucket, np.int64)
@@ -290,31 +288,36 @@ class PSModel(LocalModel):
         deltas = np.zeros((bucket, self.C), np.float32)
         deltas[: len(keys)] = delta_rows
         self.table.add_rows_local(ids, deltas)
-        self._tick_pull()
         return True
 
     def join_round(self) -> bool:
         """Drained-rank participation in one training round. Returns False
         when the round was globally dry (every rank finished)."""
-        return self._push_round(
+        if not self._push_round(
             np.zeros(0, np.int64), np.zeros((0, self.C), np.float32)
-        )
+        ):
+            return False
+        self._tick_pull()
+        return True
 
     def train_batch(self, batch: Dict[str, Any]) -> float:
         loss, grad = self._gradient(batch)  # grad: (C, F)
         lr = self.schedule.next_lr()
         delta_fm = np.asarray(lr * grad).T  # (F, C) feature-major
         if self.collective_rounds:
-            # gate on key PRESENCE: a sparse batch may legitimately touch
-            # all F features (small vocab + big minibatch), and crashing
-            # one rank mid-epoch would hang the others in the allgather
-            CHECK("keys" in batch and len(batch["keys"]),
+            # gate on key PRESENCE only: an EMPTY key set is a legitimate
+            # round (n=0 push, same as join_round) — crashing one rank for
+            # it would hang the others in the allgather. Dense X batches
+            # (identical shape everywhere, but per-rank full deltas) stay
+            # single-process.
+            CHECK("keys" in batch,
                   "multi-process PS LogReg requires sparse batches (the "
                   "lockstep round protocol pushes key buckets); dense X "
                   "batches are single-process")
             keys = np.asarray(batch["keys"], np.int64)
-            self._push_round(keys, -delta_fm[keys])
-            self.W = self.W - lr * grad
+            if self._push_round(keys, -delta_fm[keys]):
+                self.W = self.W - lr * grad
+                self._tick_pull()
             return float(loss)
         if "keys" in batch and len(batch["keys"]) and len(batch["keys"]) < self.F:
             keys = np.asarray(batch["keys"], np.int32)
@@ -327,9 +330,13 @@ class PSModel(LocalModel):
         return float(loss)
 
     def save(self, uri: str) -> None:
-        # ref ps_model Store: pull whole model first (ps_model.cpp:96-111)
+        # ref ps_model Store: pull whole model first (ps_model.cpp:96-111).
+        # The pull is collective (every rank joins); the pulled weights are
+        # identical everywhere, so ONE rank writes the file.
         self.W = jnp.asarray(self.table.get().T)
-        super().save(uri)
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        self._write_weights(uri)
 
     def load(self, uri: str) -> None:
         """Load-as-Add (ref: ps_model.cpp:113-168). The reference gates the

@@ -72,6 +72,35 @@ def test_ondevice_batch_masks_boundaries_and_subsample():
     assert not np.any(o[live, 0] == 0)
 
 
+def test_ondevice_pairs_never_span_markers():
+    """Round-3 semantics fix: word2vec windows live within one sentence
+    (pairgen.cpp:15); a pair whose center and context straddle a -1 marker
+    must be rejected even when BOTH endpoints are live tokens (round 2
+    only checked the endpoint). Corpus: 3-token sentences, each token
+    encodes its sentence id, window 5 — any live cross-sentence pair
+    would pair differing sentence ids."""
+    V = 400
+    cfg = SkipGramConfig(vocab_size=V, dim=8, negatives=2, window=5)
+    n_sent = 90
+    rows = np.zeros((n_sent, 4), np.int32)
+    for s in range(n_sent):
+        rows[s, :3] = s + 1  # tokens carry their sentence id (1-based)
+        rows[s, 3] = -1
+    corpus_np = rows.reshape(-1)
+    lut = _toy_lut(V)
+    fn = jax.jit(make_ondevice_batch_fn(cfg, batch=4096))
+    data = make_ondevice_data(cfg, corpus_np, None, lut, batch=4096)
+    c, o, w = fn(data, jax.random.PRNGKey(2))
+    c, t, w = np.asarray(c), np.asarray(o)[:, 0], np.asarray(w)
+    live = w > 0
+    assert live.any()
+    assert np.array_equal(c[live], t[live]), (
+        "cross-sentence pair leaked through the sentence-id mask"
+    )
+    # with window 5 > sentence length 3, most draws are rejected
+    assert live.mean() < 0.9
+
+
 def test_ondevice_offset_distribution_matches_word2vec():
     """Pair frequency at offset distance d must be proportional to
     P(eff >= d) = (W - d + 1) / W — word2vec emits all offsets in the
