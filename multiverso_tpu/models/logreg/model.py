@@ -58,11 +58,26 @@ class Model:
 
     @staticmethod
     def Get(config):
+        # fail FAST on configs whose checkpoint would be rejected at the
+        # end of training (the save-time CHECKs remain as backstops for
+        # direct save() calls): rank-local state cannot produce a
+        # meaningful multi-process checkpoint
+        mp = jax.process_count() > 1
         if config.updater_type == "ftrl" or config.objective_type == "ftrl":
             from multiverso_tpu.models.logreg.ftrl import FTRLModel
 
+            CHECK(not (mp and config.output_model_file
+                       and int(config.input_size) != 0),
+                  "multi-process non-hashed FTRL cannot write "
+                  "output_model_file (state is process-local); use "
+                  "input_size=0 (hashed KV store) or drop the checkpoint")
             return FTRLModel(config)
-        return PSModel(config) if config.use_ps else LocalModel(config)
+        if config.use_ps:
+            return PSModel(config)
+        CHECK(not (mp and config.output_model_file),
+              "multi-process non-PS LogReg cannot write output_model_file "
+              "(each rank's weights are rank-local); use use_ps=true")
+        return LocalModel(config)
 
 
 class LocalModel:

@@ -232,13 +232,12 @@ class MatrixTable(DenseTable):
         get_rows_local/add_rows_local round: (any_rank_has_rows, bucket).
         The bucket satisfies this table's divisibility rule (a multiple of
         the per-process worker extent — see _local_rows_prep) so callers
-        never re-encode it. Latches the liveness flag for dry-rank drain
-        loops (``last_round_had_data``), mirroring KVTable."""
+        never re-encode it; the returned flag doubles as the dry-round
+        drain signal."""
         from jax.experimental import multihost_utils
 
         meta = multihost_utils.process_allgather(np.asarray([n_own], np.int32))
         m = int(np.asarray(meta).max())
-        self._last_round_any = m > 0
         if m == 0:
             return False, 0
         lw = max(1, self.num_workers // jax.process_count())
@@ -246,9 +245,6 @@ class MatrixTable(DenseTable):
         while b < m:
             b <<= 1
         return True, b
-
-    def last_round_had_data(self) -> bool:
-        return getattr(self, "_last_round_any", False)
 
     def _local_rows_prep(self, row_ids) -> Tuple[np.ndarray, Any]:
         """Validate a process-local id vector and lift it to the global

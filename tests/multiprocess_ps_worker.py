@@ -13,8 +13,10 @@ mode=same : every rank trains the FULL corpus (identical blocks). With
             delta averaging by num_workers this must reproduce the
             single-process PS run bit-for-bit up to reduction order — the
             exactness probe the driver checks against a golden run.
-mode=shard: rank0 takes 60% of the corpus, rank1 40% (unequal block
-            counts force dry-rank lockstep rounds at the tail).
+mode=shard: uneven shards (weights nproc..1) force dry-rank lockstep
+            rounds at the tail.
+mode=shard_adagrad: same, with -use_adagrad (the g2 accumulator tables
+            ride the bucket protocol; ref communicator.cpp:17-31).
 """
 
 import os
@@ -57,7 +59,7 @@ def main():
     d.word2id = {w: i for i, w in enumerate(d.words)}
     d.counts = np.bincount(ids[ids >= 0], minlength=V).astype(np.int64)
 
-    if mode == "shard":
+    if mode.startswith("shard"):
         # uneven shards (weights nproc..1): block counts differ per rank,
         # forcing dry-rank lockstep rounds at the tail
         wts = np.arange(nproc, 0, -1, dtype=np.float64)
@@ -68,6 +70,7 @@ def main():
         size=16, negative=3, window=2, batch_size=128, steps_per_call=2,
         epoch=1, sample=0, min_count=0, output_file="", use_ps=True,
         is_pipeline=False, train_file="unused",
+        use_adagrad=mode.endswith("adagrad"),
     )
     we = WordEmbedding(opt, dictionary=d)
     loss = we.train(ids=ids)

@@ -128,18 +128,22 @@ print("GOLDEN_OK")
     assert np.abs(g).max() > 1e-3  # training actually moved the tables
 
 
-@pytest.mark.parametrize("nproc", [2, 4])
-def test_ps_wordembedding_sharded_corpus(tmp_path, nproc):
+@pytest.mark.parametrize("nproc,mode", [
+    (2, "shard"), (4, "shard"), (2, "shard_adagrad"),
+])
+def test_ps_wordembedding_sharded_corpus(tmp_path, nproc, mode):
     """Unequal corpus shards: block counts differ per rank, so the tail
     rounds run with dry ranks pushing zero deltas (the lockstep protocol).
-    All ranks must finish and agree on the final tables."""
+    All ranks must finish and agree on the final tables; the adagrad
+    variant routes the two g2 accumulator tables through the same rounds
+    (round-2 gap item 7, cross-process leg)."""
     import numpy as np
 
     corpus_path, _ = _ps_corpus(tmp_path)
     outs = [tmp_path / f"emb_{i}.npy" for i in range(nproc)]
     logs = _run_cluster(
         "multiprocess_ps_worker.py",
-        lambda i: [corpus_path, outs[i], "shard"],
+        lambda i: [corpus_path, outs[i], mode],
         nproc=nproc,
         timeout=300,
     )
