@@ -35,6 +35,7 @@ class LogReg:
     def Train(self) -> float:
         """Run ``train_epoch`` epochs; returns the final epoch's mean loss."""
         cfg = self.config
+        Model.check_trainable(cfg, self.model)  # un-checkpointable? fail NOW
         last_epoch_loss = 0.0
         # superbatch grouping: scan S same-shape minibatches per dispatch
         # when the model supports it (local models; PS steps singly)

@@ -58,26 +58,33 @@ class Model:
 
     @staticmethod
     def Get(config):
-        # fail FAST on configs whose checkpoint would be rejected at the
-        # end of training (the save-time CHECKs remain as backstops for
-        # direct save() calls): rank-local state cannot produce a
-        # meaningful multi-process checkpoint
-        mp = jax.process_count() > 1
         if config.updater_type == "ftrl" or config.objective_type == "ftrl":
             from multiverso_tpu.models.logreg.ftrl import FTRLModel
 
-            CHECK(not (mp and config.output_model_file
-                       and int(config.input_size) != 0),
+            return FTRLModel(config)
+        return PSModel(config) if config.use_ps else LocalModel(config)
+
+    @staticmethod
+    def check_trainable(config, model) -> None:
+        """Fail FAST — at TRAIN start, not after the epochs — on configs
+        whose end-of-training checkpoint would be rejected (rank-local
+        state cannot produce a meaningful multi-process checkpoint). Not
+        enforced at construction: inference-only multi-process jobs (Test
+        with init_model_file) never save and must keep working with the
+        default non-empty output_model_file."""
+        if jax.process_count() == 1 or not config.output_model_file:
+            return
+        from multiverso_tpu.models.logreg.ftrl import FTRLModel
+
+        if isinstance(model, FTRLModel):
+            CHECK(model.hashed,
                   "multi-process non-hashed FTRL cannot write "
                   "output_model_file (state is process-local); use "
                   "input_size=0 (hashed KV store) or drop the checkpoint")
-            return FTRLModel(config)
-        if config.use_ps:
-            return PSModel(config)
-        CHECK(not (mp and config.output_model_file),
+            return
+        CHECK(isinstance(model, PSModel),
               "multi-process non-PS LogReg cannot write output_model_file "
               "(each rank's weights are rank-local); use use_ps=true")
-        return LocalModel(config)
 
 
 class LocalModel:

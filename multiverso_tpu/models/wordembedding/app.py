@@ -863,7 +863,13 @@ class WordEmbedding:
 
     def save_embeddings(self, path: str, binary: bool = False) -> None:
         """word2vec format (ref: distributed_wordembedding.cpp:263-306
-        SaveEmbedding, text and -binary variants)."""
+        SaveEmbedding, text and -binary variants). Multi-process: the
+        trained embeddings are identical on every rank (SPMD global
+        arrays / collective table pulls), so ONE rank writes the file
+        instead of racing them over one path (gate BEFORE the device->host
+        materialisation: non-writers skip the copy)."""
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
         emb = self.embeddings()
         V, D = emb.shape
         with open(path, "wb") as f:

@@ -66,9 +66,14 @@ def main():
         cuts = np.floor(np.cumsum(wts / wts.sum()) * len(ids)).astype(int)[:-1]
         ids = np.split(ids, cuts)[pid]
 
+    # mode=same also exercises the multi-process embedding save: every
+    # rank passes the SAME path (derived from the shared corpus file);
+    # exactly one writes it (app.save_embeddings gates on rank 0 — the
+    # trained tables are identical everywhere)
+    w2v_path = corpus_path + ".w2v" if mode == "same" else ""
     opt = WEOptions(
         size=16, negative=3, window=2, batch_size=128, steps_per_call=2,
-        epoch=1, sample=0, min_count=0, output_file="", use_ps=True,
+        epoch=1, sample=0, min_count=0, output_file=w2v_path, use_ps=True,
         is_pipeline=False, train_file="unused",
         use_adagrad=mode.endswith("adagrad"),
     )

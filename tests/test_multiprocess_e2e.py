@@ -126,6 +126,11 @@ print("GOLDEN_OK")
     # identical blocks + /num_workers averaging == the single-client rounds
     np.testing.assert_allclose(e0, g, atol=1e-4)
     assert np.abs(g).max() > 1e-3  # training actually moved the tables
+    # the shared output path was written exactly once (rank-0 gate) and
+    # carries a valid word2vec header
+    with open(str(corpus_path) + ".w2v") as fh:
+        header = fh.readline().split()
+    assert header == [str(e0.shape[0]), str(e0.shape[1])], header
 
 
 @pytest.mark.parametrize("nproc,mode", [
