@@ -21,8 +21,29 @@ import time
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
+# jax imports are DEFERRED: under a wedged tunnel even `import jax` can
+# block forever inside the site hook's device registration, so main()
+# probes the backend in a throwaway subprocess before this process ever
+# touches jax (_probe_backend); _Lazy resolves on first attribute use.
+
+
+class _Lazy:
+    def __init__(self, modname):
+        self._modname = modname
+        self._mod = None
+
+    def __getattr__(self, name):
+        if self._mod is None:
+            import importlib
+
+            object.__setattr__(
+                self, "_mod", importlib.import_module(self._modname)
+            )
+        return getattr(self._mod, name)
+
+
+jax = _Lazy("jax")
+jnp = _Lazy("jax.numpy")
 
 
 def _zipf_counts(vocab_size):
@@ -474,9 +495,53 @@ def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
     return batch * steps / dt
 
 
+def _probe_backend(timeout_s: int = 180):
+    """The bench host's TPU rides a shared tunnel that can wedge so hard
+    even jax.devices() blocks forever in a fresh process (observed
+    2026-07-30, hours-long outage). Probe it in a subprocess first so the
+    driver gets an honest one-line error instead of a hung run. Returns
+    None when healthy, else a human-readable reason (a hang and a crash
+    point at different culprits — tunnel vs install)."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        r = subprocess.run(
+            [_sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return (f"jax.devices() hung >{timeout_s}s in a fresh process — "
+                "accelerator tunnel outage")
+    if r.returncode != 0:
+        return (f"jax backend init crashed (rc={r.returncode}): "
+                f"{r.stderr.strip()[-400:]}")
+    return None
+
+
 def main():
+    import sys as _sys
+
+    reason = _probe_backend()
+    if reason is not None:
+        print(json.dumps({
+            "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
+            "value": 0,
+            "unit": "pairs/sec",
+            "error": reason + "; see BENCH_r02.json / benchmarks/*.md for "
+                     "the last measured numbers",
+        }))
+        return
+
     import multiverso_tpu as mv
     from multiverso_tpu.models.wordembedding.skipgram import SkipGramConfig
+
+    def leg(name, fn):
+        # progressive evidence: if a later leg dies/hangs, the completed
+        # legs' numbers survive in the driver's captured stderr
+        out = fn()
+        print(f"# leg {name}: {out}", file=_sys.stderr, flush=True)
+        return out
 
     mv.MV_Init(["-updater_type=sgd"])
     cfg = SkipGramConfig(vocab_size=100_000, dim=128, negatives=5)
@@ -485,14 +550,16 @@ def main():
     # uniform-id legs keep their round-1 key names/semantics so rounds stay
     # comparable, and vs_baseline divides same-distribution (uniform) legs —
     # the architecture ratio, not the distribution change.
-    fused = _bench_fused(cfg, skewed=True)
-    fused_uniform = _bench_fused(cfg)
-    fused_unsorted = _bench_fused(cfg, presort=False)
-    ondevice = _bench_ondevice(cfg)
-    ps = _bench_ps_loop(cfg)
-    multidev = _bench_multidevice()
-    e2e = _bench_e2e()
-    quality = _bench_quality()
+    fused = leg("fused_skewed", lambda: _bench_fused(cfg, skewed=True))
+    fused_uniform = leg("fused_uniform", lambda: _bench_fused(cfg))
+    fused_unsorted = leg(
+        "fused_unsorted", lambda: _bench_fused(cfg, presort=False)
+    )
+    ondevice = leg("ondevice", lambda: _bench_ondevice(cfg))
+    ps = leg("ps_loop", lambda: _bench_ps_loop(cfg))
+    multidev = leg("multidevice", _bench_multidevice)
+    e2e = leg("e2e", _bench_e2e)
+    quality = leg("quality", _bench_quality)
     out = {
         "metric": "skipgram_ns_train_pairs_per_sec_per_chip",
         "value": round(fused, 1),

@@ -863,13 +863,19 @@ class WordEmbedding:
 
     def save_embeddings(self, path: str, binary: bool = False) -> None:
         """word2vec format (ref: distributed_wordembedding.cpp:263-306
-        SaveEmbedding, text and -binary variants). Multi-process: the
-        trained embeddings are identical on every rank (SPMD global
-        arrays / collective table pulls), so ONE rank writes the file
-        instead of racing them over one path (gate BEFORE the device->host
-        materialisation: non-writers skip the copy)."""
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            return
+        SaveEmbedding, text and -binary variants). Multi-process: ONE rank
+        writes the file instead of racing them over one path (gate BEFORE
+        the device->host materialisation: non-writers skip the copy). The
+        identical-on-every-rank property only holds for PS mode (shared
+        tables); fused-path params are rank-local, so a rank-0-only write
+        would silently drop other ranks' training — fail loudly there."""
+        if jax.process_count() > 1:
+            CHECK(self.opt.use_ps,
+                  "multi-process save_embeddings requires -use_ps (fused "
+                  "params are rank-local; only the shared tables give "
+                  "every rank identical embeddings to checkpoint)")
+            if jax.process_index() != 0:
+                return
         emb = self.embeddings()
         V, D = emb.shape
         with open(path, "wb") as f:
