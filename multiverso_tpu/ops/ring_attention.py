@@ -46,6 +46,9 @@ __all__ = [
     "ring_attention_local",
     "ulysses_attention",
     "ulysses_attention_local",
+    "zigzag_layout",
+    "zigzag_ring_attention",
+    "zigzag_ring_attention_local",
 ]
 
 _NEG_INF = float("-inf")
@@ -181,6 +184,118 @@ def ring_attention_local(
     return out.astype(q.dtype)
 
 
+def zigzag_ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """SPMD body: CAUSAL ring attention with the zigzag chunk layout.
+
+    Plain causal ring attention is load-imbalanced: device 0's queries can
+    attend only to block 0, so it skips n-1 of its n tiles while device
+    n-1 computes all of them — the ring's wall-clock is set by the busiest
+    device and ~half the fleet idles. The zigzag layout splits the
+    sequence into 2n chunks and gives device d the PAIR (d, 2n-1-d); on
+    every OFF-DIAGONAL (device, step) pair the masked-in score area is
+    then EXACTLY 2c² (c = chunk length; the one local step is 2c²+c —
+    see test_zigzag_layout_balances_causal_work) — each tile half-masked,
+    no skipped tiles, no idle devices (the llama3-style context-parallel
+    balancing).
+
+    Local q/k/v are the zigzag-ordered blocks (B, 2c, H, D); the causal
+    mask is computed from global POSITIONS — correct for any layout by
+    construction. The rotating block's positions are derived locally from
+    the step index (after ``step`` rotations the block came from device
+    ``(my - step) mod n``), so the ring moves exactly two collectives per
+    step, like the plain layout.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    B, Sq, H, D = q.shape
+    c = Sq // 2
+    qf = q.astype(jnp.float32) * scale
+    ar = jnp.arange(c)
+
+    def pos_of(dev):
+        return jnp.concatenate([dev * c + ar, (2 * n - 1 - dev) * c + ar])
+
+    q_pos = pos_of(my)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def tile(m, l, acc, k_blk, v_blk, kv_pos):
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
+        mask = kv_pos[None, :] <= q_pos[:, None]  # (Sq, Sk)
+        mask = jnp.broadcast_to(mask[None, :, None, :], s.shape)
+        return _tile_update(m, l, acc, s, v_blk, mask)
+
+    m, l, acc = tile(
+        jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
+        jnp.zeros((B, Sq, H), jnp.float32),
+        jnp.zeros((B, Sq, H, D), jnp.float32),
+        k,
+        v,
+        q_pos,  # local K/V share the local layout
+    )
+
+    def body(carry, step):
+        m, l, acc, k_blk, v_blk = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        m, l, acc = tile(m, l, acc, k_blk, v_blk, pos_of((my - step) % n))
+        return (m, l, acc, k_blk, v_blk), ()
+
+    if n > 1:
+        (m, l, acc, _, _), _ = lax.scan(
+            body, (m, l, acc, k, v), jnp.arange(1, n)
+        )
+    out = acc / jnp.maximum(l, 1e-37)[..., None]
+    return out.astype(q.dtype)
+
+
+def zigzag_layout(seq_len: int, n_dev: int):
+    """(zigzag_order, inverse) index vectors: position j of the reordered
+    sequence holds original position ``order[j]``; ``x[order][inverse]``
+    restores the original order."""
+    import numpy as np
+
+    if seq_len % (2 * n_dev):
+        raise ValueError(
+            f"zigzag needs seq len divisible by 2*n_dev ({2 * n_dev}), got "
+            f"{seq_len}"
+        )
+    c = seq_len // (2 * n_dev)
+    order = np.concatenate([
+        np.r_[d * c:(d + 1) * c, (2 * n_dev - 1 - d) * c:(2 * n_dev - d) * c]
+        for d in range(n_dev)
+    ])
+    return order, np.argsort(order)
+
+
+def zigzag_ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Global-array entry point: load-balanced CAUSAL ring attention.
+    Reorders the sequence into the zigzag layout, shards over
+    ``seq_axis``, and restores the original order on the way out (inputs
+    and outputs use the natural sequence order — the layout is an
+    internal detail)."""
+    n = int(mesh.shape[seq_axis])
+    order, inverse = zigzag_layout(q.shape[1], n)
+    return _wrap(
+        mesh, seq_axis, zigzag_ring_attention_local, q, k, v, scale,
+        order=order, inverse=inverse,
+    )
+
+
 def ulysses_attention_local(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -206,23 +321,38 @@ def ulysses_attention_local(
     return a2a(out, split_axis=1, concat_axis=2)
 
 
-def _wrap(mesh: Mesh, seq_axis: str, local_fn, q, k, v, causal, scale):
+def _wrap(mesh: Mesh, seq_axis: str, local_fn, q, k, v, scale,
+          order=None, inverse=None, **local_kw):
+    """Shared global-array wrapper: validate, (optionally) permute the
+    sequence, shard over ``seq_axis``, run the SPMD body, and restore the
+    original order. ``order``/``inverse`` are the zigzag hooks."""
     n = int(mesh.shape[seq_axis])
     for name, arr in (("q", q), ("k", k), ("v", v)):
+        if arr.shape[1] != q.shape[1]:
+            raise ValueError(
+                f"{name} seq len {arr.shape[1]} != q seq len {q.shape[1]} "
+                "(self-attention sequence parallelism needs equal lengths)"
+            )
         if arr.shape[1] % n:
             raise ValueError(
                 f"{name} seq len {arr.shape[1]} not divisible by {n} devices"
             )
     spec = P(None, seq_axis, None, None)
     fn = jax.shard_map(
-        functools.partial(local_fn, axis_name=seq_axis, causal=causal, scale=scale),
+        functools.partial(
+            local_fn, axis_name=seq_axis, scale=scale, **local_kw
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
     )
     sharding = NamedSharding(mesh, spec)
-    return fn(jax.device_put(q, sharding), jax.device_put(k, sharding),
-              jax.device_put(v, sharding))
+    args = [
+        jax.device_put(x if order is None else x[:, order], sharding)
+        for x in (q, k, v)
+    ]
+    out = fn(*args)
+    return out if inverse is None else out[:, inverse]
 
 
 def ring_attention(
@@ -236,7 +366,8 @@ def ring_attention(
 ) -> jnp.ndarray:
     """Global-array entry point: shards (B,S,H,D) inputs over ``seq_axis``
     of ``mesh`` and runs blockwise ring attention."""
-    return _wrap(mesh, seq_axis, ring_attention_local, q, k, v, causal, scale)
+    return _wrap(mesh, seq_axis, ring_attention_local, q, k, v, scale,
+                 causal=causal)
 
 
 def ulysses_attention(
@@ -253,4 +384,5 @@ def ulysses_attention(
     n = int(mesh.shape[seq_axis])
     if q.shape[2] % n:
         raise ValueError(f"num_heads {q.shape[2]} not divisible by {n} devices")
-    return _wrap(mesh, seq_axis, ulysses_attention_local, q, k, v, causal, scale)
+    return _wrap(mesh, seq_axis, ulysses_attention_local, q, k, v, scale,
+                 causal=causal)
