@@ -1,0 +1,80 @@
+"""Long-context sequence parallelism demo: ring / zigzag / Ulysses.
+
+Runs the three context-parallel attention schemes over a sequence-sharded
+mesh and checks each against the dense oracle, then prints the causal
+load-balance profile that motivates the zigzag layout. Works on any
+device set; on a machine without accelerators, force a virtual mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context_attention.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from multiverso_tpu.ops import (
+    attention_reference,
+    ring_attention,
+    ulysses_attention,
+    zigzag_layout,
+    zigzag_ring_attention,
+)
+
+
+def main():
+    devs = np.asarray(jax.devices())
+    n = len(devs)
+    mesh = Mesh(devs, ("sp",))
+    B, S, H, D = 2, 64 * n, 4 * n, 32  # H multiple of n: ulysses-safe on any mesh
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(B, S, H, D).astype(np.float32)) for _ in range(3)
+    )
+    ref = attention_reference(q, k, v, causal=True)
+    print(f"mesh: {n} device(s), sequence {S} sharded over 'sp'")
+    for name, fn in (
+        ("ring (causal)", lambda: ring_attention(q, k, v, mesh, "sp", causal=True)),
+        ("zigzag (balanced causal)", lambda: zigzag_ring_attention(q, k, v, mesh, "sp")),
+        ("ulysses (causal)", lambda: ulysses_attention(q, k, v, mesh, "sp", causal=True)),
+    ):
+        out = fn()
+        err = float(jnp.max(jnp.abs(out - ref)))
+        print(f"  {name:26s} max|err| vs dense oracle = {err:.2e}")
+
+    # why zigzag: per-(device, ring step) live score area under the plain
+    # vs zigzag layouts (rows = query device, cols = kv source device)
+    c2 = S // n
+    plain = np.zeros((n, n), np.int64)
+    for d in range(n):
+        for s in range(n):
+            qp = d * c2 + np.arange(c2)
+            kp = s * c2 + np.arange(c2)
+            plain[d, s] = int((kp[None, :] <= qp[:, None]).sum())
+    order, _ = zigzag_layout(S, n)
+    pos = order.reshape(n, -1)
+    zz = np.zeros((n, n), np.int64)
+    for d in range(n):
+        for s in range(n):
+            zz[d, s] = int((pos[s][None, :] <= pos[d][:, None]).sum())
+    print("\nplain causal layout live-area per (device, step):")
+    print(plain)
+    print("per-device totals (imbalance!):", plain.sum(axis=1))
+    print("\nzigzag layout live-area per (device, step):")
+    print(zz)
+    print("per-device totals (balanced):", zz.sum(axis=1))
+
+
+if __name__ == "__main__":
+    main()

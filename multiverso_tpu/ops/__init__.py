@@ -12,6 +12,9 @@ from multiverso_tpu.ops.ring_attention import (
     ring_attention_local,
     ulysses_attention,
     ulysses_attention_local,
+    zigzag_layout,
+    zigzag_ring_attention,
+    zigzag_ring_attention_local,
 )
 from multiverso_tpu.ops.scatter import scatter_add_rows, segment_combine_rows
 
@@ -23,4 +26,7 @@ __all__ = [
     "ring_attention_local",
     "ulysses_attention",
     "ulysses_attention_local",
+    "zigzag_layout",
+    "zigzag_ring_attention",
+    "zigzag_ring_attention_local",
 ]

@@ -197,19 +197,30 @@ def zigzag_ring_attention_local(
     attend only to block 0, so it skips n-1 of its n tiles while device
     n-1 computes all of them — the ring's wall-clock is set by the busiest
     device and ~half the fleet idles. The zigzag layout splits the
-    sequence into 2n chunks and gives device d the PAIR (d, 2n-1-d); on
-    every OFF-DIAGONAL (device, step) pair the masked-in score area is
-    then EXACTLY 2c² (c = chunk length; the one local step is 2c²+c —
-    see test_zigzag_layout_balances_causal_work) — each tile half-masked,
-    no skipped tiles, no idle devices (the llama3-style context-parallel
-    balancing).
+    sequence into 2n chunks and gives device d the PAIR (d, 2n-1-d);
+    every off-diagonal (device, step) then has EXACTLY 2c² of live score
+    area (c = chunk length; the one local step adds its diagonal,
+    2c²+c — see test_zigzag_layout_balances_causal_work), and — the
+    actual wall-clock win — the live area is exactly TWO of the four
+    c×c chunk pairs, fully live, so each step computes ONLY those
+    sub-tiles with no masks at all:
 
-    Local q/k/v are the zigzag-ordered blocks (B, 2c, H, D); the causal
-    mask is computed from global POSITIONS — correct for any layout by
-    construction. The rotating block's positions are derived locally from
-    the step index (after ``step`` rotations the block came from device
-    ``(my - step) mod n``), so the ring moves exactly two collectives per
-    step, like the plain layout.
+    * kv source src < my: the live pairs are (q_low, k_low) and
+      (q_high, k_low) — one (2c x c) tile against the low kv chunk;
+    * src > my: (q_high, k_low) and (q_high, k_high) — one (c x 2c)
+      tile for the high query chunk.
+
+    Per device per step that is 2c²·D useful FLOPs — half the full-tile
+    cost, matching plain causal ring's BUSIEST rank's useful work while
+    every rank stays busy (the llama3-style context-parallel balancing).
+    Deliberately a separate body from ``ring_attention_local``: the two
+    variants share the streaming-softmax fold (``_tile_update``) but tile
+    the score space differently (masked full tiles vs unmasked live
+    sub-tiles), and merging them would entangle both control flows.
+
+    Local q/k/v are the zigzag-ordered blocks (B, 2c, H, D). The ring
+    moves exactly two collectives per step (the rotating block's source
+    is derived locally from the step index).
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
@@ -219,33 +230,51 @@ def zigzag_ring_attention_local(
     c = Sq // 2
     qf = q.astype(jnp.float32) * scale
     ar = jnp.arange(c)
-
-    def pos_of(dev):
-        return jnp.concatenate([dev * c + ar, (2 * n - 1 - dev) * c + ar])
-
-    q_pos = pos_of(my)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def tile(m, l, acc, k_blk, v_blk, kv_pos):
-        s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
-        mask = kv_pos[None, :] <= q_pos[:, None]  # (Sq, Sk)
-        mask = jnp.broadcast_to(mask[None, :, None, :], s.shape)
-        return _tile_update(m, l, acc, s, v_blk, mask)
-
-    m, l, acc = tile(
+    # local step: both chunk pairs of one device — position-masked full tile
+    q_pos = jnp.concatenate([my * c + ar, (2 * n - 1 - my) * c + ar])
+    s0 = jnp.einsum("bqhd,bkhd->bqhk", qf, k.astype(jnp.float32))
+    mask0 = jnp.broadcast_to(
+        (q_pos[None, :] <= q_pos[:, None])[None, :, None, :], s0.shape
+    )
+    m, l, acc = _tile_update(
         jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
         jnp.zeros((B, Sq, H), jnp.float32),
         jnp.zeros((B, Sq, H, D), jnp.float32),
-        k,
+        s0,
         v,
-        q_pos,  # local K/V share the local layout
+        mask0,
     )
+
+    def low_kv(ops):
+        # src < my: every local query attends the incoming LOW chunk only
+        m, l, acc, kb, vb = ops
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, kb[:, :c].astype(jnp.float32))
+        return _tile_update(m, l, acc, s, vb[:, :c], None)
+
+    def high_q(ops):
+        # src > my: only the local HIGH query chunk attends, but to both
+        # incoming chunks — update that row slice of the running state
+        m, l, acc, kb, vb = ops
+        s = jnp.einsum(
+            "bqhd,bkhd->bqhk", qf[:, c:], kb.astype(jnp.float32)
+        )
+        m2, l2, acc2 = _tile_update(m[:, c:], l[:, c:], acc[:, c:], s, vb, None)
+        return (
+            jnp.concatenate([m[:, :c], m2], axis=1),
+            jnp.concatenate([l[:, :c], l2], axis=1),
+            jnp.concatenate([acc[:, :c], acc2], axis=1),
+        )
 
     def body(carry, step):
         m, l, acc, k_blk, v_blk = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        m, l, acc = tile(m, l, acc, k_blk, v_blk, pos_of((my - step) % n))
+        src = (my - step) % n
+        m, l, acc = lax.cond(
+            src < my, low_kv, high_q, (m, l, acc, k_blk, v_blk)
+        )
         return (m, l, acc, k_blk, v_blk), ()
 
     if n > 1:
@@ -292,7 +321,7 @@ def zigzag_ring_attention(
     order, inverse = zigzag_layout(q.shape[1], n)
     return _wrap(
         mesh, seq_axis, zigzag_ring_attention_local, q, k, v, scale,
-        order=order, inverse=inverse,
+        order=order, inverse=inverse, require_equal_seq=True,
     )
 
 
@@ -322,16 +351,20 @@ def ulysses_attention_local(
 
 
 def _wrap(mesh: Mesh, seq_axis: str, local_fn, q, k, v, scale,
-          order=None, inverse=None, **local_kw):
+          order=None, inverse=None, require_equal_seq=False, **local_kw):
     """Shared global-array wrapper: validate, (optionally) permute the
     sequence, shard over ``seq_axis``, run the SPMD body, and restore the
-    original order. ``order``/``inverse`` are the zigzag hooks."""
+    original order. ``order``/``inverse`` are the zigzag hooks;
+    ``require_equal_seq`` is for layouts derived from q's length (zigzag)
+    — plain ring/Ulysses support cross-attention with k/v longer or
+    shorter than q, so they only need per-input divisibility."""
     n = int(mesh.shape[seq_axis])
     for name, arr in (("q", q), ("k", k), ("v", v)):
-        if arr.shape[1] != q.shape[1]:
+        if require_equal_seq and arr.shape[1] != q.shape[1]:
             raise ValueError(
                 f"{name} seq len {arr.shape[1]} != q seq len {q.shape[1]} "
-                "(self-attention sequence parallelism needs equal lengths)"
+                "(the zigzag layout is built from q's length — "
+                "self-attention only)"
             )
         if arr.shape[1] % n:
             raise ValueError(

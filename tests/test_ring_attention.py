@@ -158,3 +158,25 @@ def test_zigzag_layout_balances_causal_work():
     # diagonal: the two local triangles + one full chunk pair
     diag_expected = c * (c + 1) // 2 * 2 + c * c
     assert (np.diag(areas) == diag_expected).all(), areas
+
+
+def test_ring_cross_attention_unequal_lengths():
+    """Non-causal ring attention supports cross-attention: k/v longer than
+    q (memory attention) — a regression guard for the wrapper validation
+    (only the zigzag layout requires equal lengths)."""
+    from multiverso_tpu.ops.ring_attention import (
+        attention_reference,
+        ring_attention,
+    )
+
+    mesh = _mesh()
+    n = len(jax.devices())
+    rng = np.random.RandomState(9)
+    B, H, D = 2, 2, 16
+    Sq, Sk = 8 * n, 16 * n
+    q = jnp.asarray(rng.randn(B, Sq, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, Sk, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, Sk, H, D).astype(np.float32))
+    out = ring_attention(q, k, v, mesh, "sp", causal=False)
+    ref = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
