@@ -108,6 +108,14 @@ MV_DEFINE_bool(
     "presort on device, zero per-step host traffic (NS skip-gram runs the "
     "tuned sorted-scatter step; CBOW/HS/AdaGrad use the general step)",
 )
+MV_DEFINE_string(
+    "walk", "perm",
+    "device-pipeline center selection: perm (default — without-replacement "
+    "epoch-permutation walk, every kept position visited once per n_valid "
+    "draws, the reference ParseSentence every-position-trains guarantee) | "
+    "iid (with-replacement uniform draws; ~63% distinct coverage per "
+    "epoch, measurably worse quality — benchmarks/QUALITY.md)",
+)
 
 
 @dataclasses.dataclass
@@ -139,6 +147,7 @@ class WEOptions:
     use_ps: bool = False
     presort: bool = True
     device_pipeline: bool = False
+    walk: str = "perm"
     seed: int = 1
 
     @classmethod
@@ -601,10 +610,11 @@ class WordEmbedding:
             if scale_tables else None
         )
         keep_dev = jnp.asarray(keep.astype(np.float32)) if o.sample > 0 else None
+        use_walk = o.walk == "perm"
         prepare = jax.jit(
             make_ondevice_prepare_fn(
                 self.cfg, o.batch_size, subsample=o.sample > 0,
-                scale_tables=scale_tables,
+                scale_tables=scale_tables, walk=use_walk,
             )
         )
         prep_key = jax.random.PRNGKey(o.seed ^ 0x5EED5)
@@ -652,6 +662,7 @@ class WordEmbedding:
         for epoch in range(o.epoch):
             if epoch > 0:
                 data, n_valid = epoch_data(epoch)
+            walk_t = 0  # fresh per-epoch permutation; cursor restarts
             epoch_target = max(1, n_valid * per_kept)
             epoch_done = 0
             accepted_dev = jnp.float32(0.0)
@@ -671,6 +682,11 @@ class WordEmbedding:
                 projected = pairs_done + ppc * (calls - synced_calls)
                 lr = self._lr(min(projected, total_pairs) / total_pairs)
                 key, sub = jax.random.split(key)
+                if use_walk:
+                    # host-side cursor: the dispatch consumes per_call
+                    # permutation slots; one scalar leaf swap, no re-upload
+                    data["walk_t"] = np.int32(walk_t)
+                    walk_t = (walk_t + per_call) % max(n_valid, 1)
                 self.params, (loss_dev, acc) = superstep(
                     self.params, data, sub, jnp.float32(lr)
                 )
@@ -770,6 +786,8 @@ class WordEmbedding:
               "-scale_mode=row_mean_exact exists only for -device_pipeline "
               "(the host presort path computes realized counts already — "
               "use row_mean there)")
+        CHECK(o.walk in ("perm", "iid"),
+              "-walk must be 'perm' or 'iid', got '%s'" % o.walk)
         if o.device_pipeline:
             return self._train_ondevice(ids, keep)
         def make_pipeline(shard_ids, seed):

@@ -648,6 +648,7 @@ def make_ondevice_data(
     scale_mode: str = "row_mean",
     neg_probs: Optional[np.ndarray] = None,
     huffman=None,
+    walk_seed: Optional[int] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Device-resident data pytree for the on-device step builders.
 
@@ -680,12 +681,20 @@ def make_ondevice_data(
         "corpus": corpus_dev,
         "valid_pos": jnp.asarray(valid),
         "n_valid": jnp.asarray(np.int32(valid.size)),
-        # sentence ids (markers bump the count): the samplers' one-gather
-        # never-span-a-marker test. Derived ON DEVICE from the corpus
-        # buffer that uploads anyway — a host-side cumsum would ship a
-        # second corpus-sized buffer over the ~12 MB/s link.
-        "sent": jnp.cumsum((corpus_dev < 0).astype(jnp.int32)),
     }
+    if walk_seed is not None:
+        # host-side analog of make_ondevice_prepare_fn(walk=True): a random
+        # permutation of the valid positions + cursor for the
+        # without-replacement epoch walk
+        data["walk_pos"] = jnp.asarray(
+            np.random.RandomState(walk_seed).permutation(valid)
+        )
+        data["walk_t"] = jnp.asarray(np.int32(0))
+    # sentence ids (markers bump the count): the samplers' one-gather
+    # never-span-a-marker test. Derived ON DEVICE from the corpus
+    # buffer that uploads anyway — a host-side cumsum would ship a
+    # second corpus-sized buffer over the ~12 MB/s link.
+    data["sent"] = jnp.cumsum((corpus_dev < 0).astype(jnp.int32))
     data.update(
         make_ondevice_statics(config, neg_lut, batch=batch, huffman=huffman)
     )
@@ -758,6 +767,7 @@ def make_ondevice_prepare_fn(
     *,
     subsample: bool,
     scale_tables: bool = True,
+    walk: bool = False,
 ):
     """Per-epoch on-device data preparation for the device pipeline.
 
@@ -787,14 +797,25 @@ def make_ondevice_prepare_fn(
     distribution every epoch, matching the reference's fixed negative
     table); pass None with ``scale_tables=False``. ``keep`` is ignored
     (pass None) when ``subsample`` is False.
+
+    ``walk=True`` additionally emits a fresh per-epoch random permutation of
+    the valid positions (``walk_pos``, padded like ``valid_pos``) plus a
+    ``walk_t`` cursor scalar, enabling WITHOUT-REPLACEMENT center coverage:
+    every ``n_valid`` consecutive draws visit every kept position exactly
+    once — the device analog of the reference's sequential sentence walk
+    (ref: Applications/WordEmbedding/src/wordembedding.cpp ParseSentence,
+    where every position trains every epoch). iid draws cover only ~63%
+    distinct positions per epoch-worth of draws, which measurably costs
+    quality (benchmarks/QUALITY.md). Cost: one P-element argsort per epoch.
     """
     V, K = config.vocab_size, config.negatives
 
     def prepare(ids_raw, keep, p34, key):
         P = ids_raw.shape[0]
+        k_sub, k_perm = jax.random.split(key)
         is_tok = ids_raw >= 0
         if subsample:
-            u = jax.random.uniform(key, (P,))
+            u = jax.random.uniform(k_sub, (P,))
             kept = (~is_tok) | (u < keep[jnp.maximum(ids_raw, 0)])
         else:
             kept = jnp.ones((P,), bool)
@@ -812,6 +833,13 @@ def make_ondevice_prepare_fn(
             "n_valid": n_valid,
             "sent": jnp.cumsum((corpus < 0).astype(jnp.int32)),
         }
+        if walk:
+            # fresh random permutation of the live slots of valid_pos:
+            # random sort keys, padding slots pushed to the tail with +inf
+            rk = jax.random.uniform(k_perm, (P,))
+            rk = jnp.where(jnp.arange(P) < n_valid, rk, jnp.inf)
+            dyn["walk_pos"] = valid_pos[jnp.argsort(rk)]
+            dyn["walk_t"] = jnp.int32(0)
         if scale_tables:
             cnt = jnp.zeros((V,), jnp.float32).at[jnp.maximum(ids_raw, 0)].add(
                 validm.astype(jnp.float32)
@@ -831,6 +859,34 @@ def make_ondevice_prepare_fn(
     return prepare
 
 
+def _draw_centers(data, key, batch: int):
+    """Center-position selection shared by every on-device sampler.
+
+    Walk mode (``walk_pos`` in the pytree): consecutive cursor values index
+    a per-epoch random permutation of the valid positions — every
+    ``n_valid`` draws cover every kept position exactly once (the
+    reference's every-position-trains-each-epoch guarantee, ref:
+    wordembedding.cpp ParseSentence). Otherwise iid uniform draws over
+    ``[0, n_valid)`` (``n_valid`` is a traced device scalar; ``valid_pos``
+    may be zero-padded past it for shape stability across epochs)."""
+    if "walk_pos" in data:
+        t = (data["walk_t"] + jnp.arange(batch, dtype=jnp.int32)) % data[
+            "n_valid"
+        ]
+        return data["walk_pos"][t]
+    j = jax.random.randint(key, (batch,), 0, data["n_valid"])
+    return data["valid_pos"][j]
+
+
+def _with_walk_cursor(data, off):
+    """Advance the without-replacement cursor for one microbatch (the host
+    advances the base cursor per dispatch; the scan body advances it per
+    microbatch). No-op pass-through when the walk is off."""
+    if "walk_pos" in data:
+        return {**data, "walk_t": data["walk_t"] + off}
+    return data
+
+
 def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
     """Shared skip-gram pair sampler: valid-position centers + exact
     offset-distance contexts + accept weights. Single source of truth for
@@ -842,14 +898,10 @@ def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
 
     def pairs(data, key):
         corpus = data["corpus"]
-        valid_pos = data["valid_pos"]
         n_corpus = corpus.shape[0]
         ks = jax.random.split(key, 3)
-        # n_valid is a device scalar (traced bound): valid_pos may be
-        # zero-padded past it for shape stability across epochs
-        j = jax.random.randint(ks[0], (batch,), 0, data["n_valid"])
-        p = valid_pos[j]
-        c = corpus[p]  # >= 0 by construction of valid_pos
+        p = _draw_centers(data, ks[0], batch)
+        c = corpus[p]  # >= 0 by construction of valid_pos/walk_pos
         # one draw for (distance, direction): r in [0, 2T)
         r = jax.random.randint(ks[1], (batch,), 0, 2 * T)
         d = data["dist_lut"][r % T]
@@ -994,9 +1046,11 @@ def make_ondevice_superbatch_step(
             table = data["inv_neg"] if kind == "neg" else data["inv_io"]
             return w_in_order * table[ids_sorted]
 
-        def body(params, key):
+        def body(params, xs):
+            key, off = xs
+            d = _with_walk_cursor(data, off)
             emb_in, emb_out = params["emb_in"], params["emb_out"]
-            c, o, w = sample(data, key)
+            c, o, w = sample(d, key)
             ts, negs = o[:, 0], o[:, 1:]
             vin = emb_in[c]
             vout = emb_out[o]
@@ -1033,7 +1087,8 @@ def make_ondevice_superbatch_step(
             return new, (loss, jnp.sum(w))
 
         keys = jax.random.split(key, steps)
-        params, (losses, accepted) = jax.lax.scan(body, params, keys)
+        offs = jnp.arange(steps, dtype=jnp.int32) * batch
+        params, (losses, accepted) = jax.lax.scan(body, params, (keys, offs))
         return params, (jnp.mean(losses), jnp.sum(accepted))
 
     return superstep
@@ -1081,11 +1136,9 @@ def make_ondevice_general_superbatch_step(
             tokens within b (ref: wordembedding.cpp ParseSentence CBOW
             branch). -> (target, contexts (B,2W) -1-padded, w)."""
             corpus = data["corpus"]
-            valid_pos = data["valid_pos"]
             n_corpus = corpus.shape[0]
             ks = jax.random.split(key, 4)
-            j = jax.random.randint(ks[0], (batch,), 0, data["n_valid"])
-            p = valid_pos[j]
+            p = _draw_centers(data, ks[0], batch)
             c = corpus[p]
             b = jax.random.randint(ks[1], (batch,), 1, W + 1)
             # np constant (not eager jnp): device-array constants cost a
@@ -1145,9 +1198,11 @@ def make_ondevice_general_superbatch_step(
                 "NS mode needs neg_lut — make_ondevice_data(..., neg_lut)"
             )
 
-        def body(params, key):
+        def body(params, xs):
+            key, off = xs
+            d = _with_walk_cursor(data, off)
             k1, k2 = jax.random.split(key)
-            c, tgt, contexts, w = sample(data, k1)
+            c, tgt, contexts, w = sample(d, k1)
             if hs:
                 new, loss = step(
                     params, c, data["pts"][tgt], data["cds"][tgt],
@@ -1160,7 +1215,8 @@ def make_ondevice_general_superbatch_step(
             return new, (loss, jnp.sum(w))
 
         keys = jax.random.split(key, steps)
-        params, (losses, accepted) = jax.lax.scan(body, params, keys)
+        offs = jnp.arange(steps, dtype=jnp.int32) * batch
+        params, (losses, accepted) = jax.lax.scan(body, params, (keys, offs))
         return params, (jnp.mean(losses), jnp.sum(accepted))
 
     return superstep
