@@ -204,26 +204,21 @@ def make_train_step(
 
     Gradient scaling: the reference applies **per-sample** updates at full
     ``lr`` sequentially (wordembedding.cpp:120-166); each update sees the
-    previous one, so repeated rows self-saturate through the sigmoid. A
-    batched scatter-add applies all of a row's gradients against the *old*
-    row — at full lr a row occurring k times moves k×, which diverges on
-    small vocabularies. The batched analog used here is the **per-row mean**
-    at full lr: every touched row takes one full-lr step along the average of
-    its in-batch gradients, making the step magnitude independent of both
-    batch size and row frequency (documented deviation; equals per-sample
-    behavior when rows don't repeat within a batch, the common case at real
-    vocabulary sizes). The reported loss is the per-pair mean.
+    previous one. A batched scatter-add ("raw") applies all of a row's
+    gradients against the *old* row, so a row occurring k times moves ~k×;
+    "row_mean" instead averages each row's in-batch gradients so every
+    touched row takes one full-lr step regardless of frequency.
 
-    ``scale_mode``: "row_mean" (above — the safe default) or "raw" — plain
-    full-lr scatter-add, skipping the per-row count pass (two extra
-    scatter/gather sweeps; ~50% faster on TPU). CAUTION: "raw" is only
-    word2vec-equivalent when rows rarely repeat within a batch. Negative
-    sampling draws from the unigram^3/4 distribution, so frequent words
-    repeat heavily in every real batch (a top word can appear ~1000x in a
-    41k-draw batch) and "raw" accumulates all those full-lr gradients at
-    once — where the reference's sequential updates self-saturate through
-    the sigmoid. Use "raw" only for uniform-ish workloads or benchmarking;
-    training uses "row_mean".
+    ``scale_mode``: **"raw" is the shipped default** (app.py ``-scale_mode``)
+    — round-3 measurement flipped the round-1/2 guidance: on
+    natural-statistics corpora row_mean's damping of frequent-word updates
+    COSTS quality (analogy 0.083 vs 0.245 raw on the log-linear topic
+    corpus) and quality decays with more epochs under row_mean, while raw
+    matches word2vec's accumulate semantics and is ~5% faster
+    (benchmarks/QUALITY.md). "row_mean" remains for degenerate duplicate
+    densities (tiny test vocabularies where raw's k× full-lr accumulation
+    diverges — e.g. 12-word corpora go NaN under raw). The reported loss is
+    the per-pair mean either way.
     """
     eps = 1e-6
     assert scale_mode in ("row_mean", "raw"), scale_mode
@@ -990,9 +985,11 @@ def make_ondevice_superbatch_step(
     PRNG key and the learning rate).
     NS skip-gram with plain SGD only (the flagship/benchmark config).
 
-    ``scale_mode``:
+    ``scale_mode`` (the APP ships ``raw`` — measured better quality on
+    natural corpora, benchmarks/QUALITY.md; ``row_mean`` is this builder's
+    parameter default only for small-vocab/test compatibility):
 
-    * ``row_mean`` (default) — duplicate-row updates are averaged by the
+    * ``row_mean`` — duplicate-row updates are averaged by the
       EXPECTED weighted duplicate count, read from precomputed per-word
       tables (centers/positives: batch * unigram * keep * accept-rate;
       negatives: batch*K * unigram^3/4 from the LUT's own quantization).
