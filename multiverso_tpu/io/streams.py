@@ -108,6 +108,7 @@ class ArrowFsStream(Stream):
         CHECK(mode in ("r", "w", "a", "rb", "wb", "ab"), f"bad stream mode {mode!r}")
         self._path = uri
         self._f = None
+        self._open_err: Optional[str] = None
         try:
             from pyarrow import fs as pafs
         except Exception as e:  # pragma: no cover - pyarrow is in the image
@@ -125,17 +126,27 @@ class ArrowFsStream(Stream):
             else:
                 self._f = filesystem.open_append_stream(path)
         except Exception as e:
+            # remember the root cause: remote open failures (auth, driver,
+            # network) are far more varied than local fopen ones, and the
+            # caller otherwise only ever sees a later 'not open' CHECK
+            self._open_err = f"{type(e).__name__}: {e}"
             Log.Error("ArrowFsStream: cannot open %s (%s): %s",
                       uri, mode, e)
-            self._f = None
+
+    def _check_open(self) -> None:
+        CHECK(
+            self._f is not None,
+            f"stream {self._path} not open"
+            + (f" (open failed: {self._open_err})" if self._open_err else ""),
+        )
 
     def Write(self, data: bytes) -> int:
-        CHECK(self._f is not None, f"stream {self._path} not open")
+        self._check_open()
         self._f.write(data)
         return len(data)
 
     def Read(self, size: int = -1) -> bytes:
-        CHECK(self._f is not None, f"stream {self._path} not open")
+        self._check_open()
         if size is None or size < 0:
             return self._f.read()  # pyarrow reads to EOF without a size
         return self._f.read(size)

@@ -337,8 +337,14 @@ class PSModel(LocalModel):
                   "lockstep round protocol pushes key buckets); dense X "
                   "batches are single-process")
             keys = np.asarray(batch["keys"], np.int64)
-            if self._push_round(keys, -delta_fm[keys]):
-                self.W = self.W - lr * grad
+            pushed = self._push_round(keys, -delta_fm[keys])
+            # the local apply happens whether or not the round pushed: a
+            # globally dry round (every rank's key set empty) still carried
+            # this rank's gradient (e.g. a regularizer term) — dropping it
+            # silently would diverge from the single-process path. Only the
+            # table push and the round-counted pull are collective.
+            self.W = self.W - lr * grad
+            if pushed:
                 self._tick_pull()
             return float(loss)
         if "keys" in batch and len(batch["keys"]) and len(batch["keys"]) < self.F:

@@ -304,25 +304,43 @@ class WordEmbedding:
         # distributed_wordembedding.cpp:82-127). Rows pad to this process's
         # worker-axis extent (add_rows_local bucket rule).
         nproc = jax.process_count()
-        # int32 count: exact up to 2^31 pairs (a float32 table would corrupt
-        # counts past 2^24); one row per client, global count = table sum
+        # int32 rows stay exact (a float32 table would corrupt counts past
+        # 2^24), but one int32 row per client would overflow past 2^31
+        # cumulative pairs (plausible for multi-epoch 100M+-token runs) and
+        # silently corrupt every rank's lr schedule — so each client keeps
+        # TWO rows, (lo, hi) base-2^30 limbs of its exact cumulative count,
+        # maintained by host-side carry in _wc_push_and_read
         self._t_wc = MV_CreateTable(MatrixTableOption(
-            num_row=nproc, num_col=1, dtype="int32", name="we_word_count",
+            num_row=2 * nproc, num_col=1, dtype="int32", name="we_word_count",
         ))
-        self._wc_bucket = max(1, self._t_wc.num_workers // nproc)
+        self._wc_bucket = max(2, self._t_wc.num_workers // nproc)
+        self._wc_cum = 0  # this client's exact cumulative count (host int)
         self._ps_global_pairs = 0
 
     def _wc_push_and_read(self, inc: int) -> int:
         """Add this client's trained-pair increment and read back the global
         count — one collective round every rank joins together (the
         reference's AddWordCount/GetWordCount pair,
-        distributed_wordembedding.cpp:92-127)."""
+        distributed_wordembedding.cpp:92-127).
+
+        The client's exact cumulative count lives on the host; the table
+        carries its base-2^30 limbs in rows (2p, 2p+1) = (lo, hi). Each
+        push adds the LIMB DELTAS (lo delta may be negative on carry —
+        fine for the += updater), so rows never exceed 2^30 and the
+        global count stays exact far past int32 (up to 2^61 pairs)."""
+        p = jax.process_index()
+        mask = (1 << 30) - 1
+        c_old, c_new = self._wc_cum, self._wc_cum + int(inc)
+        self._wc_cum = c_new
         lw = self._wc_bucket
-        ids = np.full(lw, jax.process_index(), np.int64)
+        ids = np.full(lw, 2 * p, np.int64)
         deltas = np.zeros((lw, 1), np.int32)
-        deltas[0, 0] = inc
+        ids[1] = 2 * p + 1
+        deltas[0, 0] = (c_new & mask) - (c_old & mask)
+        deltas[1, 0] = (c_new >> 30) - (c_old >> 30)
         self._t_wc.add_rows_local(ids, deltas)
-        return int(np.asarray(self._t_wc.get()).sum())
+        vals = np.asarray(self._t_wc.get()).astype(np.int64).reshape(-1)
+        return int(vals[0::2].sum() + (vals[1::2].sum() << 30))
 
     def _ps_round_meta(self, have: int, ni: int, no: int):
         """Per-round cross-process agreement (the fix the round-2 CHECK

@@ -79,6 +79,19 @@ def _ceil_to(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
 
 
+def bucket_from_extent(m: int, extent: int) -> int:
+    """Padded per-round bucket for cross-process collective rounds: start
+    at the per-process worker extent and double until >= m, so the bucket
+    always divides evenly over the extent (which need not be a power of
+    two — e.g. 12 workers / 2 processes). ONE definition: MatrixTable and
+    KVTable rounds must agree on the rule or their collective padding
+    desynchronizes."""
+    b = max(1, extent)
+    while b < m:
+        b <<= 1
+    return b
+
+
 class DenseTable:
     """Dense storage sharded along dim 0; shared machinery for Array/Matrix."""
 

@@ -81,7 +81,8 @@ class KVTable:
         self._index = KVIndex(self._capacity)  # key -> dense slot (host)
         self._key_dtype = np.dtype(np.int64)
         self._values = jax.device_put(
-            np.zeros(self._shape(self._capacity), self.dtype), self._sharding
+            np.zeros(self._shape(self._arr_len(self._capacity)), self.dtype),
+            self._sharding,
         )
         self._local: Dict[Any, Any] = {}  # worker-side cached map (ref raw())
         self._cache_local = bool(option.cache_local)
@@ -97,6 +98,16 @@ class KVTable:
     def _shape(self, cap: int):
         return (cap,) if self.val_dim == 1 else (cap, self.val_dim)
 
+    def _arr_len(self, cap: int) -> int:
+        """Device value-array length for an index capacity: the sharded dim
+        must divide evenly over the table shard axis, whose extent need not
+        be a power of two (the index capacity stays pow2 for the
+        open-addressing mask; slots < capacity <= _arr_len always hit a
+        real row, the pad rows are never addressed)."""
+        from multiverso_tpu.tables.base import _ceil_to
+
+        return _ceil_to(cap, self.num_shards)
+
     def _grow(self, needed: int) -> None:
         new_cap = self._capacity
         while new_cap < needed:
@@ -105,7 +116,7 @@ class KVTable:
         # round-trip of a sharded global array would not be addressable
         # cross-process; growth decisions are identical on every rank, so
         # this is one lockstep SPMD program)
-        pad = [(0, new_cap - self._capacity)]
+        pad = [(0, self._arr_len(new_cap) - self._arr_len(self._capacity))]
         if self.val_dim > 1:
             pad.append((0, 0))
         self._values = jax.jit(
@@ -253,7 +264,12 @@ class KVTable:
         self._last_round_any = m > 0
         if m == 0:
             return False, 0
-        return True, _next_pow2(max(m, self._local_extent()))
+        # the shared extent-doubling rule keeps the bucket divisible by the
+        # per-process worker extent, which need not be a power of two (a
+        # plain next-pow2 of max(m, extent) fails host_local_to_global)
+        from multiverso_tpu.tables.base import bucket_from_extent
+
+        return True, bucket_from_extent(m, self._local_extent())
 
     def _sync_union(self, keys: np.ndarray, bucket: int) -> None:
         """Insert the UNION of every rank's key batch into this rank's
@@ -427,7 +443,8 @@ class KVTable:
         self._index = KVIndex(self._capacity)
         self._local.clear()
         self._values = jax.device_put(
-            np.zeros(self._shape(self._capacity), self.dtype), self._sharding
+            np.zeros(self._shape(self._arr_len(self._capacity)), self.dtype),
+            self._sharding,
         )
         if len(keys):
             self.add(keys, vals)

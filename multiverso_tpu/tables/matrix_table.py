@@ -209,9 +209,41 @@ class MatrixTable(DenseTable):
             )
             rank = np.empty(len(ids_np), np.int64)
             rank[sort] = occ
+            # the id the scatter REALLY drops: num_row is still in bounds
+            # of shard-padded storage, so it would touch a pad row's
+            # storage/state; the padded extent is one past every real and
+            # pad row
+            oob = int(self.storage.shape[0])
             for k in range(int(rank.max()) + 1):
                 sel = np.flatnonzero(rank == k)
-                self.add_rows(ids_np[sel], deltas[sel], option)
+                # pad each pass to the next power of two so compiles stay
+                # bounded at log2(n) shapes TOTAL across all multiplicity
+                # patterns (per-pass sizes vary with duplicate multiplicity;
+                # padding every pass to the full batch would make the path
+                # O(k_max * n) device work). Padded slots scatter
+                # out-of-bounds: XLA drops them, touching neither storage
+                # nor updater state (their gathers clamp, but the clamped
+                # results are dropped on the scatter).
+                m = len(sel)
+                b = 1
+                while b < m:
+                    b <<= 1
+                pad_ids = np.full(b, oob, np.int32)
+                pad_ids[:m] = ids_np[sel]
+                pad_deltas = (
+                    jnp.zeros((b, self.num_col), deltas.dtype)
+                    .at[:m]
+                    .set(deltas[sel])
+                )
+                with monitor("table.add_rows"):
+                    self.storage, self.state = self._add_rows_fn()(
+                        self.storage,
+                        self.state,
+                        jnp.asarray(pad_ids),
+                        pad_deltas,
+                        jnp.int32(option.worker_id),
+                        option.scalars(),
+                    )
             return
         ids = jnp.asarray(ids_np)
         with monitor("table.add_rows"):  # dispatch latency only (async add);
@@ -240,11 +272,10 @@ class MatrixTable(DenseTable):
         m = int(np.asarray(meta).max())
         if m == 0:
             return False, 0
+        from multiverso_tpu.tables.base import bucket_from_extent
+
         lw = max(1, self.num_workers // jax.process_count())
-        b = lw
-        while b < m:
-            b <<= 1
-        return True, b
+        return True, bucket_from_extent(m, lw)
 
     def _local_rows_prep(self, row_ids) -> Tuple[np.ndarray, Any]:
         """Validate a process-local id vector and lift it to the global

@@ -220,3 +220,36 @@ def test_kv_vector_store_load(mv_env, tmp_path):
     t2 = mv_env.MV_CreateTable(KVTableOption(val_dim=3))
     t2.load(p)
     np.testing.assert_allclose(t2.get([22, 11]), [[4, 5, 6], [1, 2, 3]])
+
+
+def test_kv_round_bucket_multiple_of_nonpow2_extent():
+    """Round-4 advisor fix: the per-round key bucket must stay divisible by
+    the per-process worker extent, which need not be a power of two (6
+    workers / 1 process -> extent 6). A plain next-pow2 gave bucket 8 for
+    7 keys, which host_local_to_global rejects at runtime."""
+    import jax
+    import multiverso_tpu as mv
+    from multiverso_tpu.parallel import mesh as mesh_lib
+    from multiverso_tpu.tables import KVTableOption
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+
+    ResetFlagsToDefault()
+    mesh = mesh_lib.build_mesh(devices=jax.devices()[:6])
+    mv.MV_Init(mesh=mesh)
+    try:
+        # creation itself also used to fail here: the device value array
+        # padded to a pow2 capacity, which no 6-way sharding divides
+        t = mv.MV_CreateTable(KVTableOption(val_dim=1, init_capacity=8))
+        any_data, bucket = t._round_bucket(7)
+        assert any_data
+        assert bucket % 6 == 0 and bucket >= 7, bucket
+        assert t._round_bucket(1) == (True, 6)
+        assert t._round_bucket(0) == (False, 0)
+        keys = np.arange(100, dtype=np.int64) * 7  # forces _grow past 8
+        t.add(keys, np.ones(100, np.float32))
+        t.add(keys[:3], np.ones(3, np.float32))
+        got = t.get(np.asarray([0, 7, 14, 21, 9999], np.int64))
+        np.testing.assert_allclose(got, [2, 2, 2, 1, 0])
+    finally:
+        mv.MV_ShutDown(finalize=True)
+        ResetFlagsToDefault()
