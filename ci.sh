@@ -14,6 +14,18 @@ cmake --build build --parallel
 echo "== unit + integration tests (8-device CPU mesh) =="
 MV_BENCH_ASSERTS=1 python -m pytest tests/ -q
 
+# foreign-language bindings: the suite contains the Lua and C# binding
+# tests (test_lua_binding.py, test_csharp_binding.py). They skip without
+# their toolchains; under MV_REQUIRE_BINDINGS=1 (the Docker CI, which
+# installs luajit + mono) EVERY skip path in those tests fails the run
+# instead — enforcement lives in the tests so a toolchain-present-but-
+# broken environment cannot pass silently either.
+echo "== binding toolchain status (informational) =="
+command -v luajit >/dev/null 2>&1 \
+    && echo "luajit present" || echo "luajit absent (Lua test skips)"
+{ command -v mono >/dev/null 2>&1 || command -v dotnet >/dev/null 2>&1; } \
+    && echo "C# toolchain present" || echo "C# toolchain absent (C# test skips)"
+
 echo "== multi-chip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -1,0 +1,75 @@
+// C# binding smoke test — the reference's multi-worker arithmetic
+// invariants through the P/Invoke binding (same assertions as
+// binding/lua/test.lua and ref Test/test_array_table.cpp:26-47).
+//
+// Build & run (see tests/test_csharp_binding.py for the CI harness):
+//   mcs -out:smoke.exe SmokeTest.cs Multiverso.cs
+//   LD_LIBRARY_PATH=<dir of libmultiverso_c.so> PYTHONPATH=<repo> mono smoke.exe
+
+using System;
+using MultiversoTpu;
+
+public static class SmokeTest
+{
+    private static void Check(bool cond, string msg)
+    {
+        if (!cond)
+        {
+            Console.Error.WriteLine("FAIL: " + msg);
+            Environment.Exit(1);
+        }
+    }
+
+    private static bool Approx(float a, float b)
+    {
+        return Math.Abs(a - b) < 1e-4 * Math.Max(1.0, Math.Abs(b));
+    }
+
+    public static void Main()
+    {
+        MultiversoWrapper.Init();
+        int nw = MultiversoWrapper.Size();
+        // In the reference each worker PROCESS is a client; this embedded
+        // single host is ONE client — MV_NumWorkers() reports SPMD mesh
+        // slices, not extra adders (README "Deviations"). Multi-client
+        // runs = one host per process under jax.distributed.
+        const int nClients = 1;
+        Console.WriteLine(string.Format(
+            "workers={0} worker_id={1} server_id={2}",
+            nw, MultiversoWrapper.WorkerId(), MultiversoWrapper.ServerId()));
+
+        // Array table round trip: after `iters` rounds in which every
+        // client adds `delta` once, each slot holds iters*delta*nClients
+        // (ref: Test/test_array_table.cpp:26-47 form)
+        const int size = 64, iters = 3;
+        const float delta = 2.5f;
+        var at = new ArrayTableHandler(size);
+        var d = new float[size];
+        for (int k = 0; k < size; k++) d[k] = delta;
+        for (int i = 0; i < iters; i++)
+        {
+            at.Add(d, sync: true);
+            MultiversoWrapper.Barrier();
+        }
+        var got = at.Get();
+        Check(Approx(got[0], iters * delta * nClients),
+              string.Format("array invariant: got {0} want {1}",
+                            got[0], iters * delta * nClients));
+
+        // Matrix table: whole-table and row-set ops
+        var mt = new MatrixTableHandler(10, 4);
+        var all = new float[40];
+        for (int k = 0; k < 40; k++) all[k] = 1.0f;
+        mt.Add(all, sync: true);
+        var m = mt.Get();
+        Check(Approx(m[0], nClients), "matrix whole-table invariant");
+
+        mt.Add(new[] { 3 }, new float[] { 9, 9, 9, 9 }, sync: true);
+        var r = mt.Get(new[] { 3 });
+        Check(Approx(r[0], 10f * nClients), "matrix row invariant");
+
+        MultiversoWrapper.Barrier();
+        MultiversoWrapper.Shutdown();
+        Console.WriteLine("csharp binding test OK");
+    }
+}

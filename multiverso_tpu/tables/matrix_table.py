@@ -224,10 +224,10 @@ class MatrixTable(DenseTable):
                 # out-of-bounds: XLA drops them, touching neither storage
                 # nor updater state (their gathers clamp, but the clamped
                 # results are dropped on the scatter).
+                from multiverso_tpu.tables.base import bucket_from_extent
+
                 m = len(sel)
-                b = 1
-                while b < m:
-                    b <<= 1
+                b = bucket_from_extent(m, 1)
                 pad_ids = np.full(b, oob, np.int32)
                 pad_ids[:m] = ids_np[sel]
                 pad_deltas = (

@@ -32,15 +32,24 @@ def _find_luajit():
     return None
 
 
+def _skip(msg):
+    """Skip — unless the environment demands binding coverage (the Docker
+    CI installs luajit and sets MV_REQUIRE_BINDINGS=1, so ANY skip there
+    means zero binding coverage and must fail the build)."""
+    if os.environ.get("MV_REQUIRE_BINDINGS") == "1":
+        pytest.fail(f"MV_REQUIRE_BINDINGS=1 but: {msg}")
+    pytest.skip(msg)
+
+
 def test_lua_selftest():
     lua = _find_luajit()
     if lua is None:
-        pytest.skip("no LuaJIT (or lua with ffi) interpreter available")
+        _skip("no LuaJIT (or lua with ffi) interpreter available")
     from multiverso_tpu.capi import build_c_api
 
     lib_path = build_c_api()
     if lib_path is None:
-        pytest.skip("C API build failed")
+        _skip("C API build failed")
     site = sysconfig.get_paths()["purelib"]
     env = dict(
         os.environ,
