@@ -195,11 +195,66 @@ class WordEmbedding:
         self.huffman = HuffmanEncoder(self.dict.counts) if options.hs else None
         self.sampler = None if options.hs else AliasSampler(self.dict.counts)
         out_rows = self.huffman.num_inner_nodes if options.hs else V
-        self.params: Dict[str, jnp.ndarray] = init_params(self.cfg)
-        if options.hs:
-            self.params["emb_out"] = jnp.zeros((out_rows, options.size), jnp.float32)
-        if options.use_adagrad:
-            self.params.update(init_adagrad_slots(self.cfg, out_rows))
+        # Model parallelism (-num_shards=N + -device_pipeline): the tables
+        # must be born row-sharded — materializing the full (V, D) arrays
+        # on one device first and re-placing them later would OOM at the
+        # exact scale sharding exists for (the reference's headline: a
+        # 21M-vocab ~6B-param embedding sharded across servers, ref:
+        # Applications/WordEmbedding/README.md:12). Only a DEDICATED shard
+        # axis triggers this: on a role-ALL 1-D mesh the table axis
+        # doubles as the worker axis and silently sharding every run over
+        # it would surprise.
+        self._tab = self._rep = None
+        if options.device_pipeline:
+            from multiverso_tpu.parallel import mesh as mesh_lib
+            from multiverso_tpu.runtime import runtime as _runtime
+
+            rt = _runtime()
+            mesh = rt.mesh if rt.started else None
+            if (
+                mesh is not None
+                and mesh_lib.SHARD_AXIS in mesh.axis_names
+                and int(mesh.shape[mesh_lib.SHARD_AXIS]) > 1
+            ):
+                self._tab = mesh_lib.table_sharding(mesh, 2)
+                self._rep = mesh_lib.replicated_sharding(mesh)
+                self._nshards = int(mesh.shape[mesh_lib.SHARD_AXIS])
+        if self._tab is not None:
+            ns = self._nshards
+
+            def _make_sharded():
+                p = init_params(self.cfg)
+                if options.hs:
+                    p["emb_out"] = jnp.zeros(
+                        (out_rows, options.size), jnp.float32
+                    )
+                if options.use_adagrad:
+                    p.update(init_adagrad_slots(self.cfg, out_rows))
+                # pad rows to the shard multiple INSIDE the jit: sampler
+                # ids are all < V, so pad rows are never gathered or
+                # scattered; embeddings() slices them back off
+                return {
+                    k: jnp.pad(
+                        v,
+                        ((0, -(-v.shape[0] // ns) * ns - v.shape[0]), (0, 0)),
+                    )
+                    for k, v in p.items()
+                }
+
+            keys = ["emb_in", "emb_out"] + (
+                ["g2_in", "g2_out"] if options.use_adagrad else []
+            )
+            self.params: Dict[str, jnp.ndarray] = jax.jit(
+                _make_sharded, out_shardings={k: self._tab for k in keys}
+            )()
+        else:
+            self.params = init_params(self.cfg)
+            if options.hs:
+                self.params["emb_out"] = jnp.zeros(
+                    (out_rows, options.size), jnp.float32
+                )
+            if options.use_adagrad:
+                self.params.update(init_adagrad_slots(self.cfg, out_rows))
         kw = dict(hs=options.hs, use_adagrad=options.use_adagrad)
         if options.presort:
             # sorted-scatter path: scale_mode is baked into the host-side
@@ -597,13 +652,24 @@ class WordEmbedding:
 
         o = self.opt
         S = max(1, o.steps_per_call)
+        # Model parallelism: the tables were born row-sharded in __init__
+        # (-num_shards=N + -device_pipeline); here the training step keeps
+        # them sharded (out_shardings) while data/batch tensors replicate
+        # — gathers/scatters lower to XLA collectives over ICI, and the
+        # sharded tables are the load-bearing axis.
+        rep = self._rep
+        jit_kw: Dict = dict(donate_argnums=(0,))
+        if self._tab is not None:
+            jit_kw["out_shardings"] = (
+                {k: self._tab for k in self.params}, (rep, rep),
+            )
         if o.hs or o.cbow or o.use_adagrad:
             superstep = jax.jit(
                 make_ondevice_general_superbatch_step(
                     self.cfg, batch=o.batch_size, steps=S, hs=o.hs,
                     use_adagrad=o.use_adagrad, scale_mode=o.scale_mode,
                 ),
-                donate_argnums=(0,),
+                **jit_kw,
             )
         else:
             superstep = jax.jit(
@@ -611,29 +677,43 @@ class WordEmbedding:
                     self.cfg, batch=o.batch_size, steps=S,
                     scale_mode=o.scale_mode,
                 ),
-                donate_argnums=(0,),
+                **jit_kw,
             )
         flagship = not (o.hs or o.cbow or o.use_adagrad)
         neg_lut = None if o.hs else build_negative_lut(self.sampler.probs)
         start = time.perf_counter()
         t_phase = start
+
+        def _up(x):
+            """One-time upload; replicated over the mesh when sharding."""
+            a = jnp.asarray(x)
+            return jax.device_put(a, rep) if rep is not None else a
+
         # one-time uploads: raw ids, LUTs/Huffman tables, keep probs, p34
-        ids_dev = jnp.asarray(ids)
+        ids_dev = _up(ids)
         statics = make_ondevice_statics(
             self.cfg, neg_lut, batch=o.batch_size, huffman=self.huffman,
         )
+        if rep is not None:
+            statics = {k: jax.device_put(v, rep) for k, v in statics.items()}
         scale_tables = flagship and o.scale_mode == "row_mean"
         p34_dev = (
-            jnp.asarray(self.sampler.probs.astype(np.float32))
+            _up(self.sampler.probs.astype(np.float32))
             if scale_tables else None
         )
-        keep_dev = jnp.asarray(keep.astype(np.float32)) if o.sample > 0 else None
+        keep_dev = _up(keep.astype(np.float32)) if o.sample > 0 else None
         use_walk = o.walk == "perm"
+        prep_kw: Dict = {}
+        if rep is not None:
+            # every per-epoch dyn leaf (corpus, walk perm, scale tables,
+            # the n_valid scalar) replicates across the mesh
+            prep_kw["out_shardings"] = rep
         prepare = jax.jit(
             make_ondevice_prepare_fn(
                 self.cfg, o.batch_size, subsample=o.sample > 0,
                 scale_tables=scale_tables, walk=use_walk,
-            )
+            ),
+            **prep_kw,
         )
         prep_key = jax.random.PRNGKey(o.seed ^ 0x5EED5)
         t2 = time.perf_counter()
@@ -895,7 +975,9 @@ class WordEmbedding:
     # ------------------------------------------------------------- output
 
     def embeddings(self) -> np.ndarray:
-        return np.asarray(self.params["emb_in"])
+        # [:V] slices off shard-padding rows (sharded device pipeline pads
+        # the row dim to a multiple of the shard axis)
+        return np.asarray(self.params["emb_in"])[: self.cfg.vocab_size]
 
     def save_embeddings(self, path: str, binary: bool = False) -> None:
         """word2vec format (ref: distributed_wordembedding.cpp:263-306

@@ -1,0 +1,126 @@
+"""Property-fuzz worker for the cross-process round protocol
+(tests/test_multiprocess_e2e.py::test_fuzz_uneven_round_tails; the
+invariants are written up in PROTOCOL.md).
+
+Each rank draws a RANDOM number of live rounds with RANDOM batch sizes
+(including empty batches and duplicate ids) from a rank-seeded stream,
+then drains dry until the meta-allgather reports a globally dry round —
+the uneven-tail shape that deadlocks any protocol whose liveness logic
+leaks rank-local state. Every rank accumulates its own pushed deltas
+into a dense numpy golden; rank 0's final table read must equal the SUM
+of all ranks' goldens (delta-exact: += rounds are order-independent).
+
+argv: <pid> <nproc> <coord> <seed> <out_dir>
+
+Matrix rounds and KV rounds fuzz in sequence on the same cluster.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    seed, out_dir = int(sys.argv[4]), sys.argv[5]
+    import multiverso_tpu as mv
+    from jax.experimental import multihost_utils
+    from multiverso_tpu.tables import KVTableOption, MatrixTableOption
+
+    mv.MV_Init(
+        [
+            "prog",
+            f"-coordinator={coord}",
+            f"-process_id={pid}",
+            f"-num_processes={nproc}",
+        ]
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    rng = np.random.RandomState(seed * 1000 + pid)
+
+    # ---------------- matrix row rounds (uneven tails, empty batches)
+    R, C = 67, 5  # odd row count: shard padding in play
+    mt = mv.MV_CreateTable(MatrixTableOption(num_row=R, num_col=C, name="fz_m"))
+    my_rounds = int(rng.randint(0, 8))
+    golden = np.zeros((R, C), np.float64)
+    lw = max(1, mt.num_workers // nproc)
+    rounds_done = 0
+    while True:
+        if rounds_done < my_rounds:
+            k = int(rng.randint(0, 30))  # 0 => a live rank with an empty batch
+            ids = rng.randint(0, R, k).astype(np.int64)  # duplicates allowed
+            deltas = rng.randn(k, C).astype(np.float32)
+        else:
+            ids = np.zeros(0, np.int64)
+            deltas = np.zeros((0, C), np.float32)
+        any_data, bucket = mt.round_bucket(len(ids))
+        # termination is ONLY the globally-agreed flag — never local state
+        if not any_data:
+            break
+        assert bucket % lw == 0 and bucket >= max(1, len(ids)), bucket
+        pids = np.zeros(bucket, np.int64)
+        pids[: len(ids)] = ids
+        pdeltas = np.zeros((bucket, C), np.float32)
+        pdeltas[: len(ids)] = deltas
+        mt.add_rows_local(pids, pdeltas)
+        np.add.at(golden, ids, deltas.astype(np.float64))
+        # interleave a pull every few rounds: collective-count equality
+        # must hold with gets in the loop too
+        if rounds_done % 3 == 1:
+            got = mt.get_rows_local(pids)
+            assert got.shape == (bucket, C), got.shape
+        rounds_done += 1
+    mt.wait()
+    mfinal = np.asarray(mt.get(), np.float64)
+
+    # ---------------- KV key rounds (64-bit keys, uneven tails)
+    kv = mv.MV_CreateTable(KVTableOption(val_dim=2, init_capacity=8))
+    key_space = np.array(
+        [3, 11, 2**40 + 7, 2**33, 5, 77, 1024, 2**50 - 1], np.int64
+    )
+    kv_golden = {}
+    my_kv_rounds = int(rng.randint(0, 6))
+    rounds_done = 0
+    while True:
+        if rounds_done < my_kv_rounds:
+            k = int(rng.randint(0, 6))
+            keys = rng.choice(key_space, size=k).astype(np.int64)
+            vals = rng.randn(k, 2).astype(np.float32)
+        else:
+            keys = np.zeros(0, np.int64)
+            vals = np.zeros((0, 2), np.float32)
+        kv.add_local(keys, vals)
+        if not kv.last_round_had_data():
+            break
+        for kk, vv in zip(keys.tolist(), vals.astype(np.float64)):
+            kv_golden[kk] = kv_golden.get(kk, np.zeros(2)) + vv
+        rounds_done += 1
+    got_kv = kv.get(key_space)
+
+    np.savez(
+        os.path.join(out_dir, f"fuzz_rank{pid}.npz"),
+        matrix_golden=golden,
+        kv_keys=key_space,
+        kv_golden=np.stack(
+            [kv_golden.get(int(k), np.zeros(2)) for k in key_space]
+        ),
+        matrix_final=mfinal,
+        kv_final=np.asarray(got_kv, np.float64),
+    )
+    mv.MV_Barrier()
+    mv.MV_ShutDown()
+    print("WORKER_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -346,3 +346,35 @@ def test_cluster_table_invariants(nproc):
     _run_cluster(
         "multiprocess_worker.py", lambda i: [], nproc=nproc, timeout=300
     )
+
+
+@pytest.mark.parametrize("nproc,seed", [(2, 1), (2, 2), (4, 3)])
+def test_fuzz_uneven_round_tails(tmp_path, nproc, seed):
+    """Property-fuzz of the cross-process round protocol (PROTOCOL.md):
+    random per-rank round counts and batch sizes — empty batches and
+    duplicate ids included — must terminate in the same globally-dry
+    round on every rank, and the final table state must equal the numpy
+    golden of every rank's pushes (+= rounds are order-independent)."""
+    import numpy as np
+
+    _run_cluster(
+        "multiprocess_fuzz_worker.py",
+        lambda i: [seed, str(tmp_path)],
+        nproc=nproc,
+        timeout=300,
+    )
+    ranks = [
+        np.load(tmp_path / f"fuzz_rank{i}.npz") for i in range(nproc)
+    ]
+    m_expect = sum(r["matrix_golden"] for r in ranks)
+    kv_expect = sum(r["kv_golden"] for r in ranks)
+    for i, r in enumerate(ranks):
+        # every rank read the SAME final state (replicated get)
+        np.testing.assert_allclose(
+            r["matrix_final"], m_expect, rtol=1e-5, atol=1e-5,
+            err_msg=f"rank {i} matrix state != union golden",
+        )
+        np.testing.assert_allclose(
+            r["kv_final"], kv_expect, rtol=1e-5, atol=1e-5,
+            err_msg=f"rank {i} kv state != union golden",
+        )

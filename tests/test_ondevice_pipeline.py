@@ -398,3 +398,69 @@ def test_ondevice_walk_advances_inside_superbatch_scan():
         f"only {changed.sum()}/{n} rows updated — walk cursor not advancing "
         "across microbatches"
     )
+
+
+def test_app_device_pipeline_sharded_matches_unsharded_golden():
+    """Model parallelism is load-bearing (round-4): with -num_shards the
+    app's device pipeline keeps the embedding tables row-sharded over the
+    mesh's shard axis. Same seed => the sharded run must reproduce the
+    unsharded golden (identical draws; update math differs only in XLA's
+    partitioned reduction order)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+    from multiverso_tpu.parallel import mesh as mesh_lib
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+
+    rng = np.random.RandomState(0)
+    V = 97  # not divisible by 2 or 4: the row-padding path is exercised
+    ids = rng.randint(0, V, 40000).astype(np.int32)
+    ids[::11] = -1
+
+    def make_dict():
+        d = Dictionary()
+        d.words = [f"w{i}" for i in range(V)]
+        d.word2id = {w: i for i, w in enumerate(d.words)}
+        d.counts = np.bincount(ids[ids >= 0], minlength=V).astype(np.int64)
+        return d
+
+    def run(num_shards):
+        ResetFlagsToDefault()
+        mesh = mesh_lib.build_mesh(
+            devices=jax.devices()[:8], num_shards=num_shards
+        ) if num_shards > 1 else None
+        mv.MV_Init(mesh=mesh) if mesh is not None else mv.MV_Init()
+        try:
+            opt = WEOptions(
+                size=16, negative=3, window=2, batch_size=256,
+                steps_per_call=4, epoch=1, sample=0, min_count=0,
+                output_file="", device_pipeline=True, train_file="x",
+            )
+            we = WordEmbedding(opt, dictionary=make_dict())
+            we.train(ids=ids)
+            if num_shards > 1:
+                sh = we.params["emb_in"].sharding
+                spec = sh.spec
+                assert spec and spec[0] is not None, (
+                    f"emb_in not row-sharded: {sh}"
+                )
+                shard_rows = {
+                    s.data.shape[0] for s in we.params["emb_in"].addressable_shards
+                }
+                assert shard_rows == {
+                    -(-V // num_shards) if V % num_shards else V // num_shards
+                }, shard_rows
+            # [:V] drops shard-padding rows on the sharded runs
+            return (
+                np.asarray(we.params["emb_in"])[:V],
+                np.asarray(we.params["emb_out"])[:V],
+            )
+        finally:
+            mv.MV_ShutDown(finalize=True)
+            ResetFlagsToDefault()
+
+    in1, out1 = run(1)
+    for ns in (2, 4):
+        in_s, out_s = run(ns)
+        np.testing.assert_allclose(in_s, in1, rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(out_s, out1, rtol=2e-5, atol=2e-6)
