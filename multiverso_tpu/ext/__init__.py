@@ -9,10 +9,18 @@ from multiverso_tpu.ext.param_manager import (
     PytreeParamManager,
     TorchParamManager,
 )
+from multiverso_tpu.ext.sharedvar import (
+    MVSharedVariable,
+    mv_shared,
+    sync_all_mv_shared_vars,
+)
 
 __all__ = [
     "MVModelParamManager",
+    "MVSharedVariable",
     "PeriodicSync",
     "PytreeParamManager",
     "TorchParamManager",
+    "mv_shared",
+    "sync_all_mv_shared_vars",
 ]

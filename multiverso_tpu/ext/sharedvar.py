@@ -1,0 +1,93 @@
+"""Per-variable sync handles — the ``mv_shared`` pattern.
+
+Reference semantics (ref: binding/python/multiverso/theano_ext/
+sharedvar.py:12-102): a *single* model variable gets its own ArrayTable;
+``mv_sync()`` pushes ``current - last_synced`` (the accumulated local
+update, usually gradients) and pulls the latest merged value back. The
+reference wraps a theano ``SharedVariable``; there is no theano here, so
+the TPU-native analog wraps a plain mutable ndarray holder with the same
+``get_value``/``set_value`` surface — any host training loop (numpy,
+optax states materialized to host, torch tensors via ``.numpy()``) can
+drive it. The whole-model granularity of this pattern lives in
+``ext/param_manager.py``; this is the single-variable convenience.
+
+Typical use::
+
+    w = mv_shared(np.zeros((256, 10), np.float32))
+    for batch in data:
+        w.set_value(w.get_value() - lr * grad(batch, w.get_value()))
+        if step % sync_every == 0:
+            w.mv_sync()            # push delta, pull merged
+    # or sync every registered variable at once:
+    sync_all_mv_shared_vars()
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from multiverso_tpu.api import MV_Barrier
+from multiverso_tpu.binding.tables import ArrayTableHandler
+
+__all__ = ["MVSharedVariable", "mv_shared", "sync_all_mv_shared_vars"]
+
+
+class MVSharedVariable:
+    """One variable, one ArrayTable, delta sync (ref: sharedvar.py:12-50).
+
+    Construction creates the table with this variable's value as
+    ``init_value`` (master's value wins — the handler's master-init
+    protocol), barriers, then pulls the table back so every worker starts
+    identical. ``mv_sync()`` adds ``value - last_synced`` and refreshes
+    the local value from the merged table state.
+    """
+
+    def __init__(self, value, name: Optional[str] = None):
+        arr = np.ascontiguousarray(value, np.float32)
+        self.name = name
+        self._shape = arr.shape
+        self._table = ArrayTableHandler(arr.size, init_value=arr.reshape(-1))
+        MV_Barrier()  # initial value must have taken effect everywhere
+        self._value = self._table.get().reshape(self._shape).copy()
+        self._last = self._value.copy()
+
+    def get_value(self) -> np.ndarray:
+        return self._value.copy()
+
+    def set_value(self, value) -> None:
+        arr = np.ascontiguousarray(value, np.float32)
+        if arr.shape != self._shape:
+            raise ValueError(f"shape {arr.shape} != {self._shape}")
+        self._value = arr.copy()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def mv_sync(self) -> None:
+        """Push the local delta, pull the merged value (ref:
+        sharedvar.py:37-50 — add(value - last), then get())."""
+        self._table.add((self._value - self._last).reshape(-1))
+        self._value = self._table.get().reshape(self._shape).copy()
+        self._last = self._value.copy()
+
+
+def mv_shared(value, name: Optional[str] = None) -> MVSharedVariable:
+    """Create AND register a shared variable (ref: sharedvar.py:80-92 —
+    the reference registers every ``mv_shared`` call for
+    ``sync_all_mv_shared_vars``)."""
+    sv = MVSharedVariable(value, name=name)
+    mv_shared.shared_vars.append(sv)
+    return sv
+
+
+mv_shared.shared_vars: List[MVSharedVariable] = []
+
+
+def sync_all_mv_shared_vars() -> None:
+    """Sync every variable created through ``mv_shared`` (ref:
+    sharedvar.py:95-102)."""
+    for sv in mv_shared.shared_vars:
+        sv.mv_sync()

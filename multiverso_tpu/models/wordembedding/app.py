@@ -108,6 +108,13 @@ MV_DEFINE_bool(
     "presort on device, zero per-step host traffic (NS skip-gram runs the "
     "tuned sorted-scatter step; CBOW/HS/AdaGrad use the general step)",
 )
+MV_DEFINE_int(
+    "upload_chunk_tokens", 0,
+    "device-pipeline corpus upload chunk size in tokens (0 = auto, 16M): "
+    "corpora larger than ~1.5 chunks stream in fixed-size chunks with the "
+    "next chunk's host->device transfer overlapping the current chunk's "
+    "training (double buffering — hides the upload on weak links)",
+)
 MV_DEFINE_string(
     "walk", "perm",
     "device-pipeline center selection: perm (default — without-replacement "
@@ -147,6 +154,7 @@ class WEOptions:
     use_ps: bool = False
     presort: bool = True
     device_pipeline: bool = False
+    upload_chunk_tokens: int = 0
     walk: str = "perm"
     seed: int = 1
 
@@ -685,12 +693,39 @@ class WordEmbedding:
         t_phase = start
 
         def _up(x):
-            """One-time upload; replicated over the mesh when sharding."""
+            """Async upload (jnp.asarray returns before the transfer
+            completes); replicated over the mesh when sharding."""
             a = jnp.asarray(x)
             return jax.device_put(a, rep) if rep is not None else a
 
-        # one-time uploads: raw ids, LUTs/Huffman tables, keep probs, p34
-        ids_dev = _up(ids)
+        # Chunked double-buffered corpus feed: on weak host->device links
+        # (~12 MB/s measured on the tunneled bench host — E2E_GAP.md) a
+        # monolithic upload serializes in front of training. Splitting the
+        # stream into fixed-size chunks lets chunk i+1's transfer overlap
+        # chunk i's training (uploads are async; the next prepare simply
+        # waits on its transfer). Each chunk prepares independently —
+        # per-chunk subsample redraw and walk permutation; the union of
+        # chunk walks still covers every position per epoch.
+        CHECK(o.upload_chunk_tokens >= 0,
+              "-upload_chunk_tokens must be >= 0 (0 = auto), got %d"
+              % o.upload_chunk_tokens)
+        chunk_tok = o.upload_chunk_tokens or 16_000_000
+        if len(ids) > chunk_tok + chunk_tok // 2:
+            nC = -(-len(ids) // chunk_tok)
+            L = -(-len(ids) // nC)
+            chunks_np = []
+            for c in range(nC):
+                part = ids[c * L: (c + 1) * L]
+                if len(part) < L:  # -1 pads parse as sentence markers
+                    part = np.concatenate(
+                        [part, np.full(L - len(part), -1, np.int32)]
+                    )
+                chunks_np.append(np.ascontiguousarray(part))
+        else:
+            nC = 1
+            chunks_np = [ids]
+        # first chunk (or the whole corpus) + LUTs/Huffman/keep/p34 uploads
+        cur_dev = _up(chunks_np[0])
         statics = make_ondevice_statics(
             self.cfg, neg_lut, batch=o.batch_size, huffman=self.huffman,
         )
@@ -722,13 +757,13 @@ class WordEmbedding:
             t2 - t_phase,
         )
 
-        def epoch_data(epoch: int):
+        def stream_data(seq: int, buf):
             """Fresh on-device subsample draw -> compacted corpus + data
-            pytree (identical shapes every epoch: no recompiles, no
-            re-uploads; one n_valid scalar readback)."""
+            pytree for one (epoch, chunk) leg (identical shapes every leg:
+            no recompiles; one n_valid scalar readback)."""
             dyn = prepare(
-                ids_dev, keep_dev, p34_dev,
-                jax.random.fold_in(prep_key, epoch),
+                buf, keep_dev, p34_dev,
+                jax.random.fold_in(prep_key, seq),
             )
             return {**statics, **dyn}, int(dyn["n_valid"])
 
@@ -744,23 +779,39 @@ class WordEmbedding:
         loss_dev = None
         pairs_done = 0
         calls = 0
-        data, n_valid = epoch_data(0)
+        data, n_valid = stream_data(0, cur_dev)
         Log.Info(
-            "[WordEmbedding] device-pipeline startup: first epoch-prepare "
-            "(incl. compile) +%.1fs (total %.1fs)",
-            time.perf_counter() - t2, time.perf_counter() - start,
+            "[WordEmbedding] device-pipeline startup: first prepare "
+            "(incl. compile) +%.1fs (total %.1fs; %d upload chunk(s))",
+            time.perf_counter() - t2, time.perf_counter() - start, nC,
         )
-        total_pairs = max(1, n_valid * per_kept * o.epoch)
+        # lr schedule total: exact for nC == 1; with chunks, estimated from
+        # chunk 0's kept fraction and refined as each chunk prepares
+        total_pairs = max(1, n_valid * per_kept * nC * o.epoch)
         # each host sync (accepted-count drain) costs a full tunnel round
         # trip + pipeline drain (~0.2s measured — benchmarks/E2E_GAP.md):
         # syncing every call caps the loop at 2.0M pairs/s vs 3.0M at an
         # 8-call cadence and 3.16M unsynced, so the drain/log window is
         # floored at 16 calls
         log_every = max(16, (total_pairs // per_call) // 20)
-        for epoch in range(o.epoch):
-            if epoch > 0:
-                data, n_valid = epoch_data(epoch)
-            walk_t = 0  # fresh per-epoch permutation; cursor restarts
+        legs_done_pairs = 0  # exact target sum of completed legs
+        for seq in range(o.epoch * nC):
+            if seq > 0:
+                data, n_valid = stream_data(seq, cur_dev)
+                # refine the schedule total with the actual leg target
+                total_pairs = max(
+                    1,
+                    legs_done_pairs
+                    + n_valid * per_kept * (o.epoch * nC - seq),
+                )
+            if nC > 1:
+                # double buffer: dispatch the NEXT chunk's upload now so
+                # the transfer rides under this leg's training
+                nxt = seq + 1
+                cur_dev = (
+                    _up(chunks_np[nxt % nC]) if nxt < o.epoch * nC else None
+                )
+            walk_t = 0  # fresh per-leg permutation; cursor restarts
             epoch_target = max(1, n_valid * per_kept)
             epoch_done = 0
             accepted_dev = jnp.float32(0.0)
@@ -809,17 +860,18 @@ class WordEmbedding:
                             "%.0fk pairs/s, lr %.5f, loss %.4f",
                             pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
                         )
-            if calls != synced_calls:  # drain the epoch tail (if undrained)
+            if calls != synced_calls:  # drain the leg tail (if undrained)
                 got = int(float(accepted_dev))
                 epoch_done += got
                 pairs_done += got
             if calls >= max_calls and epoch_done < epoch_target:
                 Log.Error(
                     "[WordEmbedding] device-pipeline hit the %d-call bound at "
-                    "%.1fM/%.1fM epoch pairs — corpus rejects nearly every "
-                    "draw; epoch truncated",
+                    "%.1fM/%.1fM leg pairs — corpus rejects nearly every "
+                    "draw; leg truncated",
                     max_calls, epoch_done / 1e6, epoch_target / 1e6,
                 )
+            legs_done_pairs += epoch_target
         jax.block_until_ready(self.params)
         self.words_trained = pairs_done
         rate = self.words_trained / max(time.perf_counter() - start, 1e-9)

@@ -116,3 +116,40 @@ def test_pytree_param_manager_preserves_dtypes(mv_env):
     assert m.params["count"].dtype == np.int32
     assert int(m.params["count"]) == 3
     assert m.params["w"].dtype == np.float32
+
+
+def test_mv_shared_variable_delta_sync(mv_env):
+    """Per-variable sync handle (ref: theano_ext/sharedvar.py mv_shared):
+    construction master-inits the table; mv_sync pushes value-last delta
+    and pulls the merged state."""
+    import numpy as np
+
+    from multiverso_tpu.ext import MVSharedVariable, mv_shared, sync_all_mv_shared_vars
+
+    w = MVSharedVariable(np.arange(12, dtype=np.float32).reshape(3, 4))
+    # starts at the (master-initialised) table value
+    np.testing.assert_allclose(
+        w.get_value(), np.arange(12, dtype=np.float32).reshape(3, 4)
+    )
+    # local update, then sync: table absorbs exactly the delta
+    w.set_value(w.get_value() + 2.0)
+    w.mv_sync()
+    np.testing.assert_allclose(
+        w.get_value(), np.arange(12, dtype=np.float32).reshape(3, 4) + 2.0
+    )
+    # a second sync with no local change pushes a zero delta
+    w.mv_sync()
+    np.testing.assert_allclose(
+        w.get_value(), np.arange(12, dtype=np.float32).reshape(3, 4) + 2.0
+    )
+
+    # registry + bulk sync
+    n0 = len(mv_shared.shared_vars)
+    a = mv_shared(np.zeros(4, np.float32), name="a")
+    b = mv_shared(np.ones(2, np.float32), name="b")
+    assert len(mv_shared.shared_vars) == n0 + 2
+    a.set_value(np.full(4, 3.0, np.float32))
+    sync_all_mv_shared_vars()
+    np.testing.assert_allclose(a.get_value(), 3.0)
+    np.testing.assert_allclose(b.get_value(), 1.0)
+    del mv_shared.shared_vars[n0:]  # registry is process-global

@@ -464,3 +464,52 @@ def test_app_device_pipeline_sharded_matches_unsharded_golden():
         in_s, out_s = run(ns)
         np.testing.assert_allclose(in_s, in1, rtol=2e-5, atol=2e-6)
         np.testing.assert_allclose(out_s, out1, rtol=2e-5, atol=2e-6)
+
+
+def test_app_device_pipeline_chunked_upload():
+    """Chunked double-buffered corpus feed (round-4): forcing a tiny
+    -upload_chunk_tokens must stream the corpus in multiple legs and still
+    train the full epoch budget (union of per-chunk walks covers every
+    position; per-leg targets sum to the corpus target)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault
+
+    rng = np.random.RandomState(2)
+    V = 120
+    ids = rng.randint(0, V, 60_000).astype(np.int32)
+    ids[::13] = -1
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(V)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.bincount(ids[ids >= 0], minlength=V).astype(np.int64)
+
+    ResetFlagsToDefault()
+    mv.MV_Init()
+    try:
+        def run(chunk_tokens):
+            opt = WEOptions(
+                size=16, negative=3, window=2, batch_size=512,
+                steps_per_call=4, epoch=2, sample=0, min_count=0,
+                output_file="", device_pipeline=True, train_file="x",
+                upload_chunk_tokens=chunk_tokens,
+            )
+            we = WordEmbedding(opt, dictionary=d)
+            loss = we.train(ids=ids)
+            return we, loss
+
+        we_c, loss_c = run(20_000)  # 3 chunks
+        assert np.isfinite(loss_c), loss_c
+        n_valid = int((ids >= 0).sum())
+        target = n_valid * 3 * 2  # (window+1) per kept position, 2 epochs
+        # acceptance < 1 (markers/ends) but the loop runs to its per-leg
+        # targets; chunked and unchunked budgets must agree
+        we_u, loss_u = run(0)
+        assert np.isfinite(loss_u), loss_u
+        assert abs(we_c.words_trained - we_u.words_trained) < 0.05 * target, (
+            we_c.words_trained, we_u.words_trained, target,
+        )
+    finally:
+        mv.MV_ShutDown(finalize=True)
+        ResetFlagsToDefault()
