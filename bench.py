@@ -679,39 +679,68 @@ def _bench_ring_attention():
             )
 
     flops = 4.0 * B * H * S * S * D  # QK^T + AV, 2 FLOPs per MAC
+    rng = np.random.RandomState(0)
+    qS, kS, vS = (
+        jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+        for _ in range(3)
+    )
 
-    def run(bf16_mxu):
-        rng = np.random.RandomState(0)
-        q, k, v = (
-            jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
-            for _ in range(3)
-        )
-        fn = jax.jit(make_blockwise(S, blk, bf16_mxu))
-        # fence via host readback: block_until_ready is NOT a reliable
-        # queue fence on the tunneled axon platform (see _bench_fused)
-        float(fn(q, k, v)[0, 0, 0, 0])
+    def timed(fn):
+        """Best-of-3 TFLOP/s, fenced via host readback:
+        block_until_ready is NOT a reliable queue fence on the tunneled
+        axon platform (see _bench_fused)."""
+        float(fn()[0, 0, 0, 0].astype(jnp.float32))
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
-            float(fn(q, k, v)[0, 0, 0, 0])
+            float(fn()[0, 0, 0, 0].astype(jnp.float32))
             best = min(best, time.perf_counter() - t0)
         return flops / best / 1e12
 
-    tf32 = run(False)   # the shipped kernel's compute dtype
-    tbf16 = run(True)   # bf16 MXU tile, f32 accum (flash-kernel ceiling)
+    fn32 = jax.jit(make_blockwise(S, blk, False))
+    fnbf = jax.jit(make_blockwise(S, blk, True))
+    tf32 = timed(lambda: fn32(qS, kS, vS))   # the shipped kernel's dtype
+    tbf16 = timed(lambda: fnbf(qS, kS, vS))  # bf16 MXU tile, f32 accum
     on_tpu = jax.devices()[0].platform == "tpu"
     if os.environ.get("MV_BENCH_ASSERTS") == "1" and on_tpu:
         floor = float(os.environ.get("MV_BENCH_RING_MIN_TFLOPS", 5.0))
         assert tf32 > floor, (
             f"ring attention tile {tf32:.1f} TFLOP/s below {floor} floor"
         )
-    return {
+    out = {
         "ring_attention_seq": S,
         "ring_attention_tflops": round(tf32, 2),
         "ring_attention_mfu_pct": round(100 * tf32 / peak, 2),
         "ring_attention_bf16in_tflops": round(tbf16, 2),
         "ring_attention_bf16in_mfu_pct": round(100 * tbf16 / peak, 2),
     }
+    if on_tpu:
+        # the fused Pallas flash forward (ops/pallas_flash.py) — real-TPU
+        # only (interpret mode is not a perf path)
+        try:
+            from multiverso_tpu.ops.pallas_flash import flash_attention
+
+            got = flash_attention(qc, kc, vc, block_q=64, block_k=64)
+            err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref)))
+            if err > 1e-3:
+                raise RuntimeError(f"flash diverges from reference: {err}")
+            qb, kb, vb = (
+                x.astype(jnp.bfloat16) for x in (qS, kS, vS)
+            )
+            # VMEM-friendly flash tile that still divides S
+            fb = min(512, blk)
+            while fb > 1 and S % fb:
+                fb //= 2
+            tflash = timed(
+                lambda: flash_attention(qb, kb, vb, block_q=fb, block_k=fb)
+            )
+            out["ring_attention_flash_tflops"] = round(tflash, 2)
+            out["ring_attention_flash_mfu_pct"] = round(
+                100 * tflash / peak, 2
+            )
+        except Exception as e:
+            out["ring_attention_flash_error"] = str(e)[:200]
+    return out
 
 
 def _bench_quality():

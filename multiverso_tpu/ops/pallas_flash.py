@@ -1,0 +1,170 @@
+"""Pallas flash-attention forward — the MXU inner tile for ring attention.
+
+The ring/blockwise path (ops/ring_attention.py) computes its per-step
+tile with jnp f32 einsums; the round-4 bench (`_bench_ring_attention`)
+measures that tile against the MXU roofline and motivates this kernel:
+one fused Pallas program per (batch, head, Q-block) that streams K/V
+blocks through VMEM, runs both matmuls on the MXU with f32 accumulation
+(``preferred_element_type``), and keeps the running softmax state
+(m, l, acc) in VMEM scratch across the K-block grid dimension — no
+(S, S) score materialization, no HBM round trips between tiles.
+
+Scope: single-device FORWARD (the scoring/inference path and the ring's
+round-5 inner-kernel candidate). The differentiable training path stays
+on the jnp tile (``ring_attention_local``); integrating this kernel into
+the ring body needs carry-in/carry-out softmax state, which is the
+follow-up step.
+
+Reference parity note: the reference has no attention anywhere
+(SURVEY.md §5 — it predates transformers); this module is part of the
+beyond-parity long-context capability.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = float("-inf")
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
+                  scale, causal, block_q, block_k, n_k):
+    """Grid step = one (b, h, qi, ki) tile; ki is the innermost grid dim,
+    so the VMEM scratch (m, l, acc) carries the streaming softmax across
+    the K blocks of one Q block.
+
+    Causal safety: tile ki=0 is live for every Q block and its row mask
+    always admits key 0 (kpos 0 <= any qpos), so every row's running max
+    is finite after the first tile — the NaN guard the jnp tile needs for
+    arbitrary masks is unnecessary here (cross-attention masks are out of
+    scope for this kernel).
+    """
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    first_k = ki * block_k
+    live = True
+    if causal:
+        last_q = (qi + 1) * block_q - 1
+        live = first_k <= last_q  # future-only tiles contribute nothing
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q.astype(k.dtype), k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = first_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_s[...]                                 # (block_q, 128)
+        row_max = jnp.max(s, axis=1, keepdims=True)       # (block_q, 1)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(s - m_new[:, :1])
+        corr = jnp.exp(m_prev - m_new)
+        l_s[...] = l_s[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[...] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        o_ref[0, 0] = (
+            acc_s[...] / jnp.maximum(l_s[:, :1], 1e-37)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused flash forward over (B, S, H, D) inputs (the repo's attention
+    convention). ``S`` must divide by both block sizes; ``D`` should be a
+    lane multiple (128) on real TPUs. ``interpret=True`` runs the Pallas
+    interpreter (CPU tests / non-TPU backends). Matches
+    ``attention_reference`` to f32 reduction order."""
+    B, S, H, D = q.shape
+    assert k.shape == v.shape == (B, S, H, D), (q.shape, k.shape, v.shape)
+    assert S % block_q == 0 and S % block_k == 0, (S, block_q, block_k)
+    if scale is None:
+        scale = D ** -0.5
+    n_q, n_k = S // block_q, S // block_k
+    # (B, H, S, D) layout: one (b, h) pair per outer grid step
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_k=n_k,
+    )
+    if causal:
+        # Dead-tile DMA pruning: a tile whose first key is past the last
+        # query contributes nothing (pl.when skips its compute), but its
+        # K/V block fetch would still run. Clamping the index map to the
+        # last LIVE block makes the dead steps re-request the previous
+        # block — Pallas elides the copy when the block index is
+        # unchanged, so causal runs move ~half the K/V traffic.
+        last_live = lambda qi: ((qi + 1) * block_q - 1) // block_k
+
+        def kv_idx(b, h, qi, ki):
+            return (b, h, jnp.minimum(ki, last_live(qi)), 0)
+    else:
+        def kv_idx(b, h, qi, ki):
+            return (b, h, ki, 0)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # normalizer l
+            pltpu.VMEM((block_q, D), jnp.float32),    # accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)

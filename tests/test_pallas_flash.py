@@ -1,0 +1,64 @@
+"""Pallas flash forward vs the dense reference (interpret mode on the CPU
+backend; the real-TPU perf number is bench.py's ring_attention_flash_*
+fields)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.ops.pallas_flash import flash_attention
+from multiverso_tpu.ops.ring_attention import attention_reference
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 256, 2, 32
+    q, k, v = (
+        jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+        for _ in range(3)
+    )
+    got = flash_attention(
+        q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+    )
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_uneven_blocks_and_scale():
+    rng = np.random.RandomState(1)
+    B, S, H, D = 1, 192, 1, 16
+    q, k, v = (
+        jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.5)
+        for _ in range(3)
+    )
+    got = flash_attention(
+        q, k, v, causal=True, scale=0.25, block_q=96, block_k=32,
+        interpret=True,
+    )
+    ref = attention_reference(q, k, v, causal=True, scale=0.25)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bf16_inputs():
+    """bf16 operands, f32 accumulation: the MXU-native layout."""
+    rng = np.random.RandomState(2)
+    B, S, H, D = 1, 128, 2, 32
+    qf, kf, vf = (
+        rng.randn(B, S, H, D).astype(np.float32) * 0.3 for _ in range(3)
+    )
+    got = flash_attention(
+        jnp.asarray(qf, jnp.bfloat16), jnp.asarray(kf, jnp.bfloat16),
+        jnp.asarray(vf, jnp.bfloat16), block_q=64, block_k=64,
+        interpret=True,
+    )
+    ref = attention_reference(
+        jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref), rtol=5e-2, atol=5e-2
+    )
