@@ -833,9 +833,17 @@ class WordEmbedding:
                 key, sub = jax.random.split(key)
                 if use_walk:
                     # host-side cursor: the dispatch consumes per_call
-                    # permutation slots; one scalar leaf swap, no re-upload
-                    data["walk_t"] = np.int32(walk_t)
-                    walk_t = (walk_t + per_call) % max(n_valid, 1)
+                    # permutation slots; two scalar leaf swaps, no
+                    # re-upload. The abstract period is n_valid * per_kept
+                    # (the cycle index drives the per-visit offset strata
+                    # — one epoch = one pass of the (position x
+                    # offset-stratum) grid), but the cursor ships as
+                    # bounded (in-cycle offset, cycle) components so no
+                    # int32 overflows even for huge single chunks
+                    nv = max(n_valid, 1)
+                    data["walk_t"] = np.int32(walk_t % nv)
+                    data["walk_c"] = np.int32((walk_t // nv) % per_kept)
+                    walk_t = (walk_t + per_call) % max(nv * per_kept, 1)
                 self.params, (loss_dev, acc) = superstep(
                     self.params, data, sub, jnp.float32(lr)
                 )

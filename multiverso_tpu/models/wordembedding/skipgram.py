@@ -863,14 +863,27 @@ def _draw_centers(data, key, batch: int):
     reference's every-position-trains-each-epoch guarantee, ref:
     wordembedding.cpp ParseSentence). Otherwise iid uniform draws over
     ``[0, n_valid)`` (``n_valid`` is a traced device scalar; ``valid_pos``
-    may be zero-padded past it for shape stability across epochs)."""
+    may be zero-padded past it for shape stability across epochs).
+
+    Returns ``(positions, stratum)``: in walk mode ``stratum`` is the
+    cursor's cycle index through the permutation (cycle k of an epoch =
+    the k-th visit of every position), which the skip-gram sampler uses
+    to stratify each position's offset draws (see ``_make_sg_pair_fn``);
+    ``None`` in iid mode."""
     if "walk_pos" in data:
-        t = (data["walk_t"] + jnp.arange(batch, dtype=jnp.int32)) % data[
-            "n_valid"
-        ]
-        return data["walk_pos"][t]
+        # walk_t is the IN-CYCLE offset (< n_valid) and walk_c the cycle
+        # index — split so no intermediate ever approaches int32 range
+        # even for periods n_valid * (W+1) > 2^31 (t is bounded by
+        # n_valid + dispatch size)
+        t = data["walk_t"] + jnp.arange(batch, dtype=jnp.int32)
+        n = data["n_valid"]
+        p = data["walk_pos"][t % n]
+        cyc = t // n
+        if "walk_c" in data:
+            cyc = cyc + data["walk_c"]
+        return p, cyc
     j = jax.random.randint(key, (batch,), 0, data["n_valid"])
-    return data["valid_pos"][j]
+    return data["valid_pos"][j], None
 
 
 def _with_walk_cursor(data, off):
@@ -890,15 +903,30 @@ def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
     applies iff the pytree carries a ``keep`` table — pytree structure is
     static at trace time)."""
     T = int(_distance_lut(config.window).shape[0])
+    W = config.window
 
     def pairs(data, key):
         corpus = data["corpus"]
         n_corpus = corpus.shape[0]
         ks = jax.random.split(key, 3)
-        p = _draw_centers(data, ks[0], batch)
+        p, stratum = _draw_centers(data, ks[0], batch)
         c = corpus[p]  # >= 0 by construction of valid_pos/walk_pos
         # one draw for (distance, direction): r in [0, 2T)
-        r = jax.random.randint(ks[1], (batch,), 0, 2 * T)
+        if stratum is None:
+            r = jax.random.randint(ks[1], (batch,), 0, 2 * T)
+        else:
+            # walk mode: quantile-stratify each position's W+1 per-epoch
+            # visits over the (direction, distance) distribution — visit k
+            # draws from stratum k of the offset CDF (2T = W(W+1) r-values
+            # split into exactly W+1 strata of width W), so a position's
+            # per-epoch offset set is low-discrepancy (word2vec emits each
+            # in-window offset exactly once; iid redraws miss/repeat them).
+            # The union of strata is the full space and u jitters uniformly
+            # within one, so the marginal distribution is unchanged.
+            n_strata = W + 1
+            u = jax.random.uniform(ks[1], (batch,))
+            q = ((stratum % n_strata).astype(jnp.float32) + u) / n_strata
+            r = jnp.minimum((q * (2 * T)).astype(jnp.int32), 2 * T - 1)
         d = data["dist_lut"][r % T]
         off = jnp.where(r < T, d, -d)
         qpos = p + off
@@ -1135,7 +1163,7 @@ def make_ondevice_general_superbatch_step(
             corpus = data["corpus"]
             n_corpus = corpus.shape[0]
             ks = jax.random.split(key, 4)
-            p = _draw_centers(data, ks[0], batch)
+            p, _ = _draw_centers(data, ks[0], batch)  # CBOW: no offset strata
             c = corpus[p]
             b = jax.random.randint(ks[1], (batch,), 1, W + 1)
             # np constant (not eager jnp): device-array constants cost a

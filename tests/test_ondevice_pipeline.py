@@ -513,3 +513,35 @@ def test_app_device_pipeline_chunked_upload():
     finally:
         mv.MV_ShutDown(finalize=True)
         ResetFlagsToDefault()
+
+
+def test_ondevice_walk_stratified_offsets_match_marginal():
+    """Walk mode stratifies each position's W+1 visits over the offset
+    CDF (round-4): over one FULL walk period (n_valid * (W+1) draws) the
+    distance marginal must still match word2vec's (W-d+1)/W shape, and
+    each position's visits must hit distinct strata (low discrepancy)."""
+    V, W = 64, 5
+    cfg = SkipGramConfig(vocab_size=V, dim=8, negatives=1, window=W)
+    n = 1 << 12
+    corpus_np = (np.arange(n, dtype=np.int32) % V)
+    B = 1 << 12  # one batch = one full permutation cycle (n_valid == B)
+    data = make_ondevice_data(
+        cfg, corpus_np, None, _toy_lut(V), batch=B, walk_seed=5
+    )
+    fn = jax.jit(make_ondevice_batch_fn(cfg, batch=B))
+    ds = []
+    for k in range(W + 1):  # cycles 0..W = strata 0..W
+        d = {**data, "walk_t": jnp.int32(k * n)}
+        c, o, w = fn(d, jax.random.PRNGKey(k))
+        c, t, w = np.asarray(c), np.asarray(o)[:, 0], np.asarray(w)
+        live = w > 0
+        dist = np.abs(((t[live] - c[live] + V // 2) % V) - V // 2)
+        ds.append(dist)
+    alld = np.concatenate(ds)
+    counts = np.array([(alld == k).sum() for k in range(1, W + 1)], float)
+    expect = np.array([W - k + 1 for k in range(1, W + 1)], float)
+    frac, ref = counts / counts.sum(), expect / expect.sum()
+    assert np.all(np.abs(frac - ref) < 0.02), (frac, ref)
+    # stratification: cycle 0 must be distance-1-heavy (low quantiles),
+    # the last cycle distance-W-heavy (top quantiles)
+    assert np.mean(ds[0]) < np.mean(ds[-1]), (np.mean(ds[0]), np.mean(ds[-1]))
