@@ -421,8 +421,11 @@ def _bench_sharded_vocab():
 
     if os.environ.get("MV_BENCH_SHARDED", "1") == "0":
         return {}
+    # the LOAD-BEARING quantity is the table size (V rows sharded x4); the
+    # corpus stays short so this CPU leg doesn't dominate bench wall-clock
+    # (12M pairs at ~20k CPU pairs/s would be ~10 min; 600k tokens ~3 min)
     V = int(os.environ.get("MV_BENCH_SHARDED_VOCAB", 2_000_000))
-    toks = int(os.environ.get("MV_BENCH_SHARDED_TOKENS", 2_000_000))
+    toks = int(os.environ.get("MV_BENCH_SHARDED_TOKENS", 600_000))
     code = r"""
 import os, sys, json, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"

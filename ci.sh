@@ -29,12 +29,20 @@ command -v luajit >/dev/null 2>&1 \
 echo "== multi-chip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== single-chip entry compile check =="
-python - <<'EOF'
-import jax, __graft_entry__ as g
+echo "== entry compile check (CPU-forced: CI must never block on an =="
+echo "== accelerator tunnel; the driver compile-checks on real HW)  =="
+# both the env var (covers import-time backend creation) and the live
+# config update (covers site hooks that override the env — measured: this
+# host's hook does) — the _ensure_devices belt-and-braces, inline
+JAX_PLATFORMS=cpu python - <<'EOF'
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+
 fn, args = g.entry()
 jax.jit(fn)(*args)
-print("entry OK")
+print("entry OK (cpu)")
 EOF
 
 echo "CI OK"
