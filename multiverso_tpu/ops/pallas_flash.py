@@ -9,11 +9,12 @@ blocks through VMEM, runs both matmuls on the MXU with f32 accumulation
 (m, l, acc) in VMEM scratch across the K-block grid dimension — no
 (S, S) score materialization, no HBM round trips between tiles.
 
-Scope: single-device FORWARD (the scoring/inference path and the ring's
-round-5 inner-kernel candidate). The differentiable training path stays
-on the jnp tile (``ring_attention_local``); integrating this kernel into
-the ring body needs carry-in/carry-out softmax state, which is the
-follow-up step.
+Two forms: ``flash_attention`` (single-device forward) and
+``flash_attention_carry`` (the resumable per-ring-step tile — state
+enters/leaves as arrays, consumed by
+``ring_attention(..., impl='flash')``). Both are FORWARD-only (no VJP);
+the differentiable training path stays on the jnp tile
+(``ring_attention_local`` with the default ``impl='xla'``).
 
 Reference parity note: the reference has no attention anywhere
 (SURVEY.md §5 — it predates transformers); this module is part of the
@@ -30,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_carry"]
 
 _NEG_INF = float("-inf")
 
@@ -168,3 +169,165 @@ def flash_attention(
         interpret=interpret,
     )(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_carry_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+                        m_out, l_out, acc_out, m_s, l_s, acc_s, *,
+                        scale, causal_diag, block_q, block_k, n_k):
+    """Carry variant: the streaming-softmax state (m, l, acc) enters and
+    leaves as ARRAYS instead of starting at -inf/0 — the tile a ring
+    device runs per rotation step, resumable across steps.
+
+    ``causal_diag`` statically masks k_pos > q_pos within the tile (the
+    ring's step-0 LOCAL block; with equal blocks every later tile is
+    either fully live or fully dead, decided by the caller). m/l ship as
+    (..., block_q) vectors; the VMEM scratch replicates them across the
+    lane dim like the non-carry kernel.
+    """
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _load():
+        m_s[...] = m_in[0, 0][:, None] * jnp.ones(
+            (1, m_s.shape[1]), jnp.float32
+        )
+        l_s[...] = l_in[0, 0][:, None] * jnp.ones(
+            (1, l_s.shape[1]), jnp.float32
+        )
+        acc_s[...] = acc_in[0, 0]
+
+    live = True
+    if causal_diag:
+        # a tile whose every key is in the future is fully masked: skip
+        # its matmuls (the caller's index map prunes its DMA too)
+        live = ki * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q.astype(k.dtype), k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal_diag:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_s[...]
+        row_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        # entering state may be -inf (first ring step) and diagonal rows
+        # may be fully masked: guard the exponents like the jnp tile does.
+        # Masked entries then give p = exp(-inf - finite) = 0 exactly —
+        # no second mask application needed.
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, :1])
+        corr = jnp.where(jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - safe_m))
+        l_s[...] = l_s[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[...] = m_new
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        m_out[0, 0] = m_s[:, 0]
+        l_out[0, 0] = l_s[:, 0]
+        acc_out[0, 0] = acc_s[...]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal_diag", "scale", "block_q", "block_k",
+                     "interpret"),
+)
+def flash_attention_carry(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    m: jnp.ndarray,
+    l: jnp.ndarray,
+    acc: jnp.ndarray,
+    *,
+    causal_diag: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """One resumable flash pass of K/V over Q, folding into (m, l, acc).
+
+    Shapes (the ring's per-device layout): q (B, Sq, H, D); k, v
+    (B, Sk, H, D); m, l (B, Sq, H) f32; acc (B, Sq, H, D) f32. Returns
+    the updated (m, l, acc) — finalize with ``acc / max(l, eps)``.
+    Initialize m to -inf and l/acc to 0 before the first pass.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    assert Sq % block_q == 0 and Sk % block_k == 0, (Sq, Sk, block_q, block_k)
+    if scale is None:
+        scale = D ** -0.5
+    n_q, n_k = Sq // block_q, Sk // block_k
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    mt = jnp.swapaxes(m, 1, 2)          # (B, H, Sq)
+    lt = jnp.swapaxes(l, 1, 2)
+    at = jnp.swapaxes(acc, 1, 2)        # (B, H, Sq, D)
+    kernel = functools.partial(
+        _flash_carry_kernel, scale=scale, causal_diag=causal_diag,
+        block_q=block_q, block_k=block_k, n_k=n_k,
+    )
+    state_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi))
+    acc_spec = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
+    )
+    if causal_diag:
+        # dead-tile DMA pruning (same trick as flash_attention): clamp the
+        # K/V block index to the last live block so skipped tiles re-request
+        # the previous block and Pallas elides the copy
+        def kv_idx(b, h, qi, ki):
+            return (b, h, jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k), 0)
+    else:
+        def kv_idx(b, h, qi, ki):
+            return (b, h, ki, 0)
+    m2, l2, a2 = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            state_spec,
+            state_spec,
+            acc_spec,
+        ],
+        out_specs=[state_spec, state_spec, acc_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret,
+    )(qt, kt, vt, mt, lt, at)
+    return (
+        jnp.swapaxes(m2, 1, 2),
+        jnp.swapaxes(l2, 1, 2),
+        jnp.swapaxes(a2, 1, 2),
+    )

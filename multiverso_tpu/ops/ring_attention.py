@@ -104,44 +104,23 @@ def _tile_update(m, l, acc, s, v, key_mask):
     return m_new, l, acc
 
 
-def ring_attention_local(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    axis_name: str,
-    causal: bool = False,
-    scale: Optional[float] = None,
-) -> jnp.ndarray:
-    """SPMD body: blockwise ring attention over ``axis_name``.
+def _ring_orchestrate(q, k, v, axis_name, causal, tile):
+    """ONE definition of the ring schedule shared by the xla and flash
+    tiles: step 0 folds the LOCAL block (src == my — no rotation needed,
+    so only n-1 ppermutes total), then each scan step rotates K/V one hop
+    and folds the visiting block; under ``causal`` a tile whose every key
+    position is in the future is skipped entirely (the predicate varies
+    per device, but the branches are collective-free, so divergence is
+    safe in manual/shard_map mode; covers Sq == Sk block layouts).
 
-    q, k, v are the *local* sequence blocks (B, S/n, H, D) of a
-    sequence-sharded global array. Returns the local block of the output.
-    Differentiable (the ring loop is a ``lax.scan``).
+    ``tile(m, l, acc, k_blk, v_blk, src, diag) -> (m, l, acc)`` folds one
+    block; ``diag`` marks the step-0 local (diagonal-causal) call.
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    qf = q.astype(jnp.float32) * scale
-
-    q_pos = my * Sq + jnp.arange(Sq)  # global positions of local queries
     perm = [(j, (j + 1) % n) for j in range(n)]
-
-    def tile(m, l, acc, k_blk, v_blk, src):
-        s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
-        if causal:
-            k_pos = src * Sk + jnp.arange(Sk)
-            mask = k_pos[None, :] <= q_pos[:, None]  # (Sq, Sk)
-            mask = jnp.broadcast_to(mask[None, :, None, :], s.shape)
-        else:
-            mask = None  # unmasked tile: skip the masked selects entirely
-        return _tile_update(m, l, acc, s, v_blk, mask)
-
-    # Step 0 is the local block (src == my): no rotation needed before it,
-    # and folding it out of the scan means only n-1 ppermutes total (the
-    # final rotation's result would otherwise be computed and discarded).
     m, l, acc = tile(
         jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
         jnp.zeros((B, Sq, H), jnp.float32),
@@ -149,31 +128,29 @@ def ring_attention_local(
         k,
         v,
         my,
+        True,
     )
 
     def body(carry, step):
         m, l, acc, k_blk, v_blk = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        # After `step` rotations each device holds the block that started on
-        # device (my - step) mod n.
+        # After `step` rotations each device holds the block that started
+        # on device (my - step) mod n.
         src = (my - step) % n
         if causal:
-            # A tile whose every key position is in the future contributes
-            # nothing — skip its FLOPs. The predicate varies per device but
-            # the branches are collective-free, so divergence is safe in
-            # manual (shard_map) mode. Covers Sq == Sk block layouts; with
-            # unequal blocks fall back to exact position comparison.
             first_k = src * Sk
             last_q = my * Sq + Sq - 1
             m, l, acc = lax.cond(
                 first_k > last_q,
                 lambda m, l, acc, *_: (m, l, acc),
-                lambda m, l, acc, kb, vb, s: tile(m, l, acc, kb, vb, s),
+                lambda m, l, acc, kb, vb, s: tile(
+                    m, l, acc, kb, vb, s, False
+                ),
                 m, l, acc, k_blk, v_blk, src,
             )
         else:
-            m, l, acc = tile(m, l, acc, k_blk, v_blk, src)
+            m, l, acc = tile(m, l, acc, k_blk, v_blk, src, False)
         return (m, l, acc, k_blk, v_blk), ()
 
     if n > 1:
@@ -182,6 +159,76 @@ def ring_attention_local(
         )
     out = acc / jnp.maximum(l, 1e-37)[..., None]
     return out.astype(q.dtype)
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    impl: str = "xla",
+    flash_block: int = 512,
+    flash_interpret: bool = False,
+) -> jnp.ndarray:
+    """SPMD body: blockwise ring attention over ``axis_name``.
+
+    q, k, v are the *local* sequence blocks (B, S/n, H, D) of a
+    sequence-sharded global array. Returns the local block of the output.
+    Differentiable (the ring loop is a ``lax.scan``) with the default
+    ``impl='xla'`` jnp tile; ``impl='flash'`` swaps in the fused Pallas
+    MXU tile (ops/pallas_flash.py ``flash_attention_carry`` — the
+    streaming-softmax state carries across ring steps as arrays;
+    forward-only, no VJP; ``flash_interpret=True`` for non-TPU backends;
+    ``flash_block`` tunes the Pallas tile, auto-shrunk to divide the
+    local blocks).
+    """
+    my = lax.axis_index(axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+
+    if impl == "flash":
+        from multiverso_tpu.ops.pallas_flash import flash_attention_carry
+
+        if causal:
+            assert Sq == Sk, "flash ring causal requires equal q/k blocks"
+        bq, bk = min(flash_block, Sq), min(flash_block, Sk)
+        while bq > 1 and Sq % bq:
+            bq //= 2
+        while bk > 1 and Sk % bk:
+            bk //= 2
+        kw = dict(
+            scale=scale, block_q=bq, block_k=bk, interpret=flash_interpret
+        )
+
+        def flash_tile(m, l, acc, k_blk, v_blk, src, diag):
+            return flash_attention_carry(
+                q, k_blk, v_blk, m, l, acc,
+                causal_diag=causal and diag, **kw
+            )
+
+        return _ring_orchestrate(q, k, v, axis_name, causal, flash_tile)
+
+    assert impl == "xla", impl
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my * Sq + jnp.arange(Sq)  # global positions of local queries
+
+    def xla_tile(m, l, acc, k_blk, v_blk, src, diag):
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            # the generic global-position mask covers both the step-0
+            # diagonal tile and fully-live rotated tiles
+            k_pos = src * Sk + jnp.arange(Sk)
+            mask = k_pos[None, :] <= q_pos[:, None]  # (Sq, Sk)
+            mask = jnp.broadcast_to(mask[None, :, None, :], s.shape)
+        else:
+            mask = None  # unmasked tile: skip the masked selects entirely
+        return _tile_update(m, l, acc, s, v_blk, mask)
+
+    return _ring_orchestrate(q, k, v, axis_name, causal, xla_tile)
 
 
 def zigzag_ring_attention_local(
@@ -378,6 +425,10 @@ def _wrap(mesh: Mesh, seq_axis: str, local_fn, q, k, v, scale,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axes annotation, so
+        # the flash tile cannot satisfy shard_map's vma check; the xla
+        # tile keeps full checking
+        check_vma=local_kw.get("impl") != "flash",
     )
     sharding = NamedSharding(mesh, spec)
     args = [
@@ -396,11 +447,17 @@ def ring_attention(
     seq_axis: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "xla",
+    flash_block: int = 512,
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """Global-array entry point: shards (B,S,H,D) inputs over ``seq_axis``
-    of ``mesh`` and runs blockwise ring attention."""
+    of ``mesh`` and runs blockwise ring attention. ``impl='flash'`` uses
+    the fused Pallas MXU tile (forward-only); ``flash_block`` tunes the
+    Pallas tile size (auto-shrunk to divide the per-device blocks)."""
     return _wrap(mesh, seq_axis, ring_attention_local, q, k, v, scale,
-                 causal=causal)
+                 causal=causal, impl=impl, flash_block=flash_block,
+                 flash_interpret=flash_interpret)
 
 
 def ulysses_attention(
