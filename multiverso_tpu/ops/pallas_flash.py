@@ -9,12 +9,13 @@ blocks through VMEM, runs both matmuls on the MXU with f32 accumulation
 (m, l, acc) in VMEM scratch across the K-block grid dimension — no
 (S, S) score materialization, no HBM round trips between tiles.
 
-Two forms: ``flash_attention`` (single-device forward) and
+Two forms: ``flash_attention`` (single-device, DIFFERENTIABLE — a
+custom VJP recomputes softmax tiles from the saved logsumexp residual,
+the standard flash backward, in two more Pallas kernels) and
 ``flash_attention_carry`` (the resumable per-ring-step tile — state
 enters/leaves as arrays, consumed by
-``ring_attention(..., impl='flash')``). Both are FORWARD-only (no VJP);
-the differentiable training path stays on the jnp tile
-(``ring_attention_local`` with the default ``impl='xla'``).
+``ring_attention(..., impl='flash')``; forward-only, so the
+differentiable RING path stays on the jnp tile, default ``impl='xla'``).
 
 Reference parity note: the reference has no attention anywhere
 (SURVEY.md §5 — it predates transformers); this module is part of the
@@ -36,7 +37,7 @@ __all__ = ["flash_attention", "flash_attention_carry"]
 _NEG_INF = float("-inf")
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
                   scale, causal, block_q, block_k, n_k):
     """Grid step = one (b, h, qi, ki) tile; ki is the innermost grid dim,
     so the VMEM scratch (m, l, acc) carries the streaming softmax across
@@ -99,6 +100,95 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
         o_ref[0, 0] = (
             acc_s[...] / jnp.maximum(l_s[:, :1], 1e-37)
         ).astype(o_ref.dtype)
+        # per-row logsumexp residual for the backward's softmax recompute
+        lse_ref[0, 0] = (
+            m_s[:, 0] + jnp.log(jnp.maximum(l_s[:, 0], 1e-37))
+        )
+
+
+def _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Transposed-layout forward returning (out_t, lse_t) — shared by the
+    public forward and the custom-VJP rule (which keeps lse as the
+    softmax-recompute residual)."""
+    B, S, H, D = q.shape
+    n_q, n_k = S // block_q, S // block_k
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_k=n_k,
+    )
+    kv_idx = _kv_idx_map(causal, block_q, block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
+            ),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # normalizer l
+            pltpu.VMEM((block_q, D), jnp.float32),    # accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out, lse
+
+
+def _kv_idx_map(causal, block_q, block_k):
+    """K/V BlockSpec index map with dead-tile DMA pruning under causal:
+    a tile whose first key is past the last query contributes nothing
+    (pl.when skips its compute), and clamping the block index to the last
+    LIVE block makes dead steps re-request the previous block — Pallas
+    elides the copy when the index is unchanged, so causal runs move
+    ~half the K/V traffic."""
+    if causal:
+        def kv_idx(b, h, qi, ki):
+            return (
+                b, h,
+                jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k),
+                0,
+            )
+    else:
+        def kv_idx(b, h, qi, ki):
+            return (b, h, ki, 0)
+    return kv_idx
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret)
+    return jnp.swapaxes(out, 1, 2), (q, k, v, out, lse)
+
+
+def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, dout):
+    q, k, v, out_t, lse = res
+    dq, dk, dv = _bwd_core(
+        q, k, v, out_t, lse, jnp.swapaxes(dout, 1, 2),
+        causal, scale, block_q, block_k, interpret,
+    )
+    return dq, dk, dv
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 @functools.partial(
@@ -120,55 +210,15 @@ def flash_attention(
     convention). ``S`` must divide by both block sizes; ``D`` should be a
     lane multiple (128) on real TPUs. ``interpret=True`` runs the Pallas
     interpreter (CPU tests / non-TPU backends). Matches
-    ``attention_reference`` to f32 reduction order."""
+    ``attention_reference`` to f32 reduction order. DIFFERENTIABLE: a
+    custom VJP recomputes softmax tiles from the saved logsumexp
+    residual (the standard flash backward) in two Pallas kernels."""
     B, S, H, D = q.shape
     assert k.shape == v.shape == (B, S, H, D), (q.shape, k.shape, v.shape)
     assert S % block_q == 0 and S % block_k == 0, (S, block_q, block_k)
     if scale is None:
         scale = D ** -0.5
-    n_q, n_k = S // block_q, S // block_k
-    # (B, H, S, D) layout: one (b, h) pair per outer grid step
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, n_k=n_k,
-    )
-    if causal:
-        # Dead-tile DMA pruning: a tile whose first key is past the last
-        # query contributes nothing (pl.when skips its compute), but its
-        # K/V block fetch would still run. Clamping the index map to the
-        # last LIVE block makes the dead steps re-request the previous
-        # block — Pallas elides the copy when the block index is
-        # unchanged, so causal runs move ~half the K/V traffic.
-        last_live = lambda qi: ((qi + 1) * block_q - 1) // block_k
-
-        def kv_idx(b, h, qi, ki):
-            return (b, h, jnp.minimum(ki, last_live(qi)), 0)
-    else:
-        def kv_idx(b, h, qi, ki):
-            return (b, h, ki, 0)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B, H, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), kv_idx),
-            pl.BlockSpec((1, 1, block_k, D), kv_idx),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
-            pltpu.VMEM((block_q, 128), jnp.float32),  # normalizer l
-            pltpu.VMEM((block_q, D), jnp.float32),    # accumulator
-        ],
-        interpret=interpret,
-    )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+    return _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def _flash_carry_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
@@ -267,23 +317,19 @@ def flash_attention_carry(
 ):
     """One resumable flash pass of K/V over Q, folding into (m, l, acc).
 
-    Shapes (the ring's per-device layout): q (B, Sq, H, D); k, v
-    (B, Sk, H, D); m, l (B, Sq, H) f32; acc (B, Sq, H, D) f32. Returns
-    the updated (m, l, acc) — finalize with ``acc / max(l, eps)``.
-    Initialize m to -inf and l/acc to 0 before the first pass.
+    EVERYTHING rides the kernel layout — q (B, H, Sq, D); k, v
+    (B, H, Sk, D); m, l (B, H, Sq) f32; acc (B, H, Sq, D) f32 — so a
+    ring caller transposes once at entry/exit instead of six state
+    copies per ring step. Returns the updated (m, l, acc); finalize with
+    ``acc / max(l, eps)``. Initialize m to -inf and l/acc to 0 before
+    the first pass.
     """
-    B, Sq, H, D = q.shape
-    Sk = k.shape[1]
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
     assert Sq % block_q == 0 and Sk % block_k == 0, (Sq, Sk, block_q, block_k)
     if scale is None:
         scale = D ** -0.5
     n_q, n_k = Sq // block_q, Sk // block_k
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    mt = jnp.swapaxes(m, 1, 2)          # (B, H, Sq)
-    lt = jnp.swapaxes(l, 1, 2)
-    at = jnp.swapaxes(acc, 1, 2)        # (B, H, Sq, D)
     kernel = functools.partial(
         _flash_carry_kernel, scale=scale, causal_diag=causal_diag,
         block_q=block_q, block_k=block_k, n_k=n_k,
@@ -292,16 +338,8 @@ def flash_attention_carry(
     acc_spec = pl.BlockSpec(
         (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
     )
-    if causal_diag:
-        # dead-tile DMA pruning (same trick as flash_attention): clamp the
-        # K/V block index to the last live block so skipped tiles re-request
-        # the previous block and Pallas elides the copy
-        def kv_idx(b, h, qi, ki):
-            return (b, h, jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k), 0)
-    else:
-        def kv_idx(b, h, qi, ki):
-            return (b, h, ki, 0)
-    m2, l2, a2 = pl.pallas_call(
+    kv_idx = _kv_idx_map(causal_diag, block_q, block_k)
+    return pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
         in_specs=[
@@ -325,9 +363,192 @@ def flash_attention_carry(
         ],
         input_output_aliases={3: 0, 4: 1, 5: 2},
         interpret=interpret,
-    )(qt, kt, vt, mt, lt, at)
+    )(q, k, v, m, l, acc)
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                    qi, ki, scale, causal, block_q, block_k):
+    """Shared softmax-tile recompute for BOTH backward kernels: returns
+    (p, ds) with p = softmax tile from the saved lse and
+    ds = p * (dO V^T - D_row). One definition — a numerics change here
+    cannot desynchronize dQ from dK/dV."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]          # (block_q,)
+    dvec = dvec_ref[0, 0]        # (block_q,)
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(s - lse[:, None])
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        p = jnp.where(k_pos <= q_pos, p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - dvec[:, None])
+    return p, ds
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                     dq_ref, dq_s, *, scale, causal, block_q, block_k, n_k):
+    """dQ pass: grid (B, H, nQ, nK), K innermost. Recomputes each tile's
+    softmax from the saved lse, folds ds @ K into the dQ accumulator.
+
+    ds = p * (dO V^T - D_row), dQ = scale * ds K   (standard flash bwd)
+    """
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    live = True
+    if causal:
+        live = ki * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(live)
+    def _tile():
+        _, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+            qi, ki, scale, causal, block_q, block_k,
+        )
+        k = k_ref[0, 0].astype(jnp.float32)
+        dq_s[...] = dq_s[...] + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                      dk_ref, dv_ref, dk_s, dv_s, *,
+                      scale, causal, block_q, block_k, n_q):
+    """dK/dV pass: grid (B, H, nK, nQ), Q innermost. For a fixed K block,
+    streams the Q blocks: dV += p^T dO, dK += scale * ds^T Q."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    live = True
+    if causal:
+        # a Q block entirely above the diagonal of this K block is dead
+        live = (qi + 1) * block_q - 1 >= ki * block_k
+
+    @pl.when(live)
+    def _tile():
+        p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+            qi, ki, scale, causal, block_q, block_k,
+        )
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dv_s[...] = dv_s[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_s[...] = dk_s[...] + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
+              block_q, block_k, interpret):
+    """Flash backward: D_row preprocess + two Pallas passes. Inputs
+    q/k/v in the public (B, S, H, D) layout; out_t/do_t/lse transposed."""
+    B, S, H, D = q.shape
+    n_q, n_k = S // block_q, S // block_k
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    # D_row = rowsum(dO * O): tiny elementwise pass, stays in jnp
+    dvec = jnp.sum(
+        do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
+    )  # (B, H, S)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi))
+    kv_spec = pl.BlockSpec((1, 1, block_k, D), _kv_idx_map(causal, block_q, block_k))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_k=n_k,
+        ),
+        grid=(B, H, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, do_t, lse, dvec)
+
+    # dK/dV pass: K outer, Q inner. Under causal, Q blocks strictly above
+    # this K block's diagonal are dead; clamp their DMA to the first live
+    # Q block — floor(ki*block_k / block_q), the block containing this
+    # K block's first key — so the copies elide.
+    if causal:
+        def q_idx(b, h, ki, qi):
+            return (
+                b, h, jnp.maximum(qi, (ki * block_k) // block_q), 0
+            )
+
+        def qrow_idx(b, h, ki, qi):
+            return (b, h, jnp.maximum(qi, (ki * block_k) // block_q))
+    else:
+        def q_idx(b, h, ki, qi):
+            return (b, h, qi, 0)
+
+        def qrow_idx(b, h, ki, qi):
+            return (b, h, qi)
+    kv_out_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)
+    )
+    q_in_spec = pl.BlockSpec((1, 1, block_q, D), q_idx)
+    row_in_spec = pl.BlockSpec((1, 1, block_q), qrow_idx)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_q=n_q,
+        ),
+        grid=(B, H, n_k, n_q),
+        in_specs=[q_in_spec, kv_out_spec, kv_out_spec, q_in_spec,
+                  row_in_spec, row_in_spec],
+        out_specs=[kv_out_spec, kv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, do_t, lse, dvec)
     return (
-        jnp.swapaxes(m2, 1, 2),
-        jnp.swapaxes(l2, 1, 2),
-        jnp.swapaxes(a2, 1, 2),
+        jnp.swapaxes(dq, 1, 2),
+        jnp.swapaxes(dk, 1, 2),
+        jnp.swapaxes(dv, 1, 2),
     )

@@ -104,7 +104,8 @@ def _tile_update(m, l, acc, s, v, key_mask):
     return m_new, l, acc
 
 
-def _ring_orchestrate(q, k, v, axis_name, causal, tile):
+def _ring_orchestrate(q, k, v, axis_name, causal, tile, init_state,
+                      finalize, seq_dim=1):
     """ONE definition of the ring schedule shared by the xla and flash
     tiles: step 0 folds the LOCAL block (src == my — no rotation needed,
     so only n-1 ppermutes total), then each scan step rotates K/V one hop
@@ -113,26 +114,20 @@ def _ring_orchestrate(q, k, v, axis_name, causal, tile):
     per device, but the branches are collective-free, so divergence is
     safe in manual/shard_map mode; covers Sq == Sk block layouts).
 
-    ``tile(m, l, acc, k_blk, v_blk, src, diag) -> (m, l, acc)`` folds one
-    block; ``diag`` marks the step-0 local (diagonal-causal) call.
+    Layout-agnostic: the tile impl owns the streaming-state pytree
+    (``init_state() -> state``, ``tile(state, k_blk, v_blk, src, diag) ->
+    state``, ``finalize(state) -> out``); ``seq_dim`` locates the
+    sequence axis of q/k/v for the causal skip arithmetic.
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
-    B, Sq, H, D = q.shape
-    Sk = k.shape[1]
+    Sq = q.shape[seq_dim]
+    Sk = k.shape[seq_dim]
     perm = [(j, (j + 1) % n) for j in range(n)]
-    m, l, acc = tile(
-        jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
-        jnp.zeros((B, Sq, H), jnp.float32),
-        jnp.zeros((B, Sq, H, D), jnp.float32),
-        k,
-        v,
-        my,
-        True,
-    )
+    state = tile(init_state(), k, v, my, True)
 
     def body(carry, step):
-        m, l, acc, k_blk, v_blk = carry
+        state, k_blk, v_blk = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         # After `step` rotations each device holds the block that started
@@ -141,24 +136,21 @@ def _ring_orchestrate(q, k, v, axis_name, causal, tile):
         if causal:
             first_k = src * Sk
             last_q = my * Sq + Sq - 1
-            m, l, acc = lax.cond(
+            state = lax.cond(
                 first_k > last_q,
-                lambda m, l, acc, *_: (m, l, acc),
-                lambda m, l, acc, kb, vb, s: tile(
-                    m, l, acc, kb, vb, s, False
-                ),
-                m, l, acc, k_blk, v_blk, src,
+                lambda state, *_: state,
+                lambda state, kb, vb, s: tile(state, kb, vb, s, False),
+                state, k_blk, v_blk, src,
             )
         else:
-            m, l, acc = tile(m, l, acc, k_blk, v_blk, src, False)
-        return (m, l, acc, k_blk, v_blk), ()
+            state = tile(state, k_blk, v_blk, src, False)
+        return (state, k_blk, v_blk), ()
 
     if n > 1:
-        (m, l, acc, _, _), _ = lax.scan(
-            body, (m, l, acc, k, v), jnp.arange(1, n)
+        (state, _, _), _ = lax.scan(
+            body, (state, k, v), jnp.arange(1, n)
         )
-    out = acc / jnp.maximum(l, 1e-37)[..., None]
-    return out.astype(q.dtype)
+    return finalize(state)
 
 
 def ring_attention_local(
@@ -184,7 +176,6 @@ def ring_attention_local(
     ``flash_block`` tunes the Pallas tile, auto-shrunk to divide the
     local blocks).
     """
-    my = lax.axis_index(axis_name)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     B, Sq, H, D = q.shape
@@ -203,20 +194,52 @@ def ring_attention_local(
         kw = dict(
             scale=scale, block_q=bq, block_k=bk, interpret=flash_interpret
         )
+        # everything rides the kernel's (B, H, S, D) layout through the
+        # whole ring — ONE transpose at entry/exit instead of state
+        # copies on every ring step (K/V rotate transposed; ppermute is
+        # layout-agnostic)
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
 
-        def flash_tile(m, l, acc, k_blk, v_blk, src, diag):
+        def flash_init():
+            return (
+                jnp.full((B, H, Sq), _NEG_INF, jnp.float32),
+                jnp.zeros((B, H, Sq), jnp.float32),
+                jnp.zeros((B, H, Sq, D), jnp.float32),
+            )
+
+        def flash_tile(state, k_blk, v_blk, src, diag):
+            m, l, acc = state
             return flash_attention_carry(
-                q, k_blk, v_blk, m, l, acc,
+                qt, k_blk, v_blk, m, l, acc,
                 causal_diag=causal and diag, **kw
             )
 
-        return _ring_orchestrate(q, k, v, axis_name, causal, flash_tile)
+        def flash_finalize(state):
+            m, l, acc = state
+            out = acc / jnp.maximum(l, 1e-37)[..., None]
+            return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+        return _ring_orchestrate(
+            qt, kt, vt, axis_name, causal, flash_tile, flash_init,
+            flash_finalize, seq_dim=2,
+        )
 
     assert impl == "xla", impl
+    my = lax.axis_index(axis_name)  # xla tile needs global q positions
     qf = q.astype(jnp.float32) * scale
-    q_pos = my * Sq + jnp.arange(Sq)  # global positions of local queries
+    q_pos = my * Sq + jnp.arange(Sq)
 
-    def xla_tile(m, l, acc, k_blk, v_blk, src, diag):
+    def xla_init():
+        return (
+            jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
+            jnp.zeros((B, Sq, H), jnp.float32),
+            jnp.zeros((B, Sq, H, D), jnp.float32),
+        )
+
+    def xla_tile(state, k_blk, v_blk, src, diag):
+        m, l, acc = state
         s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
         if causal:
             # the generic global-position mask covers both the step-0
@@ -228,7 +251,14 @@ def ring_attention_local(
             mask = None  # unmasked tile: skip the masked selects entirely
         return _tile_update(m, l, acc, s, v_blk, mask)
 
-    return _ring_orchestrate(q, k, v, axis_name, causal, xla_tile)
+    def xla_finalize(state):
+        m, l, acc = state
+        out = acc / jnp.maximum(l, 1e-37)[..., None]
+        return out.astype(q.dtype)
+
+    return _ring_orchestrate(
+        q, k, v, axis_name, causal, xla_tile, xla_init, xla_finalize
+    )
 
 
 def zigzag_ring_attention_local(
