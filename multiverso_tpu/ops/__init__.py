@@ -6,6 +6,10 @@ hand-written fused kernel for the embedding hot path with measured tradeoffs
 (see its module docstring for the benchmark discussion).
 """
 
+from multiverso_tpu.ops.pallas_flash import (
+    flash_attention,
+    flash_attention_carry,
+)
 from multiverso_tpu.ops.ring_attention import (
     attention_reference,
     ring_attention,
@@ -22,6 +26,8 @@ __all__ = [
     "scatter_add_rows",
     "segment_combine_rows",
     "attention_reference",
+    "flash_attention",
+    "flash_attention_carry",
     "ring_attention",
     "ring_attention_local",
     "ulysses_attention",
