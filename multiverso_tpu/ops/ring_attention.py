@@ -104,6 +104,15 @@ def _tile_update(m, l, acc, s, v, key_mask):
     return m_new, l, acc
 
 
+def _fit_block(seq_len: int, block: int) -> int:
+    """Largest power-of-two block <= ``block`` that divides ``seq_len`` —
+    ONE policy for every flash-tile caller (ring bq/bk and Ulysses)."""
+    b = min(block, seq_len)
+    while b > 1 and seq_len % b:
+        b //= 2
+    return b
+
+
 def _ring_orchestrate(q, k, v, axis_name, causal, tile, init_state,
                       finalize, seq_dim=1):
     """ONE definition of the ring schedule shared by the xla and flash
@@ -186,11 +195,7 @@ def ring_attention_local(
 
         if causal:
             assert Sq == Sk, "flash ring causal requires equal q/k blocks"
-        bq, bk = min(flash_block, Sq), min(flash_block, Sk)
-        while bq > 1 and Sq % bq:
-            bq //= 2
-        while bk > 1 and Sk % bk:
-            bk //= 2
+        bq, bk = _fit_block(Sq, flash_block), _fit_block(Sk, flash_block)
         kw = dict(
             scale=scale, block_q=bq, block_k=bk, interpret=flash_interpret
         )
@@ -409,20 +414,44 @@ def ulysses_attention_local(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "xla",
+    flash_block: int = 512,
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """SPMD body: Ulysses all-to-all attention over ``axis_name``.
 
     Local inputs are sequence blocks (B, S/n, H, D) with ``H % n == 0``.
-    One tiled all_to_all re-shards to (B, S, H/n, D), dense attention runs
-    on the full sequence for the local head group, and a second all_to_all
-    restores sequence sharding.
+    One tiled all_to_all re-shards to (B, S, H/n, D), attention runs on
+    the full sequence for the local head group, and a second all_to_all
+    restores sequence sharding. ``impl='xla'`` is the dense reference —
+    O(S^2) score memory; ``impl='flash'`` runs the fused Pallas flash
+    kernel instead (O(S x block) memory, MXU matmuls) and REMAINS
+    differentiable (flash_attention carries a custom VJP).
     """
     a2a = functools.partial(lax.all_to_all, axis_name=axis_name, tiled=True)
     # (B, S/n, H, D) -> (B, S, H/n, D): split heads across the axis, gather seq
     qh = a2a(q, split_axis=2, concat_axis=1)
     kh = a2a(k, split_axis=2, concat_axis=1)
     vh = a2a(v, split_axis=2, concat_axis=1)
-    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    if impl == "flash":
+        from multiverso_tpu.ops.pallas_flash import flash_attention
+
+        if kh.shape[1] != qh.shape[1]:
+            # flash_attention assumes one S for Q and K/V; the dense xla
+            # impl covers cross-attention (k/v seq != q seq)
+            raise ValueError(
+                "ulysses impl='flash' requires equal q/k sequence lengths "
+                f"(q {qh.shape[1]} vs k {kh.shape[1]}); use impl='xla' "
+                "for cross-attention"
+            )
+        b = _fit_block(qh.shape[1], flash_block)
+        out = flash_attention(
+            qh, kh, vh, causal=causal, scale=scale,
+            block_q=b, block_k=b, interpret=flash_interpret,
+        )
+    else:
+        assert impl == "xla", impl
+        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
     # (B, S, H/n, D) -> (B, S/n, H, D)
     return a2a(out, split_axis=1, concat_axis=2)
 
@@ -498,11 +527,17 @@ def ulysses_attention(
     seq_axis: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "xla",
+    flash_block: int = 512,
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """Global-array entry point for Ulysses all-to-all attention. Requires
-    ``num_heads`` divisible by the ``seq_axis`` size."""
+    ``num_heads`` divisible by the ``seq_axis`` size. ``impl='flash'``
+    swaps the dense local attention for the fused Pallas flash kernel
+    (O(S x block) memory; still differentiable)."""
     n = int(mesh.shape[seq_axis])
     if q.shape[2] % n:
         raise ValueError(f"num_heads {q.shape[2]} not divisible by {n} devices")
     return _wrap(mesh, seq_axis, ulysses_attention_local, q, k, v, scale,
-                 causal=causal)
+                 causal=causal, impl=impl, flash_block=flash_block,
+                 flash_interpret=flash_interpret)
