@@ -272,6 +272,9 @@ def zigzag_ring_attention_local(
     v: jnp.ndarray,
     axis_name: str,
     scale: Optional[float] = None,
+    impl: str = "xla",
+    flash_block: int = 512,
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """SPMD body: CAUSAL ring attention with the zigzag chunk layout.
 
@@ -310,44 +313,113 @@ def zigzag_ring_attention_local(
         scale = q.shape[-1] ** -0.5
     B, Sq, H, D = q.shape
     c = Sq // 2
-    qf = q.astype(jnp.float32) * scale
-    ar = jnp.arange(c)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    # local step: both chunk pairs of one device — position-masked full tile
-    q_pos = jnp.concatenate([my * c + ar, (2 * n - 1 - my) * c + ar])
-    s0 = jnp.einsum("bqhd,bkhd->bqhk", qf, k.astype(jnp.float32))
-    mask0 = jnp.broadcast_to(
-        (q_pos[None, :] <= q_pos[:, None])[None, :, None, :], s0.shape
-    )
-    m, l, acc = _tile_update(
-        jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
-        jnp.zeros((B, Sq, H), jnp.float32),
-        jnp.zeros((B, Sq, H, D), jnp.float32),
-        s0,
-        v,
-        mask0,
-    )
+    if impl == "flash":
+        # Same schedule, fused Pallas tiles (forward-only like the flash
+        # ring). The chunk structure maps exactly onto the carry kernel's
+        # two mask forms: chunk-vs-same-chunk sub-tiles are
+        # diagonal-causal at EQUAL local offsets (causal_diag), every
+        # other live sub-tile is fully live (no mask). Local step =
+        # (lo,lo diag) + (hi,lo full) + (hi,hi diag); rotated steps are
+        # the same one full tile per step as the jnp path. State rides
+        # the kernel's (B, H, 2c[, D]) layout end to end.
+        from multiverso_tpu.ops.pallas_flash import flash_attention_carry
 
-    def low_kv(ops):
-        # src < my: every local query attends the incoming LOW chunk only
-        m, l, acc, kb, vb = ops
-        s = jnp.einsum("bqhd,bkhd->bqhk", qf, kb[:, :c].astype(jnp.float32))
-        return _tile_update(m, l, acc, s, vb[:, :c], None)
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        bb = _fit_block(c, flash_block)  # c-sub-tiles; 2c tiles divide too
+        kw = dict(scale=scale, block_q=bb, block_k=bb,
+                  interpret=flash_interpret)
+        m = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H, Sq), jnp.float32)
+        acc = jnp.zeros((B, H, Sq, D), jnp.float32)
+        m1, l1, a1 = flash_attention_carry(
+            qt[:, :, :c], kt[:, :, :c], vt[:, :, :c],
+            m[:, :, :c], l[:, :, :c], acc[:, :, :c],
+            causal_diag=True, **kw,
+        )
+        mh, lh, ah = flash_attention_carry(
+            qt[:, :, c:], kt[:, :, :c], vt[:, :, :c],
+            m[:, :, c:], l[:, :, c:], acc[:, :, c:],
+            causal_diag=False, **kw,
+        )
+        mh, lh, ah = flash_attention_carry(
+            qt[:, :, c:], kt[:, :, c:], vt[:, :, c:],
+            mh, lh, ah, causal_diag=True, **kw,
+        )
+        m = jnp.concatenate([m1, mh], axis=2)
+        l = jnp.concatenate([l1, lh], axis=2)
+        acc = jnp.concatenate([a1, ah], axis=2)
 
-    def high_q(ops):
-        # src > my: only the local HIGH query chunk attends, but to both
-        # incoming chunks — update that row slice of the running state
-        m, l, acc, kb, vb = ops
-        s = jnp.einsum(
-            "bqhd,bkhd->bqhk", qf[:, c:], kb.astype(jnp.float32)
+        def low_kv(ops):
+            m, l, acc, kb, vb = ops
+            return flash_attention_carry(
+                qt, kb[:, :, :c], vb[:, :, :c], m, l, acc,
+                causal_diag=False, **kw,
+            )
+
+        def high_q(ops):
+            m, l, acc, kb, vb = ops
+            m2, l2, a2 = flash_attention_carry(
+                qt[:, :, c:], kb, vb,
+                m[:, :, c:], l[:, :, c:], acc[:, :, c:],
+                causal_diag=False, **kw,
+            )
+            return (
+                jnp.concatenate([m[:, :, :c], m2], axis=2),
+                jnp.concatenate([l[:, :, :c], l2], axis=2),
+                jnp.concatenate([acc[:, :, :c], a2], axis=2),
+            )
+
+        kv0 = (kt, vt)
+    else:
+        assert impl == "xla", impl
+        qf = q.astype(jnp.float32) * scale
+        ar = jnp.arange(c)
+
+        # local step: both chunk pairs of one device — position-masked
+        # full tile
+        q_pos = jnp.concatenate([my * c + ar, (2 * n - 1 - my) * c + ar])
+        s0 = jnp.einsum("bqhd,bkhd->bqhk", qf, k.astype(jnp.float32))
+        mask0 = jnp.broadcast_to(
+            (q_pos[None, :] <= q_pos[:, None])[None, :, None, :], s0.shape
         )
-        m2, l2, acc2 = _tile_update(m[:, c:], l[:, c:], acc[:, c:], s, vb, None)
-        return (
-            jnp.concatenate([m[:, :c], m2], axis=1),
-            jnp.concatenate([l[:, :c], l2], axis=1),
-            jnp.concatenate([acc[:, :c], acc2], axis=1),
+        m, l, acc = _tile_update(
+            jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
+            jnp.zeros((B, Sq, H), jnp.float32),
+            jnp.zeros((B, Sq, H, D), jnp.float32),
+            s0,
+            v,
+            mask0,
         )
+
+        def low_kv(ops):
+            # src < my: every local query attends the incoming LOW chunk
+            m, l, acc, kb, vb = ops
+            s = jnp.einsum(
+                "bqhd,bkhd->bqhk", qf, kb[:, :c].astype(jnp.float32)
+            )
+            return _tile_update(m, l, acc, s, vb[:, :c], None)
+
+        def high_q(ops):
+            # src > my: only the local HIGH query chunk attends, but to
+            # both incoming chunks — update that row slice of the state
+            m, l, acc, kb, vb = ops
+            s = jnp.einsum(
+                "bqhd,bkhd->bqhk", qf[:, c:], kb.astype(jnp.float32)
+            )
+            m2, l2, acc2 = _tile_update(
+                m[:, c:], l[:, c:], acc[:, c:], s, vb, None
+            )
+            return (
+                jnp.concatenate([m[:, :c], m2], axis=1),
+                jnp.concatenate([l[:, :c], l2], axis=1),
+                jnp.concatenate([acc[:, :c], acc2], axis=1),
+            )
+
+        kv0 = (k, v)
 
     def body(carry, step):
         m, l, acc, k_blk, v_blk = carry
@@ -361,9 +433,11 @@ def zigzag_ring_attention_local(
 
     if n > 1:
         (m, l, acc, _, _), _ = lax.scan(
-            body, (m, l, acc, k, v), jnp.arange(1, n)
+            body, (m, l, acc, *kv0), jnp.arange(1, n)
         )
     out = acc / jnp.maximum(l, 1e-37)[..., None]
+    if impl == "flash":
+        out = jnp.swapaxes(out, 1, 2)
     return out.astype(q.dtype)
 
 
@@ -393,17 +467,22 @@ def zigzag_ring_attention(
     mesh: Mesh,
     seq_axis: str,
     scale: Optional[float] = None,
+    impl: str = "xla",
+    flash_block: int = 512,
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """Global-array entry point: load-balanced CAUSAL ring attention.
     Reorders the sequence into the zigzag layout, shards over
     ``seq_axis``, and restores the original order on the way out (inputs
     and outputs use the natural sequence order — the layout is an
-    internal detail)."""
+    internal detail). ``impl='flash'`` runs the live sub-tiles on the
+    fused Pallas carry kernel (forward-only, like the flash ring)."""
     n = int(mesh.shape[seq_axis])
     order, inverse = zigzag_layout(q.shape[1], n)
     return _wrap(
         mesh, seq_axis, zigzag_ring_attention_local, q, k, v, scale,
         order=order, inverse=inverse, require_equal_seq=True,
+        impl=impl, flash_block=flash_block, flash_interpret=flash_interpret,
     )
 
 
