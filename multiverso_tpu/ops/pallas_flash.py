@@ -13,9 +13,10 @@ Two forms: ``flash_attention`` (single-device, DIFFERENTIABLE — a
 custom VJP recomputes softmax tiles from the saved logsumexp residual,
 the standard flash backward, in two more Pallas kernels) and
 ``flash_attention_carry`` (the resumable per-ring-step tile — state
-enters/leaves as arrays, consumed by
-``ring_attention(..., impl='flash')``; forward-only, so the
-differentiable RING path stays on the jnp tile, default ``impl='xla'``).
+enters/leaves as arrays, consumed by ``ring_attention(..., impl='flash')``,
+which is ALSO differentiable: its custom VJP runs a second ring pass
+over the saved logsumexp using ``_bwd_core_t`` as the per-step tile
+backward).
 
 Reference parity note: the reference has no attention anywhere
 (SURVEY.md §5 — it predates transformers); this module is part of the
@@ -479,8 +480,6 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
               block_q, block_k, interpret):
     """Flash backward: D_row preprocess + two Pallas passes. Inputs
     q/k/v in the public (B, S, H, D) layout; out_t/do_t/lse transposed."""
-    B, S, H, D = q.shape
-    n_q, n_k = S // block_q, S // block_k
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -488,6 +487,26 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
     dvec = jnp.sum(
         do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
     )  # (B, H, S)
+    dq, dk, dv = _bwd_core_t(
+        qt, kt, vt, lse, dvec, do_t, causal, scale, block_q, block_k,
+        interpret,
+    )
+    return (
+        jnp.swapaxes(dq, 1, 2),
+        jnp.swapaxes(dk, 1, 2),
+        jnp.swapaxes(dv, 1, 2),
+    )
+
+
+def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
+                block_q, block_k, interpret):
+    """Kernel-layout backward core (everything (B, H, S[, D])): returns
+    (dq_t, dk_t, dv_t). Also the per-step tile backward of the flash
+    ring, which carries kernel-layout blocks. Supports Sq != Sk (the
+    ring's q-vs-one-visiting-block shape)."""
+    B, H, Sq, D = qt.shape
+    Sk = kt.shape[2]
+    n_q, n_k = Sq // block_q, Sk // block_k
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi))
@@ -500,7 +519,7 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
         grid=(B, H, n_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), qt.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, do_t, lse, dvec)
@@ -538,8 +557,8 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
                   row_in_spec, row_in_spec],
         out_specs=[kv_out_spec, kv_out_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), kt.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), vt.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -547,8 +566,4 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
         ],
         interpret=interpret,
     )(qt, kt, vt, do_t, lse, dvec)
-    return (
-        jnp.swapaxes(dq, 1, 2),
-        jnp.swapaxes(dk, 1, 2),
-        jnp.swapaxes(dv, 1, 2),
-    )
+    return dq, dk, dv

@@ -113,53 +113,159 @@ def _fit_block(seq_len: int, block: int) -> int:
     return b
 
 
-def _ring_orchestrate(q, k, v, axis_name, causal, tile, init_state,
-                      finalize, seq_dim=1):
-    """ONE definition of the ring schedule shared by the xla and flash
-    tiles: step 0 folds the LOCAL block (src == my — no rotation needed,
-    so only n-1 ppermutes total), then each scan step rotates K/V one hop
-    and folds the visiting block; under ``causal`` a tile whose every key
-    position is in the future is skipped entirely (the predicate varies
-    per device, but the branches are collective-free, so divergence is
-    safe in manual/shard_map mode; covers Sq == Sk block layouts).
+def _ring_orchestrate(axis_name, causal, Sq, Sk, ring_buf, tile,
+                      init_state, finalize):
+    """ONE definition of the ring schedule shared by the xla tile, the
+    flash tile, AND the flash backward: step 0 folds the LOCAL block
+    (src == my — no rotation needed, so only n-1 ppermutes total), then
+    each scan step rotates the ring buffer one hop and folds the
+    visiting block; under ``causal`` a tile whose every key position is
+    in the future is skipped entirely (the predicate varies per device,
+    but the branches are collective-free, so divergence is safe in
+    manual/shard_map mode; covers Sq == Sk block layouts).
 
-    Layout-agnostic: the tile impl owns the streaming-state pytree
-    (``init_state() -> state``, ``tile(state, k_blk, v_blk, src, diag) ->
-    state``, ``finalize(state) -> out``); ``seq_dim`` locates the
-    sequence axis of q/k/v for the causal skip arithmetic.
+    ``ring_buf`` is an arbitrary pytree rotated leaf-wise each step —
+    (k, v) for forwards, (k, v, dk, dv) for the flash backward, whose
+    tiles MUTATE the traveling gradient accumulators. The tile impl owns
+    both pytrees: ``init_state() -> state``, ``tile(state, ring_buf,
+    src, diag) -> (state, ring_buf)``, ``finalize(state, ring_buf) ->
+    out`` (collectives allowed — the backward's rotate-home hop lives in
+    its finalize).
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
-    Sq = q.shape[seq_dim]
-    Sk = k.shape[seq_dim]
     perm = [(j, (j + 1) % n) for j in range(n)]
-    state = tile(init_state(), k, v, my, True)
+    state, ring_buf = tile(init_state(), ring_buf, my, True)
 
     def body(carry, step):
-        state, k_blk, v_blk = carry
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        state, buf = carry
+        buf = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), buf
+        )
         # After `step` rotations each device holds the block that started
         # on device (my - step) mod n.
         src = (my - step) % n
         if causal:
             first_k = src * Sk
             last_q = my * Sq + Sq - 1
-            state = lax.cond(
+            state, buf = lax.cond(
                 first_k > last_q,
-                lambda state, *_: state,
-                lambda state, kb, vb, s: tile(state, kb, vb, s, False),
-                state, k_blk, v_blk, src,
+                lambda state, buf, _: (state, buf),
+                lambda state, buf, s: tile(state, buf, s, False),
+                state, buf, src,
             )
         else:
-            state = tile(state, k_blk, v_blk, src, False)
-        return (state, k_blk, v_blk), ()
+            state, buf = tile(state, buf, src, False)
+        return (state, buf), ()
 
     if n > 1:
-        (state, _, _), _ = lax.scan(
-            body, (state, k, v), jnp.arange(1, n)
+        (state, ring_buf), _ = lax.scan(
+            body, (state, ring_buf), jnp.arange(1, n)
         )
-    return finalize(state)
+    return finalize(state, ring_buf)
+
+
+def _flash_ring_fwd_core(qt, kt, vt, axis_name, causal, scale, bq, bk,
+                         interpret):
+    """Kernel-layout flash ring forward: returns (out_t, lse) — lse is
+    the VJP's softmax-recompute residual."""
+    from multiverso_tpu.ops.pallas_flash import flash_attention_carry
+
+    B, H, Sq, D = qt.shape
+    kw = dict(scale=scale, block_q=bq, block_k=bk, interpret=interpret)
+
+    def init():
+        return (
+            jnp.full((B, H, Sq), _NEG_INF, jnp.float32),
+            jnp.zeros((B, H, Sq), jnp.float32),
+            jnp.zeros((B, H, Sq, D), jnp.float32),
+        )
+
+    def tile(state, buf, src, diag):
+        m, l, acc = state
+        k_blk, v_blk = buf
+        return flash_attention_carry(
+            qt, k_blk, v_blk, m, l, acc, causal_diag=causal and diag, **kw
+        ), buf
+
+    def finalize(state, buf):
+        m, l, acc = state
+        safe_l = jnp.maximum(l, 1e-37)
+        out = (acc / safe_l[..., None]).astype(qt.dtype)
+        return out, m + jnp.log(safe_l)
+
+    return _ring_orchestrate(
+        axis_name, causal, qt.shape[2], kt.shape[2], (kt, vt), tile, init,
+        finalize,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_ring_t(qt, kt, vt, axis_name, causal, scale, bq, bk, interpret):
+    out, _ = _flash_ring_fwd_core(
+        qt, kt, vt, axis_name, causal, scale, bq, bk, interpret
+    )
+    return out
+
+
+def _flash_ring_t_fwd(qt, kt, vt, axis_name, causal, scale, bq, bk,
+                      interpret):
+    out, lse = _flash_ring_fwd_core(
+        qt, kt, vt, axis_name, causal, scale, bq, bk, interpret
+    )
+    return out, (qt, kt, vt, out, lse)
+
+
+def _flash_ring_t_bwd(axis_name, causal, scale, bq, bk, interpret, res,
+                      do_t):
+    """The ring backward is ANOTHER ring pass on the SAME schedule
+    (_ring_orchestrate): K/V blocks rotate again, each live (my, src)
+    tile's backward (softmax recomputed from the saved lse) adds to the
+    local dQ and to dK/dV accumulators that travel WITH their block;
+    after the cycle one extra rotation (in finalize) brings every
+    block's gradient home to its owner. Accumulation is f32 regardless
+    of input dtype — n bf16 roundings per ring would diverge from the
+    xla path's f32 cotangents — cast once at the end."""
+    from multiverso_tpu.ops.pallas_flash import _bwd_core_t
+
+    qt, kt, vt, out_t, lse = res
+    n = lax.psum(1, axis_name)
+    dvec = jnp.sum(
+        do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
+    )
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def init():
+        return jnp.zeros(qt.shape, jnp.float32)  # dQ accumulator
+
+    def tile(dq, buf, src, diag):
+        kb, vb, dkb, dvb = buf
+        dq_c, dk_c, dv_c = _bwd_core_t(
+            qt, kb, vb, lse, dvec, do_t, causal and diag, scale, bq, bk,
+            interpret,
+        )
+        return dq + dq_c.astype(jnp.float32), (
+            kb, vb,
+            dkb + dk_c.astype(jnp.float32),
+            dvb + dv_c.astype(jnp.float32),
+        )
+
+    def finalize(dq, buf):
+        _, _, dkb, dvb = buf
+        # each block's accumulator sits one hop short of its owner
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        return dq.astype(qt.dtype), dkb.astype(kt.dtype), dvb.astype(vt.dtype)
+
+    zeros_kv = jnp.zeros(kt.shape, jnp.float32)
+    return _ring_orchestrate(
+        axis_name, causal, qt.shape[2], kt.shape[2],
+        (kt, vt, zeros_kv, jnp.zeros(vt.shape, jnp.float32)),
+        tile, init, finalize,
+    )
+
+
+_flash_ring_t.defvjp(_flash_ring_t_fwd, _flash_ring_t_bwd)
 
 
 def ring_attention_local(
@@ -177,13 +283,12 @@ def ring_attention_local(
 
     q, k, v are the *local* sequence blocks (B, S/n, H, D) of a
     sequence-sharded global array. Returns the local block of the output.
-    Differentiable (the ring loop is a ``lax.scan``) with the default
-    ``impl='xla'`` jnp tile; ``impl='flash'`` swaps in the fused Pallas
-    MXU tile (ops/pallas_flash.py ``flash_attention_carry`` — the
-    streaming-softmax state carries across ring steps as arrays;
-    forward-only, no VJP; ``flash_interpret=True`` for non-TPU backends;
-    ``flash_block`` tunes the Pallas tile, auto-shrunk to divide the
-    local blocks).
+    Differentiable with BOTH impls: the default ``impl='xla'`` jnp tile
+    via plain autodiff, ``impl='flash'`` (fused Pallas MXU tiles, state
+    carried across ring steps in kernel layout) via a custom VJP whose
+    backward is a second ring pass over the saved logsumexp
+    (``flash_interpret=True`` for non-TPU backends; ``flash_block``
+    tunes the Pallas tile, auto-shrunk to divide the local blocks).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -191,45 +296,17 @@ def ring_attention_local(
     Sk = k.shape[1]
 
     if impl == "flash":
-        from multiverso_tpu.ops.pallas_flash import flash_attention_carry
-
         if causal:
             assert Sq == Sk, "flash ring causal requires equal q/k blocks"
         bq, bk = _fit_block(Sq, flash_block), _fit_block(Sk, flash_block)
-        kw = dict(
-            scale=scale, block_q=bq, block_k=bk, interpret=flash_interpret
+        # ONE transpose at entry/exit; everything inside (ppermutes,
+        # carry tiles, the VJP's second ring pass) rides (B, H, S, D)
+        out_t = _flash_ring_t(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), axis_name, causal, scale, bq, bk,
+            flash_interpret,
         )
-        # everything rides the kernel's (B, H, S, D) layout through the
-        # whole ring — ONE transpose at entry/exit instead of state
-        # copies on every ring step (K/V rotate transposed; ppermute is
-        # layout-agnostic)
-        qt = jnp.swapaxes(q, 1, 2)
-        kt = jnp.swapaxes(k, 1, 2)
-        vt = jnp.swapaxes(v, 1, 2)
-
-        def flash_init():
-            return (
-                jnp.full((B, H, Sq), _NEG_INF, jnp.float32),
-                jnp.zeros((B, H, Sq), jnp.float32),
-                jnp.zeros((B, H, Sq, D), jnp.float32),
-            )
-
-        def flash_tile(state, k_blk, v_blk, src, diag):
-            m, l, acc = state
-            return flash_attention_carry(
-                qt, k_blk, v_blk, m, l, acc,
-                causal_diag=causal and diag, **kw
-            )
-
-        def flash_finalize(state):
-            m, l, acc = state
-            out = acc / jnp.maximum(l, 1e-37)[..., None]
-            return jnp.swapaxes(out, 1, 2).astype(q.dtype)
-
-        return _ring_orchestrate(
-            qt, kt, vt, axis_name, causal, flash_tile, flash_init,
-            flash_finalize, seq_dim=2,
-        )
+        return jnp.swapaxes(out_t, 1, 2)
 
     assert impl == "xla", impl
     my = lax.axis_index(axis_name)  # xla tile needs global q positions
@@ -243,8 +320,9 @@ def ring_attention_local(
             jnp.zeros((B, Sq, H, D), jnp.float32),
         )
 
-    def xla_tile(state, k_blk, v_blk, src, diag):
+    def xla_tile(state, buf, src, diag):
         m, l, acc = state
+        k_blk, v_blk = buf
         s = jnp.einsum("bqhd,bkhd->bqhk", qf, k_blk.astype(jnp.float32))
         if causal:
             # the generic global-position mask covers both the step-0
@@ -254,15 +332,15 @@ def ring_attention_local(
             mask = jnp.broadcast_to(mask[None, :, None, :], s.shape)
         else:
             mask = None  # unmasked tile: skip the masked selects entirely
-        return _tile_update(m, l, acc, s, v_blk, mask)
+        return _tile_update(m, l, acc, s, v_blk, mask), buf
 
-    def xla_finalize(state):
+    def xla_finalize(state, buf):
         m, l, acc = state
         out = acc / jnp.maximum(l, 1e-37)[..., None]
         return out.astype(q.dtype)
 
     return _ring_orchestrate(
-        q, k, v, axis_name, causal, xla_tile, xla_init, xla_finalize
+        axis_name, causal, Sq, Sk, (k, v), xla_tile, xla_init, xla_finalize
     )
 
 
@@ -476,7 +554,9 @@ def zigzag_ring_attention(
     ``seq_axis``, and restores the original order on the way out (inputs
     and outputs use the natural sequence order — the layout is an
     internal detail). ``impl='flash'`` runs the live sub-tiles on the
-    fused Pallas carry kernel (forward-only, like the flash ring)."""
+    fused Pallas carry kernel (forward-only for now — the plain flash
+    ring and Ulysses have VJPs; the zigzag sub-tile backward is the
+    remaining piece)."""
     n = int(mesh.shape[seq_axis])
     order, inverse = zigzag_layout(q.shape[1], n)
     return _wrap(
@@ -591,8 +671,10 @@ def ring_attention(
 ) -> jnp.ndarray:
     """Global-array entry point: shards (B,S,H,D) inputs over ``seq_axis``
     of ``mesh`` and runs blockwise ring attention. ``impl='flash'`` uses
-    the fused Pallas MXU tile (forward-only); ``flash_block`` tunes the
-    Pallas tile size (auto-shrunk to divide the per-device blocks)."""
+    the fused Pallas MXU tiles and is DIFFERENTIABLE (custom VJP: a
+    second ring pass over the saved logsumexp); ``flash_block`` tunes
+    the Pallas tile size (auto-shrunk to divide the per-device
+    blocks)."""
     return _wrap(mesh, seq_axis, ring_attention_local, q, k, v, scale,
                  causal=causal, impl=impl, flash_block=flash_block,
                  flash_interpret=flash_interpret)
