@@ -492,16 +492,18 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
         interpret,
     )
     return (
-        jnp.swapaxes(dq, 1, 2),
-        jnp.swapaxes(dk, 1, 2),
-        jnp.swapaxes(dv, 1, 2),
+        jnp.swapaxes(dq, 1, 2).astype(q.dtype),
+        jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+        jnp.swapaxes(dv, 1, 2).astype(v.dtype),
     )
 
 
 def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
                 block_q, block_k, interpret):
     """Kernel-layout backward core (everything (B, H, S[, D])): returns
-    (dq_t, dk_t, dv_t). Also the per-step tile backward of the flash
+    (dq_t, dk_t, dv_t) in FLOAT32 — ring callers accumulate across steps
+    and must not absorb one input-dtype rounding per hop; cast to primal
+    dtypes at the very end. Also the per-step tile backward of the flash
     ring, which carries kernel-layout blocks. Supports Sq != Sk (the
     ring's q-vs-one-visiting-block shape)."""
     B, H, Sq, D = qt.shape
@@ -519,7 +521,7 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
         grid=(B, H, n_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), qt.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, do_t, lse, dvec)
@@ -557,8 +559,8 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
                   row_in_spec, row_in_spec],
         out_specs=[kv_out_spec, kv_out_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sk, D), kt.dtype),
-            jax.ShapeDtypeStruct((B, H, Sk, D), vt.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),

@@ -244,11 +244,7 @@ def _flash_ring_t_bwd(axis_name, causal, scale, bq, bk, interpret, res,
             qt, kb, vb, lse, dvec, do_t, causal and diag, scale, bq, bk,
             interpret,
         )
-        return dq + dq_c.astype(jnp.float32), (
-            kb, vb,
-            dkb + dk_c.astype(jnp.float32),
-            dvb + dv_c.astype(jnp.float32),
-        )
+        return dq + dq_c, (kb, vb, dkb + dk_c, dvb + dv_c)
 
     def finalize(dq, buf):
         _, _, dkb, dvb = buf
@@ -344,6 +340,173 @@ def ring_attention_local(
     )
 
 
+def _flash_zigzag_fwd_core(qt, kt, vt, axis_name, scale, bb, interpret):
+    """Kernel-layout zigzag flash forward over the SHARED ring schedule
+    (_ring_orchestrate with causal=False — zigzag's liveness is decided
+    inside the tile by the src<my dispatch, not by the causal skip).
+    Returns (out_t, lse)."""
+    from multiverso_tpu.ops.pallas_flash import flash_attention_carry
+
+    my = lax.axis_index(axis_name)
+    B, H, Sq, D = qt.shape
+    c = Sq // 2
+    kw = dict(scale=scale, block_q=bb, block_k=bb, interpret=interpret)
+
+    def init():
+        return (
+            jnp.full((B, H, Sq), _NEG_INF, jnp.float32),
+            jnp.zeros((B, H, Sq), jnp.float32),
+            jnp.zeros((B, H, Sq, D), jnp.float32),
+        )
+
+    def tile(state, buf, src, diag):
+        m, l, acc = state
+        kb, vb = buf
+        if diag:
+            # local step: (lo,lo diag) + (hi,lo full) + (hi,hi diag)
+            m1, l1, a1 = flash_attention_carry(
+                qt[:, :, :c], kb[:, :, :c], vb[:, :, :c],
+                m[:, :, :c], l[:, :, :c], acc[:, :, :c],
+                causal_diag=True, **kw,
+            )
+            mh, lh, ah = flash_attention_carry(
+                qt[:, :, c:], kb[:, :, :c], vb[:, :, :c],
+                m[:, :, c:], l[:, :, c:], acc[:, :, c:],
+                causal_diag=False, **kw,
+            )
+            mh, lh, ah = flash_attention_carry(
+                qt[:, :, c:], kb[:, :, c:], vb[:, :, c:],
+                mh, lh, ah, causal_diag=True, **kw,
+            )
+            return (
+                jnp.concatenate([m1, mh], axis=2),
+                jnp.concatenate([l1, lh], axis=2),
+                jnp.concatenate([a1, ah], axis=2),
+            ), buf
+
+        def low_kv(m, l, acc, kb, vb):
+            return flash_attention_carry(
+                qt, kb[:, :, :c], vb[:, :, :c], m, l, acc,
+                causal_diag=False, **kw,
+            )
+
+        def high_q(m, l, acc, kb, vb):
+            m2, l2, a2 = flash_attention_carry(
+                qt[:, :, c:], kb, vb,
+                m[:, :, c:], l[:, :, c:], acc[:, :, c:],
+                causal_diag=False, **kw,
+            )
+            return (
+                jnp.concatenate([m[:, :, :c], m2], axis=2),
+                jnp.concatenate([l[:, :, :c], l2], axis=2),
+                jnp.concatenate([acc[:, :, :c], a2], axis=2),
+            )
+
+        return lax.cond(src < my, low_kv, high_q, m, l, acc, kb, vb), buf
+
+    def finalize(state, buf):
+        m, l, acc = state
+        safe_l = jnp.maximum(l, 1e-37)
+        return (acc / safe_l[..., None]).astype(qt.dtype), m + jnp.log(safe_l)
+
+    return _ring_orchestrate(
+        axis_name, False, Sq, Sq, (kt, vt), tile, init, finalize
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_zigzag_t(qt, kt, vt, axis_name, scale, bb, interpret):
+    return _flash_zigzag_fwd_core(qt, kt, vt, axis_name, scale, bb,
+                                  interpret)[0]
+
+
+def _flash_zigzag_t_fwd(qt, kt, vt, axis_name, scale, bb, interpret):
+    out, lse = _flash_zigzag_fwd_core(
+        qt, kt, vt, axis_name, scale, bb, interpret
+    )
+    return out, (qt, kt, vt, out, lse)
+
+
+def _flash_zigzag_t_bwd(axis_name, scale, bb, interpret, res, do_t):
+    """Second zigzag pass over the saved lse on the SHARED ring schedule
+    (mirrors the forward's sub-tile dispatch): the local step runs three
+    sub-tile backwards, rotated steps one each; dK/dV accumulators (f32)
+    travel with their block and rotate home in finalize."""
+    from multiverso_tpu.ops.pallas_flash import _bwd_core_t
+
+    qt, kt, vt, out_t, lse = res
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, Sq, D = qt.shape
+    c = Sq // 2
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    dvec = jnp.sum(
+        do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
+    )
+    lo = (slice(None), slice(None), slice(None, c))
+    hi = (slice(None), slice(None), slice(c, None))
+
+    def sub_bwd(qs, ks, vs, rows, diag):
+        return _bwd_core_t(
+            qs, ks, vs, lse[rows], dvec[rows], do_t[rows],
+            diag, scale, bb, bb, interpret,
+        )
+
+    def init():
+        return jnp.zeros(qt.shape, jnp.float32)  # dQ accumulator
+
+    def tile(dq, buf, src, diag):
+        kb, vb, dkb, dvb = buf
+        if diag:
+            dq_lo, dkl, dvl = sub_bwd(qt[lo], kb[lo], vb[lo], lo, True)
+            dq_hi, dkl2, dvl2 = sub_bwd(qt[hi], kb[lo], vb[lo], hi, False)
+            dq_hi2, dkh, dvh = sub_bwd(qt[hi], kb[hi], vb[hi], hi, True)
+            dq = jnp.concatenate([dq_lo, dq_hi + dq_hi2], axis=2)
+            return dq, (
+                kb, vb,
+                dkb + jnp.concatenate([dkl + dkl2, dkh], axis=2),
+                dvb + jnp.concatenate([dvl + dvl2, dvh], axis=2),
+            )
+
+        def low_bwd(dq, kb, vb, dkb, dvb):
+            dq_c, dk_c, dv_c = _bwd_core_t(
+                qt, kb[lo], vb[lo], lse, dvec, do_t,
+                False, scale, bb, bb, interpret,
+            )
+            return (
+                dq + dq_c,
+                dkb.at[lo].add(dk_c),
+                dvb.at[lo].add(dv_c),
+            )
+
+        def high_bwd(dq, kb, vb, dkb, dvb):
+            dq_c, dk_c, dv_c = sub_bwd(qt[hi], kb, vb, hi, False)
+            return (dq.at[hi].add(dq_c), dkb + dk_c, dvb + dv_c)
+
+        dq, dkb, dvb = lax.cond(
+            src < my, low_bwd, high_bwd, dq, kb, vb, dkb, dvb
+        )
+        return dq, (kb, vb, dkb, dvb)
+
+    def finalize(dq, buf):
+        _, _, dkb, dvb = buf
+        # each block's accumulator sits one hop short of its owner
+        # (identity rotation when n == 1)
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        return dq.astype(qt.dtype), dkb.astype(kt.dtype), dvb.astype(vt.dtype)
+
+    zeros = jnp.zeros(kt.shape, jnp.float32)
+    return _ring_orchestrate(
+        axis_name, False, Sq, Sq,
+        (kt, vt, zeros, jnp.zeros(vt.shape, jnp.float32)),
+        tile, init, finalize,
+    )
+
+
+_flash_zigzag_t.defvjp(_flash_zigzag_t_fwd, _flash_zigzag_t_bwd)
+
+
 def zigzag_ring_attention_local(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -376,10 +539,10 @@ def zigzag_ring_attention_local(
     Per device per step that is 2c²·D useful FLOPs — half the full-tile
     cost, matching plain causal ring's BUSIEST rank's useful work while
     every rank stays busy (the llama3-style context-parallel balancing).
-    Deliberately a separate body from ``ring_attention_local``: the two
-    variants share the streaming-softmax fold (``_tile_update``) but tile
-    the score space differently (masked full tiles vs unmasked live
-    sub-tiles), and merging them would entangle both control flows.
+    The rotation/scan schedule is the shared ``_ring_orchestrate``
+    (causal=False: zigzag decides liveness inside the tile via the
+    src<my dispatch); only the TILE bodies differ from
+    ``ring_attention_local``.
 
     Local q/k/v are the zigzag-ordered blocks (B, 2c, H, D). The ring
     moves exactly two collectives per step (the rotating block's source
@@ -391,105 +554,64 @@ def zigzag_ring_attention_local(
         scale = q.shape[-1] ** -0.5
     B, Sq, H, D = q.shape
     c = Sq // 2
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
     if impl == "flash":
-        # Same schedule, fused Pallas tiles (forward-only like the flash
-        # ring). The chunk structure maps exactly onto the carry kernel's
-        # two mask forms: chunk-vs-same-chunk sub-tiles are
+        # Fused Pallas tiles on the same schedule, DIFFERENTIABLE via
+        # _flash_zigzag_t's custom VJP (a second zigzag pass over the
+        # saved lse). The chunk structure maps exactly onto the carry
+        # kernel's two mask forms: chunk-vs-same-chunk sub-tiles are
         # diagonal-causal at EQUAL local offsets (causal_diag), every
         # other live sub-tile is fully live (no mask). Local step =
         # (lo,lo diag) + (hi,lo full) + (hi,hi diag); rotated steps are
         # the same one full tile per step as the jnp path. State rides
         # the kernel's (B, H, 2c[, D]) layout end to end.
-        from multiverso_tpu.ops.pallas_flash import flash_attention_carry
-
-        qt = jnp.swapaxes(q, 1, 2)
-        kt = jnp.swapaxes(k, 1, 2)
-        vt = jnp.swapaxes(v, 1, 2)
-        bb = _fit_block(c, flash_block)  # c-sub-tiles; 2c tiles divide too
-        kw = dict(scale=scale, block_q=bb, block_k=bb,
-                  interpret=flash_interpret)
-        m = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
-        l = jnp.zeros((B, H, Sq), jnp.float32)
-        acc = jnp.zeros((B, H, Sq, D), jnp.float32)
-        m1, l1, a1 = flash_attention_carry(
-            qt[:, :, :c], kt[:, :, :c], vt[:, :, :c],
-            m[:, :, :c], l[:, :, :c], acc[:, :, :c],
-            causal_diag=True, **kw,
+        out_t = _flash_zigzag_t(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), axis_name, scale,
+            _fit_block(c, flash_block), flash_interpret,
         )
-        mh, lh, ah = flash_attention_carry(
-            qt[:, :, c:], kt[:, :, :c], vt[:, :, :c],
-            m[:, :, c:], l[:, :, c:], acc[:, :, c:],
-            causal_diag=False, **kw,
-        )
-        mh, lh, ah = flash_attention_carry(
-            qt[:, :, c:], kt[:, :, c:], vt[:, :, c:],
-            mh, lh, ah, causal_diag=True, **kw,
-        )
-        m = jnp.concatenate([m1, mh], axis=2)
-        l = jnp.concatenate([l1, lh], axis=2)
-        acc = jnp.concatenate([a1, ah], axis=2)
+        return jnp.swapaxes(out_t, 1, 2)
 
-        def low_kv(ops):
-            m, l, acc, kb, vb = ops
-            return flash_attention_carry(
-                qt, kb[:, :, :c], vb[:, :, :c], m, l, acc,
-                causal_diag=False, **kw,
-            )
+    assert impl == "xla", impl
+    qf = q.astype(jnp.float32) * scale
+    ar = jnp.arange(c)
+    # local-step mask: both chunk pairs of one device, global positions
+    q_pos = jnp.concatenate([my * c + ar, (2 * n - 1 - my) * c + ar])
 
-        def high_q(ops):
-            m, l, acc, kb, vb = ops
-            m2, l2, a2 = flash_attention_carry(
-                qt[:, :, c:], kb, vb,
-                m[:, :, c:], l[:, :, c:], acc[:, :, c:],
-                causal_diag=False, **kw,
-            )
-            return (
-                jnp.concatenate([m[:, :, :c], m2], axis=2),
-                jnp.concatenate([l[:, :, :c], l2], axis=2),
-                jnp.concatenate([acc[:, :, :c], a2], axis=2),
-            )
-
-        kv0 = (kt, vt)
-    else:
-        assert impl == "xla", impl
-        qf = q.astype(jnp.float32) * scale
-        ar = jnp.arange(c)
-
-        # local step: both chunk pairs of one device — position-masked
-        # full tile
-        q_pos = jnp.concatenate([my * c + ar, (2 * n - 1 - my) * c + ar])
-        s0 = jnp.einsum("bqhd,bkhd->bqhk", qf, k.astype(jnp.float32))
-        mask0 = jnp.broadcast_to(
-            (q_pos[None, :] <= q_pos[:, None])[None, :, None, :], s0.shape
-        )
-        m, l, acc = _tile_update(
+    def init():
+        return (
             jnp.full((B, Sq, H), _NEG_INF, jnp.float32),
             jnp.zeros((B, Sq, H), jnp.float32),
             jnp.zeros((B, Sq, H, D), jnp.float32),
-            s0,
-            v,
-            mask0,
         )
 
-        def low_kv(ops):
+    def tile(state, buf, src, diag):
+        m, l, acc = state
+        kb, vb = buf
+        if diag:
+            # local step: position-masked full tile
+            s0 = jnp.einsum("bqhd,bkhd->bqhk", qf, kb.astype(jnp.float32))
+            mask0 = jnp.broadcast_to(
+                (q_pos[None, :] <= q_pos[:, None])[None, :, None, :],
+                s0.shape,
+            )
+            return _tile_update(m, l, acc, s0, vb, mask0), buf
+
+        def low_kv(m, l, acc, kb, vb):
             # src < my: every local query attends the incoming LOW chunk
-            m, l, acc, kb, vb = ops
-            s = jnp.einsum(
+            sc = jnp.einsum(
                 "bqhd,bkhd->bqhk", qf, kb[:, :c].astype(jnp.float32)
             )
-            return _tile_update(m, l, acc, s, vb[:, :c], None)
+            return _tile_update(m, l, acc, sc, vb[:, :c], None)
 
-        def high_q(ops):
-            # src > my: only the local HIGH query chunk attends, but to
-            # both incoming chunks — update that row slice of the state
-            m, l, acc, kb, vb = ops
-            s = jnp.einsum(
+        def high_q(m, l, acc, kb, vb):
+            # src > my: only the local HIGH query chunk attends, to both
+            # incoming chunks — update that row slice of the state
+            sc = jnp.einsum(
                 "bqhd,bkhd->bqhk", qf[:, c:], kb.astype(jnp.float32)
             )
             m2, l2, acc2 = _tile_update(
-                m[:, c:], l[:, c:], acc[:, c:], s, vb, None
+                m[:, c:], l[:, c:], acc[:, c:], sc, vb, None
             )
             return (
                 jnp.concatenate([m[:, :c], m2], axis=1),
@@ -497,26 +619,16 @@ def zigzag_ring_attention_local(
                 jnp.concatenate([acc[:, :c], acc2], axis=1),
             )
 
-        kv0 = (k, v)
+        return lax.cond(src < my, low_kv, high_q, m, l, acc, kb, vb), buf
 
-    def body(carry, step):
-        m, l, acc, k_blk, v_blk = carry
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        src = (my - step) % n
-        m, l, acc = lax.cond(
-            src < my, low_kv, high_q, (m, l, acc, k_blk, v_blk)
-        )
-        return (m, l, acc, k_blk, v_blk), ()
+    def finalize(state, buf):
+        m, l, acc = state
+        out = acc / jnp.maximum(l, 1e-37)[..., None]
+        return out.astype(q.dtype)
 
-    if n > 1:
-        (m, l, acc, _, _), _ = lax.scan(
-            body, (m, l, acc, *kv0), jnp.arange(1, n)
-        )
-    out = acc / jnp.maximum(l, 1e-37)[..., None]
-    if impl == "flash":
-        out = jnp.swapaxes(out, 1, 2)
-    return out.astype(q.dtype)
+    return _ring_orchestrate(
+        axis_name, False, Sq, Sq, (k, v), tile, init, finalize
+    )
 
 
 def zigzag_layout(seq_len: int, n_dev: int):
@@ -554,9 +666,8 @@ def zigzag_ring_attention(
     ``seq_axis``, and restores the original order on the way out (inputs
     and outputs use the natural sequence order — the layout is an
     internal detail). ``impl='flash'`` runs the live sub-tiles on the
-    fused Pallas carry kernel (forward-only for now — the plain flash
-    ring and Ulysses have VJPs; the zigzag sub-tile backward is the
-    remaining piece)."""
+    fused Pallas carry kernel and is DIFFERENTIABLE (custom VJP: a
+    second zigzag pass over the saved logsumexp)."""
     n = int(mesh.shape[seq_axis])
     order, inverse = zigzag_layout(q.shape[1], n)
     return _wrap(
