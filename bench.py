@@ -741,6 +741,24 @@ def _bench_ring_attention():
             out["ring_attention_flash_mfu_pct"] = round(
                 100 * tflash / peak, 2
             )
+            # fwd+bwd through the flash custom VJP (the training shape):
+            # standard flash accounting — fwd 2 matmuls, bwd 5 => 3.5x
+            grad_fn = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    flash_attention(q, k, v, block_q=fb, block_k=fb)
+                    .astype(jnp.float32)
+                ),
+                argnums=(0, 1, 2),
+            ))
+
+            def run_bwd():
+                return grad_fn(qb, kb, vb)[0]
+
+            tfb = 3.5 * timed(run_bwd)  # timed() divides by fwd-only flops
+            out["ring_attention_flash_fwdbwd_tflops"] = round(tfb, 2)
+            out["ring_attention_flash_fwdbwd_mfu_pct"] = round(
+                100 * tfb / peak, 2
+            )
         except Exception as e:
             out["ring_attention_flash_error"] = str(e)[:200]
     return out

@@ -44,10 +44,21 @@ def main():
     )
     ref = attention_reference(q, k, v, causal=True)
     print(f"mesh: {n} device(s), sequence {S} sharded over 'sp'")
+    # every scheme also runs fused Pallas MXU tiles via impl='flash'
+    # (differentiable — ring/zigzag carry second-ring-pass VJPs); off-TPU
+    # backends use the Pallas interpreter
+    flash_kw = dict(impl="flash",
+                    flash_interpret=jax.devices()[0].platform != "tpu")
     for name, fn in (
         ("ring (causal)", lambda: ring_attention(q, k, v, mesh, "sp", causal=True)),
         ("zigzag (balanced causal)", lambda: zigzag_ring_attention(q, k, v, mesh, "sp")),
         ("ulysses (causal)", lambda: ulysses_attention(q, k, v, mesh, "sp", causal=True)),
+        ("ring FLASH", lambda: ring_attention(q, k, v, mesh, "sp",
+                                              causal=True, **flash_kw)),
+        ("zigzag FLASH", lambda: zigzag_ring_attention(q, k, v, mesh, "sp",
+                                                       **flash_kw)),
+        ("ulysses FLASH", lambda: ulysses_attention(q, k, v, mesh, "sp",
+                                                    causal=True, **flash_kw)),
     ):
         out = fn()
         err = float(jnp.max(jnp.abs(out - ref)))
