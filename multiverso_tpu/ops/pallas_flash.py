@@ -107,7 +107,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         )
 
 
-def _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret,
+              vma=()):
     """Transposed-layout forward returning (out_t, lse_t) — shared by the
     public forward and the custom-VJP rule (which keeps lse as the
     softmax-recompute residual)."""
@@ -136,8 +137,8 @@ def _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+            _sds((B, H, S, D), q.dtype, vma),
+            _sds((B, H, S), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
@@ -147,6 +148,19 @@ def _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret):
         interpret=interpret,
     )(qt, kt, vt)
     return out, lse
+
+
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct with an optional varying-mesh-axes annotation.
+
+    Under ``shard_map(..., check_vma=True)`` pallas_call outputs MUST
+    declare which mesh axes they vary over; ring/zigzag/Ulysses callers
+    pass ``vma=(seq_axis,)`` so the rest of their program keeps full vma
+    checking (ADVICE r4 — it used to be check_vma=False program-wide).
+    Outside shard_map, ``vma=()`` leaves the struct unannotated."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _kv_idx_map(causal, block_q, block_k):
@@ -169,22 +183,29 @@ def _kv_idx_map(causal, block_q, block_k):
     return kv_idx
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret,
+                vma):
+    out, _ = _fwd_core(
+        q, k, v, causal, scale, block_q, block_k, interpret, vma
+    )
     return jnp.swapaxes(out, 1, 2)
 
 
-def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_diff_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                    vma):
+    out, lse = _fwd_core(
+        q, k, v, causal, scale, block_q, block_k, interpret, vma
+    )
     return jnp.swapaxes(out, 1, 2), (q, k, v, out, lse)
 
 
-def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, res, dout):
+def _flash_diff_bwd(causal, scale, block_q, block_k, interpret, vma, res,
+                    dout):
     q, k, v, out_t, lse = res
     dq, dk, dv = _bwd_core(
         q, k, v, out_t, lse, jnp.swapaxes(dout, 1, 2),
-        causal, scale, block_q, block_k, interpret,
+        causal, scale, block_q, block_k, interpret, vma,
     )
     return dq, dk, dv
 
@@ -194,7 +215,9 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret", "vma"
+    ),
 )
 def flash_attention(
     q: jnp.ndarray,
@@ -206,6 +229,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    vma: tuple = (),
 ) -> jnp.ndarray:
     """Fused flash forward over (B, S, H, D) inputs (the repo's attention
     convention). ``S`` must divide by both block sizes; ``D`` should be a
@@ -219,7 +243,9 @@ def flash_attention(
     assert S % block_q == 0 and S % block_k == 0, (S, block_q, block_k)
     if scale is None:
         scale = D ** -0.5
-    return _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash_diff(
+        q, k, v, causal, scale, block_q, block_k, interpret, vma
+    )
 
 
 def _flash_carry_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
@@ -300,7 +326,7 @@ def _flash_carry_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
 @functools.partial(
     jax.jit,
     static_argnames=("causal_diag", "scale", "block_q", "block_k",
-                     "interpret"),
+                     "interpret", "vma"),
 )
 def flash_attention_carry(
     q: jnp.ndarray,
@@ -315,6 +341,7 @@ def flash_attention_carry(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    vma: tuple = (),
 ):
     """One resumable flash pass of K/V over Q, folding into (m, l, acc).
 
@@ -353,9 +380,9 @@ def flash_attention_carry(
         ],
         out_specs=[state_spec, state_spec, acc_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, Sq, D), jnp.float32),
+            _sds((B, H, Sq), jnp.float32, vma),
+            _sds((B, H, Sq), jnp.float32, vma),
+            _sds((B, H, Sq, D), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -383,15 +410,19 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    p = jnp.exp(s - lse[:, None])
     if causal:
+        # Mask BEFORE the exp (as the forward kernels do): masked future
+        # logits can exceed lse, and exp would transiently overflow to
+        # +inf even though a post-hoc where() selects 0 — keep the
+        # backward inf-free rather than inf-then-corrected.
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        p = jnp.where(k_pos <= q_pos, p, 0.0)
+        s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+    p = jnp.exp(s - lse[:, None])
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -477,7 +508,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
 
 
 def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
-              block_q, block_k, interpret):
+              block_q, block_k, interpret, vma=()):
     """Flash backward: D_row preprocess + two Pallas passes. Inputs
     q/k/v in the public (B, S, H, D) layout; out_t/do_t/lse transposed."""
     qt = jnp.swapaxes(q, 1, 2)
@@ -489,7 +520,7 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
     )  # (B, H, S)
     dq, dk, dv = _bwd_core_t(
         qt, kt, vt, lse, dvec, do_t, causal, scale, block_q, block_k,
-        interpret,
+        interpret, vma,
     )
     return (
         jnp.swapaxes(dq, 1, 2).astype(q.dtype),
@@ -499,7 +530,7 @@ def _bwd_core(q, k, v, out_t, lse, do_t, causal, scale,
 
 
 def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
-                block_q, block_k, interpret):
+                block_q, block_k, interpret, vma=()):
     """Kernel-layout backward core (everything (B, H, S[, D])): returns
     (dq_t, dk_t, dv_t) in FLOAT32 — ring callers accumulate across steps
     and must not absorb one input-dtype rounding per hop; cast to primal
@@ -521,7 +552,7 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
         grid=(B, H, n_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), jnp.float32),
+        out_shape=_sds((B, H, Sq, D), jnp.float32, vma),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, do_t, lse, dvec)
@@ -559,8 +590,8 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
                   row_in_spec, row_in_spec],
         out_specs=[kv_out_spec, kv_out_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
+            _sds((B, H, Sk, D), jnp.float32, vma),
+            _sds((B, H, Sk, D), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),

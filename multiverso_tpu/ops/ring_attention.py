@@ -113,6 +113,33 @@ def _fit_block(seq_len: int, block: int) -> int:
     return b
 
 
+# The TPU lane tile: Mosaic cannot profitably lower flash tiles whose
+# last-two-dims block falls below the (8, 128) register tile; 128 is the
+# floor for the sequence blocks.
+_MIN_MOSAIC_BLOCK = 128
+
+
+def _flash_viable(interpret: bool, *seq_lens: int, block: int) -> bool:
+    """True when the fused Pallas tile can actually compile for these
+    local sequence lengths. Interpret mode runs any size (tests use tiny
+    shards); real Mosaic needs every fitted block to reach the hardware
+    tile — below that, callers fall back to the jnp tile with a logged
+    warning instead of silently shipping a degenerate (even size-1)
+    Pallas grid that Mosaic rejects or runs pathologically."""
+    if interpret:
+        return True
+    if all(_fit_block(s, block) >= _MIN_MOSAIC_BLOCK for s in seq_lens):
+        return True
+    from multiverso_tpu.utils.log import Log
+
+    Log.Info(
+        "flash tile: local seq lens %s fit no Pallas block >= %d "
+        "(block budget %d); falling back to impl='xla'"
+        % (list(seq_lens), _MIN_MOSAIC_BLOCK, block)
+    )
+    return False
+
+
 def _ring_orchestrate(axis_name, causal, Sq, Sk, ring_buf, tile,
                       init_state, finalize):
     """ONE definition of the ring schedule shared by the xla tile, the
@@ -172,7 +199,12 @@ def _flash_ring_fwd_core(qt, kt, vt, axis_name, causal, scale, bq, bk,
     from multiverso_tpu.ops.pallas_flash import flash_attention_carry
 
     B, H, Sq, D = qt.shape
-    kw = dict(scale=scale, block_q=bq, block_k=bk, interpret=interpret)
+    # vma: declare the kernel outputs varying over the ring axis so the
+    # surrounding shard_map keeps full check_vma (ADVICE r4); interpret
+    # mode stays unannotated (the Pallas HLO interpreter can't eval vma)
+    vma = () if interpret else (axis_name,)
+    kw = dict(scale=scale, block_q=bq, block_k=bk, interpret=interpret,
+              vma=vma)
 
     def init():
         return (
@@ -229,6 +261,7 @@ def _flash_ring_t_bwd(axis_name, causal, scale, bq, bk, interpret, res,
     from multiverso_tpu.ops.pallas_flash import _bwd_core_t
 
     qt, kt, vt, out_t, lse = res
+    vma = () if interpret else (axis_name,)
     n = lax.psum(1, axis_name)
     dvec = jnp.sum(
         do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1
@@ -242,7 +275,7 @@ def _flash_ring_t_bwd(axis_name, causal, scale, bq, bk, interpret, res,
         kb, vb, dkb, dvb = buf
         dq_c, dk_c, dv_c = _bwd_core_t(
             qt, kb, vb, lse, dvec, do_t, causal and diag, scale, bq, bk,
-            interpret,
+            interpret, vma,
         )
         return dq + dq_c, (kb, vb, dkb + dk_c, dvb + dv_c)
 
@@ -291,6 +324,10 @@ def ring_attention_local(
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
 
+    if impl == "flash" and not _flash_viable(
+        flash_interpret, Sq, Sk, block=flash_block
+    ):
+        impl = "xla"
     if impl == "flash":
         if causal:
             assert Sq == Sk, "flash ring causal requires equal q/k blocks"
@@ -350,7 +387,9 @@ def _flash_zigzag_fwd_core(qt, kt, vt, axis_name, scale, bb, interpret):
     my = lax.axis_index(axis_name)
     B, H, Sq, D = qt.shape
     c = Sq // 2
-    kw = dict(scale=scale, block_q=bb, block_k=bb, interpret=interpret)
+    vma = () if interpret else (axis_name,)
+    kw = dict(scale=scale, block_q=bb, block_k=bb, interpret=interpret,
+              vma=vma)
 
     def init():
         return (
@@ -435,6 +474,7 @@ def _flash_zigzag_t_bwd(axis_name, scale, bb, interpret, res, do_t):
     from multiverso_tpu.ops.pallas_flash import _bwd_core_t
 
     qt, kt, vt, out_t, lse = res
+    vma = () if interpret else (axis_name,)
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, H, Sq, D = qt.shape
@@ -449,7 +489,7 @@ def _flash_zigzag_t_bwd(axis_name, scale, bb, interpret, res, do_t):
     def sub_bwd(qs, ks, vs, rows, diag):
         return _bwd_core_t(
             qs, ks, vs, lse[rows], dvec[rows], do_t[rows],
-            diag, scale, bb, bb, interpret,
+            diag, scale, bb, bb, interpret, vma,
         )
 
     def init():
@@ -471,7 +511,7 @@ def _flash_zigzag_t_bwd(axis_name, scale, bb, interpret, res, do_t):
         def low_bwd(dq, kb, vb, dkb, dvb):
             dq_c, dk_c, dv_c = _bwd_core_t(
                 qt, kb[lo], vb[lo], lse, dvec, do_t,
-                False, scale, bb, bb, interpret,
+                False, scale, bb, bb, interpret, vma,
             )
             return (
                 dq + dq_c,
@@ -555,6 +595,10 @@ def zigzag_ring_attention_local(
     B, Sq, H, D = q.shape
     c = Sq // 2
 
+    if impl == "flash" and not _flash_viable(
+        flash_interpret, c, block=flash_block
+    ):
+        impl = "xla"
     if impl == "flash":
         # Fused Pallas tiles on the same schedule, DIFFERENTIABLE via
         # _flash_zigzag_t's custom VJP (a second zigzag pass over the
@@ -703,6 +747,10 @@ def ulysses_attention_local(
     qh = a2a(q, split_axis=2, concat_axis=1)
     kh = a2a(k, split_axis=2, concat_axis=1)
     vh = a2a(v, split_axis=2, concat_axis=1)
+    if impl == "flash" and not _flash_viable(
+        flash_interpret, qh.shape[1], block=flash_block
+    ):
+        impl = "xla"
     if impl == "flash":
         from multiverso_tpu.ops.pallas_flash import flash_attention
 
@@ -718,6 +766,7 @@ def ulysses_attention_local(
         out = flash_attention(
             qh, kh, vh, causal=causal, scale=scale,
             block_q=b, block_k=b, interpret=flash_interpret,
+            vma=() if flash_interpret else (axis_name,),
         )
     else:
         assert impl == "xla", impl
@@ -754,10 +803,19 @@ def _wrap(mesh: Mesh, seq_axis: str, local_fn, q, k, v, scale,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # pallas_call outputs carry no varying-mesh-axes annotation, so
-        # the flash tile cannot satisfy shard_map's vma check; the xla
-        # tile keeps full checking
-        check_vma=local_kw.get("impl") != "flash",
+        # full vma checking everywhere except flash-in-interpret: the
+        # compiled flash tiles declare their outputs varying over the
+        # seq axis (vma= on the pallas out_shape), so the real-TPU
+        # program keeps every collective verified (ADVICE r4 scoped
+        # this — it used to be check_vma=False for ALL flash runs); the
+        # Pallas HLO interpreter however cannot evaluate kernels whose
+        # operands carry vma at all (jax 0.9 raises "Primitive
+        # dynamic_slice requires varying manual axes to match ... open
+        # an issue"), so CPU interpret tests alone run unchecked.
+        check_vma=not (
+            local_kw.get("impl") == "flash"
+            and local_kw.get("flash_interpret")
+        ),
     )
     sharding = NamedSharding(mesh, spec)
     args = [

@@ -134,6 +134,12 @@ class MatrixTable(DenseTable):
         updater = self.updater
         if updater.linear:
             return updater.scatter_apply(storage, ids, deltas), state
+        # Duplicate-occurrence passes pad ids with storage.shape[0]: the
+        # gathers below CLAMP those to the last row (harmless — the
+        # result is discarded) and the scatters must DROP them, or a pad
+        # slot would corrupt the clamped row's storage/state. The drop is
+        # spelled out rather than inherited from JAX's default
+        # out-of-bounds scatter semantics.
         rows = storage[ids]
         state_rows = {
             k: (v[:, ids] if v.ndim == storage.ndim + 1 else v[ids])
@@ -142,13 +148,13 @@ class MatrixTable(DenseTable):
         new_rows, new_state_rows = updater.apply(
             rows, deltas.astype(storage.dtype), state_rows, worker_id, opt
         )
-        storage = storage.at[ids].set(new_rows)
+        storage = storage.at[ids].set(new_rows, mode="drop")
         new_state = {}
         for k, v in state.items():
             if v.ndim == storage.ndim + 1:
-                new_state[k] = v.at[:, ids].set(new_state_rows[k])
+                new_state[k] = v.at[:, ids].set(new_state_rows[k], mode="drop")
             else:
-                new_state[k] = v.at[ids].set(new_state_rows[k])
+                new_state[k] = v.at[ids].set(new_state_rows[k], mode="drop")
         return storage, new_state
 
     def _add_rows_fn(self):

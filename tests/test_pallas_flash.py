@@ -163,9 +163,10 @@ def test_flash_grads_match_reference(causal):
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_flash_matches_reference_and_trains(causal):
     """Ulysses with the flash local kernel: forward matches the dense
-    oracle on a 4-device mesh, and — unlike the flash RING — it stays
-    differentiable (flash_attention carries a custom VJP), so grads must
-    match the xla-impl Ulysses grads."""
+    oracle on a 4-device mesh and — like every flash scheme here (ring
+    and zigzag carry ring-pass custom VJPs, flash_attention its own) —
+    stays differentiable, so grads must match the xla-impl Ulysses
+    grads."""
     from jax.sharding import Mesh
 
     from multiverso_tpu.ops.ring_attention import ulysses_attention
