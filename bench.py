@@ -158,10 +158,17 @@ def _bench_fused(cfg, calls=10, warmup=2, batch=8192, scan_steps=64,
 
 
 def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=256,
-                    corpus_tokens=8_000_000):
+                    corpus_tokens=8_000_000, walk=None):
     """Zero-host-traffic mode: corpus resident in HBM, sampling/negatives/
     presort inside the jitted step (-device_pipeline). Reported as a
-    secondary metric in ACCEPTED pairs/sec (rejected draws aren't trained)."""
+    secondary metric in ACCEPTED pairs/sec (rejected draws aren't trained).
+
+    ``walk``: None = iid center draws (round-2..4 comparable numbers);
+    'perm' = the app's default without-replacement permutation walk;
+    'presort' = the walk with window-presorted centers (walk_n pytree key)
+    — the per-microbatch center argsort moves into the per-epoch prepare,
+    so ('perm' minus 'presort') step time is the measured argsort saving
+    (round-4 VERDICT item 3)."""
     from multiverso_tpu.models.wordembedding.sampler import AliasSampler
     from multiverso_tpu.models.wordembedding.skipgram import (
         build_negative_lut,
@@ -183,6 +190,8 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=256,
     data = make_ondevice_data(
         cfg, corpus, None, build_negative_lut(sampler.probs),
         batch=batch, neg_probs=sampler.probs,
+        walk_seed=None if walk is None else 0,
+        walk_presort=walk == "presort",
     )
     params = init_params(cfg)
     key = jax.random.PRNGKey(0)
@@ -968,6 +977,13 @@ def main():
         "fused_unsorted", lambda: _bench_fused(cfg, presort=False)
     )
     ondevice = leg("ondevice", lambda: _bench_ondevice(cfg))
+    ondevice_walk = leg(
+        "ondevice_walk", lambda: _bench_ondevice(cfg, walk="perm")
+    )
+    ondevice_presort = leg(
+        "ondevice_walk_presort",
+        lambda: _bench_ondevice(cfg, walk="presort"),
+    )
     ps = leg("ps_loop", lambda: _bench_ps_loop(cfg))
     multidev = leg("multidevice", _bench_multidevice)
     sharded = leg("sharded_vocab", _bench_sharded_vocab)
@@ -995,6 +1011,11 @@ def main():
         "uniform_ids_value": round(fused_uniform, 1),
         "unsorted_value": round(fused_unsorted, 1),
         "ondevice_pipeline_value": round(ondevice, 1),
+        # the app's default walk (round-4 quality parity) and the round-5
+        # window-presorted walk: their ratio is the measured saving from
+        # moving the center argsort into the per-epoch prepare
+        "ondevice_walk_value": round(ondevice_walk, 1),
+        "ondevice_walk_presort_value": round(ondevice_presort, 1),
     }
     out.update(roofline)
     out.update(multidev)

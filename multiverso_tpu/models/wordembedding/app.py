@@ -738,6 +738,11 @@ class WordEmbedding:
         )
         keep_dev = _up(keep.astype(np.float32)) if o.sample > 0 else None
         use_walk = o.walk == "perm"
+        # flagship sorted step + walk: window-presort the epoch permutation
+        # so the step's per-microbatch center argsort disappears (the walk
+        # modulus becomes the batch-padded walk_n; the host cursor below
+        # mirrors it)
+        presort_walk = use_walk and flagship
         prep_kw: Dict = {}
         if rep is not None:
             # every per-epoch dyn leaf (corpus, walk perm, scale tables,
@@ -747,6 +752,7 @@ class WordEmbedding:
             make_ondevice_prepare_fn(
                 self.cfg, o.batch_size, subsample=o.sample > 0,
                 scale_tables=scale_tables, walk=use_walk,
+                presort=presort_walk,
             ),
             **prep_kw,
         )
@@ -841,6 +847,11 @@ class WordEmbedding:
                     # bounded (in-cycle offset, cycle) components so no
                     # int32 overflows even for huge single chunks
                     nv = max(n_valid, 1)
+                    if presort_walk:
+                        # presorted walks run on the batch-padded modulus
+                        # (walk_n) — keeps every dispatch window aligned
+                        # to the presorted batch grid
+                        nv = -(-nv // o.batch_size) * o.batch_size
                     data["walk_t"] = np.int32(walk_t % nv)
                     data["walk_c"] = np.int32((walk_t // nv) % per_kept)
                     walk_t = (walk_t + per_call) % max(nv * per_kept, 1)
