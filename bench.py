@@ -164,8 +164,9 @@ def _bench_ondevice(cfg, calls=5, warmup=1, batch=8192, scan_steps=256,
     secondary metric in ACCEPTED pairs/sec (rejected draws aren't trained).
 
     ``walk``: None = iid center draws (round-2..4 comparable numbers);
-    'perm' = the app's default without-replacement permutation walk;
+    'perm' = the round-4 without-replacement permutation walk;
     'presort' = the walk with window-presorted centers (walk_n pytree key)
+    — the flagship app's DEFAULT since round 5 (app.py presort_walk)
     — the per-microbatch center argsort moves into the per-epoch prepare,
     so ('perm' minus 'presort') step time is the measured argsort saving
     (round-4 VERDICT item 3)."""
@@ -830,11 +831,12 @@ def _bench_quality():
     ids, d, qs, sims = generate_natural(ncfg)
     counts = np.asarray(d.counts)
 
-    def train_ours(stream):
+    def train_ours(stream, seed=1):
         opt = WEOptions(
             train_file="<synthetic>", size=128, window=5, negative=5,
             epoch=1, batch_size=8192, sample=1e-3, min_count=1,
             output_file="", steps_per_call=256, device_pipeline=True,
+            seed=seed,
         )
         we = WordEmbedding(opt, dictionary=d)
         t0 = time.perf_counter()
@@ -846,11 +848,36 @@ def _bench_quality():
 
     acc_full, rho_full, rate_full, nq, npair = train_ours(ids)
     sl = ids[:slice_tokens]
-    acc_o, rho_o, rate_o, _, _ = train_ours(sl)
-    ref_emb, ref_rate = train_sgns(sl, len(d), counts, epochs=1)
-    acc_r, _ = analogy_accuracy(d.words, ref_emb, qs)
-    rho_r, _ = similarity_spearman(d.words, ref_emb, sims)
+    # parity slice at MULTIPLE seeds on BOTH systems (round-5 VERDICT
+    # items 4/9: the round-4 claim compared a 4-seed mean against a
+    # single torch draw inside a ~±0.01 noise floor — error bars must be
+    # symmetric). Seed 1 keeps the round-4 single-seed field names.
+    n_seeds = max(1, int(os.environ.get("MV_BENCH_QUALITY_SEEDS", 4)))
+    accs_o, rhos_o, accs_r, rhos_r = [], [], [], []
+    ref_rate = 0.0
+    for s in range(1, n_seeds + 1):
+        a_o, r_o, _, _, _ = train_ours(sl, seed=s)
+        ref_emb, ref_rate_s = train_sgns(sl, len(d), counts, epochs=1, seed=s)
+        a_r, _ = analogy_accuracy(d.words, ref_emb, qs)
+        r_r, _ = similarity_spearman(d.words, ref_emb, sims)
+        accs_o.append(a_o); rhos_o.append(r_o)
+        accs_r.append(a_r); rhos_r.append(r_r)
+        if s == 1:
+            ref_rate = ref_rate_s
+        print(f"# quality seed {s}: ours acc={a_o:.4f} rho={r_o:.4f} | "
+              f"torch acc={a_r:.4f} rho={r_r:.4f}", file=_sys.stderr,
+              flush=True)
+    acc_o, rho_o, acc_r, rho_r = accs_o[0], rhos_o[0], accs_r[0], rhos_r[0]
     return {
+        "quality_seeds": n_seeds,
+        "quality_analogy_ours_mean": round(float(np.mean(accs_o)), 4),
+        "quality_analogy_ours_std": round(float(np.std(accs_o)), 4),
+        "quality_analogy_torch_mean": round(float(np.mean(accs_r)), 4),
+        "quality_analogy_torch_std": round(float(np.std(accs_r)), 4),
+        "quality_spearman_ours_mean": round(float(np.mean(rhos_o)), 4),
+        "quality_spearman_ours_std": round(float(np.std(rhos_o)), 4),
+        "quality_spearman_torch_mean": round(float(np.mean(rhos_r)), 4),
+        "quality_spearman_torch_std": round(float(np.std(rhos_r)), 4),
         "quality_tokens": int((ids >= 0).sum()),
         "quality_analogy_ours_full": round(acc_full, 4),
         "quality_spearman_ours_full": round(rho_full, 4),
@@ -1011,9 +1038,10 @@ def main():
         "uniform_ids_value": round(fused_uniform, 1),
         "unsorted_value": round(fused_unsorted, 1),
         "ondevice_pipeline_value": round(ondevice, 1),
-        # the app's default walk (round-4 quality parity) and the round-5
-        # window-presorted walk: their ratio is the measured saving from
-        # moving the center argsort into the per-epoch prepare
+        # the round-4 permutation walk and the round-5 window-presorted
+        # walk (the app's default since round 5): their ratio is the
+        # measured saving from moving the center argsort into the
+        # per-epoch prepare
         "ondevice_walk_value": round(ondevice_walk, 1),
         "ondevice_walk_presort_value": round(ondevice_presort, 1),
     }

@@ -1,0 +1,81 @@
+"""Multi-seed torch-SGNS baseline at the QUALITY.md parity operating
+point (round-5 VERDICT item 4: the round-4 parity table compared a
+4-seed mean of ours against a SINGLE torch draw inside a ~±0.01 seed
+noise floor — this script makes the error bars symmetric).
+
+Operating point (matches the round-4 table): natural corpus
+``NaturalConfig(tokens=60M, vocab_size=50k)`` (≈57M valid tokens),
+parity slice = first 10M raw ids (≈9.5M valid), 1 epoch, dim 128,
+window 5, neg 5, sample 1e-3 — identical to what both systems trained
+in round 4.
+
+Usage: python benchmarks/quality_seeds.py [--seeds 1 2 3 4] [--threads 2]
+Prints one line per seed and a mean/std summary; paste into QUALITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, nargs="+", default=[1, 2, 3, 4])
+    ap.add_argument("--threads", type=int, default=2)
+    ap.add_argument("--tokens", type=int, default=60_000_000)
+    ap.add_argument("--slice-tokens", type=int, default=10_000_000)
+    ap.add_argument("--vocab", type=int, default=50_000)
+    args = ap.parse_args()
+
+    import torch
+
+    torch.set_num_threads(args.threads)
+
+    from torch_sgns import train_sgns
+
+    from multiverso_tpu.models.wordembedding.eval import (
+        analogy_accuracy,
+        similarity_spearman,
+    )
+    from multiverso_tpu.models.wordembedding.synth_natural import (
+        NaturalConfig,
+        generate_natural,
+    )
+
+    ncfg = NaturalConfig(tokens=args.tokens, vocab_size=args.vocab)
+    ids, d, qs, sims = generate_natural(ncfg)
+    counts = np.asarray(d.counts)
+    sl = ids[: args.slice_tokens]
+    print(f"corpus valid tokens={int((ids >= 0).sum())} "
+          f"slice valid tokens={int((sl >= 0).sum())}", flush=True)
+
+    accs, rhos = [], []
+    for s in args.seeds:
+        t0 = time.perf_counter()
+        emb, rate = train_sgns(sl, len(d), counts, epochs=1, seed=s)
+        acc, nq = analogy_accuracy(d.words, emb, qs)
+        rho, npair = similarity_spearman(d.words, emb, sims)
+        accs.append(acc)
+        rhos.append(rho)
+        print(f"seed {s}: analogy={acc:.4f} ({nq} questions) "
+              f"spearman={rho:.4f} ({npair} pairs) "
+              f"rate={rate:,.0f} pairs/s wall={time.perf_counter()-t0:.0f}s",
+              flush=True)
+    print(f"torch-SGNS over seeds {args.seeds}: "
+          f"analogy mean={np.mean(accs):.4f} std={np.std(accs):.4f} "
+          f"({' '.join(f'{a:.4f}' for a in accs)}) | "
+          f"spearman mean={np.mean(rhos):.4f} std={np.std(rhos):.4f} "
+          f"({' '.join(f'{r:.4f}' for r in rhos)})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

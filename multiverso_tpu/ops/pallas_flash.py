@@ -102,8 +102,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
             acc_s[...] / jnp.maximum(l_s[:, :1], 1e-37)
         ).astype(o_ref.dtype)
         # per-row logsumexp residual for the backward's softmax recompute
+        # (row vectors ride a trailing singleton dim — Mosaic requires the
+        # last two block dims to be (8k, 128k) or equal to the array dims,
+        # which a (1, 1, block_q) block of a (B, H, S) array violates)
         lse_ref[0, 0] = (
-            m_s[:, 0] + jnp.log(jnp.maximum(l_s[:, 0], 1e-37))
+            m_s[:, :1] + jnp.log(jnp.maximum(l_s[:, :1], 1e-37))
         )
 
 
@@ -134,11 +137,13 @@ def _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret,
             pl.BlockSpec(
                 (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
             ),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi)),
+            pl.BlockSpec(
+                (1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)
+            ),
         ],
         out_shape=[
             _sds((B, H, S, D), q.dtype, vma),
-            _sds((B, H, S), jnp.float32, vma),
+            _sds((B, H, S, 1), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
@@ -147,7 +152,7 @@ def _fwd_core(q, k, v, causal, scale, block_q, block_k, interpret,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out, lse
+    return out, lse[..., 0]
 
 
 def _sds(shape, dtype, vma):
@@ -266,10 +271,12 @@ def _flash_carry_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
 
     @pl.when(ki == 0)
     def _load():
-        m_s[...] = m_in[0, 0][:, None] * jnp.ones(
+        # m_in/l_in blocks are (1, 1, block_q, 1): broadcast the column
+        # vector across the scratch's lane dim
+        m_s[...] = m_in[0, 0] * jnp.ones(
             (1, m_s.shape[1]), jnp.float32
         )
-        l_s[...] = l_in[0, 0][:, None] * jnp.ones(
+        l_s[...] = l_in[0, 0] * jnp.ones(
             (1, l_s.shape[1]), jnp.float32
         )
         acc_s[...] = acc_in[0, 0]
@@ -318,8 +325,8 @@ def _flash_carry_kernel(q_ref, k_ref, v_ref, m_in, l_in, acc_in,
 
     @pl.when(ki == n_k - 1)
     def _emit():
-        m_out[0, 0] = m_s[:, 0]
-        l_out[0, 0] = l_s[:, 0]
+        m_out[0, 0] = m_s[:, :1]
+        l_out[0, 0] = l_s[:, :1]
         acc_out[0, 0] = acc_s[...]
 
 
@@ -362,12 +369,14 @@ def flash_attention_carry(
         _flash_carry_kernel, scale=scale, causal_diag=causal_diag,
         block_q=block_q, block_k=block_k, n_k=n_k,
     )
-    state_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi))
+    state_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)
+    )
     acc_spec = pl.BlockSpec(
         (1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)
     )
     kv_idx = _kv_idx_map(causal_diag, block_q, block_k)
-    return pl.pallas_call(
+    m_new, l_new, acc_new = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
         in_specs=[
@@ -380,8 +389,8 @@ def flash_attention_carry(
         ],
         out_specs=[state_spec, state_spec, acc_spec],
         out_shape=[
-            _sds((B, H, Sq), jnp.float32, vma),
-            _sds((B, H, Sq), jnp.float32, vma),
+            _sds((B, H, Sq, 1), jnp.float32, vma),
+            _sds((B, H, Sq, 1), jnp.float32, vma),
             _sds((B, H, Sq, D), jnp.float32, vma),
         ],
         scratch_shapes=[
@@ -391,7 +400,8 @@ def flash_attention_carry(
         ],
         input_output_aliases={3: 0, 4: 1, 5: 2},
         interpret=interpret,
-    )(q, k, v, m, l, acc)
+    )(q, k, v, m[..., None], l[..., None], acc)
+    return m_new[..., 0], l_new[..., 0], acc_new
 
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
@@ -404,8 +414,8 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]          # (block_q,)
-    dvec = dvec_ref[0, 0]        # (block_q,)
+    lse = lse_ref[0, 0]          # (block_q, 1) column vector
+    dvec = dvec_ref[0, 0]        # (block_q, 1) column vector
     s = scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -422,12 +432,12 @@ def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
             jnp.int32, (block_q, block_k), 1
         )
         s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
-    p = jnp.exp(s - lse[:, None])
+    p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - dvec[:, None])
+    ds = p * (dp - dvec)
     return p, ds
 
 
@@ -541,8 +551,12 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
     Sk = kt.shape[2]
     n_q, n_k = Sq // block_q, Sk // block_k
 
+    lse4 = lse[..., None]
+    dvec4 = dvec[..., None]
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, qi, ki: (b, h, qi))
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)
+    )
     kv_spec = pl.BlockSpec((1, 1, block_k, D), _kv_idx_map(causal, block_q, block_k))
     dq = pl.pallas_call(
         functools.partial(
@@ -555,7 +569,7 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
         out_shape=_sds((B, H, Sq, D), jnp.float32, vma),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, do_t, lse, dvec)
+    )(qt, kt, vt, do_t, lse4, dvec4)
 
     # dK/dV pass: K outer, Q inner. Under causal, Q blocks strictly above
     # this K block's diagonal are dead; clamp their DMA to the first live
@@ -566,20 +580,14 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
             return (
                 b, h, jnp.maximum(qi, (ki * block_k) // block_q), 0
             )
-
-        def qrow_idx(b, h, ki, qi):
-            return (b, h, jnp.maximum(qi, (ki * block_k) // block_q))
     else:
         def q_idx(b, h, ki, qi):
             return (b, h, qi, 0)
-
-        def qrow_idx(b, h, ki, qi):
-            return (b, h, qi)
     kv_out_spec = pl.BlockSpec(
         (1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)
     )
     q_in_spec = pl.BlockSpec((1, 1, block_q, D), q_idx)
-    row_in_spec = pl.BlockSpec((1, 1, block_q), qrow_idx)
+    row_in_spec = pl.BlockSpec((1, 1, block_q, 1), q_idx)
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_dkv_kernel, scale=scale, causal=causal,
@@ -598,5 +606,5 @@ def _bwd_core_t(qt, kt, vt, lse, dvec, do_t, causal, scale,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, do_t, lse, dvec)
+    )(qt, kt, vt, do_t, lse4, dvec4)
     return dq, dk, dv

@@ -1,0 +1,128 @@
+"""Compiled-execution gate for the Pallas flash family (round-5 VERDICT
+item 2): every other flash test runs ``interpret=True`` (the Pallas
+interpreter — numerics only), which never proves the kernels LOWER
+through the real Mosaic compiler. These tests run ``interpret=False`` and
+therefore execute only where a real TPU backend is attached (the bench
+host / driver chip); on CPU they skip.
+
+History: the round-4 kernels failed real Mosaic lowering on every
+(B, H, S)-shaped row vector (lse/m/l/dvec) — a ``(1, 1, block_q)`` block
+violates Mosaic's last-two-dims tiling rule (second-to-last block dim
+must be a multiple of 8 or equal the array dim). Round 5 moved those to
+``(B, H, S, 1)`` arrays with ``(1, 1, block_q, 1)`` blocks at each
+pallas_call boundary. This file is the regression gate: green here means
+the whole family compiles AND matches the dense oracle on hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu",
+    reason="compiled (non-interpret) Pallas requires a real TPU backend",
+)
+
+B, S, H, D = 1, 1024, 4, 128
+
+
+def _qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((B, S, H, D), dtype=np.float32))
+        for _ in range(3)
+    )
+
+
+def _dense(q, k, v, causal):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -jnp.inf)
+    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_compiles_and_matches(causal):
+    from multiverso_tpu.ops.pallas_flash import flash_attention
+
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=False)
+    ref = _dense(q, k, v, causal)
+    # TPU default matmul precision (bf16 operands) bounds both sides
+    assert float(jnp.max(jnp.abs(out - ref))) < 3e-2
+
+
+def test_flash_bwd_compiles_and_matches():
+    from multiverso_tpu.ops.pallas_flash import flash_attention
+
+    q, k, v = _qkv(1)
+    g = jax.grad(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=False
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: _dense(q, k, v, True).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 6e-2
+
+
+def test_flash_carry_compiles_with_aliasing():
+    """flash_attention_carry's input_output_aliases on hardware: two
+    passes over split K/V must equal one flash pass over the whole."""
+    from multiverso_tpu.ops.pallas_flash import (
+        flash_attention,
+        flash_attention_carry,
+    )
+
+    q, k, v = _qkv(2)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    m = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+    acc = jnp.zeros((B, H, S, D), jnp.float32)
+    half = S // 2
+    for sl in (slice(0, half), slice(half, S)):
+        m, l, acc = flash_attention_carry(
+            qt, kt[:, :, sl], vt[:, :, sl], m, l, acc,
+            block_q=256, block_k=256, interpret=False,
+        )
+    out = jnp.swapaxes(acc / jnp.maximum(l, 1e-37)[..., None], 1, 2)
+    ref = flash_attention(q, k, v, causal=False, interpret=False)
+    assert float(jnp.max(jnp.abs(out - ref.astype(jnp.float32)))) < 3e-2
+
+
+@pytest.mark.parametrize("scheme", ["ring", "zigzag", "ulysses"])
+def test_flash_schemes_compile_on_one_device_mesh(scheme):
+    """The ring schedule is the same program at n=1 (VERDICT r4 item 7):
+    one real chip proves the shard_map + pallas composition lowers."""
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.ops.ring_attention import (
+        attention_reference,
+        ring_attention,
+        ulysses_attention,
+        zigzag_ring_attention,
+    )
+
+    q, k, v = _qkv(3)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    kw = dict(impl="flash", flash_interpret=False)
+    if scheme == "ring":
+        fn = lambda q, k, v: ring_attention(q, k, v, mesh, "sp", causal=True, **kw)
+    elif scheme == "zigzag":
+        fn = lambda q, k, v: zigzag_ring_attention(q, k, v, mesh, "sp", **kw)
+    else:
+        fn = lambda q, k, v: ulysses_attention(q, k, v, mesh, "sp", causal=True, **kw)
+    ref = attention_reference(q, k, v, causal=True)
+    out = fn(q, k, v)
+    assert float(jnp.max(jnp.abs(out - ref))) < 3e-2
+    g = jax.grad(lambda *a: fn(*a).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda *a: attention_reference(*a, causal=True).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 6e-2
