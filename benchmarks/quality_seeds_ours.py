@@ -1,0 +1,80 @@
+"""Multi-seed OUR-side quality at the QUALITY.md parity operating point
+(companion to quality_seeds.py, which runs the torch baseline): the same
+57M-valid-token natural corpus, same 9.5M-valid-token parity slice, one
+epoch, seeds 1..4 — trained with the flagship device pipeline (the
+`-walk=perm` presorted default).
+
+Usage: python benchmarks/quality_seeds_ours.py [--seeds 1 2 3 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, nargs="+", default=[1, 2, 3, 4])
+    ap.add_argument("--tokens", type=int, default=60_000_000)
+    ap.add_argument("--slice-tokens", type=int, default=10_000_000)
+    ap.add_argument("--vocab", type=int, default=50_000)
+    args = ap.parse_args()
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import (
+        WEOptions,
+        WordEmbedding,
+    )
+    from multiverso_tpu.models.wordembedding.eval import (
+        analogy_accuracy,
+        similarity_spearman,
+    )
+    from multiverso_tpu.models.wordembedding.synth_natural import (
+        NaturalConfig,
+        generate_natural,
+    )
+
+    mv.MV_Init(["-updater_type=sgd"])
+    ncfg = NaturalConfig(tokens=args.tokens, vocab_size=args.vocab)
+    ids, d, qs, sims = generate_natural(ncfg)
+    sl = ids[: args.slice_tokens]
+    print(f"corpus valid tokens={int((ids >= 0).sum())} "
+          f"slice valid tokens={int((sl >= 0).sum())}", flush=True)
+
+    accs, rhos = [], []
+    for s in args.seeds:
+        opt = WEOptions(
+            train_file="<synthetic>", size=128, window=5, negative=5,
+            epoch=1, batch_size=8192, sample=1e-3, min_count=1,
+            output_file="", steps_per_call=256, device_pipeline=True,
+            seed=s,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        t0 = time.perf_counter()
+        we.train(sl)
+        rate = we.words_trained / max(time.perf_counter() - t0, 1e-9)
+        emb = we.embeddings()
+        acc, nq = analogy_accuracy(d.words, emb, qs)
+        rho, npair = similarity_spearman(d.words, emb, sims)
+        accs.append(acc)
+        rhos.append(rho)
+        print(f"seed {s}: analogy={acc:.4f} ({nq} questions) "
+              f"spearman={rho:.4f} ({npair} pairs) "
+              f"rate={rate:,.0f} pairs/s", flush=True)
+    print(f"ours over seeds {args.seeds}: "
+          f"analogy mean={np.mean(accs):.4f} std={np.std(accs):.4f} "
+          f"({' '.join(f'{a:.4f}' for a in accs)}) | "
+          f"spearman mean={np.mean(rhos):.4f} std={np.std(rhos):.4f} "
+          f"({' '.join(f'{r:.4f}' for r in rhos)})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

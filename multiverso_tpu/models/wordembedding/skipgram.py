@@ -1140,6 +1140,34 @@ def make_ondevice_superbatch_step(
             emb_in, emb_out = params["emb_in"], params["emb_out"]
             c, o, w = sample(d, key)
             ts, negs = o[:, 0], o[:, 1:]
+            # Decorrelate the stratified negative block from the slot
+            # index: the sorted flat sequence assigns quantile stratum
+            # k*B + j to slot j, so ADJACENT slots draw ADJACENT quantiles
+            # — near-identical negatives. With window-PRESORTED walks all
+            # duplicates of a hot center word occupy a contiguous slot
+            # run, so every duplicate trains against the same few negative
+            # rows each microbatch: perfectly aligned updates, and
+            # training runs away (measured: 1e14 absmax within one
+            # 256-step superbatch; a cyclic shift does NOT fix it — it
+            # preserves adjacency). A fresh random AFFINE permutation
+            # perm(j) = (a*j + b) mod B (a odd — a bijection for
+            # power-of-two B) spreads any slot run stride-a apart across
+            # the whole quantile range, keeps the scatter's flat sequence
+            # sorted, and costs no argsort. Applied in EVERY mode
+            # (harmless for random-order centers) so the presorted and
+            # argsort step branches stay bit-identical on the same draw.
+            ka, kb = jax.random.split(jax.random.fold_in(key, 7))
+            if batch & (batch - 1) == 0:
+                a = 2 * jax.random.randint(ka, (), 0, batch // 2) + 1
+                b = jax.random.randint(kb, (), 0, batch)
+                perm = (
+                    a * jnp.arange(batch, dtype=jnp.int32) + b
+                ) % batch
+            else:  # rare non-pow2 batch: bijection via a real shuffle
+                perm = jax.random.permutation(ka, batch)
+            nflat = negs.T.reshape(-1)  # the sorted flat scatter sequence
+            negs = negs[perm]           # slot j <- flat stratum perm[j]
+            o = jnp.concatenate([ts[:, None], negs], axis=1)
             vin = emb_in[c]
             vout = emb_out[o]
             logits = jnp.einsum("bd,bkd->bk", vin, vout)
@@ -1148,16 +1176,23 @@ def make_ondevice_superbatch_step(
             loss = jnp.sum(_bce_sum(logits, labels) * w) / n_valid
             g = (jax.nn.sigmoid(logits) - labels) * w[:, None]
             d_vin = jnp.einsum("bk,bkd->bd", g, vout)
-            # negatives block: column-major flatten is sorted by
-            # construction — scatter with no argsort and no permutation
-            # (sorted position j belongs to pair j % B, slot j // B)
-            nflat = negs.T.reshape(-1)
-            gneg = g[:, 1:].T.reshape(-1)
-            nsc = _scale(nflat, jnp.tile(w, K), "neg")
-            # slot-major layout: flat position j belongs to pair j % B, so
-            # the input rows are K stacked copies of vin — a tile/broadcast,
-            # not a gather
-            upd_n = (gneg * nsc)[:, None] * jnp.tile(vin, (K, 1))
+            # negatives block: realign the slot-ordered gradients with the
+            # sorted flat sequence — flat stratum perm[j] carries slot j's
+            # gradient. One (B,) int scatter builds the inverse, then the
+            # wide arrays move by GATHER (cheaper than three full-width
+            # scatters in this hot scan body)
+            inv = jnp.zeros((batch,), jnp.int32).at[perm].set(
+                jnp.arange(batch, dtype=jnp.int32)
+            )
+            g_n = g[:, 1:][inv]
+            w_n = w[inv]
+            vin_n = vin[inv]
+            gneg = g_n.T.reshape(-1)
+            nsc = _scale(nflat, jnp.tile(w_n, K), "neg")
+            # stratum-major layout: flat position k*B + i belongs to the
+            # slot that perm maps to i, so the input rows are K stacked
+            # copies of the realigned vin — a tile, not a second gather
+            upd_n = (gneg * nsc)[:, None] * jnp.tile(vin_n, (K, 1))
             emb_out = emb_out.at[nflat].add(-lr * upd_n, indices_are_sorted=True)
             # positives: small (B) argsort
             operm = jnp.argsort(ts)
