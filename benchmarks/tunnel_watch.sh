@@ -20,7 +20,9 @@ while true; do
   echo "$(date -u +%FT%TZ) tunnel=$STATE" >> "$LOG"
   if [ "$STATE" = up ] && [ "$PREV" = down ]; then
     echo "$(date -u +%FT%TZ) recovery edge: running bench" >> "$LOG"
-    python bench.py > "$BENCHOUT" 2>> "$LOG" || true
+    # bounded like the probe: a tunnel that flaps down again mid-bench
+    # must not hang the watcher forever
+    timeout 5400 python bench.py > "$BENCHOUT" 2>> "$LOG" || true
   fi
   PREV=$STATE
   sleep 470

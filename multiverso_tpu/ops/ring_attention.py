@@ -119,6 +119,27 @@ def _fit_block(seq_len: int, block: int) -> int:
 _MIN_MOSAIC_BLOCK = 128
 
 
+def _resolve_impl(impl: str, interpret: bool, *seq_lens: int,
+                  block: int) -> str:
+    """One policy for every attention entry point: ``'auto'`` (the
+    default) picks the fused Pallas tile on a real TPU backend and the
+    jnp tile everywhere else, then the viability floor applies to any
+    flash choice (explicit or auto) with a logged xla fallback.
+
+    Measured basis for the auto choice (round 5, TPU v5 lite, S=32k,
+    B=1 H=8 D=128, bf16 inputs, host-readback fenced): flash forward
+    26.3 TFLOP/s vs 19.5 for the jnp blockwise tile (+35%), flash
+    fwd+bwd 66.1 TFLOP/s effective (33.6% MFU vs the bf16 peak). On CPU
+    the compiled Pallas path does not exist, so auto == xla there."""
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "flash" and not _flash_viable(
+        interpret, *seq_lens, block=block
+    ):
+        impl = "xla"
+    return impl
+
+
 def _flash_viable(interpret: bool, *seq_lens: int, block: int) -> bool:
     """True when the fused Pallas tile can actually compile for these
     local sequence lengths. Interpret mode runs any size (tests use tiny
@@ -304,7 +325,7 @@ def ring_attention_local(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
-    impl: str = "xla",
+    impl: str = "auto",
     flash_block: int = 512,
     flash_interpret: bool = False,
 ) -> jnp.ndarray:
@@ -312,22 +333,27 @@ def ring_attention_local(
 
     q, k, v are the *local* sequence blocks (B, S/n, H, D) of a
     sequence-sharded global array. Returns the local block of the output.
-    Differentiable with BOTH impls: the default ``impl='xla'`` jnp tile
-    via plain autodiff, ``impl='flash'`` (fused Pallas MXU tiles, state
+    Differentiable with BOTH impls: the ``impl='xla'`` jnp tile via
+    plain autodiff, ``impl='flash'`` (fused Pallas MXU tiles, state
     carried across ring steps in kernel layout) via a custom VJP whose
     backward is a second ring pass over the saved logsumexp
     (``flash_interpret=True`` for non-TPU backends; ``flash_block``
     tunes the Pallas tile, auto-shrunk to divide the local blocks).
+    ``impl='auto'`` (default since round 5) resolves to flash on a TPU
+    backend and xla elsewhere — see ``_resolve_impl`` for the measured
+    basis (+35% fwd, 33.6% fwd+bwd MFU at S=32k on the v5 lite).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
 
-    if impl == "flash" and not _flash_viable(
-        flash_interpret, Sq, Sk, block=flash_block
-    ):
+    if impl == "auto" and causal and Sq != Sk:
+        # the flash ring's causal form requires equal q/k blocks; auto
+        # must not turn a working xla call into an assert — only an
+        # EXPLICIT impl='flash' request hits the assertion below
         impl = "xla"
+    impl = _resolve_impl(impl, flash_interpret, Sq, Sk, block=flash_block)
     if impl == "flash":
         if causal:
             assert Sq == Sk, "flash ring causal requires equal q/k blocks"
@@ -553,7 +579,7 @@ def zigzag_ring_attention_local(
     v: jnp.ndarray,
     axis_name: str,
     scale: Optional[float] = None,
-    impl: str = "xla",
+    impl: str = "auto",
     flash_block: int = 512,
     flash_interpret: bool = False,
 ) -> jnp.ndarray:
@@ -595,10 +621,7 @@ def zigzag_ring_attention_local(
     B, Sq, H, D = q.shape
     c = Sq // 2
 
-    if impl == "flash" and not _flash_viable(
-        flash_interpret, c, block=flash_block
-    ):
-        impl = "xla"
+    impl = _resolve_impl(impl, flash_interpret, c, block=flash_block)
     if impl == "flash":
         # Fused Pallas tiles on the same schedule, DIFFERENTIABLE via
         # _flash_zigzag_t's custom VJP (a second zigzag pass over the
@@ -701,7 +724,7 @@ def zigzag_ring_attention(
     mesh: Mesh,
     seq_axis: str,
     scale: Optional[float] = None,
-    impl: str = "xla",
+    impl: str = "auto",
     flash_block: int = 512,
     flash_interpret: bool = False,
 ) -> jnp.ndarray:
@@ -728,7 +751,7 @@ def ulysses_attention_local(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
-    impl: str = "xla",
+    impl: str = "auto",
     flash_block: int = 512,
     flash_interpret: bool = False,
 ) -> jnp.ndarray:
@@ -747,10 +770,8 @@ def ulysses_attention_local(
     qh = a2a(q, split_axis=2, concat_axis=1)
     kh = a2a(k, split_axis=2, concat_axis=1)
     vh = a2a(v, split_axis=2, concat_axis=1)
-    if impl == "flash" and not _flash_viable(
-        flash_interpret, qh.shape[1], block=flash_block
-    ):
-        impl = "xla"
+    impl = _resolve_impl(impl, flash_interpret, qh.shape[1],
+                         block=flash_block)
     if impl == "flash":
         from multiverso_tpu.ops.pallas_flash import flash_attention
 
@@ -834,7 +855,7 @@ def ring_attention(
     seq_axis: str,
     causal: bool = False,
     scale: Optional[float] = None,
-    impl: str = "xla",
+    impl: str = "auto",
     flash_block: int = 512,
     flash_interpret: bool = False,
 ) -> jnp.ndarray:
@@ -857,7 +878,7 @@ def ulysses_attention(
     seq_axis: str,
     causal: bool = False,
     scale: Optional[float] = None,
-    impl: str = "xla",
+    impl: str = "auto",
     flash_block: int = 512,
     flash_interpret: bool = False,
 ) -> jnp.ndarray:

@@ -13,18 +13,41 @@ each test, not a mock — here a real 8-device mesh with real XLA collectives.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# MV_TEST_REAL_TPU=1 keeps the session on the real accelerator so the
+# compiled (non-interpret) Pallas gate in test_pallas_flash_compiled.py
+# can execute: `MV_TEST_REAL_TPU=1 pytest tests/test_pallas_flash_compiled.py`
+# on the bench host. Default: the 8-device fake-CPU pod every other test
+# expects.
+if os.environ.get("MV_TEST_REAL_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-# The environment preloads jax at interpreter startup (site hook), so the env
-# var alone is too late — override the live config before any backend is built.
-import jax  # noqa: E402
+    # The environment preloads jax at interpreter startup (site hook), so
+    # the env var alone is too late — override the live config before any
+    # backend is built.
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Under MV_TEST_REAL_TPU=1 the fake 8-device pod is disabled, so
+    every mesh-building test would fail on the one-chip host — keep only
+    the compiled-Pallas gate (the flag's whole purpose) and deselect the
+    rest instead of letting them error."""
+    if os.environ.get("MV_TEST_REAL_TPU") != "1":
+        return
+    keep = [i for i in items if "test_pallas_flash_compiled" in str(i.fspath)]
+    drop = [i for i in items if "test_pallas_flash_compiled" not in str(i.fspath)]
+    if drop:
+        config.hook.pytest_deselected(items=drop)
+        items[:] = keep
 
 
 @pytest.fixture

@@ -94,6 +94,26 @@ def test_flash_carry_compiles_with_aliasing():
     assert float(jnp.max(jnp.abs(out - ref.astype(jnp.float32)))) < 3e-2
 
 
+def test_impl_auto_resolves_to_flash_on_tpu():
+    """The round-5 default: ``impl='auto'`` must pick the fused Pallas
+    tile on a TPU backend (measured +35% fwd over the jnp tile at S=32k)
+    and still match the oracle through the ring composition."""
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.ops.ring_attention import (
+        _resolve_impl,
+        attention_reference,
+        ring_attention,
+    )
+
+    assert _resolve_impl("auto", False, S, S, block=512) == "flash"
+    q, k, v = _qkv(7)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    out = ring_attention(q, k, v, mesh, "sp", causal=True)  # default auto
+    ref = attention_reference(q, k, v, causal=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 3e-2
+
+
 @pytest.mark.parametrize("scheme", ["ring", "zigzag", "ulysses"])
 def test_flash_schemes_compile_on_one_device_mesh(scheme):
     """The ring schedule is the same program at n=1 (VERDICT r4 item 7):
