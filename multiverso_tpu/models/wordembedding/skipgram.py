@@ -707,7 +707,15 @@ def make_ondevice_data(
     # never-span-a-marker test. Derived ON DEVICE from the corpus
     # buffer that uploads anyway — a host-side cumsum would ship a
     # second corpus-sized buffer over the ~12 MB/s link.
-    data["sent"] = jnp.cumsum((corpus_dev < 0).astype(jnp.int32))
+    # packed (token, sentence-id) rows: the SG sampler's four scalar
+    # gathers (corpus[p], corpus[qc], sent[p], sent[qc]) become two
+    # 2-wide ROW gathers — TPU gathers pay per row, not per byte, and
+    # sampling is gather-element-rate-bound (measured round 5). The
+    # sentence-id vector lives ONLY as cs[:, 1] (a standalone copy
+    # would be corpus-sized dead HBM on the flagship path; the CBOW
+    # sampler slices it out on demand).
+    sent = jnp.cumsum((corpus_dev < 0).astype(jnp.int32))
+    data["cs"] = jnp.stack([data["corpus"], sent], axis=1)
     data.update(
         make_ondevice_statics(config, neg_lut, batch=batch, huffman=huffman)
     )
@@ -855,11 +863,14 @@ def make_ondevice_prepare_fn(
         vidx = jnp.where(validm, vcnt, P)
         valid_pos = jnp.zeros((P,), jnp.int32).at[vidx].set(pos, mode="drop")
         n_valid = jnp.sum(validm.astype(jnp.int32))
+        sent = jnp.cumsum((corpus < 0).astype(jnp.int32))
         dyn = {
             "corpus": corpus,
             "valid_pos": valid_pos,
             "n_valid": n_valid,
-            "sent": jnp.cumsum((corpus < 0).astype(jnp.int32)),
+            # packed rows for the SG sampler's two-row-gather fast path;
+            # sentence ids ride ONLY as cs[:, 1] (see make_ondevice_data)
+            "cs": jnp.stack([corpus, sent], axis=1),
         }
         if walk:
             # fresh random permutation of the live slots of valid_pos:
@@ -970,8 +981,16 @@ def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
         # valid_pos/walk_pos; presorted walks pad with the sentinel
         # position P, whose gather clamps to corpus[P-1] (possibly a -1
         # marker) — floor it so downstream gathers never wrap, and
-        # weight the slot 0 below
-        c = jnp.maximum(corpus[p], 0)
+        # weight the slot 0 below.
+        # "cs" fast path: packed (token, sent) rows turn the four scalar
+        # gathers of this function into two row gathers (TPU gathers pay
+        # per row; sampling is gather-rate-bound — round 5)
+        packed = "cs" in data
+        if packed:
+            row_p = data["cs"][p]                 # (B, 2)
+            c = jnp.maximum(row_p[:, 0], 0)
+        else:
+            c = jnp.maximum(corpus[p], 0)
         # one draw for (distance, direction): r in [0, 2T)
         if stratum is None:
             r = jax.random.randint(ks[1], (batch,), 0, 2 * T)
@@ -992,13 +1011,21 @@ def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
         off = jnp.where(r < T, d, -d)
         qpos = p + off
         qc = jnp.clip(qpos, 0, n_corpus - 1)
-        t = corpus[qc]
         # word2vec windows never span a sentence marker (pairgen.cpp:15
         # semantics, aligned in round 3; round 2 only checked the
         # endpoint): the precomputed sentence-id array turns the crossing
-        # test into ONE extra (B,) gather — markers bump the id, so any
+        # test into ONE extra gather — markers bump the id, so any
         # marker between p and q makes the ids differ
-        valid = (t >= 0) & (qpos == qc) & (data["sent"][p] == data["sent"][qc])
+        if packed:
+            row_q = data["cs"][qc]                # (B, 2)
+            t = row_q[:, 0]
+            valid = (t >= 0) & (qpos == qc) & (row_p[:, 1] == row_q[:, 1])
+        else:
+            t = corpus[qc]
+            valid = (
+                (t >= 0) & (qpos == qc)
+                & (data["sent"][p] == data["sent"][qc])
+            )
         if "walk_n" in data:  # reject the presorted walk's sentinel pads
             valid = valid & (p < n_corpus)
         ts = jnp.maximum(t, 0)
@@ -1135,10 +1162,8 @@ def make_ondevice_superbatch_step(
             return w_in_order * table[ids_sorted]
 
         def body(params, xs):
-            key, off = xs
-            d = _with_walk_cursor(data, off)
+            key, (c, o, w) = xs
             emb_in, emb_out = params["emb_in"], params["emb_out"]
-            c, o, w = sample(d, key)
             ts, negs = o[:, 0], o[:, 1:]
             # Decorrelate the stratified negative block from the slot
             # index: the sorted flat sequence assigns quantile stratum
@@ -1221,7 +1246,29 @@ def make_ondevice_superbatch_step(
 
         keys = jax.random.split(key, steps)
         offs = jnp.arange(steps, dtype=jnp.int32) * batch
-        params, (losses, accepted) = jax.lax.scan(body, params, (keys, offs))
+        # Chunked sampling: vmap a chunk of microbatches' sampling into
+        # ONE program per outer step — the (B,)-sized corpus/LUT gathers
+        # are per-op-overhead-bound inside a plain scan (measured 7.5M
+        # slots/s scanned vs 25.5M at 16x batched on the v5 lite, round
+        # 5), while the parameter updates stay an inner sequential scan
+        # (each microbatch trains against post-update rows, as before).
+        # Keys and cursor offsets are IDENTICAL to the unchunked form,
+        # so the sampled streams are bit-for-bit unchanged.
+        pf = 16
+        while steps % pf:
+            pf //= 2
+        kc = keys.reshape(steps // pf, pf, *keys.shape[1:])
+        oc = offs.reshape(steps // pf, pf)
+
+        def outer(params, xs):
+            ks, os = xs
+            mbs = jax.vmap(
+                lambda k, o: sample(_with_walk_cursor(data, o), k)
+            )(ks, os)
+            params, (losses, accs) = jax.lax.scan(body, params, (ks, mbs))
+            return params, (losses, accs)
+
+        params, (losses, accepted) = jax.lax.scan(outer, params, (kc, oc))
         return params, (jnp.mean(losses), jnp.sum(accepted))
 
     return superstep
@@ -1286,12 +1333,15 @@ def make_ondevice_general_superbatch_step(
             qc = jnp.clip(qpos, 0, n_corpus - 1)
             t = corpus[qc]  # (B, 2W)
             # windows never span a sentence marker (pairgen.cpp:15
-            # semantics): one sentence-id gather per slot
+            # semantics): one sentence-id gather per slot (sentence ids
+            # ride as cs[:, 1] in builder pytrees; standalone "sent"
+            # covers legacy hand-built ones)
+            sent = data["cs"][:, 1] if "cs" in data else data["sent"]
             m = (
                 (jnp.abs(offs)[None, :] <= b[:, None])
                 & (t >= 0)
                 & (qpos == qc)
-                & (data["sent"][qc] == data["sent"][p][:, None])
+                & (sent[qc] == sent[p][:, None])
             )
             ts = jnp.maximum(t, 0)
             w = jnp.ones((batch,), jnp.float32)

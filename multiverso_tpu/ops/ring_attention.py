@@ -770,6 +770,11 @@ def ulysses_attention_local(
     qh = a2a(q, split_axis=2, concat_axis=1)
     kh = a2a(k, split_axis=2, concat_axis=1)
     vh = a2a(v, split_axis=2, concat_axis=1)
+    if impl == "auto" and kh.shape[1] != qh.shape[1]:
+        # flash assumes one S for Q and K/V; auto must not turn a
+        # working cross-attention call into the ValueError below — only
+        # an EXPLICIT impl='flash' request errors
+        impl = "xla"
     impl = _resolve_impl(impl, flash_interpret, qh.shape[1],
                          block=flash_block)
     if impl == "flash":
