@@ -124,10 +124,11 @@ def _resolve_impl(impl: str, interpret: bool, *seq_lens: int,
     flash choice (explicit or auto) with a logged xla fallback.
 
     Measured basis for the auto choice (round 5, TPU v5 lite, S=32k,
-    B=1 H=8 D=128, bf16 inputs, host-readback fenced): flash forward
-    26.3 TFLOP/s vs 19.5 for the jnp blockwise tile (+35%), flash
-    fwd+bwd 66.1 TFLOP/s effective (33.6% MFU vs the bf16 peak). On CPU
-    the compiled Pallas path does not exist, so auto == xla there."""
+    B=1 H=8 D=128, bf16 inputs, host-readback fenced, at the tuned
+    Q 512 / K 2048 blocks): flash forward 43.3 TFLOP/s vs 19.5 for the
+    jnp blockwise tile (+2.2x), full flash fwd+bwd 81.8 TFLOP/s
+    effective (41.5% MFU vs the bf16 peak). On CPU the compiled Pallas
+    path does not exist, so auto == xla there."""
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "flash" and not _flash_viable(
