@@ -12,6 +12,10 @@ cmake -S . -B build >/dev/null
 cmake --build build --parallel
 
 echo "== unit + integration tests (8-device CPU mesh) =="
+# the fused Pallas train-step suite (tests/test_fused_step.py) runs here
+# in INTERPRET mode — the kernel logic is tier-1 on CPU, never TPU-gated;
+# only the Mosaic-lowering gate (tests/test_fused_step_compiled.py)
+# needs real hardware (MV_TEST_REAL_TPU=1 on the bench host)
 MV_BENCH_ASSERTS=1 python -m pytest tests/ -q
 
 # foreign-language bindings: the suite contains the Lua and C# binding

@@ -39,6 +39,9 @@ __all__ = [
     "make_superbatch_step",
     "make_sorted_train_step",
     "make_sorted_superbatch_step",
+    "make_fused_train_step",
+    "make_fused_superbatch_step",
+    "presort_fused_batch",
     "make_ondevice_batch_fn",
     "make_ondevice_data",
     "make_ondevice_prepare_fn",
@@ -471,6 +474,19 @@ def presort_batch(
     return out
 
 
+def _apply_sorted(table, g2, ids, upd, lr, eps=1e-6):
+    """The sorted-scatter row update rule — ONE definition shared by the
+    host-presorted step AND the fused step's tile-sequential XLA
+    reference (which must bit-match it; the fused Pallas kernel encodes
+    the same math, incl. AdaGrad's gather-the-POST-add-g2 scaling, in
+    its run-flush — see ops/pallas_embed._scatter_runs)."""
+    if g2 is None:
+        return table.at[ids].add(-lr * upd, indices_are_sorted=True), None
+    g2 = g2.at[ids].add(upd * upd, indices_are_sorted=True)
+    sc = jax.lax.rsqrt(g2[ids] + eps)
+    return table.at[ids].add(-lr * upd * sc, indices_are_sorted=True), g2
+
+
 def make_sorted_train_step(
     config: SkipGramConfig, hs: bool = False, use_adagrad: bool = False
 ):
@@ -483,14 +499,6 @@ def make_sorted_train_step(
     batch_dict holds centers + outputs (NS) or points/codes/lengths (HS),
     contexts for CBOW, and the six presort arrays.
     """
-    eps = 1e-6
-
-    def apply_sorted(table, g2, ids, upd, lr):
-        if g2 is None:
-            return table.at[ids].add(-lr * upd, indices_are_sorted=True), None
-        g2 = g2.at[ids].add(upd * upd, indices_are_sorted=True)
-        sc = jax.lax.rsqrt(g2[ids] + eps)
-        return table.at[ids].add(-lr * upd * sc, indices_are_sorted=True), g2
 
     def step(params, batch, lr):
         emb_in, emb_out = params["emb_in"], params["emb_out"]
@@ -518,7 +526,7 @@ def make_sorted_train_step(
         # of its sample — gathers hit only the small per-batch buffers
         op, osort, oscale = batch["out_perm"], batch["out_sort"], batch["out_scale"]
         upd_o = (gmat.reshape(-1)[op] * oscale)[:, None] * vin[op // ncol]
-        emb_out, g2o = apply_sorted(emb_out, params.get("g2_out"), osort, upd_o, lr)
+        emb_out, g2o = _apply_sorted(emb_out, params.get("g2_out"), osort, upd_o, lr)
 
         ip, isort, iscale = batch["in_perm"], batch["in_sort"], batch["in_scale"]
         if cbow:
@@ -526,7 +534,7 @@ def make_sorted_train_step(
             upd_i = dv[ip // contexts.shape[1]] * iscale[:, None]
         else:
             upd_i = d_vin[ip] * iscale[:, None]
-        emb_in, g2i = apply_sorted(emb_in, params.get("g2_in"), isort, upd_i, lr)
+        emb_in, g2i = _apply_sorted(emb_in, params.get("g2_in"), isort, upd_i, lr)
 
         new = {**params, "emb_in": emb_in, "emb_out": emb_out}
         if use_adagrad:
@@ -547,6 +555,192 @@ def make_sorted_superbatch_step(
         params, losses = jax.lax.scan(lambda p, b: step(p, b, lr), params, batches)
         return params, jnp.mean(losses)
 
+    return superstep
+
+
+def presort_fused_batch(
+    batch: Dict[str, np.ndarray],
+    tile: int = 256,
+    scale_mode: str = "row_mean",
+) -> Dict[str, np.ndarray]:
+    """Augment a finalized NS skip-gram batch with the PER-TILE sort
+    metadata the fused Pallas train step consumes (``fin_*``/``fout_*``/
+    ``fvalid`` keys — see ``ops.pallas_embed.fused_ns_train_step``).
+
+    The host presort story of ``presort_batch``, restricted per batch
+    tile: within each tile the kernel reduces every row's contributions
+    in VMEM and writes the row back once. Scale semantics match
+    ``presort_updates`` (row-mean counts over the WHOLE microbatch, or
+    raw word2vec accumulate), so at ``tile >= B`` the fused step is the
+    XLA sorted step exactly. Batches not a multiple of ``tile`` are
+    padded: pad pairs point at row 0 with zero scale and zero validity —
+    no gradient, no loss, one wasted no-op row write per padded run."""
+    from multiverso_tpu.ops.pallas_embed import fused_sort_metadata
+
+    assert scale_mode in ("row_mean", "raw"), scale_mode
+    centers = np.asarray(batch["centers"], np.int32).reshape(-1)
+    outputs = np.asarray(batch["outputs"], np.int32)
+    B, NC = outputs.shape
+    Bp = -(-B // tile) * tile
+    valid = np.zeros(Bp, np.float32)
+    valid[:B] = 1.0
+
+    def _scale(ids_real, n_pad):
+        if scale_mode == "raw":
+            s = np.ones(ids_real.size, np.float32)
+        else:
+            cnt = np.bincount(ids_real)
+            s = (1.0 / np.maximum(cnt[ids_real], 1.0)).astype(np.float32)
+        return np.concatenate([s, np.zeros(n_pad, np.float32)])
+
+    si = _scale(centers, Bp - B)
+    so = _scale(outputs.reshape(-1), (Bp - B) * NC)
+    if Bp > B:
+        centers = np.concatenate([centers, np.zeros(Bp - B, np.int32)])
+        outputs = np.concatenate(
+            [outputs, np.zeros((Bp - B, NC), np.int32)]
+        )
+    out = dict(batch)
+    out["centers"], out["outputs"] = centers, outputs
+    (out["fin_sort"], out["fin_perm"], out["fin_slot"],
+     out["fin_scale"]) = fused_sort_metadata(centers, tile, scale=si)
+    (out["fout_sort"], out["fout_perm"], out["fout_slot"],
+     out["fout_scale"]) = fused_sort_metadata(
+        outputs.reshape(-1), tile * NC, scale=so
+    )
+    out["fvalid"] = valid
+    return out
+
+
+def make_fused_train_step(
+    config: SkipGramConfig,
+    use_adagrad: bool = False,
+    *,
+    tile: int = 256,
+    impl: str = "auto",
+    interpret: bool = False,
+):
+    """Fused-kernel NS skip-gram train step factory: ``(params,
+    fused_batch, lr) -> (params, loss)`` over ``presort_fused_batch``
+    batches, behind the repo's ``impl='auto'|'xla'|'pallas'`` convention
+    (ops/ring_attention.py precedent).
+
+    ``impl='pallas'`` runs ``ops.pallas_embed.fused_ns_train_step`` — one
+    HBM pass per touched row (gather -> logits -> grad -> scatter-update
+    fused; tiles apply sequentially). ``impl='xla'`` (and every fallback)
+    runs the TILE-SEQUENTIAL XLA reference: a ``lax.scan`` over the same
+    tiles issuing the same per-tile-sorted scatter-adds — the numerics
+    oracle the kernel is tested against, bit-comparable up to float
+    reassociation. ``'auto'`` resolves via
+    ``pallas_embed.resolve_fused_impl`` (currently 'xla' everywhere —
+    the compiled kernel's wall-clock is unmeasured this round, so the
+    kernel is explicit opt-in; the viability floor then guards any
+    pallas choice with a logged xla fallback). The resolved choice is exposed as
+    ``step.impl``. AdaGrad is selected by the PARAMS pytree (g2_in/g2_out
+    present — the ``fused_ns_train_step`` convention) identically in both
+    impls; ``use_adagrad`` only informs the viability gate's VMEM scratch
+    estimate, so pass it truthfully."""
+    assert not config.cbow, "fused step supports NS skip-gram only"
+    from multiverso_tpu.ops import pallas_embed as pe
+
+    NC = 1 + config.negatives
+    resolved = pe.resolve_fused_impl(
+        impl, interpret, dim=config.dim, tile=tile, ncol=NC,
+        adagrad=use_adagrad,
+    )
+
+    if resolved == "pallas":
+
+        def step(params, batch, lr):
+            return pe.fused_ns_train_step(
+                params, batch, lr, tile=tile, interpret=interpret
+            )
+
+    else:
+
+        def step(params, batch, lr):
+            B = batch["fin_sort"].shape[0]
+            G = B // tile
+
+            def resh(a, w):
+                return a.reshape((G, w) + a.shape[2:]) if a.ndim > 1 else (
+                    a.reshape(G, w)
+                )
+
+            xs = {
+                "c": batch["centers"].reshape(G, tile),
+                "o": batch["outputs"].reshape(G, tile, NC),
+                "isort": resh(batch["fin_sort"], tile),
+                "iperm": resh(batch["fin_perm"], tile),
+                "iscale": resh(batch["fin_scale"], tile),
+                "osort": resh(batch["fout_sort"], tile * NC),
+                "operm": resh(batch["fout_perm"], tile * NC),
+                "oscale": resh(batch["fout_scale"], tile * NC),
+                "v": resh(batch["fvalid"], tile),
+            }
+
+            def body(p, x):
+                vin = p["emb_in"][x["c"]]
+                vout = p["emb_out"][x["o"]]
+                logits = jnp.einsum("bd,bkd->bk", vin, vout)
+                labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+                lsum = jnp.sum(_bce_sum(logits, labels) * x["v"])
+                g = jax.nn.sigmoid(logits) - labels
+                d_vin = jnp.einsum("bk,bkd->bd", g, vout)
+                updo = g.reshape(-1)[:, None] * jnp.broadcast_to(
+                    vin[:, None, :], (tile, NC, vin.shape[-1])
+                ).reshape(tile * NC, -1)
+                upd_o = updo[x["operm"]] * x["oscale"][:, None]
+                eo, g2o = _apply_sorted(
+                    p["emb_out"], p.get("g2_out"), x["osort"], upd_o, lr
+                )
+                upd_i = d_vin[x["iperm"]] * x["iscale"][:, None]
+                ei, g2i = _apply_sorted(
+                    p["emb_in"], p.get("g2_in"), x["isort"], upd_i, lr
+                )
+                # AdaGrad is keyed off the params pytree, EXACTLY like
+                # the kernel path (adagrad = 'g2_in' in params): keying
+                # the threading off use_adagrad while the scaling keys
+                # off p.get() would rsqrt-scale against a never-advancing
+                # g2 when the two disagree
+                new = {**p, "emb_in": ei, "emb_out": eo}
+                if "g2_in" in p:
+                    new["g2_in"], new["g2_out"] = g2i, g2o
+                return new, lsum
+
+            params, lsums = jax.lax.scan(body, params, xs)
+            loss = jnp.sum(lsums) / jnp.maximum(
+                jnp.sum(batch["fvalid"]), 1.0
+            )
+            return params, loss
+
+    step.impl = resolved
+    return step
+
+
+def make_fused_superbatch_step(
+    config: SkipGramConfig,
+    use_adagrad: bool = False,
+    *,
+    tile: int = 256,
+    impl: str = "auto",
+    interpret: bool = False,
+):
+    """``lax.scan`` over S fused microbatches (stacked
+    ``presort_fused_batch`` dicts, leading S dim) in one dispatch —
+    ``make_sorted_superbatch_step``'s shape for the fused kernel path.
+    The resolved impl rides on ``superstep.impl``."""
+    step = make_fused_train_step(
+        config, use_adagrad, tile=tile, impl=impl, interpret=interpret
+    )
+
+    def superstep(params, batches, lr):
+        params, losses = jax.lax.scan(
+            lambda p, b: step(p, b, lr), params, batches
+        )
+        return params, jnp.mean(losses)
+
+    superstep.impl = step.impl
     return superstep
 
 
@@ -674,7 +868,6 @@ def make_ondevice_data(
     assert valid.size > 0, "corpus has no non-marker tokens"
     corpus_dev = jnp.asarray(corpus_np)
     data: Dict[str, jnp.ndarray] = {
-        "corpus": corpus_dev,
         "valid_pos": jnp.asarray(valid),
         "n_valid": jnp.asarray(np.int32(valid.size)),
     }
@@ -710,12 +903,14 @@ def make_ondevice_data(
     # packed (token, sentence-id) rows: the SG sampler's four scalar
     # gathers (corpus[p], corpus[qc], sent[p], sent[qc]) become two
     # 2-wide ROW gathers — TPU gathers pay per row, not per byte, and
-    # sampling is gather-element-rate-bound (measured round 5). The
-    # sentence-id vector lives ONLY as cs[:, 1] (a standalone copy
-    # would be corpus-sized dead HBM on the flagship path; the CBOW
-    # sampler slices it out on demand).
+    # sampling is gather-element-rate-bound (measured round 5). Both the
+    # token stream and the sentence ids live ONLY inside ``cs`` (tokens
+    # as cs[:, 0], sentence ids as cs[:, 1]): a standalone "corpus" or
+    # "sent" vector would be a corpus-sized dead int32 HBM buffer on the
+    # flagship path (ADVICE r5 — the SG/CBOW samplers slice/row-gather
+    # from cs directly).
     sent = jnp.cumsum((corpus_dev < 0).astype(jnp.int32))
-    data["cs"] = jnp.stack([data["corpus"], sent], axis=1)
+    data["cs"] = jnp.stack([corpus_dev, sent], axis=1)
     data.update(
         make_ondevice_statics(config, neg_lut, batch=batch, huffman=huffman)
     )
@@ -811,7 +1006,9 @@ def make_ondevice_prepare_fn(
     indices in ``[0, n_valid)`` with ``n_valid`` a traced device scalar.
 
     Returns ``prepare(ids_raw, keep, p34, key) -> dyn`` where ``dyn`` has
-    corpus / valid_pos / n_valid (+ inv_io / inv_neg when
+    cs (packed (token, sentence-id) rows — the compacted corpus rides
+    ONLY as cs[:, 0], no standalone corpus-sized buffer) / valid_pos /
+    n_valid (+ inv_io / inv_neg when
     ``scale_tables``); merge as ``{**statics, **dyn}`` with the
     distribution-static entries from ``make_ondevice_data`` (dist_lut,
     neg_lut, neg_lo, neg_span, Huffman tables). ``p34`` is the static
@@ -865,11 +1062,12 @@ def make_ondevice_prepare_fn(
         n_valid = jnp.sum(validm.astype(jnp.int32))
         sent = jnp.cumsum((corpus < 0).astype(jnp.int32))
         dyn = {
-            "corpus": corpus,
             "valid_pos": valid_pos,
             "n_valid": n_valid,
             # packed rows for the SG sampler's two-row-gather fast path;
-            # sentence ids ride ONLY as cs[:, 1] (see make_ondevice_data)
+            # the token stream and sentence ids ride ONLY as cs[:, 0] /
+            # cs[:, 1] — no standalone corpus-sized buffers (see
+            # make_ondevice_data)
             "cs": jnp.stack([corpus, sent], axis=1),
         }
         if walk:
@@ -973,8 +1171,15 @@ def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
     W = config.window
 
     def pairs(data, key):
-        corpus = data["corpus"]
-        n_corpus = corpus.shape[0]
+        # "cs" pytrees carry the token stream only as cs[:, 0] (no
+        # standalone corpus buffer — ADVICE r5); legacy hand-built
+        # pytrees still ship separate corpus/sent vectors
+        packed = "cs" in data
+        if packed:
+            n_corpus = data["cs"].shape[0]
+        else:
+            corpus = data["corpus"]
+            n_corpus = corpus.shape[0]
         ks = jax.random.split(key, 3)
         p, stratum = _draw_centers(data, ks[0], batch)
         # plain walks/iid produce c >= 0 by construction of
@@ -985,7 +1190,6 @@ def _make_sg_pair_fn(config: SkipGramConfig, batch: int):
         # "cs" fast path: packed (token, sent) rows turn the four scalar
         # gathers of this function into two row gathers (TPU gathers pay
         # per row; sampling is gather-rate-bound — round 5)
-        packed = "cs" in data
         if packed:
             row_p = data["cs"][p]                 # (B, 2)
             c = jnp.maximum(row_p[:, 0], 0)
@@ -1090,12 +1294,29 @@ def make_ondevice_batch_fn(config: SkipGramConfig, batch: int):
     return sample
 
 
+def _affine_neg_perm(key, batch: int):
+    """The negative-block decorrelation permutation shared by the XLA and
+    fused-Pallas ondevice step bodies (ONE definition so the two impls
+    train bit-identical pair streams): a fresh random affine bijection
+    perm(j) = (a*j + b) mod B (a odd) for power-of-two B, a real shuffle
+    otherwise. See the in-body comment below for why it exists."""
+    ka, kb = jax.random.split(jax.random.fold_in(key, 7))
+    if batch & (batch - 1) == 0:
+        a = 2 * jax.random.randint(ka, (), 0, batch // 2) + 1
+        b = jax.random.randint(kb, (), 0, batch)
+        return (a * jnp.arange(batch, dtype=jnp.int32) + b) % batch
+    return jax.random.permutation(ka, batch)
+
+
 def make_ondevice_superbatch_step(
     config: SkipGramConfig,
     *,
     batch: int,
     steps: int,
     scale_mode: str = "row_mean",
+    impl: str = "auto",
+    fused_tile: int = 256,
+    fused_interpret: bool = False,
 ):
     """Fully device-resident training: corpus, sampling, presort and the
     sorted-scatter updates all inside ONE jitted program — zero per-step
@@ -1140,9 +1361,46 @@ def make_ondevice_superbatch_step(
     from ``make_ondevice_data`` (same ``batch``/``scale_mode``); swapping
     in a same-shaped pytree (per-epoch re-subsampled corpus) reuses the
     compiled program.
-    """
+
+    ``impl`` ('auto'|'xla'|'pallas', the ring_attention convention)
+    selects the update engine inside the scan body: 'pallas' replaces the
+    gather/einsum/three-scatter sequence with the fused
+    ``ops.pallas_embed`` train-step kernel (one HBM pass per touched row;
+    per-tile sort metadata built on device by
+    ``fused_sort_metadata_jnp``); 'auto' resolves via
+    ``pallas_embed.resolve_fused_impl`` (currently 'xla' everywhere —
+    the compiled kernel's wall-clock is unmeasured this round, see the
+    kernel module docstring).
+    ``scale_mode='row_mean_exact'`` is not supported by the kernel and
+    forces 'xla'. The sampled pair stream is bit-identical across impls
+    (same keys, same decorrelation permutation)."""
     assert not config.cbow, "device pipeline supports NS skip-gram only"
     assert scale_mode in ("row_mean", "row_mean_exact", "raw"), scale_mode
+    from multiverso_tpu.ops import pallas_embed as _pe
+
+    if scale_mode == "row_mean_exact":
+        fused_impl = "xla"
+    else:
+        fused_impl = _pe.resolve_fused_impl(
+            impl, fused_interpret, dim=config.dim, tile=fused_tile,
+            ncol=1 + config.negatives,
+        )
+    if fused_impl == "pallas" and batch % fused_tile:
+        # 'auto' must never turn a working call into an error: a batch
+        # the tile doesn't divide falls back to xla with a logged
+        # reason; only an EXPLICIT 'pallas' request errors
+        if impl == "pallas":
+            raise ValueError(
+                f"batch {batch} is not a multiple of fused_tile "
+                f"{fused_tile} (pad the batch or pick a dividing tile)"
+            )
+        from multiverso_tpu.utils.log import Log
+
+        Log.Info(
+            "fused step: batch %d not a multiple of fused_tile %d; "
+            "falling back to impl='xla'" % (batch, fused_tile)
+        )
+        fused_impl = "xla"
     sample = make_ondevice_batch_fn(config, batch)
     K = config.negatives
 
@@ -1176,20 +1434,14 @@ def make_ondevice_superbatch_step(
             # 256-step superbatch; a cyclic shift does NOT fix it — it
             # preserves adjacency). A fresh random AFFINE permutation
             # perm(j) = (a*j + b) mod B (a odd — a bijection for
-            # power-of-two B) spreads any slot run stride-a apart across
-            # the whole quantile range, keeps the scatter's flat sequence
-            # sorted, and costs no argsort. Applied in EVERY mode
-            # (harmless for random-order centers) so the presorted and
-            # argsort step branches stay bit-identical on the same draw.
-            ka, kb = jax.random.split(jax.random.fold_in(key, 7))
-            if batch & (batch - 1) == 0:
-                a = 2 * jax.random.randint(ka, (), 0, batch // 2) + 1
-                b = jax.random.randint(kb, (), 0, batch)
-                perm = (
-                    a * jnp.arange(batch, dtype=jnp.int32) + b
-                ) % batch
-            else:  # rare non-pow2 batch: bijection via a real shuffle
-                perm = jax.random.permutation(ka, batch)
+            # power-of-two B; non-pow2 falls back to a real shuffle)
+            # spreads any slot run stride-a apart across the whole
+            # quantile range, keeps the scatter's flat sequence sorted,
+            # and costs no argsort. Applied in EVERY mode (harmless for
+            # random-order centers) so the presorted and argsort step
+            # branches — and the fused-Pallas branch — stay bit-identical
+            # on the same draw (shared _affine_neg_perm).
+            perm = _affine_neg_perm(key, batch)
             nflat = negs.T.reshape(-1)  # the sorted flat scatter sequence
             negs = negs[perm]           # slot j <- flat stratum perm[j]
             o = jnp.concatenate([ts[:, None], negs], axis=1)
@@ -1243,6 +1495,52 @@ def make_ondevice_superbatch_step(
             emb_in = emb_in.at[is2].add(-lr * upd_i, indices_are_sorted=True)
             new = {**params, "emb_in": emb_in, "emb_out": emb_out}
             return new, (loss, jnp.sum(w))
+
+        def body_pallas(params, xs):
+            """Fused-kernel body: same sampled stream (same keys, same
+            decorrelation perm as the xla body), but the whole
+            gather -> logits -> grad -> scatter sequence runs inside
+            ``pallas_embed.fused_ns_train_step`` — one HBM pass per
+            touched row. Per-tile sort metadata is built on device; the
+            binary pair weights ride the scale arrays (idempotent, as in
+            the xla body) and the validity vector."""
+            key, (c, o, w) = xs
+            ts, negs = o[:, 0], o[:, 1:]
+            perm = _affine_neg_perm(key, batch)
+            negs = negs[perm]
+            o2 = jnp.concatenate([ts[:, None], negs], axis=1)
+            if scale_mode == "raw":
+                sc_c = w
+                sc_o = jnp.broadcast_to(w[:, None], o2.shape)
+            else:  # row_mean: expected-count inverse tables
+                sc_c = w * data["inv_io"][c]
+                sc_o = w[:, None] * jnp.concatenate(
+                    [
+                        data["inv_io"][ts][:, None],
+                        data["inv_neg"][negs],
+                    ],
+                    axis=1,
+                )
+            isort, iperm, islot, iscale = _pe.fused_sort_metadata_jnp(
+                c, sc_c, fused_tile
+            )
+            osort, operm, oslot, oscale = _pe.fused_sort_metadata_jnp(
+                o2.reshape(-1), sc_o.reshape(-1), fused_tile * (1 + K)
+            )
+            fb = {
+                "fin_sort": isort, "fin_perm": iperm,
+                "fin_slot": islot, "fin_scale": iscale,
+                "fout_sort": osort, "fout_perm": operm,
+                "fout_slot": oslot, "fout_scale": oscale,
+                "fvalid": w,
+            }
+            new, loss = _pe.fused_ns_train_step(
+                params, fb, lr, tile=fused_tile, interpret=fused_interpret
+            )
+            return new, (loss, jnp.sum(w))
+
+        if fused_impl == "pallas":
+            body = body_pallas
 
         keys = jax.random.split(key, steps)
         offs = jnp.arange(steps, dtype=jnp.int32) * batch
@@ -1315,14 +1613,19 @@ def make_ondevice_general_superbatch_step(
             """CBOW window sample: shrunk window b ~ U[1, W], CBOW uses ALL
             tokens within b (ref: wordembedding.cpp ParseSentence CBOW
             branch). -> (target, contexts (B,2W) -1-padded, w)."""
-            corpus = data["corpus"]
-            n_corpus = corpus.shape[0]
+            # "cs" pytrees pack (token, sentence-id) rows — the token
+            # stream and sentence ids have NO standalone buffers (ADVICE
+            # r5); each (B, 2W) context gather becomes one 2-wide row
+            # gather. Legacy hand-built pytrees still ship corpus/sent.
+            packed = "cs" in data
+            n_corpus = (
+                data["cs"].shape[0] if packed else data["corpus"].shape[0]
+            )
             ks = jax.random.split(key, 4)
             p, _ = _draw_centers(data, ks[0], batch)  # CBOW: no offset strata
             # presorted walks pad with the sentinel position P: floor the
             # clamped gather so no downstream index wraps, and kill the
             # whole window below (same contract as _make_sg_pair_fn)
-            c = jnp.maximum(corpus[p], 0)
             b = jax.random.randint(ks[1], (batch,), 1, W + 1)
             # np constant (not eager jnp): device-array constants cost a
             # readback round trip each at lowering on the tunneled backend
@@ -1331,17 +1634,24 @@ def make_ondevice_general_superbatch_step(
             ).astype(np.int32)
             qpos = p[:, None] + offs[None, :]
             qc = jnp.clip(qpos, 0, n_corpus - 1)
-            t = corpus[qc]  # (B, 2W)
             # windows never span a sentence marker (pairgen.cpp:15
-            # semantics): one sentence-id gather per slot (sentence ids
-            # ride as cs[:, 1] in builder pytrees; standalone "sent"
-            # covers legacy hand-built ones)
-            sent = data["cs"][:, 1] if "cs" in data else data["sent"]
+            # semantics): one sentence-id gather per slot
+            if packed:
+                row_p = data["cs"][p]       # (B, 2)
+                rows_q = data["cs"][qc]     # (B, 2W, 2)
+                c = jnp.maximum(row_p[:, 0], 0)
+                t = rows_q[..., 0]          # (B, 2W)
+                sent_ok = rows_q[..., 1] == row_p[:, 1][:, None]
+            else:
+                corpus, sent = data["corpus"], data["sent"]
+                c = jnp.maximum(corpus[p], 0)
+                t = corpus[qc]              # (B, 2W)
+                sent_ok = sent[qc] == sent[p][:, None]
             m = (
                 (jnp.abs(offs)[None, :] <= b[:, None])
                 & (t >= 0)
                 & (qpos == qc)
-                & (sent[qc] == sent[p][:, None])
+                & sent_ok
             )
             ts = jnp.maximum(t, 0)
             w = jnp.ones((batch,), jnp.float32)

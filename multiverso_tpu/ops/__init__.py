@@ -1,11 +1,22 @@
 """TPU op layer: scatter/gather building blocks and Pallas kernels.
 
 The compute primitives the tables and models are built from. XLA's native
-gather/scatter emitters are the default lowering; ``pallas_embed`` provides a
-hand-written fused kernel for the embedding hot path with measured tradeoffs
-(see its module docstring for the benchmark discussion).
+gather/scatter emitters are the default lowering; ``pallas_embed`` provides
+hand-written fused kernels for the embedding hot path — the forward-only
+``ns_logits`` probe and the full ``fused_ns_train_step`` (one HBM pass for
+gather -> logits -> grad -> scatter-update, SGD and AdaGrad) — with
+measured tradeoffs (see the module docstrings for the benchmark
+discussion).
 """
 
+from multiverso_tpu.ops.pallas_embed import (
+    fused_ns_train_step,
+    fused_sort_metadata,
+    fused_sort_metadata_jnp,
+    fused_step_hbm_bytes,
+    ns_logits,
+    ns_logits_reference,
+)
 from multiverso_tpu.ops.pallas_flash import (
     flash_attention,
     flash_attention_carry,
@@ -25,6 +36,12 @@ from multiverso_tpu.ops.scatter import scatter_add_rows, segment_combine_rows
 __all__ = [
     "scatter_add_rows",
     "segment_combine_rows",
+    "ns_logits",
+    "ns_logits_reference",
+    "fused_ns_train_step",
+    "fused_sort_metadata",
+    "fused_sort_metadata_jnp",
+    "fused_step_hbm_bytes",
     "attention_reference",
     "flash_attention",
     "flash_attention_carry",

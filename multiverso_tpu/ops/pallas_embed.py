@@ -24,12 +24,23 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["ns_logits", "ns_logits_reference"]
+__all__ = [
+    "ns_logits",
+    "ns_logits_reference",
+    "fused_ns_train_step",
+    "fused_sort_metadata",
+    "fused_sort_metadata_jnp",
+    "fused_step_hbm_bytes",
+    "fused_viable",
+    "resolve_fused_impl",
+]
 
 
 def ns_logits_reference(emb_in, emb_out, centers, outputs):
@@ -122,3 +133,575 @@ def ns_logits(emb_in, emb_out, centers, outputs, *, tile: int = 256,
         emb_in,
         emb_out,
     )
+
+
+# ---------------------------------------------------------------------------
+# Fused negative-sampling TRAIN step: gather -> logits -> grad -> scatter
+# update in ONE pass over the touched rows' HBM bytes.
+#
+# The XLA training step (models/wordembedding/skipgram.py
+# make_sorted_train_step) moves each touched embedding row through HBM
+# several times per microbatch: the gather reads it (and materialises the
+# gathered copy), the backward materialises the update rows, and the
+# scatter-add reads + writes the table row — ~3 row passes per
+# CONTRIBUTION by the analytic model (bench.py _bench_roofline), more once
+# the intermediates count. This kernel touches each UNIQUE row's bytes
+# twice total: one HBM->VMEM gather when its sorted run starts, one
+# VMEM->HBM write-back after its run's updates are reduced in VMEM.
+#
+# Design contract (mirrors the reference's §3.3/§3.4 Get/Add loop, fused):
+#
+# * per B-tile, the kernel DMAs only the tile's UNIQUE rows (run starts of
+#   the per-tile-sorted id stream — the host presort that already feeds
+#   the sorted-scatter XLA path, restricted per tile) into VMEM, computes
+#   logits + closed-form sigmoid grads in registers, reduces each sorted
+#   run's contributions in VMEM, and writes each unique row back once;
+# * tiles apply SEQUENTIALLY (the TPU grid is sequential): a row shared by
+#   two tiles is re-gathered by the later tile AFTER the earlier tile's
+#   write-back, so later tiles train against updated rows — the same
+#   semantics as the reference's sequential sample loop, and exactly the
+#   XLA step's semantics when ``tile >= B`` (one tile). The parity suite
+#   pins both claims (tests/test_fused_step.py).
+# * updates ride ``input_output_aliases``: the tables are donated and
+#   updated in place — the kernel GATHERS THROUGH THE OUTPUT REFS, which
+#   is what makes tile t+1 see tile t's writes (the aliased input ref is
+#   NOT guaranteed to observe output writes, measured in interpret mode).
+#
+# AdaGrad variant: the per-row g2 accumulators are two more aliased
+# tables; a run flush adds the run's summed squared contributions to the
+# g2 row and scales the row step by rsqrt(g2_new + eps) — bit-matching the
+# XLA sorted path, which also gathers the POST-add g2 for every
+# contribution of the row.
+#
+# Perf notes (honest): the gather/scatter loops issue one row DMA at a
+# time with an immediate wait (the seed ``ns_logits`` pattern, known to
+# lower through Mosaic). Per-row DMA issue cost dominates at D=128
+# (ns_logits measured 5x slower than XLA's hardware gather on v5e), so
+# wall-clock wins are expected only for wide rows (D >= 512) or when HBM
+# bandwidth, not DMA issue rate, is the binding constraint — but the HBM
+# BYTES win (the roofline lever) holds at every D and is exactly
+# accountable: see ``fused_step_hbm_bytes``. Double-buffering the run DMAs
+# is the known next step.
+# ---------------------------------------------------------------------------
+
+# Mosaic viability floor for the fused step (the _MIN_MOSAIC_BLOCK analog
+# of ops/ring_attention.py): compiled lowering needs lane-aligned rows and
+# at least a sublane of batch tile; anything smaller falls back to XLA
+# with a logged warning. Interpret mode runs any size.
+_MIN_FUSED_LANE = 128   # row width floor (TPU lane tile)
+_MIN_FUSED_SUBLANE = 8  # batch-tile floor (f32 sublane tile)
+# Where per-row DMA issue cost is EXPECTED to amortise (the measured
+# ns_logits threshold story: D=128 rows lose 5x to DMA issue cost;
+# >= 512 is the documented break-even regime on v5e). This is the
+# candidate promotion threshold for impl='auto' — NOT yet applied: until
+# the compiled fused leg has bench numbers on real hardware (ROADMAP
+# open item), 'auto' stays on XLA everywhere and the kernel is explicit
+# opt-in (impl='pallas').
+_FUSED_AUTO_MIN_DIM = 512
+# VMEM scratch budget: v4/v5e cores carry ~16 MB of VMEM; leave headroom
+# for the scale/valid/loss blocks and compiler temporaries. A shape whose
+# scratch exceeds this fails Mosaic at compile time, so the viability
+# gate must reject it up front.
+_FUSED_VMEM_BUDGET = 14 * 2**20
+
+
+def _fused_scratch_bytes(dim: int, tile: int, ncol: int,
+                         adagrad: bool) -> int:
+    """Exact VMEM scratch the kernel allocates (see the scratch_shapes
+    list in ``fused_ns_train_step``): 3 (tile, D) + 3 (tile*NC, D) f32
+    buffers, one more of each under AdaGrad."""
+    per = 4 if adagrad else 3
+    return 4 * dim * per * (tile + tile * ncol)
+
+
+def fused_viable(interpret: bool, *, dim: int, tile: int, ncol: int = 6,
+                 adagrad: bool = False) -> bool:
+    """True when the fused train-step kernel can compile for this shape.
+
+    Mirrors ``ring_attention._flash_viable``: interpret mode runs
+    anything (CPU tests use tiny shapes); real Mosaic needs ``dim`` to be
+    a lane multiple, the batch tile to reach the sublane tile, the
+    kernel's VMEM scratch (which scales with dim * tile * ncol) to fit
+    the budget, and there must be a TPU backend at all. Returns False
+    with a logged reason instead of shipping a kernel Mosaic rejects."""
+    if interpret:
+        return True
+    from multiverso_tpu.utils.log import Log
+
+    if jax.default_backend() != "tpu":
+        Log.Info(
+            "fused step: no TPU backend and interpret=False; "
+            "falling back to impl='xla'"
+        )
+        return False
+    if dim % _MIN_FUSED_LANE or tile < _MIN_FUSED_SUBLANE:
+        Log.Info(
+            "fused step: dim %d / tile %d below the Mosaic floor "
+            "(dim %% %d == 0 and tile >= %d); falling back to impl='xla'"
+            % (dim, tile, _MIN_FUSED_LANE, _MIN_FUSED_SUBLANE)
+        )
+        return False
+    scratch = _fused_scratch_bytes(dim, tile, ncol, adagrad)
+    if scratch > _FUSED_VMEM_BUDGET:
+        Log.Info(
+            "fused step: VMEM scratch %.1f MB (dim %d, tile %d, ncol %d"
+            "%s) exceeds the %.0f MB budget; shrink tile or fall back — "
+            "impl='xla'"
+            % (scratch / 2**20, dim, tile, ncol,
+               ", adagrad" if adagrad else "",
+               _FUSED_VMEM_BUDGET / 2**20)
+        )
+        return False
+    return True
+
+
+def resolve_fused_impl(
+    impl: str, interpret: bool, *, dim: int, tile: int, ncol: int = 6,
+    adagrad: bool = False
+) -> str:
+    """One policy for every fused-step entry point, the
+    ``ring_attention._resolve_impl`` convention: ``'auto'`` currently
+    resolves to 'xla' EVERYWHERE — the kernel's compiled wall-clock is
+    unmeasured this round (the bench fused_pallas leg exists but has not
+    produced hardware numbers yet), so promoting it into default paths
+    would ship an unbenchmarked Mosaic lowering to production; the
+    intended future policy is TPU backend + D >= _FUSED_AUTO_MIN_DIM
+    (see the constant's comment and the ROADMAP open item). The kernel is
+    explicit opt-in via impl='pallas'; the viability floor then applies
+    to any 'pallas' choice with a logged 'xla' fallback."""
+    assert impl in ("auto", "xla", "pallas"), impl
+    if impl == "auto":
+        impl = "xla"
+    if impl == "pallas" and not fused_viable(
+        interpret, dim=dim, tile=tile, ncol=ncol, adagrad=adagrad
+    ):
+        impl = "xla"
+    return impl
+
+
+def _gather_unique_runs(sort_ref, base, n, table_ref, uniq_buf, sem,
+                        extra=None):
+    """DMA one row per RUN of the per-tile-sorted id stream: run j's row
+    lands in uniq_buf[slot] where slot counts run starts (the host/device
+    metadata assigns the same slot numbering — ``fused_sort_metadata``).
+    ``extra=(table2, buf2)`` mirrors the gather for the AdaGrad g2 table.
+    Reads go through ``table_ref`` (an aliased OUTPUT ref) so a row
+    re-touched by a later tile observes earlier tiles' write-backs."""
+
+    def body(j, nslot):
+        rid = sort_ref[base + j]
+        prev = sort_ref[base + jnp.maximum(j - 1, 0)]
+        is_new = jnp.logical_or(j == 0, rid != prev)
+
+        @pl.when(is_new)
+        def _():
+            cp = pltpu.make_async_copy(
+                table_ref.at[pl.ds(rid, 1), :],
+                uniq_buf.at[pl.ds(nslot, 1), :],
+                sem,
+            )
+            cp.start()
+            cp.wait()
+            if extra is not None:
+                t2, b2 = extra
+                cp2 = pltpu.make_async_copy(
+                    t2.at[pl.ds(rid, 1), :], b2.at[pl.ds(nslot, 1), :], sem
+                )
+                cp2.start()
+                cp2.wait()
+
+        return nslot + is_new.astype(jnp.int32)
+
+    jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+
+def _expand_rows(slot_ref, base, n, uniq_buf, dst_buf):
+    """Materialise the natural-order row matrix from the unique-row buffer
+    (VMEM->VMEM row copies — no HBM bytes): dst[j] = uniq[slot[j]]."""
+
+    def body(j, _):
+        s = slot_ref[base + j]
+        dst_buf[pl.ds(j, 1), :] = uniq_buf[pl.ds(s, 1), :]
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _scatter_runs(sort_ref, perm_ref, scale_ref, base, n, upd_buf, uniq_buf,
+                  table_ref, sem, lr, g2=None, eps=1e-6):
+    """Reduce each sorted run's scaled update rows in VMEM, then write the
+    run's unique row back to HBM ONCE: new = old - lr * sum(contribs)
+    (SGD) or the AdaGrad row step against the post-add g2. ``perm_ref``
+    maps sorted position -> natural within-tile position (the update-row
+    index); ``scale_ref`` is aligned to sorted order and already carries
+    pair weights / row-mean factors, so a zero-scale contribution (padded
+    or rejected pair) is a no-op inside its run."""
+    D = uniq_buf.shape[1]
+    zero = jnp.zeros((1, D), jnp.float32)
+
+    def body(j, carry):
+        slot, acc, acc2 = carry
+        rid = sort_ref[base + j]
+        prev = sort_ref[base + jnp.maximum(j - 1, 0)]
+        is_new = jnp.logical_or(j == 0, rid != prev)
+        slot = slot + is_new.astype(jnp.int32)
+        acc = jnp.where(is_new, 0.0, acc)
+        acc2 = jnp.where(is_new, 0.0, acc2)
+        p = perm_ref[base + j]
+        contrib = (
+            upd_buf[pl.ds(p, 1), :].astype(jnp.float32)
+            * scale_ref[base + j]
+        )
+        acc = acc + contrib
+        if g2 is not None:
+            acc2 = acc2 + contrib * contrib
+        nxt = sort_ref[base + jnp.minimum(j + 1, n - 1)]
+        is_end = jnp.logical_or(j == n - 1, rid != nxt)
+
+        @pl.when(is_end)
+        def _flush():
+            old = uniq_buf[pl.ds(slot, 1), :].astype(jnp.float32)
+            if g2 is not None:
+                g2_buf, g2_table = g2
+                g2_new = (
+                    g2_buf[pl.ds(slot, 1), :].astype(jnp.float32) + acc2
+                )
+                g2_buf[pl.ds(slot, 1), :] = g2_new.astype(g2_buf.dtype)
+                cpg = pltpu.make_async_copy(
+                    g2_buf.at[pl.ds(slot, 1), :],
+                    g2_table.at[pl.ds(rid, 1), :],
+                    sem,
+                )
+                cpg.start()
+                cpg.wait()
+                new = old - lr * acc * jax.lax.rsqrt(g2_new + eps)
+            else:
+                new = old - lr * acc
+            uniq_buf[pl.ds(slot, 1), :] = new.astype(uniq_buf.dtype)
+            cp = pltpu.make_async_copy(
+                uniq_buf.at[pl.ds(slot, 1), :],
+                table_ref.at[pl.ds(rid, 1), :],
+                sem,
+            )
+            cp.start()
+            cp.wait()
+
+        return (slot, acc, acc2)
+
+    jax.lax.fori_loop(0, n, body, (jnp.int32(-1), zero, zero))
+
+
+def _fused_train_kernel(*args, tile, ncol, adagrad, eps):
+    """One grid step = one batch tile of ``tile`` pairs, end to end.
+
+    Arg layout (PrefetchScalarGridSpec order): 8 scalar-prefetch refs
+    (in_sort/in_perm/in_slot/in_scale for the input table, the same four
+    for the output table — ids/positions int32, scales f32, all SMEM and
+    per-tile-sorted), then inputs (lr (1,1) SMEM; valid (tile,1) VMEM;
+    emb_in/emb_out [, g2_in/g2_out] left in HBM), then outputs (the
+    aliased tables, the (G,1) per-tile loss, [aliased g2 tables]), then
+    VMEM scratch (unique-row buffers, natural-order row matrices, the
+    update matrices) and one DMA semaphore."""
+    (isort, iperm, islot, iscale, osort, operm, oslot, oscale) = args[:8]
+    if adagrad:
+        (lr_ref, valid_ref, _ein_in, _eout_in, _g2i_in, _g2o_in,
+         ein, eout, loss_ref, g2i, g2o,
+         uin, uout, ug2i, ug2o, vin_s, vout_s, updo_s, dvin_s,
+         sem) = args[8:]
+    else:
+        (lr_ref, valid_ref, _ein_in, _eout_in,
+         ein, eout, loss_ref,
+         uin, uout, vin_s, vout_s, updo_s, dvin_s, sem) = args[8:]
+        ug2i = ug2o = g2i = g2o = None
+
+    t = pl.program_id(0)
+    T = tile
+    NC = ncol
+    ibase = t * T
+    obase = t * T * NC
+    lr = lr_ref[0, 0]
+
+    # phase 1: gather each run's unique row once (through the OUTPUT refs
+    # — cross-tile freshness, see module comment)
+    _gather_unique_runs(
+        isort, ibase, T, ein, uin, sem,
+        extra=None if not adagrad else (g2i, ug2i),
+    )
+    _gather_unique_runs(
+        osort, obase, T * NC, eout, uout, sem,
+        extra=None if not adagrad else (g2o, ug2o),
+    )
+
+    # phase 2: materialise natural-order row matrices (VMEM->VMEM)
+    _expand_rows(islot, ibase, T, uin, vin_s)
+    _expand_rows(oslot, obase, T * NC, uout, vout_s)
+
+    # phase 3: logits + closed-form NS grads, fully vectorised in
+    # registers (the math of skipgram._ns_loss_and_grad)
+    vin = vin_s[...].astype(jnp.float32)                  # (T, D)
+    vout = vout_s[...].astype(jnp.float32).reshape(T, NC, -1)
+    logits = jnp.sum(vin[:, None, :] * vout, axis=-1)     # (T, NC)
+    labels = (
+        jax.lax.broadcasted_iota(jnp.int32, (T, NC), 1) == 0
+    ).astype(jnp.float32)
+    bce = (
+        jnp.maximum(logits, 0.0)
+        - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+    valid = valid_ref[...]                                # (T, 1)
+    loss_ref[0, 0] = jnp.sum(
+        jnp.sum(bce, axis=1, keepdims=True) * valid
+    )
+    g = jax.nn.sigmoid(logits) - labels                   # (T, NC)
+    dvin_s[...] = jnp.sum(g[:, :, None] * vout, axis=1).astype(
+        dvin_s.dtype
+    )
+    updo_s[...] = (
+        g[:, :, None] * vin[:, None, :]
+    ).reshape(T * NC, -1).astype(updo_s.dtype)
+
+    # phase 4: sorted-run reduction in VMEM + one write-back per unique
+    # row (scales already carry weights/row-mean factors and zero out
+    # padded slots)
+    _scatter_runs(
+        osort, operm, oscale, obase, T * NC, updo_s, uout, eout, sem, lr,
+        g2=None if not adagrad else (ug2o, g2o), eps=eps,
+    )
+    _scatter_runs(
+        isort, iperm, iscale, ibase, T, dvin_s, uin, ein, sem, lr,
+        g2=None if not adagrad else (ug2i, g2i), eps=eps,
+    )
+
+
+def fused_ns_train_step(params, batch, lr, *, tile: int = 256,
+                        interpret: bool = False):
+    """Fused NS skip-gram train step: ``(params, batch, lr) ->
+    (params, loss)`` in one Pallas pass over the touched rows' HBM bytes.
+
+    ``params``: ``emb_in``/``emb_out`` (V, D) tables; the AdaGrad variant
+    is selected by the presence of ``g2_in``/``g2_out`` accumulators (the
+    ``make_train_step(use_adagrad=True)`` convention). ``batch`` carries
+    the per-tile-sorted contribution metadata built by
+    ``fused_sort_metadata`` (host) or ``fused_sort_metadata_jnp``
+    (device): for each table, ``*_sort`` (ids), ``*_perm`` (sorted pos ->
+    natural within-tile pos), ``*_slot`` (natural pos -> unique-row slot)
+    and ``*_scale`` (sorted-aligned scale, carrying weights/row-mean
+    factors; zero for padded slots) under keys ``fin_*`` ((B,) — input
+    table / centers) and ``fout_*`` ((B*NC,) — output table, NC = 1+K
+    flat), plus ``fvalid`` (B,) f32 pair-validity for the loss mean.
+
+    ``B`` must be a multiple of ``tile`` (callers pad; see
+    ``skipgram.presort_fused_batch``). The tables update IN PLACE via
+    ``input_output_aliases`` — jit callers should donate ``params``.
+    Loss is ``sum(bce * fvalid) / max(sum(fvalid), 1)`` — the XLA step's
+    per-pair mean over real pairs."""
+    emb_in, emb_out = params["emb_in"], params["emb_out"]
+    adagrad = "g2_in" in params
+    isort = batch["fin_sort"]
+    B = isort.shape[0]
+    NC = batch["fout_sort"].shape[0] // B
+    V, D = emb_in.shape
+    assert B % tile == 0, f"batch {B} not a multiple of tile {tile}"
+    G = B // tile
+
+    kernel = functools.partial(
+        _fused_train_kernel, tile=tile, ncol=NC, adagrad=adagrad, eps=1e-6
+    )
+    n_tab = 4 if adagrad else 2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1), lambda t, *_: (0, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (tile, 1), lambda t, *_: (t, 0), memory_space=pltpu.VMEM
+            ),
+        ]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * n_tab,
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(
+                (1, 1), lambda t, *_: (t, 0), memory_space=pltpu.VMEM
+            ),
+        ]
+        + ([pl.BlockSpec(memory_space=pl.ANY)] * 2 if adagrad else []),
+        scratch_shapes=(
+            [
+                pltpu.VMEM((tile, D), emb_in.dtype),        # unique in rows
+                pltpu.VMEM((tile * NC, D), emb_out.dtype),  # unique out rows
+            ]
+            + (
+                [
+                    pltpu.VMEM((tile, D), jnp.float32),       # unique g2_in
+                    pltpu.VMEM((tile * NC, D), jnp.float32),  # unique g2_out
+                ]
+                if adagrad
+                else []
+            )
+            + [
+                pltpu.VMEM((tile, D), jnp.float32),       # vin natural
+                pltpu.VMEM((tile * NC, D), jnp.float32),  # vout natural
+                pltpu.VMEM((tile * NC, D), jnp.float32),  # out-update rows
+                pltpu.VMEM((tile, D), jnp.float32),       # d_vin rows
+                pltpu.SemaphoreType.DMA(()),
+            ]
+        ),
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct(emb_in.shape, emb_in.dtype),
+        jax.ShapeDtypeStruct(emb_out.shape, emb_out.dtype),
+        jax.ShapeDtypeStruct((G, 1), jnp.float32),
+    ]
+    # alias indices count the scalar-prefetch operands: 8 prefetch + lr +
+    # valid put the first table at operand 10
+    aliases = {10: 0, 11: 1}
+    operands = [
+        batch["fin_sort"].astype(jnp.int32),
+        batch["fin_perm"].astype(jnp.int32),
+        batch["fin_slot"].astype(jnp.int32),
+        batch["fin_scale"].astype(jnp.float32),
+        batch["fout_sort"].astype(jnp.int32),
+        batch["fout_perm"].astype(jnp.int32),
+        batch["fout_slot"].astype(jnp.int32),
+        batch["fout_scale"].astype(jnp.float32),
+        jnp.asarray(lr, jnp.float32).reshape(1, 1),
+        batch["fvalid"].astype(jnp.float32).reshape(B, 1),
+        emb_in,
+        emb_out,
+    ]
+    if adagrad:
+        out_shape += [
+            jax.ShapeDtypeStruct(params["g2_in"].shape, jnp.float32),
+            jax.ShapeDtypeStruct(params["g2_out"].shape, jnp.float32),
+        ]
+        aliases.update({12: 3, 13: 4})
+        operands += [params["g2_in"], params["g2_out"]]
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*operands)
+    valid = batch["fvalid"].astype(jnp.float32)
+    loss = jnp.sum(outs[2]) / jnp.maximum(jnp.sum(valid), 1.0)
+    new = {**params, "emb_in": outs[0], "emb_out": outs[1]}
+    if adagrad:
+        new["g2_in"], new["g2_out"] = outs[3], outs[4]
+    return new, loss
+
+
+def fused_sort_metadata(ids, tile_contrib: int, scale=None,
+                        scale_mode: str = "row_mean"):
+    """Host-side per-tile sort metadata for the fused kernel (numpy).
+
+    ``ids`` (N,) int32 contribution row ids, ``N % tile_contrib == 0``
+    (``tile_contrib`` is ``tile`` for the input table, ``tile * (1+K)``
+    for the output table). ``scale`` (N,) overrides the per-contribution
+    scale in NATURAL order; else ``scale_mode='raw'`` gives 1.0 and
+    ``'row_mean'`` gives 1/count with counts over the WHOLE batch (the
+    ``presort_updates`` semantics, so the fused step matches the XLA
+    sorted path bit-for-bit at tile >= B).
+
+    Returns ``(sort, perm, slot, scale_sorted)`` flat (N,) arrays:
+    ``sort`` the per-tile-sorted ids, ``perm`` the sorted->natural
+    within-tile positions, ``slot`` the natural->unique-row-slot map
+    (slots count run starts per tile), ``scale_sorted`` aligned to
+    ``sort``."""
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    n = ids.shape[0]
+    assert n % tile_contrib == 0, (n, tile_contrib)
+    if scale is None:
+        if scale_mode == "raw":
+            scale = np.ones(n, np.float32)
+        else:
+            cnt = np.bincount(ids)
+            scale = (1.0 / np.maximum(cnt[ids], 1.0)).astype(np.float32)
+    else:
+        scale = np.asarray(scale, np.float32).reshape(-1)
+    g = n // tile_contrib
+    ids2 = ids.reshape(g, tile_contrib)
+    perm = np.argsort(ids2, axis=-1, kind="stable")
+    srt = np.take_along_axis(ids2, perm, axis=-1)
+    ssc = np.take_along_axis(scale.reshape(g, -1), perm, axis=-1)
+    is_new = np.ones_like(srt, bool)
+    is_new[:, 1:] = srt[:, 1:] != srt[:, :-1]
+    slot_sorted = np.cumsum(is_new, axis=-1) - 1
+    slot_nat = np.empty_like(slot_sorted)
+    np.put_along_axis(slot_nat, perm, slot_sorted, axis=-1)
+    return (
+        srt.reshape(-1).astype(np.int32),
+        perm.reshape(-1).astype(np.int32),
+        slot_nat.reshape(-1).astype(np.int32),
+        np.ascontiguousarray(ssc.reshape(-1), np.float32),
+    )
+
+
+def fused_sort_metadata_jnp(ids, scale, tile_contrib: int):
+    """Device-side analog of ``fused_sort_metadata`` for pipelines whose
+    ids are generated on device (the -device_pipeline path): per-tile
+    argsort + run-start slot numbering, all jnp. ``scale`` (N,) is the
+    per-contribution scale in NATURAL order (the caller owns weights /
+    row-mean tables)."""
+    ids = ids.reshape(-1).astype(jnp.int32)
+    n = ids.shape[0]
+    g = n // tile_contrib
+    ids2 = ids.reshape(g, tile_contrib)
+    perm = jnp.argsort(ids2, axis=-1, stable=True)
+    srt = jnp.take_along_axis(ids2, perm, axis=-1)
+    ssc = jnp.take_along_axis(
+        scale.reshape(g, tile_contrib).astype(jnp.float32), perm, axis=-1
+    )
+    is_new = jnp.concatenate(
+        [
+            jnp.ones((g, 1), bool),
+            srt[:, 1:] != srt[:, :-1],
+        ],
+        axis=-1,
+    )
+    slot_sorted = jnp.cumsum(is_new.astype(jnp.int32), axis=-1) - 1
+    rows = jnp.arange(g, dtype=jnp.int32)[:, None]
+    slot_nat = (
+        jnp.zeros_like(slot_sorted).at[rows, perm].set(slot_sorted)
+    )
+    return (
+        srt.reshape(-1),
+        perm.reshape(-1).astype(jnp.int32),
+        slot_nat.reshape(-1),
+        ssc.reshape(-1),
+    )
+
+
+def fused_step_hbm_bytes(batch, dim: int, adagrad: bool = False) -> int:
+    """EXACT HBM bytes the fused kernel moves for one microbatch — the
+    kernel's DMA schedule is deterministic given the metadata, so this is
+    an accounting of issued transfers, not a model: one row read per
+    unique-rows-per-tile run start, one row write per run end (x2 more
+    for the AdaGrad g2 tables), plus the SMEM metadata and VMEM side
+    inputs. Used by the bench leg's measured-bytes field."""
+    B = np.asarray(batch["fin_sort"]).shape[0]
+    nout = np.asarray(batch["fout_sort"]).shape[0]
+
+    def runs(sort_flat, width):
+        s = np.asarray(sort_flat).reshape(-1, width)
+        return int(
+            np.sum(s[:, 1:] != s[:, :-1]) + s.shape[0]
+        )  # boundaries + one run start per tile
+
+    # tile width is recoverable from the perm map: each tile's sorted
+    # permutation contains within-tile position 0 exactly once
+    tile = B // max(1, int(np.sum(np.asarray(batch["fin_perm"]) == 0)))
+    uniq = runs(batch["fin_sort"], tile) + runs(
+        batch["fout_sort"], (nout // B) * tile
+    )
+    row_bytes = dim * 4
+    passes = 4 if adagrad else 2  # read + write (+ g2 read + write)
+    table_bytes = uniq * row_bytes * passes
+    meta_bytes = (B + nout) * 3 * 4  # sort/perm/slot int32
+    meta_bytes += (B + nout) * 4 + B * 4 + 4  # scales + valid + lr
+    loss_bytes = (B // tile) * 4
+    return int(table_bytes + meta_bytes + loss_bytes)

@@ -47,6 +47,14 @@ _DEF_BLOCK_K = 2048
 # its K-tile budgets from it (_K_RATIO * flash_block)
 _K_RATIO = _DEF_BLOCK_K // _DEF_BLOCK_Q
 
+# The TPU lane tile: Mosaic cannot profitably lower flash tiles whose
+# last-two-dims block falls below the (8, 128) register tile; 128 is the
+# floor for the sequence blocks. ONE definition — the ring layer imports
+# it for its _flash_viable gate, and the entry points here enforce it on
+# their None-default block auto-fit (ADVICE r5: an auto-fitted degenerate
+# block used to reach Mosaic and fail/crawl there).
+_MIN_MOSAIC_BLOCK = 128
+
 
 def _fit_pow2(seq_len: int, budget: int) -> int:
     """Largest power-of-two block <= budget that divides seq_len — the
@@ -55,6 +63,26 @@ def _fit_pow2(seq_len: int, budget: int) -> int:
     while b > 1 and seq_len % b:
         b //= 2
     return b
+
+
+def _check_auto_block(name: str, block: int, seq_len: int,
+                      interpret: bool) -> None:
+    """Viability floor for the None-default auto-fit (the
+    ``_flash_viable`` contract applied INSIDE the kernel entry points):
+    compiling a Mosaic kernel with a fitted block below the hardware
+    tile either fails lowering or runs pathologically, so raise a clear
+    error instead. Explicit caller-chosen blocks are untouched (small
+    explicit blocks are legitimate for tests/probes), and interpret mode
+    runs any size."""
+    if interpret or block >= _MIN_MOSAIC_BLOCK:
+        return
+    raise ValueError(
+        f"flash attention: auto-fitted {name}={block} for seq_len "
+        f"{seq_len} is below the Mosaic floor ({_MIN_MOSAIC_BLOCK}); "
+        "pass an explicit block size, pad the sequence, use "
+        "interpret=True, or fall back to the jnp tile "
+        "(ring_attention impl='xla')"
+    )
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
@@ -268,8 +296,10 @@ def flash_attention(
     assert k.shape == v.shape == (B, S, H, D), (q.shape, k.shape, v.shape)
     if block_q is None:
         block_q = _fit_pow2(S, _DEF_BLOCK_Q)
+        _check_auto_block("block_q", block_q, S, interpret)
     if block_k is None:
         block_k = _fit_pow2(S, _DEF_BLOCK_K)
+        _check_auto_block("block_k", block_k, S, interpret)
     assert S % block_q == 0 and S % block_k == 0, (S, block_q, block_k)
     if scale is None:
         scale = D ** -0.5
@@ -388,8 +418,10 @@ def flash_attention_carry(
     Sk = k.shape[2]
     if block_q is None:
         block_q = _fit_pow2(Sq, _DEF_BLOCK_Q)
+        _check_auto_block("block_q", block_q, Sq, interpret)
     if block_k is None:
         block_k = _fit_pow2(Sk, _DEF_BLOCK_K)
+        _check_auto_block("block_k", block_k, Sk, interpret)
     assert Sq % block_q == 0 and Sk % block_k == 0, (Sq, Sk, block_q, block_k)
     if scale is None:
         scale = D ** -0.5

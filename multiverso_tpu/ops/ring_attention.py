@@ -106,21 +106,39 @@ def _tile_update(m, l, acc, s, v, key_mask):
 
 from multiverso_tpu.ops.pallas_flash import (  # noqa: E402
     _K_RATIO,
+    _MIN_MOSAIC_BLOCK,
     _fit_pow2 as _fit_block,
 )
 
 
-# The TPU lane tile: Mosaic cannot profitably lower flash tiles whose
-# last-two-dims block falls below the (8, 128) register tile; 128 is the
-# floor for the sequence blocks.
-_MIN_MOSAIC_BLOCK = 128
+def _operand_platform(*operands) -> str:
+    """Platform the operands actually LIVE on, falling back to
+    ``jax.default_backend()``: a committed jax.Array knows its devices,
+    so ``impl='auto'`` follows the data (e.g. CPU-placed arrays in a
+    process whose default backend is TPU pick the jnp tile, not a Pallas
+    kernel the executable's platform cannot run — ADVICE r5).
+
+    Limitation: inside ``jit``/``shard_map`` traces the operands are
+    tracers with no device information, and numpy inputs carry none
+    either — both fall back to the process default backend, so a traced
+    caller on a multi-platform process should pass ``impl`` explicitly."""
+    for x in operands:
+        try:
+            devices = x.devices()  # jax.Array (committed or uncommitted)
+        except Exception:  # tracers, numpy arrays, duck types
+            continue
+        if devices:
+            return next(iter(devices)).platform
+    return jax.default_backend()
 
 
 def _resolve_impl(impl: str, interpret: bool, *seq_lens: int,
-                  block: int) -> str:
+                  block: int, operands=()) -> str:
     """One policy for every attention entry point: ``'auto'`` (the
-    default) picks the fused Pallas tile on a real TPU backend and the
-    jnp tile everywhere else, then the viability floor applies to any
+    default) picks the fused Pallas tile when the operands are committed
+    to (or the default backend is) a real TPU and the jnp tile everywhere
+    else (see ``_operand_platform`` for the placement probe and its
+    traced-caller limitation), then the viability floor applies to any
     flash choice (explicit or auto) with a logged xla fallback.
 
     Measured basis for the auto choice (round 5, TPU v5 lite, S=32k,
@@ -130,7 +148,7 @@ def _resolve_impl(impl: str, interpret: bool, *seq_lens: int,
     effective (41.5% MFU vs the bf16 peak). On CPU the compiled Pallas
     path does not exist, so auto == xla there."""
     if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        impl = "flash" if _operand_platform(*operands) == "tpu" else "xla"
     if impl == "flash" and not _flash_viable(
         interpret, *seq_lens, block=block
     ):
@@ -354,7 +372,8 @@ def ring_attention_local(
         # must not turn a working xla call into an assert — only an
         # EXPLICIT impl='flash' request hits the assertion below
         impl = "xla"
-    impl = _resolve_impl(impl, flash_interpret, Sq, Sk, block=flash_block)
+    impl = _resolve_impl(impl, flash_interpret, Sq, Sk, block=flash_block,
+                         operands=(q, k, v))
     if impl == "flash":
         if causal:
             assert Sq == Sk, "flash ring causal requires equal q/k blocks"
@@ -627,7 +646,8 @@ def zigzag_ring_attention_local(
     B, Sq, H, D = q.shape
     c = Sq // 2
 
-    impl = _resolve_impl(impl, flash_interpret, c, block=flash_block)
+    impl = _resolve_impl(impl, flash_interpret, c, block=flash_block,
+                         operands=(q, k, v))
     if impl == "flash":
         # Fused Pallas tiles on the same schedule, DIFFERENTIABLE via
         # _flash_zigzag_t's custom VJP (a second zigzag pass over the
@@ -783,7 +803,7 @@ def ulysses_attention_local(
         # an EXPLICIT impl='flash' request errors
         impl = "xla"
     impl = _resolve_impl(impl, flash_interpret, qh.shape[1],
-                         block=flash_block)
+                         block=flash_block, operands=(qh, kh, vh))
     if impl == "flash":
         from multiverso_tpu.ops.pallas_flash import flash_attention
 

@@ -36,15 +36,42 @@ if os.environ.get("MV_TEST_REAL_TPU") != "1":
 import pytest  # noqa: E402
 
 
+# the compiled (non-interpret) Pallas gates MV_TEST_REAL_TPU exists for
+_COMPILED_GATES = ("test_pallas_flash_compiled", "test_fused_step_compiled")
+
+
 def pytest_collection_modifyitems(config, items):
     """Under MV_TEST_REAL_TPU=1 the fake 8-device pod is disabled, so
     every mesh-building test would fail on the one-chip host — keep only
-    the compiled-Pallas gate (the flag's whole purpose) and deselect the
-    rest instead of letting them error."""
+    the compiled-Pallas gates (the flag's whole purpose) and deselect the
+    rest instead of letting them error.
+
+    The flag also HARD-FAILS when the accelerator is not actually a TPU
+    (ADVICE r5): the compiled gates are skipif-guarded on the platform,
+    so an unreachable/tunnel-wedged TPU used to false-green the gate with
+    zero tests executed. An explicit real-TPU request that cannot see a
+    TPU is an error, not a skip."""
     if os.environ.get("MV_TEST_REAL_TPU") != "1":
         return
-    keep = [i for i in items if "test_pallas_flash_compiled" in str(i.fspath)]
-    drop = [i for i in items if "test_pallas_flash_compiled" not in str(i.fspath)]
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        pytest.exit(
+            "MV_TEST_REAL_TPU=1 but jax.devices()[0].platform == "
+            f"'{platform}' — the TPU is unreachable, and the compiled "
+            "Pallas gates would be skipped (a false green). Fix the "
+            "accelerator attachment or unset MV_TEST_REAL_TPU.",
+            returncode=1,
+        )
+    keep = [
+        i for i in items if any(g in str(i.fspath) for g in _COMPILED_GATES)
+    ]
+    drop = [
+        i
+        for i in items
+        if not any(g in str(i.fspath) for g in _COMPILED_GATES)
+    ]
     if drop:
         config.hook.pytest_deselected(items=drop)
         items[:] = keep

@@ -1,0 +1,384 @@
+"""Fused Pallas SGNS train-step kernel == the XLA sorted-scatter step.
+
+The fused kernel (ops/pallas_embed.fused_ns_train_step) collapses the
+flagship step's gather -> logits -> grad -> scatter-update chain into one
+Pallas pass over the touched rows' HBM bytes. Everything here runs the
+Pallas INTERPRETER (CPU tier-1 — kernel logic, not Mosaic lowering; the
+compiled gate is tests/test_fused_step_compiled.py):
+
+* at ``tile >= B`` the fused step IS the XLA sorted step (one tile =
+  whole-batch gather, then whole-batch scatter) — exact parity incl.
+  duplicate row ids within the tile, SGD and AdaGrad, raw and row_mean;
+* at ``tile < B`` tiles apply sequentially (later tiles gather
+  post-update rows — the reference's sequential-sample semantics); the
+  oracle is ``make_fused_train_step(impl='xla')``, a lax.scan over the
+  SAME tiles;
+* non-multiple-of-tile batches pad with zero-scale/zero-valid slots;
+* the impl='auto'|'xla'|'pallas' resolution and its viability-floor
+  fallback (no TPU backend / narrow rows -> 'xla');
+* the device-pipeline wiring: make_ondevice_superbatch_step(impl=...)
+  trains the same pair stream to the same parameters either way.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.models.wordembedding.skipgram import (
+    SkipGramConfig,
+    build_negative_lut,
+    init_adagrad_slots,
+    init_params,
+    make_fused_superbatch_step,
+    make_fused_train_step,
+    make_ondevice_data,
+    make_ondevice_superbatch_step,
+    make_sorted_train_step,
+    presort_batch,
+    presort_fused_batch,
+)
+from multiverso_tpu.ops import pallas_embed as pe
+
+V, D, B, K = 97, 16, 64, 3
+NC = 1 + K
+
+
+def _params(rng, cfg, adagrad=False, out_rows=None):
+    p = init_params(cfg)
+    p["emb_out"] = jnp.asarray(
+        rng.randn(out_rows or cfg.vocab_size, cfg.dim).astype(np.float32)
+        * 0.1
+    )
+    if adagrad:
+        p.update(init_adagrad_slots(cfg, out_rows))
+        p["g2_in"] = jnp.asarray(
+            np.abs(rng.randn(cfg.vocab_size, cfg.dim)).astype(np.float32)
+            * 0.01
+        )
+    return p
+
+
+def _batch(rng, vocab=V, batch=B):
+    return {
+        "centers": rng.randint(0, vocab, size=(batch,)).astype(np.int32),
+        "outputs": rng.randint(0, vocab, size=(batch, NC)).astype(np.int32),
+    }
+
+
+def _as_jnp(d):
+    return {k: jnp.asarray(v) for k, v in d.items()}
+
+
+@pytest.mark.parametrize("use_adagrad", [False, True])
+@pytest.mark.parametrize("scale_mode", ["raw", "row_mean"])
+def test_fused_single_tile_matches_sorted_step(use_adagrad, scale_mode):
+    """tile >= B: the fused kernel is the XLA sorted step exactly (small
+    V => heavy duplicate ids inside the one tile)."""
+    rng = np.random.RandomState(0)
+    cfg = SkipGramConfig(vocab_size=V, dim=D, negatives=K)
+    batch = _batch(rng)
+    params = _params(rng, cfg, use_adagrad)
+    lr = jnp.float32(0.05)
+
+    sb = presort_batch(batch, scale_mode=scale_mode)
+    ref_step = make_sorted_train_step(cfg, use_adagrad=use_adagrad)
+    ref_p, ref_loss = ref_step(dict(params), _as_jnp(sb), lr)
+
+    fb = presort_fused_batch(batch, tile=B, scale_mode=scale_mode)
+    step = make_fused_train_step(
+        cfg, use_adagrad, tile=B, impl="pallas", interpret=True
+    )
+    assert step.impl == "pallas"
+    got_p, got_loss = step(dict(params), _as_jnp(fb), lr)
+
+    assert np.allclose(float(got_loss), float(ref_loss), atol=1e-6)
+    for k in ref_p:
+        assert np.allclose(
+            np.asarray(got_p[k]), np.asarray(ref_p[k]), atol=1e-6
+        ), f"param {k} mismatch (adagrad={use_adagrad} {scale_mode})"
+
+
+@pytest.mark.parametrize("use_adagrad", [False, True])
+def test_fused_multi_tile_matches_tilewise_xla(use_adagrad):
+    """tile < B with duplicates WITHIN and ACROSS tiles: the fused kernel
+    matches the tile-sequential XLA reference (impl='xla') — the same
+    per-tile sorted scatters in a lax.scan."""
+    rng = np.random.RandomState(1)
+    cfg = SkipGramConfig(vocab_size=23, dim=D, negatives=K)
+    batch = _batch(rng, vocab=23)
+    params = _params(rng, cfg, use_adagrad, out_rows=23)
+    params["emb_in"] = jnp.asarray(
+        rng.randn(23, D).astype(np.float32) * 0.1
+    )
+    if use_adagrad:
+        params["g2_in"] = jnp.asarray(
+            np.abs(rng.randn(23, D)).astype(np.float32) * 0.01
+        )
+    lr = jnp.float32(0.05)
+    tile = 16
+
+    fb = _as_jnp(presort_fused_batch(batch, tile=tile))
+    pl_step = make_fused_train_step(
+        cfg, use_adagrad, tile=tile, impl="pallas", interpret=True
+    )
+    xla_step = make_fused_train_step(
+        cfg, use_adagrad, tile=tile, impl="xla"
+    )
+    got_p, got_loss = pl_step(dict(params), fb, lr)
+    ref_p, ref_loss = xla_step(dict(params), fb, lr)
+    assert np.allclose(float(got_loss), float(ref_loss), atol=1e-6)
+    for k in ref_p:
+        assert np.allclose(
+            np.asarray(got_p[k]), np.asarray(ref_p[k]), atol=1e-6
+        ), f"param {k} mismatch"
+
+
+def test_fused_tile_sequencing_differs_from_batch_step():
+    """Documents the multi-tile semantics: a duplicate row SPANNING tiles
+    trains its later contribution against the earlier tile's update (the
+    reference's sequential semantics), so the result intentionally
+    differs from the whole-batch XLA step — while the single-tile run
+    matches it. Guards against silently losing the sequential gather."""
+    cfg = SkipGramConfig(vocab_size=5, dim=8, negatives=1)
+    rng = np.random.RandomState(2)
+    # every pair hits row 1: maximal cross-tile coupling
+    batch = {
+        "centers": np.full(8, 1, np.int32),
+        "outputs": np.full((8, 2), 1, np.int32),
+    }
+    params = _params(rng, cfg, out_rows=5)
+    params["emb_in"] = jnp.asarray(rng.randn(5, 8).astype(np.float32))
+    lr = jnp.float32(0.5)
+    one = make_fused_train_step(cfg, tile=8, impl="pallas", interpret=True)
+    two = make_fused_train_step(cfg, tile=4, impl="pallas", interpret=True)
+    p1, _ = one(dict(params), _as_jnp(presort_fused_batch(batch, tile=8)), lr)
+    p2, _ = two(dict(params), _as_jnp(presort_fused_batch(batch, tile=4)), lr)
+    d = float(
+        jnp.max(jnp.abs(p1["emb_in"] - p2["emb_in"]))
+    )
+    assert d > 1e-6, "tile sequencing had no effect on a coupled batch"
+
+
+def test_fused_non_multiple_batch_pads_cleanly():
+    """B not a multiple of tile: padded slots carry zero scale/validity.
+    With all-distinct row ids the tile split cannot change numerics, so
+    the padded multi-tile fused run must equal the plain whole-batch
+    sorted step on the UNPADDED batch — loss included."""
+    rng = np.random.RandomState(3)
+    bigV = 512
+    cfg = SkipGramConfig(vocab_size=bigV, dim=D, negatives=K)
+    ids = rng.permutation(bigV)[: 40 * (1 + NC)].astype(np.int32)
+    batch = {
+        "centers": ids[:40],
+        "outputs": ids[40:].reshape(40, NC),
+    }
+    params = _params(rng, cfg, out_rows=bigV)
+    lr = jnp.float32(0.05)
+
+    ref_step = make_sorted_train_step(cfg)
+    ref_p, ref_loss = ref_step(
+        dict(params), _as_jnp(presort_batch(batch)), lr
+    )
+    fb = presort_fused_batch(batch, tile=16)  # 40 -> 48 padded, 3 tiles
+    assert fb["centers"].shape[0] == 48
+    assert float(fb["fvalid"].sum()) == 40.0
+    step = make_fused_train_step(cfg, tile=16, impl="pallas", interpret=True)
+    got_p, got_loss = step(dict(params), _as_jnp(fb), lr)
+    assert np.allclose(float(got_loss), float(ref_loss), atol=1e-6)
+    for k in ref_p:
+        assert np.allclose(
+            np.asarray(got_p[k]), np.asarray(ref_p[k]), atol=1e-6
+        ), f"param {k} mismatch"
+
+
+@pytest.mark.parametrize("use_adagrad", [False, True])
+def test_fused_superbatch_trajectory_matches_xla(use_adagrad):
+    """The acceptance trajectory bar: 10 microbatches through the fused
+    superbatch scan track the XLA sorted step's loss trajectory and land
+    within atol 1e-5 on the embeddings."""
+    rng = np.random.RandomState(4)
+    cfg = SkipGramConfig(vocab_size=V, dim=D, negatives=K)
+    tile = 32
+    S = 10
+    batches = [_batch(rng, batch=tile) for _ in range(S)]
+    params = _params(rng, cfg, use_adagrad)
+    lr = jnp.float32(0.05)
+
+    fbs = [presort_fused_batch(b, tile=tile) for b in batches]
+    stacked = _as_jnp(
+        {k: np.stack([fb[k] for fb in fbs]) for k in fbs[0]}
+    )
+    superstep = make_fused_superbatch_step(
+        cfg, use_adagrad, tile=tile, impl="pallas", interpret=True
+    )
+    got_p, got_loss = superstep(dict(params), stacked, lr)
+
+    ref_step = make_sorted_train_step(cfg, use_adagrad=use_adagrad)
+    ref_p = dict(params)
+    losses = []
+    for b in batches:
+        ref_p, l = ref_step(ref_p, _as_jnp(presort_batch(b)), lr)
+        losses.append(float(l))
+    assert np.allclose(float(got_loss), np.mean(losses), atol=1e-5)
+    for k in ref_p:
+        assert np.allclose(
+            np.asarray(got_p[k]), np.asarray(ref_p[k]), atol=1e-5
+        ), f"param {k} drifted past 1e-5 after {S} microbatches"
+
+
+def test_fused_impl_resolution_and_viability_floor():
+    """impl='auto' on a CPU backend resolves to 'xla'; an explicit
+    'pallas' request without interpret falls back to 'xla' through the
+    viability floor (no TPU / narrow rows); interpret keeps 'pallas'."""
+    cfg = SkipGramConfig(vocab_size=V, dim=D, negatives=K)
+    assert make_fused_train_step(cfg, impl="auto").impl == "xla"
+    assert (
+        make_fused_train_step(cfg, impl="pallas", interpret=False).impl
+        == "xla"
+    )
+    assert (
+        make_fused_train_step(cfg, impl="pallas", interpret=True).impl
+        == "pallas"
+    )
+    # the resolver itself: interpret passes any shape; compiled needs a
+    # TPU backend, lane-multiple dims and a sublane of tile
+    assert pe.resolve_fused_impl("pallas", True, dim=16, tile=4) == "pallas"
+    assert pe.resolve_fused_impl("pallas", False, dim=16, tile=4) == "xla"
+    assert pe.resolve_fused_impl("auto", True, dim=128, tile=256) == "xla"
+    assert not pe.fused_viable(False, dim=128, tile=256)  # no TPU here
+    # the VMEM scratch account the gate uses: 3 (tile,D) + 3 (tile*NC,D)
+    # f32 buffers (4 each under AdaGrad); an AdaGrad dim=640 tile=256
+    # shape overflows the budget and must be rejected pre-Mosaic
+    assert (
+        pe._fused_scratch_bytes(128, 256, 6, False)
+        == 4 * 128 * 3 * (256 + 256 * 6)
+    )
+    assert (
+        pe._fused_scratch_bytes(640, 256, 6, True) > pe._FUSED_VMEM_BUDGET
+    )
+
+
+def test_fused_adagrad_keyed_off_params_in_both_impls():
+    """AdaGrad selection follows the params pytree identically in the
+    kernel and the XLA reference: g2-carrying params with
+    use_adagrad=False still run (and THREAD) the accumulators in both
+    impls, so they stay numerics oracles for each other."""
+    rng = np.random.RandomState(8)
+    cfg = SkipGramConfig(vocab_size=V, dim=D, negatives=K)
+    batch = _batch(rng)
+    params = _params(rng, cfg, adagrad=True)
+    lr = jnp.float32(0.05)
+    fb = _as_jnp(presort_fused_batch(batch, tile=16))
+    outs = {}
+    for impl, interp in (("pallas", True), ("xla", False)):
+        step = make_fused_train_step(
+            cfg, False, tile=16, impl=impl, interpret=interp
+        )
+        outs[impl], _ = step(dict(params), fb, lr)
+    for k in outs["xla"]:
+        assert np.allclose(
+            np.asarray(outs["pallas"][k]),
+            np.asarray(outs["xla"][k]),
+            atol=1e-6,
+        ), f"param {k} diverges between impls"
+    assert not np.allclose(  # the accumulators really advanced
+        np.asarray(outs["xla"]["g2_out"]), np.asarray(params["g2_out"])
+    )
+
+
+def test_ondevice_auto_impl_never_errors_on_awkward_batch():
+    """impl='auto' with a batch the fused tile doesn't divide must build
+    a working (xla) step, never assert (code-review r6 finding); only an
+    explicit 'pallas' request errors."""
+    cfg = SkipGramConfig(vocab_size=50, dim=8, negatives=2, window=2)
+    step = make_ondevice_superbatch_step(
+        cfg, batch=40, steps=2, scale_mode="raw", impl="auto",
+        fused_tile=256,
+    )
+    assert callable(step)
+    with pytest.raises(ValueError, match="multiple of fused_tile"):
+        make_ondevice_superbatch_step(
+            cfg, batch=40, steps=2, scale_mode="raw", impl="pallas",
+            fused_tile=256, fused_interpret=True,
+        )
+
+
+def test_fused_metadata_jnp_matches_numpy():
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 17, size=96).astype(np.int32)
+    scale = rng.rand(96).astype(np.float32)
+    h = pe.fused_sort_metadata(ids, 24, scale=scale)
+    d = pe.fused_sort_metadata_jnp(
+        jnp.asarray(ids), jnp.asarray(scale), 24
+    )
+    for a, b, name in zip(h, d, ("sort", "perm", "slot", "scale")):
+        assert np.allclose(np.asarray(b), a), name
+    # slot map is the run index of each natural position's id per tile
+    srt = h[0].reshape(4, 24)
+    assert np.all(np.diff(srt, axis=-1) >= 0)
+
+
+def test_fused_step_hbm_bytes_accounting():
+    """The bench leg's measured-bytes field is an exact DMA account:
+    unique-rows-per-tile * row bytes * 2 passes (+2 for AdaGrad's g2),
+    plus the metadata streams."""
+    batch = {
+        "centers": np.array([3, 3, 5, 7], np.int32),
+        "outputs": np.array(
+            [[1, 2], [1, 2], [2, 2], [9, 9]], np.int32
+        ),
+    }
+    fb = presort_fused_batch(batch, tile=2, scale_mode="raw")
+    # centers tiles: [3,3] -> 1 unique, [5,7] -> 2; outputs tiles
+    # (width 4): [1,2,1,2] -> 2 unique, [2,2,9,9] -> 2. total 7 rows.
+    dim = 8
+    got = pe.fused_step_hbm_bytes(fb, dim)
+    rows = 7
+    meta = (4 + 8) * 3 * 4 + (4 + 8) * 4 + 4 * 4 + 4
+    loss = 2 * 4
+    assert got == rows * dim * 4 * 2 + meta + loss
+    assert (
+        pe.fused_step_hbm_bytes(fb, dim, adagrad=True)
+        == rows * dim * 4 * 4 + meta + loss
+    )
+
+
+@pytest.mark.parametrize("scale_mode", ["raw", "row_mean"])
+def test_ondevice_superbatch_fused_matches_xla(scale_mode):
+    """Device-pipeline wiring: the fused-Pallas body trains the SAME
+    sampled pair stream (same keys, same decorrelation perm) as the XLA
+    body; at fused_tile == batch the parameters match to float
+    reassociation."""
+    rng = np.random.RandomState(6)
+    Vo, Bo, steps = 60, 64, 4
+    cfg = SkipGramConfig(vocab_size=Vo, dim=8, negatives=2, window=2)
+    corpus = rng.randint(0, Vo, 600).astype(np.int32)
+    corpus[::13] = -1
+    counts = np.bincount(corpus[corpus >= 0], minlength=Vo)
+    lut = build_negative_lut(
+        (np.maximum(counts, 1) ** 0.75), table_bits=10
+    )
+    data = make_ondevice_data(
+        cfg, corpus, None, lut, batch=Bo, scale_mode=scale_mode,
+    )
+    params = init_params(cfg)
+    key = jax.random.PRNGKey(7)
+    lr = jnp.float32(0.05)
+
+    xla_step = make_ondevice_superbatch_step(
+        cfg, batch=Bo, steps=steps, scale_mode=scale_mode, impl="xla"
+    )
+    pl_step = make_ondevice_superbatch_step(
+        cfg, batch=Bo, steps=steps, scale_mode=scale_mode,
+        impl="pallas", fused_tile=Bo, fused_interpret=True,
+    )
+    ref_p, (ref_loss, ref_acc) = xla_step(dict(params), data, key, lr)
+    got_p, (got_loss, got_acc) = pl_step(dict(params), data, key, lr)
+    assert float(got_acc) == float(ref_acc)
+    assert np.allclose(float(got_loss), float(ref_loss), atol=1e-5)
+    for k in ref_p:
+        assert np.allclose(
+            np.asarray(got_p[k]), np.asarray(ref_p[k]), atol=1e-5
+        ), f"param {k} mismatch ({scale_mode})"

@@ -652,7 +652,7 @@ def test_prepare_presort_emits_sorted_aligned_windows():
     assert nvp % B == 0 and nv <= nvp < nv + B
     wp = np.asarray(dyn["walk_pos"])
     assert wp.shape[0] % B == 0
-    corpus = np.asarray(dyn["corpus"])
+    corpus = np.asarray(dyn["cs"][:, 0])
     live = wp[:nvp][wp[:nvp] < P]
     assert np.array_equal(
         np.sort(live), np.sort(np.flatnonzero(corpus >= 0))
