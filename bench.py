@@ -1061,6 +1061,96 @@ def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
     return batch * steps / dt
 
 
+def _bench_serving(cfg, queries=4000, clients=4, topk_every=8,
+                   deadlines_ms=(0.5, 2.0, 8.0)):
+    """Serving leg: QPS and p99 latency vs batch deadline through the
+    dynamic batcher (multiverso_tpu/serving/). One (V, dim) table —
+    the headline model's shape — serves mixed lookup + top-k traffic
+    from ``clients`` closed-loop client threads at each deadline in the
+    sweep; headline keys report the middle (default) deadline. Backend-
+    agnostic: on the bench chip the score matmul runs sharded on TPU,
+    and the leg is skipped with the rest of the bench when no backend
+    probe succeeds."""
+    import threading
+
+    from multiverso_tpu.serving import Overloaded, TableServer
+
+    rng = np.random.RandomState(0)
+    emb = rng.randn(cfg.vocab_size, cfg.dim).astype(np.float32) * 0.1
+    sweep = {}
+    headline = None
+    for deadline_ms in deadlines_ms:
+        srv = TableServer(
+            {"emb": emb},
+            max_batch=64,
+            max_delay_s=deadline_ms * 1e-3,
+            name=f"bench{deadline_ms}",
+            register_runtime=False,
+        ).start()
+        shed = [0]
+        shed_lock = threading.Lock()
+
+        def client(seed):
+            r = np.random.RandomState(seed)
+            per = queries // clients
+            for q in range(per):
+                ids = r.randint(0, cfg.vocab_size, size=8)
+                try:
+                    if q % topk_every == topk_every - 1:
+                        srv.topk_async("emb", emb[ids[:2]], k=10).result(
+                            timeout=60
+                        )
+                    else:
+                        srv.lookup_async("emb", ids).result(timeout=60)
+                except Overloaded:
+                    with shed_lock:  # += across client threads is not atomic
+                        shed[0] += 1
+
+        # warmup compiles every padded bucket the traffic can hit: flushes
+        # concatenate up to max_batch REQUESTS, i.e. up to 64*8 lookup
+        # rows / 64*2 topk rows — walk the power-of-two buckets up to
+        # those maxima so no jit compile lands inside the timed window
+        b = 8
+        while b <= 64 * 8:
+            srv.lookup("emb", np.zeros(b, np.int64))
+            if b <= 64 * 2:
+                srv.topk("emb", np.tile(emb[:1], (b, 1)), k=10)
+            b <<= 1
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        rep = srv.metrics.report()
+        srv.stop()
+        entry = {
+            "qps": round((queries - shed[0]) / wall, 1),
+            "lookup_p50_ms": rep.get("lookup:emb_p50_ms"),
+            "lookup_p99_ms": rep.get("lookup:emb_p99_ms"),
+            "topk_p99_ms": rep.get("topk:emb:10_p99_ms"),
+            "batch_fill": rep.get("batch_fill"),
+            "shed": rep.get("shed"),
+        }
+        sweep[f"{deadline_ms}ms"] = entry
+        if deadline_ms == deadlines_ms[1]:
+            headline = entry
+    headline = headline or next(iter(sweep.values()))
+    return {
+        "serving_qps": headline["qps"],
+        "serving_lookup_p50_ms": headline["lookup_p50_ms"],
+        "serving_lookup_p99_ms": headline["lookup_p99_ms"],
+        "serving_topk_p99_ms": headline["topk_p99_ms"],
+        "serving_batch_fill": headline["batch_fill"],
+        "serving_shed": headline["shed"],
+        "serving_deadline_sweep": sweep,
+    }
+
+
 def _probe_backend(timeout_s: int = 180):
     """The bench host's TPU rides a shared tunnel that can wedge so hard
     even jax.devices() blocks forever in a fresh process (observed
@@ -1157,6 +1247,11 @@ def main():
     except Exception as e:
         print(f"# leg ring_attention FAILED: {e}", file=_sys.stderr, flush=True)
         ring = {"ring_attention_error": str(e)[:200]}
+    try:
+        serving = leg("serving", lambda: _bench_serving(cfg))
+    except Exception as e:
+        print(f"# leg serving FAILED: {e}", file=_sys.stderr, flush=True)
+        serving = {"serving_error": str(e)[:200]}
     e2e = leg("e2e", _bench_e2e)
     quality = leg("quality", _bench_quality)
     out = {
@@ -1184,6 +1279,7 @@ def main():
     out.update(sharded)
     out.update(bigvocab)
     out.update(ring)
+    out.update(serving)
     out.update(e2e)
     out.update(quality)
     print(json.dumps(out))

@@ -30,6 +30,14 @@ command -v luajit >/dev/null 2>&1 \
 { command -v mono >/dev/null 2>&1 || command -v dotnet >/dev/null 2>&1; } \
     && echo "C# toolchain present" || echo "C# toolchain absent (C# test skips)"
 
+echo "== serving smoke e2e (train tiny -> hot-swap -> serve) =="
+# the online-serving path end to end on the CPU mesh: tiny skip-gram
+# trains while a TableServer hot-swaps its weights and serves batched
+# lookup + top-k traffic; --assert-clean fails the run unless p99 is
+# finite, shed == 0 at this low load, and ZERO torn reads were observed
+JAX_PLATFORMS=cpu python examples/serving_demo.py \
+    --queries 2000 --assert-clean
+
 echo "== multi-chip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

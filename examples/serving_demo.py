@@ -1,0 +1,176 @@
+"""End-to-end online-serving demo — CPU-runnable, no corpus needed.
+
+Trains a tiny skip-gram model while a ``TableServer`` serves lookup and
+top-k traffic through the dynamic batcher, hot-swapping freshly trained
+weights into the live server every few steps. Every lookup response is
+checked against the registry of published weight versions: a response
+that matches no single version would be a torn read (the atomicity
+guarantee serving/server.py documents). Finishes with the dashboard
+report: p50/p99 latency per route, QPS, batch-fill ratio, shed count.
+
+    JAX_PLATFORMS=cpu python examples/serving_demo.py
+    python examples/serving_demo.py --queries 3000 --assert-clean  # CI
+
+``--assert-clean`` exits non-zero unless torn == 0, shed == 0 and the
+p99s are finite — the ci.sh serving smoke gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.wordembedding import skipgram as sg
+from multiverso_tpu.serving import Overloaded, TableServer
+from multiverso_tpu.utils.dashboard import Dashboard
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--queries", type=int, default=12000,
+                    help="total queries to serve (lookup + top-k)")
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--deadline-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--swap-every", type=int, default=10,
+                    help="publish new weights every N train steps")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--assert-clean", action="store_true",
+                    help="exit 1 unless torn==0, shed==0, p99 finite")
+    args = ap.parse_args(argv)
+
+    mv.MV_Init(["prog"])
+    cfg = sg.SkipGramConfig(vocab_size=args.vocab, dim=args.dim,
+                            negatives=3, seed=0)
+    params = sg.init_params(cfg)
+    step = sg.make_train_step(cfg)
+
+    srv = TableServer(
+        {"emb": np.asarray(params["emb_in"])},
+        max_batch=args.max_batch,
+        max_delay_s=args.deadline_ms * 1e-3,
+        name="demo",
+    ).start()
+
+    # version registry: the torn-read oracle. version -> full table copy.
+    history = {srv.version: np.asarray(params["emb_in"]).copy()}
+    history_lock = threading.Lock()
+    stop_training = threading.Event()
+
+    def trainer():
+        nonlocal params
+        rng = np.random.RandomState(1)
+        i = 0
+        while not stop_training.is_set():
+            centers = rng.randint(0, args.vocab, size=64)
+            outputs = rng.randint(0, args.vocab, size=(64, 4))
+            params, _ = step(
+                params, jnp.asarray(centers), jnp.asarray(outputs), None, 0.05
+            )
+            i += 1
+            if i % args.swap_every == 0:
+                emb = np.asarray(params["emb_in"]).copy()
+                with history_lock:
+                    # registry first, swap second: a response can never be
+                    # from a version the oracle has not seen
+                    history[srv.version + 1] = emb
+                srv.publish({"emb": emb})
+            time.sleep(0.001)  # keep the CPU demo fair to the clients
+
+    counters = {"torn": 0, "lookups": 0, "topk": 0, "shed_client": 0}
+    counters_lock = threading.Lock()
+    per_client = args.queries // args.clients
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        for q in range(per_client):
+            ids = rng.randint(0, args.vocab, size=rng.randint(1, 9))
+            try:
+                if q % 8 == 7:  # 1-in-8 queries is a top-k
+                    with history_lock:
+                        some = history[max(history)]
+                    f = srv.topk_async("emb", some[ids[:2]], k=5)
+                    f.result(timeout=30)
+                    with counters_lock:
+                        counters["topk"] += 1
+                    continue
+                f = srv.lookup_async("emb", ids)
+                rows = f.result(timeout=30)
+            except Overloaded as e:
+                with counters_lock:
+                    counters["shed_client"] += 1
+                time.sleep(e.retry_after_s)
+                continue
+            with history_lock:
+                versions = list(history.values())
+            torn = not any(
+                np.array_equal(rows, emb[ids]) for emb in versions
+            )
+            with counters_lock:
+                counters["lookups"] += 1
+                if torn:
+                    counters["torn"] += 1
+
+    t0 = time.monotonic()
+    trainer_th = threading.Thread(target=trainer, daemon=True)
+    trainer_th.start()
+    clients = [
+        threading.Thread(target=client, args=(10 + i,), daemon=True)
+        for i in range(args.clients)
+    ]
+    for th in clients:
+        th.start()
+    for th in clients:
+        th.join()
+    stop_training.set()
+    trainer_th.join(timeout=10)
+    wall = time.monotonic() - t0
+
+    print()
+    Dashboard.Display()
+    r = srv.metrics.report()
+    summary = {
+        "queries_served": counters["lookups"] + counters["topk"],
+        "lookups": counters["lookups"],
+        "topk": counters["topk"],
+        "torn_reads": counters["torn"],
+        "weight_versions_published": max(history),
+        "shed": r["shed"],
+        "qps_overall": round((counters["lookups"] + counters["topk"]) / wall, 1),
+        "batch_fill": r["batch_fill"],
+        "p50_ms": r.get("lookup:emb_p50_ms"),
+        "p99_ms": r.get("lookup:emb_p99_ms"),
+        "topk_p99_ms": r.get("topk:emb:5_p99_ms"),
+        "wall_s": round(wall, 2),
+    }
+    print(json.dumps(summary, indent=2))
+    srv.stop()
+    mv.MV_ShutDown()
+
+    if args.assert_clean:
+        ok = (
+            counters["torn"] == 0
+            and r["shed"] == 0
+            and summary["p99_ms"] is not None
+            and np.isfinite(summary["p99_ms"])
+            and summary["queries_served"] >= args.queries * 0.99
+        )
+        if not ok:
+            print("SERVING SMOKE FAILED", file=sys.stderr)
+            return 1
+        print("SERVING SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

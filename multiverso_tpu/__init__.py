@@ -5,8 +5,10 @@ A ground-up JAX/XLA/Pallas rebuild of the capabilities of Multiverso
 sharded parameter tables (array / matrix / sparse matrix / KV), asynchronous
 and BSP-synchronous Get/Add semantics, server-side optimizers (SGD / momentum /
 AdaGrad / FTRL), model-averaging allreduce, checkpointing, Python table
-handlers and framework param-manager hooks, and the two reference
-applications (WordEmbedding, LogisticRegression).
+handlers and framework param-manager hooks, the two reference
+applications (WordEmbedding, LogisticRegression), and an online serving
+subsystem (``multiverso_tpu.serving``: dynamic-batching ``TableServer``
+with hot-swap weights over frozen table snapshots).
 
 Architecture (see SURVEY.md §7): tables are sharded ``jax.Array``s in HBM over
 a device mesh; Get/Add lower to XLA collectives over ICI/DCN; updaters are

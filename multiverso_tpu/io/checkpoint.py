@@ -26,7 +26,7 @@ import numpy as np
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.utils.log import Log
 
-__all__ = ["save_tables", "restore_tables"]
+__all__ = ["save_tables", "restore_tables", "load_arrays"]
 
 
 def _dense_tables(tables: Optional[List[Any]]) -> List[Any]:
@@ -58,12 +58,79 @@ def save_tables(directory: str, tables: Optional[List[Any]] = None) -> str:
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.join(directory, "tables"), _tree_of(dense), force=True)
         ckptr.wait_until_finished()
+        if jax.process_index() == 0:
+            # logical shapes ride alongside: the orbax tree stores the
+            # PHYSICAL shard-padded storage (what restore_tables maps
+            # straight back onto live tables), but a serving consumer
+            # must not see padding rows — load_arrays crops with this
+            import json
+
+            meta = {
+                f"table_{t.table_id}": list(t.shape) for t in dense
+            }
+            with open(os.path.join(directory, "logical_shapes.json"), "w") as f:
+                json.dump(meta, f)
     all_tables = tables if tables is not None else runtime().tables
     for t in all_tables:
         if isinstance(t, KVTable):
             t.store(os.path.join(directory, f"kv_{t.table_id}.npz"))
     Log.Info("checkpoint saved: %s (%d dense tables)", directory, len(dense))
     return directory
+
+
+def load_arrays(directory: str) -> Dict[str, np.ndarray]:
+    """Load-for-serving: restore the dense tables' raw storage arrays from
+    a ``save_tables`` checkpoint WITHOUT live tables or a started runtime.
+
+    ``restore_tables`` needs the creation-order table registry to exist
+    (training resume); a serving process has no reason to rebuild
+    updater state or register tables just to read weights. Returns
+    ``{"table_<id>": storage}`` as host arrays, ready for
+    ``TableServer.publish`` / ``restore`` (optimizer slots are restored
+    by ``restore_tables`` only — serving reads weights, not momenta)."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, "tables")
+    ckptr = ocp.PyTreeCheckpointer()
+    # no abstract target tree (no live arrays to mirror): read the stored
+    # STRUCTURE, then restore only each table's 'storage' leaf as plain
+    # numpy — serving never reads optimizer slots, and the g2/momentum
+    # arrays are storage-sized, so a full-tree restore would move 2-3x
+    # the bytes just to drop them; plain-numpy also keeps the load
+    # topology-independent (the orbax sharding-file path is explicitly
+    # unsafe across topologies)
+    structure = ckptr.metadata(path)
+    item = {k: {"storage": v["storage"]} for k, v in structure.items()}
+    restore_args = {
+        k: {"storage": ocp.RestoreArgs(restore_type=np.ndarray)}
+        for k in structure
+    }
+    restored = ckptr.restore(
+        path, item=item, restore_args=restore_args, transforms={}
+    )
+    # crop shard padding: the stored storage is physical (dim 0 padded up
+    # to a shard multiple); serving phantom zero rows would corrupt top-k
+    # (padding ids outscore real rows at negative cosine) and let
+    # out-of-range lookups pass the range check. Checkpoints written
+    # before the sidecar existed load uncropped (physical == best known).
+    import json
+
+    meta_path = os.path.join(directory, "logical_shapes.json")
+    logical = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            logical = json.load(f)
+    out: Dict[str, np.ndarray] = {}
+    for key, entry in restored.items():
+        arr = np.asarray(entry["storage"])
+        shape = logical.get(key)
+        if shape is not None:
+            arr = arr[tuple(slice(0, s) for s in shape)]
+        out[key] = arr
+    Log.Info("checkpoint arrays loaded for serving: %s (%d tables)",
+             directory, len(out))
+    return out
 
 
 def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:

@@ -13,7 +13,13 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["load_word2vec_text", "analogy_accuracy", "similarity_spearman", "nearest"]
+__all__ = [
+    "load_word2vec_text",
+    "analogy_accuracy",
+    "similarity_spearman",
+    "nearest",
+    "cosine_topk",
+]
 
 
 def load_word2vec_text(path: str) -> Tuple[List[str], np.ndarray]:
@@ -106,14 +112,33 @@ def similarity_spearman(
     return float(rho), len(xs)
 
 
+def cosine_topk(
+    emb: np.ndarray, queries: np.ndarray, k: int = 10
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched cosine top-k: (Q, D) query vectors against (V, D) rows ->
+    (ids (Q, k), scores (Q, k)), descending. ONE scoring definition:
+    ``nearest`` reuses it, and it is the numpy golden the serving
+    subsystem's jitted top-k route (serving/server.py) is tested
+    against — the two must not drift."""
+    emb_n = _normalize(np.asarray(emb, np.float32))
+    q_n = _normalize(np.asarray(queries, np.float32).reshape(-1, emb.shape[1]))
+    sims = q_n @ emb_n.T  # (Q, V)
+    top = np.argsort(-sims, axis=1, kind="stable")[:, :k]
+    return top, np.take_along_axis(sims, top, axis=1)
+
+
 def nearest(
     words: List[str], emb: np.ndarray, query: str, k: int = 10
 ) -> List[Tuple[str, float]]:
     w2i = {w: i for i, w in enumerate(words)}
     if query not in w2i:
         return []
-    emb_n = _normalize(emb)
-    sims = emb_n @ emb_n[w2i[query]]
-    sims[w2i[query]] = -np.inf
-    top = np.argsort(-sims)[:k]
-    return [(words[i], float(sims[i])) for i in top]
+    qi = w2i[query]
+    # k+1 through the shared scorer, then drop the query row itself
+    top, scores = cosine_topk(emb, emb[qi : qi + 1], k + 1)
+    out = [
+        (words[i], float(s))
+        for i, s in zip(top[0], scores[0])
+        if i != qi
+    ]
+    return out[:k]

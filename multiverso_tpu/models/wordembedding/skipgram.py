@@ -1504,6 +1504,15 @@ def make_ondevice_superbatch_step(
             touched row. Per-tile sort metadata is built on device; the
             binary pair weights ride the scale arrays (idempotent, as in
             the xla body) and the validity vector."""
+            # SGD-only, like the xla body (which plain-scatter-adds and
+            # never touches g2): the kernel keys AdaGrad off the params
+            # pytree, so passing g2 slots through would silently train
+            # DIFFERENT math than impl='xla' on the same draw
+            assert "g2_in" not in params, (
+                "ondevice impl='pallas' is SGD-only (the xla body it must "
+                "match applies plain SGD); drop the g2_* slots or use "
+                "make_ondevice_general_superbatch_step(use_adagrad=True)"
+            )
             key, (c, o, w) = xs
             ts, negs = o[:, 0], o[:, 1:]
             perm = _affine_neg_perm(key, batch)

@@ -103,7 +103,12 @@ class MtQueue:
                 return out.value
             return None
         timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
-        deadline_step = 0.05
+        # poll step never exceeds the caller's timeout: the serving
+        # batcher passes millisecond deadlines, and a flat 50 ms step
+        # would quietly stretch its max_delay_s bound ~25x on hosts
+        # without the native lib (the 50 ms ceiling only bounds how
+        # stale the exit()-poison check can get while blocking forever)
+        deadline_step = 0.05 if timeout is None else max(min(0.05, timeout), 1e-4)
         waited = 0.0
         while True:
             try:

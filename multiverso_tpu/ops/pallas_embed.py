@@ -173,15 +173,18 @@ def ns_logits(emb_in, emb_out, centers, outputs, *, tile: int = 256,
 # XLA sorted path, which also gathers the POST-add g2 for every
 # contribution of the row.
 #
-# Perf notes (honest): the gather/scatter loops issue one row DMA at a
-# time with an immediate wait (the seed ``ns_logits`` pattern, known to
-# lower through Mosaic). Per-row DMA issue cost dominates at D=128
-# (ns_logits measured 5x slower than XLA's hardware gather on v5e), so
-# wall-clock wins are expected only for wide rows (D >= 512) or when HBM
-# bandwidth, not DMA issue rate, is the binding constraint — but the HBM
-# BYTES win (the roofline lever) holds at every D and is exactly
-# accountable: see ``fused_step_hbm_bytes``. Double-buffering the run DMAs
-# is the known next step.
+# Perf notes (honest): the GATHER loops are double-buffered — run i+1's
+# row copy starts before run i's is waited on (a (2,) parity semaphore
+# pair; see _gather_unique_runs), so gather overlaps DMA issue with DMA
+# flight instead of serialising on per-row latency. The SCATTER loop
+# still start/waits each write-back immediately: a run's write must be
+# ordered before a later tile's re-gather of the same row, and the
+# in-VMEM run reduction already hides most of its latency. Per-row DMA
+# issue cost still bounds narrow rows (ns_logits measured 5x slower than
+# XLA's hardware gather at D=128 on v5e), so wall-clock wins are expected
+# for wide rows (D >= 512) or when HBM bandwidth, not issue rate, binds —
+# but the HBM BYTES win (the roofline lever) holds at every D and is
+# exactly accountable: see ``fused_step_hbm_bytes``.
 # ---------------------------------------------------------------------------
 
 # Mosaic viability floor for the fused step (the _MIN_MOSAIC_BLOCK analog
@@ -286,7 +289,30 @@ def _gather_unique_runs(sort_ref, base, n, table_ref, uniq_buf, sem,
     metadata assigns the same slot numbering — ``fused_sort_metadata``).
     ``extra=(table2, buf2)`` mirrors the gather for the AdaGrad g2 table.
     Reads go through ``table_ref`` (an aliased OUTPUT ref) so a row
-    re-touched by a later tile observes earlier tiles' write-backs."""
+    re-touched by a later tile observes earlier tiles' write-backs.
+
+    DOUBLE-BUFFERED (the ROADMAP 'NEXT' item): run *s*'s copy starts
+    before run *s-1*'s is waited on, so DMA issue overlaps DMA flight
+    instead of serialising on per-row latency. ``sem`` is a (2,) DMA
+    semaphore pair indexed by run parity: before starting run *s* we wait
+    only for run *s-2* (the previous user of parity ``s % 2``), keeping
+    up to two row copies in flight; the loop epilogue drains the last one
+    or two. Each copy lands in its own ``uniq_buf`` slot, so in-flight
+    copies never alias — numerics are unchanged at any depth, and the
+    parity suite pins exact interpret-mode parity."""
+
+    def _wait_one(parity):
+        # same (1, D) shape/dtype as every gather copy on this table: the
+        # wait consumes exactly one row-copy completion on that parity
+        pltpu.make_async_copy(
+            table_ref.at[pl.ds(0, 1), :], uniq_buf.at[pl.ds(0, 1), :],
+            sem.at[parity],
+        ).wait()
+        if extra is not None:
+            t2, b2 = extra
+            pltpu.make_async_copy(
+                t2.at[pl.ds(0, 1), :], b2.at[pl.ds(0, 1), :], sem.at[parity]
+            ).wait()
 
     def body(j, nslot):
         rid = sort_ref[base + j]
@@ -295,24 +321,36 @@ def _gather_unique_runs(sort_ref, base, n, table_ref, uniq_buf, sem,
 
         @pl.when(is_new)
         def _():
+            @pl.when(nslot >= 2)
+            def _():  # reclaim this parity: run nslot-2 must have landed
+                _wait_one(nslot % 2)
+
             cp = pltpu.make_async_copy(
                 table_ref.at[pl.ds(rid, 1), :],
                 uniq_buf.at[pl.ds(nslot, 1), :],
-                sem,
+                sem.at[nslot % 2],
             )
             cp.start()
-            cp.wait()
             if extra is not None:
                 t2, b2 = extra
-                cp2 = pltpu.make_async_copy(
-                    t2.at[pl.ds(rid, 1), :], b2.at[pl.ds(nslot, 1), :], sem
-                )
-                cp2.start()
-                cp2.wait()
+                pltpu.make_async_copy(
+                    t2.at[pl.ds(rid, 1), :], b2.at[pl.ds(nslot, 1), :],
+                    sem.at[nslot % 2],
+                ).start()
 
         return nslot + is_new.astype(jnp.int32)
 
-    jax.lax.fori_loop(0, n, body, jnp.int32(0))
+    nruns = jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+    # epilogue: the last min(nruns, 2) copies are still in flight; callers
+    # read uniq_buf right after this returns, so drain before returning
+    @pl.when(nruns >= 2)
+    def _():
+        _wait_one((nruns - 2) % 2)
+
+    @pl.when(nruns >= 1)
+    def _():
+        _wait_one((nruns - 1) % 2)
 
 
 def _expand_rows(slot_ref, base, n, uniq_buf, dst_buf):
@@ -370,7 +408,7 @@ def _scatter_runs(sort_ref, perm_ref, scale_ref, base, n, upd_buf, uniq_buf,
                 cpg = pltpu.make_async_copy(
                     g2_buf.at[pl.ds(slot, 1), :],
                     g2_table.at[pl.ds(rid, 1), :],
-                    sem,
+                    sem.at[0],  # gathers drained the pair; slot 0 is free
                 )
                 cpg.start()
                 cpg.wait()
@@ -381,7 +419,7 @@ def _scatter_runs(sort_ref, perm_ref, scale_ref, base, n, upd_buf, uniq_buf,
             cp = pltpu.make_async_copy(
                 uniq_buf.at[pl.ds(slot, 1), :],
                 table_ref.at[pl.ds(rid, 1), :],
-                sem,
+                sem.at[0],
             )
             cp.start()
             cp.wait()
@@ -547,7 +585,9 @@ def fused_ns_train_step(params, batch, lr, *, tile: int = 256,
                 pltpu.VMEM((tile * NC, D), jnp.float32),  # vout natural
                 pltpu.VMEM((tile * NC, D), jnp.float32),  # out-update rows
                 pltpu.VMEM((tile, D), jnp.float32),       # d_vin rows
-                pltpu.SemaphoreType.DMA(()),
+                # (2,) parity pair: the gather loops keep two row DMAs in
+                # flight (double buffering); scatter uses slot 0 serially
+                pltpu.SemaphoreType.DMA((2,)),
             ]
         ),
     )

@@ -209,10 +209,12 @@ def _sds(shape, dtype, vma):
     declare which mesh axes they vary over; ring/zigzag/Ulysses callers
     pass ``vma=(seq_axis,)`` so the rest of their program keeps full vma
     checking (ADVICE r4 — it used to be check_vma=False program-wide).
-    Outside shard_map, ``vma=()`` leaves the struct unannotated."""
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
-    return jax.ShapeDtypeStruct(shape, dtype)
+    Outside shard_map, ``vma=()`` leaves the struct unannotated. On JAX
+    versions without the annotation the compat helper drops it (legacy
+    check_rep infers replication without per-output declarations)."""
+    from multiverso_tpu.parallel.compat import shape_dtype_struct
+
+    return shape_dtype_struct(shape, dtype, vma)
 
 
 def _kv_idx_map(causal, block_q, block_k):

@@ -850,8 +850,10 @@ def _wrap(mesh: Mesh, seq_axis: str, local_fn, q, k, v, scale,
             raise ValueError(
                 f"{name} seq len {arr.shape[1]} not divisible by {n} devices"
             )
+    from multiverso_tpu.parallel.compat import shard_map
+
     spec = P(None, seq_axis, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             local_fn, axis_name=seq_axis, scale=scale, **local_kw
         ),

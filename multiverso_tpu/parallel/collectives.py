@@ -230,9 +230,11 @@ def _mesh_or_runtime(mesh: Optional[Mesh]) -> Mesh:
 
 
 def _shard_map_worker(mesh: Mesh, fn):
+    from multiverso_tpu.parallel.compat import shard_map
+
     spec = P(mesh_lib.WORKER_AXIS)
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(mesh_lib.WORKER_AXIS),),

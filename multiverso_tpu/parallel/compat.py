@@ -1,0 +1,84 @@
+"""JAX API-drift shims, one definition for the whole tree.
+
+``shard_map`` has moved twice across the JAX versions this repo meets in
+the wild: modern releases export ``jax.shard_map`` with a ``check_vma``
+kwarg (varying-mesh-axes checking); older releases only ship
+``jax.experimental.shard_map.shard_map`` whose equivalent kwarg is
+``check_rep`` (replication checking — same contract, earlier name), and
+their ``jax.ShapeDtypeStruct`` has no ``vma`` annotation at all. Every
+caller in this repo goes through this module so the resolution happens in
+exactly one place; new call sites must import from here, not from jax.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["shard_map", "shape_dtype_struct", "HAS_NATIVE_SHARD_MAP"]
+
+# resolved once at import: the module-level probe is the whole point (a
+# per-call getattr would hide which API the process actually runs on)
+_NATIVE = getattr(jax, "shard_map", None)
+HAS_NATIVE_SHARD_MAP = _NATIVE is not None
+
+if not HAS_NATIVE_SHARD_MAP:
+    from jax.experimental.shard_map import shard_map as _LEGACY
+else:
+    _LEGACY = None
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: Optional[bool] = None,
+    **kwargs: Any,
+):
+    """``jax.shard_map`` resolved across API drift.
+
+    Keyword-only mirror of the modern signature. On modern JAX
+    ``check_vma`` passes straight through; ``None`` leaves the installed
+    default. On legacy JAX the nearest kwarg is ``check_rep``, but the
+    pre-vma replication checker is strictly weaker: it rejects valid
+    programs whose branches/VJPs mix replication types (``cond`` inside a
+    ring step raises "mismatched replication types ... as a temporary
+    workaround pass check_rep=False" on programs the modern vma checker
+    accepts). An explicit ``check_vma=True`` therefore degrades to
+    ``check_rep=False`` there — unchecked, not wrongly-rejected — while
+    ``None`` keeps the legacy default so simple psum/ppermute bodies stay
+    verified.
+    """
+    if HAS_NATIVE_SHARD_MAP:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _NATIVE(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+    if check_vma is not None:
+        kwargs["check_rep"] = False
+    return _LEGACY(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+@functools.lru_cache(maxsize=1)
+def _sds_accepts_vma() -> bool:
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+        return True
+    except TypeError:
+        return False
+
+
+def shape_dtype_struct(shape, dtype, vma=()) -> jax.ShapeDtypeStruct:
+    """``jax.ShapeDtypeStruct`` with an optional varying-mesh-axes
+    annotation, dropped on JAX versions that predate ``vma``.
+
+    Dropping is sound, not a silent behavior change: pre-vma shard_map has
+    no per-output varying-axes check to feed — its ``check_rep`` pass
+    infers replication from the ops alone — so there is nothing the
+    annotation could alter."""
+    if vma and _sds_accepts_vma():
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)

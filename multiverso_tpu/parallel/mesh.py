@@ -41,6 +41,7 @@ __all__ = [
     "table_sharding",
     "worker_sharding",
     "replicated_sharding",
+    "query_sharding",
 ]
 
 WORKER_AXIS = "worker"
@@ -119,3 +120,15 @@ def worker_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def query_sharding(mesh: Mesh, ndim: int, batch: int) -> NamedSharding:
+    """Serving-query placement: split the padded query bucket's dim 0
+    over the worker axis when it divides evenly (data-parallel gather /
+    score matmul), else replicate — a non-divisible bucket only occurs
+    for direct sub-``min_bucket`` calls where replication is free."""
+    if batch % num_workers(mesh) == 0:
+        spec = [None] * ndim
+        spec[0] = WORKER_AXIS
+        return NamedSharding(mesh, P(*spec))
+    return replicated_sharding(mesh)

@@ -177,6 +177,28 @@ def initialize(
         if num_processes == 1:
             Log.Info("single-process cluster; skipping distributed rendezvous")
             return
+    # A multi-process CPU cluster (the test rig's 2-4 process "pod") needs
+    # a cross-host collectives transport: newer jaxlib defaults CPU
+    # multiprocess to gloo, older versions ship it but leave the default
+    # on the unimplemented stub ("Multiprocess computations aren't
+    # implemented on the CPU backend"). Opt in explicitly — must happen
+    # before the backend initialises, which jax.distributed.initialize
+    # triggers. TPU/GPU platforms ignore the CPU setting entirely.
+    platforms = (getattr(jax.config, "jax_platforms", None) or "").split(",")
+    if "cpu" in platforms:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # option absent (very old/new jax): keep its default
+        try:
+            # gloo's TCP pairs cannot take two in-flight collectives from
+            # one process: async dispatch lets computation N+1's psum race
+            # computation N's ("op.preamble.length <= op.nbytes" aborts).
+            # Synchronous dispatch serialises them; CPU multiprocess is a
+            # test rig, so the lost overlap is irrelevant.
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
+        except Exception:
+            pass
     # num_processes=None with a coordinator: jax infers the count from the
     # TPU pod environment.
     jax.distributed.initialize(

@@ -118,6 +118,7 @@ class Runtime:
         self.mesh: Optional[Mesh] = None
         self._started = False
         self._tables: List[Any] = []
+        self._servers: List[Any] = []
         self._barrier_fn = None
         self._barrier_input = None
         self._aggregate_fn = None
@@ -183,6 +184,15 @@ class Runtime:
         MPI alive (SURVEY.md §4 note on ``MV_ShutDown(false)``)."""
         if not self._started:
             return
+        # serving teardown precedes table teardown: servers drain their
+        # in-flight batches against snapshots, never against live tables,
+        # but their metrics/dashboard hooks must not outlive the runtime
+        for srv in list(self._servers):
+            try:
+                srv.stop()
+            except Exception as e:  # teardown must not mask the shutdown
+                Log.Info("table server stop failed during shutdown: %s", e)
+        self._servers.clear()
         self.barrier()
         self._tables.clear()
         if finalize:
@@ -306,6 +316,24 @@ class Runtime:
     @property
     def tables(self) -> List[Any]:
         return list(self._tables)
+
+    # ------------------------------------------------------------ serving
+
+    def attach_server(self, server: Any) -> None:
+        """Track a ``serving.TableServer`` for lifecycle: ``shut_down``
+        stops attached servers before tearing tables down (the server
+        registers itself at construction when the runtime is started)."""
+        self._require_started()
+        if server not in self._servers:
+            self._servers.append(server)
+
+    def detach_server(self, server: Any) -> None:
+        if server in self._servers:
+            self._servers.remove(server)
+
+    @property
+    def servers(self) -> List[Any]:
+        return list(self._servers)
 
 
 def runtime() -> Runtime:

@@ -1,0 +1,33 @@
+"""Online serving subsystem: dynamic-batching query server over tables.
+
+The reference's tables exist to be *read* under traffic — workers issue
+``Get`` lookups against sharded state (SURVEY.md §2.2) — and the north
+star is a system that serves heavy traffic from millions of users. This
+package is the read path sized for that traffic:
+
+* ``batcher``  — dynamic micro-batching front door: an MtQueue-backed
+  request queue flushed on max-batch-size OR deadline, bounded depth with
+  backpressure / shed-on-overload (reject with retry-after);
+* ``server``   — ``TableServer``: frozen sharded table snapshots behind
+  jitted padded-bucket query programs (embedding lookup, top-k nearest
+  neighbour, logreg predict) with double-buffered hot-swap publication;
+* ``metrics``  — per-route latency histograms (p50/p99), QPS, queue
+  depth, batch-fill ratio and shed counts, wired into the Dashboard.
+
+Everything is CPU-runnable (the fake 8-device mesh used by tier-1 tests);
+on TPU the same jitted programs shard the score matmuls over the mesh.
+"""
+
+from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded, Request
+from multiverso_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from multiverso_tpu.serving.server import ServingSnapshot, TableServer
+
+__all__ = [
+    "DynamicBatcher",
+    "Overloaded",
+    "Request",
+    "LatencyHistogram",
+    "ServingMetrics",
+    "ServingSnapshot",
+    "TableServer",
+]

@@ -1,0 +1,264 @@
+"""Dynamic micro-batcher: the serving front door.
+
+Single-query device dispatch wastes the mesh (a 1-row gather pays the
+same program-launch cost as a 256-row one), so the server batches: every
+request lands in an ``MtQueue``-backed ticket queue (the native blocking
+MPMC queue that already feeds the training pipeline —
+native/host_runtime.py), and a flusher thread drains it into per-route
+micro-batches that close on **max-batch-size OR deadline**, whichever
+comes first:
+
+* a request older than ``max_delay_s`` flushes its route immediately —
+  the latency bound;
+* a route reaching ``max_batch`` requests flushes immediately — the
+  throughput bound (and the padded-bucket compile cache's upper size).
+
+Depth is bounded (``max_depth`` tickets). When the queue is full the
+batcher is *overloaded* and degrades instead of queueing unboundedly:
+``submit(block=False)`` (the default) sheds the request with
+``Overloaded`` carrying a ``retry_after_s`` hint (reject-with-retry-after,
+the reference's SenderQueueLimit backpressure made explicit);
+``submit(block=True)`` applies backpressure by blocking for a free
+ticket. Shed counts, queue depth, batch fill and per-request latency all
+land in the attached ``ServingMetrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from multiverso_tpu.serving.metrics import ServingMetrics
+from multiverso_tpu.utils.log import CHECK
+
+__all__ = ["DynamicBatcher", "Overloaded", "Request"]
+
+
+class Overloaded(Exception):
+    """Request shed: the queue is at max depth. ``retry_after_s`` is the
+    client hint (roughly one drain round of the current backlog)."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"serving queue overloaded; retry after {retry_after_s * 1e3:.1f} ms"
+        )
+        self.retry_after_s = retry_after_s
+
+
+def _set_future(fut: "Future", result: Any) -> None:
+    """Racing resolvers (flusher vs a timed-out close()) must not throw:
+    a done()-then-set pair is TOCTOU, so absorb InvalidStateError."""
+    try:
+        fut.set_result(result)
+    except Exception:
+        pass  # already resolved by the other side
+
+
+def _fail_future(fut: "Future", exc: BaseException) -> None:
+    try:
+        fut.set_exception(exc)
+    except Exception:
+        pass
+
+
+@dataclass
+class Request:
+    route: str
+    payload: Any
+    future: "Future" = field(default_factory=Future)
+    enqueue_t: float = field(default_factory=time.monotonic)
+
+
+class DynamicBatcher:
+    """Deadline/size dynamic batcher over an MtQueue ticket ring.
+
+    ``flush_fn(route, payloads) -> results`` runs on the flusher thread
+    with a list of payloads and must return one result per payload (any
+    exception fails that batch's futures). One flusher thread keeps
+    device dispatch single-threaded — batches are the concurrency unit,
+    exactly like the training pipeline's consumer.
+    """
+
+    def __init__(
+        self,
+        flush_fn: Callable[[str, List[Any]], List[Any]],
+        *,
+        max_batch: int = 64,
+        max_delay_s: float = 0.002,
+        max_depth: int = 1024,
+        metrics: Optional[ServingMetrics] = None,
+        name: str = "batcher",
+    ):
+        CHECK(max_batch >= 1, "max_batch must be >= 1")
+        CHECK(max_depth >= max_batch, "max_depth must be >= max_batch")
+        from multiverso_tpu.native.host_runtime import MtQueue
+
+        self._flush_fn = flush_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_s)
+        self.max_depth = int(max_depth)
+        self.metrics = metrics if metrics is not None else ServingMetrics(name)
+        # ticket ring: slots hold Requests; `free` bounds depth, `ready`
+        # carries filled tickets to the flusher (both MtQueues: uint64
+        # handles + blocking pop + exit poison)
+        self._slots: List[Optional[Request]] = [None] * self.max_depth
+        self._free = MtQueue()
+        self._ready = MtQueue()
+        for i in range(self.max_depth):
+            self._free.push(i)
+        self._depth = 0  # approximate live count (metrics gauge)
+        self._depth_lock = threading.Lock()
+        self._pending: Dict[str, List[Request]] = {}  # route -> open batch
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------ client
+
+    def start(self) -> "DynamicBatcher":
+        CHECK(self._thread is None, "batcher already started")
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="mv-serving-batcher"
+        )
+        self._thread.start()
+        return self
+
+    def submit(self, route: str, payload: Any, block: bool = False) -> Future:
+        """Enqueue one request; returns its Future.
+
+        ``block=False`` (online serving): a full queue sheds the request
+        by raising ``Overloaded`` with a retry-after hint. ``block=True``
+        (offline/bulk clients): wait for a free ticket instead —
+        backpressure propagates to the producer.
+        """
+        CHECK(not self._closed, "batcher is closed")
+        if block:
+            ticket = self._free.pop()
+        else:
+            ticket = self._free.try_pop()
+        if ticket is None:
+            if self._closed:
+                # close() raced us and exited the free queue: this is
+                # shutdown, not overload — neither a shed count nor a
+                # retry-after hint (retrying a dead server forever)
+                raise RuntimeError("batcher closed")
+            self.metrics.record_shed()
+            raise Overloaded(self._retry_after())
+        req = Request(route=route, payload=payload)
+        self._slots[ticket] = req
+        with self._depth_lock:
+            self._depth += 1
+            self.metrics.set_queue_depth(self._depth)
+        if not self._ready.push(ticket):  # closed while enqueueing
+            req.future.set_exception(RuntimeError("batcher closed"))
+        return req.future
+
+    def _retry_after(self) -> float:
+        """Client hint: time to drain the live backlog at the deadline
+        cadence — depth/max_batch flush rounds of max_delay each, floored
+        at one round."""
+        rounds = max(1.0, self._depth / float(self.max_batch))
+        return rounds * max(self.max_delay_s, 1e-4)
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Drain-and-stop: in-flight tickets flush, then the thread exits."""
+        if self._closed:
+            return
+        self._closed = True
+        self._ready.exit()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=timeout_s)
+        self._free.exit()
+        if th is None or not th.is_alive():
+            # flusher is gone: safe to fail whatever it never reached.
+            # (If the join timed out — e.g. a flush_fn stuck in a long
+            # compile — the flusher still OWNS _pending; touching it here
+            # would race its setdefault/pop mid-iteration. It saw
+            # _closed and will drain-and-exit when the flush returns.)
+            for reqs in self._pending.values():
+                for r in reqs:
+                    _fail_future(r.future, RuntimeError("batcher closed"))
+            self._pending.clear()
+
+    # ------------------------------------------------------------ flusher
+
+    def _oldest_deadline(self) -> Optional[float]:
+        ts = [
+            reqs[0].enqueue_t + self.max_delay_s
+            for reqs in self._pending.values()
+            if reqs
+        ]
+        return min(ts) if ts else None
+
+    def _run(self) -> None:
+        while True:
+            deadline = self._oldest_deadline()
+            if deadline is None:
+                ticket = self._ready.pop()  # idle: block for work
+            else:
+                wait_ms = int(max(0.0, deadline - time.monotonic()) * 1e3)
+                ticket = self._ready.pop(timeout_ms=max(wait_ms, 1))
+            if ticket is not None:
+                req = self._slots[ticket]
+                self._slots[ticket] = None
+                self._free.push(ticket)
+                if req is not None:
+                    self._pending.setdefault(req.route, []).append(req)
+                    if len(self._pending[req.route]) >= self.max_batch:
+                        self._flush(req.route)
+            # deadline sweep EVERY iteration — not only on pop timeout: a
+            # steady stream on one route keeps pop() returning tickets, and
+            # skipping the sweep then would starve a quieter route's
+            # past-due partial batch indefinitely
+            now = time.monotonic()
+            for route in list(self._pending):
+                reqs = self._pending[route]
+                if reqs and reqs[0].enqueue_t + self.max_delay_s <= now:
+                    self._flush(route)
+            if ticket is None and self._closed:
+                # drain whatever arrived before the poison, then leave
+                while True:
+                    t2 = self._ready.try_pop()
+                    if t2 is None:
+                        break
+                    req = self._slots[t2]
+                    self._slots[t2] = None
+                    self._free.push(t2)
+                    if req is not None:
+                        self._pending.setdefault(req.route, []).append(req)
+                for route in list(self._pending):
+                    if self._pending[route]:
+                        self._flush(route)
+                return
+
+    def _flush(self, route: str) -> None:
+        reqs = self._pending.pop(route, [])
+        if not reqs:
+            return
+        with self._depth_lock:
+            self._depth -= len(reqs)
+            self.metrics.set_queue_depth(self._depth)
+        payloads = [r.payload for r in reqs]
+        try:
+            results = self._flush_fn(route, payloads)
+            CHECK(
+                len(results) == len(payloads),
+                f"flush_fn returned {len(results)} results for "
+                f"{len(payloads)} payloads on route {route!r}",
+            )
+        except BaseException as e:  # noqa: BLE001 — fail the batch, stay alive
+            for r in reqs:
+                _fail_future(r.future, e)
+            return
+        done = time.monotonic()
+        for r, res in zip(reqs, results):
+            _set_future(r.future, res)
+        self.metrics.record_batch(
+            route,
+            len(reqs),
+            self.max_batch,
+            [done - r.enqueue_t for r in reqs],
+        )

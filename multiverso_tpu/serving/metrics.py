@@ -1,0 +1,208 @@
+"""Serving metrics: latency histograms, QPS, queue depth, fill, sheds.
+
+The reference's Dashboard accumulates {count, total ms} per monitor
+(ref: include/multiverso/dashboard.h:16-74) — enough for training loops,
+not for an online server whose contract is a latency *distribution*
+(p50/p99) and an overload story (shed counts, queue depth). This module
+adds those as a serving-scoped registry that plugs into the process-wide
+``Dashboard.Display()`` via the section hook, so one call still dumps
+everything.
+
+Histograms are fixed log-spaced buckets (30 per decade is overkill;
+we use ~14% resolution) — constant memory, lock-cheap, and percentile
+queries never touch the record path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["LatencyHistogram", "ServingMetrics"]
+
+
+class LatencyHistogram:
+    """Fixed log-bucket latency histogram over [10us, ~100s).
+
+    ``record`` is O(1) under a lock; ``percentile`` interpolates within
+    the winning bucket (log-bucket resolution ~14%, plenty for p50/p99
+    reporting). Values below/above the range clamp to the edge buckets.
+    """
+
+    _LO = 1e-5  # 10 us
+    _RATIO = 1.148698354997035  # 2 ** (1/5): 5 buckets per octave
+    _NBUCKETS = 120  # reaches ~10us * 2^24 ≈ 167s
+
+    def __init__(self) -> None:
+        self._counts = [0] * self._NBUCKETS
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total_s = 0.0
+
+    def _bucket(self, seconds: float) -> int:
+        if seconds <= self._LO:
+            return 0
+        b = 0
+        x = self._LO
+        # loop beats math.log in branch-predictability for the common
+        # sub-ms case (b <= ~35) and keeps the bucket rule integral
+        while x * self._RATIO < seconds and b < self._NBUCKETS - 1:
+            x *= self._RATIO
+            b += 1
+        return b
+
+    def record(self, seconds: float) -> None:
+        b = self._bucket(seconds)
+        with self._lock:
+            self._counts[b] += 1
+            self.count += 1
+            self.total_s += seconds
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100] -> seconds (0.0 when empty)."""
+        with self._lock:
+            total = self.count
+            counts = list(self._counts)
+        if total == 0:
+            return 0.0
+        target = max(1, int(round(q / 100.0 * total)))
+        seen = 0
+        for b, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                # geometric midpoint of the winning bucket
+                lo = self._LO * (self._RATIO ** b)
+                return lo * (self._RATIO ** 0.5)
+        return self._LO * (self._RATIO ** self._NBUCKETS)
+
+    @property
+    def mean_s(self) -> float:
+        with self._lock:
+            return self.total_s / self.count if self.count else 0.0
+
+
+class ServingMetrics:
+    """Per-server metrics bundle; one instance per TableServer/batcher.
+
+    Tracks, per route: request latency histograms (enqueue -> result set).
+    Globally: served/shed counters, flushed-batch fill ratio, live queue
+    depth (gauge set by the batcher), QPS over a sliding window.
+    """
+
+    def __init__(self, name: str = "serving", window_s: float = 30.0):
+        self.name = name
+        self._lock = threading.Lock()
+        self.route_latency: Dict[str, LatencyHistogram] = {}
+        self.served = 0
+        self.shed = 0
+        self.batches = 0
+        self.batch_fill_sum = 0.0  # sum of per-batch size/max_batch
+        self.queue_depth = 0
+        self.swaps = 0
+        self._window_s = float(window_s)
+        self._served_times: List[tuple] = []  # (t, n) per flush, pruned
+
+    # ------------------------------------------------------------ record
+
+    def latency(self, route: str) -> LatencyHistogram:
+        with self._lock:
+            h = self.route_latency.get(route)
+            if h is None:
+                h = LatencyHistogram()
+                self.route_latency[route] = h
+            return h
+
+    def record_batch(self, route: str, size: int, max_batch: int,
+                     latencies_s: List[float]) -> None:
+        hist = self.latency(route)
+        for s in latencies_s:
+            hist.record(s)
+        now = time.monotonic()
+        with self._lock:
+            self.served += size
+            self.batches += 1
+            self.batch_fill_sum += size / float(max_batch)
+            self._served_times.append((now, size))
+            cutoff = now - self._window_s
+            while self._served_times and self._served_times[0][0] < cutoff:
+                self._served_times.pop(0)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.shed += n
+
+    def record_swap(self) -> None:
+        with self._lock:
+            self.swaps += 1
+
+    def set_queue_depth(self, depth: int) -> None:
+        self.queue_depth = int(depth)
+
+    # ------------------------------------------------------------ read
+
+    def qps(self) -> float:
+        """Served queries/sec over the sliding window (span measured from
+        the oldest retained flush, so short bursts aren't averaged over
+        an empty 30s)."""
+        now = time.monotonic()
+        with self._lock:
+            cutoff = now - self._window_s
+            pts = [(t, n) for t, n in self._served_times if t >= cutoff]
+            if not pts:
+                return 0.0
+            span = max(now - pts[0][0], 1e-6)
+            return sum(n for _, n in pts) / span
+
+    def batch_fill(self) -> float:
+        with self._lock:
+            return self.batch_fill_sum / self.batches if self.batches else 0.0
+
+    def report(self) -> Dict[str, object]:
+        """Snapshot dict — the BENCH/demo/ci JSON payload."""
+        out: Dict[str, object] = {
+            "served": self.served,
+            "shed": self.shed,
+            "batches": self.batches,
+            "batch_fill": round(self.batch_fill(), 4),
+            "queue_depth": self.queue_depth,
+            "qps": round(self.qps(), 1),
+            "swaps": self.swaps,
+        }
+        for route, hist in sorted(self.route_latency.items()):
+            out[f"{route}_p50_ms"] = round(hist.percentile(50) * 1e3, 4)
+            out[f"{route}_p99_ms"] = round(hist.percentile(99) * 1e3, 4)
+            out[f"{route}_mean_ms"] = round(hist.mean_s * 1e3, 4)
+            out[f"{route}_count"] = hist.count
+        return out
+
+    def info_lines(self) -> List[str]:
+        """Dashboard section lines (the Display() wiring)."""
+        r = self.report()
+        lines = [
+            f"[Serving:{self.name}] served={r['served']} shed={r['shed']} "
+            f"qps={r['qps']} batches={r['batches']} "
+            f"fill={r['batch_fill']:.2f} depth={r['queue_depth']} "
+            f"swaps={r['swaps']}"
+        ]
+        for route in sorted(self.route_latency):
+            lines.append(
+                f"[Serving:{self.name}] {route}: n={r[f'{route}_count']} "
+                f"p50={r[f'{route}_p50_ms']:.3f}ms "
+                f"p99={r[f'{route}_p99_ms']:.3f}ms "
+                f"mean={r[f'{route}_mean_ms']:.3f}ms"
+            )
+        return lines
+
+    def register_dashboard(self) -> None:
+        """Hook this bundle into ``Dashboard.Display()``. Keyed add is
+        naturally idempotent — no guard flag, so re-registering after a
+        ``Dashboard.Reset()`` (which wipes sections) just works."""
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.add_section(f"serving.{self.name}.{id(self)}", self.info_lines)
+
+    def unregister_dashboard(self) -> None:
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.remove_section(f"serving.{self.name}.{id(self)}")

@@ -1,0 +1,451 @@
+"""TableServer: frozen sharded tables behind jitted query programs.
+
+The training side of this repo reproduces the reference's write path
+(Get/Add as SPMD collectives); this is the read path sized for online
+traffic. A ``TableServer`` holds an immutable ``ServingSnapshot`` of
+named arrays (embedding tables, logreg weights) placed on the mesh with
+the same dim-0 row sharding tables train under, and serves three routes
+through jitted, padded-bucket programs:
+
+* ``lookup``  — row gather: ids -> rows (the reference ``Get`` under
+  traffic);
+* ``topk``    — top-k nearest neighbours by cosine: query vectors ->
+  (ids, scores), the score matmul sharded over the table's row axis
+  (the WordEmbedding eval protocol, served — scoring math shared with
+  ``models/wordembedding/eval.py``);
+* ``predict`` — logistic-regression predict: features -> sigmoid scores
+  (the LogReg app's inference half).
+
+**Padded buckets**: query row blocks are padded up to a power-of-two
+bucket (floored at ``min_bucket``, capped at ``max_rows``) so the jit
+cache holds a logarithmic set of programs instead of one per batch size,
+and a client-supplied payload can never compile an arbitrarily large
+program.
+
+**Hot-swap** is double-buffered publication: ``publish()`` stages the new
+weights on device while queries keep draining from the current snapshot,
+then swaps the snapshot *reference* atomically. Snapshots are immutable
+and every query program reads exactly one snapshot reference, so no
+query can ever observe a torn mix of old and new weights — the swap
+guarantee the tests pin. Old buffers free when the last in-flight batch
+drops them (ordinary GC, no epoch machinery needed).
+
+Weights can come from live training tables (``publish_from_tables`` — a
+donation-safe copy via ``DenseTable.snapshot_array``), from a checkpoint
+directory (``restore`` — the ``io/checkpoint.py`` load-for-serving path),
+or straight from host arrays (``publish``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.serving.batcher import DynamicBatcher
+from multiverso_tpu.serving.metrics import ServingMetrics
+from multiverso_tpu.utils import next_pow2 as _next_pow2
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["ServingSnapshot", "TableServer"]
+
+
+class ServingSnapshot:
+    """Immutable named-array bundle, one weights version.
+
+    ``arrays`` are device-resident (sharded over the mesh); ``derived``
+    lazily caches per-snapshot transforms (the row-normalised table the
+    topk route scores against) so they are computed once per version and
+    die with it."""
+
+    def __init__(self, arrays: Dict[str, jax.Array], version: int):
+        self.arrays = dict(arrays)
+        self.version = version
+        self._derived: Dict[str, jax.Array] = {}
+        self._derived_lock = threading.Lock()
+
+    def names(self) -> List[str]:
+        return sorted(self.arrays)
+
+    def derived(self, key: str, build) -> jax.Array:
+        with self._derived_lock:
+            arr = self._derived.get(key)
+            if arr is None:
+                arr = build()
+                self._derived[key] = arr
+            return arr
+
+
+class TableServer:
+    """Dynamic-batching query server over frozen sharded tables."""
+
+    def __init__(
+        self,
+        arrays: Optional[Dict[str, Any]] = None,
+        *,
+        mesh=None,
+        max_batch: int = 64,
+        max_delay_s: float = 0.002,
+        max_depth: int = 1024,
+        min_bucket: int = 8,
+        max_rows: int = 1 << 16,
+        name: str = "tableserver",
+        register_runtime: bool = True,
+    ):
+        if mesh is None:
+            from multiverso_tpu.runtime import runtime
+
+            rt = runtime()
+            mesh = rt.mesh if rt.started else mesh_lib.build_mesh()
+        self.mesh = mesh
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.min_bucket = int(min_bucket)
+        self.max_rows = int(max_rows)
+        CHECK(
+            self.min_bucket <= self.max_rows,
+            "min_bucket must be <= max_rows",
+        )
+        self.metrics = ServingMetrics(name)
+        self.metrics.register_dashboard()
+        self._snapshot: Optional[ServingSnapshot] = None
+        self._publish_lock = threading.Lock()  # serialises publishers only
+        self._version = 0
+        self._jit_cache: Dict[Tuple, Any] = {}
+        self._batcher = DynamicBatcher(
+            self._flush,
+            max_batch=max_batch,
+            max_delay_s=max_delay_s,
+            max_depth=max_depth,
+            metrics=self.metrics,
+            name=name,
+        )
+        self._started = False
+        self._registered = False
+        if arrays:
+            self.publish(arrays)
+        if register_runtime:
+            from multiverso_tpu.runtime import runtime
+
+            rt = runtime()
+            if rt.started:
+                rt.attach_server(self)
+                self._registered = True
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "TableServer":
+        """Start the batching front door (direct query methods work
+        without it; ``*_async`` need it)."""
+        if not self._started:
+            self._batcher.start()
+            self._started = True
+        return self
+
+    def stop(self) -> None:
+        self._batcher.close()
+        self.metrics.unregister_dashboard()
+        if self._registered:
+            from multiverso_tpu.runtime import runtime
+
+            runtime().detach_server(self)
+            self._registered = False
+
+    # ------------------------------------------------------------ publish
+
+    def _place(self, name: str, arr: Any) -> jax.Array:
+        arr = np.asarray(arr) if not isinstance(arr, jax.Array) else arr
+        CHECK(arr.ndim == 2, f"table {name!r} must be 2-D, got shape {arr.shape}")
+        nshards = mesh_lib.num_shards(self.mesh)
+        if arr.shape[0] % nshards == 0:
+            sharding = mesh_lib.table_sharding(self.mesh, arr.ndim)
+        else:  # uneven rows: replicate (correctness first; serving tables
+            # produced by DenseTable are shard-padded already)
+            sharding = mesh_lib.replicated_sharding(self.mesh)
+        return jax.device_put(arr, sharding)
+
+    def publish(self, arrays: Dict[str, Any]) -> int:
+        """Stage new weights on device, then swap atomically. Returns the
+        new version. Queries in flight keep the old snapshot (double
+        buffering); queries arriving after the swap see only the new one.
+        """
+        with self._publish_lock:
+            staged = {k: self._place(k, v) for k, v in arrays.items()}
+            for v in staged.values():
+                v.block_until_ready()  # fully resident BEFORE visibility
+            self._version += 1
+            snap = ServingSnapshot(staged, self._version)
+            # atomic reference swap: the ONLY mutation queries can observe
+            self._snapshot = snap
+            self.metrics.record_swap()
+            Log.Info(
+                "table server %s: published weights v%d (%s)",
+                self.name,
+                snap.version,
+                ",".join(f"{k}{list(v.shape)}" for k, v in staged.items()),
+            )
+            return snap.version
+
+    def publish_from_tables(self, tables: Dict[str, Any]) -> int:
+        """Publish live training tables (``DenseTable``s): donation-safe
+        snapshot copies, so subsequent donated ``add`` steps cannot
+        invalidate serving buffers."""
+        return self.publish(
+            {name: t.snapshot_array() for name, t in tables.items()}
+        )
+
+    def restore(self, directory: str, names: Optional[Sequence[str]] = None) -> int:
+        """Load-for-serving from an ``io/checkpoint.py`` checkpoint
+        directory: restores raw table storages without constructing live
+        tables, names them ``table_<id>`` (or ``names`` in id order)."""
+        from multiverso_tpu.io.checkpoint import load_arrays
+
+        stored = load_arrays(directory)
+        if names is not None:
+            CHECK(
+                len(names) == len(stored),
+                f"{len(names)} names for {len(stored)} stored tables",
+            )
+            # numeric table-id order, NOT lexicographic: sorted() would put
+            # table_10 before table_2 and silently serve the wrong weights
+            by_id = sorted(stored, key=lambda k: int(k.rpartition("_")[2]))
+            stored = {n: stored[k] for n, k in zip(names, by_id)}
+        return self.publish(stored)
+
+    @property
+    def snapshot(self) -> ServingSnapshot:
+        snap = self._snapshot
+        CHECK(snap is not None, "no weights published yet")
+        return snap
+
+    @property
+    def version(self) -> int:
+        return self.snapshot.version
+
+    # ------------------------------------------------------------ programs
+
+    def _bucket(self, n: int) -> int:
+        """Padded bucket: next power of two, floored at ``min_bucket``.
+        ``n`` counts ROWS of the concatenated micro-batch (requests x
+        rows-per-request), so the jit cache grows one program per power
+        of two the traffic actually reaches — logarithmic in the largest
+        flush. ``max_rows`` caps it: client payload size must not be
+        able to compile (and permanently cache) an arbitrarily large
+        padded program."""
+        CHECK(n >= 1, "empty query batch")
+        CHECK(
+            n <= self.max_rows,
+            f"query block of {n} rows exceeds max_rows={self.max_rows}; "
+            "split the request or raise TableServer(max_rows=...)",
+        )
+        return max(self.min_bucket, _next_pow2(n))
+
+    def _jit(self, key: Tuple, build):
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            fn = build()
+            self._jit_cache[key] = fn
+        return fn
+
+    def _lookup_fn(self):
+        def build():
+            out = mesh_lib.replicated_sharding(self.mesh)
+
+            def run(table, ids):
+                return table[ids]
+
+            return jax.jit(run, out_shardings=out)
+
+        return self._jit(("lookup",), build)
+
+    def _topk_fn(self, k: int):
+        def build():
+            out = mesh_lib.replicated_sharding(self.mesh)
+
+            def run(table_n, queries):
+                qn = queries / jnp.maximum(
+                    jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-12
+                )
+                sims = qn @ table_n.T  # row-sharded contraction
+                scores, idx = jax.lax.top_k(sims, k)
+                return idx, scores
+
+            return jax.jit(run, out_shardings=(out, out))
+
+        return self._jit(("topk", k), build)
+
+    def _normalized(self, snap: ServingSnapshot, name: str) -> jax.Array:
+        """Per-snapshot row-normalised table (computed once per version,
+        keeps the table's row sharding; dies with the snapshot)."""
+
+        def run(t):
+            t = t.astype(jnp.float32)
+            return t / jnp.maximum(
+                jnp.linalg.norm(t, axis=1, keepdims=True), 1e-12
+            )
+
+        fn = self._jit(("normalize",), lambda: jax.jit(run))
+        return snap.derived(
+            f"normalized:{name}", lambda: fn(self._table(snap, name))
+        )
+
+    def _predict_fn(self):
+        def build():
+            out = mesh_lib.replicated_sharding(self.mesh)
+
+            def run(W, X):
+                return jax.nn.sigmoid(X.astype(jnp.float32) @ W.T.astype(jnp.float32))
+
+            return jax.jit(run, out_shardings=out)
+
+        return self._jit(("predict",), build)
+
+    def _pad_batch(self, arr: np.ndarray, bucket: int) -> np.ndarray:
+        pad = bucket - arr.shape[0]
+        if pad == 0:
+            return arr
+        return np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
+
+    def _table(self, snap: ServingSnapshot, name: str) -> jax.Array:
+        arr = snap.arrays.get(name)
+        CHECK(arr is not None, f"no table {name!r} in snapshot "
+              f"(have: {snap.names()})")
+        return arr
+
+    # ------------------------------------------------------------ direct API
+    # Each method reads self._snapshot exactly ONCE — the torn-read
+    # guarantee. `snap=` lets the batched flusher pin one snapshot for a
+    # whole multi-request batch.
+
+    def lookup(self, name: str, ids, snap: Optional[ServingSnapshot] = None
+               ) -> np.ndarray:
+        """Row gather: ids (n,) -> rows (n, D)."""
+        snap = snap or self.snapshot
+        table = self._table(snap, name)
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        CHECK(ids.size >= 1, "empty lookup request")
+        CHECK(
+            int(ids.min()) >= 0 and int(ids.max()) < table.shape[0],
+            f"lookup ids out of range for table {name!r} ({table.shape[0]} rows)",
+        )
+        n = ids.shape[0]
+        bucket = self._bucket(n)
+        padded = self._pad_batch(ids, bucket)
+        placed = jax.device_put(
+            padded, mesh_lib.query_sharding(self.mesh, 1, bucket)
+        )
+        return np.asarray(self._lookup_fn()(table, placed))[:n]
+
+    def topk(self, name: str, queries, k: int = 10,
+             snap: Optional[ServingSnapshot] = None
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """Cosine top-k: queries (n, D) -> (ids (n, k), scores (n, k)).
+
+        Scoring protocol matches ``models/wordembedding/eval.py``
+        (cosine over unit-normalised rows — ``eval.cosine_topk`` is the
+        numpy golden the tests compare against)."""
+        snap = snap or self.snapshot
+        table_n = self._normalized(snap, name)
+        q = np.asarray(queries, np.float32)
+        CHECK(q.ndim == 2 and q.shape[0] >= 1
+              and q.shape[1] == table_n.shape[1],
+              f"queries shape {q.shape} does not match table dim "
+              f"{table_n.shape[1]}")
+        CHECK(1 <= k <= table_n.shape[0], f"k={k} out of range")
+        n = q.shape[0]
+        bucket = self._bucket(n)
+        padded = self._pad_batch(q, bucket)
+        placed = jax.device_put(
+            padded, mesh_lib.query_sharding(self.mesh, 2, bucket)
+        )
+        idx, scores = self._topk_fn(k)(table_n, placed)
+        return np.asarray(idx)[:n], np.asarray(scores)[:n]
+
+    def predict(self, name: str, X, snap: Optional[ServingSnapshot] = None
+                ) -> np.ndarray:
+        """Logreg predict: X (n, F) -> sigmoid(X @ W.T) (n, C)."""
+        snap = snap or self.snapshot
+        W = self._table(snap, name)
+        X = np.asarray(X, np.float32)
+        CHECK(X.ndim == 2 and X.shape[0] >= 1 and X.shape[1] == W.shape[1],
+              f"features shape {X.shape} does not match weights {W.shape}")
+        n = X.shape[0]
+        bucket = self._bucket(n)
+        padded = self._pad_batch(X, bucket)
+        placed = jax.device_put(
+            padded, mesh_lib.query_sharding(self.mesh, 2, bucket)
+        )
+        return np.asarray(self._predict_fn()(W, placed))[:n]
+
+    # ------------------------------------------------------------ batched API
+
+    # Per-request validation happens HERE, before the request can be
+    # co-batched: an invalid payload must fail its own future, never the
+    # whole micro-batch it would have ridden in (the in-flush CHECKs stay
+    # as a backstop, e.g. a hot-swap shrinking the table mid-flight).
+
+    def lookup_async(self, name: str, ids, block: bool = False):
+        """Enqueue a lookup through the dynamic batcher; returns a Future
+        of the (n, D) rows. Raises ``Overloaded`` when shedding."""
+        self._require_started()
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        table = self._table(self.snapshot, name)
+        CHECK(ids.size >= 1, "empty lookup request")
+        CHECK(
+            int(ids.min()) >= 0 and int(ids.max()) < table.shape[0],
+            f"lookup ids out of range for table {name!r} "
+            f"({table.shape[0]} rows)",
+        )
+        return self._batcher.submit(f"lookup:{name}", ids, block=block)
+
+    def topk_async(self, name: str, queries, k: int = 10, block: bool = False):
+        self._require_started()
+        q = np.asarray(queries, np.float32)
+        table = self._table(self.snapshot, name)
+        CHECK(
+            q.ndim == 2 and q.shape[0] >= 1 and q.shape[1] == table.shape[1],
+            f"queries shape {q.shape} does not match table {name!r} dim "
+            f"{table.shape[1]}",
+        )
+        CHECK(1 <= k <= table.shape[0], f"k={k} out of range")
+        return self._batcher.submit(f"topk:{name}:{int(k)}", q, block=block)
+
+    def predict_async(self, name: str, X, block: bool = False):
+        self._require_started()
+        X = np.asarray(X, np.float32)
+        W = self._table(self.snapshot, name)
+        CHECK(
+            X.ndim == 2 and X.shape[0] >= 1 and X.shape[1] == W.shape[1],
+            f"features shape {X.shape} does not match weights {W.shape}",
+        )
+        return self._batcher.submit(f"predict:{name}", X, block=block)
+
+    def _require_started(self) -> None:
+        CHECK(self._started, "TableServer.start() the batcher before *_async")
+
+    def _flush(self, route: str, payloads: List[np.ndarray]) -> List[Any]:
+        """Batcher flush: ONE padded-bucket program over the concatenated
+        micro-batch, results split back per request. The whole batch pins
+        a single snapshot reference — requests batched together always
+        answer from one weights version."""
+        snap = self.snapshot
+        kind, _, rest = route.partition(":")
+        sizes = [p.shape[0] for p in payloads]
+        flat = np.concatenate(payloads, axis=0)
+        bounds = np.cumsum(sizes)[:-1]
+        if kind == "lookup":
+            rows = self.lookup(rest, flat, snap=snap)
+            return [r for r in np.split(rows, bounds)]
+        if kind == "topk":
+            name, _, kstr = rest.rpartition(":")
+            idx, scores = self.topk(name, flat, k=int(kstr), snap=snap)
+            return list(zip(np.split(idx, bounds), np.split(scores, bounds)))
+        if kind == "predict":
+            probs = self.predict(rest, flat, snap=snap)
+            return [p for p in np.split(probs, bounds)]
+        raise ValueError(f"unknown route {route!r}")

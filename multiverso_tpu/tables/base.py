@@ -339,6 +339,38 @@ class DenseTable:
             self.storage, self.state, deltas, option.scalars()
         )
 
+    # ----------------------------------------------------------- serving
+
+    def snapshot_array(self) -> jax.Array:
+        """Read-only serving snapshot: the logical rows (padding stripped,
+        updater access transform applied) as a FRESH device buffer.
+
+        Donation-safety is the point: ``add``/``add_per_worker`` donate
+        the live ``storage`` buffer (``donate_argnums``), which
+        invalidates any alias of it — so a server must never hold the raw
+        ``self.storage`` reference across training steps. This jitted
+        copy's output is a distinct buffer (no donation on this program),
+        safe to publish into a ``TableServer`` and to keep serving from
+        while training keeps committing. Keeps the table's row sharding
+        when the logical row count splits evenly over the shard axis,
+        else replicates (uneven logical rows — the padded physical rows
+        are what shard evenly)."""
+        fn = self._compiled.get("snapshot")
+        if fn is None:
+            n = self.shape[0]
+            access = self.updater.access
+            if n % self.num_shards == 0:
+                out = mesh_lib.table_sharding(self.mesh, len(self._pshape))
+            else:
+                out = self._replicated
+
+            def run(storage):
+                return access(storage)[:n]
+
+            fn = jax.jit(run, out_shardings=out)
+            self._compiled["snapshot"] = fn
+        return fn(self.storage)
+
     # ----------------------------------------------------------- waiting
 
     def wait(self) -> None:

@@ -38,6 +38,7 @@ from multiverso_tpu.native.kv_index import KVIndex
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.tables.base import TableOption, register_table_type
+from multiverso_tpu.utils import next_pow2 as _next_pow2  # shared rounding rule
 from multiverso_tpu.utils.log import CHECK
 
 __all__ = ["KVTableOption", "KVTable"]
@@ -53,13 +54,6 @@ class KVTableOption(TableOption):
     # retain one host entry per distinct key ever fetched.
     cache_local: bool = True
     name: str = "kv_table"
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
 
 
 @register_table_type(KVTableOption)

@@ -6,7 +6,10 @@ the TPU runtime makes obsolete are intentionally absent:
 
 * ``MtQueue`` / ``Waiter`` / actor mailboxes — JAX's async dispatch already
   gives every table op a future-like handle (``jax.Array`` +
-  ``block_until_ready``); there is no actor thread pool to feed.
+  ``block_until_ready``); there is no actor thread pool to feed. (The
+  native ``MtQueue`` rebuild lives in ``native/host_runtime.py`` for the
+  places that DO want a real blocking queue: the training prefetch
+  pipeline and the serving batcher's ticket ring.)
 * ``Allocator`` / ``Blob`` — buffers live in HBM and are managed by the XLA
   runtime allocator; host-side staging uses numpy.
 * ``net_util`` — no sockets; the mesh fabric is ICI/DCN owned by XLA.
@@ -24,6 +27,15 @@ from multiverso_tpu.utils.configure import (
 from multiverso_tpu.utils.dashboard import Dashboard, Monitor, monitor
 from multiverso_tpu.utils.log import CHECK, CHECK_NOTNULL, FatalError, Log, LogLevel, Logger
 from multiverso_tpu.utils.timer import Timer
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1). ONE definition: KV-table
+    growth and the serving padded-bucket rule both round with this."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
 
 __all__ = [
     "MV_DEFINE_bool",
@@ -43,4 +55,5 @@ __all__ = [
     "LogLevel",
     "Logger",
     "Timer",
+    "next_pow2",
 ]

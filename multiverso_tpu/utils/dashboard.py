@@ -46,10 +46,16 @@ class Monitor:
 
 
 class Dashboard:
-    """Static name -> Monitor registry (ref: dashboard.h:16-40)."""
+    """Static name -> Monitor registry (ref: dashboard.h:16-40).
+
+    Extension: ``add_section(name, fn)`` registers a callable returning
+    extra display lines — the serving subsystem plugs its histogram /
+    QPS / shed report in through this, so ``Display()`` stays the one
+    process-wide dump."""
 
     _lock = threading.Lock()
     _monitors: Dict[str, Monitor] = {}
+    _sections: Dict[str, object] = {}  # name -> () -> List[str]
 
     @classmethod
     def get(cls, name: str) -> Monitor:
@@ -61,9 +67,22 @@ class Dashboard:
             return mon
 
     @classmethod
+    def add_section(cls, name: str, fn) -> None:
+        with cls._lock:
+            cls._sections[name] = fn
+
+    @classmethod
+    def remove_section(cls, name: str) -> None:
+        with cls._lock:
+            cls._sections.pop(name, None)
+
+    @classmethod
     def Display(cls) -> str:
         with cls._lock:
             lines = [m.info_string() for m in cls._monitors.values()]
+            sections = list(cls._sections.values())
+        for fn in sections:  # outside the lock: sections take their own
+            lines.extend(fn())
         out = "\n".join(lines)
         if out:
             print(out, flush=True)
@@ -73,6 +92,7 @@ class Dashboard:
     def Reset(cls) -> None:
         with cls._lock:
             cls._monitors.clear()
+            cls._sections.clear()
 
 
 @contextmanager

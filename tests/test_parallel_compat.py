@@ -1,0 +1,74 @@
+"""parallel/compat.py: the shard_map API-drift resolver.
+
+The seed pinned `jax.shard_map` (modern) and failed wholesale on the
+installed legacy JAX (41 tier-1 failures); every call site now routes
+through the compat shim, which must work on BOTH APIs — these tests run
+against whichever the container ships.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from multiverso_tpu.parallel import compat
+from multiverso_tpu.parallel import mesh as mesh_lib
+
+
+def _mesh():
+    return mesh_lib.build_mesh()
+
+
+def test_resolver_picked_an_implementation():
+    # the probe is static; whichever branch, shard_map must be callable
+    assert callable(compat.shard_map)
+    assert isinstance(compat.HAS_NATIVE_SHARD_MAP, bool)
+    if compat.HAS_NATIVE_SHARD_MAP:
+        assert getattr(jax, "shard_map", None) is not None
+    else:
+        from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def test_shard_map_psum_body_runs():
+    mesh = _mesh()
+    n = mesh_lib.num_workers(mesh)
+
+    def body(x):
+        return jax.lax.psum(x, mesh_lib.WORKER_AXIS)
+
+    fn = compat.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(mesh_lib.WORKER_AXIS),),
+        out_specs=P(mesh_lib.WORKER_AXIS),
+    )
+    x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
+    out = np.asarray(fn(x))
+    assert np.allclose(out, x.sum())
+
+
+def test_shard_map_check_vma_kwarg_accepted_both_ways():
+    mesh = _mesh()
+
+    def body(x):
+        return x * 2.0
+
+    for check in (True, False, None):
+        fn = compat.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(mesh_lib.WORKER_AXIS),),
+            out_specs=P(mesh_lib.WORKER_AXIS),
+            check_vma=check,
+        )
+        n = mesh_lib.num_workers(mesh)
+        out = np.asarray(fn(jnp.ones((n, 2))))
+        assert np.allclose(out, 2.0)
+
+
+def test_shape_dtype_struct_vma_annotation_degrades():
+    plain = compat.shape_dtype_struct((2, 3), jnp.float32)
+    assert plain.shape == (2, 3) and plain.dtype == jnp.float32
+    ann = compat.shape_dtype_struct((2, 3), jnp.float32, vma=("worker",))
+    assert ann.shape == (2, 3)  # annotation kept or dropped, never a raise

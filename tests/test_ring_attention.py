@@ -64,10 +64,16 @@ def test_ring_grad_matches_dense():
     spec = P(None, "sp", None, None)
     local = partial(ring_attention_local, axis_name="sp", causal=True)
 
+    from multiverso_tpu.parallel.compat import shard_map
+
     @jax.jit
     def ring_loss(q, k, v):
-        out = jax.shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        # check_vma=True matches ring_attention._wrap's own call (compat
+        # degrades it to unchecked on legacy JAX, whose rep checker
+        # rejects the ring VJP's cond)
+        out = shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=True,
         )(q, k, v)
         return jnp.sum(out**2)
 

@@ -1,0 +1,523 @@
+"""Serving subsystem tests: batcher properties, query correctness,
+hot-swap atomicity, checkpoint -> serve round trip.
+
+All CPU tier-1 (the fake 8-device mesh from conftest): the batcher is
+pure host machinery; the query programs are ordinary jitted XLA programs
+that run identically on the CPU mesh and a real TPU mesh.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.serving import DynamicBatcher, Overloaded, TableServer
+from multiverso_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+
+
+# --------------------------------------------------------------- batcher
+
+
+def _echo_flush(route, payloads):
+    return [(route, p) for p in payloads]
+
+
+def test_batcher_size_trigger_flushes_full_batches():
+    sizes = []
+
+    def flush(route, payloads):
+        sizes.append(len(payloads))
+        return payloads
+
+    b = DynamicBatcher(flush, max_batch=8, max_delay_s=10.0, max_depth=64).start()
+    try:
+        futs = [b.submit("r", i) for i in range(16)]
+        for i, f in enumerate(futs):
+            assert f.result(timeout=5) == i
+        # a 10s deadline can't have fired: both flushes were size-triggered
+        assert sizes == [8, 8]
+    finally:
+        b.close()
+
+
+def test_batcher_deadline_trigger_flushes_partial_batch():
+    b = DynamicBatcher(
+        _echo_flush, max_batch=1000, max_delay_s=0.02, max_depth=1000
+    ).start()
+    try:
+        t0 = time.monotonic()
+        f = b.submit("r", 42)
+        assert f.result(timeout=5) == ("r", 42)
+        waited = time.monotonic() - t0
+        # flushed by the deadline, far below a size trigger (1 << 1000)
+        assert waited < 5.0
+        assert b.metrics.batches == 1
+        assert b.metrics.batch_fill() < 0.01  # 1/1000 — a partial batch
+    finally:
+        b.close()
+
+
+def test_batcher_deadline_vs_size_property():
+    """Property sweep: for random (max_batch, burst) shapes every request
+    completes, and no flushed batch ever exceeds max_batch."""
+    rng = np.random.RandomState(7)
+    for _ in range(5):
+        max_batch = int(rng.randint(2, 17))
+        burst = int(rng.randint(1, 64))
+        seen = []
+
+        def flush(route, payloads):
+            seen.append(len(payloads))
+            return payloads
+
+        b = DynamicBatcher(
+            flush, max_batch=max_batch, max_delay_s=0.005, max_depth=256
+        ).start()
+        try:
+            futs = [b.submit("r", i) for i in range(burst)]
+            got = [f.result(timeout=10) for f in futs]
+            assert got == list(range(burst))
+            assert all(s <= max_batch for s in seen), (max_batch, seen)
+            assert sum(seen) == burst
+        finally:
+            b.close()
+
+
+def test_batcher_sheds_with_retry_after_when_full():
+    release = threading.Event()
+
+    def slow_flush(route, payloads):
+        release.wait(timeout=10)
+        return payloads
+
+    b = DynamicBatcher(
+        slow_flush, max_batch=4, max_delay_s=0.001, max_depth=4
+    ).start()
+    try:
+        # fill the ticket ring; the flusher blocks inside slow_flush
+        futs = [b.submit("r", i) for i in range(4)]
+        time.sleep(0.05)  # let the flusher claim the batch and block
+        # ring may have been recycled by the claimed batch: fill it again
+        extra = []
+        shed = None
+        for i in range(16):
+            try:
+                extra.append(b.submit("r", 100 + i))
+            except Overloaded as e:
+                shed = e
+                break
+        assert shed is not None, "queue never overloaded"
+        assert shed.retry_after_s > 0
+        assert b.metrics.shed >= 1
+        release.set()
+        for f in futs + extra:
+            f.result(timeout=10)
+    finally:
+        release.set()
+        b.close()
+
+
+def test_batcher_backpressure_blocks_instead_of_shedding():
+    in_flush = threading.Event()
+    release = threading.Event()
+
+    def slow_flush(route, payloads):
+        in_flush.set()
+        release.wait(timeout=10)
+        return payloads
+
+    b = DynamicBatcher(
+        slow_flush, max_batch=2, max_delay_s=0.001, max_depth=2
+    ).start()
+    try:
+        futs = [b.submit("r", i) for i in range(2)]
+        assert in_flush.wait(timeout=5)
+        state = {"submitted": False}
+
+        def producer():
+            # block=True: waits for a free ticket, never raises Overloaded
+            f = b.submit("r", 99, block=True)
+            state["submitted"] = True
+            state["future"] = f
+
+        th = threading.Thread(target=producer, daemon=True)
+        th.start()
+        release.set()
+        th.join(timeout=10)
+        assert state["submitted"], "backpressured submit never unblocked"
+        for f in futs + [state["future"]]:
+            f.result(timeout=10)
+        assert b.metrics.shed == 0
+    finally:
+        release.set()
+        b.close()
+
+
+def test_batcher_quiet_route_deadline_survives_busy_route():
+    """A steady stream on one route must not starve another route's
+    deadline: the flusher's sweep runs every iteration, not only on pop
+    timeout (regression: the quiet route used to wait for a gap in the
+    busy route's traffic)."""
+    b = DynamicBatcher(
+        _echo_flush, max_batch=4096, max_delay_s=0.02, max_depth=4096
+    ).start()
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            try:
+                b.submit("busy", 0)
+            except Overloaded:
+                pass
+            time.sleep(0.0002)  # steady trickle: pop() keeps seeing tickets
+
+    th = threading.Thread(target=busy, daemon=True)
+    th.start()
+    try:
+        time.sleep(0.05)  # busy stream established
+        t0 = time.monotonic()
+        f = b.submit("quiet", 7)
+        assert f.result(timeout=5) == ("quiet", 7)
+        waited = time.monotonic() - t0
+        # deadline is 20ms; generous 10x bound still catches starvation
+        assert waited < 0.2, f"quiet route starved: {waited:.3f}s"
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        b.close()
+
+
+def test_batcher_flush_error_fails_that_batch_only():
+    def flaky(route, payloads):
+        if any(p < 0 for p in payloads):
+            raise ValueError("bad payload")
+        return payloads
+
+    b = DynamicBatcher(flaky, max_batch=4, max_delay_s=0.002, max_depth=64).start()
+    try:
+        bad = b.submit("r", -1)
+        with pytest.raises(ValueError):
+            bad.result(timeout=5)
+        ok = b.submit("r", 5)
+        assert ok.result(timeout=5) == 5  # flusher survived the bad batch
+    finally:
+        b.close()
+
+
+# --------------------------------------------------------------- metrics
+
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for ms in range(1, 101):  # 1..100ms uniform
+        h.record(ms * 1e-3)
+    p50 = h.percentile(50)
+    p99 = h.percentile(99)
+    assert 0.035 <= p50 <= 0.075, p50  # log-bucket resolution ~14%
+    assert 0.080 <= p99 <= 0.130, p99
+    assert h.count == 100
+    assert abs(h.mean_s - 0.0505) < 0.002
+
+
+def test_serving_metrics_report_and_dashboard_section():
+    from multiverso_tpu.utils.dashboard import Dashboard
+
+    m = ServingMetrics("testsrv")
+    m.record_batch("lookup", 8, 16, [0.001] * 8)
+    m.record_shed()
+    m.register_dashboard()
+    try:
+        out = Dashboard.Display()
+        assert "Serving:testsrv" in out
+        r = m.report()
+        assert r["served"] == 8 and r["shed"] == 1
+        assert r["batch_fill"] == 0.5
+        assert r["lookup_p99_ms"] > 0
+    finally:
+        m.unregister_dashboard()
+    assert "Serving:testsrv" not in Dashboard.Display()
+
+
+# --------------------------------------------------------------- server
+
+
+@pytest.fixture
+def server(mv_env):
+    rng = np.random.RandomState(0)
+    emb = rng.randn(48, 16).astype(np.float32)
+    W = rng.randn(2, 16).astype(np.float32)
+    srv = TableServer(
+        {"emb": emb, "w": W}, max_batch=16, max_delay_s=0.002
+    ).start()
+    yield srv, emb, W
+    srv.stop()
+
+
+def test_lookup_matches_direct_rows(server):
+    srv, emb, _ = server
+    ids = np.array([0, 7, 7, 47, 1])
+    assert np.allclose(srv.lookup("emb", ids), emb[ids])
+    # non-pow2 sizes exercise bucket padding
+    for n in (1, 3, 9, 17):
+        ids = np.arange(n) % 48
+        assert np.allclose(srv.lookup("emb", ids), emb[ids])
+
+
+def test_topk_matches_eval_scoring(server):
+    """The serving top-k must agree with the eval module's scoring (the
+    shared-protocol contract named in serving/server.py)."""
+    from multiverso_tpu.models.wordembedding.eval import cosine_topk
+
+    srv, emb, _ = server
+    q = emb[[3, 11, 30]] + 0.01
+    idx, scores = srv.topk("emb", q, k=7)
+    gidx, gscores = cosine_topk(emb, q, 7)
+    assert (idx == gidx).all()
+    assert np.allclose(scores, gscores, atol=1e-5)
+
+
+def test_predict_matches_sigmoid(server):
+    srv, emb, W = server
+    X = emb[:5]
+    got = srv.predict("w", X)
+    want = 1.0 / (1.0 + np.exp(-(X @ W.T)))
+    assert got.shape == (5, 2)
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_batched_routes_roundtrip(server):
+    srv, emb, W = server
+    lf = [srv.lookup_async("emb", [i, (i * 3) % 48]) for i in range(40)]
+    tf = srv.topk_async("emb", emb[:2], k=3)
+    pf = srv.predict_async("w", emb[:4])
+    for i, f in enumerate(lf):
+        assert np.allclose(f.result(timeout=10), emb[[i, (i * 3) % 48]])
+    idx, scores = tf.result(timeout=10)
+    assert idx.shape == (2, 3) and scores.shape == (2, 3)
+    assert pf.result(timeout=10).shape == (4, 2)
+    assert srv.metrics.served >= 42  # 40 lookups + 1 topk + 1 predict
+    assert srv.metrics.shed == 0
+
+
+def test_lookup_rejects_out_of_range(server):
+    from multiverso_tpu.utils.log import FatalError
+
+    srv, _, _ = server
+    with pytest.raises((FatalError, AssertionError, ValueError)):
+        srv.lookup("emb", [48])
+
+
+def test_invalid_async_request_fails_alone(server):
+    """Per-request validation happens at submit: a bad request must never
+    poison the micro-batch it would have been co-batched with."""
+    from multiverso_tpu.utils.log import FatalError
+
+    srv, emb, _ = server
+    good = srv.lookup_async("emb", [1, 2])
+    with pytest.raises((FatalError, AssertionError)):
+        srv.lookup_async("emb", [48])  # out of range: rejected at submit
+    with pytest.raises((FatalError, AssertionError)):
+        srv.topk_async("emb", emb[0], k=3)  # 1-D query: rejected at submit
+    assert np.allclose(good.result(timeout=10), emb[[1, 2]])
+
+
+def test_hot_swap_versions_and_results(server):
+    srv, emb, W = server
+    v1 = srv.version
+    srv.publish({"emb": emb * 3.0, "w": W})
+    assert srv.version == v1 + 1
+    assert np.allclose(srv.lookup("emb", [5]), emb[[5]] * 3.0)
+    # topk's per-snapshot normalized cache must rebuild for the new version
+    idx, _ = srv.topk("emb", emb[:1], k=2)
+    from multiverso_tpu.models.wordembedding.eval import cosine_topk
+
+    assert (idx == cosine_topk(emb * 3.0, emb[:1], 2)[0]).all()
+
+
+def test_hot_swap_atomicity_no_torn_reads(server):
+    """Queries racing a rapid swapper must each see exactly ONE version:
+    every returned row set must be a scalar multiple (the version scale)
+    of the base rows, identical across the whole response."""
+    srv, emb, _ = server
+    scales = {}
+    stop = threading.Event()
+    swaps = [0]
+
+    def swapper():
+        s = 1.0
+        while not stop.is_set():
+            s += 1.0
+            scales[float(s)] = True
+            srv.publish({"emb": emb * s})
+            swaps[0] += 1
+        # not time-based: keep swapping until the reader says enough
+
+    th = threading.Thread(target=swapper, daemon=True)
+    th.start()
+    try:
+        torn = 0
+        checked = 0
+        ids = np.array([1, 9, 17, 33, 41])
+        base = emb[ids]
+        while swaps[0] < 25:  # overlap with at least 25 swaps
+            rows = srv.lookup("emb", ids)
+            ratio = rows / base
+            # one scale for the WHOLE response, and a published one
+            s0 = float(np.round(ratio.flat[0], 6))
+            if not np.allclose(ratio, s0, atol=1e-5):
+                torn += 1
+            checked += 1
+        assert torn == 0, f"{torn}/{checked} torn responses"
+        assert checked > 0
+    finally:
+        stop.set()
+        th.join(timeout=10)
+
+
+def test_publish_from_tables_is_donation_safe(mv_env):
+    """Serve from live training tables: snapshot copies must survive the
+    table's subsequent donated add steps."""
+    from multiverso_tpu.tables import MatrixTableOption
+
+    t = mv_env.MV_CreateTable(MatrixTableOption(num_row=24, num_col=8))
+    w0 = np.arange(24 * 8, dtype=np.float32).reshape(24, 8)
+    t.add(w0)
+    t.wait()
+    srv = TableServer(register_runtime=True)
+    try:
+        srv.publish_from_tables({"emb": t})
+        # train on: donated adds invalidate the table's old storage buffer
+        for _ in range(3):
+            t.add(np.ones((24, 8), np.float32))
+        t.wait()
+        assert np.allclose(srv.lookup("emb", np.arange(24)), w0)
+        srv.publish_from_tables({"emb": t})
+        assert np.allclose(srv.lookup("emb", np.arange(24)), w0 + 3.0)
+    finally:
+        srv.stop()
+
+
+def test_runtime_shutdown_stops_attached_servers(mv_env):
+    srv = TableServer({"emb": np.eye(8, dtype=np.float32)})
+    assert srv in mv_env.runtime().servers if hasattr(mv_env, "runtime") else True
+    from multiverso_tpu.runtime import runtime
+
+    assert srv in runtime().servers
+    mv_env.MV_ShutDown(finalize=False)
+    assert srv not in runtime().servers
+    with pytest.raises(Exception):
+        srv._batcher.submit("lookup:emb", np.array([0]))  # closed
+
+
+def test_restore_strips_shard_padding(mv_env, tmp_path):
+    """A table whose logical rows don't divide the shard count stores
+    PHYSICAL padded storage in the checkpoint; serving it must crop back
+    to logical rows — phantom zero rows would win top-k at negative
+    cosine and let out-of-range lookups pass (regression)."""
+    from multiverso_tpu.io.checkpoint import save_tables
+    from multiverso_tpu.tables import MatrixTableOption
+    from multiverso_tpu.utils.log import FatalError
+
+    rows = 10  # 8-shard mesh pads physical storage to 16
+    t = mv_env.MV_CreateTable(MatrixTableOption(num_row=rows, num_col=4))
+    w = np.random.RandomState(0).randn(rows, 4).astype(np.float32)
+    t.add(w)
+    t.wait()
+    ckpt = str(tmp_path / "padded")
+    save_tables(ckpt)
+    srv = TableServer()
+    try:
+        srv.restore(ckpt, names=["emb"])
+        assert srv.snapshot.arrays["emb"].shape == (rows, 4)
+        with pytest.raises((FatalError, AssertionError)):
+            srv.lookup("emb", [12])  # physical-only row must be invisible
+        # a query anti-aligned with every row: all true scores negative;
+        # zero padding rows (cosine 0) would outrank them if served
+        q = -w.sum(axis=0, keepdims=True)
+        idx, _ = srv.topk("emb", q, k=4)
+        assert idx.max() < rows, f"phantom padding id served: {idx}"
+    finally:
+        srv.stop()
+
+
+def test_restore_names_bind_in_table_id_order(mv_env, tmp_path):
+    """restore(names=...) must bind by NUMERIC table id: lexicographic
+    order puts table_10 before table_2 and would silently serve the
+    wrong weights (regression)."""
+    from multiverso_tpu.io.checkpoint import save_tables
+    from multiverso_tpu.tables import MatrixTableOption
+
+    n_tables = 11  # > 10 forces the table_10-vs-table_2 lexicographic trap
+    tables = []
+    for i in range(n_tables):
+        t = mv_env.MV_CreateTable(MatrixTableOption(num_row=8, num_col=2))
+        t.add(np.full((8, 2), float(i + 1), np.float32))
+        t.wait()
+        tables.append(t)
+    ckpt = str(tmp_path / "many")
+    save_tables(ckpt)
+    srv = TableServer()
+    try:
+        names = [f"t{i}" for i in range(n_tables)]
+        srv.restore(ckpt, names=names)
+        for i, name in enumerate(names):
+            rows = srv.lookup(name, [0])
+            assert np.allclose(rows, i + 1), (name, rows[0, 0])
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------- checkpoint round trip
+
+
+def test_checkpoint_to_serve_roundtrip(mv_env, tmp_path):
+    """Train a tiny skip-gram model against live tables, checkpoint via
+    io/checkpoint, restore into a TableServer, and assert every route
+    answers from exactly the checkpointed weights."""
+    import jax.numpy as jnp
+
+    from multiverso_tpu.io.checkpoint import save_tables
+    from multiverso_tpu.models.wordembedding import skipgram as sg
+    from multiverso_tpu.models.wordembedding.eval import cosine_topk
+    from multiverso_tpu.tables import MatrixTableOption
+
+    cfg = sg.SkipGramConfig(vocab_size=32, dim=8, negatives=2, seed=3)
+    params = sg.init_params(cfg)
+    step = sg.make_train_step(cfg)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        centers = rng.randint(0, 32, size=16)
+        outputs = rng.randint(0, 32, size=(16, 3))
+        params, _ = step(
+            params, jnp.asarray(centers), jnp.asarray(outputs), None, 0.1
+        )
+    emb_in = np.asarray(params["emb_in"])
+    emb_out = np.asarray(params["emb_out"])
+
+    t_in = mv_env.MV_CreateTable(MatrixTableOption(num_row=32, num_col=8))
+    t_out = mv_env.MV_CreateTable(MatrixTableOption(num_row=32, num_col=8))
+    t_in.add(emb_in)
+    t_out.add(emb_out)
+    t_in.wait()
+    t_out.wait()
+    ckpt = str(tmp_path / "serve_ckpt")
+    save_tables(ckpt)
+
+    srv = TableServer(max_batch=8, max_delay_s=0.001)
+    try:
+        srv.restore(ckpt, names=["emb_in", "emb_out"])
+        # lookup == direct table reads
+        ids = np.arange(32)
+        assert np.allclose(srv.lookup("emb_in", ids), t_in.get(), atol=1e-6)
+        assert np.allclose(srv.lookup("emb_out", ids), t_out.get(), atol=1e-6)
+        # topk over the restored table matches eval on the live table
+        q = emb_in[[0, 5]]
+        idx, _ = srv.topk("emb_in", q, k=4)
+        assert (idx == cosine_topk(t_in.get(), q, 4)[0]).all()
+        # and through the batcher
+        srv.start()
+        f = srv.lookup_async("emb_in", [3, 4])
+        assert np.allclose(f.result(timeout=10), emb_in[[3, 4]], atol=1e-6)
+    finally:
+        srv.stop()
