@@ -1061,6 +1061,64 @@ def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
     return batch * steps / dt
 
 
+def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
+                      period_steps=50, reps=3):
+    """Resilience leg: what fault tolerance costs.
+
+    * checkpoint publish latency (atomic save of app-sized params: two
+      (V, D) tables + one optimizer slot, manifest-sealed) and payload
+      bytes;
+    * time-to-resume: latest_valid discovery + verified load back to host
+      arrays (excludes jit re-compile, which the persistent compilation
+      cache already amortizes — runtime.py);
+    * overhead as % of step time at a checkpoint-every-``period_steps``
+      policy, from the measured fused step rate (the SYNC bound; the
+      async checkpointer hides the file write, paying only the
+      device_get snapshot).
+    """
+    import shutil
+    import tempfile
+
+    from multiverso_tpu.resilience import (
+        latest_valid,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    rng = np.random.RandomState(0)
+    arrays = {
+        "emb_in": rng.randn(cfg.vocab_size, cfg.dim).astype(np.float32),
+        "emb_out": rng.randn(cfg.vocab_size, cfg.dim).astype(np.float32),
+        "g2_in": np.ones((cfg.vocab_size, cfg.dim), np.float32),
+    }
+    nbytes = sum(a.nbytes for a in arrays.values())
+    root = tempfile.mkdtemp(prefix="mv_resilience_bench_")
+    try:
+        save_s, resume_s = [], []
+        for i in range(reps):
+            t0 = time.perf_counter()
+            save_checkpoint(root, i + 1, arrays=arrays,
+                            meta={"step": i + 1, "pairs_done": 0})
+            save_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            path = latest_valid(root)
+            restored, _meta = load_checkpoint(path)
+            resume_s.append(time.perf_counter() - t0)
+            assert restored["emb_in"].shape == (cfg.vocab_size, cfg.dim)
+        best_save, best_resume = min(save_s), min(resume_s)
+        step_s = (batch * scan_steps) / max(fused_pairs_per_sec, 1e-9)
+        overhead_pct = 100.0 * best_save / (best_save + period_steps * step_s)
+        return {
+            "resilience_ckpt_save_ms": round(best_save * 1e3, 1),
+            "resilience_ckpt_mb": round(nbytes / 1e6, 1),
+            "resilience_time_to_resume_ms": round(best_resume * 1e3, 1),
+            f"resilience_ckpt_overhead_pct_every_{period_steps}_steps":
+                round(overhead_pct, 2),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _bench_serving(cfg, queries=4000, clients=4, topk_every=8,
                    deadlines_ms=(0.5, 2.0, 8.0)):
     """Serving leg: QPS and p99 latency vs batch deadline through the
@@ -1252,6 +1310,13 @@ def main():
     except Exception as e:
         print(f"# leg serving FAILED: {e}", file=_sys.stderr, flush=True)
         serving = {"serving_error": str(e)[:200]}
+    try:
+        resilience = leg(
+            "resilience", lambda: _bench_resilience(cfg, fused)
+        )
+    except Exception as e:
+        print(f"# leg resilience FAILED: {e}", file=_sys.stderr, flush=True)
+        resilience = {"resilience_error": str(e)[:200]}
     e2e = leg("e2e", _bench_e2e)
     quality = leg("quality", _bench_quality)
     out = {
@@ -1280,6 +1345,7 @@ def main():
     out.update(bigvocab)
     out.update(ring)
     out.update(serving)
+    out.update(resilience)
     out.update(e2e)
     out.update(quality)
     print(json.dumps(out))

@@ -38,6 +38,42 @@ echo "== serving smoke e2e (train tiny -> hot-swap -> serve) =="
 JAX_PLATFORMS=cpu python examples/serving_demo.py \
     --queries 2000 --assert-clean
 
+echo "== crash-recovery smoke (chaos kill -> elastic resume) =="
+# fault-tolerance end to end with a REAL process death: the WordEmbedding
+# CLI is chaos-killed (os._exit 137) mid-run with crash-consistent
+# checkpointing on, then relaunched with the same argv — the relaunch must
+# resume from the latest valid checkpoint (step/loss continuity is the
+# logged "resumed from" line) and finish cleanly
+CKROOT=$(mktemp -d)
+trap 'rm -rf "$CKROOT"' EXIT
+JAX_PLATFORMS=cpu python - "$CKROOT" <<'EOF'
+import sys
+import numpy as np
+rng = np.random.RandomState(5)
+p = rng.randint(0, 30, 400) * 2
+with open(sys.argv[1] + "/corpus.txt", "w") as fh:
+    for a, b in zip(p, p + 1):
+        fh.write(f"w{a} w{b}\n")
+EOF
+WE_ARGS=(-train_file="$CKROOT/corpus.txt" -size=16 -window=2 -negative=3
+         -batch_size=64 -steps_per_call=2 -epoch=2 -sample=0 -min_count=0
+         -threads=1 -is_pipeline=false -output_file="$CKROOT/emb.w2v"
+         -checkpoint_dir="$CKROOT/ck" -checkpoint_every_steps=3)
+set +e
+JAX_PLATFORMS=cpu python tests/crash_recovery_worker.py \
+    "${WE_ARGS[@]}" -chaos_kill_at_step=8 > "$CKROOT/kill.log" 2>&1
+rc=$?
+set -e
+if [ "$rc" -ne 137 ]; then
+    echo "expected chaos kill (exit 137), got rc=$rc"; tail -20 "$CKROOT/kill.log"; exit 1
+fi
+JAX_PLATFORMS=cpu python tests/crash_recovery_worker.py \
+    "${WE_ARGS[@]}" | tee "$CKROOT/resume.log" | tail -3
+grep -q "resumed from" "$CKROOT/resume.log" \
+    || { echo "relaunch did not resume from the checkpoint"; exit 1; }
+grep -q "WORKER_OK" "$CKROOT/resume.log" \
+    || { echo "resumed run did not finish cleanly"; exit 1; }
+
 echo "== multi-chip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

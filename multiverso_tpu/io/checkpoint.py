@@ -13,16 +13,29 @@ promotes checkpointing to a first-class subsystem:
 * ``save_tables``/``restore_tables`` (here) — orbax-backed sharded
   checkpoint of every registered table's storage + optimizer slots: each
   device writes its own HBM shard, restore re-shards onto the live mesh.
+
+**Crash consistency** (resilience subsystem): ``save_tables`` publishes
+atomically — the whole payload (orbax tree, ``logical_shapes.json``
+sidecar, KV npz dumps) lands in ``<dir>.tmp-<token>``, a fsynced
+``MANIFEST.json`` seals it with per-file size+crc32 checksums, and one
+rename makes it visible. A reader therefore never observes a torn
+directory; ``load_arrays``/``restore_tables`` verify the manifest first
+and die with ONE clear error naming the directory and the broken piece
+instead of an orbax stack trace.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
+import uuid
 from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from multiverso_tpu.resilience import checkpoint as rckpt
+from multiverso_tpu.resilience.chaos import with_retries
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.utils.log import Log
 
@@ -44,20 +57,69 @@ def _tree_of(tables: List[Any]) -> Dict[str, Any]:
     return tree
 
 
-def save_tables(directory: str, tables: Optional[List[Any]] = None) -> str:
-    """Write a sharded checkpoint of all (dense) registered tables. KV tables
-    save alongside as npz (their index is host metadata). Returns the path."""
+def _sync(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _shared_token() -> str:
+    """One tmp-dir token every process agrees on (multi-process saves write
+    shards into the SAME staging directory)."""
+    if jax.process_count() == 1:
+        return uuid.uuid4().hex[:8]
+    from jax.experimental import multihost_utils
+
+    tok = np.frombuffer(uuid.uuid4().bytes, np.uint8).copy()
+    tok = np.asarray(multihost_utils.broadcast_one_to_all(tok))
+    return bytes(tok.tolist()).hex()[:8]
+
+
+def save_tables(
+    directory: str,
+    tables: Optional[List[Any]] = None,
+    *,
+    step: Optional[int] = None,
+    meta: Optional[Dict] = None,
+) -> str:
+    """Write a crash-consistent sharded checkpoint of all (dense)
+    registered tables; KV tables save alongside as npz (their index is
+    host metadata). The directory appears atomically — write to
+    ``<dir>.tmp-<token>``, seal with a checksummed ``MANIFEST.json``
+    (carrying ``step``/``meta`` for elastic resume), rename. Returns the
+    path."""
     import orbax.checkpoint as ocp
 
     from multiverso_tpu.tables.kv_table import KVTable
 
     directory = os.path.abspath(directory)
-    os.makedirs(directory, exist_ok=True)
+    parent = os.path.dirname(directory)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{directory}.tmp-{_shared_token()}"
+    if jax.process_index() == 0 and os.path.exists(tmp):
+        shutil.rmtree(tmp)  # corpse of a crashed save with our token (rare)
+    _sync("mv_ckpt_stage")
+    os.makedirs(tmp, exist_ok=True)
     dense = _dense_tables(tables)
     if dense:  # orbax rejects an empty pytree (KV-only checkpoints)
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(directory, "tables"), _tree_of(dense), force=True)
-        ckptr.wait_until_finished()
+
+        def _write():
+            ckptr.save(os.path.join(tmp, "tables"), _tree_of(dense), force=True)
+            ckptr.wait_until_finished()
+
+        # transient-fs retry budget: a flaky NFS/gcsfuse write gets three
+        # tries; a real failure still propagates (and leaves only a tmp
+        # corpse — never a torn published checkpoint). SINGLE-process
+        # only: the orbax save is a collective in multi-process runs, and
+        # one rank retrying while its peers proceed to the sync points
+        # would desync the pod's barrier sequence — there, one attempt,
+        # fail loudly, relaunch the save collectively.
+        attempts = 3 if jax.process_count() == 1 else 1
+        with_retries(_write, attempts=attempts, base_delay_s=0.2,
+                     max_delay_s=2.0, describe=f"checkpoint table write {tmp}")
         if jax.process_index() == 0:
             # logical shapes ride alongside: the orbax tree stores the
             # PHYSICAL shard-padded storage (what restore_tables maps
@@ -65,17 +127,39 @@ def save_tables(directory: str, tables: Optional[List[Any]] = None) -> str:
             # must not see padding rows — load_arrays crops with this
             import json
 
-            meta = {
-                f"table_{t.table_id}": list(t.shape) for t in dense
-            }
-            with open(os.path.join(directory, "logical_shapes.json"), "w") as f:
-                json.dump(meta, f)
+            shapes = {f"table_{t.table_id}": list(t.shape) for t in dense}
+            with open(os.path.join(tmp, "logical_shapes.json"), "w") as f:
+                json.dump(shapes, f)
     all_tables = tables if tables is not None else runtime().tables
     for t in all_tables:
         if isinstance(t, KVTable):
-            t.store(os.path.join(directory, f"kv_{t.table_id}.npz"))
+            t.store(os.path.join(tmp, f"kv_{t.table_id}.npz"))
+    _sync("mv_ckpt_written")
+    if jax.process_index() == 0:
+        rckpt.commit_atomic(tmp, directory, step=step, meta=meta)
+    _sync("mv_ckpt_commit")
     Log.Info("checkpoint saved: %s (%d dense tables)", directory, len(dense))
     return directory
+
+
+def _check_readable(directory: str) -> None:
+    """Pre-flight: a manifest-sealed checkpoint must verify; a pre-manifest
+    (legacy) directory must at least contain the orbax tree. Either way a
+    bad directory dies HERE with one clear message, not inside orbax."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        Log.Fatal("checkpoint %s is incomplete or corrupt: not a directory",
+                  directory)
+    if os.path.exists(os.path.join(directory, rckpt.MANIFEST_NAME)):
+        rckpt.require_valid(directory)
+
+
+def _fatal_orbax(directory: str, what: str, exc: Exception) -> None:
+    Log.Fatal(
+        "checkpoint %s is incomplete or corrupt: %s (%s: %s)",
+        directory, what, type(exc).__name__,
+        str(exc).splitlines()[0] if str(exc) else "no detail",
+    )
 
 
 def load_arrays(directory: str) -> Dict[str, np.ndarray]:
@@ -91,7 +175,13 @@ def load_arrays(directory: str) -> Dict[str, np.ndarray]:
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
+    _check_readable(directory)
     path = os.path.join(directory, "tables")
+    if not os.path.isdir(path):
+        Log.Fatal(
+            "checkpoint %s is incomplete or corrupt: missing the 'tables' "
+            "orbax tree (dense-table payload)", directory,
+        )
     ckptr = ocp.PyTreeCheckpointer()
     # no abstract target tree (no live arrays to mirror): read the stored
     # STRUCTURE, then restore only each table's 'storage' leaf as plain
@@ -100,15 +190,18 @@ def load_arrays(directory: str) -> Dict[str, np.ndarray]:
     # the bytes just to drop them; plain-numpy also keeps the load
     # topology-independent (the orbax sharding-file path is explicitly
     # unsafe across topologies)
-    structure = ckptr.metadata(path)
-    item = {k: {"storage": v["storage"]} for k, v in structure.items()}
-    restore_args = {
-        k: {"storage": ocp.RestoreArgs(restore_type=np.ndarray)}
-        for k in structure
-    }
-    restored = ckptr.restore(
-        path, item=item, restore_args=restore_args, transforms={}
-    )
+    try:
+        structure = ckptr.metadata(path)
+        item = {k: {"storage": v["storage"]} for k, v in structure.items()}
+        restore_args = {
+            k: {"storage": ocp.RestoreArgs(restore_type=np.ndarray)}
+            for k in structure
+        }
+        restored = ckptr.restore(
+            path, item=item, restore_args=restore_args, transforms={}
+        )
+    except Exception as e:  # noqa: BLE001 — one clear error, not a stack dump
+        _fatal_orbax(directory, "failed to read the 'tables' orbax tree", e)
     # crop shard padding: the stored storage is physical (dim 0 padded up
     # to a shard multiple); serving phantom zero rows would corrupt top-k
     # (padding ids outscore real rows at negative cosine) and let
@@ -142,6 +235,7 @@ def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:
     from multiverso_tpu.tables.kv_table import KVTable
 
     directory = os.path.abspath(directory)
+    _check_readable(directory)
     dense = _dense_tables(tables)
     if dense:
         target = jax.tree.map(
@@ -149,7 +243,10 @@ def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:
             _tree_of(dense),
         )
         ckptr = ocp.StandardCheckpointer()
-        restored = ckptr.restore(os.path.join(directory, "tables"), target)
+        try:
+            restored = ckptr.restore(os.path.join(directory, "tables"), target)
+        except Exception as e:  # noqa: BLE001 — one clear error
+            _fatal_orbax(directory, "failed to restore the 'tables' orbax tree", e)
         for t in dense:
             entry = restored[f"table_{t.table_id}"]
             t.storage = entry["storage"]

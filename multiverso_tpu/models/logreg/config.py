@@ -50,6 +50,14 @@ class Configure:
     pipeline: bool = True
     sync_frequency: int = 1
 
+    # fault tolerance (resilience subsystem): crash-consistent training
+    # checkpoints + elastic resume. checkpoint_every_n counts dispatch
+    # groups (steps_per_call minibatches each); 0 disables auto-saves.
+    checkpoint_dir: str = ""
+    checkpoint_every_n: int = 0
+    checkpoint_retain: int = 3
+    resume: bool = True
+
     # max nonzero features per sparse sample (fixed TPU batch shape); samples
     # with more features are truncated with a logged warning
     max_sparse_features: int = 128

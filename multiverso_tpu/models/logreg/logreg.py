@@ -56,7 +56,84 @@ class LogReg:
                 total += len(g["y"])
             return loss_sum / len(group), total
 
-        for epoch in range(cfg.train_epoch):
+        # elastic resume (resilience subsystem): restore the model + lr
+        # schedule + data cursor from the latest valid checkpoint, replay
+        # the reader to the cursor, continue. Saves are synchronous (the
+        # model dump must see the exact post-step weights, and logreg
+        # models are small).
+        ck, start_epoch, resume_skip, gstep, restarts = (None, 0, 0, 0, 0)
+        if cfg.checkpoint_dir:
+            import os as _os
+
+            import jax
+
+            from multiverso_tpu.resilience import (
+                AutoCheckpointer,
+                latest_valid,
+                load_checkpoint,
+            )
+            from multiverso_tpu.resilience import stats as _rstats
+            from multiverso_tpu.utils.log import CHECK
+
+            CHECK(jax.process_count() == 1,
+                  "checkpoint_dir requires a single process (multi-process "
+                  "logreg checkpoints go through the PS tables)")
+            if cfg.resume:
+                path = latest_valid(cfg.checkpoint_dir)
+                if path is not None:
+                    _arrays, meta = load_checkpoint(path)
+                    self.model.load(_os.path.join(path, "model.bin"))
+                    if hasattr(self.model, "schedule"):
+                        self.model.schedule.count = int(meta.get("lr_count", 0))
+                    start_epoch = int(meta["epoch"])
+                    resume_skip = int(meta["batches_in_epoch"])
+                    gstep = int(meta["step"])
+                    restarts = int(meta.get("restarts", 0)) + 1
+                    _rstats.note_restart(restarts)
+                    Log.Info(
+                        "[LogReg] resumed from %s: step %d, epoch %d, "
+                        "batch %d, restart #%d",
+                        path, gstep, start_epoch, resume_skip, restarts,
+                    )
+            ck = AutoCheckpointer(
+                cfg.checkpoint_dir,
+                every_n_steps=cfg.checkpoint_every_n,
+                retain=cfg.checkpoint_retain,
+                async_=False,
+            )
+        from multiverso_tpu.resilience import chaos, save_checkpoint
+
+        def on_step(epoch, batches_in_epoch, n_flushed):
+            """Post-flush fault points: policy checkpoint, chaos kill."""
+            nonlocal gstep
+            gstep += 1
+            if ck is not None:
+                step, cursor = gstep, batches_in_epoch
+                lr_count = (
+                    int(self.model.schedule.count)
+                    if hasattr(self.model, "schedule") else 0
+                )
+                ck.maybe_save(
+                    step,
+                    lambda: lambda: save_checkpoint(
+                        ck.root, step,
+                        write_payload=lambda d: self.model.save(
+                            _join(d, "model.bin")
+                        ),
+                        meta={
+                            "epoch": epoch,
+                            "batches_in_epoch": cursor,
+                            "step": step,
+                            "lr_count": lr_count,
+                            "restarts": restarts,
+                        },
+                    ),
+                )
+            chaos.maybe_kill(gstep)
+
+        from os.path import join as _join
+
+        for epoch in range(start_epoch, cfg.train_epoch):
             timer = Timer()
             seen, since_log = 0, 0
             # loss stays a device value between log points (forcing it per
@@ -64,13 +141,24 @@ class LogReg:
             # accumulate sums and sync once per show_time_per_sample window
             ep_sum, ep_n, win_sum, win_n = 0.0, 0, 0.0, 0
             group: list = []
+            skip = resume_skip if epoch == start_epoch else 0
+            skipped = 0
+            batches_in_epoch = skip
 
             for batch in self.reader.async_batches(batch_size=cfg.minibatch_size):
+                if skipped < skip:
+                    # resume cursor: these minibatches were trained before
+                    # the crash; replay the (deterministic) reader past them
+                    skipped += 1
+                    continue
                 group.append(batch)
                 if len(group) < S:
                     continue
+                n_flushed = len(group)
                 loss, n_in_group = flush(group)
                 group = []
+                batches_in_epoch += n_flushed
+                on_step(epoch, batches_in_epoch, n_flushed)
                 win_sum = win_sum + loss
                 win_n += 1
                 seen += n_in_group
@@ -86,7 +174,10 @@ class LogReg:
                     win_sum, win_n = 0.0, 0
                     since_log = 0
             if group:  # epoch tail: whatever is left of the last group
+                n_flushed = len(group)
                 loss, n_in_group = flush(group)
+                batches_in_epoch += n_flushed
+                on_step(epoch, batches_in_epoch, n_flushed)
                 win_sum = win_sum + loss
                 win_n += 1
                 seen += n_in_group

@@ -115,6 +115,33 @@ MV_DEFINE_int(
     "next chunk's host->device transfer overlapping the current chunk's "
     "training (double buffering — hides the upload on weak links)",
 )
+# Fault tolerance (resilience subsystem): crash-consistent auto-checkpoints
+# + elastic resume on the host-batch fused path. A run killed at step K and
+# restarted with the same flags resumes from the latest valid checkpoint —
+# params (incl. optimizer slots), step counter, lr-schedule progress and the
+# data cursor all restore, so the result matches an uninterrupted run.
+MV_DEFINE_string(
+    "checkpoint_dir", "",
+    "root for crash-consistent training checkpoints (empty = off); "
+    "versions publish atomically as <dir>/ckpt-<step>",
+)
+MV_DEFINE_int(
+    "checkpoint_every_steps", 0,
+    "auto-checkpoint every N dispatch steps (0 = off)",
+)
+MV_DEFINE_double(
+    "checkpoint_every_seconds", 0.0,
+    "auto-checkpoint every N seconds (0 = off; combines with _steps)",
+)
+MV_DEFINE_int("checkpoint_retain", 3, "checkpoint versions kept by GC")
+MV_DEFINE_bool(
+    "checkpoint_async", True,
+    "write checkpoints off the training thread (snapshot is taken on it)",
+)
+MV_DEFINE_bool(
+    "resume", True,
+    "resume from the latest valid checkpoint under -checkpoint_dir",
+)
 MV_DEFINE_string(
     "walk", "perm",
     "device-pipeline center selection: perm (default — without-replacement "
@@ -156,6 +183,12 @@ class WEOptions:
     device_pipeline: bool = False
     upload_chunk_tokens: int = 0
     walk: str = "perm"
+    checkpoint_dir: str = ""
+    checkpoint_every_steps: int = 0
+    checkpoint_every_seconds: float = 0.0
+    checkpoint_retain: int = 3
+    checkpoint_async: bool = True
+    resume: bool = True
     seed: int = 1
 
     @classmethod
@@ -321,6 +354,34 @@ class WordEmbedding:
                 jnp.float32(lr),
             )
         return loss
+
+    def _maybe_checkpoint(
+        self, ckpt, step: int, epoch: int, batches_in_epoch: int,
+        pairs_done: int, restarts: int,
+    ) -> None:
+        """Policy-gated atomic checkpoint. The host snapshot (device_get)
+        happens HERE on the training thread — the next dispatch donates
+        these buffers — and only the file write rides the async thread."""
+
+        def build():
+            host = {
+                k: np.asarray(jax.device_get(v))
+                for k, v in self.params.items()
+            }
+            meta = {
+                "epoch": epoch,
+                "batches_in_epoch": batches_in_epoch,
+                "pairs_done": pairs_done,
+                "step": step,
+                "restarts": restarts,
+            }
+            from multiverso_tpu.resilience import save_checkpoint
+
+            return lambda: save_checkpoint(
+                ckpt.root, step, arrays=host, meta=meta
+            )
+
+        ckpt.maybe_save(step, build)
 
     # ---------------------------------------------------------- PS mode
 
@@ -957,6 +1018,10 @@ class WordEmbedding:
               "use row_mean there)")
         CHECK(o.walk in ("perm", "iid"),
               "-walk must be 'perm' or 'iid', got '%s'" % o.walk)
+        CHECK(not (o.checkpoint_dir and o.device_pipeline),
+              "-checkpoint_dir supports the host-batch fused path only "
+              "(the device pipeline has no per-step host data cursor to "
+              "checkpoint; its epochs are single dispatch legs)")
         if o.device_pipeline:
             return self._train_ondevice(ids, keep)
         def make_pipeline(shard_ids, seed):
@@ -999,38 +1064,125 @@ class WordEmbedding:
             else pipeline
         )
         if o.use_ps:
+            CHECK(not o.checkpoint_dir,
+                  "-checkpoint_dir supports the fused host-batch path only "
+                  "(PS-mode state lives in the shared tables; use "
+                  "io.save_tables for those)")
             return self._train_ps(source, total_pairs_est, start)
         S = max(1, o.steps_per_call)
         log_every = o.batch_size * max(64, S * 8)
-        for epoch in range(o.epoch):
-            it = source.batches(epoch)
-            done = False
-            while not done:
-                # pack up to S microbatches into one scanned dispatch
-                group = []
-                while len(group) < S:
-                    batch = next(it, None)
-                    if batch is None:
-                        done = True
-                        break
-                    group.append(batch)
-                if not group:
-                    break
-                lr = self._lr(pairs_done / total_pairs_est)
-                if len(group) == S:
-                    loss_dev = self._run_superbatch(group, lr)
-                else:  # epoch tail: step singly, avoids a per-length recompile
-                    for b in group:
-                        loss_dev = self._run_batch(b, lr)
-                prev = pairs_done
-                pairs_done += o.batch_size * len(group)
-                if pairs_done // log_every > prev // log_every:
-                    rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+        # -- elastic resume (resilience subsystem): restore params +
+        # optimizer slots + step counter + lr progress + data cursor from
+        # the latest VALID checkpoint, then replay the epoch tail. Batches
+        # regenerate deterministically (same seed, skip= cursor), so a
+        # kill-at-step-K + restart run is step-for-step identical to an
+        # uninterrupted one.
+        ckpt = None
+        start_epoch = 0
+        resume_skip = 0
+        step = 0
+        restarts = 0
+        if o.checkpoint_dir:
+            from multiverso_tpu.resilience import (
+                AutoCheckpointer,
+                latest_valid,
+                load_checkpoint,
+            )
+            from multiverso_tpu.resilience import stats as _rstats
+
+            CHECK(jax.process_count() == 1,
+                  "-checkpoint_dir requires a single process (fused params "
+                  "are rank-local; multi-process training goes through "
+                  "-use_ps + io.save_tables)")
+            CHECK(nthreads == 1,
+                  "-checkpoint_dir requires -threads=1: the resume data "
+                  "cursor needs a deterministic batch order")
+            if o.resume:
+                path = latest_valid(o.checkpoint_dir)
+                if path is not None:
+                    tree, meta = load_checkpoint(path)
+                    CHECK(set(tree) == set(self.params),
+                          f"checkpoint {path} params {sorted(tree)} do not "
+                          f"match this config's {sorted(self.params)} "
+                          "(hs/adagrad/size flags must match the saved run)")
+                    self.params = {k: jnp.asarray(v) for k, v in tree.items()}
+                    start_epoch = int(meta["epoch"])
+                    resume_skip = int(meta["batches_in_epoch"])
+                    pairs_done = int(meta["pairs_done"])
+                    step = int(meta["step"])
+                    restarts = int(meta.get("restarts", 0)) + 1
+                    _rstats.note_restart(restarts)
                     Log.Info(
-                        "[WordEmbedding] epoch %d: %.1fM pairs, %.0fk pairs/s, "
-                        "lr %.5f, loss %.4f",
-                        epoch, pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
+                        "[WordEmbedding] resumed from %s: step %d, epoch %d, "
+                        "batch %d, %.1fM pairs, restart #%d",
+                        path, step, start_epoch, resume_skip,
+                        pairs_done / 1e6, restarts,
                     )
+            ckpt = AutoCheckpointer(
+                o.checkpoint_dir,
+                every_n_steps=o.checkpoint_every_steps,
+                every_n_seconds=o.checkpoint_every_seconds,
+                retain=o.checkpoint_retain,
+                async_=o.checkpoint_async,
+            )
+        from multiverso_tpu.resilience import chaos
+
+        if start_epoch > 0:
+            # the pair generator's RNG stream (negative draws, presort
+            # seeds) spans epochs; regenerate-and-discard the completed
+            # epochs so the resumed stream is bit-identical to an
+            # uninterrupted run's (host-only work, no device steps)
+            Log.Info(
+                "[WordEmbedding] resume: advancing the batch stream through "
+                "%d completed epoch(s)", start_epoch,
+            )
+            for ep in range(start_epoch):
+                for _ in source.batches(ep):
+                    pass
+        try:
+            for epoch in range(start_epoch, o.epoch):
+                skip = resume_skip if epoch == start_epoch else 0
+                it = source.batches(epoch, skip=skip)
+                batches_in_epoch = skip
+                done = False
+                while not done:
+                    # pack up to S microbatches into one scanned dispatch
+                    group = []
+                    while len(group) < S:
+                        batch = next(it, None)
+                        if batch is None:
+                            done = True
+                            break
+                        group.append(batch)
+                    if not group:
+                        break
+                    lr = self._lr(pairs_done / total_pairs_est)
+                    if len(group) == S:
+                        loss_dev = self._run_superbatch(group, lr)
+                    else:  # epoch tail: step singly, avoids a per-length recompile
+                        for b in group:
+                            loss_dev = self._run_batch(b, lr)
+                    prev = pairs_done
+                    pairs_done += o.batch_size * len(group)
+                    batches_in_epoch += len(group)
+                    step += 1
+                    if ckpt is not None:
+                        self._maybe_checkpoint(
+                            ckpt, step, epoch, batches_in_epoch, pairs_done,
+                            restarts,
+                        )
+                    chaos.maybe_kill(step)
+                    if pairs_done // log_every > prev // log_every:
+                        rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+                        Log.Info(
+                            "[WordEmbedding] epoch %d: %.1fM pairs, %.0fk pairs/s, "
+                            "lr %.5f, loss %.4f",
+                            epoch, pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
+                        )
+        finally:
+            if ckpt is not None:
+                ckpt.close()  # drain the in-flight async save (even on a
+                # raise-mode chaos kill: the test's restart must see it)
         jax.block_until_ready(self.params)
         last_loss = float(loss_dev) if loss_dev is not None else 0.0
         self.words_trained = pairs_done

@@ -57,9 +57,26 @@ class BatchPipeline:
         self.scale_mode = scale_mode
         self._rng = np.random.RandomState(seed)
 
-    def batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    def batches(self, epoch: int = 0, skip: int = 0
+                ) -> Iterator[Dict[str, np.ndarray]]:
         """One epoch of fixed-shape batches. The final partial batch is
-        wrapped with leading pairs (fixed shapes for the jitted step)."""
+        wrapped with leading pairs (fixed shapes for the jitted step).
+
+        ``skip`` is the elastic-resume data cursor: regenerate and DISCARD
+        the first ``skip`` batches instead of yielding them. Regeneration
+        (not seeking) is deliberate — it advances the internal RNG through
+        exactly the draws the pre-crash run consumed, so batch ``skip``
+        onward is bit-identical to an uninterrupted epoch."""
+        if skip:
+            it = self._batches(epoch)
+            for _ in range(skip):
+                if next(it, None) is None:
+                    break
+            yield from it
+            return
+        yield from self._batches(epoch)
+
+    def _batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         pos = 0
         n = len(self.ids)
         seed = (self.seed + epoch * 0x9E3779B9) or 1
@@ -190,8 +207,18 @@ class PrefetchPipeline:
         # it simply block in free.pop() until tickets recycle
         self._depth = int(depth)
 
-    def batches(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    def batches(self, epoch: int = 0, skip: int = 0
+                ) -> Iterator[Dict[str, np.ndarray]]:
         from multiverso_tpu.native.host_runtime import MtQueue
+
+        # resume cursor: only a SINGLE producer yields a deterministic
+        # batch order, so a skip against interleaved shards would drop a
+        # different set than the pre-crash run consumed
+        CHECK(
+            skip == 0 or len(self._pls) == 1,
+            "resume (skip>0) requires a single producer pipeline "
+            "(-threads=1): multi-shard interleaving is nondeterministic",
+        )
 
         ready: MtQueue = MtQueue()
         free: MtQueue = MtQueue()
@@ -204,7 +231,11 @@ class PrefetchPipeline:
 
         def produce(pl):
             try:
-                for batch in pl.batches(epoch):
+                # skip= only when resuming: wrapped pipelines are
+                # duck-typed (tests wrap bare generators) and need not
+                # accept the cursor kwarg
+                it = pl.batches(epoch, skip=skip) if skip else pl.batches(epoch)
+                for batch in it:
                     ticket = free.pop()
                     if ticket is None:  # consumer gone
                         return

@@ -62,6 +62,18 @@ MV_DEFINE_int("port", 55555, "coordinator port when machine_file lines lack one"
 MV_DEFINE_string("coordinator", "", "coordinator ip:port (overrides machine_file)")
 MV_DEFINE_int("process_id", -1, "this process's id (-1: infer from machine_file)")
 MV_DEFINE_int("num_processes", 0, "total processes (0: infer)")
+# Bounded rendezvous (resilience subsystem): the reference's ZMQ handshake
+# simply blocks forever on a missing peer; here every attempt is bounded
+# and transient failures (a peer restarting after a host loss) get a
+# jittered-backoff retry budget instead of a hang.
+MV_DEFINE_int(
+    "rendezvous_timeout_s", 300,
+    "per-attempt cluster rendezvous timeout (bounded failure, not a hang)",
+)
+MV_DEFINE_int(
+    "rendezvous_retries", 3,
+    "extra rendezvous attempts after the first (jittered backoff between)",
+)
 
 _initialized = False
 
@@ -200,11 +212,61 @@ def initialize(
         except Exception:
             pass
     # num_processes=None with a coordinator: jax infers the count from the
-    # TPU pod environment.
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
+    # TPU pod environment. The rendezvous itself is BOUNDED (per-attempt
+    # timeout) and retried with jittered backoff — a worker restarting
+    # into a half-formed cluster after a host loss must converge or fail
+    # loudly, never hang forever (resilience subsystem; chaos flag
+    # -chaos_rendezvous_failures drills the retry path deterministically).
+    from multiverso_tpu.resilience.chaos import (
+        rendezvous_should_fail,
+        with_retries,
+    )
+
+    timeout_s = max(1, int(GetFlag("rendezvous_timeout_s")))
+
+    def _rendezvous() -> None:
+        if rendezvous_should_fail():
+            raise TimeoutError("chaos: injected rendezvous failure")
+        try:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    initialization_timeout=timeout_s,
+                )
+            except TypeError:  # older jax: no initialization_timeout kwarg
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+        except Exception as e:
+            # initialize() done by someone else (embedding app, launcher)
+            # is the success state, not an error. This can only happen on
+            # the FIRST attempt: our own failed attempts tear down below.
+            low = str(e).lower()
+            if isinstance(e, RuntimeError) and (
+                "already initialized" in low or "called once" in low
+            ):
+                return
+            # a timed-out connect leaves jax's global distributed client
+            # assigned, and the next initialize() would then refuse with
+            # "should only be called once" instead of reconnecting — tear
+            # the half-initialized service down so the retry is real
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best effort, keep the cause
+                pass
+            raise
+
+    with_retries(
+        _rendezvous,
+        attempts=max(1, int(GetFlag("rendezvous_retries")) + 1),
+        base_delay_s=0.2,
+        max_delay_s=5.0,
+        seed=(process_id or 0) + 1,
+        describe="multihost rendezvous",
     )
     _initialized = True
     Log.Info(

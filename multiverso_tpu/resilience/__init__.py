@@ -1,0 +1,52 @@
+"""Fault-tolerance layer: crash-consistent checkpoints, elastic resume,
+deterministic fault injection, bounded retries, and serving degradation.
+
+The reference parameter server survives worker churn by design — workers
+are stateless against sharded server tables (ref: SURVEY.md §2.2) — but
+the TPU-native SPMD port concentrates all state in one program. This
+package makes process death, torn checkpoint writes and poisoned weight
+publishes *normal*, tested events:
+
+* ``resilience.checkpoint`` — atomic manifest-sealed checkpoint publish,
+  ``latest_valid`` discovery that skips torn/corrupt versions, retention
+  GC, and the ``AutoCheckpointer``/``CheckpointPolicy`` pieces the
+  training loops wire in (``io/checkpoint.save_tables`` commits through
+  the same machinery);
+* ``resilience.chaos`` — ``MV_DEFINE_*``-armed seedable fault points
+  (kill-at-step, torn writer, checksum corruption, route errors, failed
+  rendezvous) plus ``with_retries`` (jittered exponential backoff under a
+  hard deadline) used by the multihost rendezvous and checkpoint I/O;
+* ``resilience.breaker`` — the per-route circuit breaker the
+  ``TableServer`` sheds through when a route keeps failing.
+"""
+
+from multiverso_tpu.resilience.breaker import CircuitBreaker
+from multiverso_tpu.resilience.chaos import ChaosInterrupt, with_retries
+from multiverso_tpu.resilience.checkpoint import (
+    AutoCheckpointer,
+    CheckpointPolicy,
+    gc_checkpoints,
+    latest_valid,
+    list_checkpoints,
+    load_checkpoint,
+    require_valid,
+    save_checkpoint,
+    stats,
+    verify_checkpoint,
+)
+
+__all__ = [
+    "AutoCheckpointer",
+    "ChaosInterrupt",
+    "CheckpointPolicy",
+    "CircuitBreaker",
+    "gc_checkpoints",
+    "latest_valid",
+    "list_checkpoints",
+    "load_checkpoint",
+    "require_valid",
+    "save_checkpoint",
+    "stats",
+    "verify_checkpoint",
+    "with_retries",
+]

@@ -1,0 +1,111 @@
+"""Per-route circuit breaker: shed fast when a route keeps failing.
+
+An online server whose route throws on every flush still pays the full
+queue -> batch -> dispatch cost per request, turning one bad route (a
+poisoned table, a chaos drill, an OOM-ing program) into whole-server
+latency collapse. The breaker converts repeated failure into *fast*
+failure: after ``threshold`` consecutive failures the route opens and
+requests are rejected immediately with a retry-after hint; after
+``cooldown_s`` it half-opens and admits exactly one probe — success
+closes it, failure re-opens it for another cooldown.
+
+Deterministic by construction: state moves only on ``allow`` /
+``record_*`` calls, the clock is injectable, and there are no background
+threads — tests drive transitions with a fake clock, never a sleep.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Tuple
+
+__all__ = ["CircuitBreaker"]
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probing.
+
+    States: ``closed`` (traffic flows; failures counted), ``open``
+    (reject with retry-after = remaining cooldown), ``half_open`` (one
+    in-flight probe admitted; the rest rejected until it resolves).
+    """
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        cooldown_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        assert threshold >= 1
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> Tuple[bool, float]:
+        """(admitted, retry_after_s). Admitting from ``open`` past the
+        cooldown transitions to ``half_open`` and claims the probe slot —
+        the caller that got True MUST follow with record_success/failure."""
+        now = self._clock()
+        with self._lock:
+            if self._state == "closed":
+                return True, 0.0
+            if self._state == "open":
+                elapsed = now - self._opened_at
+                if elapsed < self.cooldown_s:
+                    return False, self.cooldown_s - elapsed
+                self._state = "half_open"
+                self._probe_inflight = True
+                return True, 0.0
+            # half_open: one probe at a time
+            if self._probe_inflight:
+                return False, self.cooldown_s
+            self._probe_inflight = True
+            return True, 0.0
+
+    def peek(self) -> Tuple[bool, float]:
+        """Like ``allow`` but WITHOUT claiming the half-open probe slot or
+        mutating state — the submit-time fast-shed check. A request that
+        passes ``peek`` may still be rejected by the flush-side ``allow``
+        (someone else took the probe); that is the intended funnel."""
+        now = self._clock()
+        with self._lock:
+            if self._state == "closed":
+                return True, 0.0
+            if self._state == "open":
+                elapsed = now - self._opened_at
+                if elapsed < self.cooldown_s:
+                    return False, self.cooldown_s - elapsed
+                return True, 0.0  # cooldown over: let a probe candidate in
+            if self._probe_inflight:
+                return False, self.cooldown_s
+            return True, 0.0
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = "closed"
+            self._failures = 0
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        now = self._clock()
+        with self._lock:
+            self._probe_inflight = False
+            if self._state == "half_open":
+                self._state = "open"  # probe failed: full new cooldown
+                self._opened_at = now
+                return
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._state = "open"
+                self._opened_at = now

@@ -1,0 +1,227 @@
+"""Deterministic fault injection + bounded retries.
+
+The reference survives worker churn because workers are stateless against
+sharded server tables; the TPU-native SPMD port concentrates all state in
+one program, so process death, torn checkpoint writes and poisoned
+publishes must be *testable* events, not hopes. This module is the one
+switchboard: every fault is a ``MV_DEFINE_*`` flag (so the multiprocess
+e2e workers and the CLI drivers can arm faults through ordinary argv,
+deterministically — no sleeps, no signal races), and every production
+code path that can fail transiently goes through ``with_retries``
+(seeded-jitter exponential backoff under a hard deadline).
+
+Fault points (all off by default):
+
+* ``-chaos_kill_at_step=K``      — the training loop dies at step K
+  (``os._exit(137)``, or ``ChaosInterrupt`` with
+  ``-chaos_kill_mode=raise`` for in-process tests);
+* ``-chaos_torn_checkpoint=true``   — the checkpoint writer crashes after
+  the payload but *before* the atomic rename (leaves a ``.tmp-`` corpse);
+* ``-chaos_corrupt_checkpoint=true`` — a published checkpoint gets one
+  payload byte flipped after its checksums were recorded (what a partial
+  disk write or bit rot looks like to ``latest_valid``);
+* ``-chaos_route_errors=lookup:3``   — the next 3 serving flushes whose
+  route contains ``lookup`` raise (drives the circuit breaker);
+* ``-chaos_rendezvous_failures=N``   — the first N cluster-rendezvous
+  attempts raise (drives the multihost retry path).
+
+Counters are process-local and reset with ``reset()`` (test isolation).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_bool,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    GetFlag,
+)
+from multiverso_tpu.utils.log import Log
+
+__all__ = [
+    "ChaosInterrupt",
+    "kill_exit_code",
+    "maybe_kill",
+    "torn_checkpoint",
+    "corrupt_checkpoint",
+    "should_fail_route",
+    "rendezvous_should_fail",
+    "reset",
+    "with_retries",
+]
+
+MV_DEFINE_int("chaos_kill_at_step", -1, "kill this process at training step K (-1 = off)")
+MV_DEFINE_string(
+    "chaos_kill_mode", "exit",
+    "how -chaos_kill_at_step dies: exit (os._exit 137, the crash-recovery "
+    "e2e) | raise (ChaosInterrupt, in-process tests)",
+)
+MV_DEFINE_bool(
+    "chaos_torn_checkpoint", False,
+    "checkpoint saves crash after the payload write, before the atomic "
+    "rename (leaves a .tmp- directory; no new version is published)",
+)
+MV_DEFINE_bool(
+    "chaos_corrupt_checkpoint", False,
+    "flip one payload byte of each published checkpoint AFTER its "
+    "checksums were recorded (latest_valid must detect and skip it)",
+)
+MV_DEFINE_string(
+    "chaos_route_errors", "",
+    "substr:count — the next <count> serving flushes whose route contains "
+    "<substr> raise an injected error (circuit-breaker drills)",
+)
+MV_DEFINE_int(
+    "chaos_rendezvous_failures", 0,
+    "fail the first N multihost rendezvous attempts (retry-path drills)",
+)
+
+_KILL_EXIT_CODE = 137
+
+_lock = threading.Lock()
+_route_budget: Dict[str, int] = {}  # parsed spec -> remaining failures
+_route_spec_seen: Optional[str] = None
+_rendezvous_failed = 0
+
+
+class ChaosInterrupt(RuntimeError):
+    """An injected fault fired (never raised unless a chaos flag is set)."""
+
+
+def kill_exit_code() -> int:
+    return _KILL_EXIT_CODE
+
+
+def reset() -> None:
+    """Forget all chaos counters (test isolation; flags reset separately)."""
+    global _route_spec_seen, _rendezvous_failed
+    with _lock:
+        _route_budget.clear()
+        _route_spec_seen = None
+        _rendezvous_failed = 0
+
+
+def maybe_kill(step: int) -> None:
+    """Training-loop fault point: die at the armed step.
+
+    ``exit`` mode uses ``os._exit`` — a real crash, no atexit handlers, no
+    checkpoint flush — so the recovery test exercises exactly what a host
+    loss leaves behind."""
+    k = GetFlag("chaos_kill_at_step")
+    if k < 0 or step != k:
+        return
+    Log.Error("[chaos] killing process at step %d (-chaos_kill_at_step)", step)
+    if GetFlag("chaos_kill_mode") == "raise":
+        raise ChaosInterrupt(f"chaos: killed at step {step}")
+    os._exit(_KILL_EXIT_CODE)
+
+
+def torn_checkpoint() -> bool:
+    return bool(GetFlag("chaos_torn_checkpoint"))
+
+
+def corrupt_checkpoint() -> bool:
+    return bool(GetFlag("chaos_corrupt_checkpoint"))
+
+
+def should_fail_route(route: str) -> bool:
+    """Serving-flush fault point: consume one failure from the armed
+    ``substr:count`` budget when the route matches."""
+    spec = GetFlag("chaos_route_errors")
+    if not spec:
+        return False
+    global _route_spec_seen
+    with _lock:
+        if spec != _route_spec_seen:  # flag changed: re-arm the budget
+            _route_budget.clear()
+            for part in spec.split(";"):
+                substr, _, cnt = part.partition(":")
+                if substr:
+                    _route_budget[substr] = int(cnt or 1)
+            _route_spec_seen = spec
+        for substr in _route_budget:
+            if substr in route and _route_budget[substr] > 0:
+                _route_budget[substr] -= 1
+                Log.Error("[chaos] injected route failure on %r", route)
+                return True
+    return False
+
+
+def rendezvous_should_fail() -> bool:
+    """Rendezvous fault point: fail the first N attempts."""
+    n = GetFlag("chaos_rendezvous_failures")
+    if n <= 0:
+        return False
+    global _rendezvous_failed
+    with _lock:
+        if _rendezvous_failed < n:
+            _rendezvous_failed += 1
+            Log.Error(
+                "[chaos] injected rendezvous failure %d/%d",
+                _rendezvous_failed, n,
+            )
+            return True
+    return False
+
+
+# --------------------------------------------------------------- retries
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    *,
+    attempts: int = 5,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    deadline_s: Optional[float] = None,
+    retry_on: Tuple[type, ...] = (Exception,),
+    seed: int = 0,
+    describe: str = "operation",
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+) -> Any:
+    """Run ``fn`` with jittered exponential backoff under a hard deadline.
+
+    Deterministic: the jitter sequence is a seeded xorshift, so two runs
+    with the same seed retry on an identical schedule (no flaky test
+    timing). Backoff for attempt i is ``min(max_delay_s, base * 2^i)``
+    scaled into [0.5, 1.0) — full-jitter halves thundering herds while the
+    floor keeps the deadline math predictable. A ``deadline_s`` bounds the
+    TOTAL time: a retry whose backoff would cross the deadline is not
+    taken (bounded failure instead of hanging forever — the reference's
+    ZMQ rendezvous simply blocks; we refuse to)."""
+    assert attempts >= 1
+    start = clock()
+    state = (seed * 2654435761 + 0x9E3779B9) & 0xFFFFFFFF or 1
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop
+            last = e
+            if i == attempts - 1:
+                break
+            # xorshift32: cheap, seedable, good enough for jitter
+            state ^= (state << 13) & 0xFFFFFFFF
+            state ^= state >> 17
+            state ^= (state << 5) & 0xFFFFFFFF
+            u = state / 0xFFFFFFFF
+            delay = min(max_delay_s, base_delay_s * (2.0 ** i)) * (0.5 + 0.5 * u)
+            if deadline_s is not None and (clock() - start) + delay > deadline_s:
+                Log.Error(
+                    "%s: giving up after %d attempt(s) — deadline %.1fs "
+                    "would be exceeded (%s)", describe, i + 1, deadline_s, e,
+                )
+                break
+            Log.Info(
+                "%s failed (attempt %d/%d): %s — retrying in %.3fs",
+                describe, i + 1, attempts, e, delay,
+            )
+            sleep(delay)
+    assert last is not None
+    raise last

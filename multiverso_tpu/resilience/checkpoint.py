@@ -1,0 +1,511 @@
+"""Crash-consistent checkpoint lifecycle: atomic publish, discovery, GC.
+
+The seed's ``save_tables`` wrote the orbax tree and the
+``logical_shapes.json`` sidecar non-atomically, in sequence — a crash
+mid-save left a torn directory that ``restore_tables``/``load_arrays``
+would happily misread. This module makes every checkpoint a single
+atomic event with an integrity proof:
+
+1. the payload is written into ``<final>.tmp-<uuid>`` (never the final
+   name);
+2. a ``MANIFEST.json`` is written LAST inside the tmp dir, carrying the
+   step, caller metadata (data cursor, restart count, ...) and a
+   size+crc32 record of every payload file, then fsynced;
+3. the tmp dir is renamed onto the final name (one atomic filesystem op)
+   and the parent directory fsynced.
+
+A reader therefore sees either nothing, a ``.tmp-`` corpse (ignored), or
+a complete checkpoint whose manifest proves the payload intact.
+``latest_valid`` walks a checkpoint root newest-first and returns the
+first version that verifies — torn, truncated, checksum-flipped or
+manifest-less directories are skipped with a logged reason, never
+loaded. ``gc_checkpoints`` bounds disk: newest N valid versions stay,
+older versions and tmp corpses go.
+
+On top sit the policy pieces training loops wire in: ``CheckpointPolicy``
+(every-N-steps / every-N-seconds), ``AutoCheckpointer`` (snapshot on the
+training thread, write off it), and a process-wide ``stats`` record
+(restart count, last-checkpoint age) that lands on the Dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import uuid
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from multiverso_tpu.resilience import chaos
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = [
+    "MANIFEST_NAME",
+    "write_manifest",
+    "commit_atomic",
+    "verify_checkpoint",
+    "require_valid",
+    "list_checkpoints",
+    "latest_valid",
+    "gc_checkpoints",
+    "save_checkpoint",
+    "load_checkpoint",
+    "CheckpointPolicy",
+    "AutoCheckpointer",
+    "stats",
+]
+
+MANIFEST_NAME = "MANIFEST.json"
+_FORMAT = 1
+_PREFIX = "ckpt-"
+
+
+# ------------------------------------------------------------ integrity
+
+
+def _payload_files(directory: str) -> List[str]:
+    """Relative paths of every payload file under ``directory`` (manifest
+    excluded), sorted for stable manifests."""
+    out: List[str] = []
+    for base, _dirs, files in os.walk(directory):
+        for f in files:
+            rel = os.path.relpath(os.path.join(base, f), directory)
+            if rel != MANIFEST_NAME:
+                out.append(rel)
+    return sorted(out)
+
+
+def _crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_manifest(
+    directory: str, step: Optional[int] = None, meta: Optional[Dict] = None
+) -> str:
+    """Checksum the payload and write+fsync ``MANIFEST.json`` — the commit
+    record. Must be the LAST write into the tmp dir."""
+    files = {}
+    for rel in _payload_files(directory):
+        p = os.path.join(directory, rel)
+        files[rel] = {"size": os.path.getsize(p), "crc32": _crc32(p)}
+    manifest = {
+        "format": _FORMAT,
+        "step": step,
+        "created": time.time(),
+        "meta": meta or {},
+        "files": files,
+    }
+    path = os.path.join(directory, MANIFEST_NAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def commit_atomic(
+    tmp_dir: str,
+    final_dir: str,
+    *,
+    step: Optional[int] = None,
+    meta: Optional[Dict] = None,
+) -> str:
+    """Manifest + atomic rename: publish ``tmp_dir`` as ``final_dir``.
+
+    If ``final_dir`` already exists it is moved aside first and removed
+    after the rename, so no reader ever observes a half-replaced
+    directory. Chaos hooks: ``-chaos_torn_checkpoint`` dies between the
+    manifest and the rename (the crash window the protocol defends
+    against); ``-chaos_corrupt_checkpoint`` flips a payload byte after
+    publication (what verification must catch)."""
+    write_manifest(tmp_dir, step=step, meta=meta)
+    if chaos.torn_checkpoint():
+        raise chaos.ChaosInterrupt(
+            f"torn checkpoint write: crashed before renaming {tmp_dir}"
+        )
+    aside = None
+    if os.path.exists(final_dir):
+        aside = f"{final_dir}.old-{uuid.uuid4().hex[:8]}"
+        os.rename(final_dir, aside)
+    os.replace(tmp_dir, final_dir)
+    try:  # durability of the rename itself
+        _fsync_path(os.path.dirname(os.path.abspath(final_dir)) or ".")
+    except OSError:
+        pass  # fsync-on-dir unsupported (some filesystems): rename still atomic
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
+    if chaos.corrupt_checkpoint():
+        _flip_one_payload_byte(final_dir)
+    return final_dir
+
+
+def _flip_one_payload_byte(directory: str) -> None:
+    rels = _payload_files(directory)
+    CHECK(rels, f"chaos corrupt: no payload files under {directory}")
+    target = max(rels, key=lambda r: os.path.getsize(os.path.join(directory, r)))
+    path = os.path.join(directory, target)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    Log.Error("[chaos] corrupted checkpoint payload byte: %s", path)
+
+
+def verify_checkpoint(directory: str) -> Optional[str]:
+    """Return None when ``directory`` is a complete, uncorrupted
+    checkpoint, else one human-readable reason (the first problem found:
+    missing manifest, missing/truncated payload file, checksum
+    mismatch)."""
+    if not os.path.isdir(directory):
+        return "not a directory"
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return f"missing {MANIFEST_NAME}"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (ValueError, KeyError, OSError) as e:
+        return f"unreadable {MANIFEST_NAME} ({e})"
+    for rel, rec in sorted(files.items()):
+        p = os.path.join(directory, rel)
+        if not os.path.exists(p):
+            return f"missing payload file {rel}"
+        size = os.path.getsize(p)
+        if size != rec["size"]:
+            return f"truncated payload file {rel} ({size} != {rec['size']} bytes)"
+        if _crc32(p) != rec["crc32"]:
+            return f"checksum mismatch in {rel}"
+    return None
+
+
+def require_valid(directory: str) -> Dict:
+    """Verify or die with ONE clear error naming the directory and the
+    broken piece (never an orbax stack trace). Returns the manifest."""
+    problem = verify_checkpoint(directory)
+    if problem is not None:
+        Log.Fatal(
+            "checkpoint %s is incomplete or corrupt: %s", directory, problem
+        )
+    with open(os.path.join(directory, MANIFEST_NAME)) as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------------ discovery
+
+
+def _is_version_dir(name: str) -> bool:
+    return (
+        name.startswith(_PREFIX)
+        and ".tmp-" not in name
+        and ".old-" not in name
+        and name[len(_PREFIX):].isdigit()
+    )
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """All published versions under ``root`` as (step, path), ascending.
+    ``.tmp-``/``.old-`` corpses are not versions."""
+    if not os.path.isdir(root):
+        return []
+    out = [
+        (int(name[len(_PREFIX):]), os.path.join(root, name))
+        for name in os.listdir(root)
+        if _is_version_dir(name)
+    ]
+    return sorted(out)
+
+
+def latest_valid(root: str) -> Optional[str]:
+    """Newest checkpoint under ``root`` that passes verification; torn or
+    corrupt versions are skipped (logged) — the fallback the torn-write
+    fixtures pin."""
+    for step, path in reversed(list_checkpoints(root)):
+        problem = verify_checkpoint(path)
+        if problem is None:
+            return path
+        Log.Error(
+            "skipping checkpoint %s (step %d): %s", path, step, problem
+        )
+    return None
+
+
+def gc_checkpoints(root: str, retain: int = 3) -> List[str]:
+    """Bound disk: keep the newest ``retain`` VALID versions; delete every
+    other version (older valid ones and torn/corrupt ones) and every
+    ``.tmp-``/``.old-`` corpse. Returns the removed paths. Single-writer
+    protocol: the saver calls this after its own commit, so any corpse
+    present is from a crashed save, never a live one."""
+    CHECK(retain >= 1, "gc_checkpoints retain must be >= 1")
+    removed: List[str] = []
+    if not os.path.isdir(root):
+        return removed
+    versions = list_checkpoints(root)
+    valid = [p for _s, p in versions if verify_checkpoint(p) is None]
+    keep = set(valid[-retain:])
+    for _step, path in versions:
+        if path not in keep:
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+    for name in os.listdir(root):
+        if ".tmp-" in name or ".old-" in name:
+            corpse = os.path.join(root, name)
+            shutil.rmtree(corpse, ignore_errors=True)
+            removed.append(corpse)
+    if removed:
+        Log.Info("checkpoint gc: removed %d entr(y/ies) under %s", len(removed), root)
+    return removed
+
+
+# ------------------------------------------------------------ array ckpts
+
+
+def save_checkpoint(
+    root: str,
+    step: int,
+    *,
+    arrays: Optional[Dict[str, Any]] = None,
+    meta: Optional[Dict] = None,
+    write_payload: Optional[Callable[[str], None]] = None,
+) -> str:
+    """Publish ``<root>/ckpt-<step>`` atomically.
+
+    Payload is a flat name->array dict (written as ``arrays.npz``), a
+    caller-supplied ``write_payload(tmp_dir)`` (e.g. a model's own binary
+    dump), or both. ``meta`` rides in the manifest — step counter, data
+    cursor, restart count: everything elastic resume needs beyond the
+    arrays themselves."""
+    import numpy as np
+
+    CHECK(arrays is not None or write_payload is not None,
+          "save_checkpoint needs arrays and/or write_payload")
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, f"{_PREFIX}{int(step)}")
+    tmp = f"{final}.tmp-{uuid.uuid4().hex[:8]}"
+    os.makedirs(tmp)
+    try:
+        if write_payload is not None:
+            write_payload(tmp)
+        if arrays is not None:
+            np.savez(
+                os.path.join(tmp, "arrays.npz"),
+                **{k: np.asarray(v) for k, v in arrays.items()},
+            )
+        return commit_atomic(tmp, final, step=step, meta=meta)
+    except chaos.ChaosInterrupt:
+        raise  # the tmp corpse IS the fixture the tests want
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_checkpoint(directory: str) -> Tuple[Dict[str, Any], Dict]:
+    """(arrays, meta) from a ``save_checkpoint`` directory. Verifies
+    first; a torn/corrupt directory dies with one clear error."""
+    import numpy as np
+
+    manifest = require_valid(directory)
+    arrays: Dict[str, Any] = {}
+    npz = os.path.join(directory, "arrays.npz")
+    if os.path.exists(npz):
+        with np.load(npz, allow_pickle=False) as data:
+            arrays = {k: data[k] for k in data.files}
+    return arrays, dict(manifest.get("meta") or {})
+
+
+# ------------------------------------------------------------ policy
+
+
+class CheckpointPolicy:
+    """When to checkpoint: ``every_n_steps`` and/or ``every_n_seconds``
+    (either may be 0 = off; both 0 = never). Injectable clock for tests."""
+
+    def __init__(
+        self,
+        every_n_steps: int = 0,
+        every_n_seconds: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.every_n_steps = int(every_n_steps)
+        self.every_n_seconds = float(every_n_seconds)
+        self._clock = clock
+        self._last_t = clock()
+        self._last_step: Optional[int] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.every_n_steps > 0 or self.every_n_seconds > 0
+
+    def due(self, step: int) -> bool:
+        if self._last_step == step:
+            return False  # one decision per step
+        if self.every_n_steps > 0 and step % self.every_n_steps == 0:
+            return True
+        if (
+            self.every_n_seconds > 0
+            and self._clock() - self._last_t >= self.every_n_seconds
+        ):
+            return True
+        return False
+
+    def record(self, step: int) -> None:
+        self._last_t = self._clock()
+        self._last_step = step
+
+
+class AutoCheckpointer:
+    """Policy-driven checkpointing, off the training thread.
+
+    ``maybe_save(step, build)``: when the policy says so, ``build()`` runs
+    ON the training thread (snapshot device state to host there — the
+    next step may donate those buffers) and must return a zero-arg job
+    that performs the actual ``save_checkpoint`` write; with
+    ``async_=True`` (default) the job runs on a worker thread while
+    training continues. A save that is still writing when the next one
+    comes due makes the new one a no-op (never a backlog). Failures are
+    recorded (``last_error``, Dashboard save_failures) and logged — a
+    broken disk must not kill the training run it exists to protect."""
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        every_n_steps: int = 0,
+        every_n_seconds: float = 0.0,
+        retain: int = 3,
+        async_: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.root = root
+        self.retain = int(retain)
+        self.policy = CheckpointPolicy(every_n_steps, every_n_seconds, clock)
+        self.async_ = bool(async_)
+        self.last_error: Optional[BaseException] = None
+        self.saves = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def maybe_save(self, step: int, build: Callable[[], Callable[[], str]]) -> bool:
+        """Returns True when a save was started (or completed, sync)."""
+        if not self.policy.enabled or not self.policy.due(step):
+            return False
+        if self._thread is not None and self._thread.is_alive():
+            Log.Info(
+                "checkpoint at step %d skipped: previous save still writing",
+                step,
+            )
+            return False
+        job = build()
+        self.policy.record(step)
+        if self.async_:
+            self._thread = threading.Thread(
+                target=self._run, args=(step, job), daemon=True,
+                name="mv-checkpointer",
+            )
+            self._thread.start()
+        else:
+            self._run(step, job)
+            if self.last_error is not None:
+                raise self.last_error
+        return True
+
+    def _run(self, step: int, job: Callable[[], str]) -> None:
+        try:
+            path = job()
+            gc_checkpoints(self.root, self.retain)
+            self.saves += 1
+            self.last_error = None
+            stats.note_save(step, path)
+            Log.Info("checkpoint published: %s (step %d)", path, step)
+        except BaseException as e:  # noqa: BLE001 — surface, don't kill training
+            self.last_error = e
+            stats.note_save_failure()
+            Log.Error("checkpoint save at step %d FAILED: %s", step, e)
+
+    def wait(self, timeout_s: float = 60.0) -> None:
+        th = self._thread
+        if th is not None:
+            th.join(timeout=timeout_s)
+
+    def close(self, timeout_s: float = 60.0) -> None:
+        self.wait(timeout_s)
+
+
+# ------------------------------------------------------------ stats
+
+
+class _ResilienceStats:
+    """Process-wide fault-tolerance counters, surfaced on the Dashboard
+    next to the serving health section: restart count (from resume meta),
+    checkpoint saves/failures, and the age of the last good checkpoint —
+    the number an operator actually pages on."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.restarts = 0
+        self.saves = 0
+        self.save_failures = 0
+        self.last_checkpoint_t: Optional[float] = None
+        self.last_checkpoint_step: Optional[int] = None
+        self.last_checkpoint_path: Optional[str] = None
+
+    def _register(self) -> None:
+        # lazy + keyed: survives Dashboard.Reset() by re-adding on next note
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.add_section("resilience", self.lines)
+
+    def note_save(self, step: int, path: str) -> None:
+        with self._lock:
+            self.saves += 1
+            self.last_checkpoint_t = time.monotonic()
+            self.last_checkpoint_step = step
+            self.last_checkpoint_path = path
+        self._register()
+
+    def note_save_failure(self) -> None:
+        with self._lock:
+            self.save_failures += 1
+        self._register()
+
+    def note_restart(self, restarts: int) -> None:
+        with self._lock:
+            self.restarts = int(restarts)
+        self._register()
+
+    def last_checkpoint_age_s(self) -> Optional[float]:
+        with self._lock:
+            if self.last_checkpoint_t is None:
+                return None
+            return time.monotonic() - self.last_checkpoint_t
+
+    def lines(self) -> List[str]:
+        age = self.last_checkpoint_age_s()
+        with self._lock:
+            return [
+                f"[Resilience] restarts={self.restarts} saves={self.saves} "
+                f"save_failures={self.save_failures} "
+                f"last_ckpt_step={self.last_checkpoint_step} "
+                f"last_ckpt_age_s={-1.0 if age is None else round(age, 1)}"
+            ]
+
+
+stats = _ResilienceStats()

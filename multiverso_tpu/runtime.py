@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.parallel import multihost  # registers -machine_file/-coordinator flags
+from multiverso_tpu.resilience import chaos as _chaos  # noqa: F401 — registers -chaos_* fault flags
 from multiverso_tpu.utils.configure import (
     MV_DEFINE_bool,
     MV_DEFINE_int,

@@ -14,17 +14,27 @@ package is the read path sized for that traffic:
 * ``metrics``  — per-route latency histograms (p50/p99), QPS, queue
   depth, batch-fill ratio and shed counts, wired into the Dashboard.
 
+Degradation (resilience subsystem): ``publish`` validates staged weights
+and rejects poisoned tables with ``PublishRejected`` (previous snapshot
+keeps serving); failing routes shed fast through per-route circuit
+breakers; ``TableServer.health()`` is the operator status struct.
+
 Everything is CPU-runnable (the fake 8-device mesh used by tier-1 tests);
 on TPU the same jitted programs shard the score matmuls over the mesh.
 """
 
 from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded, Request
 from multiverso_tpu.serving.metrics import LatencyHistogram, ServingMetrics
-from multiverso_tpu.serving.server import ServingSnapshot, TableServer
+from multiverso_tpu.serving.server import (
+    PublishRejected,
+    ServingSnapshot,
+    TableServer,
+)
 
 __all__ = [
     "DynamicBatcher",
     "Overloaded",
+    "PublishRejected",
     "Request",
     "LatencyHistogram",
     "ServingMetrics",

@@ -195,29 +195,40 @@ class DynamicBatcher:
 
     def _run(self) -> None:
         while True:
-            deadline = self._oldest_deadline()
-            if deadline is None:
-                ticket = self._ready.pop()  # idle: block for work
-            else:
-                wait_ms = int(max(0.0, deadline - time.monotonic()) * 1e3)
-                ticket = self._ready.pop(timeout_ms=max(wait_ms, 1))
-            if ticket is not None:
-                req = self._slots[ticket]
-                self._slots[ticket] = None
-                self._free.push(ticket)
-                if req is not None:
-                    self._pending.setdefault(req.route, []).append(req)
-                    if len(self._pending[req.route]) >= self.max_batch:
-                        self._flush(req.route)
-            # deadline sweep EVERY iteration — not only on pop timeout: a
-            # steady stream on one route keeps pop() returning tickets, and
-            # skipping the sweep then would starve a quieter route's
-            # past-due partial batch indefinitely
-            now = time.monotonic()
-            for route in list(self._pending):
-                reqs = self._pending[route]
-                if reqs and reqs[0].enqueue_t + self.max_delay_s <= now:
-                    self._flush(route)
+            try:
+                deadline = self._oldest_deadline()
+                if deadline is None:
+                    ticket = self._ready.pop()  # idle: block for work
+                else:
+                    wait_ms = int(max(0.0, deadline - time.monotonic()) * 1e3)
+                    ticket = self._ready.pop(timeout_ms=max(wait_ms, 1))
+                if ticket is not None:
+                    req = self._slots[ticket]
+                    self._slots[ticket] = None
+                    self._free.push(ticket)
+                    if req is not None:
+                        self._pending.setdefault(req.route, []).append(req)
+                        if len(self._pending[req.route]) >= self.max_batch:
+                            self._flush(req.route)
+                # deadline sweep EVERY iteration — not only on pop timeout: a
+                # steady stream on one route keeps pop() returning tickets, and
+                # skipping the sweep then would starve a quieter route's
+                # past-due partial batch indefinitely
+                now = time.monotonic()
+                for route in list(self._pending):
+                    reqs = self._pending[route]
+                    if reqs and reqs[0].enqueue_t + self.max_delay_s <= now:
+                        self._flush(route)
+            except Exception as e:  # noqa: BLE001 — the flusher NEVER dies
+                # _flush already contains per-batch failures; anything that
+                # reaches here is harness breakage (queue/metrics/bookkeeping).
+                # A dead flusher strands every future forever — log, keep
+                # serving the routes that still work.
+                from multiverso_tpu.utils.log import Log
+
+                Log.Error("serving flusher survived internal error: %r", e)
+                time.sleep(0.01)  # if the queue itself is broken: no hot spin
+                ticket = None
             if ticket is None and self._closed:
                 # drain whatever arrived before the poison, then leave
                 while True:
@@ -256,9 +267,14 @@ class DynamicBatcher:
         done = time.monotonic()
         for r, res in zip(reqs, results):
             _set_future(r.future, res)
-        self.metrics.record_batch(
-            route,
-            len(reqs),
-            self.max_batch,
-            [done - r.enqueue_t for r in reqs],
-        )
+        try:  # results are delivered by now: metrics must not undo that
+            self.metrics.record_batch(
+                route,
+                len(reqs),
+                self.max_batch,
+                [done - r.enqueue_t for r in reqs],
+            )
+        except Exception as e:  # noqa: BLE001
+            from multiverso_tpu.utils.log import Log
+
+            Log.Error("serving metrics record failed (batch served): %r", e)

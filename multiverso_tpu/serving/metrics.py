@@ -100,6 +100,8 @@ class ServingMetrics:
         self.batch_fill_sum = 0.0  # sum of per-batch size/max_batch
         self.queue_depth = 0
         self.swaps = 0
+        self.publish_rejects = 0
+        self.last_swap_t: Optional[float] = None  # monotonic; health() age
         self._window_s = float(window_s)
         self._served_times: List[tuple] = []  # (t, n) per flush, pruned
 
@@ -135,6 +137,17 @@ class ServingMetrics:
     def record_swap(self) -> None:
         with self._lock:
             self.swaps += 1
+            self.last_swap_t = time.monotonic()
+
+    def record_publish_reject(self) -> None:
+        with self._lock:
+            self.publish_rejects += 1
+
+    def last_swap_age_s(self) -> Optional[float]:
+        with self._lock:
+            if self.last_swap_t is None:
+                return None
+            return time.monotonic() - self.last_swap_t
 
     def set_queue_depth(self, depth: int) -> None:
         self.queue_depth = int(depth)
@@ -168,6 +181,7 @@ class ServingMetrics:
             "queue_depth": self.queue_depth,
             "qps": round(self.qps(), 1),
             "swaps": self.swaps,
+            "publish_rejects": self.publish_rejects,
         }
         for route, hist in sorted(self.route_latency.items()):
             out[f"{route}_p50_ms"] = round(hist.percentile(50) * 1e3, 4)

@@ -34,6 +34,18 @@ Weights can come from live training tables (``publish_from_tables`` — a
 donation-safe copy via ``DenseTable.snapshot_array``), from a checkpoint
 directory (``restore`` — the ``io/checkpoint.py`` load-for-serving path),
 or straight from host arrays (``publish``).
+
+**Graceful degradation** (resilience subsystem): ``publish`` VALIDATES
+staged weights before the swap — shape/dtype against the serving
+snapshot, a finiteness probe over every float table — and rejects a
+poisoned publish with ``PublishRejected`` while the previous snapshot
+keeps serving. Each route runs behind a circuit breaker: a route that
+keeps failing (bad program, chaos drill) opens after
+``breaker_threshold`` consecutive failures and sheds instantly with
+``Overloaded`` (retry-after = remaining cooldown) instead of burning the
+flusher, half-opening one probe per ``breaker_cooldown_s``. ``health()``
+reports last-swap age, breaker states, queue depth and reject counts,
+and lands on the process Dashboard next to the resilience stats.
 """
 
 from __future__ import annotations
@@ -47,12 +59,19 @@ import jax
 import jax.numpy as jnp
 
 from multiverso_tpu.parallel import mesh as mesh_lib
-from multiverso_tpu.serving.batcher import DynamicBatcher
+from multiverso_tpu.resilience import chaos
+from multiverso_tpu.resilience.breaker import CircuitBreaker
+from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded
 from multiverso_tpu.serving.metrics import ServingMetrics
 from multiverso_tpu.utils import next_pow2 as _next_pow2
 from multiverso_tpu.utils.log import CHECK, Log
 
-__all__ = ["ServingSnapshot", "TableServer"]
+__all__ = ["PublishRejected", "ServingSnapshot", "TableServer"]
+
+
+class PublishRejected(RuntimeError):
+    """A staged weights publish failed validation; the previous snapshot
+    is untouched and keeps serving."""
 
 
 class ServingSnapshot:
@@ -96,6 +115,9 @@ class TableServer:
         max_rows: int = 1 << 16,
         name: str = "tableserver",
         register_runtime: bool = True,
+        breaker_threshold: int = 5,
+        breaker_cooldown_s: float = 5.0,
+        breaker_clock=None,
     ):
         if mesh is None:
             from multiverso_tpu.runtime import runtime
@@ -113,10 +135,24 @@ class TableServer:
         )
         self.metrics = ServingMetrics(name)
         self.metrics.register_dashboard()
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.add_section(f"serving.{name}.{id(self)}.health",
+                              self._health_lines)
         self._snapshot: Optional[ServingSnapshot] = None
         self._publish_lock = threading.Lock()  # serialises publishers only
         self._version = 0
         self._jit_cache: Dict[Tuple, Any] = {}
+        # per-route circuit breakers (created lazily on first traffic);
+        # deterministic: state moves only on allow/record calls, and tests
+        # inject a fake clock through breaker_clock
+        import time as _time
+
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        self._breaker_clock = breaker_clock or _time.monotonic
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._breakers_lock = threading.Lock()
         self._batcher = DynamicBatcher(
             self._flush,
             max_batch=max_batch,
@@ -150,6 +186,9 @@ class TableServer:
     def stop(self) -> None:
         self._batcher.close()
         self.metrics.unregister_dashboard()
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.remove_section(f"serving.{self.name}.{id(self)}.health")
         if self._registered:
             from multiverso_tpu.runtime import runtime
 
@@ -169,13 +208,86 @@ class TableServer:
             sharding = mesh_lib.replicated_sharding(self.mesh)
         return jax.device_put(arr, sharding)
 
-    def publish(self, arrays: Dict[str, Any]) -> int:
-        """Stage new weights on device, then swap atomically. Returns the
-        new version. Queries in flight keep the old snapshot (double
-        buffering); queries arriving after the swap see only the new one.
+    def _validate_host(
+        self, host: Dict[str, np.ndarray], cur: Optional[ServingSnapshot],
+        allow_reshape: bool,
+    ) -> List[str]:
+        """Degradation gate: reasons to REJECT a staged publish. A poisoned
+        table (NaN/Inf from a diverged run, a half-written file) or a
+        shape/dtype drift against the live snapshot must never reach the
+        query path — routes compiled against the old geometry would serve
+        garbage or crash mid-flight.
+
+        Runs on HOST arrays, deliberately: publish executes concurrently
+        with in-flight query programs, and launching validation compute
+        onto the multi-device mesh from the publisher thread can deadlock
+        the fake-CPU backend's per-device executors against a racing
+        query launch. Transfers (the device_put staging below) are safe;
+        so is numpy."""
+        problems: List[str] = []
+        for name, arr in sorted(host.items()):
+            if np.issubdtype(arr.dtype, np.floating):
+                # full-table finiteness probe, once per publish (not per
+                # query); numpy scan — memory-bandwidth cheap vs the H2D
+                # staging copy that follows
+                if not bool(np.isfinite(arr).all()):
+                    problems.append(f"table {name!r} contains NaN/Inf values")
+            if cur is not None and not allow_reshape:
+                prev = cur.arrays.get(name)
+                if prev is not None:
+                    if tuple(prev.shape) != tuple(arr.shape):
+                        problems.append(
+                            f"table {name!r} shape {list(arr.shape)} != "
+                            f"serving shape {list(prev.shape)} "
+                            "(pass allow_reshape=True for intentional resizes)"
+                        )
+                    elif prev.dtype != arr.dtype:
+                        problems.append(
+                            f"table {name!r} dtype {arr.dtype} != "
+                            f"serving dtype {prev.dtype}"
+                        )
+        return problems
+
+    def publish(self, arrays: Dict[str, Any], *, allow_reshape: bool = False
+                ) -> int:
+        """Validate + stage new weights on device, then swap atomically.
+        Returns the new version. Queries in flight keep the old snapshot
+        (double buffering); queries arriving after the swap see only the
+        new one. A publish that fails validation raises
+        ``PublishRejected`` and leaves the current snapshot serving.
         """
         with self._publish_lock:
-            staged = {k: self._place(k, v) for k, v in arrays.items()}
+            # host view first: validation reads it (see _validate_host),
+            # and a rejected publish then costs no device placement at all
+            host = {
+                k: (v if isinstance(v, np.ndarray) else np.asarray(v))
+                for k, v in arrays.items()
+            }
+            problems = self._validate_host(
+                host, self._snapshot, allow_reshape
+            )
+            if problems:
+                self.metrics.record_publish_reject()
+                msg = (
+                    f"table server {self.name}: publish REJECTED "
+                    f"(v{self._version} keeps serving): " + "; ".join(problems)
+                )
+                Log.Error("%s", msg)
+                raise PublishRejected(msg)
+            cur = self._snapshot
+            if cur is not None:
+                # publish REPLACES the whole snapshot (the contract restore/
+                # rollback rely on): dropping a served table is allowed but
+                # must be LOUD — queries on that route start failing at
+                # validation, and a silent drop would read as data loss
+                dropped = sorted(set(cur.arrays) - set(host))
+                if dropped:
+                    Log.Error(
+                        "table server %s: publish drops served table(s) %s "
+                        "(snapshot replace; their routes will reject until "
+                        "republished)", self.name, ",".join(dropped),
+                    )
+            staged = {k: self._place(k, v) for k, v in host.items()}
             for v in staged.values():
                 v.block_until_ready()  # fully resident BEFORE visibility
             self._version += 1
@@ -199,10 +311,14 @@ class TableServer:
             {name: t.snapshot_array() for name, t in tables.items()}
         )
 
-    def restore(self, directory: str, names: Optional[Sequence[str]] = None) -> int:
+    def restore(self, directory: str, names: Optional[Sequence[str]] = None,
+                *, allow_reshape: bool = False) -> int:
         """Load-for-serving from an ``io/checkpoint.py`` checkpoint
         directory: restores raw table storages without constructing live
-        tables, names them ``table_<id>`` (or ``names`` in id order)."""
+        tables, names them ``table_<id>`` (or ``names`` in id order).
+        Rolling back to a prior checkpoint version whose tables were a
+        different size needs ``allow_reshape=True`` (the runbook's
+        serving-rollback flow)."""
         from multiverso_tpu.io.checkpoint import load_arrays
 
         stored = load_arrays(directory)
@@ -215,7 +331,7 @@ class TableServer:
             # table_10 before table_2 and silently serve the wrong weights
             by_id = sorted(stored, key=lambda k: int(k.rpartition("_")[2]))
             stored = {n: stored[k] for n, k in zip(names, by_id)}
-        return self.publish(stored)
+        return self.publish(stored, allow_reshape=allow_reshape)
 
     @property
     def snapshot(self) -> ServingSnapshot:
@@ -401,6 +517,7 @@ class TableServer:
             f"lookup ids out of range for table {name!r} "
             f"({table.shape[0]} rows)",
         )
+        self._shed_if_open(f"lookup:{name}")
         return self._batcher.submit(f"lookup:{name}", ids, block=block)
 
     def topk_async(self, name: str, queries, k: int = 10, block: bool = False):
@@ -413,6 +530,7 @@ class TableServer:
             f"{table.shape[1]}",
         )
         CHECK(1 <= k <= table.shape[0], f"k={k} out of range")
+        self._shed_if_open(f"topk:{name}:{int(k)}")
         return self._batcher.submit(f"topk:{name}:{int(k)}", q, block=block)
 
     def predict_async(self, name: str, X, block: bool = False):
@@ -423,29 +541,107 @@ class TableServer:
             X.ndim == 2 and X.shape[0] >= 1 and X.shape[1] == W.shape[1],
             f"features shape {X.shape} does not match weights {W.shape}",
         )
+        self._shed_if_open(f"predict:{name}")
         return self._batcher.submit(f"predict:{name}", X, block=block)
 
     def _require_started(self) -> None:
         CHECK(self._started, "TableServer.start() the batcher before *_async")
 
+    # ------------------------------------------------------------ degradation
+
+    def _breaker(self, route: str) -> CircuitBreaker:
+        with self._breakers_lock:
+            br = self._breakers.get(route)
+            if br is None:
+                br = CircuitBreaker(
+                    threshold=self._breaker_threshold,
+                    cooldown_s=self._breaker_cooldown_s,
+                    clock=self._breaker_clock,
+                )
+                self._breakers[route] = br
+            return br
+
+    def _shed_if_open(self, route: str) -> None:
+        """Submit-time fast shed: an open route rejects BEFORE queueing —
+        the request never costs a ticket, a batch slot or a dispatch.
+        ``peek`` (not ``allow``): the flush side owns the half-open probe
+        slot; claiming it here would shed the probe batch itself."""
+        allowed, retry_after = self._breaker(route).peek()
+        if not allowed:
+            self.metrics.record_shed()
+            raise Overloaded(retry_after)
+
+    def health(self) -> Dict[str, Any]:
+        """Operator-facing status struct: weights freshness, per-route
+        breaker states, queue pressure, reject/shed counts. Cheap enough
+        to poll; also rendered into the Dashboard."""
+        snap = self._snapshot
+        with self._breakers_lock:
+            breakers = {r: b.state for r, b in sorted(self._breakers.items())}
+        return {
+            "name": self.name,
+            "started": self._started,
+            "version": snap.version if snap is not None else 0,
+            "tables": snap.names() if snap is not None else [],
+            "last_swap_age_s": self.metrics.last_swap_age_s(),
+            "publish_rejects": self.metrics.publish_rejects,
+            "breakers": breakers,
+            "breakers_open": sorted(
+                r for r, s in breakers.items() if s != "closed"
+            ),
+            "queue_depth": self.metrics.queue_depth,
+            "served": self.metrics.served,
+            "shed": self.metrics.shed,
+        }
+
+    def _health_lines(self) -> List[str]:
+        h = self.health()
+        age = h["last_swap_age_s"]
+        return [
+            f"[Serving:{self.name}] health: v{h['version']} "
+            f"swap_age={-1.0 if age is None else round(age, 1)}s "
+            f"rejects={h['publish_rejects']} depth={h['queue_depth']} "
+            f"breakers_open={h['breakers_open'] or 'none'}"
+        ]
+
     def _flush(self, route: str, payloads: List[np.ndarray]) -> List[Any]:
         """Batcher flush: ONE padded-bucket program over the concatenated
         micro-batch, results split back per request. The whole batch pins
         a single snapshot reference — requests batched together always
-        answer from one weights version."""
-        snap = self.snapshot
-        kind, _, rest = route.partition(":")
-        sizes = [p.shape[0] for p in payloads]
-        flat = np.concatenate(payloads, axis=0)
-        bounds = np.cumsum(sizes)[:-1]
-        if kind == "lookup":
-            rows = self.lookup(rest, flat, snap=snap)
-            return [r for r in np.split(rows, bounds)]
-        if kind == "topk":
-            name, _, kstr = rest.rpartition(":")
-            idx, scores = self.topk(name, flat, k=int(kstr), snap=snap)
-            return list(zip(np.split(idx, bounds), np.split(scores, bounds)))
-        if kind == "predict":
-            probs = self.predict(rest, flat, snap=snap)
-            return [p for p in np.split(probs, bounds)]
-        raise ValueError(f"unknown route {route!r}")
+        answer from one weights version.
+
+        Runs behind the route's circuit breaker: an open route fails the
+        batch instantly with ``Overloaded`` (no device work); repeated
+        dispatch failures open it."""
+        br = self._breaker(route)
+        allowed, retry_after = br.allow()
+        if not allowed:
+            self.metrics.record_shed(len(payloads))
+            raise Overloaded(retry_after)
+        try:
+            if chaos.should_fail_route(route):
+                raise RuntimeError(f"chaos: injected failure on route {route!r}")
+            snap = self.snapshot
+            kind, _, rest = route.partition(":")
+            sizes = [p.shape[0] for p in payloads]
+            flat = np.concatenate(payloads, axis=0)
+            bounds = np.cumsum(sizes)[:-1]
+            if kind == "lookup":
+                rows = self.lookup(rest, flat, snap=snap)
+                results: List[Any] = [r for r in np.split(rows, bounds)]
+            elif kind == "topk":
+                name, _, kstr = rest.rpartition(":")
+                idx, scores = self.topk(name, flat, k=int(kstr), snap=snap)
+                results = list(
+                    zip(np.split(idx, bounds), np.split(scores, bounds))
+                )
+            elif kind == "predict":
+                probs = self.predict(rest, flat, snap=snap)
+                results = [p for p in np.split(probs, bounds)]
+            else:
+                raise ValueError(f"unknown route {route!r}")
+        except BaseException:
+            br.record_failure()
+            raise
+        br.record_success()
+        return results

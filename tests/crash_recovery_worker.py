@@ -1,0 +1,35 @@
+"""Crash-recovery e2e worker: the WordEmbedding CLI on the fake 8-device
+CPU pod, argv passed straight through. The test launches this three ways:
+
+1. with ``-checkpoint_dir`` + ``-chaos_kill_at_step=K`` — the process
+   REALLY dies (``os._exit(137)``) mid-run, leaving whatever the
+   crash-consistent checkpointer managed to publish;
+2. the same command without the kill — elastic resume picks up from the
+   latest valid checkpoint and finishes;
+3. without checkpointing at all — the uninterrupted golden.
+
+Final embeddings of (1)+(2) must match (3): the resume protocol replays
+the exact step sequence the crash interrupted.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from multiverso_tpu.models.wordembedding.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    rc = main(["crash_recovery_worker"] + sys.argv[1:])
+    if rc == 0:
+        print("WORKER_OK", flush=True)
+    sys.exit(rc)
