@@ -1061,6 +1061,73 @@ def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
     return batch * steps / dt
 
 
+def _bench_ps_comms(V=20000, dim=64, toks=300_000):
+    """PS comms leg: the pipelined PS rounds vs the sync baseline on the
+    zipf workload — pairs/sec, overlap %, and bytes/round for three
+    configs of the SAME training run:
+
+    * sync        — -ps_pipeline_depth=0 (the pinned parity mode);
+    * pipelined   — depth=1 + dirty-row tracked sparse pulls;
+    * compressed  — depth=1 + sparse pulls + -ps_compress=1bit packed
+      delta pushes (device-side pack/unpack, error-feedback residual).
+      1bit is the bench's compressed leg because its 32x is
+      workload-independent; -ps_compress=sparse only wins when >50%% of
+      a push block is zero (bucket padding), which the dense zipf unions
+      here don't reach — that mode's coverage lives in the lossless
+      bit-exactness tests.
+
+    Headline claims the driver checks: overlap_pct > 0 (the comms thread
+    actually hid pull/push time under training) and compressed
+    bytes/round < dense bytes/round both directions. MV_BENCH_PS_COMMS=0
+    skips."""
+    import os as _os
+
+    if _os.environ.get("MV_BENCH_PS_COMMS", "1") == "0":
+        return {}
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+
+    ids, d = _zipf_app_corpus(V, toks, seed=7)
+
+    def one(tag, **kw):
+        opt = WEOptions(
+            size=dim, negative=5, window=5, batch_size=4096,
+            steps_per_call=8, epoch=1, sample=0, min_count=0,
+            output_file="", use_ps=True, is_pipeline=False,
+            train_file="x", **kw,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        t0 = time.perf_counter()
+        loss = we.train(ids=ids.copy())
+        dt = time.perf_counter() - t0
+        assert np.isfinite(loss), (tag, loss)
+        rate = we.words_trained / max(dt, 1e-9)
+        stats = getattr(we, "_ps_stats", None)
+        return rate, (stats.to_dict() if stats is not None else None)
+
+    sync_rate, _ = one("sync")
+    pipe_rate, pipe_stats = one("pipelined", ps_pipeline_depth=1)
+    comp_rate, comp_stats = one(
+        "compressed", ps_pipeline_depth=1, ps_compress="1bit"
+    )
+    out = {
+        "ps_comms_sync_pairs_per_sec": round(sync_rate, 1),
+        "ps_comms_pipelined_pairs_per_sec": round(pipe_rate, 1),
+        "ps_comms_compressed_pairs_per_sec": round(comp_rate, 1),
+        "ps_comms_pipeline_speedup": round(pipe_rate / max(sync_rate, 1e-9), 3),
+        "ps_comms_overlap_pct": pipe_stats["overlap_pct"],
+        "ps_comms_rounds": pipe_stats["rounds"],
+        "ps_comms_pull_bytes_dense_per_round":
+            pipe_stats["pull_bytes_dense_per_round"],
+        "ps_comms_pull_bytes_wire_per_round":
+            pipe_stats["pull_bytes_wire_per_round"],
+        "ps_comms_push_bytes_dense_per_round":
+            comp_stats["push_bytes_dense_per_round"],
+        "ps_comms_push_bytes_wire_per_round":
+            comp_stats["push_bytes_wire_per_round"],
+    }
+    return out
+
+
 def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
                       period_steps=50, reps=3):
     """Resilience leg: what fault tolerance costs.
@@ -1293,6 +1360,11 @@ def main():
         lambda: _bench_ondevice(cfg, walk="presort"),
     )
     ps = leg("ps_loop", lambda: _bench_ps_loop(cfg))
+    try:
+        ps_comms = leg("ps_comms", _bench_ps_comms)
+    except Exception as e:
+        print(f"# leg ps_comms FAILED: {e}", file=_sys.stderr, flush=True)
+        ps_comms = {"ps_comms_error": str(e)[:200]}
     multidev = leg("multidevice", _bench_multidevice)
     sharded = leg("sharded_vocab", _bench_sharded_vocab)
     try:
@@ -1340,6 +1412,7 @@ def main():
     }
     out.update(roofline)
     out.update(fusedp)
+    out.update(ps_comms)
     out.update(multidev)
     out.update(sharded)
     out.update(bigvocab)

@@ -74,6 +74,43 @@ grep -q "resumed from" "$CKROOT/resume.log" \
 grep -q "WORKER_OK" "$CKROOT/resume.log" \
     || { echo "resumed run did not finish cleanly"; exit 1; }
 
+echo "== pipelined PS smoke (2-proc CPU-gloo, depth=1 + sparse compress) =="
+# the pipelined PS rounds end to end across REAL processes: comms-thread
+# overlap, dirty-row tracked sparse pulls and packed delta pushes must
+# keep the SPMD collective sequence lockstep — the smoke asserts loss
+# finiteness (in-worker), identical final tables, and ROUND-COUNT
+# lockstep + identical lr traces across ranks. Reuses the cluster
+# launcher's infra-retry/skip machinery from the pytest tier.
+PSROOT=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$PSROOT" <<'EOF'
+import re, sys
+import numpy as np
+
+sys.path.insert(0, ".")
+from tests.test_multiprocess_e2e import _run_cluster
+
+root = sys.argv[1]
+rng = np.random.RandomState(11)
+p = rng.randint(0, 30, 2000) * 2
+ids = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1).astype(np.int32)
+np.save(root + "/corpus.npy", ids)
+outs = _run_cluster(
+    "multiprocess_ps_worker.py",
+    lambda i: [root + "/corpus.npy", f"{root}/emb_{i}.npy",
+               "shard_pipelined_sparse"],
+    nproc=2, timeout=300,
+)
+rounds = [int(re.search(r"rounds=(\d+)", o).group(1)) for o in outs]
+assert rounds[0] == rounds[1] and rounds[0] > 2, rounds  # lockstep rounds
+traces = [re.search(r"lr_trace=(\S+)", o).group(1) for o in outs]
+assert traces[0] == traces[1], "lr traces diverged across ranks"
+e = [np.load(f"{root}/emb_{i}.npy") for i in range(2)]
+np.testing.assert_allclose(e[0], e[1], atol=1e-6)
+assert np.isfinite(e[0]).all() and np.abs(e[0]).max() > 1e-3
+print("pipelined PS smoke OK: rounds", rounds[0])
+EOF
+rm -rf "$PSROOT"
+
 echo "== multi-chip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

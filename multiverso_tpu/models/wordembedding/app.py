@@ -150,6 +150,35 @@ MV_DEFINE_string(
     "iid (with-replacement uniform draws; ~63% distinct coverage per "
     "epoch, measurably worse quality — benchmarks/QUALITY.md)",
 )
+# PS comms pipeline (the reference's -is_pipeline Communicator overlap,
+# ref: communicator.cpp:117-249 + async_buffer.h, rebuilt for the PS
+# table path): see README "PS comms" / DEPLOY.md for the tuning guide.
+MV_DEFINE_int(
+    "ps_pipeline_depth", 0,
+    "PS-mode software pipeline depth: 0 (default) = fully synchronous "
+    "rounds, bit-exact with prior releases; d >= 1 overlaps each block's "
+    "training with the NEXT d blocks' pulls and the previous block's "
+    "push on a comms thread — bounded staleness of exactly d rounds "
+    "(block k trains on tables missing pushes k-d..k-1; 1 = the "
+    "reference's -is_pipeline semantics)",
+)
+MV_DEFINE_string(
+    "ps_compress", "none",
+    "PS push-delta wire compression (pipelined path only): none | "
+    "sparse (SparseFilter (idx,val) pairs when >50%% of the block is "
+    "zero — lossless) | 1bit (OneBitsFilter sign+scale with per-row "
+    "error-feedback residual — 32x smaller, quantized; AdaGrad g2 "
+    "deltas always ride sparse, never 1bit). Pack/unpack run as jitted "
+    "device programs, so compression never stalls the host",
+)
+MV_DEFINE_bool(
+    "ps_sparse_pull", True,
+    "PS-mode dirty-row tracked pulls (pipelined path only): route the "
+    "tables through SparseMatrixTable so repeat pulls move only rows "
+    "dirtied since this worker's last pull (bitmap doubled when "
+    "pipelining, as the reference does); local fresh rows are served "
+    "from the client's row cache — values identical to a full pull",
+)
 
 
 @dataclasses.dataclass
@@ -183,6 +212,9 @@ class WEOptions:
     device_pipeline: bool = False
     upload_chunk_tokens: int = 0
     walk: str = "perm"
+    ps_pipeline_depth: int = 0
+    ps_compress: str = "none"
+    ps_sparse_pull: bool = True
     checkpoint_dir: str = ""
     checkpoint_every_steps: int = 0
     checkpoint_every_seconds: float = 0.0
@@ -195,6 +227,107 @@ class WEOptions:
     def from_flags(cls) -> "WEOptions":
         names = [f.name for f in dataclasses.fields(cls) if f.name != "seed"]
         return cls(**{n: GetFlag(n) for n in names})
+
+
+class _PSCommsStats:
+    """Per-run PS comms accounting: per-round pull/train/push wall time,
+    overlap %, and pre/post-compression byte counters. Registered as the
+    Dashboard "ps_comms" section so ``Dashboard.Display()`` reports the
+    pipeline's measured win (and ``to_dict`` feeds the bench leg).
+    Thread-safe: the comms thread and the training thread both record."""
+
+    def __init__(self, dim: int):
+        import threading
+
+        self._lock = threading.Lock()
+        self.dim = dim
+        self.rounds = 0
+        self.pull_s = 0.0
+        self.train_s = 0.0
+        self.push_s = 0.0
+        self.wall_s = 0.0
+        self.pull_rows_dense = 0  # rows a full (non-tracked) pull moves
+        self.pull_rows_wire = 0   # rows actually transferred
+        self.push_bytes_dense = 0  # pre-compression delta bytes
+        self.push_bytes_wire = 0   # bytes actually moved
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.add_section("ps_comms", self.lines)
+
+    def add_pull(self, dt: float, rows_dense: int, rows_wire: int) -> None:
+        with self._lock:
+            self.rounds += 1
+            self.pull_s += dt
+            self.pull_rows_dense += rows_dense
+            self.pull_rows_wire += rows_wire
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        # process-global cumulative mirror (this object is per-run)
+        Dashboard.counter("ps.pull_bytes_wire").add(rows_wire * self.dim * 4)
+
+    def add_train(self, dt: float) -> None:
+        with self._lock:
+            self.train_s += dt
+
+    def add_push(self, dt: float, bytes_dense: int, bytes_wire: int) -> None:
+        with self._lock:
+            self.push_s += dt
+            self.push_bytes_dense += bytes_dense
+            self.push_bytes_wire += bytes_wire
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.counter("ps.push_bytes_wire").add(bytes_wire)
+
+    def set_wall(self, seconds: float) -> None:
+        with self._lock:
+            self.wall_s = seconds
+
+    def overlap_pct(self) -> float:
+        """How much of the serialized stage time the pipeline hid:
+        ``(sum(stages) - wall) / sum(stages)``. 0 when the stages ran
+        strictly back to back (the sync path's shape), higher the more
+        pull/push rode under training."""
+        stages = self.pull_s + self.train_s + self.push_s
+        if stages <= 0 or self.wall_s <= 0:
+            return 0.0
+        return max(0.0, 100.0 * (stages - self.wall_s) / stages)
+
+    def to_dict(self) -> Dict[str, float]:
+        r = max(self.rounds, 1)
+        row_b = self.dim * 4
+        return {
+            "rounds": self.rounds,
+            "pull_ms_per_round": round(1e3 * self.pull_s / r, 3),
+            "train_ms_per_round": round(1e3 * self.train_s / r, 3),
+            "push_ms_per_round": round(1e3 * self.push_s / r, 3),
+            "overlap_pct": round(self.overlap_pct(), 1),
+            "pull_bytes_dense_per_round": round(
+                self.pull_rows_dense * row_b / r, 1
+            ),
+            "pull_bytes_wire_per_round": round(
+                self.pull_rows_wire * row_b / r, 1
+            ),
+            "push_bytes_dense_per_round": round(self.push_bytes_dense / r, 1),
+            "push_bytes_wire_per_round": round(self.push_bytes_wire / r, 1),
+        }
+
+    def lines(self) -> list:
+        d = self.to_dict()
+        return [
+            "[ps_comms] rounds=%d pull=%.2fms train=%.2fms push=%.2fms "
+            "per round, overlap=%.1f%%" % (
+                d["rounds"], d["pull_ms_per_round"],
+                d["train_ms_per_round"], d["push_ms_per_round"],
+                d["overlap_pct"],
+            ),
+            "[ps_comms] pull bytes/round dense=%.0f wire=%.0f; "
+            "push bytes/round dense=%.0f wire=%.0f" % (
+                d["pull_bytes_dense_per_round"],
+                d["pull_bytes_wire_per_round"],
+                d["push_bytes_dense_per_round"],
+                d["push_bytes_wire_per_round"],
+            ),
+        ]
 
 
 class WordEmbedding:
@@ -392,18 +525,39 @@ class WordEmbedding:
         table that coordinates the global lr decay,
         distributed_wordembedding.cpp:82-127)."""
         from multiverso_tpu.api import MV_CreateTable
-        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.tables import (
+            MatrixTableOption,
+            SparseMatrixTableOption,
+        )
 
         V, D = self.cfg.vocab_size, self.opt.size
         out_rows = int(self.params["emb_out"].shape[0])
         scale = 0.5 / D
-        self._t_in = MV_CreateTable(MatrixTableOption(
+        # Pipelined PS (-ps_pipeline_depth >= 1) with -ps_sparse_pull:
+        # the weight/g2 tables become SparseMatrixTables so repeat pulls
+        # move only rows dirtied since this client's last pull; the
+        # per-worker bitmap doubles (is_pipeline=True) exactly as the
+        # reference does for its prefetch buffer
+        # (sparse_matrix_table.cpp:187-190)
+        sparse = (
+            self.opt.ps_pipeline_depth >= 1 and self.opt.ps_sparse_pull
+        )
+
+        def _mk(**kw):
+            if sparse:
+                return MV_CreateTable(
+                    SparseMatrixTableOption(is_pipeline=True, **kw)
+                )
+            return MV_CreateTable(MatrixTableOption(**kw))
+
+        self._ps_sparse_tables = sparse
+        self._t_in = _mk(
             num_row=V, num_col=D, init_uniform=(-scale, scale),
             seed=self.cfg.seed, name="we_emb_in",
-        ))
-        self._t_out = MV_CreateTable(MatrixTableOption(
+        )
+        self._t_out = _mk(
             num_row=out_rows, num_col=D, name="we_emb_out",
-        ))
+        )
         # delta-averaging divisor = concurrent delta-pushing clients (ref:
         # communicator.cpp AddDeltaParameter divides by its worker count).
         # One client per PROCESS: mesh worker slices within a process are a
@@ -416,12 +570,10 @@ class WordEmbedding:
         # every rank reproduce the single-client rounds exactly)
         self._t_g2_in = self._t_g2_out = None
         if self.opt.use_adagrad:
-            self._t_g2_in = MV_CreateTable(MatrixTableOption(
-                num_row=V, num_col=D, name="we_g2_in",
-            ))
-            self._t_g2_out = MV_CreateTable(MatrixTableOption(
+            self._t_g2_in = _mk(num_row=V, num_col=D, name="we_g2_in")
+            self._t_g2_out = _mk(
                 num_row=out_rows, num_col=D, name="we_g2_out",
-            ))
+            )
         # shared word(pair)-count table driving the lr schedule: one row per
         # client; the global trained-pair count is the table sum, so every
         # rank decays its lr identically (ref: the word-count KV table,
@@ -438,8 +590,21 @@ class WordEmbedding:
             num_row=2 * nproc, num_col=1, dtype="int32", name="we_word_count",
         ))
         self._wc_bucket = max(2, self._t_wc.num_workers // nproc)
+        self._wc_row_ids = np.arange(2 * nproc, dtype=np.int32)
         self._wc_cum = 0  # this client's exact cumulative count (host int)
         self._ps_global_pairs = 0
+        # client-local row caches for the dirty-row tracked pull: server
+        # truth for every row this client has pulled, kept coherent by
+        # applying the client's OWN pushed deltas (other clients' pushes
+        # arrive via the staleness exchange -> re-pull)
+        if self._ps_sparse_tables:
+            self._ps_cache = {
+                "in": np.zeros((V, D), np.float32),
+                "out": np.zeros((out_rows, D), np.float32),
+            }
+            if self.opt.use_adagrad:
+                self._ps_cache["g2_in"] = np.zeros((V, D), np.float32)
+                self._ps_cache["g2_out"] = np.zeros((out_rows, D), np.float32)
 
     def _wc_push_and_read(self, inc: int) -> int:
         """Add this client's trained-pair increment and read back the global
@@ -463,7 +628,14 @@ class WordEmbedding:
         deltas[0, 0] = (c_new & mask) - (c_old & mask)
         deltas[1, 0] = (c_new >> 30) - (c_old >> 30)
         self._t_wc.add_rows_local(ids, deltas)
-        vals = np.asarray(self._t_wc.get()).astype(np.int64).reshape(-1)
+        # row-subset get of exactly the 2*nproc limb rows (baked-id
+        # program: multiprocess-safe, no whole-table materialisation —
+        # the table's storage may be padded well past the logical rows)
+        vals = (
+            self._t_wc.get_rows_fixed(self._wc_row_ids)
+            .astype(np.int64)
+            .reshape(-1)
+        )
         return int(vals[0::2].sum() + (vals[1::2].sum() << 30))
 
     def _ps_round_meta(self, have: int, ni: int, no: int):
@@ -495,6 +667,402 @@ class WordEmbedding:
         while b < n:
             b *= 2
         return b
+
+    # ------------------------------------------- PS mode: pipelined rounds
+    #
+    # The reference's -is_pipeline Communicator overlap (ref:
+    # communicator.cpp:117-249 on its own thread + async_buffer.h double
+    # buffering), rebuilt as a software pipeline over the block rounds:
+    # while block k trains on device, block k+1..k+d's pulls and block
+    # k-1's push run on a comms thread (utils.async_buffer.TaskPipe — one
+    # thread, strict submission order, so every rank's collective sequence
+    # stays SPMD-lockstep). Staleness contract at -ps_pipeline_depth=d:
+    # block k trains on tables missing exactly the last d blocks' deltas
+    # (pull k issued before pushes k-d..k-1 land), and the lr schedule
+    # reads the global pair count as of round k-d-1 — bounded, documented,
+    # and deterministic (every rank derives both from the same collective
+    # results, so lr traces still agree rank-to-rank). d=1 is the
+    # reference's one-round-stale pipeline; d=0 never reaches this path
+    # (bit-exact sync rounds).
+
+    def _ps_block_prep(self, batches: Optional[list]):
+        """Host-side prep of one block (no table access — safe on the
+        ASyncBuffer prefetch thread): node unions + compact-id remap +
+        presort, exactly the sync path's math. ``None`` stays ``None``
+        (local corpus exhausted; the rank still joins rounds)."""
+        if not batches:
+            return None
+        from multiverso_tpu.models.wordembedding.skipgram import presort_batch
+
+        o = self.opt
+        uin = np.unique(np.concatenate([b["centers"] for b in batches]))
+        okey = "points" if o.hs else "outputs"
+        uout = np.unique(
+            np.concatenate([b[okey].reshape(-1) for b in batches])
+        )
+        if o.cbow:
+            ctx = np.concatenate([b["contexts"].reshape(-1) for b in batches])
+            uin = np.unique(np.concatenate([uin, np.maximum(ctx, 0)]))
+        remapped = []
+        for b in batches:
+            rb = {"centers": np.searchsorted(uin, b["centers"]).astype(np.int32)}
+            if o.hs:
+                rb["points"] = np.searchsorted(uout, b["points"]).astype(np.int32)
+                rb["codes"], rb["lengths"] = b["codes"], b["lengths"]
+            else:
+                rb["outputs"] = np.searchsorted(uout, b["outputs"]).astype(np.int32)
+            if o.cbow:
+                cx = b["contexts"]
+                rb["contexts"] = np.where(
+                    cx >= 0, np.searchsorted(uin, np.maximum(cx, 0)), -1
+                ).astype(np.int32)
+            remapped.append(
+                presort_batch(rb, hs=o.hs, cbow=o.cbow, scale_mode=o.scale_mode)
+            )
+        xs_np = {
+            k: np.stack([b[k] for b in remapped])
+            for k in remapped[0]
+            if remapped[0][k] is not None
+        }
+        return {
+            "nbatches": len(batches), "uin": uin, "uout": uout, "xs": xs_np,
+        }
+
+    def _ps_entries(self):
+        """(name, table, side) in the FIXED per-round op order — every
+        rank must issue the same collective sequence."""
+        ent = [("in", self._t_in, "in"), ("out", self._t_out, "out")]
+        if self.opt.use_adagrad:
+            ent += [
+                ("g2_in", self._t_g2_in, "in"),
+                ("g2_out", self._t_g2_out, "out"),
+            ]
+        return ent
+
+    def _ps_pull_round(self, blk):
+        """Comms-thread pull task for one round: cross-rank meta
+        agreement, then the (optionally dirty-row tracked) pulls, then
+        the local model block assembly — all under the comms thread's
+        serialization, so the assembled block deterministically reflects
+        every push ordered before this pull and none after (the
+        documented d-round staleness). Returns ``None`` when no rank has
+        data (the loop's termination signal)."""
+        from multiverso_tpu.utils.dashboard import monitor
+
+        o = self.opt
+        t0 = time.perf_counter()
+        have = blk is not None
+        ni_u = int(blk["uin"].size) if have else 0
+        no_u = int(blk["uout"].size) if have else 0
+        any_data, ni, no = self._ps_round_meta(
+            1 if have else 0, ni_u, no_u
+        )
+        if not any_data:
+            return None
+        ids_in = np.zeros(ni, np.int64)
+        ids_out = np.zeros(no, np.int64)
+        if have:
+            ids_in[:ni_u] = blk["uin"]
+            ids_out[:no_u] = blk["uout"]
+        rows_dense = 0
+        rows_wire = 0
+        pulled = {}
+        with monitor("ps.pull"):
+            for name, table, side in self._ps_entries():
+                ids_b = ids_in if side == "in" else ids_out
+                n_u = ni_u if side == "in" else no_u
+                rows_dense += ids_b.size
+                if self._ps_sparse_tables:
+                    from multiverso_tpu.updaters import GetOption
+
+                    uids = (
+                        (blk["uin"] if side == "in" else blk["uout"])
+                        if have
+                        else np.zeros(0, np.int64)
+                    )
+                    stale, rows, wire = table.get_stale_rows_local(
+                        uids, GetOption(worker_id=table.client_view())
+                    )
+                    cache = self._ps_cache[name]
+                    if stale.size:
+                        cache[stale] = rows
+                    W = cache[ids_b]  # fancy indexing: already a copy
+                    rows_wire += wire
+                else:
+                    W = np.asarray(
+                        table.get_rows_local(ids_b), np.float32
+                    ).copy()
+                    rows_wire += ids_b.size
+                W[n_u:] = 0.0
+                pulled[name] = W
+        dt = time.perf_counter() - t0
+        self._ps_stats.add_pull(dt, rows_dense, rows_wire)
+        return {
+            "blk": blk, "ids_in": ids_in, "ids_out": ids_out,
+            "n_in": ni_u, "n_out": no_u, "pulled": pulled,
+        }
+
+    def _ps_train_block(self, pull, lr: float):
+        """Training-thread leg of one round: device step over the
+        assembled block + delta encode (jitted, device-side when
+        compressing). Returns ``(payloads, inc, loss_or_None)`` — dry
+        ranks produce zero payloads so the push stays lockstep."""
+        from multiverso_tpu.models.wordembedding.skipgram import (
+            SkipGramConfig,
+            make_sorted_superbatch_step,
+        )
+
+        o = self.opt
+        nw = self._num_workers
+        t0 = time.perf_counter()
+        ids_in, ids_out = pull["ids_in"], pull["ids_out"]
+        ni, no = ids_in.size, ids_out.size
+        n_in, n_out = pull["n_in"], pull["n_out"]
+        blk = pull["blk"]
+        entries = self._ps_entries()
+        if blk is None:
+            payloads = {}
+            for name, _table, side in entries:
+                ids_b = ids_in if side == "in" else ids_out
+                codec = self._ps_codecs[name]
+                if codec.mode == "none":
+                    payloads[name] = (
+                        "dense", np.zeros((ids_b.size, o.size), np.float32)
+                    )
+                else:
+                    z = jnp.zeros((ids_b.size, o.size), jnp.float32)
+                    payloads[name] = codec.encode(z, z, ids_b, 0, float(nw))
+            self._ps_stats.add_train(time.perf_counter() - t0)
+            return payloads, 0, None
+        nb = blk["nbatches"]
+        donate = o.ps_compress == "none"
+        key = (ni, no, nb, donate)
+        step = self._ps_steps.get(key)
+        if step is None:
+            cfg = SkipGramConfig(
+                vocab_size=ni, dim=o.size, negatives=o.negative,
+                cbow=o.cbow, window=o.window,
+            )
+            step = jax.jit(
+                make_sorted_superbatch_step(
+                    cfg, hs=o.hs, use_adagrad=o.use_adagrad
+                ),
+                # the compressed encode reads the OLD device params after
+                # the step — donation would invalidate them
+                donate_argnums=(0,) if donate else (),
+            )
+            self._ps_steps[key] = step
+        name2key = {
+            "in": "emb_in", "out": "emb_out",
+            "g2_in": "g2_in", "g2_out": "g2_out",
+        }
+        params = {
+            name2key[name]: jnp.asarray(pull["pulled"][name])
+            for name, _t, _s in entries
+        }
+        olds = None if donate else dict(params)
+        xs = {k: jnp.asarray(v) for k, v in blk["xs"].items()}
+        new_params, loss = step(params, xs, jnp.float32(lr))
+        payloads = {}
+        for name, _table, side in entries:
+            pk = name2key[name]
+            ids_b = ids_in if side == "in" else ids_out
+            n_u = n_in if side == "in" else n_out
+            codec = self._ps_codecs[name]
+            if codec.mode == "none":
+                d = np.asarray(new_params[pk]) - pull["pulled"][name]
+                d[n_u:] = 0.0
+                payloads[name] = ("dense", (d / nw).astype(np.float32))
+            else:
+                payloads[name] = codec.encode(
+                    new_params[pk], olds[pk], ids_b, n_u, float(nw)
+                )
+        self._ps_stats.add_train(time.perf_counter() - t0)
+        return payloads, o.batch_size * nb, loss
+
+    def _ps_push_round(self, payloads, ids_in, ids_out, n_in, n_out,
+                       inc: int) -> int:
+        """Comms-thread push task: apply every table's (possibly packed)
+        averaged delta in the fixed entry order, compensate the local row
+        caches with this client's own contribution, then run the shared
+        word-count round. Returns the new GLOBAL pair count (the lr
+        schedule's deterministic input d+1 rounds later)."""
+        from multiverso_tpu.updaters import AddOption
+        from multiverso_tpu.utils import quantization as q
+        from multiverso_tpu.utils.dashboard import monitor
+
+        t0 = time.perf_counter()
+        bytes_dense = 0
+        bytes_wire = 0
+        with monitor("ps.push"):
+            for name, table, side in self._ps_entries():
+                ids_b = ids_in if side == "in" else ids_out
+                n_u = n_in if side == "in" else n_out
+                pl = payloads[name]
+                bytes_dense += ids_b.size * self.opt.size * 4
+                bytes_wire += q.payload_nbytes(pl)
+                if self._ps_sparse_tables:
+                    opt = AddOption(worker_id=table.client_view())
+                    if pl[0] == "dense":
+                        table.add_rows_local(ids_b, pl[1], opt)
+                    else:
+                        table.add_rows_local_packed(ids_b, pl, opt)
+                    # coherence: the client's cache tracks server truth
+                    # for rows only IT pushes; rows other clients touch
+                    # come back via the staleness exchange
+                    dec = q.decode_payload(pl)
+                    if n_u:
+                        self._ps_cache[name][ids_b[:n_u]] += dec[:n_u]
+                else:
+                    if pl[0] == "dense":
+                        table.add_rows_local(ids_b, pl[1])
+                    else:
+                        table.add_rows_local_packed(ids_b, pl)
+            new_global = self._wc_push_and_read(inc)
+        self._ps_global_pairs = new_global
+        self._ps_stats.add_push(
+            time.perf_counter() - t0, bytes_dense, bytes_wire
+        )
+        return new_global
+
+    def _train_ps_pipelined(self, source, total_pairs_est: float,
+                            start: float) -> float:
+        """Pipelined PS training loop (see the block comment above for
+        the staleness contract). Blocks stream across epoch boundaries
+        without a per-epoch drain barrier — rounds are just blocks to the
+        table protocol, and the lr schedule is driven by the global
+        word-count table either way."""
+        from collections import deque
+
+        from multiverso_tpu.utils.async_buffer import ASyncBuffer, TaskPipe
+        from multiverso_tpu.utils.quantization import DeltaCodec
+
+        o = self.opt
+        depth = o.ps_pipeline_depth
+        S = max(1, o.steps_per_call)
+        V, D = self.cfg.vocab_size, o.size
+        out_rows = int(self.params["emb_out"].shape[0])
+        self._ps_stats = _PSCommsStats(D)
+
+        def _codec(name: str, rows: int) -> DeltaCodec:
+            mode = o.ps_compress
+            if name.startswith("g2") and mode == "1bit":
+                # g2 deltas are nonnegative accumulator increments — sign
+                # quantization would corrupt them; they ride the lossless
+                # sparse filter instead
+                mode = "sparse"
+            if mode == "1bit":
+                return DeltaCodec("1bit", num_row=rows, dim=D)
+            return DeltaCodec(mode)
+
+        self._ps_codecs = {
+            "in": _codec("in", V), "out": _codec("out", out_rows),
+        }
+        if o.use_adagrad:
+            self._ps_codecs["g2_in"] = _codec("g2_in", V)
+            self._ps_codecs["g2_out"] = _codec("g2_out", out_rows)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            total_global = float(
+                multihost_utils.process_allgather(
+                    np.asarray([total_pairs_est], np.float64)
+                ).sum()
+            )
+        else:
+            total_global = float(total_pairs_est)
+
+        def gen_blocks():
+            for epoch in range(o.epoch):
+                it = source.batches(epoch)
+                done = False
+                while not done:
+                    group = []
+                    while len(group) < S:
+                        b = next(it, None)
+                        if b is None:
+                            done = True
+                            break
+                        group.append(b)
+                    if group:
+                        yield group
+            while True:  # local corpus done: keep joining rounds dry
+                yield None
+
+        gen = gen_blocks()
+        # one-block-ahead prep prefetch (unions/remap/presort are host
+        # CPU heavy) — the reference ASyncBuffer reused as designed
+        buf = ASyncBuffer(lambda: self._ps_block_prep(next(gen)))
+        pipe = TaskPipe(name="mv-ps-comms")
+        pull_tickets: deque = deque()
+        push_tickets: Dict[int, object] = {}
+        r = 0
+        issued = 0
+        pairs_done = 0
+        loss_dev = None
+        log_every = o.batch_size * max(64, S * 8)
+        loop_t0 = time.perf_counter()
+        try:
+            while True:
+                # keep pulls for rounds r..r+depth in flight: pull k+d is
+                # submitted BEFORE push k..k+d-1, which is the whole
+                # overlap (and the whole staleness)
+                while issued <= r + depth:
+                    blk = buf.Get()
+                    pull_tickets.append(
+                        pipe.submit(
+                            lambda b=blk: self._ps_pull_round(b)
+                        )
+                    )
+                    issued += 1
+                pull = pull_tickets.popleft().result()
+                if pull is None:
+                    break
+                # deterministic lr: the newest wc round whose completion
+                # is ORDERED before this round's pull on the comms thread
+                lr_src = r - depth - 1
+                if lr_src >= 0:
+                    gp = push_tickets.pop(lr_src).result()
+                else:
+                    gp = 0
+                lr = self._lr(gp / total_global)
+                payloads, inc, loss = self._ps_train_block(pull, lr)
+                push_tickets[r] = pipe.submit(
+                    lambda pl=payloads, p=pull, i=inc: self._ps_push_round(
+                        pl, p["ids_in"], p["ids_out"], p["n_in"],
+                        p["n_out"], i,
+                    )
+                )
+                self._ps_lr_trace.append(lr)
+                if loss is not None:
+                    loss_dev = loss
+                prev = pairs_done
+                pairs_done += inc
+                if pairs_done // log_every > prev // log_every:
+                    rate = pairs_done / max(time.perf_counter() - start, 1e-9)
+                    Log.Info(
+                        "[WordEmbedding] PS pipelined (d=%d): %.1fM pairs, "
+                        "%.0fk pairs/s, lr %.5f, loss %.4f",
+                        depth, pairs_done / 1e6, rate / 1e3, lr,
+                        float(loss_dev) if loss_dev is not None else 0.0,
+                    )
+                r += 1
+        finally:
+            # drain: the already-submitted trailing pulls run their meta
+            # allgathers (every rank submitted the same count), queued
+            # pushes complete — collectives stay lockstep even on errors
+            pipe.close()
+            buf.Stop()
+        # surface any comms-thread error parked on a drained push ticket
+        for rr in sorted(push_tickets):
+            push_tickets[rr].result()
+        self._ps_stats.set_wall(time.perf_counter() - loop_t0)
+        self.params["emb_in"] = jnp.asarray(self._t_in.get())
+        self.params["emb_out"] = jnp.asarray(self._t_out.get())
+        self.words_trained = pairs_done
+        if o.output_file:
+            self.save_embeddings(o.output_file, binary=o.binary)
+        return float(loss_dev) if loss_dev is not None else 0.0
 
     def _run_superbatch_ps(self, batches: list, lr: float):
         """One PS block round (ref: the Communicator protocol —
@@ -624,11 +1192,16 @@ class WordEmbedding:
         return True, loss
 
     def _train_ps(self, source, total_pairs_est: float, start: float) -> float:
-        """PS-mode training loop: block = steps_per_call microbatches."""
+        """PS-mode training loop: block = steps_per_call microbatches.
+        ``-ps_pipeline_depth=0`` (default) runs the fully synchronous
+        rounds below — bit-exact with prior releases; depth >= 1 branches
+        to the software pipeline (``_train_ps_pipelined``)."""
         o = self.opt
         self._ps_setup()
         self._ps_steps: Dict = {}
         self._ps_lr_trace: list = []  # per-round lr (tests assert ranks agree)
+        if o.ps_pipeline_depth >= 1:
+            return self._train_ps_pipelined(source, total_pairs_est, start)
         S = max(1, o.steps_per_call)
         loss_dev = None
         pairs_done = 0
@@ -1018,6 +1591,15 @@ class WordEmbedding:
               "use row_mean there)")
         CHECK(o.walk in ("perm", "iid"),
               "-walk must be 'perm' or 'iid', got '%s'" % o.walk)
+        CHECK(o.ps_pipeline_depth >= 0,
+              "-ps_pipeline_depth must be >= 0, got %d" % o.ps_pipeline_depth)
+        CHECK(o.ps_compress in ("none", "sparse", "1bit"),
+              "-ps_compress must be none|sparse|1bit, got '%s'"
+              % o.ps_compress)
+        CHECK(o.ps_compress == "none" or o.ps_pipeline_depth >= 1,
+              "-ps_compress applies to the pipelined PS path only: set "
+              "-ps_pipeline_depth >= 1 (the depth-0 sync rounds stay the "
+              "pinned bit-exact parity mode)")
         CHECK(not (o.checkpoint_dir and o.device_pipeline),
               "-checkpoint_dir supports the host-batch fused path only "
               "(the device pipeline has no per-step host data cursor to "

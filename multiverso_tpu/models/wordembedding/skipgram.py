@@ -632,10 +632,10 @@ def make_fused_train_step(
     tiles issuing the same per-tile-sorted scatter-adds — the numerics
     oracle the kernel is tested against, bit-comparable up to float
     reassociation. ``'auto'`` resolves via
-    ``pallas_embed.resolve_fused_impl`` (currently 'xla' everywhere —
-    the compiled kernel's wall-clock is unmeasured this round, so the
-    kernel is explicit opt-in; the viability floor then guards any
-    pallas choice with a logged xla fallback). The resolved choice is exposed as
+    ``pallas_embed.resolve_fused_impl`` (pallas on real TPU backends at
+    dim >= 512 — the documented DMA break-even regime — xla everywhere
+    else; the viability floor guards any pallas choice with a logged xla
+    fallback). The resolved choice is exposed as
     ``step.impl``. AdaGrad is selected by the PARAMS pytree (g2_in/g2_out
     present — the ``fused_ns_train_step`` convention) identically in both
     impls; ``use_adagrad`` only informs the viability gate's VMEM scratch
@@ -1368,9 +1368,9 @@ def make_ondevice_superbatch_step(
     ``ops.pallas_embed`` train-step kernel (one HBM pass per touched row;
     per-tile sort metadata built on device by
     ``fused_sort_metadata_jnp``); 'auto' resolves via
-    ``pallas_embed.resolve_fused_impl`` (currently 'xla' everywhere —
-    the compiled kernel's wall-clock is unmeasured this round, see the
-    kernel module docstring).
+    ``pallas_embed.resolve_fused_impl`` (pallas on real TPU backends at
+    dim >= 512, xla everywhere else — see the resolution matrix in that
+    function's docstring).
     ``scale_mode='row_mean_exact'`` is not supported by the kernel and
     forces 'xla'. The sampled pair stream is bit-identical across impls
     (same keys, same decorrelation permutation)."""

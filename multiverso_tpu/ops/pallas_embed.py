@@ -195,11 +195,11 @@ _MIN_FUSED_LANE = 128   # row width floor (TPU lane tile)
 _MIN_FUSED_SUBLANE = 8  # batch-tile floor (f32 sublane tile)
 # Where per-row DMA issue cost is EXPECTED to amortise (the measured
 # ns_logits threshold story: D=128 rows lose 5x to DMA issue cost;
-# >= 512 is the documented break-even regime on v5e). This is the
-# candidate promotion threshold for impl='auto' — NOT yet applied: until
-# the compiled fused leg has bench numbers on real hardware (ROADMAP
-# open item), 'auto' stays on XLA everywhere and the kernel is explicit
-# opt-in (impl='pallas').
+# >= 512 is the documented break-even regime on v5e). impl='auto' now
+# promotes to the fused kernel at this dim on REAL TPU backends (ROADMAP
+# PR 1 NEXT item: flagship default at dim>=512 tables); every other
+# (backend, dim) cell resolves to 'xla'. The full resolution matrix is
+# pinned by tests/test_fused_step.py::TestAutoResolutionMatrix.
 _FUSED_AUTO_MIN_DIM = 512
 # VMEM scratch budget: v4/v5e cores carry ~16 MB of VMEM; leave headroom
 # for the scale/valid/loss blocks and compiler temporaries. A shape whose
@@ -263,18 +263,40 @@ def resolve_fused_impl(
     adagrad: bool = False
 ) -> str:
     """One policy for every fused-step entry point, the
-    ``ring_attention._resolve_impl`` convention: ``'auto'`` currently
-    resolves to 'xla' EVERYWHERE — the kernel's compiled wall-clock is
-    unmeasured this round (the bench fused_pallas leg exists but has not
-    produced hardware numbers yet), so promoting it into default paths
-    would ship an unbenchmarked Mosaic lowering to production; the
-    intended future policy is TPU backend + D >= _FUSED_AUTO_MIN_DIM
-    (see the constant's comment and the ROADMAP open item). The kernel is
-    explicit opt-in via impl='pallas'; the viability floor then applies
-    to any 'pallas' choice with a logged 'xla' fallback."""
+    ``ring_attention._resolve_impl`` convention. Resolution matrix
+    (pinned by tests/test_fused_step.py::TestAutoResolutionMatrix):
+
+    ========  ==========  ===================  =========
+    impl      backend     dim                  resolved
+    ========  ==========  ===================  =========
+    auto      tpu (real)  >= _FUSED_AUTO_MIN_DIM  pallas (if viable)
+    auto      tpu (real)  <  _FUSED_AUTO_MIN_DIM  xla
+    auto      non-tpu     any                  xla
+    auto      interpret   any                  xla (interpret kernels are
+                                               test opt-in, never a default)
+    xla       any         any                  xla
+    pallas    any         any                  pallas, demoted to xla by
+                                               the viability floor (logged)
+    ========  ==========  ===================  =========
+
+    'auto' promotes the fused kernel on real TPU backends at
+    dim >= _FUSED_AUTO_MIN_DIM — the documented DMA break-even regime
+    (the ROADMAP PR 1 flagship-default item); the viability floor (lane
+    alignment, sublane tile, VMEM scratch budget) still gates the
+    promotion, falling back to 'xla' with a logged reason rather than
+    shipping a shape Mosaic rejects."""
     assert impl in ("auto", "xla", "pallas"), impl
     if impl == "auto":
-        impl = "xla"
+        # promotion checks backend/dim only; the shared viability guard
+        # below demotes non-viable shapes (one fused_viable call total)
+        if (
+            not interpret
+            and dim >= _FUSED_AUTO_MIN_DIM
+            and jax.default_backend() == "tpu"
+        ):
+            impl = "pallas"
+        else:
+            impl = "xla"
     if impl == "pallas" and not fused_viable(
         interpret, dim=dim, tile=tile, ncol=ncol, adagrad=adagrad
     ):

@@ -19,9 +19,24 @@ src/table/sparse_matrix_table.cpp). Reference semantics preserved:
   sparse_matrix_table.cpp:187-190).
 
 What vanishes on TPU: the ``SparseFilter`` wire compression both directions
-(ref: sparse_matrix_table.cpp:148-153) — there is no wire; the dirty-row
-bookkeeping itself lives host-side (it is control metadata, exactly as the
-reference keeps it in server RAM) while row data stays in HBM.
+(ref: sparse_matrix_table.cpp:148-153) — there is no server wire; the
+dirty-row bookkeeping itself lives host-side (it is control metadata,
+exactly as the reference keeps it in server RAM) while row data stays in
+HBM. (The PUSH direction's compression survives on the wires TPU
+deployments do have — see ``MatrixTable.add_rows_local_packed``.)
+
+Cross-process (SPMD) support for the PS protocol: ``add_rows_local``
+allgathers the per-rank row-id buckets so each process can mark the rows
+OTHER ranks dirtied stale in its host-local bitmaps, and
+``get_stale_rows_local`` is the delta-tracked pull — only rows stale for
+this process's client view transfer (padded to a cross-rank-agreed bucket
+so the gather stays one identical SPMD program). The pipelined PS loop
+(``-ps_pipeline_depth``) constructs these tables with ``is_pipeline=True``,
+doubling the per-worker views exactly as the reference does for its
+prefetch buffer (sparse_matrix_table.cpp:187-190); the comms thread pulls
+through the even (buffer-0) views and its own pushes spare BOTH of the
+client's views, because the client keeps ONE coherent row cache that it
+compensates with its own pushed deltas.
 """
 
 from __future__ import annotations
@@ -90,6 +105,37 @@ class SparseMatrixTable(MatrixTable):
         CHECK(0 <= worker_id < self.num_views, f"bad worker/view id {worker_id}")
         return np.where(~self._up_to_date[worker_id])[0].astype(np.int32)
 
+    def client_view(self, buffer: int = 0) -> int:
+        """The calling PROCESS's view id under the one-logical-client-
+        per-process PS protocol: the first worker slice this process owns
+        (+ ``num_workers`` for the doubled pipeline buffer)."""
+        import jax
+
+        CHECK(0 <= buffer < self.num_views // self.num_workers,
+              f"buffer {buffer} out of range for {self.num_views} views")
+        lw = max(1, self.num_workers // jax.process_count())
+        return jax.process_index() * lw + buffer * self.num_workers
+
+    def _own_views(self, view: int) -> tuple:
+        """Every buffer view belonging to ``view``'s worker (a client's
+        own pushes leave ALL its buffers fresh — it compensates its one
+        shared row cache with its own deltas)."""
+        if not (0 <= view < self.num_views):
+            return ()
+        base = view % self.num_workers
+        return tuple(
+            base + k * self.num_workers
+            for k in range(self.num_views // self.num_workers)
+        )
+
+    def _mark_stale_rows(self, row_ids: np.ndarray, spare: tuple) -> None:
+        mask = np.ones(self.num_views, dtype=bool)
+        for v in spare:
+            mask[v] = False
+        ids = np.unique(np.asarray(row_ids, np.int64))
+        if ids.size:
+            self._up_to_date[np.ix_(mask, ids)] = False
+
     # ------------------------------------------------------------ overrides
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:
@@ -108,20 +154,66 @@ class SparseMatrixTable(MatrixTable):
         for w in range(ids.shape[0]):
             self._mark_stale(w, ids[w])
 
-    def add_rows_local(self, row_ids, deltas) -> None:
+    def add_rows_local(self, row_ids, deltas, option: Optional[AddOption] = None) -> None:
+        """Cross-process bucket Add WITH dirty tracking. The storage
+        update is the parent's SPMD scatter; the staleness exchange is
+        one small id allgather — each process marks the rows every OTHER
+        process pushed stale for all its local views, and its own rows
+        stale for every view except its own client's (both pipeline
+        buffers: the client's shared row cache is compensated with its
+        own delta, so its views stay coherent). Single-process: identical
+        to the parent's short-circuit plus the same marking."""
         import jax
 
-        # the dirty bitmaps are host-local per process: a rank cannot mark
-        # other ranks' row sets stale, so the cross-process bucket path
-        # would silently serve stale reads — reject it (the PS protocol
-        # uses plain MatrixTables)
-        CHECK(
-            jax.process_count() == 1,
-            "SparseMatrixTable.add_rows_local is single-process only: each "
-            "rank's dirty bitmaps cannot see other ranks' row sets; use a "
-            "MatrixTable for the cross-process bucket protocol",
-        )
-        super().add_rows_local(row_ids, deltas)  # -> add_rows (marks stale)
+        option = option or AddOption()
+        ids = np.asarray(row_ids, np.int64)
+        if jax.process_count() == 1:
+            # parent's storage path WITHOUT the add_rows dynamic dispatch
+            # (which would apply the coarse reference marking: stale for
+            # all views but one buffer of the adder)
+            MatrixTable.add_rows(self, row_ids, deltas)
+            self._mark_stale_rows(ids, self._own_views(option.worker_id))
+            return
+        MatrixTable.add_rows_local(self, row_ids, deltas)
+        from jax.experimental import multihost_utils
+
+        all_ids = np.asarray(
+            multihost_utils.process_allgather(ids.astype(np.int64))
+        ).reshape(jax.process_count(), -1)
+        p = jax.process_index()
+        others = np.unique(np.delete(all_ids, p, axis=0))
+        self._mark_stale_rows(others, ())
+        self._mark_stale_rows(all_ids[p], self._own_views(option.worker_id))
+
+    def add_rows_local_packed(self, row_ids, payload,
+                              option: Optional[AddOption] = None) -> None:
+        """Compressed-payload bucket Add (see
+        ``MatrixTable.add_rows_local_packed``) with the same staleness
+        exchange as ``add_rows_local``."""
+        import jax
+
+        option = option or AddOption()
+        if isinstance(payload, np.ndarray):
+            payload = ("dense", payload)
+        if payload[0] == "dense" and jax.process_count() == 1:
+            # delegate to this class's add_rows_local: the parent's dense
+            # short-circuit would route through self.add_rows, whose
+            # coarse reference marking spares only one buffer view
+            return self.add_rows_local(row_ids, payload[1], option)
+        ids = np.asarray(row_ids, np.int64)
+        MatrixTable.add_rows_local_packed(self, row_ids, payload)
+        if jax.process_count() == 1:
+            self._mark_stale_rows(ids, self._own_views(option.worker_id))
+            return
+        from jax.experimental import multihost_utils
+
+        all_ids = np.asarray(
+            multihost_utils.process_allgather(ids.astype(np.int64))
+        ).reshape(jax.process_count(), -1)
+        p = jax.process_index()
+        others = np.unique(np.delete(all_ids, p, axis=0))
+        self._mark_stale_rows(others, ())
+        self._mark_stale_rows(all_ids[p], self._own_views(option.worker_id))
 
     # ------------------------------------------------------------ sparse get
 
@@ -161,3 +253,60 @@ class SparseMatrixTable(MatrixTable):
             padded_n <<= 1
         padded = np.pad(stale, (0, padded_n - n), mode="edge")
         return stale, self.get_rows(padded)[:n]
+
+    def get_stale_rows_local(
+        self,
+        row_ids: np.ndarray,
+        option: Optional[GetOption] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """SPMD delta-tracked pull: among ``row_ids`` (this process's
+        round union), return ``(stale_ids, rows, wire_rows)`` — only the
+        rows stale for ``option.worker_id``'s view transfer; the caller
+        serves the rest from its local row cache. Marks the returned rows
+        fresh. ``wire_rows`` is the PADDED gather size actually moved
+        (the byte-accounting truth: single-process pads to the next power
+        of two; multi-process pads to the cross-rank-agreed bucket of
+        ``round_bucket`` so the gather is one identical SPMD program on
+        every rank — a rank with nothing stale still joins it whenever
+        any rank has stale rows). Returns ``(empty, empty, 0)`` — no
+        transfer at all — only when NO rank has stale rows. Unlike
+        ``get_sparse`` this does NOT send row 0 on an all-fresh round:
+        the reference's always-reply-row-0 quirk is wire-protocol parity,
+        and here an empty reply simply skips the gather."""
+        import jax
+
+        option = option or GetOption()
+        w = option.worker_id
+        CHECK(0 <= w < self.num_views, f"bad worker/view id {w}")
+        ids = np.asarray(row_ids, np.int64)
+        CHECK(ids.ndim == 1, "row_ids must be 1-D")
+        stale = ids[~self._up_to_date[w, ids]] if ids.size else ids
+        stale = np.unique(stale)
+        if jax.process_count() == 1:
+            if stale.size == 0:
+                return (
+                    stale.astype(np.int64),
+                    np.zeros((0, self.num_col), self.dtype),
+                    0,
+                )
+            self._up_to_date[w, stale] = True
+            from multiverso_tpu.utils import next_pow2
+
+            n = stale.size
+            padded_n = next_pow2(n)
+            padded = np.pad(stale, (0, padded_n - n), mode="edge")
+            return stale, self.get_rows(padded)[:n], padded_n
+        any_stale, bucket = self.round_bucket(int(stale.size))
+        if not any_stale:
+            return (
+                stale.astype(np.int64),
+                np.zeros((0, self.num_col), self.dtype),
+                0,
+            )
+        n = stale.size
+        padded = np.zeros(bucket, np.int64)
+        padded[:n] = stale
+        rows = self.get_rows_local(padded)[:n]
+        if n:
+            self._up_to_date[w, stale] = True
+        return stale, rows, bucket

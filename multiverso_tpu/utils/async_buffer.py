@@ -1,4 +1,4 @@
-"""Double-buffered prefetcher.
+"""Double-buffered prefetcher + ordered comms executor.
 
 TPU-native equivalent of the reference ASyncBuffer
 (ref: include/multiverso/util/async_buffer.h:10-116): a background thread
@@ -7,16 +7,24 @@ the ready one; ``Get()`` swaps. Used for pipelined model pulls
 (sync_frequency / pipeline mode — ref:
 Applications/LogisticRegression/src/model/ps_model.cpp:232-271) and block
 prefetch in WordEmbedding.
+
+``TaskPipe`` is the pipelined-PS communicator thread (the reference's
+Communicator + MtQueueMove handoff, communicator.cpp:117-249 running on its
+own thread): a single background thread executing submitted thunks in
+STRICT submission order. That ordering is the whole contract — every rank
+submits the identical sequence of collective table ops (meta allgather,
+pull, push), so the SPMD programs stay lockstep across processes while the
+training thread overlaps device compute with them.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Generic, Optional, TypeVar
+from typing import Any, Callable, Generic, Optional, TypeVar
 
 T = TypeVar("T")
 
-__all__ = ["ASyncBuffer"]
+__all__ = ["ASyncBuffer", "TaskPipe"]
 
 
 class ASyncBuffer(Generic[T]):
@@ -69,3 +77,87 @@ class ASyncBuffer(Generic[T]):
 
     get = Get
     stop = Stop
+
+
+class _Ticket:
+    """Result handle for one ``TaskPipe`` submission."""
+
+    __slots__ = ("_done", "_value", "_error")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block until the task ran on the pipe thread; re-raise its
+        exception there if it failed."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("TaskPipe task did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class TaskPipe:
+    """Single worker thread running submitted thunks strictly in
+    submission order; ``submit`` returns a ticket whose ``result()``
+    blocks and re-raises. Handoff rides the native ``MtQueue`` ticket
+    ring (runtime.cpp — the reference's MtQueueMove; the queue's Python
+    fallback engages when the native lib is absent). ``capacity`` bounds
+    in-flight tasks: a full ring blocks ``submit`` (natural backpressure
+    for a runaway producer)."""
+
+    def __init__(self, capacity: int = 64, name: str = "mv-taskpipe"):
+        from multiverso_tpu.native.host_runtime import MtQueue
+
+        assert capacity >= 1
+        self._ready: MtQueue = MtQueue()
+        self._free: MtQueue = MtQueue()
+        self._slots: list = [None] * capacity
+        for i in range(capacity):
+            self._free.push(i)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=name
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            slot = self._ready.pop()
+            if slot is None:  # exit() drained — no more tasks can arrive
+                return
+            fn, ticket = self._slots[slot]
+            self._slots[slot] = None
+            self._free.push(slot)
+            try:
+                ticket._value = fn()
+            except BaseException as e:  # surfaced at ticket.result()
+                ticket._error = e
+            finally:
+                ticket._done.set()
+
+    def submit(self, fn: Callable[[], Any]) -> _Ticket:
+        if self._closed:
+            raise RuntimeError("TaskPipe already closed")
+        ticket = _Ticket()
+        slot = self._free.pop()
+        if slot is None:
+            raise RuntimeError("TaskPipe torn down while submitting")
+        self._slots[slot] = (fn, ticket)
+        if not self._ready.push(slot):
+            raise RuntimeError("TaskPipe torn down while submitting")
+        return ticket
+
+    def close(self) -> None:
+        """Drain every queued task, then stop the thread (idempotent).
+        Exceptions from drained tasks stay parked on their tickets."""
+        if self._closed:
+            return
+        self._closed = True
+        self._ready.exit()  # pop() returns queued items, then None
+        self._thread.join(timeout=60)

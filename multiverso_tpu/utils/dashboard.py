@@ -19,7 +19,37 @@ from typing import Dict, Iterator, Optional
 
 from multiverso_tpu.utils.timer import Timer
 
-__all__ = ["Monitor", "Dashboard", "monitor"]
+__all__ = ["Monitor", "Counter", "Dashboard", "monitor"]
+
+
+class Counter:
+    """Plain value accumulator (bytes moved, rows transferred, rounds run)
+    — the Monitor's unit-less sibling for quantities that are not wall
+    time. Process-global and cumulative, like Monitors: the pipelined PS
+    loop mirrors its per-run wire-byte totals into the ``ps.*_bytes_wire``
+    counters so ``Display()`` shows lifetime traffic next to the per-run
+    ``ps_comms`` section."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += value
+
+    @property
+    def average(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def info_string(self) -> str:
+        return (
+            f"[Counter] {self.name}: count={self.count} "
+            f"total={self.total:.0f} avg={self.average:.1f}"
+        )
 
 
 class Monitor:
@@ -55,6 +85,7 @@ class Dashboard:
 
     _lock = threading.Lock()
     _monitors: Dict[str, Monitor] = {}
+    _counters: Dict[str, Counter] = {}
     _sections: Dict[str, object] = {}  # name -> () -> List[str]
 
     @classmethod
@@ -65,6 +96,15 @@ class Dashboard:
                 mon = Monitor(name)
                 cls._monitors[name] = mon
             return mon
+
+    @classmethod
+    def counter(cls, name: str) -> Counter:
+        with cls._lock:
+            ctr = cls._counters.get(name)
+            if ctr is None:
+                ctr = Counter(name)
+                cls._counters[name] = ctr
+            return ctr
 
     @classmethod
     def add_section(cls, name: str, fn) -> None:
@@ -80,6 +120,7 @@ class Dashboard:
     def Display(cls) -> str:
         with cls._lock:
             lines = [m.info_string() for m in cls._monitors.values()]
+            lines.extend(c.info_string() for c in cls._counters.values())
             sections = list(cls._sections.values())
         for fn in sections:  # outside the lock: sections take their own
             lines.extend(fn())
@@ -92,6 +133,7 @@ class Dashboard:
     def Reset(cls) -> None:
         with cls._lock:
             cls._monitors.clear()
+            cls._counters.clear()
             cls._sections.clear()
 
 

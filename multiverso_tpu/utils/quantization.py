@@ -4,8 +4,9 @@ TPU-native equivalent of the reference SparseFilter
 (ref: include/multiverso/util/quantization_util.h:10-158): per-blob, if more
 than half the entries are zero, rewrite as (index, value) pairs plus a size
 header; ``FilterIn`` compresses, ``FilterOut`` restores. On TPU there is no
-wire between workers and servers, so this is used for checkpoint/export
-compaction and for the C-API/IPC boundary.
+server wire, but the host<->device PCIe link and the cross-process
+collective transport are real wires — the PS push path
+(``-ps_compress=sparse|1bit``) moves exactly these payloads.
 
 ``OneBitsFilter`` implements the filter the reference declares but leaves
 empty (quantization_util.h:160-161): 1-bit SGD gradient compression — each
@@ -13,6 +14,19 @@ entry reduced to its sign, scaled by the mean absolute value of its sign
 class, with the quantization error fed back into the next round (Seide et
 al.'s error-feedback scheme, the standard completion of the reference's
 stub). 32x smaller payloads for delta pushes over DCN/IPC.
+
+Two layers:
+
+* the original host-side numpy filters (``SparseFilter``/``OneBitsFilter``)
+  — checkpoint/export compaction and the C-API/IPC boundary;
+* jit-traceable device kernels (``onebit_pack_jnp``/``onebit_unpack_jnp``,
+  ``sparse_pack_jnp``/``sparse_unpack_jnp``) sharing the numpy filters' bit
+  and (idx, val) layouts, so either side can decode the other. These run
+  INSIDE jitted programs — the pipelined PS push packs deltas on device
+  (compression never stalls the host) and the table unpacks inside its
+  scatter program, so only packed bytes cross the wire.
+  ``DeltaCodec`` wraps them per delta stream with a device-resident
+  per-row error-feedback residual for the 1-bit mode.
 """
 
 from __future__ import annotations
@@ -21,7 +35,15 @@ from typing import Optional, Tuple, Union
 
 import numpy as np
 
-__all__ = ["SparseFilter", "OneBitsFilter"]
+__all__ = [
+    "SparseFilter",
+    "OneBitsFilter",
+    "onebit_pack_jnp",
+    "onebit_unpack_jnp",
+    "sparse_pack_jnp",
+    "sparse_unpack_jnp",
+    "DeltaCodec",
+]
 
 Dense = np.ndarray
 Compressed = Tuple[str, tuple, np.ndarray, np.ndarray]  # ("sparse", shape, idx, vals)
@@ -99,3 +121,253 @@ class OneBitsFilter:
     # reference-style aliases
     FilterIn = filter_in
     FilterOut = filter_out
+
+
+# --------------------------------------------------------------------------
+# Device-side (jit-traceable) kernels.
+#
+# Bit/value layouts match the numpy filters above exactly (packbits is
+# MSB-first; sparse is ascending (idx, val) pairs), so a device-packed
+# payload decodes with the host filters and vice versa. All of these are
+# pure jnp and safe to call INSIDE other jitted programs — the PS tables
+# unpack inside their scatter programs so only packed bytes cross the
+# host<->device / cross-process wire.
+# --------------------------------------------------------------------------
+
+_BIT_WEIGHTS = np.array([128, 64, 32, 16, 8, 4, 2, 1], np.uint8)  # MSB-first
+
+
+def onebit_pack_jnp(x, valid=None):
+    """Trace-safe 1-bit pack of ``x`` (any shape): returns
+    ``(bits u8[ceil(n/8)], pos_scale f32, neg_scale f32)``. ``valid`` —
+    optional flat-broadcastable 0/1 mask; masked-out elements are excluded
+    from the scale means and packed as sign-positive (callers re-mask after
+    decode — ``onebit_unpack_jnp`` cannot know the mask)."""
+    import jax.numpy as jnp
+
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    if valid is None:
+        v = jnp.ones((n,), jnp.float32)
+    else:
+        v = valid.reshape(-1).astype(jnp.float32)
+    pos = (flat >= 0).astype(jnp.float32) * v
+    neg = (1.0 - (flat >= 0)) * v
+    # per-sign-class mean magnitude minimizes L2 quantization error
+    pos_scale = jnp.sum(flat * pos) / jnp.maximum(jnp.sum(pos), 1.0)
+    neg_scale = jnp.sum(flat * neg) / jnp.maximum(jnp.sum(neg), 1.0)
+    npad = -(-n // 8) * 8
+    bitsrc = jnp.pad((flat >= 0).astype(jnp.uint8), (0, npad - n))
+    bits = jnp.sum(
+        bitsrc.reshape(-1, 8) * jnp.asarray(_BIT_WEIGHTS), axis=1
+    ).astype(jnp.uint8)
+    return bits, pos_scale, neg_scale
+
+
+def onebit_unpack_jnp(bits, pos_scale, neg_scale, n):
+    """Trace-safe 1-bit decode: flat (n,) f32 of the two scale values
+    (``n`` static). Inverse of ``onebit_pack_jnp`` / ``OneBitsFilter``'s
+    bit layout."""
+    import jax.numpy as jnp
+
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    expanded = (bits[:, None] >> shifts) & jnp.uint8(1)
+    posmask = expanded.reshape(-1)[:n].astype(jnp.bool_)
+    return jnp.where(
+        posmask,
+        jnp.asarray(pos_scale, jnp.float32),
+        jnp.asarray(neg_scale, jnp.float32),
+    )
+
+
+def sparse_pack_jnp(x, cap):
+    """Trace-safe sparse pack: ``(count i32, idx i32[cap], vals f32[cap])``
+    of the nonzero entries of flat ``x`` (ascending idx, the SparseFilter
+    pair layout; padding slots carry idx 0 / val 0). ``cap`` is static —
+    callers size it from a counted readback; entries past ``cap`` are
+    DROPPED, so cap must be >= the nonzero count for a lossless
+    round-trip."""
+    import jax.numpy as jnp
+
+    flat = x.reshape(-1).astype(jnp.float32)
+    count = jnp.count_nonzero(flat).astype(jnp.int32)
+    (idx,) = jnp.nonzero(flat, size=cap, fill_value=0)
+    idx = idx.astype(jnp.int32)
+    live = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(count, cap)
+    vals = flat[idx] * live.astype(jnp.float32)
+    return count, idx, vals
+
+
+def sparse_unpack_jnp(idx, vals, n):
+    """Trace-safe sparse decode to a flat (n,) f32 (``n`` static).
+    Padding pairs are (0, 0.0) so a scatter-ADD restores exactly."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((n,), jnp.float32).at[idx].add(vals)
+
+
+def payload_nbytes(payload) -> int:
+    """Wire footprint of an encoded payload (array bytes + 8 per scalar
+    field) — the byte counters the ps_comms dashboard reports."""
+    if isinstance(payload, np.ndarray):
+        return payload.nbytes
+    tag = payload[0]
+    if tag == "dense":
+        return payload[1].nbytes
+    if tag == "sparse":
+        _, _shape, idx, vals, _count = payload
+        return int(idx.nbytes + vals.nbytes + 8)
+    if tag == "1bit":
+        _, _shape, bits, _pos, _neg, _nrows = payload
+        return int(bits.nbytes + 3 * 8)
+    raise ValueError(f"unknown payload tag {tag!r}")
+
+
+def decode_payload(payload) -> np.ndarray:
+    """Host-side decode of any push payload to a dense np.float32 array —
+    what the PS client applies to its local row cache (the values match
+    what the table's in-program unpack scatters, bit for bit)."""
+    if isinstance(payload, np.ndarray):
+        return payload
+    tag = payload[0]
+    if tag == "dense":
+        return payload[1]
+    if tag == "sparse":
+        _, shape, idx, vals, count = payload
+        flat = np.zeros(int(np.prod(shape)), np.float32)
+        flat[idx[:count]] = vals[:count]
+        return flat.reshape(shape)
+    if tag == "1bit":
+        _, shape, bits, pos, neg, nrows = payload
+        dense = OneBitsFilter.filter_out(
+            ("1bit", shape, bits, float(pos), float(neg))
+        )
+        dense[nrows:] = 0.0  # bucket padding rows carry no delta
+        return dense
+    raise ValueError(f"unknown payload tag {tag!r}")
+
+
+class DeltaCodec:
+    """Per-stream device-side delta encoder for PS push blocks.
+
+    One codec per (table, direction) stream. ``encode`` runs the whole
+    subtract / error-feedback / quantize pipeline in jitted device
+    programs (cached per bucket shape) and returns a HOST payload tuple —
+    the only device->host bytes moved are the packed ones:
+
+    * ``mode='none'``   — passthrough ``("dense", (new-old)/denom)``;
+    * ``mode='sparse'`` — SparseFilter layout when >50% of entries are
+      zero, dense passthrough otherwise (one counted-scalar readback
+      decides; lossless either way);
+    * ``mode='1bit'``   — OneBitsFilter layout with a PERSISTENT
+      device-resident per-row error-feedback residual (``(num_row, dim)``
+      f32, Seide et al. 2014): each encode quantizes
+      ``delta + residual[ids]`` and retains the new per-row error, so a
+      row's long-run pushed sum stays unbiased even across rounds that
+      touch it intermittently.
+
+    Payload tuples are understood by ``MatrixTable.add_rows_local_packed``
+    (in-program unpack before the scatter) and by ``decode_payload``
+    (host cache update).
+    """
+
+    def __init__(self, mode: str, num_row: int = 0, dim: int = 0):
+        assert mode in ("none", "sparse", "1bit"), mode
+        self.mode = mode
+        self._jits: dict = {}
+        self._residual = None
+        if mode == "1bit":
+            assert num_row > 0 and dim > 0, "1bit codec needs (num_row, dim)"
+            self._num_row, self._dim = int(num_row), int(dim)
+
+    def _jit(self, key, build):
+        fn = self._jits.get(key)
+        if fn is None:
+            fn = build()
+            self._jits[key] = fn
+        return fn
+
+    # ------------------------------------------------------------- encode
+
+    def encode(self, new_dev, old_dev, ids: np.ndarray, nrows: int,
+               denom: float):
+        """Encode ``(new - old) / denom`` for a padded row bucket.
+        ``ids``/``nrows`` — the bucket's global row ids and its real
+        (unpadded) row count; padding rows carry zero delta by
+        construction and are masked out of 1-bit scales/residuals."""
+        import jax
+        import jax.numpy as jnp
+
+        shape = tuple(new_dev.shape)
+        if self.mode == "none":
+            delta = (np.asarray(new_dev) - np.asarray(old_dev)) / denom
+            return ("dense", delta.astype(np.float32))
+        if self.mode == "sparse":
+            count_fn = self._jit(("count", shape), lambda: jax.jit(
+                lambda a, b: jnp.count_nonzero(
+                    (a - b).astype(jnp.float32)
+                ).astype(jnp.int32)
+            ))
+            nnz = int(count_fn(new_dev, old_dev))
+            size = int(np.prod(shape))
+            if nnz * 2 >= size:  # not sparse enough — dense passthrough
+                diff_fn = self._jit(("diff", shape), lambda: jax.jit(
+                    lambda a, b, d: (a - b).astype(jnp.float32) / d
+                ))
+                return (
+                    "dense",
+                    np.asarray(diff_fn(new_dev, old_dev, jnp.float32(denom))),
+                )
+            from multiverso_tpu.utils import next_pow2
+
+            cap = max(8, next_pow2(max(nnz, 1)))
+            pack_fn = self._jit(("pack", shape, cap), lambda: jax.jit(
+                lambda a, b, d: sparse_pack_jnp(
+                    (a - b).astype(jnp.float32) / d, cap
+                )
+            ))
+            count, idx, vals = pack_fn(new_dev, old_dev, jnp.float32(denom))
+            return (
+                "sparse", shape, np.asarray(idx), np.asarray(vals), int(count)
+            )
+        # 1bit: error-feedback quantization against the persistent residual
+        if self._residual is None:
+            self._residual = jnp.zeros(
+                (self._num_row, self._dim), jnp.float32
+            )
+
+        def build():
+            nr = self._num_row
+
+            def run(new, old, residual, ids_d, n, d):
+                delta = (new - old).astype(jnp.float32) / d
+                valid = (
+                    jnp.arange(new.shape[0], dtype=jnp.int32) < n
+                ).astype(jnp.float32)
+                x = (delta + residual[ids_d]) * valid[:, None]
+                vmask = jnp.broadcast_to(valid[:, None], x.shape)
+                bits, pos_s, neg_s = onebit_pack_jnp(x, valid=vmask)
+                deq = onebit_unpack_jnp(
+                    bits, pos_s, neg_s, x.size
+                ).reshape(x.shape) * vmask
+                # padding slots scatter out of bounds -> dropped (id-0
+                # duplicates would otherwise race on residual row 0)
+                ids_clean = jnp.where(
+                    jnp.arange(new.shape[0], dtype=jnp.int32) < n,
+                    ids_d, nr,
+                )
+                residual = residual.at[ids_clean].set(x - deq, mode="drop")
+                return bits, pos_s, neg_s, residual
+
+            return jax.jit(run, donate_argnums=(2,))
+
+        fn = self._jit(("1bit", shape), build)
+        bits, pos_s, neg_s, self._residual = fn(
+            new_dev, old_dev, self._residual,
+            jnp.asarray(np.asarray(ids, np.int32)), jnp.int32(nrows),
+            jnp.float32(denom),
+        )
+        return (
+            "1bit", shape, np.asarray(bits), float(pos_s), float(neg_s),
+            int(nrows),
+        )

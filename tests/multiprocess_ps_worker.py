@@ -17,6 +17,12 @@ mode=shard: uneven shards (weights nproc..1) force dry-rank lockstep
             rounds at the tail.
 mode=shard_adagrad: same, with -use_adagrad (the g2 accumulator tables
             ride the bucket protocol; ref communicator.cpp:17-31).
+mode=shard_pipelined: uneven shards through the PIPELINED PS path
+            (-ps_pipeline_depth=1: comms thread overlaps pull/train/push,
+            dirty-row tracked sparse pulls) — the cross-process leg of
+            the reference's -is_pipeline Communicator.
+mode=shard_pipelined_sparse: same plus -ps_compress=sparse (packed delta
+            pushes unpacked inside the SPMD scatter program).
 """
 
 import os
@@ -76,6 +82,8 @@ def main():
         epoch=1, sample=0, min_count=0, output_file=w2v_path, use_ps=True,
         is_pipeline=False, train_file="unused",
         use_adagrad=mode.endswith("adagrad"),
+        ps_pipeline_depth=1 if "pipelined" in mode else 0,
+        ps_compress="sparse" if mode.endswith("pipelined_sparse") else "none",
     )
     we = WordEmbedding(opt, dictionary=d)
     loss = we.train(ids=ids)
@@ -86,7 +94,8 @@ def main():
     trace = ",".join(f"{v:.8f}" for v in we._ps_lr_trace)
     print(
         f"WORKER_OK pid={pid} pairs={we.words_trained} "
-        f"global={we._ps_global_pairs} lr_trace={trace}",
+        f"global={we._ps_global_pairs} rounds={len(we._ps_lr_trace)} "
+        f"lr_trace={trace}",
         flush=True,
     )
 

@@ -260,6 +260,52 @@ def test_fused_impl_resolution_and_viability_floor():
     )
 
 
+class TestAutoResolutionMatrix:
+    """Pins the (impl, backend, dim) -> resolved matrix of
+    ``resolve_fused_impl`` (CPU-safe: the TPU cells monkeypatch
+    ``jax.default_backend``). 'auto' promotes to the fused kernel ONLY on
+    a real TPU backend at dim >= _FUSED_AUTO_MIN_DIM and only when the
+    shape passes the viability floor; every other cell is 'xla', and an
+    explicit choice is never overridden upward."""
+
+    def _fake_tpu(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def test_auto_promotes_on_tpu_at_break_even_dim(self, monkeypatch):
+        self._fake_tpu(monkeypatch)
+        assert pe.resolve_fused_impl("auto", False, dim=512, tile=256) == "pallas"
+        # above the threshold, still lane-aligned (tile shrunk to keep
+        # the VMEM scratch inside the budget at the wider dim)
+        assert pe.resolve_fused_impl("auto", False, dim=1024, tile=128) == "pallas"
+
+    def test_auto_stays_xla_below_break_even(self, monkeypatch):
+        self._fake_tpu(monkeypatch)
+        assert pe.resolve_fused_impl("auto", False, dim=128, tile=256) == "xla"
+        assert pe.resolve_fused_impl("auto", False, dim=256, tile=256) == "xla"
+
+    def test_auto_stays_xla_off_tpu_and_in_interpret(self, monkeypatch):
+        assert pe.resolve_fused_impl("auto", False, dim=512, tile=256) == "xla"
+        # interpret-mode kernels are explicit test opt-in, never a default
+        self._fake_tpu(monkeypatch)
+        assert pe.resolve_fused_impl("auto", True, dim=512, tile=256) == "xla"
+
+    def test_auto_respects_viability_floor(self, monkeypatch):
+        self._fake_tpu(monkeypatch)
+        # dim 520 >= threshold but not a lane multiple -> demoted
+        assert pe.resolve_fused_impl("auto", False, dim=520, tile=256) == "xla"
+        # VMEM scratch overflow (AdaGrad dim=640 tile=256) -> demoted
+        assert pe.resolve_fused_impl(
+            "auto", False, dim=640, tile=256, adagrad=True
+        ) == "xla"
+
+    def test_explicit_choices_unchanged(self, monkeypatch):
+        self._fake_tpu(monkeypatch)
+        assert pe.resolve_fused_impl("xla", False, dim=512, tile=256) == "xla"
+        assert pe.resolve_fused_impl("pallas", False, dim=512, tile=256) == "pallas"
+        # explicit pallas still demoted by the floor, never errors
+        assert pe.resolve_fused_impl("pallas", False, dim=520, tile=256) == "xla"
+
+
 def test_fused_adagrad_keyed_off_params_in_both_impls():
     """AdaGrad selection follows the params pytree identically in the
     kernel and the XLA reference: g2-carrying params with

@@ -201,6 +201,13 @@ print("GOLDEN_OK")
     (2, "shard"),
     pytest.param(4, "shard", marks=_skip_4proc_legacy_gloo),
     (2, "shard_adagrad"),
+    # pipelined PS rounds (-ps_pipeline_depth=1): the comms-thread
+    # overlap + dirty-row tracked sparse pulls must keep the SPMD
+    # collective sequence lockstep across ranks — same final tables,
+    # same lr trace, exact global count; the _sparse variant additionally
+    # routes packed delta pushes through the in-program unpack scatter
+    (2, "shard_pipelined"),
+    (2, "shard_pipelined_sparse"),
 ])
 def test_ps_wordembedding_sharded_corpus(tmp_path, nproc, mode):
     """Unequal corpus shards: block counts differ per rank, so the tail

@@ -1,0 +1,239 @@
+"""Pipelined PS rounds (-ps_pipeline_depth / -ps_compress /
+-ps_sparse_pull): the software pipeline over the PS block protocol.
+
+Contracts pinned here (single-process legs; the cross-process legs live
+in tests/test_multiprocess_e2e.py::test_ps_wordembedding_sharded_corpus
+[shard_pipelined / shard_pipelined_sparse] and the ci.sh smoke):
+
+* depth=0 (the default) runs the untouched synchronous rounds — the
+  bit-exact parity mode (two identical runs agree bitwise, and no
+  pipeline machinery is constructed);
+* depth=1 trains with EXACTLY one round of bounded staleness: it still
+  learns the corpus structure, matching a sync run within the documented
+  staleness tolerance (same pair-similarity structure, correlated
+  embeddings — not bitwise equality);
+* the dirty-row tracked pull serves values bit-identical to a full pull
+  (sparse vs dense pipelined runs agree bitwise), while moving a
+  fraction of the rows;
+* -ps_compress=sparse is lossless (bitwise equal to uncompressed
+  pipelined) and moves fewer push bytes; 1bit is quantized but
+  converges, with its error-feedback residual carried on device;
+* the ps_comms Dashboard section reports rounds / stage times /
+  overlap%% / byte counters;
+* the shared word-count table stays EXACT across the base-2^30 limb
+  carry, now read back through the row-subset get.
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+V = 200
+
+
+def _corpus(seed=0, n=6000):
+    rng = np.random.RandomState(seed)
+    p = rng.randint(0, V // 2, n) * 2
+    return (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+
+
+def _dict(ids):
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(V)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(
+        np.bincount(np.maximum(ids, 0), minlength=V), 1
+    ).astype(np.int64)
+    return d
+
+
+def _run_ps(ids, d, **kw):
+    """One PS training run inside its own runtime lifecycle; returns
+    (loss, embeddings, stats_dict_or_None, rounds)."""
+    import multiverso_tpu as mv
+
+    mv.MV_Init(["prog"])
+    try:
+        opt = WEOptions(
+            size=16, negative=3, window=2, batch_size=512, steps_per_call=2,
+            epoch=6, sample=0, alpha=0.1, output_file="", use_ps=True,
+            is_pipeline=False, **kw,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        loss = we.train(ids=ids)
+        emb = we.embeddings().copy()
+        stats = getattr(we, "_ps_stats", None)
+        return loss, emb, (stats.to_dict() if stats else None), len(
+            we._ps_lr_trace
+        )
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def _paircos(e):
+    """Mean cosine of the trained (2i, 2i+1) pairs — the corpus's learned
+    structure, robust to the staleness-induced parameter drift."""
+    a, b = e[0:V:2], e[1:V:2]
+    num = (a * b).sum(1)
+    den = np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1) + 1e-9
+    return float((num / den).mean())
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    ids = _corpus()
+    return ids, _dict(ids)
+
+
+def test_depth0_default_is_sync_and_deterministic(corpus):
+    """The default path must not grow pipeline machinery, and two
+    identical runs agree BITWISE — the pinned depth-0 parity mode."""
+    ids, d = corpus
+    l0, e0, s0, r0 = _run_ps(ids, d)
+    l1, e1, s1, _ = _run_ps(ids, d)
+    assert s0 is None and s1 is None  # no _PSCommsStats on the sync path
+    assert np.isfinite(l0)
+    np.testing.assert_array_equal(e0, e1)
+    assert r0 > 10
+
+
+def test_depth1_trains_within_staleness_tolerance(corpus):
+    """depth=1 = one-round bounded staleness: the run converges (loss
+    well under the ln2*(K+1)=2.77 no-signal floor) and learns the SAME
+    pair structure as the sync run. The tolerance is structural, not
+    bitwise — block k trains on tables missing exactly block k-1's
+    delta, so parameters drift while the learned geometry agrees (the
+    contract documented in README 'PS comms')."""
+    ids, d = corpus
+    l0, e0, _, r0 = _run_ps(ids, d)
+    l1, e1, s1, r1 = _run_ps(ids, d, ps_pipeline_depth=1)
+    assert np.isfinite(l1) and l1 < 1.0 and l0 < 1.0
+    assert abs(_paircos(e1) - _paircos(e0)) < 0.1
+    corr = np.corrcoef(e0.reshape(-1), e1.reshape(-1))[0, 1]
+    assert corr > 0.6, corr
+    assert r1 == r0  # same block count, rounds in lockstep
+    assert s1 is not None and s1["rounds"] == r1
+
+
+def test_sparse_pull_bitexact_vs_dense_pull(corpus):
+    """Dirty-row tracked pulls serve the SAME values a full pull would
+    (cache coherence: own pushes compensate the cache; there are no
+    other writers single-process) — while moving far fewer rows."""
+    ids, d = corpus
+    _, e_sparse, s_sparse, _ = _run_ps(ids, d, ps_pipeline_depth=1)
+    _, e_dense, s_dense, _ = _run_ps(
+        ids, d, ps_pipeline_depth=1, ps_sparse_pull=False
+    )
+    np.testing.assert_array_equal(e_sparse, e_dense)
+    assert (
+        s_sparse["pull_bytes_wire_per_round"]
+        < 0.25 * s_sparse["pull_bytes_dense_per_round"]
+    ), s_sparse
+    assert (
+        s_dense["pull_bytes_wire_per_round"]
+        == s_dense["pull_bytes_dense_per_round"]
+    )
+
+
+def test_sparse_compression_lossless_bitexact(corpus):
+    """-ps_compress=sparse round-trips deltas exactly (idx,val pairs or
+    dense passthrough), so the run is BITWISE equal to the uncompressed
+    pipelined run — and the pushed wire bytes shrink."""
+    ids, d = corpus
+    _, e_none, _, _ = _run_ps(ids, d, ps_pipeline_depth=1)
+    _, e_sp, s_sp, _ = _run_ps(
+        ids, d, ps_pipeline_depth=1, ps_compress="sparse"
+    )
+    np.testing.assert_array_equal(e_none, e_sp)
+    assert (
+        s_sp["push_bytes_wire_per_round"]
+        < s_sp["push_bytes_dense_per_round"]
+    ), s_sp
+
+
+def test_1bit_compression_converges_with_error_feedback(corpus):
+    """1-bit pushes quantize aggressively (32x) but the device-resident
+    per-row error-feedback residual keeps long-run updates unbiased: the
+    run must still learn (loss under the 2.77 no-signal floor)."""
+    ids, d = corpus
+    l1, e1, s1, _ = _run_ps(ids, d, ps_pipeline_depth=1, ps_compress="1bit")
+    assert np.isfinite(l1) and l1 < 2.0, l1
+    assert _paircos(e1) > 0.15
+    assert (
+        s1["push_bytes_wire_per_round"]
+        < 0.1 * s1["push_bytes_dense_per_round"]
+    ), s1
+
+
+def test_ps_comms_dashboard_section(corpus):
+    """The ps_comms section lands on the Dashboard: per-round stage
+    times, overlap %, and the byte counters."""
+    from multiverso_tpu.utils.dashboard import Dashboard
+
+    ids, d = corpus
+    _, _, s, _ = _run_ps(ids, d, ps_pipeline_depth=1, ps_compress="sparse")
+    out = Dashboard.Display()
+    assert "[ps_comms]" in out and "overlap=" in out
+    assert s["overlap_pct"] >= 0.0
+    for k in (
+        "pull_ms_per_round", "train_ms_per_round", "push_ms_per_round",
+        "pull_bytes_wire_per_round", "push_bytes_wire_per_round",
+    ):
+        assert s[k] >= 0.0
+
+
+def test_compress_requires_pipeline_depth(corpus):
+    from multiverso_tpu.utils.log import FatalError
+
+    ids, d = corpus
+    with pytest.raises(FatalError):
+        _run_ps(ids, d, ps_compress="sparse")  # depth=0
+
+
+def test_pipelined_adagrad_g2_tables_ride_along(corpus):
+    """-use_adagrad under the pipeline: the two g2 accumulator tables
+    ride the same sparse-pull/push rounds (1bit is demoted to the
+    lossless sparse filter for them)."""
+    ids, d = corpus
+    l1, e1, _, _ = _run_ps(
+        ids, d, ps_pipeline_depth=1, use_adagrad=True, ps_compress="1bit",
+    )
+    assert np.isfinite(l1) and l1 < 2.5
+    assert np.abs(e1).max() > 1e-3
+
+
+def test_word_count_exact_across_limb_carry(corpus):
+    """Regression for the 2^30 limb carry: the shared word-count table's
+    global count stays EXACT past int32 territory, read back through the
+    row-subset get (get_rows_fixed), and the stored limb rows never
+    exceed 2^30."""
+    import multiverso_tpu as mv
+
+    ids, d = corpus
+    mv.MV_Init(["prog"])
+    try:
+        opt = WEOptions(
+            size=16, negative=3, window=2, batch_size=128, epoch=1,
+            sample=0, output_file="", use_ps=True, train_file="unused",
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        we._ps_setup()
+        total = 0
+        # push increments that straddle the 2^30 lo-limb boundary twice
+        for inc in [(1 << 30) - 7, 5, 9, (1 << 30) - 1, 123]:
+            total += inc
+            got = we._wc_push_and_read(inc)
+            assert got == total, (got, total)
+        limbs = (
+            we._t_wc.get_rows_fixed(we._wc_row_ids)
+            .astype(np.int64).reshape(-1)
+        )
+        assert int(limbs[0::2].sum() + (limbs[1::2].sum() << 30)) == total
+        assert np.abs(limbs).max() < (1 << 30)  # no limb ever overflows
+    finally:
+        mv.MV_ShutDown(finalize=True)

@@ -1,0 +1,200 @@
+"""utils/quantization.py unit coverage (previously zero direct tests).
+
+Host filters: SparseFilter round-trip at the 50%-zeros decision boundary,
+empty/all-zero blocks, OneBitsFilter reconstruction + the error-feedback
+residual's convergence property (Seide et al. 2014: with the residual
+carried forward, the CUMULATIVE dequantized stream tracks the cumulative
+input stream — the long-run updates are unbiased).
+
+Device kernels: the jit-traceable pack/unpack pairs must round-trip and
+share the host filters' exact bit/(idx,val) layouts (either side decodes
+the other — the PS wire contract), and ``DeltaCodec`` must produce
+payloads whose host decode equals what the table-side in-program unpack
+scatters.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.utils.quantization import (
+    DeltaCodec,
+    OneBitsFilter,
+    SparseFilter,
+    decode_payload,
+    onebit_pack_jnp,
+    onebit_unpack_jnp,
+    payload_nbytes,
+    sparse_pack_jnp,
+    sparse_unpack_jnp,
+)
+
+# ---------------------------------------------------------------- host
+
+
+def test_sparse_filter_threshold_boundary():
+    """nz*2 >= size passes through dense; one fewer nonzero compresses.
+    8 elements: 4 nonzero = exactly half -> dense; 3 nonzero -> sparse."""
+    half = np.array([1.0, 2.0, 3.0, 4.0, 0, 0, 0, 0], np.float32)
+    out = SparseFilter.filter_in(half)
+    assert isinstance(out, np.ndarray)  # not sparse enough
+    np.testing.assert_array_equal(SparseFilter.filter_out(out), half)
+
+    below = half.copy()
+    below[3] = 0.0  # 3 nonzero of 8
+    out = SparseFilter.filter_in(below)
+    assert not isinstance(out, np.ndarray)
+    tag, shape, idx, vals = out
+    assert tag == "sparse" and shape == (8,)
+    assert idx.tolist() == [0, 1, 2] and vals.tolist() == [1.0, 2.0, 3.0]
+    np.testing.assert_array_equal(SparseFilter.filter_out(out), below)
+
+
+def test_sparse_filter_empty_and_all_zero():
+    empty = np.zeros((0,), np.float32)
+    out = SparseFilter.filter_in(empty)
+    # 0 nonzero * 2 >= 0 size: passthrough, round-trips to empty
+    np.testing.assert_array_equal(SparseFilter.filter_out(out), empty)
+
+    zeros = np.zeros((4, 6), np.float32)
+    out = SparseFilter.filter_in(zeros)
+    assert not isinstance(out, np.ndarray)  # fully sparse
+    assert out[2].size == 0 and out[3].size == 0
+    np.testing.assert_array_equal(SparseFilter.filter_out(out), zeros)
+
+
+def test_sparse_filter_2d_round_trip():
+    rng = np.random.RandomState(0)
+    arr = np.zeros((16, 8), np.float32)
+    mask = rng.rand(16, 8) < 0.2
+    arr[mask] = rng.randn(mask.sum())
+    out = SparseFilter.filter_in(arr)
+    assert not isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(SparseFilter.filter_out(out), arr)
+
+
+def test_onebit_reconstruction_and_scales():
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 10).astype(np.float32)
+    f = OneBitsFilter()
+    tag, shape, bits, pos, neg = f.filter_in(x)
+    assert tag == "1bit" and shape == x.shape
+    dec = OneBitsFilter.filter_out((tag, shape, bits, pos, neg))
+    # every entry is one of the two scales, sign-matched
+    assert set(np.unique(dec).tolist()) <= {np.float32(pos), np.float32(neg)}
+    assert ((dec >= 0) == (x >= 0)).all()
+    # the residual is exactly the quantization error of this round
+    np.testing.assert_allclose(f._residual, x - dec, atol=1e-6)
+
+
+def test_onebit_error_feedback_convergence():
+    """Carried residual makes the cumulative dequantized stream track the
+    cumulative input: after N rounds of the same filter instance,
+    |sum(inputs) - sum(decoded)| == |residual| stays bounded (it does NOT
+    grow with N), so long-run pushed updates are unbiased."""
+    rng = np.random.RandomState(2)
+    f = OneBitsFilter()
+    total_in = np.zeros((4, 8), np.float32)
+    total_out = np.zeros((4, 8), np.float32)
+    gaps = []
+    for _ in range(50):
+        x = rng.randn(4, 8).astype(np.float32) * 0.1
+        total_in += x
+        total_out += OneBitsFilter.filter_out(f.filter_in(x))
+        gaps.append(np.abs(total_in - total_out).max())
+    # the gap IS the residual magnitude — bounded, not accumulating
+    np.testing.assert_allclose(total_in - total_out, f._residual, atol=1e-4)
+    assert gaps[-1] < 1.0
+    assert np.mean(gaps[-10:]) < 2.0 * np.mean(gaps[:10]) + 0.5
+
+
+def test_onebit_stream_shape_change_rejected():
+    f = OneBitsFilter()
+    f.filter_in(np.ones((2, 3), np.float32))
+    with pytest.raises(ValueError):
+        f.filter_in(np.ones((4, 3), np.float32))
+
+
+# ---------------------------------------------------------------- device
+
+
+def test_device_onebit_layout_matches_host():
+    """Device pack -> host filter_out decode (and vice versa): the bit
+    layout is np.packbits MSB-first on both sides."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(5, 7).astype(np.float32)  # 35 bits: exercises tail pad
+    bits, pos, neg = jax.jit(onebit_pack_jnp)(jnp.asarray(x))
+    ref = OneBitsFilter().filter_in(x.copy())
+    np.testing.assert_array_equal(np.asarray(bits), ref[2])
+    assert np.isclose(float(pos), ref[3], atol=1e-6)
+    assert np.isclose(float(neg), ref[4], atol=1e-6)
+    host_dec = OneBitsFilter.filter_out(
+        ("1bit", x.shape, np.asarray(bits), float(pos), float(neg))
+    )
+    dev_dec = np.asarray(
+        jax.jit(lambda b, p, n: onebit_unpack_jnp(b, p, n, x.size))(
+            bits, pos, neg
+        )
+    ).reshape(x.shape)
+    np.testing.assert_allclose(dev_dec, host_dec, atol=1e-6)
+
+
+def test_device_sparse_round_trip_and_cap():
+    y = np.zeros(64, np.float32)
+    y[[1, 8, 33, 63]] = [0.5, -1.0, 2.0, -3.0]
+    count, idx, vals = jax.jit(lambda a: sparse_pack_jnp(a, 8))(jnp.asarray(y))
+    assert int(count) == 4
+    back = np.asarray(
+        jax.jit(lambda i, v: sparse_unpack_jnp(i, v, 64))(idx, vals)
+    )
+    np.testing.assert_array_equal(back, y)
+    # cap < nnz drops the tail (documented lossy case callers must avoid)
+    count2, idx2, vals2 = jax.jit(lambda a: sparse_pack_jnp(a, 2))(
+        jnp.asarray(y)
+    )
+    assert int(count2) == 4  # true count still reported
+    assert np.asarray(idx2).tolist() == [1, 8]
+
+
+def test_delta_codec_sparse_lossless_and_dense_fallback():
+    cod = DeltaCodec("sparse")
+    old = jnp.zeros((8, 8), jnp.float32)
+    sparse_delta = np.zeros((8, 8), np.float32)
+    sparse_delta[2, 3] = 4.0
+    pl = cod.encode(jnp.asarray(sparse_delta), old, np.arange(8), 8, 2.0)
+    assert pl[0] == "sparse"
+    np.testing.assert_array_equal(decode_payload(pl), sparse_delta / 2.0)
+    assert payload_nbytes(pl) < sparse_delta.nbytes
+    dense_delta = np.ones((8, 8), np.float32)
+    pl2 = cod.encode(jnp.asarray(dense_delta), old, np.arange(8), 8, 1.0)
+    assert pl2[0] == "dense"  # >50% nonzero: passthrough
+    np.testing.assert_array_equal(decode_payload(pl2), dense_delta)
+
+
+def test_delta_codec_1bit_residual_rows_and_padding_mask():
+    """Per-row device residual: only the REAL (unpadded) bucket rows'
+    residuals update; padding rows decode to exactly zero and touch
+    nothing (the id-0 duplicates in bucket padding must not corrupt row
+    0's residual)."""
+    rng = np.random.RandomState(4)
+    cod = DeltaCodec("1bit", num_row=32, dim=4)
+    ids = np.array([3, 9, 17, 0, 0, 0, 0, 0], np.int64)  # 3 real + padding
+    d = np.zeros((8, 4), np.float32)
+    d[:3] = rng.randn(3, 4)
+    pl = cod.encode(jnp.asarray(d), jnp.zeros((8, 4)), ids, 3, 1.0)
+    dec = decode_payload(pl)
+    assert np.all(dec[3:] == 0)
+    res = np.asarray(cod._residual)
+    np.testing.assert_allclose(res[ids[:3]], d[:3] - dec[:3], atol=1e-5)
+    assert np.all(res[0] == 0)  # padding id 0 never written
+    # second round feeds the error back for the same rows
+    pl2 = cod.encode(jnp.asarray(d), jnp.zeros((8, 4)), ids, 3, 1.0)
+    dec2 = decode_payload(pl2)
+    res2 = np.asarray(cod._residual)
+    np.testing.assert_allclose(
+        res2[ids[:3]], (d[:3] + res[ids[:3]]) - dec2[:3], atol=1e-5
+    )
+    # 32x-class wire win
+    assert payload_nbytes(pl) < d.nbytes / 4

@@ -277,6 +277,62 @@ def test_topk_matches_eval_scoring(server):
     assert np.allclose(scores, gscores, atol=1e-5)
 
 
+def test_sharded_topk_matches_golden_and_replicated(mv_env):
+    """The sharded cosine top-k (per-shard partial top-k inside
+    shard_map, merge of k*num_shards candidates — scores never
+    replicated) must agree EXACTLY with both the ``eval.cosine_topk``
+    numpy golden and the replicated program, ids and scores, across odd
+    k and query counts. 48 rows / 8 fake devices = 6 rows per shard, so
+    k=7 > rows-per-shard also exercises the kk=min(k, V/s) clamp."""
+    from multiverso_tpu.models.wordembedding.eval import cosine_topk
+
+    rng = np.random.RandomState(5)
+    emb = rng.randn(48, 16).astype(np.float32)
+    sharded = TableServer({"emb": emb}, topk_impl="sharded",
+                          register_runtime=False)
+    replicated = TableServer({"emb": emb}, topk_impl="replicated",
+                             register_runtime=False)
+    try:
+        for k, nq in [(1, 1), (3, 5), (7, 3), (12, 2)]:
+            q = rng.randn(nq, 16).astype(np.float32)
+            idx, sc = sharded.topk("emb", q, k=k)
+            gidx, gsc = cosine_topk(emb, q, k)
+            assert (idx == gidx).all(), (k, nq)
+            assert np.allclose(sc, gsc, atol=1e-5)
+            ridx, rsc = replicated.topk("emb", q, k=k)
+            assert (idx == ridx).all()
+            assert np.allclose(sc, rsc, atol=1e-6)
+    finally:
+        sharded.stop()
+        replicated.stop()
+
+
+def test_sharded_topk_guard_and_auto(mv_env):
+    """topk_impl='sharded' fails loudly on shard-indivisible tables
+    (they were placed replicated — there is nothing to shard over);
+    'auto' silently serves them through the replicated program."""
+    from multiverso_tpu.models.wordembedding.eval import cosine_topk
+    from multiverso_tpu.utils.log import FatalError
+
+    rng = np.random.RandomState(6)
+    emb = rng.randn(45, 8).astype(np.float32)  # 45 % 8 != 0
+    q = rng.randn(2, 8).astype(np.float32)
+    strict = TableServer({"emb": emb}, topk_impl="sharded",
+                         register_runtime=False)
+    auto = TableServer({"emb": emb}, topk_impl="auto",
+                       register_runtime=False)
+    try:
+        with pytest.raises(FatalError):
+            strict.topk("emb", q, k=3)
+        idx, sc = auto.topk("emb", q, k=3)
+        gidx, gsc = cosine_topk(emb, q, 3)
+        assert (idx == gidx).all()
+        assert np.allclose(sc, gsc, atol=1e-5)
+    finally:
+        strict.stop()
+        auto.stop()
+
+
 def test_predict_matches_sigmoid(server):
     srv, emb, W = server
     X = emb[:5]
