@@ -1141,8 +1141,18 @@ def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
     * overhead as % of step time at a checkpoint-every-``period_steps``
       policy, from the measured fused step rate (the SYNC bound; the
       async checkpointer hides the file write, paying only the
-      device_get snapshot).
+      device_get snapshot);
+    * failure-detection latency: wall time from a peer's last heartbeat
+      to the monitor declaring it dead (file-backed store, real clocks —
+      the number ``-heartbeat_deadline_s`` tuning starts from);
+    * ``drain()`` overhead vs pipeline depth: landing d in-flight comms
+      tasks at a round boundary (what every drained checkpoint and every
+      containment pays);
+    * quorum-commit cost: the stage-record + verify pass
+      (``verify_checkpoint`` re-reads and re-checksums the payload) on
+      top of the plain single-rank save.
     """
+    import os
     import shutil
     import tempfile
 
@@ -1151,6 +1161,12 @@ def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
         load_checkpoint,
         save_checkpoint,
     )
+    from multiverso_tpu.resilience import verify_checkpoint
+    from multiverso_tpu.resilience.watchdog import (
+        FileHeartbeatStore,
+        HeartbeatMonitor,
+    )
+    from multiverso_tpu.utils.async_buffer import TaskPipe
 
     rng = np.random.RandomState(0)
     arrays = {
@@ -1175,12 +1191,59 @@ def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
         best_save, best_resume = min(save_s), min(resume_s)
         step_s = (batch * scan_steps) / max(fused_pairs_per_sec, 1e-9)
         overhead_pct = 100.0 * best_save / (best_save + period_steps * step_s)
+        # quorum verify pass: re-read + re-checksum of the sealed payload
+        # (what rank 0's phase-2 gate and every latest_valid walk costs)
+        vpath = latest_valid(root)
+        t0 = time.perf_counter()
+        assert verify_checkpoint(vpath) is None
+        quorum_verify_ms = (time.perf_counter() - t0) * 1e3
+        # failure-detection latency: real clocks, tight drill intervals —
+        # beat a fake peer, stop, measure silence -> declared-dead wall
+        hb_dir = os.path.join(root, "hb")
+        deadline_s, interval_s = 0.15, 0.02
+        mon = HeartbeatMonitor(
+            FileHeartbeatStore(hb_dir, 0), rank=0, world=2,
+            deadline_s=deadline_s, interval_s=interval_s,
+        )
+        peer = FileHeartbeatStore(hb_dir, 1)
+        for s in range(3):
+            peer.beat(s)
+            mon.poll_once()
+            time.sleep(interval_s)
+        last_beat = time.perf_counter()  # peer goes silent now
+        while mon.failed() is None:
+            mon.poll_once()
+            time.sleep(interval_s)
+        detect_ms = (time.perf_counter() - last_beat) * 1e3
+        # drain() vs depth: d in-flight 1ms comms tasks landing at a
+        # round boundary
+        drain_ms = {}
+        for depth in (1, 2, 4, 8):
+            pipe = TaskPipe()
+            for _ in range(depth):
+                pipe.submit(lambda: time.sleep(1e-3))
+            t0 = time.perf_counter()
+            assert pipe.drain(timeout_s=30)
+            drain_ms[depth] = round((time.perf_counter() - t0) * 1e3, 2)
+            pipe.close()
         return {
             "resilience_ckpt_save_ms": round(best_save * 1e3, 1),
             "resilience_ckpt_mb": round(nbytes / 1e6, 1),
             "resilience_time_to_resume_ms": round(best_resume * 1e3, 1),
             f"resilience_ckpt_overhead_pct_every_{period_steps}_steps":
                 round(overhead_pct, 2),
+            "resilience_quorum_verify_ms": round(quorum_verify_ms, 1),
+            "resilience_quorum_verify_pct_of_save": round(
+                100.0 * quorum_verify_ms / max(best_save * 1e3, 1e-9), 1
+            ),
+            "resilience_failure_detect_ms": round(detect_ms, 1),
+            "resilience_failure_detect_budget_ms": round(
+                (deadline_s + interval_s) * 1e3, 1
+            ),
+            **{
+                f"resilience_drain_ms_depth{d}": v
+                for d, v in drain_ms.items()
+            },
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)

@@ -45,8 +45,14 @@ def main(argv=None):
     ap.add_argument("--swap-every", type=int, default=10,
                     help="publish new weights every N train steps")
     ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--health-port", type=int, default=-1,
+                    help="serve GET /healthz while the demo runs "
+                         "(-1 = off, 0 = ephemeral port, >0 explicit); "
+                         "the summary reports a self-probe of it")
     ap.add_argument("--assert-clean", action="store_true",
-                    help="exit 1 unless torn==0, shed==0, p99 finite")
+                    help="exit 1 unless torn==0, shed==0, p99 finite "
+                         "(and the /healthz self-probe returned ok when "
+                         "--health-port is armed)")
     args = ap.parse_args(argv)
 
     mv.MV_Init(["prog"])
@@ -61,6 +67,12 @@ def main(argv=None):
         max_delay_s=args.deadline_ms * 1e-3,
         name="demo",
     ).start()
+
+    health_srv = None
+    if args.health_port >= 0:
+        from multiverso_tpu.serving import HealthServer
+
+        health_srv = HealthServer(srv, port=args.health_port)
 
     # version registry: the torn-read oracle. version -> full table copy.
     history = {srv.version: np.asarray(params["emb_in"]).copy()}
@@ -136,6 +148,14 @@ def main(argv=None):
     trainer_th.join(timeout=10)
     wall = time.monotonic() - t0
 
+    healthz = None
+    if health_srv is not None:
+        # self-probe over real HTTP: the operator's path, end to end
+        import urllib.request
+
+        with urllib.request.urlopen(health_srv.url, timeout=10) as resp:
+            healthz = json.loads(resp.read().decode())
+
     print()
     Dashboard.Display()
     r = srv.metrics.report()
@@ -152,8 +172,15 @@ def main(argv=None):
         "p99_ms": r.get("lookup:emb_p99_ms"),
         "topk_p99_ms": r.get("topk:emb:5_p99_ms"),
         "wall_s": round(wall, 2),
+        "healthz_status": None if healthz is None else healthz.get("status"),
+        "healthz_version": (
+            None if healthz is None
+            else (healthz.get("serving") or {}).get("version")
+        ),
     }
     print(json.dumps(summary, indent=2))
+    if health_srv is not None:
+        health_srv.stop()
     srv.stop()
     mv.MV_ShutDown()
 
@@ -164,6 +191,7 @@ def main(argv=None):
             and summary["p99_ms"] is not None
             and np.isfinite(summary["p99_ms"])
             and summary["queries_served"] >= args.queries * 0.99
+            and (healthz is None or healthz.get("status") == "ok")
         )
         if not ok:
             print("SERVING SMOKE FAILED", file=sys.stderr)

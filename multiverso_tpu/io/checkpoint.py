@@ -22,20 +22,42 @@ rename makes it visible. A reader therefore never observes a torn
 directory; ``load_arrays``/``restore_tables`` verify the manifest first
 and die with ONE clear error naming the directory and the broken piece
 instead of an orbax stack trace.
+
+**Quorum commit** (failure-domain hardening): multi-process saves are
+TWO-PHASE. Phase 1 — every rank stages its payload (orbax shards, its
+``rank<p>/`` extra files) and seals its own fsynced
+``stage-rank<p>.json`` record. Phase 2 — rank 0 verifies every rank's
+stage record is present and parseable *before* the single commit
+rename; a missing/broken record aborts the commit (``QuorumAbort``) and
+sweeps the staging dir. A rank dying mid-save can therefore never
+publish a half checkpoint: the torn artifact is always an ignored
+``.tmp-`` corpse. The cross-rank sync points are bounded by
+``-collective_timeout_s`` (when armed) so a dead peer raises
+``RankFailure`` instead of hanging the save forever.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
+import threading
+import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from multiverso_tpu.resilience import checkpoint as rckpt
+from multiverso_tpu.resilience import chaos
 from multiverso_tpu.resilience.chaos import with_retries
+from multiverso_tpu.resilience.watchdog import (
+    QuorumAbort,
+    RankFailure,
+    collective_timeout_s,
+    fd_stats,
+)
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.utils.log import Log
 
@@ -58,10 +80,97 @@ def _tree_of(tables: List[Any]) -> Dict[str, Any]:
 
 
 def _sync(tag: str) -> None:
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    """Cross-rank checkpoint sync point, bounded by
+    ``-collective_timeout_s`` when armed: a peer that died mid-save makes
+    this raise ``RankFailure`` (no commit happened yet — the staging dir
+    is the only artifact) instead of hanging every survivor forever."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
 
+    timeout = collective_timeout_s()
+    if timeout is None:
         multihost_utils.sync_global_devices(tag)
+        return
+    err: List[BaseException] = []
+
+    def run():
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            err.append(e)
+
+    th = threading.Thread(target=run, daemon=True, name="mv-ckpt-sync")
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        rf = RankFailure(
+            "collective_timeout",
+            f"checkpoint sync point {tag!r} exceeded {timeout:.1f}s "
+            "(a peer likely died mid-save; no checkpoint was published)",
+        )
+        fd_stats.note_rank_failure("collective_timeout")
+        raise rf
+    if err:
+        raise err[0]
+
+
+_STAGE_PREFIX = "stage-rank"
+
+
+def _stage_record_path(tmp: str, rank: int) -> str:
+    return os.path.join(tmp, f"{_STAGE_PREFIX}{rank}.json")
+
+
+def _write_stage_record(tmp: str, rank_meta: Optional[Dict]) -> None:
+    """Phase-1 seal: this rank finished staging its payload. fsynced so a
+    crash after the sync point cannot leave a record the verifier reads
+    as complete while its bytes are still in flight."""
+    path = _stage_record_path(tmp, jax.process_index())
+    with open(path, "w") as f:
+        json.dump(
+            {"rank": jax.process_index(), "ok": True,
+             "rank_meta": rank_meta or {}},
+            f,
+        )
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _verify_quorum(tmp: str, attempts: int = 4,
+                   grace_s: float = 0.2) -> Dict[str, Dict]:
+    """Phase-2 gate (rank 0): every rank's stage record must be present
+    and parseable, else ``QuorumAbort``. Returns the merged per-rank
+    metadata for the manifest.
+
+    A short bounded re-read grace covers shared filesystems whose
+    attribute caches can hide a peer's just-written record for a moment
+    after the barrier (NFS) — a healthy save must not flake into an
+    abort; a genuinely dead rank still aborts within ~1s."""
+    missing: List[str] = []
+    for attempt in range(attempts):
+        ranks: Dict[str, Dict] = {}
+        missing = []
+        for p in range(jax.process_count()):
+            path = _stage_record_path(tmp, p)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                if not rec.get("ok"):
+                    raise ValueError("stage record not ok")
+                ranks[str(p)] = rec.get("rank_meta") or {}
+            except (OSError, ValueError) as e:
+                missing.append(f"rank {p} ({e})")
+        if not missing:
+            return ranks
+        if attempt < attempts - 1:
+            time.sleep(grace_s)
+    fd_stats.note_quorum_abort()
+    raise QuorumAbort(
+        "checkpoint quorum commit ABORTED — stage record missing or "
+        f"broken for {', '.join(missing)}; no version was published "
+        f"(staging dir {tmp} swept)"
+    )
 
 
 def _shared_token() -> str:
@@ -82,13 +191,25 @@ def save_tables(
     *,
     step: Optional[int] = None,
     meta: Optional[Dict] = None,
+    rank_payload: Optional[Callable[[str], None]] = None,
+    rank_meta: Optional[Dict] = None,
 ) -> str:
     """Write a crash-consistent sharded checkpoint of all (dense)
     registered tables; KV tables save alongside as npz (their index is
     host metadata). The directory appears atomically — write to
     ``<dir>.tmp-<token>``, seal with a checksummed ``MANIFEST.json``
     (carrying ``step``/``meta`` for elastic resume), rename. Returns the
-    path."""
+    path.
+
+    Two-phase quorum commit: every rank stages payload + its own
+    ``stage-rank<p>.json`` record; rank 0 verifies ALL stage records
+    before the single commit rename (``QuorumAbort`` and a swept staging
+    dir otherwise — a rank dying mid-save can never publish a half
+    checkpoint). ``rank_payload(tmp_dir)`` lets each rank stage extra
+    files of its own (e.g. the pipelined PS in-flight pull buffers — by
+    convention under ``rank<p>/``); ``rank_meta`` rides in that rank's
+    stage record and lands merged in the manifest as
+    ``meta["ranks"][str(p)]``."""
     import orbax.checkpoint as ocp
 
     from multiverso_tpu.tables.kv_table import KVTable
@@ -125,8 +246,6 @@ def save_tables(
             # PHYSICAL shard-padded storage (what restore_tables maps
             # straight back onto live tables), but a serving consumer
             # must not see padding rows — load_arrays crops with this
-            import json
-
             shapes = {f"table_{t.table_id}": list(t.shape) for t in dense}
             with open(os.path.join(tmp, "logical_shapes.json"), "w") as f:
                 json.dump(shapes, f)
@@ -134,10 +253,45 @@ def save_tables(
     for t in all_tables:
         if isinstance(t, KVTable):
             t.store(os.path.join(tmp, f"kv_{t.table_id}.npz"))
+    if rank_payload is not None:
+        rank_payload(tmp)
+    # phase 1 seal: this rank's staging is complete (chaos can drop it —
+    # what a rank dying between payload and seal looks like to rank 0)
+    if not chaos.quorum_stage_should_skip():
+        _write_stage_record(tmp, rank_meta)
     _sync("mv_ckpt_written")
+    commit_err: Optional[BaseException] = None
     if jax.process_index() == 0:
-        rckpt.commit_atomic(tmp, directory, step=step, meta=meta)
+        try:
+            ranks = _verify_quorum(tmp)
+            full_meta = dict(meta or {})
+            full_meta["ranks"] = ranks
+            rckpt.commit_atomic(tmp, directory, step=step, meta=full_meta)
+            fd_stats.note_quorum_commit()
+        except BaseException as e:  # noqa: BLE001 — ANY commit failure
+            # (QuorumAbort, a disk-full OSError in the manifest/rename,
+            # chaos) must join the commit sync first, THEN raise: peers
+            # must not hang on a barrier rank 0 never reaches
+            commit_err = e
     _sync("mv_ckpt_commit")
+    if commit_err is not None:
+        if isinstance(commit_err, QuorumAbort):
+            shutil.rmtree(tmp, ignore_errors=True)
+        Log.Error("checkpoint commit failed: %s", commit_err)
+        raise commit_err
+    if jax.process_index() != 0:
+        # rank 0 aborted (or died) before the rename: shared-fs truth is
+        # the absence of the published directory. Bounded re-probe: an
+        # NFS negative-dentry cache can hide a just-renamed directory
+        for attempt in range(4):
+            if os.path.isdir(directory):
+                break
+            time.sleep(0.2)
+        else:
+            raise QuorumAbort(
+                f"checkpoint {directory} was not published by rank 0 "
+                "(quorum commit aborted)"
+            )
     Log.Info("checkpoint saved: %s (%d dense tables)", directory, len(dense))
     return directory
 

@@ -28,6 +28,7 @@ Two training paths:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Dict, Optional
 
@@ -116,10 +117,13 @@ MV_DEFINE_int(
     "training (double buffering — hides the upload on weak links)",
 )
 # Fault tolerance (resilience subsystem): crash-consistent auto-checkpoints
-# + elastic resume on the host-batch fused path. A run killed at step K and
-# restarted with the same flags resumes from the latest valid checkpoint —
-# params (incl. optimizer slots), step counter, lr-schedule progress and the
-# data cursor all restore, so the result matches an uninterrupted run.
+# + elastic resume on the host-batch fused path, the device pipeline
+# (call-count cursor through the superbatch walk state) AND PS mode
+# (drained, quorum-committed round checkpoints incl. the pipelined path's
+# in-flight pull window). A run killed at step/call/round K and restarted
+# with the same flags resumes from the latest valid checkpoint — params
+# (incl. optimizer slots), counters, lr-schedule progress and the data
+# cursor all restore, so the result matches an uninterrupted run.
 MV_DEFINE_string(
     "checkpoint_dir", "",
     "root for crash-consistent training checkpoints (empty = off); "
@@ -127,7 +131,8 @@ MV_DEFINE_string(
 )
 MV_DEFINE_int(
     "checkpoint_every_steps", 0,
-    "auto-checkpoint every N dispatch steps (0 = off)",
+    "auto-checkpoint every N dispatch steps (fused paths) / N PS rounds "
+    "(0 = off)",
 )
 MV_DEFINE_double(
     "checkpoint_every_seconds", 0.0,
@@ -497,8 +502,10 @@ class WordEmbedding:
         these buffers — and only the file write rides the async thread."""
 
         def build():
+            # np.array (copy=True): device_get is zero-copy on CPU
+            # backends and the next dispatch donates these buffers
             host = {
-                k: np.asarray(jax.device_get(v))
+                k: np.array(jax.device_get(v))
                 for k, v in self.params.items()
             }
             meta = {
@@ -515,6 +522,53 @@ class WordEmbedding:
             )
 
         ckpt.maybe_save(step, build)
+
+    def _ondevice_maybe_checkpoint(
+        self, ckpt, calls: int, seq: int, pairs_done: int,
+        legs_done_pairs: int, total_pairs: int, walk_t: int,
+        epoch_done: int, accepted_dev, epoch_calls0: int,
+        synced_calls: int, ppc: float, key, restarts: int,
+    ) -> None:
+        """Device-pipeline checkpoint: params + the device-side data
+        cursor (leg seq, call count, walk_t, PRNG key) + the projection
+        state. The accepted accumulator is READ, not drained — the
+        regular sync cadence (and so the lr math) is untouched, which is
+        what makes kill+restart bit-identical to an uninterrupted run.
+        Snapshot happens on the training thread (the next dispatch
+        donates the param buffers); only the file write rides async."""
+
+        def build():
+            # np.array (copy=True): on CPU backends device_get returns a
+            # ZERO-COPY view of the device buffer, which the next
+            # dispatch donates — the async writer would read reused
+            # memory through it
+            host = {
+                k: np.array(jax.device_get(v))
+                for k, v in self.params.items()
+            }
+            host["__prng_key"] = np.array(jax.device_get(key))
+            meta = {
+                "kind": "device_pipeline",
+                "seq": int(seq),
+                "calls": int(calls),
+                "pairs_done": int(pairs_done),
+                "legs_done_pairs": int(legs_done_pairs),
+                "total_pairs": int(total_pairs),
+                "walk_t": int(walk_t),
+                "epoch_done": int(epoch_done),
+                "accepted_partial": float(accepted_dev),
+                "epoch_calls0": int(epoch_calls0),
+                "synced_calls": int(synced_calls),
+                "ppc": float(ppc),
+                "restarts": int(restarts),
+            }
+            from multiverso_tpu.resilience import save_checkpoint
+
+            return lambda: save_checkpoint(
+                ckpt.root, calls, arrays=host, meta=meta
+            )
+
+        ckpt.maybe_save(calls, build)
 
     # ---------------------------------------------------------- PS mode
 
@@ -593,6 +647,13 @@ class WordEmbedding:
         self._wc_row_ids = np.arange(2 * nproc, dtype=np.int32)
         self._wc_cum = 0  # this client's exact cumulative count (host int)
         self._ps_global_pairs = 0
+        # failure-domain round accounting (comms thread increments;
+        # containment reads after drain): pushes entered vs committed
+        self._ps_push_entered = 0
+        self._ps_rounds_pushed = 0
+        self._ps_restarts = 0
+        self._ps_codecs: Dict[str, object] = {}
+        self._ps_deadline_s = None
         # client-local row caches for the dirty-row tracked pull: server
         # truth for every row this client has pulled, kept coherent by
         # applying the client's OWN pushed deltas (other clients' pushes
@@ -739,7 +800,7 @@ class WordEmbedding:
             ]
         return ent
 
-    def _ps_pull_round(self, blk):
+    def _ps_pull_round(self, blk, round_idx: int = -1):
         """Comms-thread pull task for one round: cross-rank meta
         agreement, then the (optionally dirty-row tracked) pulls, then
         the local model block assembly — all under the comms thread's
@@ -747,8 +808,10 @@ class WordEmbedding:
         every push ordered before this pull and none after (the
         documented d-round staleness). Returns ``None`` when no rank has
         data (the loop's termination signal)."""
+        from multiverso_tpu.resilience import chaos
         from multiverso_tpu.utils.dashboard import monitor
 
+        chaos.maybe_hang_collective(round_idx)  # hung-collective drills
         o = self.opt
         t0 = time.perf_counter()
         have = blk is not None
@@ -894,6 +957,10 @@ class WordEmbedding:
         t0 = time.perf_counter()
         bytes_dense = 0
         bytes_wire = 0
+        # failure-domain accounting: entered vs completed tells the
+        # containment path whether the drained boundary is CLEAN (no push
+        # died between its first and last table collective)
+        self._ps_push_entered += 1
         with monitor("ps.push"):
             for name, table, side in self._ps_entries():
                 ids_b = ids_in if side == "in" else ids_out
@@ -920,10 +987,318 @@ class WordEmbedding:
                         table.add_rows_local_packed(ids_b, pl)
             new_global = self._wc_push_and_read(inc)
         self._ps_global_pairs = new_global
+        self._ps_rounds_pushed += 1  # this round's boundary is committed
         self._ps_stats.add_push(
             time.perf_counter() - t0, bytes_dense, bytes_wire
         )
         return new_global
+
+    # ------------------------------- PS mode: failure domains + checkpoints
+    #
+    # Failure-domain hardening (resilience subsystem): the pipelined
+    # collectives run behind per-ticket deadlines (-collective_timeout_s)
+    # and a peer-liveness watchdog (-heartbeat_deadline_s) — a hung or
+    # dead rank raises a structured RankFailure on the training thread,
+    # the pipe is poisoned (fail-fast PipelineBroken for later calls) and
+    # drain() lands every in-flight push at a consistent round boundary.
+    # Checkpoints: -checkpoint_dir/-checkpoint_every_steps count in PS
+    # ROUNDS (every rank checkpoints at the SAME round — the save is a
+    # two-phase quorum-committed collective). Pipelined checkpoints go
+    # through drain() first AND stage each rank's d in-flight pull
+    # buffers, so a resumed run replays the exact warm-up the staleness
+    # window left in flight — kill + restart == uninterrupted, bit for
+    # bit, at any depth.
+
+    class _Resolved:
+        """A pre-resolved ticket: what a checkpoint-staged pull (or wc
+        count) looks like to the resumed pipeline loop."""
+
+        __slots__ = ("_value",)
+
+        def __init__(self, value):
+            self._value = value
+
+        def result(self, timeout=None):
+            return self._value
+
+        def wait_result(self, *args, **kwargs):
+            return self._value
+
+        def done(self):
+            return True
+
+    def _ps_tables(self):
+        """The PS-mode table set, in creation order (checkpoint identity:
+        restore binds by the same order)."""
+        tabs = [self._t_in, self._t_out]
+        if self.opt.use_adagrad:
+            tabs += [self._t_g2_in, self._t_g2_out]
+        return tabs + [self._t_wc]
+
+    @staticmethod
+    def _pack_pull(out: Dict[str, np.ndarray], i: int, pull) -> None:
+        """Flatten one in-flight pull payload into npz-able keys."""
+        p = f"pull{i}_"
+        if pull is None:  # the termination sentinel (no rank has data)
+            out[p + "sentinel"] = np.int64(1)
+            return
+        out[p + "ids_in"] = pull["ids_in"]
+        out[p + "ids_out"] = pull["ids_out"]
+        out[p + "n_in"] = np.int64(pull["n_in"])
+        out[p + "n_out"] = np.int64(pull["n_out"])
+        for name, W in pull["pulled"].items():
+            out[p + "pulled_" + name] = W
+        blk = pull["blk"]
+        if blk is None:  # dry rank: joins rounds with zero deltas
+            out[p + "dry"] = np.int64(1)
+            return
+        out[p + "nbatches"] = np.int64(blk["nbatches"])
+        out[p + "uin"] = blk["uin"]
+        out[p + "uout"] = blk["uout"]
+        for k, v in blk["xs"].items():
+            out[p + "xs_" + k] = v
+
+    @staticmethod
+    def _unpack_pull(data, i: int):
+        p = f"pull{i}_"
+        if p + "sentinel" in data:
+            return None
+        pulled = {
+            k[len(p + "pulled_"):]: data[k]
+            for k in data.files if k.startswith(p + "pulled_")
+        }
+        pull = {
+            "ids_in": data[p + "ids_in"], "ids_out": data[p + "ids_out"],
+            "n_in": int(data[p + "n_in"]), "n_out": int(data[p + "n_out"]),
+            "pulled": pulled, "blk": None,
+        }
+        if p + "dry" not in data:
+            pull["blk"] = {
+                "nbatches": int(data[p + "nbatches"]),
+                "uin": data[p + "uin"], "uout": data[p + "uout"],
+                "xs": {
+                    k[len(p + "xs_"):]: data[k]
+                    for k in data.files if k.startswith(p + "xs_")
+                },
+            }
+        return pull
+
+    def _ps_rank_state_arrays(self, pulls) -> Dict[str, np.ndarray]:
+        """This rank's private resume state: the d in-flight pull
+        buffers, the sparse-pull client caches + staleness bitmaps, and
+        the 1-bit codecs' error-feedback residuals."""
+        out: Dict[str, np.ndarray] = {}
+        for i, pull in enumerate(pulls):
+            self._pack_pull(out, i, pull)
+        if self._ps_sparse_tables:
+            for name, cache in self._ps_cache.items():
+                out["cache_" + name] = cache
+            for name, table, _side in self._ps_entries():
+                out["bitmap_" + name] = table._up_to_date
+        for name, codec in self._ps_codecs.items():
+            if getattr(codec, "_residual", None) is not None:
+                out["residual_" + name] = np.asarray(codec._residual)
+        return out
+
+    def _ps_restore_rank_state(self, data, depth: int):
+        """Inverse of ``_ps_rank_state_arrays``; returns the staged pull
+        payloads (len == depth)."""
+        if self._ps_sparse_tables:
+            for name in list(self._ps_cache):
+                self._ps_cache[name][...] = data["cache_" + name]
+            for name, table, _side in self._ps_entries():
+                table._up_to_date[...] = data["bitmap_" + name]
+        for name, codec in self._ps_codecs.items():
+            key = "residual_" + name
+            if key in data.files:
+                codec._residual = jnp.array(data[key])
+        return [self._unpack_pull(data, i) for i in range(depth)]
+
+    def _ps_save_checkpoint(
+        self, round_idx: int, pairs_done: int, *, depth: int,
+        pulls=(), gp_history: Optional[Dict[int, int]] = None,
+        epoch: int = 0, batches_in_epoch: int = 0,
+    ) -> None:
+        """Quorum-committed PS checkpoint at a drained round boundary.
+        Every rank calls this at the SAME round (rounds are lockstep);
+        tables save collectively, each rank stages its private state as
+        ``rank<p>/state.npz`` through the two-phase protocol."""
+        from multiverso_tpu.io.checkpoint import save_tables
+        from multiverso_tpu.resilience.checkpoint import gc_checkpoints
+
+        o = self.opt
+        gp_history = gp_history or {}
+        pid = jax.process_index()
+
+        def rank_payload(tmp: str) -> None:
+            rdir = os.path.join(tmp, f"rank{pid}")
+            os.makedirs(rdir, exist_ok=True)
+            np.savez(os.path.join(rdir, "state.npz"),
+                     **self._ps_rank_state_arrays(pulls))
+
+        meta = {
+            "kind": "ps", "round": int(round_idx), "depth": int(depth),
+            "compress": o.ps_compress,
+            "sparse_pull": bool(self._ps_sparse_tables),
+            "adagrad": bool(o.use_adagrad),
+            "gp_history": {str(k): int(v) for k, v in gp_history.items()},
+            "gp_last": int(self._ps_global_pairs),
+        }
+        rank_meta = {
+            "pairs_done": int(pairs_done), "wc_cum": int(self._wc_cum),
+            "epoch": int(epoch), "batches_in_epoch": int(batches_in_epoch),
+            "restarts": int(self._ps_restarts),
+        }
+        path = os.path.join(o.checkpoint_dir, f"ckpt-{int(round_idx)}")
+        save_tables(path, self._ps_tables(), step=round_idx, meta=meta,
+                    rank_payload=rank_payload, rank_meta=rank_meta)
+        if pid == 0:
+            gc_checkpoints(o.checkpoint_dir, o.checkpoint_retain)
+
+    def _ps_maybe_resume(self, depth: int):
+        """Restore the latest valid PS checkpoint (tables + this rank's
+        private state); returns the resume record or None. Collective:
+        every rank must call this together."""
+        from multiverso_tpu.io.checkpoint import restore_tables
+        from multiverso_tpu.resilience import latest_valid
+        from multiverso_tpu.resilience import stats as _rstats
+        from multiverso_tpu.resilience.checkpoint import require_valid
+
+        o = self.opt
+        self._ps_restarts = 0
+        if not (o.checkpoint_dir and o.resume):
+            return None
+        path = latest_valid(o.checkpoint_dir)
+        if path is None:
+            return None
+        manifest = require_valid(path)
+        meta = manifest.get("meta") or {}
+        CHECK(meta.get("kind") == "ps",
+              f"checkpoint {path} is not a PS-mode checkpoint "
+              "(the fused host-batch and PS paths do not share roots)")
+        CHECK(int(meta.get("depth", -1)) == depth,
+              f"checkpoint {path} was written at -ps_pipeline_depth="
+              f"{meta.get('depth')} but this run uses {depth}: the staged "
+              "in-flight pull window would not line up — resume with the "
+              "same depth")
+        # the staged rank state (pull payloads, client caches, codec
+        # residuals) and the table set are flag-shaped: a silent mismatch
+        # would either KeyError on the npz or break the bit-exact resume
+        # contract — fail loudly like the fused path's params CHECK
+        for flag, current in (
+            ("compress", o.ps_compress),
+            ("sparse_pull", bool(self._ps_sparse_tables)),
+            ("adagrad", bool(o.use_adagrad)),
+        ):
+            CHECK(meta.get(flag) == current,
+                  f"checkpoint {path} was written with {flag}="
+                  f"{meta.get(flag)} but this run uses {current}: "
+                  "-ps_compress/-ps_sparse_pull/-use_adagrad must match "
+                  "the saved run to resume")
+        restore_tables(path, self._ps_tables())
+        pid = jax.process_index()
+        rmeta = (meta.get("ranks") or {}).get(str(pid))
+        CHECK(rmeta is not None,
+              f"checkpoint {path} has no rank {pid} state: it was written "
+              "by a different world size — relaunch with the original "
+              "process count")
+        pulls = []
+        if depth > 0:
+            with np.load(os.path.join(path, f"rank{pid}", "state.npz"),
+                         allow_pickle=False) as data:
+                pulls = self._ps_restore_rank_state(data, depth)
+        self._wc_cum = int(rmeta["wc_cum"])
+        self._ps_global_pairs = int(meta.get("gp_last", 0))
+        self._ps_restarts = int(rmeta.get("restarts", 0)) + 1
+        _rstats.note_restart(self._ps_restarts)
+        Log.Info(
+            "[WordEmbedding] resumed from %s: PS round %d, %.1fM pairs, "
+            "restart #%d",
+            path, int(meta["round"]), rmeta["pairs_done"] / 1e6,
+            self._ps_restarts,
+        )
+        return {
+            "round": int(meta["round"]),
+            "pairs_done": int(rmeta["pairs_done"]),
+            "epoch": int(rmeta.get("epoch", 0)),
+            "batches_in_epoch": int(rmeta.get("batches_in_epoch", 0)),
+            "gp_history": {
+                int(k): int(v)
+                for k, v in (meta.get("gp_history") or {}).items()
+            },
+            "pulls": pulls,
+        }
+
+    def _ps_await(self, ticket, round_idx: int, pipe, wd):
+        """Failure-domain-aware ticket wait: bounded by the collective
+        deadline + watchdog; transport-looking comms-thread errors are
+        promoted to structured RankFailure (and poison the pipe) while
+        logic errors propagate unchanged."""
+        from multiverso_tpu.resilience import watchdog as wdg
+
+        try:
+            return ticket.wait_result(
+                self._ps_deadline_s, wd, round_idx=round_idx
+            )
+        except (wdg.RankFailure, wdg.PipelineBroken):
+            raise
+        except BaseException as e:
+            rf = wdg.classify_collective_error(e, round_idx=round_idx)
+            if rf is None:
+                raise
+            wdg.fd_stats.note_rank_failure(rf.kind)
+            pipe.break_pipe(rf)
+            raise rf from e
+
+    def _ps_contain_failure(self, pipe, failure, round_idx: int, wd) -> None:
+        """Poisoned-pipe containment: mark the pipe broken, drain what
+        can still land so surviving state stops at a well-defined round
+        boundary, and publish a failure report next to the checkpoints
+        (recovery truth stays the last quorum-committed drained
+        checkpoint — a lone survivor cannot write a complete table
+        snapshot, its peers' shards died with them)."""
+        import json
+
+        from multiverso_tpu.resilience import latest_valid
+
+        o = self.opt
+        pipe.break_pipe(failure)
+        drained = pipe.drain(timeout_s=max(5.0, self._ps_deadline_s or 0.0))
+        committed = self._ps_rounds_pushed
+        clean = committed == self._ps_push_entered
+        last_ckpt = (
+            latest_valid(o.checkpoint_dir) if o.checkpoint_dir else None
+        )
+        report = {
+            "failure": str(failure),
+            "kind": getattr(failure, "kind", "unknown"),
+            "suspected_rank": getattr(failure, "rank", -1),
+            "detected_at_round": int(round_idx),
+            "committed_round_boundary": int(committed),
+            "boundary_clean": bool(clean),
+            "drained": bool(drained),
+            "heartbeat_ages_s": (
+                {str(k): v for k, v in wd.ages().items()}
+                if wd is not None else {}
+            ),
+            "resume_from": last_ckpt,
+        }
+        Log.Error(
+            "[WordEmbedding] PS rank failure CONTAINED at round %d: %s — "
+            "pushes committed through round boundary %d (clean=%s, "
+            "drained=%s); resume from %s",
+            round_idx, failure, committed, clean, drained,
+            last_ckpt or "<no checkpoint>",
+        )
+        if o.checkpoint_dir:
+            os.makedirs(o.checkpoint_dir, exist_ok=True)
+            path = os.path.join(
+                o.checkpoint_dir, f"FAILURE-round{int(round_idx)}.json"
+            )
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=1)
+            os.replace(tmp, path)
 
     def _train_ps_pipelined(self, source, total_pairs_est: float,
                             start: float) -> float:
@@ -989,21 +1364,81 @@ class WordEmbedding:
             while True:  # local corpus done: keep joining rounds dry
                 yield None
 
+        from multiverso_tpu.resilience import chaos
+        from multiverso_tpu.resilience import watchdog as wdg
+
+        self._ps_deadline_s = wdg.collective_timeout_s()
+        ckpt_every = (
+            o.checkpoint_every_steps if o.checkpoint_dir else 0
+        )
+        # elastic resume (collective): restore tables + wc state + this
+        # rank's staged in-flight pulls, then advance the block stream to
+        # the drained boundary — the resumed loop replays the exact
+        # pipeline warm-up the checkpoint left in flight, so kill +
+        # restart == uninterrupted bit for bit at any depth
+        resume = self._ps_maybe_resume(depth)
         gen = gen_blocks()
-        # one-block-ahead prep prefetch (unions/remap/presort are host
-        # CPU heavy) — the reference ASyncBuffer reused as designed
-        buf = ASyncBuffer(lambda: self._ps_block_prep(next(gen)))
-        pipe = TaskPipe(name="mv-ps-comms")
-        pull_tickets: deque = deque()
-        push_tickets: Dict[int, object] = {}
         r = 0
         issued = 0
         pairs_done = 0
+        pull_tickets: deque = deque()
+        push_tickets: Dict[int, object] = {}
+        resume_round = -1
+        if resume is not None:
+            r = resume_round = resume["round"]
+            issued = r + depth
+            pairs_done = resume["pairs_done"]
+            for pull in resume["pulls"]:  # rounds r..r+depth-1, in order
+                pull_tickets.append(self._Resolved(pull))
+            for k, gp in resume["gp_history"].items():
+                push_tickets[k] = self._Resolved(gp)
+            # regenerate-and-discard the consumed blocks: same seed, same
+            # grouping, so block `issued` onward is bit-identical
+            for _ in range(issued):
+                next(gen)
+        # one-block-ahead prep prefetch (unions/remap/presort are host
+        # CPU heavy) — the reference ASyncBuffer reused as designed
+        buf = ASyncBuffer(lambda: self._ps_block_prep(next(gen)))
+        wd = wdg.monitor_from_flags()
+        pipe = TaskPipe(name="mv-ps-comms")
         loss_dev = None
         log_every = o.batch_size * max(64, S * 8)
         loop_t0 = time.perf_counter()
         try:
             while True:
+                chaos.maybe_drop_rank(r)  # failure-domain drills
+                if (
+                    ckpt_every and r > 0 and r % ckpt_every == 0
+                    and r != resume_round
+                ):
+                    # planned drained checkpoint: land every in-flight
+                    # push (consistent boundary: tables hold exactly
+                    # rounds < r), then quorum-save tables + the staged
+                    # pull window rounds r..r+depth-1. The drain is
+                    # bounded by the collective deadline when armed — a
+                    # peer dying mid-drain raises instead of hanging.
+                    if not pipe.drain(timeout_s=self._ps_deadline_s):
+                        raise wdg.RankFailure(
+                            "collective_timeout",
+                            "pre-checkpoint drain timed out",
+                            round_idx=r,
+                        )
+                    if wd is not None:
+                        wd.check()
+                    # ticket reads go through the classified await: a
+                    # transport error parked on a drained ticket must hit
+                    # the containment handler, not escape raw
+                    self._ps_save_checkpoint(
+                        r, pairs_done, depth=depth,
+                        pulls=[
+                            self._ps_await(t, r, pipe, wd)
+                            for t in pull_tickets
+                        ],
+                        gp_history={
+                            k: self._ps_await(t, r, pipe, wd)
+                            for k, t in push_tickets.items()
+                        },
+                    )
                 # keep pulls for rounds r..r+depth in flight: pull k+d is
                 # submitted BEFORE push k..k+d-1, which is the whole
                 # overlap (and the whole staleness)
@@ -1011,18 +1446,23 @@ class WordEmbedding:
                     blk = buf.Get()
                     pull_tickets.append(
                         pipe.submit(
-                            lambda b=blk: self._ps_pull_round(b)
+                            lambda b=blk, rr=issued: self._ps_pull_round(
+                                b, rr
+                            ),
+                            tag=f"pull:{issued}",
                         )
                     )
                     issued += 1
-                pull = pull_tickets.popleft().result()
+                pull = self._ps_await(pull_tickets.popleft(), r, pipe, wd)
                 if pull is None:
                     break
                 # deterministic lr: the newest wc round whose completion
                 # is ORDERED before this round's pull on the comms thread
                 lr_src = r - depth - 1
-                if lr_src >= 0:
-                    gp = push_tickets.pop(lr_src).result()
+                if lr_src in push_tickets:  # absent only in the warm-up
+                    gp = self._ps_await(
+                        push_tickets.pop(lr_src), r, pipe, wd
+                    )
                 else:
                     gp = 0
                 lr = self._lr(gp / total_global)
@@ -1031,7 +1471,8 @@ class WordEmbedding:
                     lambda pl=payloads, p=pull, i=inc: self._ps_push_round(
                         pl, p["ids_in"], p["ids_out"], p["n_in"],
                         p["n_out"], i,
-                    )
+                    ),
+                    tag=f"push:{r}",
                 )
                 self._ps_lr_trace.append(lr)
                 if loss is not None:
@@ -1047,11 +1488,20 @@ class WordEmbedding:
                         float(loss_dev) if loss_dev is not None else 0.0,
                     )
                 r += 1
+        except (wdg.RankFailure, wdg.PipelineBroken) as failure:
+            # a hung/dead peer: contain instead of hanging — poison the
+            # pipe, drain what can still land, publish the failure report
+            self._ps_contain_failure(pipe, failure, r, wd)
+            raise
         finally:
             # drain: the already-submitted trailing pulls run their meta
             # allgathers (every rank submitted the same count), queued
-            # pushes complete — collectives stay lockstep even on errors
-            pipe.close()
+            # pushes complete — collectives stay lockstep even on errors.
+            # On a broken pipe the join is best-effort: the worker may be
+            # stuck inside a hung collective.
+            if wd is not None:
+                wd.stop()
+            pipe.close(timeout_s=5.0 if pipe.broken is not None else 60.0)
             buf.Stop()
         # surface any comms-thread error parked on a drained push ticket
         for rr in sorted(push_tickets):
@@ -1196,6 +1646,8 @@ class WordEmbedding:
         ``-ps_pipeline_depth=0`` (default) runs the fully synchronous
         rounds below — bit-exact with prior releases; depth >= 1 branches
         to the software pipeline (``_train_ps_pipelined``)."""
+        from multiverso_tpu.resilience import chaos
+
         o = self.opt
         self._ps_setup()
         self._ps_steps: Dict = {}
@@ -1219,10 +1671,34 @@ class WordEmbedding:
         else:
             total_global = float(total_pairs_est)
         log_every = o.batch_size * max(64, S * 8)
-        for epoch in range(o.epoch):
-            it = source.batches(epoch)
+        # elastic resume (collective): restore tables + the per-rank data
+        # cursor from the latest valid PS checkpoint; batches regenerate
+        # deterministically past it, so kill + restart == uninterrupted
+        ckpt_every = o.checkpoint_every_steps if o.checkpoint_dir else 0
+        resume = self._ps_maybe_resume(depth=0)
+        rounds_done = 0
+        start_epoch = 0
+        resume_skip = 0
+        if resume is not None:
+            rounds_done = resume["round"]
+            pairs_done = resume["pairs_done"]
+            start_epoch = resume["epoch"]
+            resume_skip = resume["batches_in_epoch"]
+            if start_epoch > 0:
+                # the pair generator's RNG stream spans epochs: drain the
+                # completed epochs so the resumed stream is bit-identical
+                for ep in range(start_epoch):
+                    for _ in source.batches(ep):
+                        pass
+        for epoch in range(start_epoch, o.epoch):
+            skip = resume_skip if epoch == start_epoch else 0
+            it = source.batches(epoch, skip=skip) if skip else source.batches(
+                epoch
+            )
+            batches_in_epoch = skip
             done = False
             while True:
+                chaos.maybe_drop_rank(rounds_done)  # failure-domain drills
                 group = []
                 if not done:
                     while len(group) < S:
@@ -1245,6 +1721,15 @@ class WordEmbedding:
                     loss_dev = loss
                 prev = pairs_done
                 pairs_done += o.batch_size * len(group)
+                batches_in_epoch += len(group)
+                rounds_done += 1
+                if ckpt_every and rounds_done % ckpt_every == 0:
+                    # synchronous rounds ARE drained boundaries: every
+                    # push landed before this line, on every rank
+                    self._ps_save_checkpoint(
+                        rounds_done, pairs_done, depth=0, epoch=epoch,
+                        batches_in_epoch=batches_in_epoch,
+                    )
                 if pairs_done // log_every > prev // log_every:
                     rate = pairs_done / max(time.perf_counter() - start, 1e-9)
                     Log.Info(
@@ -1435,8 +1920,81 @@ class WordEmbedding:
         # floored at 16 calls
         log_every = max(16, (total_pairs // per_call) // 20)
         legs_done_pairs = 0  # exact target sum of completed legs
-        for seq in range(o.epoch * nC):
-            if seq > 0:
+        # -- elastic resume (resilience subsystem; ROADMAP device-pipeline
+        # NEXT): the device-side data cursor is (leg seq, dispatch-call
+        # count, walk_t, PRNG key) — everything the on-device superbatch
+        # walk state needs to regenerate the exact remaining schedule
+        # (prepare() re-derives each leg's subsample draw + permutation
+        # from seed + seq). Checkpoints snapshot the cursor WITHOUT
+        # draining the pairs accumulator (it is read, not reset), so the
+        # sync cadence — and therefore the projected-lr math — is
+        # bit-identical with checkpointing on or off: kill at call K +
+        # restart == uninterrupted run.
+        ckpt = None
+        res = None
+        restarts = 0
+        seq_start = 0
+        if o.checkpoint_dir:
+            from multiverso_tpu.resilience import (
+                AutoCheckpointer,
+                latest_valid,
+                load_checkpoint,
+            )
+            from multiverso_tpu.resilience import stats as _rstats
+
+            if o.resume:
+                ck_path = latest_valid(o.checkpoint_dir)
+                if ck_path is not None:
+                    tree, ck_meta = load_checkpoint(ck_path)
+                    CHECK(ck_meta.get("kind") == "device_pipeline",
+                          f"checkpoint {ck_path} was not written by the "
+                          "device pipeline (checkpoint roots are not "
+                          "shared across training paths)")
+                    key = jnp.asarray(tree.pop("__prng_key"))
+                    CHECK(set(tree) == set(self.params),
+                          f"checkpoint {ck_path} params {sorted(tree)} do "
+                          f"not match this config's {sorted(self.params)} "
+                          "(hs/adagrad/size flags must match)")
+                    # jnp.array (copy): a zero-copy asarray view of the
+                    # npz-backed host memory would be DONATED by the
+                    # first dispatch — the device must own fresh buffers
+                    put = (
+                        (lambda v: jax.device_put(jnp.array(v), self._tab))
+                        if self._tab is not None
+                        else (lambda v: jnp.array(v))
+                    )
+                    self.params = {k: put(v) for k, v in tree.items()}
+                    res = ck_meta
+                    seq_start = int(ck_meta["seq"])
+                    calls = int(ck_meta["calls"])
+                    pairs_done = int(ck_meta["pairs_done"])
+                    legs_done_pairs = int(ck_meta["legs_done_pairs"])
+                    restarts = int(ck_meta.get("restarts", 0)) + 1
+                    _rstats.note_restart(restarts)
+                    Log.Info(
+                        "[WordEmbedding] resumed from %s: leg %d, call %d, "
+                        "%.1fM pairs, restart #%d",
+                        ck_path, seq_start, calls, pairs_done / 1e6,
+                        restarts,
+                    )
+            ckpt = AutoCheckpointer(
+                o.checkpoint_dir,
+                every_n_steps=o.checkpoint_every_steps,
+                retain=o.checkpoint_retain,
+                async_=o.checkpoint_async,
+            )
+        from multiverso_tpu.resilience import chaos
+
+        for seq in range(seq_start, o.epoch * nC):
+            mid_resume = res is not None and seq == seq_start
+            if mid_resume:
+                # re-enter THIS leg: its chunk re-uploads and its data
+                # pytree re-prepares (deterministic from seed + seq); the
+                # startup prepare above was leg 0's
+                cur_dev = _up(chunks_np[seq % nC])
+                data, n_valid = stream_data(seq, cur_dev)
+                total_pairs = int(res["total_pairs"])
+            elif seq > 0:
                 data, n_valid = stream_data(seq, cur_dev)
                 # refine the schedule total with the actual leg target
                 total_pairs = max(
@@ -1451,17 +2009,30 @@ class WordEmbedding:
                 cur_dev = (
                     _up(chunks_np[nxt % nC]) if nxt < o.epoch * nC else None
                 )
-            walk_t = 0  # fresh per-leg permutation; cursor restarts
-            epoch_target = max(1, n_valid * per_kept)
-            epoch_done = 0
-            accepted_dev = jnp.float32(0.0)
-            epoch_calls0 = calls
-            synced_calls = calls
-            # accepted pairs per call, refined at each sync; the initial
-            # value is the hard upper bound (every slot accepted), so the
-            # projection can only over-estimate progress — it forces an
-            # early sync, never an overshoot by a whole log window
-            ppc = float(per_call)
+            if mid_resume:
+                # mid-leg cursor: walk position, accepted accounting and
+                # the projection state restore exactly as staged
+                walk_t = int(res["walk_t"])
+                epoch_target = max(1, n_valid * per_kept)
+                epoch_done = int(res["epoch_done"])
+                accepted_dev = jnp.float32(res["accepted_partial"])
+                epoch_calls0 = int(res["epoch_calls0"])
+                synced_calls = int(res["synced_calls"])
+                ppc = float(res["ppc"])
+                res = None
+            else:
+                walk_t = 0  # fresh per-leg permutation; cursor restarts
+                epoch_target = max(1, n_valid * per_kept)
+                epoch_done = 0
+                accepted_dev = jnp.float32(0.0)
+                epoch_calls0 = calls
+                synced_calls = calls
+                # accepted pairs per call, refined at each sync; the
+                # initial value is the hard upper bound (every slot
+                # accepted), so the projection can only over-estimate
+                # progress — it forces an early sync, never an overshoot
+                # by a whole log window
+                ppc = float(per_call)
             est_calls = max(1, epoch_target // per_call)
             max_calls = epoch_calls0 + 20 * est_calls
             while epoch_done < epoch_target and calls < max_calls:
@@ -1513,6 +2084,16 @@ class WordEmbedding:
                             "%.0fk pairs/s, lr %.5f, loss %.4f",
                             pairs_done / 1e6, rate / 1e3, lr, float(loss_dev),
                         )
+                if ckpt is not None:
+                    # AFTER the sync block: the staged state is the end of
+                    # this call's iteration, so a resumed loop re-enters
+                    # exactly where an uninterrupted one would continue
+                    self._ondevice_maybe_checkpoint(
+                        ckpt, calls, seq, pairs_done, legs_done_pairs,
+                        total_pairs, walk_t, epoch_done, accepted_dev,
+                        epoch_calls0, synced_calls, ppc, key, restarts,
+                    )
+                chaos.maybe_kill(calls)
             if calls != synced_calls:  # drain the leg tail (if undrained)
                 got = int(float(accepted_dev))
                 epoch_done += got
@@ -1525,6 +2106,8 @@ class WordEmbedding:
                     max_calls, epoch_done / 1e6, epoch_target / 1e6,
                 )
             legs_done_pairs += epoch_target
+        if ckpt is not None:
+            ckpt.close()  # drain the in-flight async save
         jax.block_until_ready(self.params)
         self.words_trained = pairs_done
         rate = self.words_trained / max(time.perf_counter() - start, 1e-9)
@@ -1600,10 +2183,15 @@ class WordEmbedding:
               "-ps_compress applies to the pipelined PS path only: set "
               "-ps_pipeline_depth >= 1 (the depth-0 sync rounds stay the "
               "pinned bit-exact parity mode)")
-        CHECK(not (o.checkpoint_dir and o.device_pipeline),
-              "-checkpoint_dir supports the host-batch fused path only "
-              "(the device pipeline has no per-step host data cursor to "
-              "checkpoint; its epochs are single dispatch legs)")
+        if o.checkpoint_dir and o.device_pipeline:
+            CHECK(jax.process_count() == 1,
+                  "-checkpoint_dir on the device pipeline requires a "
+                  "single process (multi-process training goes through "
+                  "-use_ps, whose checkpoints are quorum-committed)")
+            CHECK(o.checkpoint_every_seconds == 0,
+                  "-checkpoint_every_seconds is wall-clock driven and "
+                  "would perturb the device pipeline's deterministic "
+                  "resume; use -checkpoint_every_steps (dispatch calls)")
         if o.device_pipeline:
             return self._train_ondevice(ids, keep)
         def make_pipeline(shard_ids, seed):
@@ -1646,10 +2234,20 @@ class WordEmbedding:
             else pipeline
         )
         if o.use_ps:
-            CHECK(not o.checkpoint_dir,
-                  "-checkpoint_dir supports the fused host-batch path only "
-                  "(PS-mode state lives in the shared tables; use "
-                  "io.save_tables for those)")
+            if o.checkpoint_dir:
+                # PS checkpoints count in ROUNDS and must fire at the
+                # SAME round on every rank (the save is a collective):
+                # only the round counter is rank-identical, wall clocks
+                # are not — and the resume cursor needs a deterministic
+                # batch order
+                CHECK(o.checkpoint_every_seconds == 0,
+                      "-checkpoint_every_seconds is unsupported in PS "
+                      "mode: ranks must checkpoint at the SAME round "
+                      "(use -checkpoint_every_steps = every N rounds)")
+                CHECK(nthreads == 1,
+                      "-checkpoint_dir in PS mode requires -threads=1: "
+                      "the resume data cursor needs a deterministic "
+                      "batch order")
             return self._train_ps(source, total_pairs_est, start)
         S = max(1, o.steps_per_call)
         log_every = o.batch_size * max(64, S * 8)
@@ -1687,7 +2285,9 @@ class WordEmbedding:
                           f"checkpoint {path} params {sorted(tree)} do not "
                           f"match this config's {sorted(self.params)} "
                           "(hs/adagrad/size flags must match the saved run)")
-                    self.params = {k: jnp.asarray(v) for k, v in tree.items()}
+                    # jnp.array (copy): the donated first dispatch must
+                    # not alias the npz-backed host memory
+                    self.params = {k: jnp.array(v) for k, v in tree.items()}
                     start_epoch = int(meta["epoch"])
                     resume_skip = int(meta["batches_in_epoch"])
                     pairs_done = int(meta["pairs_done"])

@@ -46,6 +46,7 @@ __all__ = [
     "initialize",
     "initialize_from_flags",
     "initialize_from_machine_file",
+    "kv_client",
     "parse_machine_file",
     "local_ips",
     "build_multihost_mesh",
@@ -84,6 +85,31 @@ def process_index() -> int:
 
 def process_count() -> int:
     return jax.process_count()
+
+
+def kv_client():
+    """The cluster's distributed key-value client (the coordination
+    service behind ``jax.distributed.initialize``), or ``None`` when no
+    cluster is up or this jax build does not expose one.
+
+    This is the control-plane side channel the failure-domain watchdog
+    publishes liveness beacons over (``resilience.watchdog``
+    ``KVHeartbeatStore``) when no shared ``-heartbeat_dir`` filesystem
+    exists: write-once keys, so peers probe forward from their last
+    confirmed sequence. Kept here — not in the watchdog — because the
+    client's lifetime is owned by this module's rendezvous (a failed
+    ``initialize`` tears it down for the retry)."""
+    try:
+        from jax._src import distributed as _dist
+
+        client = _dist.global_state.client
+    except Exception:  # noqa: BLE001 — jax internals moved: no client
+        return None
+    if client is None or not hasattr(client, "key_value_set") or not (
+        hasattr(client, "key_value_try_get")
+    ):
+        return None
+    return client
 
 
 def _strip_scheme(endpoint: str) -> str:

@@ -17,11 +17,23 @@ publishes *normal*, tested events:
   rendezvous) plus ``with_retries`` (jittered exponential backoff under a
   hard deadline) used by the multihost rendezvous and checkpoint I/O;
 * ``resilience.breaker`` — the per-route circuit breaker the
-  ``TableServer`` sheds through when a route keeps failing.
+  ``TableServer`` sheds through when a route keeps failing;
+* ``resilience.watchdog`` — the distributed failure-domain layer:
+  per-rank liveness beacons + per-ticket collective deadlines that turn
+  a hung/dead peer into a structured ``RankFailure`` (and poisoned-pipe
+  ``PipelineBroken`` fail-fast) instead of a silent cluster-wide hang,
+  plus the ``failure_domain`` Dashboard/health stats.
 """
 
 from multiverso_tpu.resilience.breaker import CircuitBreaker
 from multiverso_tpu.resilience.chaos import ChaosInterrupt, with_retries
+from multiverso_tpu.resilience.watchdog import (
+    HeartbeatMonitor,
+    PipelineBroken,
+    QuorumAbort,
+    RankFailure,
+    fd_stats,
+)
 from multiverso_tpu.resilience.checkpoint import (
     AutoCheckpointer,
     CheckpointPolicy,
@@ -40,6 +52,11 @@ __all__ = [
     "ChaosInterrupt",
     "CheckpointPolicy",
     "CircuitBreaker",
+    "HeartbeatMonitor",
+    "PipelineBroken",
+    "QuorumAbort",
+    "RankFailure",
+    "fd_stats",
     "gc_checkpoints",
     "latest_valid",
     "list_checkpoints",

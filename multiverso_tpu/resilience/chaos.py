@@ -23,7 +23,19 @@ Fault points (all off by default):
 * ``-chaos_route_errors=lookup:3``   — the next 3 serving flushes whose
   route contains ``lookup`` raise (drives the circuit breaker);
 * ``-chaos_rendezvous_failures=N``   — the first N cluster-rendezvous
-  attempts raise (drives the multihost retry path).
+  attempts raise (drives the multihost retry path);
+* ``-chaos_hang_collective=round:secs`` — the PS comms thread sleeps
+  ``secs`` inside round ``round``'s pull (a hung collective, fired once:
+  drives the per-ticket deadline / ``RankFailure`` path);
+* ``-chaos_drop_rank=rank:round``    — process ``rank`` dies at PS round
+  ``round`` (``os._exit(137)``, or ``ChaosInterrupt`` under
+  ``-chaos_kill_mode=raise`` — the 2-process failure-domain drill);
+* ``-chaos_drop_heartbeats_after=N`` — this rank's heartbeat thread stops
+  publishing beacons after N beats while the process stays alive (pure
+  heartbeat-loss injection: peers must escalate to RankFailure);
+* ``-chaos_quorum_missing_stage=R``  — rank R skips writing its quorum
+  stage record during a multi-process ``save_tables`` (rank 0 must abort
+  the commit; no half checkpoint may publish).
 
 Counters are process-local and reset with ``reset()`` (test isolation).
 """
@@ -47,6 +59,10 @@ __all__ = [
     "ChaosInterrupt",
     "kill_exit_code",
     "maybe_kill",
+    "maybe_hang_collective",
+    "maybe_drop_rank",
+    "heartbeats_dropped",
+    "quorum_stage_should_skip",
     "torn_checkpoint",
     "corrupt_checkpoint",
     "should_fail_route",
@@ -80,6 +96,27 @@ MV_DEFINE_int(
     "chaos_rendezvous_failures", 0,
     "fail the first N multihost rendezvous attempts (retry-path drills)",
 )
+MV_DEFINE_string(
+    "chaos_hang_collective", "",
+    "round:secs — the PS comms thread sleeps <secs> inside round <round>'s "
+    "pull, once (a hung collective: per-ticket-deadline drills)",
+)
+MV_DEFINE_string(
+    "chaos_drop_rank", "",
+    "rank:round — process <rank> dies at PS round <round> (os._exit 137, "
+    "or ChaosInterrupt under -chaos_kill_mode=raise): the failure-domain "
+    "2-process drill",
+)
+MV_DEFINE_int(
+    "chaos_drop_heartbeats_after", -1,
+    "stop publishing this rank's liveness beacons after N beats while the "
+    "process stays alive (-1 = off): pure heartbeat-loss injection",
+)
+MV_DEFINE_int(
+    "chaos_quorum_missing_stage", -1,
+    "rank R skips writing its quorum stage record during save_tables "
+    "(-1 = off): the two-phase commit must abort, never half-publish",
+)
 
 _KILL_EXIT_CODE = 137
 
@@ -87,6 +124,7 @@ _lock = threading.Lock()
 _route_budget: Dict[str, int] = {}  # parsed spec -> remaining failures
 _route_spec_seen: Optional[str] = None
 _rendezvous_failed = 0
+_hang_fired = False
 
 
 class ChaosInterrupt(RuntimeError):
@@ -99,11 +137,12 @@ def kill_exit_code() -> int:
 
 def reset() -> None:
     """Forget all chaos counters (test isolation; flags reset separately)."""
-    global _route_spec_seen, _rendezvous_failed
+    global _route_spec_seen, _rendezvous_failed, _hang_fired
     with _lock:
         _route_budget.clear()
         _route_spec_seen = None
         _rendezvous_failed = 0
+        _hang_fired = False
 
 
 def maybe_kill(step: int) -> None:
@@ -119,6 +158,72 @@ def maybe_kill(step: int) -> None:
     if GetFlag("chaos_kill_mode") == "raise":
         raise ChaosInterrupt(f"chaos: killed at step {step}")
     os._exit(_KILL_EXIT_CODE)
+
+
+def maybe_hang_collective(round_idx: int) -> None:
+    """PS comms-thread fault point: sleep through the armed round's pull
+    once — what a hung peer's collective looks like to the ticket wait."""
+    spec = GetFlag("chaos_hang_collective")
+    if not spec:
+        return
+    global _hang_fired
+    rd, _, secs = spec.partition(":")
+    if int(rd) != round_idx:
+        return
+    with _lock:
+        if _hang_fired:
+            return
+        _hang_fired = True
+    Log.Error(
+        "[chaos] hanging collective at round %d for %ss "
+        "(-chaos_hang_collective)", round_idx, secs or "5",
+    )
+    time.sleep(float(secs or 5))
+
+
+def maybe_drop_rank(round_idx: int) -> None:
+    """PS training-loop fault point: the armed rank dies at the armed
+    round (a real ``os._exit`` by default — the 2-process drill — or
+    ``ChaosInterrupt`` under ``-chaos_kill_mode=raise``)."""
+    spec = GetFlag("chaos_drop_rank")
+    if not spec:
+        return
+    import jax
+
+    rk, _, rd = spec.partition(":")
+    if jax.process_index() != int(rk) or round_idx != int(rd):
+        return
+    Log.Error(
+        "[chaos] dropping rank %s at round %d (-chaos_drop_rank)",
+        rk, round_idx,
+    )
+    if GetFlag("chaos_kill_mode") == "raise":
+        raise ChaosInterrupt(f"chaos: rank {rk} dropped at round {round_idx}")
+    os._exit(_KILL_EXIT_CODE)
+
+
+def heartbeats_dropped(seq: int) -> bool:
+    """Heartbeat-thread fault point: True once this rank's beacon budget
+    is exhausted (the process stays alive; peers must notice)."""
+    n = GetFlag("chaos_drop_heartbeats_after")
+    return n >= 0 and seq >= n
+
+
+def quorum_stage_should_skip() -> bool:
+    """save_tables fault point: this rank 'dies' before writing its stage
+    record (rank 0 must abort the two-phase commit)."""
+    r = GetFlag("chaos_quorum_missing_stage")
+    if r < 0:
+        return False
+    import jax
+
+    if jax.process_index() == r:
+        Log.Error(
+            "[chaos] skipping quorum stage record for rank %d "
+            "(-chaos_quorum_missing_stage)", r,
+        )
+        return True
+    return False
 
 
 def torn_checkpoint() -> bool:

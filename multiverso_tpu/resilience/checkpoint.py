@@ -497,6 +497,22 @@ class _ResilienceStats:
                 return None
             return time.monotonic() - self.last_checkpoint_t
 
+    def to_dict(self) -> Dict[str, Any]:
+        """The health/bench view of the same record (``/healthz`` embeds
+        it next to the serving and failure_domain sections)."""
+        age = self.last_checkpoint_age_s()
+        with self._lock:
+            return {
+                "restarts": self.restarts,
+                "saves": self.saves,
+                "save_failures": self.save_failures,
+                "last_checkpoint_step": self.last_checkpoint_step,
+                "last_checkpoint_path": self.last_checkpoint_path,
+                "last_checkpoint_age_s": (
+                    None if age is None else round(age, 1)
+                ),
+            }
+
     def lines(self) -> List[str]:
         age = self.last_checkpoint_age_s()
         with self._lock:

@@ -1,0 +1,502 @@
+"""Distributed failure-domain layer: liveness beacons + structured failures.
+
+The PR-4 pipeline moved every PS table collective onto a comms thread
+(``utils.async_buffer.TaskPipe``) with no failure handling: one hung or
+dead rank stalled the pipe forever and the training thread blocked on a
+ticket that would never resolve. This module turns that silent
+cluster-wide hang into a *detected, drained, resumable* event:
+
+* ``RankFailure`` — the structured exception a training thread sees when
+  a peer dies or a collective exceeds its deadline (kind, rank, round,
+  cause), instead of blocking forever;
+* ``PipelineBroken`` — fail-fast for every submit/result after the first
+  failure marked the pipe poisoned (containment: one bad collective must
+  not let later callers block on tickets that can never resolve);
+* ``QuorumAbort`` — a two-phase multi-process ``save_tables`` commit was
+  refused because some rank's stage record is missing or broken (a rank
+  dying mid-save can never publish a half checkpoint);
+* ``HeartbeatMonitor`` — a side-thread liveness beacon per rank (over a
+  file-backed store on a shared filesystem, or the jax distributed KV
+  service when available) plus peer-age tracking: a peer that misses
+  ``-heartbeat_deadline_s`` raises ``RankFailure`` on the next watched
+  wait;
+* ``fd_stats`` — the process-wide ``failure_domain`` Dashboard section
+  (heartbeat ages, ticket wait p50/p99, broken-pipe / drain /
+  quorum-abort counters) that also feeds ``/healthz`` and the bench leg.
+
+Peer liveness is judged on the OBSERVER's monotonic clock (age since the
+last *new* beacon sequence number was seen), so wall-clock skew between
+hosts never fakes a death.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_double,
+    MV_DEFINE_string,
+    GetFlag,
+)
+from multiverso_tpu.utils.log import Log
+
+__all__ = [
+    "RankFailure",
+    "PipelineBroken",
+    "QuorumAbort",
+    "classify_collective_error",
+    "FileHeartbeatStore",
+    "KVHeartbeatStore",
+    "HeartbeatMonitor",
+    "monitor_from_flags",
+    "collective_timeout_s",
+    "fd_stats",
+]
+
+# Failure-domain flags (all off by default — arming them is what turns a
+# hang into a bounded, structured failure; see DEPLOY.md for tuning).
+MV_DEFINE_double(
+    "collective_timeout_s", 0.0,
+    "per-ticket deadline on pipelined PS collectives (and multi-process "
+    "checkpoint sync points): a collective that exceeds this raises "
+    "RankFailure on the training thread instead of hanging (0 = off). "
+    "Tune ABOVE the slowest legitimate collective incl. first-round "
+    "compile — see DEPLOY.md",
+)
+MV_DEFINE_double(
+    "heartbeat_deadline_s", 0.0,
+    "a peer that publishes no new liveness beacon for this long is "
+    "declared dead (RankFailure kind=heartbeat_lost; 0 = watchdog off)",
+)
+MV_DEFINE_double(
+    "heartbeat_interval_s", 0.0,
+    "beacon publish/poll period (0 = auto: heartbeat_deadline_s / 4)",
+)
+MV_DEFINE_string(
+    "heartbeat_dir", "",
+    "file-backed beacon directory (must be shared across ranks — one "
+    "host or a shared filesystem); empty = use the jax distributed KV "
+    "service when available",
+)
+
+
+class RankFailure(RuntimeError):
+    """A peer rank died or a collective exceeded its deadline.
+
+    Structured: ``kind`` in {"heartbeat_lost", "collective_timeout",
+    "peer_dead"}, ``rank`` (the suspected peer, -1 unknown), ``round``
+    (PS round when known), ``cause`` (the underlying exception, if any).
+    """
+
+    def __init__(self, kind: str, detail: str, *, rank: int = -1,
+                 round_idx: int = -1, cause: Optional[BaseException] = None):
+        self.kind = kind
+        self.rank = int(rank)
+        self.round_idx = int(round_idx)
+        self.cause = cause
+        msg = f"RankFailure[{kind}] {detail}"
+        if rank >= 0:
+            msg += f" (suspected rank {rank})"
+        if round_idx >= 0:
+            msg += f" at round {round_idx}"
+        if cause is not None:
+            msg += f": {type(cause).__name__}: {cause}"
+        super().__init__(msg)
+
+
+class PipelineBroken(RuntimeError):
+    """The comms pipe was poisoned by an earlier failure; this call fails
+    fast instead of blocking on a ticket that can never resolve."""
+
+    def __init__(self, cause: Optional[BaseException] = None):
+        self.cause = cause
+        super().__init__(
+            "comms pipeline is broken (poisoned by an earlier failure"
+            + (f": {cause}" if cause is not None else "")
+            + "); drain() and restart from the last drained checkpoint"
+        )
+
+
+class QuorumAbort(RuntimeError):
+    """Two-phase checkpoint commit refused: not every rank's stage record
+    verified, so no version was published (the tmp staging dir is the
+    only artifact)."""
+
+
+# Transport/coordination-layer signatures that mean "a peer is gone", not
+# "this program has a bug" — a comms-thread exception matching one of
+# these is promoted to RankFailure so the containment path runs (same
+# signature family the cluster test launcher retries on).
+_PEER_DEATH_SIGNATURES = (
+    "gloo",
+    "op.preamble.length",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "heartbeat timeout",
+    "deadline exceeded",
+    "barrier",
+    "distributed runtime",
+    "peer closed",
+    "socket closed",
+)
+
+
+def classify_collective_error(
+    exc: BaseException, *, round_idx: int = -1
+) -> Optional[RankFailure]:
+    """Map a comms-thread exception to a structured ``RankFailure`` when
+    it looks like peer death / transport loss; ``None`` for anything else
+    (logic errors must propagate unchanged)."""
+    if isinstance(exc, RankFailure):
+        return exc
+    low = f"{type(exc).__name__}: {exc}".lower()
+    if any(sig in low for sig in _PEER_DEATH_SIGNATURES):
+        return RankFailure(
+            "peer_dead", "collective failed like a dead peer",
+            round_idx=round_idx, cause=exc,
+        )
+    return None
+
+
+# ----------------------------------------------------------- beacon stores
+
+
+class FileHeartbeatStore:
+    """Beacons as one JSON file per rank on a shared filesystem. Writes
+    are atomic (tmp + rename) so a reader never sees a torn beacon."""
+
+    def __init__(self, directory: str, rank: int):
+        self.directory = os.path.abspath(directory)
+        self.rank = int(rank)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, rank: int) -> str:
+        return os.path.join(self.directory, f"hb-{int(rank)}.json")
+
+    def beat(self, seq: int) -> None:
+        path = self._path(self.rank)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "seq": int(seq),
+                       "wall": time.time()}, f)
+        os.replace(tmp, path)
+
+    def latest_seq(self, rank: int, hint: int = -1) -> Optional[int]:
+        try:
+            with open(self._path(rank)) as f:
+                return int(json.load(f)["seq"])
+        except (OSError, ValueError, KeyError):
+            return None  # no (readable) beacon yet
+
+
+class KVHeartbeatStore:
+    """Beacons over the jax distributed KV service (write-once keys:
+    ``mv_hb/<rank>/<seq>``). Peers probe forward from their last
+    confirmed sequence — no overwrite semantics needed."""
+
+    def __init__(self, client, rank: int):
+        self._client = client
+        self.rank = int(rank)
+
+    @classmethod
+    def try_create(cls, rank: int) -> Optional["KVHeartbeatStore"]:
+        from multiverso_tpu.parallel.multihost import kv_client
+
+        client = kv_client()
+        if client is None:
+            return None
+        return cls(client, rank)
+
+    def beat(self, seq: int) -> None:
+        try:
+            self._client.key_value_set(
+                f"mv_hb/{self.rank}/{int(seq)}", str(time.time())
+            )
+        except Exception as e:  # noqa: BLE001 — beacon loss is survivable
+            Log.Error("heartbeat publish failed (kv): %s", e)
+
+    def latest_seq(self, rank: int, hint: int = -1) -> Optional[int]:
+        seq = None if hint < 0 else hint
+        probe = (hint + 1) if hint >= 0 else 0
+        while True:
+            try:
+                got = self._client.key_value_try_get(f"mv_hb/{rank}/{probe}")
+            except Exception:  # noqa: BLE001 — NotFound surfaces as raise
+                got = None
+            if not got:
+                return seq
+            seq = probe
+            probe += 1
+
+
+# ----------------------------------------------------------- monitor
+
+
+class HeartbeatMonitor:
+    """Publish this rank's beacon and watch the peers' — a peer that
+    produces no NEW beacon for ``deadline_s`` (observer's monotonic
+    clock) is recorded as failed; the failure surfaces through
+    ``check()`` / ``failed()`` and through any watchdog-aware ticket wait
+    (``TaskPipe`` integration). ``poll_once()`` is the deterministic unit
+    tests drive with a fake clock; ``start()`` runs it on a side thread.
+    """
+
+    def __init__(
+        self,
+        store,
+        rank: int,
+        world: int,
+        deadline_s: float,
+        interval_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.store = store
+        self.rank = int(rank)
+        self.world = int(world)
+        self.deadline_s = float(deadline_s)
+        self.interval_s = float(interval_s or max(deadline_s / 4.0, 1e-3))
+        self._clock = clock
+        self._sleep = sleep
+        self._seq = 0
+        now = clock()
+        # peers get a full deadline from monitor start to their first beacon
+        self._peers: Dict[int, List] = {
+            p: [-1, now] for p in range(self.world) if p != self.rank
+        }
+        self._failure: Optional[RankFailure] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def poll_once(self) -> Optional[RankFailure]:
+        """One beacon publish + one peer sweep (the thread body; also the
+        deterministic test entry point)."""
+        from multiverso_tpu.resilience import chaos
+
+        if not chaos.heartbeats_dropped(self._seq):
+            self.store.beat(self._seq)
+            self._seq += 1
+        now = self._clock()
+        with self._lock:
+            for peer, rec in self._peers.items():
+                seq = self.store.latest_seq(peer, hint=rec[0])
+                if seq is not None and seq != rec[0]:
+                    rec[0], rec[1] = seq, now
+                elif now - rec[1] > self.deadline_s and self._failure is None:
+                    self._failure = RankFailure(
+                        "heartbeat_lost",
+                        f"no beacon from peer for {now - rec[1]:.2f}s "
+                        f"(deadline {self.deadline_s:.2f}s)",
+                        rank=peer,
+                    )
+                    fd_stats.note_rank_failure("heartbeat_lost")
+                    Log.Error("[watchdog] %s", self._failure)
+            return self._failure
+
+    def failed(self) -> Optional[RankFailure]:
+        with self._lock:
+            return self._failure
+
+    def check(self) -> None:
+        f = self.failed()
+        if f is not None:
+            raise f
+
+    def ages(self) -> Dict[int, float]:
+        """Seconds since each peer's last NEW beacon was observed."""
+        now = self._clock()
+        with self._lock:
+            return {p: round(now - rec[1], 3) for p, rec in self._peers.items()}
+
+    def start(self) -> "HeartbeatMonitor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="mv-heartbeat"
+            )
+            self._thread.start()
+            fd_stats.set_heartbeat_ages_provider(self.ages)
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the watchdog must not die
+                Log.Error("[watchdog] poll failed: %s", e)
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        fd_stats.set_heartbeat_ages_provider(None)
+
+
+def collective_timeout_s() -> Optional[float]:
+    """The armed per-ticket collective deadline, or None when off."""
+    t = float(GetFlag("collective_timeout_s"))
+    return t if t > 0 else None
+
+
+def monitor_from_flags(
+    *, clock: Callable[[], float] = time.monotonic
+) -> Optional[HeartbeatMonitor]:
+    """Build + start the flag-armed heartbeat monitor (None when
+    ``-heartbeat_deadline_s`` is 0 or no beacon transport is usable)."""
+    import jax
+
+    deadline = float(GetFlag("heartbeat_deadline_s"))
+    if deadline <= 0:
+        return None
+    rank, world = jax.process_index(), jax.process_count()
+    hb_dir = GetFlag("heartbeat_dir")
+    if hb_dir:
+        store = FileHeartbeatStore(hb_dir, rank)
+    else:
+        store = KVHeartbeatStore.try_create(rank)
+        if store is None:
+            Log.Error(
+                "-heartbeat_deadline_s=%.1f armed but no beacon transport: "
+                "set -heartbeat_dir to a shared directory (or run under "
+                "the jax distributed service) — watchdog DISABLED", deadline,
+            )
+            return None
+    interval = float(GetFlag("heartbeat_interval_s")) or None
+    return HeartbeatMonitor(
+        store, rank, world, deadline, interval, clock=clock
+    ).start()
+
+
+# ----------------------------------------------------------- fd stats
+
+
+class _FailureDomainStats:
+    """Process-wide failure-domain counters: Dashboard ``failure_domain``
+    section, ``/healthz`` payload and the bench resilience leg all read
+    the same record."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.tickets = 0
+        self._waits_ms: deque = deque(maxlen=4096)
+        self.broken_pipes = 0
+        self.drains = 0
+        self.drain_timeouts = 0
+        self.drain_ms_total = 0.0
+        self.quorum_commits = 0
+        self.quorum_aborts = 0
+        self.rank_failures = 0
+        self.last_failure_kind: Optional[str] = None
+        self._ages_fn: Optional[Callable[[], Dict[int, float]]] = None
+
+    def _register(self) -> None:
+        # lazy + keyed: survives Dashboard.Reset() by re-adding on next note
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.add_section("failure_domain", self.lines)
+
+    def note_ticket_wait(self, wait_s: float) -> None:
+        with self._lock:
+            self.tickets += 1
+            self._waits_ms.append(wait_s * 1e3)
+        self._register()
+
+    def note_broken_pipe(self) -> None:
+        with self._lock:
+            self.broken_pipes += 1
+        self._register()
+
+    def note_drain(self, seconds: float, ok: bool) -> None:
+        with self._lock:
+            self.drains += 1
+            self.drain_ms_total += seconds * 1e3
+            if not ok:
+                self.drain_timeouts += 1
+        self._register()
+
+    def note_quorum_commit(self) -> None:
+        with self._lock:
+            self.quorum_commits += 1
+        self._register()
+
+    def note_quorum_abort(self) -> None:
+        with self._lock:
+            self.quorum_aborts += 1
+        self._register()
+
+    def note_rank_failure(self, kind: str) -> None:
+        with self._lock:
+            self.rank_failures += 1
+            self.last_failure_kind = kind
+        self._register()
+
+    def set_heartbeat_ages_provider(
+        self, fn: Optional[Callable[[], Dict[int, float]]]
+    ) -> None:
+        with self._lock:
+            self._ages_fn = fn
+        if fn is not None:
+            self._register()
+
+    def heartbeat_ages(self) -> Dict[int, float]:
+        with self._lock:
+            fn = self._ages_fn
+        try:
+            return fn() if fn is not None else {}
+        except Exception:  # noqa: BLE001 — a stopped monitor must not throw
+            return {}
+
+    def to_dict(self) -> Dict:
+        ages = self.heartbeat_ages()
+        with self._lock:
+            return {
+                "tickets": self.tickets,
+                "ticket_wait_p50_ms": round(self._wait_pct_locked(50), 3),
+                "ticket_wait_p99_ms": round(self._wait_pct_locked(99), 3),
+                "broken_pipes": self.broken_pipes,
+                "drains": self.drains,
+                "drain_timeouts": self.drain_timeouts,
+                "drain_ms_avg": round(
+                    self.drain_ms_total / self.drains, 3
+                ) if self.drains else 0.0,
+                "quorum_commits": self.quorum_commits,
+                "quorum_aborts": self.quorum_aborts,
+                "rank_failures": self.rank_failures,
+                "last_failure_kind": self.last_failure_kind,
+                "heartbeat_ages_s": {str(k): v for k, v in ages.items()},
+            }
+
+    def _wait_pct_locked(self, pct: float) -> float:
+        if not self._waits_ms:
+            return 0.0
+        s = sorted(self._waits_ms)
+        return s[min(len(s) - 1, int(pct / 100.0 * len(s)))]
+
+    def lines(self) -> List[str]:
+        d = self.to_dict()
+        hb = " ".join(
+            f"r{k}={v}s" for k, v in sorted(d["heartbeat_ages_s"].items())
+        ) or "none"
+        return [
+            "[failure_domain] tickets=%d wait_p50=%.2fms wait_p99=%.2fms "
+            "broken_pipes=%d drains=%d (timeouts=%d, avg=%.1fms)" % (
+                d["tickets"], d["ticket_wait_p50_ms"],
+                d["ticket_wait_p99_ms"], d["broken_pipes"], d["drains"],
+                d["drain_timeouts"], d["drain_ms_avg"],
+            ),
+            "[failure_domain] quorum commits=%d aborts=%d rank_failures=%d "
+            "last=%s heartbeat_ages: %s" % (
+                d["quorum_commits"], d["quorum_aborts"], d["rank_failures"],
+                d["last_failure_kind"], hb,
+            ),
+        ]
+
+
+fd_stats = _FailureDomainStats()

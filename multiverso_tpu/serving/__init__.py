@@ -12,7 +12,10 @@ package is the read path sized for that traffic:
   jitted padded-bucket query programs (embedding lookup, top-k nearest
   neighbour, logreg predict) with double-buffered hot-swap publication;
 * ``metrics``  — per-route latency histograms (p50/p99), QPS, queue
-  depth, batch-fill ratio and shed counts, wired into the Dashboard.
+  depth, batch-fill ratio and shed counts, wired into the Dashboard;
+* ``http_health`` — stdlib HTTP surface: ``GET /healthz`` answers with
+  ``TableServer.health()`` + the resilience and failure_domain sections
+  as one JSON document (``-health_port`` flag).
 
 Degradation (resilience subsystem): ``publish`` validates staged weights
 and rejects poisoned tables with ``PublishRejected`` (previous snapshot
@@ -24,6 +27,7 @@ on TPU the same jitted programs shard the score matmuls over the mesh.
 """
 
 from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded, Request
+from multiverso_tpu.serving.http_health import HealthServer, health_payload
 from multiverso_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from multiverso_tpu.serving.server import (
     PublishRejected,
@@ -33,6 +37,7 @@ from multiverso_tpu.serving.server import (
 
 __all__ = [
     "DynamicBatcher",
+    "HealthServer",
     "Overloaded",
     "PublishRejected",
     "Request",
@@ -40,4 +45,5 @@ __all__ = [
     "ServingMetrics",
     "ServingSnapshot",
     "TableServer",
+    "health_payload",
 ]

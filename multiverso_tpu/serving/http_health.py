@@ -1,0 +1,123 @@
+"""HTTP health surface for the serving subsystem (stdlib-only).
+
+ROADMAP "an HTTP surface for ``health()``": ``HealthServer`` exposes
+``GET /healthz`` on a daemon thread (``http.server.ThreadingHTTPServer``
+— no new dependencies), answering with one JSON document that joins the
+three operator-facing status records:
+
+* ``serving``        — ``TableServer.health()`` (weights freshness,
+  breaker states, queue pressure, shed counts);
+* ``resilience``     — the process-wide checkpoint/restart record
+  (``resilience.stats``: saves, failures, last-checkpoint age);
+* ``failure_domain`` — the watchdog record (``watchdog.fd_stats``:
+  heartbeat ages, ticket wait p99, broken-pipe / drain / quorum-abort
+  counters).
+
+Top-level ``status`` is ``"ok"`` unless a breaker is open or a rank
+failure was recorded (``"degraded"`` — the page an operator's prober
+keys on). ``-health_port`` wires it into flag-driven apps;
+``examples/serving_demo.py --health-port`` demonstrates the probe end to
+end (and ci.sh asserts it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from multiverso_tpu.utils.configure import MV_DEFINE_int, GetFlag
+from multiverso_tpu.utils.log import Log
+
+__all__ = ["HealthServer", "health_payload", "maybe_start_from_flags"]
+
+MV_DEFINE_int(
+    "health_port", 0,
+    "serve GET /healthz (TableServer.health() + resilience + "
+    "failure_domain sections as JSON) on this port, started/stopped with "
+    "TableServer.start()/stop() (0 = off; flags cannot express an "
+    "ephemeral port — the demo's --health-port 0 can)",
+)
+
+
+def health_payload(server=None) -> Dict[str, Any]:
+    """The one status document: serving + resilience + failure_domain."""
+    from multiverso_tpu.resilience import stats as rstats
+    from multiverso_tpu.resilience.watchdog import fd_stats
+
+    serving: Optional[Dict[str, Any]] = None
+    if server is not None:
+        serving = server.health()
+    fd = fd_stats.to_dict()
+    degraded = bool(serving and serving.get("breakers_open")) or (
+        fd["rank_failures"] > 0
+    )
+    return {
+        "status": "degraded" if degraded else "ok",
+        "serving": serving,
+        "resilience": rstats.to_dict(),
+        "failure_domain": fd,
+    }
+
+
+class HealthServer:
+    """``GET /healthz`` on a daemon thread. ``port=0`` binds an ephemeral
+    port (read it back from ``.port``); anything but ``/healthz`` is 404.
+    Responses serialize with ``default=str`` so numpy scalars riding in
+    the health dicts can never 500 the prober."""
+
+    def __init__(self, server=None, host: str = "127.0.0.1", port: int = 0):
+        self.table_server = server
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                if self.path.split("?", 1)[0] != "/healthz":
+                    self.send_error(404, "only /healthz is served")
+                    return
+                try:
+                    body = json.dumps(
+                        health_payload(outer.table_server), default=str
+                    ).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001 — a broken section
+                    # must degrade the probe, not kill the prober thread
+                    body = json.dumps(
+                        {"status": "error", "error": str(e)}
+                    ).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # probes must not spam stdout
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="mv-healthz"
+        )
+        self._thread.start()
+        Log.Info("health endpoint: http://%s:%d/healthz", self.host, self.port)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/healthz"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def maybe_start_from_flags(server=None) -> Optional[HealthServer]:
+    """Start the health endpoint when ``-health_port`` is armed."""
+    port = int(GetFlag("health_port"))
+    if port <= 0:
+        return None
+    return HealthServer(server, port=port)

@@ -175,6 +175,7 @@ class TableServer:
         )
         self._started = False
         self._registered = False
+        self._health_http = None  # -health_port endpoint (start()/stop())
         if arrays:
             self.publish(arrays)
         if register_runtime:
@@ -189,13 +190,24 @@ class TableServer:
 
     def start(self) -> "TableServer":
         """Start the batching front door (direct query methods work
-        without it; ``*_async`` need it)."""
+        without it; ``*_async`` need it). When ``-health_port`` is armed
+        the HTTP health endpoint (``GET /healthz``) starts alongside and
+        stops with the server."""
         if not self._started:
             self._batcher.start()
             self._started = True
+            if self._health_http is None:
+                from multiverso_tpu.serving.http_health import (
+                    maybe_start_from_flags,
+                )
+
+                self._health_http = maybe_start_from_flags(self)
         return self
 
     def stop(self) -> None:
+        if self._health_http is not None:
+            self._health_http.stop()
+            self._health_http = None
         self._batcher.close()
         self.metrics.unregister_dashboard()
         from multiverso_tpu.utils.dashboard import Dashboard

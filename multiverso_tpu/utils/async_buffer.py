@@ -6,7 +6,10 @@ fills the idle buffer via ``fill_buffer_action`` while the caller consumes
 the ready one; ``Get()`` swaps. Used for pipelined model pulls
 (sync_frequency / pipeline mode — ref:
 Applications/LogisticRegression/src/model/ps_model.cpp:232-271) and block
-prefetch in WordEmbedding.
+prefetch in WordEmbedding. A fill-thread exception is STICKY: it re-raises
+on the consumer's next ``Get()`` (and every one after), and ``Get()``
+after ``Stop()`` raises cleanly — the consumer can never deadlock on (or
+silently re-consume) a buffer whose producer died.
 
 ``TaskPipe`` is the pipelined-PS communicator thread (the reference's
 Communicator + MtQueueMove handoff, communicator.cpp:117-249 running on its
@@ -15,11 +18,22 @@ STRICT submission order. That ordering is the whole contract — every rank
 submits the identical sequence of collective table ops (meta allgather,
 pull, push), so the SPMD programs stay lockstep across processes while the
 training thread overlaps device compute with them.
+
+Failure domains (resilience subsystem): a ticket wait can be bounded
+(``wait_result(deadline_s=...)``) and watchdog-aware — a collective that
+exceeds its deadline, or a peer the heartbeat monitor declared dead,
+raises a structured ``RankFailure`` on the waiting (training) thread
+instead of blocking forever. The first such failure marks the pipe
+*broken*: subsequent ``submit``/waits fail fast with ``PipelineBroken``
+(poisoned-pipe containment), and ``drain()`` waits for every already-
+submitted task to land so surviving ranks stop at a well-defined round
+boundary.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Generic, Optional, TypeVar
 
 T = TypeVar("T")
@@ -48,7 +62,7 @@ class ASyncBuffer(Generic[T]):
                 value = self._fill()
                 with self._lock:
                     self._value = value
-            except BaseException as e:  # surfaced on next Get()
+            except BaseException as e:  # surfaced (sticky) on next Get()
                 with self._lock:
                     self._error = e
             finally:
@@ -59,15 +73,16 @@ class ASyncBuffer(Generic[T]):
 
     def Get(self) -> T:
         """Block until the in-flight fill completes, return it, and start
-        prefetching the next one."""
+        prefetching the next one. A failed fill re-raises here — and on
+        every later ``Get()`` (sticky): no stale value is ever served and
+        no new fill is started after an error."""
         if self._stopped:
             raise RuntimeError("ASyncBuffer already stopped")
         self._ready.wait()
         with self._lock:
             if self._error is not None:
-                err, self._error = self._error, None
-                raise err
-            value = self._value
+                raise self._error
+            value, self._value = self._value, None
         self._start_fill()
         return value
 
@@ -82,18 +97,75 @@ class ASyncBuffer(Generic[T]):
 class _Ticket:
     """Result handle for one ``TaskPipe`` submission."""
 
-    __slots__ = ("_done", "_value", "_error")
+    __slots__ = ("_done", "_value", "_error", "_pipe", "tag")
 
-    def __init__(self):
+    def __init__(self, pipe: Optional["TaskPipe"] = None, tag: str = ""):
         self._done = threading.Event()
         self._value: Any = None
         self._error: Optional[BaseException] = None
+        self._pipe = pipe
+        self.tag = tag
 
     def result(self, timeout: Optional[float] = None) -> Any:
         """Block until the task ran on the pipe thread; re-raise its
-        exception there if it failed."""
+        exception there if it failed. Idempotent — a resolved ticket can
+        be read any number of times."""
         if not self._done.wait(timeout):
             raise TimeoutError("TaskPipe task did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def wait_result(
+        self,
+        deadline_s: Optional[float] = None,
+        watchdog=None,
+        *,
+        round_idx: int = -1,
+        poll_s: float = 0.05,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> Any:
+        """Failure-domain-aware ``result()``: bounded by the per-ticket
+        ``deadline_s`` and by the heartbeat ``watchdog`` — either firing
+        marks the pipe broken and raises a structured ``RankFailure``
+        here (the training thread) instead of blocking forever. A pipe
+        already broken by an earlier failure fails fast with
+        ``PipelineBroken``."""
+        from multiverso_tpu.resilience.watchdog import (
+            PipelineBroken,
+            RankFailure,
+            fd_stats,
+        )
+
+        start = clock()
+        while True:
+            if self._done.wait(poll_s):
+                break
+            pipe = self._pipe
+            if pipe is not None and pipe.broken is not None:
+                raise PipelineBroken(pipe.broken)
+            if watchdog is not None:
+                hb = watchdog.failed()
+                if hb is not None:
+                    rf = RankFailure(
+                        hb.kind, f"peer lost while waiting on {self.tag!r}",
+                        rank=hb.rank, round_idx=round_idx, cause=hb,
+                    )
+                    if pipe is not None:
+                        pipe.break_pipe(rf)
+                    raise rf
+            if deadline_s is not None and clock() - start > deadline_s:
+                rf = RankFailure(
+                    "collective_timeout",
+                    f"{self.tag or 'task'} exceeded its "
+                    f"{deadline_s:.1f}s deadline",
+                    round_idx=round_idx,
+                )
+                fd_stats.note_rank_failure("collective_timeout")
+                if pipe is not None:
+                    pipe.break_pipe(rf)
+                raise rf
+        fd_stats.note_ticket_wait(clock() - start)
         if self._error is not None:
             raise self._error
         return self._value
@@ -121,10 +193,33 @@ class TaskPipe:
         for i in range(capacity):
             self._free.push(i)
         self._closed = False
+        self._broken: Optional[BaseException] = None
+        self._inflight = 0
+        self._state_lock = threading.Lock()
+        self._idle = threading.Condition(self._state_lock)
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=name
         )
         self._thread.start()
+
+    @property
+    def broken(self) -> Optional[BaseException]:
+        return self._broken
+
+    def break_pipe(self, cause: BaseException) -> None:
+        """Poisoned-pipe containment: mark the pipe broken (first cause
+        wins, idempotent). Subsequent ``submit``/``wait_result`` calls
+        fail fast with ``PipelineBroken`` instead of queueing work behind
+        (or blocking on) a collective that will never resolve. The worker
+        thread is NOT joined — it may be stuck inside a hung collective;
+        already-queued tasks still run/fail and park on their tickets."""
+        with self._state_lock:
+            if self._broken is not None:
+                return
+            self._broken = cause
+        from multiverso_tpu.resilience.watchdog import fd_stats
+
+        fd_stats.note_broken_pipe()
 
     def _run(self) -> None:
         while True:
@@ -140,24 +235,61 @@ class TaskPipe:
                 ticket._error = e
             finally:
                 ticket._done.set()
+                with self._idle:
+                    self._inflight -= 1
+                    if self._inflight == 0:
+                        self._idle.notify_all()
 
-    def submit(self, fn: Callable[[], Any]) -> _Ticket:
+    def submit(self, fn: Callable[[], Any], tag: str = "") -> _Ticket:
         if self._closed:
             raise RuntimeError("TaskPipe already closed")
-        ticket = _Ticket()
+        if self._broken is not None:
+            from multiverso_tpu.resilience.watchdog import PipelineBroken
+
+            raise PipelineBroken(self._broken)
+        ticket = _Ticket(self, tag)
         slot = self._free.pop()
         if slot is None:
             raise RuntimeError("TaskPipe torn down while submitting")
         self._slots[slot] = (fn, ticket)
+        with self._idle:
+            self._inflight += 1
         if not self._ready.push(slot):
+            with self._idle:
+                self._inflight -= 1
             raise RuntimeError("TaskPipe torn down while submitting")
         return ticket
 
-    def close(self) -> None:
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Wait until every already-submitted task has completed (landed
+        or failed onto its ticket) — the consistent-round-boundary
+        primitive: after a True return, all in-flight pushes have been
+        applied and the table state sits at a well-defined boundary.
+        Returns False when ``timeout_s`` expires first (a hung collective
+        is still in flight)."""
+        from multiverso_tpu.resilience.watchdog import fd_stats
+
+        t0 = time.monotonic()
+        deadline = None if timeout_s is None else t0 + timeout_s
+        with self._idle:
+            while self._inflight > 0:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    fd_stats.note_drain(time.monotonic() - t0, ok=False)
+                    return False
+                self._idle.wait(remaining if remaining is not None else 1.0)
+        fd_stats.note_drain(time.monotonic() - t0, ok=True)
+        return True
+
+    def close(self, timeout_s: float = 60.0) -> None:
         """Drain every queued task, then stop the thread (idempotent).
-        Exceptions from drained tasks stay parked on their tickets."""
+        Exceptions from drained tasks stay parked on their tickets. On a
+        broken pipe the join is best-effort under ``timeout_s`` — the
+        worker may be stuck inside a hung collective."""
         if self._closed:
             return
         self._closed = True
         self._ready.exit()  # pop() returns queued items, then None
-        self._thread.join(timeout=60)
+        self._thread.join(timeout=timeout_s)

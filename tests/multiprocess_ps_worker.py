@@ -8,6 +8,7 @@ N-node Communicator deployment (ref:
 Applications/WordEmbedding/src/communicator.cpp:117-249).
 
 argv: <pid> <nproc> <coord> <corpus.npy> <out.npy> <mode: same|shard>
+      [shared_root]
 
 mode=same : every rank trains the FULL corpus (identical blocks). With
             delta averaging by num_workers this must reproduce the
@@ -23,6 +24,16 @@ mode=shard_pipelined: uneven shards through the PIPELINED PS path
             the reference's -is_pipeline Communicator.
 mode=shard_pipelined_sparse: same plus -ps_compress=sparse (packed delta
             pushes unpacked inside the SPMD scatter program).
+mode=chaos_drill: the failure-domain drill (shared_root required —
+            holds <root>/ck checkpoints + <root>/hb heartbeat beacons).
+            Pipelined depth=1 with quorum checkpoints every 2 rounds,
+            watchdog armed; rank 1 is chaos-dropped (os._exit 137) at
+            round 5. The survivor must exit via a structured RankFailure
+            (printing "RANK_FAILURE kind=... round=...", rc 42) with a
+            valid drained checkpoint left behind — never hang.
+mode=chaos_resume: relaunch after the drill: every rank resumes from the
+            drained quorum checkpoint and finishes ("resumed from"
+            continuity + identical final tables).
 """
 
 import os
@@ -43,18 +54,34 @@ import numpy as np
 def main():
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     corpus_path, out_path, mode = sys.argv[4], sys.argv[5], sys.argv[6]
+    shared_root = sys.argv[7] if len(sys.argv) > 7 else ""
     import multiverso_tpu as mv
     from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
     from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+    from multiverso_tpu.resilience.watchdog import RankFailure
 
-    mv.MV_Init(
-        [
-            "prog",
-            f"-coordinator={coord}",
-            f"-process_id={pid}",
-            f"-num_processes={nproc}",
+    chaos_mode = mode.startswith("chaos_")
+    argv = [
+        "prog",
+        f"-coordinator={coord}",
+        f"-process_id={pid}",
+        f"-num_processes={nproc}",
+    ]
+    if chaos_mode:
+        assert shared_root, "chaos_* modes need the shared_root argv"
+        # watchdog armed: file-backed beacons on the shared root, tight
+        # deadlines so the drill detects within seconds, bounded ticket
+        # waits as the backstop when the transport hangs instead of
+        # erroring
+        argv += [
+            f"-heartbeat_dir={shared_root}/hb",
+            "-heartbeat_deadline_s=3",
+            "-heartbeat_interval_s=0.2",
+            "-collective_timeout_s=20",
         ]
-    )
+        if mode == "chaos_drill":
+            argv.append("-chaos_drop_rank=1:5")
+    mv.MV_Init(argv)
     assert jax.process_count() == nproc, jax.process_count()
 
     ids = np.load(corpus_path)
@@ -82,11 +109,26 @@ def main():
         epoch=1, sample=0, min_count=0, output_file=w2v_path, use_ps=True,
         is_pipeline=False, train_file="unused",
         use_adagrad=mode.endswith("adagrad"),
-        ps_pipeline_depth=1 if "pipelined" in mode else 0,
+        ps_pipeline_depth=1 if "pipelined" in mode or chaos_mode else 0,
         ps_compress="sparse" if mode.endswith("pipelined_sparse") else "none",
+        checkpoint_dir=f"{shared_root}/ck" if chaos_mode else "",
+        checkpoint_every_steps=2 if chaos_mode else 0,
     )
     we = WordEmbedding(opt, dictionary=d)
-    loss = we.train(ids=ids)
+    try:
+        loss = we.train(ids=ids)
+    except RankFailure as rf:
+        # the drill's survivor path: detection + containment ran (drained
+        # boundary + FAILURE report published by _ps_contain_failure);
+        # exit with a distinct code the driver asserts on — NOT a hang
+        print(
+            f"RANK_FAILURE pid={pid} kind={rf.kind} round={rf.round_idx} "
+            f"suspected={rf.rank}",
+            flush=True,
+        )
+        # os._exit: the jax distributed service's atexit teardown can
+        # itself block on the dead peer — containment already ran
+        os._exit(42)
     assert np.isfinite(loss), loss
     np.save(out_path, we.embeddings())
     mv.MV_Barrier()

@@ -1,0 +1,641 @@
+"""Failure-domain hardening: watchdog, poisoned-pipe containment, quorum
+checkpoint commit, and elastic resume for the PS (sync + pipelined) and
+device-pipeline paths.
+
+Contracts pinned here (the cross-process leg — a REAL rank kill +
+survivor containment + relaunch — lives in the ci.sh 2-proc drill):
+
+* ``ASyncBuffer``: a fill-thread exception re-raises on the consumer's
+  next ``Get()`` and stays sticky (no stale value is ever served);
+  ``Get()`` after ``Stop()`` raises cleanly;
+* ``TaskPipe``: a ticket wait that exceeds its deadline raises a
+  structured ``RankFailure`` (collective_timeout) instead of blocking;
+  the first failure marks the pipe broken and subsequent submits/waits
+  fail FAST with ``PipelineBroken``; ``drain()`` waits for every
+  in-flight task (and times out instead of hanging on a stuck one);
+* ``HeartbeatMonitor``: a peer that stops publishing beacons for longer
+  than the deadline is declared dead (deterministic fake-clock drills,
+  incl. the ``-chaos_drop_heartbeats_after`` injection);
+* breaker x watchdog: serving routes tripped by ``-chaos_route_errors``
+  shed with ``Overloaded`` and never escalate to ``RankFailure``;
+* quorum commit: ``save_tables`` seals a per-rank stage record and
+  rank 0 verifies it before the rename — a missing record
+  (``-chaos_quorum_missing_stage``) aborts with ``QuorumAbort``, sweeps
+  the staging dir and publishes NOTHING;
+* containment e2e (single-process, deterministic): a chaos-hung
+  collective under an armed ticket deadline raises ``RankFailure`` from
+  ``train()``, drains, and publishes the failure report;
+* elastic resume == uninterrupted, bit for bit: PS depth 0, PS depth 1
+  (tables + staged in-flight pull window + gp history), and the device
+  pipeline (call-count cursor through the superbatch walk state).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.resilience import chaos
+from multiverso_tpu.resilience.watchdog import (
+    FileHeartbeatStore,
+    HeartbeatMonitor,
+    PipelineBroken,
+    QuorumAbort,
+    RankFailure,
+    classify_collective_error,
+    fd_stats,
+)
+from multiverso_tpu.utils.async_buffer import ASyncBuffer, TaskPipe
+from multiverso_tpu.utils.configure import SetCMDFlag
+
+
+@pytest.fixture
+def chaos_reset():
+    chaos.reset()
+    yield
+    for flag, off in [
+        ("chaos_hang_collective", ""), ("chaos_drop_rank", ""),
+        ("chaos_drop_heartbeats_after", -1),
+        ("chaos_quorum_missing_stage", -1), ("chaos_kill_at_step", -1),
+        ("chaos_kill_mode", "exit"), ("chaos_route_errors", ""),
+        ("collective_timeout_s", 0.0), ("heartbeat_deadline_s", 0.0),
+        ("heartbeat_dir", ""),
+    ]:
+        SetCMDFlag(flag, off)
+    chaos.reset()
+
+
+# ==================================================== ASyncBuffer contract
+
+
+def test_async_buffer_error_is_sticky_not_stale():
+    """A fill exception re-raises on Get() — and on EVERY later Get():
+    the consumer can never spin on a stale value from a dead producer."""
+    calls = []
+
+    def fill():
+        calls.append(1)
+        if len(calls) >= 2:
+            raise ValueError("producer died")
+        return "first"
+
+    buf = ASyncBuffer(fill)
+    assert buf.Get() == "first"
+    with pytest.raises(ValueError, match="producer died"):
+        buf.Get()
+    with pytest.raises(ValueError, match="producer died"):
+        buf.Get()  # sticky — not a stale "first", not a deadlock
+    assert len(calls) == 2  # no new fill was started after the error
+    buf.Stop()
+
+
+def test_async_buffer_get_after_stop_raises():
+    buf = ASyncBuffer(lambda: 1)
+    assert buf.Get() == 1
+    buf.Stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        buf.Get()
+
+
+# ======================================================= TaskPipe hardening
+
+
+def test_taskpipe_deadline_raises_rank_failure_and_breaks_pipe():
+    pipe = TaskPipe()
+    release = threading.Event()
+    slow = pipe.submit(lambda: release.wait(10), tag="hung-collective")
+    before = fd_stats.rank_failures
+    with pytest.raises(RankFailure) as ei:
+        slow.wait_result(deadline_s=0.1, poll_s=0.01)
+    assert ei.value.kind == "collective_timeout"
+    assert "hung-collective" in str(ei.value)
+    assert fd_stats.rank_failures == before + 1
+    # poisoned-pipe containment: fail FAST from now on
+    with pytest.raises(PipelineBroken):
+        pipe.submit(lambda: 1)
+    queued = slow  # the hung ticket itself now fails fast on wait
+    t0 = time.monotonic()
+    with pytest.raises(PipelineBroken):
+        queued.wait_result(deadline_s=30, poll_s=0.01)
+    assert time.monotonic() - t0 < 5
+    release.set()
+    pipe.close(timeout_s=5)
+
+
+def test_taskpipe_drain_lands_all_inflight_tasks():
+    pipe = TaskPipe()
+    done = []
+    for i in range(8):
+        pipe.submit(lambda i=i: done.append(i) or time.sleep(0.005))
+    assert pipe.drain(timeout_s=10) is True
+    assert done == list(range(8))  # strict submission order, all landed
+    pipe.close()
+
+
+def test_taskpipe_drain_times_out_on_stuck_task_instead_of_hanging():
+    pipe = TaskPipe()
+    release = threading.Event()
+    pipe.submit(lambda: release.wait(30), tag="stuck")
+    t0 = time.monotonic()
+    assert pipe.drain(timeout_s=0.2) is False
+    assert time.monotonic() - t0 < 5
+    release.set()
+    pipe.close(timeout_s=5)
+
+
+def test_taskpipe_watchdog_failure_surfaces_on_wait(tmp_path):
+    """A peer the monitor declared dead interrupts the ticket wait with
+    RankFailure(heartbeat_lost) — the training thread never blocks on a
+    collective whose peer is gone."""
+    clock = [0.0]
+    mon = HeartbeatMonitor(
+        FileHeartbeatStore(str(tmp_path), 0), rank=0, world=2,
+        deadline_s=5.0, interval_s=1.0, clock=lambda: clock[0],
+    )
+    peer = FileHeartbeatStore(str(tmp_path), 1)
+    peer.beat(0)
+    clock[0] = 1.0
+    assert mon.poll_once() is None  # peer alive
+    clock[0] = 7.0
+    assert mon.poll_once() is not None  # silent past the deadline
+
+    pipe = TaskPipe()
+    release = threading.Event()
+    slow = pipe.submit(lambda: release.wait(10), tag="pull:7")
+    with pytest.raises(RankFailure) as ei:
+        slow.wait_result(deadline_s=None, watchdog=mon, round_idx=7,
+                         poll_s=0.01)
+    assert ei.value.kind == "heartbeat_lost"
+    assert ei.value.rank == 1
+    assert ei.value.round_idx == 7
+    assert pipe.broken is not None
+    release.set()
+    pipe.close(timeout_s=5)
+
+
+# ========================================================== heartbeat drills
+
+
+def test_heartbeat_monitor_detects_silent_peer_within_deadline(tmp_path):
+    """Deterministic fake-clock latency pin: a peer silent for longer
+    than deadline_s is declared dead on the first poll past it — and the
+    failure names the rank."""
+    clock = [0.0]
+    mon = HeartbeatMonitor(
+        FileHeartbeatStore(str(tmp_path), 0), rank=0, world=3,
+        deadline_s=2.0, interval_s=0.5, clock=lambda: clock[0],
+    )
+    peers = {p: FileHeartbeatStore(str(tmp_path), p) for p in (1, 2)}
+    for step in range(4):  # everyone beating: no failure
+        for p, st in peers.items():
+            st.beat(step)
+        clock[0] += 0.5
+        assert mon.poll_once() is None, clock[0]
+    # rank 2 goes silent; rank 1 keeps beating
+    for step in range(4, 9):  # 2.5s of silence > the 2.0s deadline
+        peers[1].beat(step)
+        clock[0] += 0.5
+        mon.poll_once()
+    failure = mon.failed()
+    assert failure is not None and failure.kind == "heartbeat_lost"
+    assert failure.rank == 2
+    ages = mon.ages()
+    assert ages[1] <= 0.5 and ages[2] > 2.0
+    with pytest.raises(RankFailure):
+        mon.check()
+
+
+def test_chaos_heartbeat_loss_injection(tmp_path, chaos_reset):
+    """-chaos_drop_heartbeats_after=N: this rank's beacons stop while the
+    process lives — a PEER's monitor must escalate."""
+    SetCMDFlag("chaos_drop_heartbeats_after", 2)
+    clock = [0.0]
+    victim = HeartbeatMonitor(
+        FileHeartbeatStore(str(tmp_path), 1), rank=1, world=2,
+        deadline_s=100.0, interval_s=0.5, clock=lambda: clock[0],
+    )
+    observer = HeartbeatMonitor(
+        FileHeartbeatStore(str(tmp_path), 0), rank=0, world=2,
+        deadline_s=2.0, interval_s=0.5, clock=lambda: clock[0],
+    )
+    for _ in range(10):
+        victim.poll_once()  # beats 0, 1, then chaos swallows the rest
+        observer.poll_once()
+        clock[0] += 0.5
+    failure = observer.failed()
+    assert failure is not None and failure.kind == "heartbeat_lost"
+    assert failure.rank == 1
+
+
+def test_classify_collective_error_maps_transport_not_logic():
+    rf = classify_collective_error(
+        RuntimeError("Gloo AllGather failed: Connection reset by peer"),
+        round_idx=3,
+    )
+    assert rf is not None and rf.kind == "peer_dead" and rf.round_idx == 3
+    assert classify_collective_error(ValueError("bad shape")) is None
+    same = RankFailure("heartbeat_lost", "x", rank=1)
+    assert classify_collective_error(same) is same
+
+
+# ================================================== breaker x watchdog
+
+
+def test_breaker_trip_does_not_escalate_to_rank_failure(chaos_reset):
+    """A route tripped by -chaos_route_errors while the watchdog is armed
+    sheds with Overloaded — serving-plane failures must never be promoted
+    to a control-plane RankFailure."""
+    from multiverso_tpu.serving import Overloaded, TableServer
+
+    clock = [0.0]
+    store_dir = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"mv_hb_brk_{os.getpid()}"
+    )
+    mon = HeartbeatMonitor(
+        FileHeartbeatStore(store_dir, 0), rank=0, world=2,
+        deadline_s=5.0, interval_s=0.5, clock=lambda: clock[0],
+    )
+    FileHeartbeatStore(store_dir, 1).beat(0)  # peer alive throughout
+    SetCMDFlag("chaos_route_errors", "lookup:3")
+    srv = TableServer(
+        {"emb": np.ones((32, 8), np.float32)},
+        max_batch=4, max_delay_s=0.001, breaker_threshold=2,
+        breaker_cooldown_s=30.0, name="fd-breaker",
+    ).start()
+    before = fd_stats.rank_failures
+    shed = 0
+    try:
+        for _ in range(8):
+            try:
+                srv.lookup_async("emb", np.arange(3), block=True).result(
+                    timeout=10
+                )
+            except (Overloaded, RuntimeError):
+                shed += 1
+            clock[0] += 0.2
+            assert mon.poll_once() is None  # watchdog stays quiet
+    finally:
+        srv.stop()
+    assert shed >= 3  # injected failures + breaker sheds
+    assert mon.failed() is None
+    assert fd_stats.rank_failures == before  # no spurious escalation
+
+
+# ======================================================== quorum commit
+
+
+@pytest.fixture
+def mv_env():
+    import multiverso_tpu as mv
+
+    mv.MV_Init(["prog"])
+    yield mv
+    mv.MV_ShutDown(finalize=True)
+
+
+def test_quorum_save_writes_stage_record_and_rank_meta(mv_env, tmp_path):
+    from multiverso_tpu.api import MV_CreateTable
+    from multiverso_tpu.io.checkpoint import save_tables
+    from multiverso_tpu.resilience.checkpoint import require_valid
+    from multiverso_tpu.tables import MatrixTableOption
+
+    t = MV_CreateTable(MatrixTableOption(num_row=8, num_col=4, name="q"))
+    t.add_rows(np.arange(4), np.ones((4, 4), np.float32))
+    path = str(tmp_path / "ckpt-1")
+    extra = []
+
+    def rank_payload(tmp):
+        os.makedirs(os.path.join(tmp, "rank0"), exist_ok=True)
+        np.savez(os.path.join(tmp, "rank0", "state.npz"),
+                 cursor=np.int64(7))
+        extra.append(tmp)
+
+    save_tables(path, [t], step=1, meta={"kind": "test"},
+                rank_payload=rank_payload, rank_meta={"pairs": 123})
+    manifest = require_valid(path)
+    # the stage record is part of the sealed payload
+    assert os.path.exists(os.path.join(path, "stage-rank0.json"))
+    assert manifest["meta"]["ranks"]["0"] == {"pairs": 123}
+    with np.load(os.path.join(path, "rank0", "state.npz")) as d:
+        assert int(d["cursor"]) == 7
+
+
+def test_quorum_abort_on_missing_stage_record(mv_env, tmp_path, chaos_reset):
+    """A rank dying between payload and stage seal: rank 0 must ABORT the
+    commit — nothing published, staging dir swept, abort counted."""
+    from multiverso_tpu.api import MV_CreateTable
+    from multiverso_tpu.io.checkpoint import save_tables
+    from multiverso_tpu.tables import MatrixTableOption
+
+    t = MV_CreateTable(MatrixTableOption(num_row=8, num_col=4, name="qa"))
+    root = tmp_path / "qroot"
+    path = str(root / "ckpt-1")
+    SetCMDFlag("chaos_quorum_missing_stage", 0)
+    before = fd_stats.quorum_aborts
+    with pytest.raises(QuorumAbort):
+        save_tables(path, [t], step=1)
+    assert fd_stats.quorum_aborts == before + 1
+    assert not os.path.exists(path)  # no half checkpoint, ever
+    assert not [n for n in os.listdir(root) if ".tmp-" in n]  # swept
+
+
+# ========================================= containment e2e (deterministic)
+
+
+V = 100
+
+
+def _corpus(seed=0, n=3000):
+    rng = np.random.RandomState(seed)
+    p = rng.randint(0, V // 2, n) * 2
+    return (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+
+
+def _dict(ids):
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(V)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(
+        np.bincount(np.maximum(ids, 0), minlength=V), 1
+    ).astype(np.int64)
+    return d
+
+
+def _run_ps(ids, d, **kw):
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import (
+        WEOptions,
+        WordEmbedding,
+    )
+
+    mv.MV_Init(["prog"])
+    try:
+        base = dict(
+            size=16, negative=3, window=2, batch_size=256, steps_per_call=2,
+            epoch=3, sample=0, alpha=0.1, output_file="", use_ps=True,
+            is_pipeline=False, train_file="unused",
+        )
+        base.update(kw)
+        opt = WEOptions(**base)
+        we = WordEmbedding(opt, dictionary=d)
+        we.train(ids=ids)
+        return we.embeddings().copy()
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def test_hung_collective_contained_with_drained_report(tmp_path,
+                                                       chaos_reset):
+    """A chaos-hung round-6 pull under a 0.5s ticket deadline: train()
+    raises RankFailure(collective_timeout) instead of hanging, and the
+    containment path publishes the failure report naming the committed
+    round boundary and the checkpoint to resume from."""
+    ids = _corpus()
+    d = _dict(ids)
+    ck = str(tmp_path / "ck")
+    SetCMDFlag("chaos_hang_collective", "6:30")
+    SetCMDFlag("collective_timeout_s", 0.5)
+    t0 = time.monotonic()
+    with pytest.raises(RankFailure) as ei:
+        _run_ps(ids, d, ps_pipeline_depth=1, checkpoint_dir=ck,
+                checkpoint_every_steps=3)
+    assert time.monotonic() - t0 < 60  # bounded, not a 30s+ hang per wait
+    assert ei.value.kind == "collective_timeout"
+    reports = [f for f in os.listdir(ck) if f.startswith("FAILURE-")]
+    assert reports, os.listdir(ck)
+    with open(os.path.join(ck, reports[0])) as f:
+        rep = json.load(f)
+    assert rep["kind"] == "collective_timeout"
+    assert rep["drained"] in (True, False)
+    assert rep["committed_round_boundary"] >= 3
+    assert rep["resume_from"] and os.path.basename(
+        rep["resume_from"]
+    ).startswith("ckpt-")
+    out = __import__(
+        "multiverso_tpu.utils.dashboard", fromlist=["Dashboard"]
+    ).Dashboard.Display()
+    assert "[failure_domain]" in out and "broken_pipes" in out
+
+
+# =============================================== elastic resume == golden
+
+
+def test_ps_sync_kill_resume_matches_uninterrupted(tmp_path, chaos_reset):
+    """Depth 0: chaos-kill at round 10, resume — final embeddings EQUAL
+    the uninterrupted run's, bit for bit (tables + wc + data cursor all
+    restore; rounds regenerate deterministically past the cursor)."""
+    ids = _corpus()
+    d = _dict(ids)
+    golden = _run_ps(ids, d)
+    ck = str(tmp_path / "ck0")
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", "0:10")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_ps(ids, d, checkpoint_dir=ck, checkpoint_every_steps=4)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+    resumed = _run_ps(ids, d, checkpoint_dir=ck, checkpoint_every_steps=4)
+    np.testing.assert_array_equal(resumed, golden)
+
+
+def test_ps_pipelined_kill_resume_matches_uninterrupted(tmp_path,
+                                                        chaos_reset):
+    """Depth 1 (the acceptance bar): the drained checkpoint stages the
+    in-flight pull window + gp history, so the resumed run replays the
+    exact staleness warm-up — kill at round 8 + restart EQUALS the
+    uninterrupted pipelined run bit for bit, sparse pulls and all."""
+    ids = _corpus()
+    d = _dict(ids)
+    golden = _run_ps(ids, d, ps_pipeline_depth=1)
+    ck = str(tmp_path / "ck1")
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", "0:8")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_ps(ids, d, ps_pipeline_depth=1, checkpoint_dir=ck,
+                checkpoint_every_steps=3)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+    resumed = _run_ps(ids, d, ps_pipeline_depth=1, checkpoint_dir=ck,
+                      checkpoint_every_steps=3)
+    np.testing.assert_array_equal(resumed, golden)
+
+
+def test_ps_pipelined_1bit_residual_rides_resume(tmp_path, chaos_reset):
+    """-ps_compress=1bit: the device-resident error-feedback residual is
+    part of the staged rank state — kill + resume still EQUALS the
+    uninterrupted 1bit run (a dropped residual would re-bias every
+    post-resume push)."""
+    ids = _corpus(seed=7, n=1500)
+    d = _dict(ids)
+    kw = dict(ps_pipeline_depth=1, ps_compress="1bit")
+    golden = _run_ps(ids, d, epoch=2, **kw)
+    ck = str(tmp_path / "ck1b")
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", "0:7")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_ps(ids, d, epoch=2, checkpoint_dir=ck,
+                checkpoint_every_steps=3, **kw)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+    resumed = _run_ps(ids, d, epoch=2, checkpoint_dir=ck,
+                      checkpoint_every_steps=3, **kw)
+    np.testing.assert_array_equal(resumed, golden)
+
+
+def test_ps_resume_rejects_mismatched_flags(tmp_path, chaos_reset):
+    """A checkpoint's staged rank state is flag-shaped: resuming with a
+    different -ps_sparse_pull (or compress/adagrad) must die with ONE
+    clear CHECK, not an npz KeyError or a silent contract break."""
+    from multiverso_tpu.utils.log import FatalError
+
+    ids = _corpus(seed=9, n=1200)
+    d = _dict(ids)
+    ck = str(tmp_path / "ck_flags")
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", "0:6")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_ps(ids, d, ps_pipeline_depth=1, checkpoint_dir=ck,
+                checkpoint_every_steps=2)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+    with pytest.raises(FatalError, match="sparse_pull"):
+        _run_ps(ids, d, ps_pipeline_depth=1, ps_sparse_pull=False,
+                checkpoint_dir=ck, checkpoint_every_steps=2)
+
+
+def test_ps_pipelined_checkpointing_never_perturbs_training(tmp_path):
+    """Drained checkpoints pause the pipe but change no math: a pipelined
+    run WITH checkpointing equals one without, bit for bit."""
+    ids = _corpus(seed=5, n=2000)
+    d = _dict(ids)
+    plain = _run_ps(ids, d, ps_pipeline_depth=1)
+    ck = str(tmp_path / "ck_noperturb")
+    with_ck = _run_ps(ids, d, ps_pipeline_depth=1, checkpoint_dir=ck,
+                      checkpoint_every_steps=2)
+    np.testing.assert_array_equal(plain, with_ck)
+
+
+def _run_device(ids, d, **kw):
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import (
+        WEOptions,
+        WordEmbedding,
+    )
+
+    mv.MV_Init(["prog"])
+    try:
+        opt = WEOptions(
+            size=16, negative=3, window=2, batch_size=64, steps_per_call=2,
+            epoch=2, sample=0, min_count=0, output_file="",
+            device_pipeline=True, threads=1, is_pipeline=False,
+            train_file="unused", **kw,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        we.train(ids=ids)
+        return we.embeddings().copy()
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def test_device_pipeline_kill_resume_matches_uninterrupted(tmp_path,
+                                                           chaos_reset):
+    """The device-pipeline data cursor (leg seq, call count, walk_t, PRNG
+    key) rides the checkpoint: kill at dispatch call 14 + restart EQUALS
+    the uninterrupted run (ROADMAP device-pipeline resume NEXT)."""
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, 30, 800) * 2
+    ids = (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+    d = Dictionary()
+    vv = int(ids.max()) + 1
+    d.words = [f"w{i}" for i in range(vv)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.bincount(ids[ids >= 0], minlength=vv).astype(np.int64)
+
+    golden = _run_device(ids, d)
+    assert np.abs(golden).max() > 1e-3
+    ck = str(tmp_path / "dev_ck")
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_kill_at_step", 14)
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_device(ids, d, checkpoint_dir=ck, checkpoint_every_steps=3)
+    SetCMDFlag("chaos_kill_at_step", -1)
+    chaos.reset()
+    from multiverso_tpu.resilience import latest_valid
+
+    assert latest_valid(ck) is not None
+    resumed = _run_device(ids, d, checkpoint_dir=ck,
+                          checkpoint_every_steps=3)
+    np.testing.assert_allclose(resumed, golden, atol=1e-6)
+
+
+# ============================================================ /healthz
+
+
+def test_http_health_endpoint_serves_all_sections():
+    import urllib.request
+
+    from multiverso_tpu.serving import HealthServer, TableServer
+
+    srv = TableServer(
+        {"emb": np.ones((16, 4), np.float32)},
+        max_batch=4, max_delay_s=0.001, name="hz",
+    ).start()
+    h = HealthServer(srv, port=0)  # ephemeral
+    try:
+        with urllib.request.urlopen(h.url, timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+        assert payload["status"] in ("ok", "degraded")
+        assert payload["serving"]["name"] == "hz"
+        assert "restarts" in payload["resilience"]
+        for k in ("tickets", "broken_pipes", "drains", "quorum_aborts",
+                  "rank_failures", "ticket_wait_p99_ms"):
+            assert k in payload["failure_domain"], k
+        # anything but /healthz is a 404
+        bad = urllib.request.Request(h.url.replace("/healthz", "/metrics"))
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=10)
+    finally:
+        h.stop()
+        srv.stop()
+
+
+def test_health_port_flag_starts_endpoint_with_server(chaos_reset):
+    """-health_port wires the endpoint into TableServer.start()/stop()
+    — the flag must not be dead surface."""
+    import socket
+    import urllib.request
+
+    from multiverso_tpu.serving import TableServer
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    SetCMDFlag("health_port", port)
+    srv = TableServer(
+        {"emb": np.ones((8, 4), np.float32)},
+        max_batch=4, max_delay_s=0.001, name="hzflag",
+    ).start()
+    try:
+        url = f"http://127.0.0.1:{port}/healthz"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+        assert payload["serving"]["name"] == "hzflag"
+    finally:
+        SetCMDFlag("health_port", 0)
+        srv.stop()
+    with pytest.raises(Exception):  # endpoint stops with the server
+        urllib.request.urlopen(url, timeout=2)
