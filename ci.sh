@@ -112,6 +112,60 @@ print("pipelined PS smoke OK: rounds", rounds[0])
 EOF
 rm -rf "$PSROOT"
 
+echo "== tiered-table smoke (small HBM cache == resident tables) =="
+# the HBM<->host tiered MatrixTable end to end through the app: a
+# zipf corpus trains with -table_tier_hbm_mb sized to ~15% of the
+# tables (real faults/evictions + look-ahead prefetch) and must land
+# a finite loss, a nonzero cache hit rate, and final tables EQUAL to
+# the resident-table run — the tier moves rows, never changes values
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.tables import tier_cache_stats
+
+V = 2000
+rng = np.random.RandomState(11)
+p = (rng.zipf(2.0, 6000) % (V // 2)) * 2
+ids = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1).astype(np.int32)
+d = Dictionary()
+d.words = [f"w{i}" for i in range(V)]
+d.word2id = {w: i for i, w in enumerate(d.words)}
+d.counts = np.maximum(
+    np.bincount(np.maximum(ids, 0), minlength=V), 1
+).astype(np.int64)
+
+
+def run(**kw):
+    mv.MV_Init(["prog"])
+    try:
+        opt = WEOptions(
+            size=16, negative=3, window=2, batch_size=32, steps_per_call=2,
+            epoch=1, sample=0, alpha=0.1, output_file="", use_ps=True,
+            is_pipeline=False, **kw,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        loss = we.train(ids=ids.copy())
+        return loss, we.embeddings().copy(), dict(tier_cache_stats())
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+_, golden, _ = run(ps_pipeline_depth=1, ps_sparse_pull=False)
+mb = 2 * V * 16 * 4 * 0.15 / 2**20
+loss, tiered, stats = run(table_tier_hbm_mb=mb)
+assert np.isfinite(loss), loss
+s = stats["we_emb_in"]
+assert s["resident"] == 0 and s["hit_rate_pct"] > 0, s
+assert s["faulted_rows"] > 0, s
+np.testing.assert_array_equal(tiered, golden)
+print("tiered smoke OK: hit %.1f%%, prefetch coverage %.1f%%, "
+      "faulted %d, evicted %d" % (
+          s["hit_rate_pct"], s["prefetch_coverage_pct"],
+          s["faulted_rows"], s["evicted_rows"]))
+EOF
+
 echo "== failure-domain drill (2-proc, kill rank 1 mid-pipelined-run) =="
 # the failure-domain layer end to end across REAL processes: rank 1 is
 # chaos-dropped (os._exit 137) at round 5 of a depth-1 pipelined run with

@@ -73,9 +73,13 @@ def _dense_tables(tables: Optional[List[Any]]) -> List[Any]:
 
 
 def _tree_of(tables: List[Any]) -> Dict[str, Any]:
+    # checkpoint_tree is the per-table serialization hook: dense tables
+    # hand over their raw sharded storage + slots; a TieredMatrixTable
+    # flushes its HBM cache and hands over the full host-tier logical
+    # table, so checkpoints are tier-transparent
     tree: Dict[str, Any] = {}
     for t in tables:
-        tree[f"table_{t.table_id}"] = {"storage": t.storage, "state": dict(t.state)}
+        tree[f"table_{t.table_id}"] = t.checkpoint_tree()
     return tree
 
 
@@ -392,19 +396,18 @@ def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:
     _check_readable(directory)
     dense = _dense_tables(tables)
     if dense:
-        target = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
-            _tree_of(dense),
-        )
+        # checkpoint_spec is the shape/dtype skeleton of checkpoint_tree
+        # (host-tier numpy leaves restore as numpy, device leaves onto
+        # their live sharding) — building the TARGET must never pay a
+        # tiered table's flush-and-copy
+        target = {f"table_{t.table_id}": t.checkpoint_spec() for t in dense}
         ckptr = ocp.StandardCheckpointer()
         try:
             restored = ckptr.restore(os.path.join(directory, "tables"), target)
         except Exception as e:  # noqa: BLE001 — one clear error
             _fatal_orbax(directory, "failed to restore the 'tables' orbax tree", e)
         for t in dense:
-            entry = restored[f"table_{t.table_id}"]
-            t.storage = entry["storage"]
-            t.state = dict(entry["state"])
+            t.restore_checkpoint_tree(restored[f"table_{t.table_id}"])
     all_tables = tables if tables is not None else runtime().tables
     for t in all_tables:
         if isinstance(t, KVTable):

@@ -184,6 +184,18 @@ MV_DEFINE_bool(
     "pipelining, as the reference does); local fresh rows are served "
     "from the client's row cache — values identical to a full pull",
 )
+MV_DEFINE_int(
+    "table_tier_hbm_mb", 0,
+    "total HBM budget (MB, split across the embedding/g2 tables "
+    "proportionally to their row counts) for the tiered HBM<->host "
+    "MatrixTable: 0 (default) keeps tables fully HBM-resident; > 0 keeps "
+    "each full logical table in host RAM with a fixed-budget HBM cache "
+    "of hot rows + look-ahead prefetch from the block prep — training "
+    "vocabularies far past chip HBM (see DEPLOY.md for sizing). Routes "
+    "training through the pipelined PS block loop: implies -use_ps and "
+    "-ps_pipeline_depth >= 1, replaces -device_pipeline, and disables "
+    "-ps_sparse_pull (the HBM cache subsumes the dirty-row client cache)",
+)
 
 
 @dataclasses.dataclass
@@ -220,6 +232,9 @@ class WEOptions:
     ps_pipeline_depth: int = 0
     ps_compress: str = "none"
     ps_sparse_pull: bool = True
+    # float so tests/benches can request sub-MB caches; the CLI flag is
+    # whole MB
+    table_tier_hbm_mb: float = 0
     checkpoint_dir: str = ""
     checkpoint_every_steps: int = 0
     checkpoint_every_seconds: float = 0.0
@@ -253,22 +268,28 @@ class _PSCommsStats:
         self.wall_s = 0.0
         self.pull_rows_dense = 0  # rows a full (non-tracked) pull moves
         self.pull_rows_wire = 0   # rows actually transferred
+        self.pull_bytes_wire = 0  # bytes actually moved (packed pulls
+        # ship (idx, val) pairs, so bytes can undercut rows * row_bytes)
         self.push_bytes_dense = 0  # pre-compression delta bytes
         self.push_bytes_wire = 0   # bytes actually moved
         from multiverso_tpu.utils.dashboard import Dashboard
 
         Dashboard.add_section("ps_comms", self.lines)
 
-    def add_pull(self, dt: float, rows_dense: int, rows_wire: int) -> None:
+    def add_pull(self, dt: float, rows_dense: int, rows_wire: int,
+                 bytes_wire: Optional[int] = None) -> None:
+        if bytes_wire is None:
+            bytes_wire = rows_wire * self.dim * 4
         with self._lock:
             self.rounds += 1
             self.pull_s += dt
             self.pull_rows_dense += rows_dense
             self.pull_rows_wire += rows_wire
+            self.pull_bytes_wire += bytes_wire
         from multiverso_tpu.utils.dashboard import Dashboard
 
         # process-global cumulative mirror (this object is per-run)
-        Dashboard.counter("ps.pull_bytes_wire").add(rows_wire * self.dim * 4)
+        Dashboard.counter("ps.pull_bytes_wire").add(bytes_wire)
 
     def add_train(self, dt: float) -> None:
         with self._lock:
@@ -309,9 +330,7 @@ class _PSCommsStats:
             "pull_bytes_dense_per_round": round(
                 self.pull_rows_dense * row_b / r, 1
             ),
-            "pull_bytes_wire_per_round": round(
-                self.pull_rows_wire * row_b / r, 1
-            ),
+            "pull_bytes_wire_per_round": round(self.pull_bytes_wire / r, 1),
             "push_bytes_dense_per_round": round(self.push_bytes_dense / r, 1),
             "push_bytes_wire_per_round": round(self.push_bytes_wire / r, 1),
         }
@@ -374,6 +393,37 @@ class WordEmbedding:
         self.huffman = HuffmanEncoder(self.dict.counts) if options.hs else None
         self.sampler = None if options.hs else AliasSampler(self.dict.counts)
         out_rows = self.huffman.num_inner_nodes if options.hs else V
+        self._out_rows = out_rows
+        # Tiered tables (-table_tier_hbm_mb > 0): the full logical tables
+        # live in host RAM with a fixed-budget HBM cache of hot rows —
+        # the config for vocabularies past chip HBM. Training must be
+        # block-structured (the working set has to be known before the
+        # step), so the run routes through the PIPELINED PS block loop:
+        # pulls fault rows in on the comms thread while the previous
+        # block trains, and the block-prep look-ahead prefetches the next
+        # block's unions on top of that.
+        self._tier = options.table_tier_hbm_mb > 0
+        if self._tier:
+            if options.device_pipeline:
+                Log.Info(
+                    "[WordEmbedding] -table_tier_hbm_mb: the fully "
+                    "HBM-resident device pipeline assumes the whole table "
+                    "fits — routing through the tiered PS block loop "
+                    "instead"
+                )
+                options.device_pipeline = False
+            options.use_ps = True
+            if options.ps_pipeline_depth == 0:
+                Log.Info(
+                    "[WordEmbedding] -table_tier_hbm_mb: raising "
+                    "-ps_pipeline_depth to 1 so row faults ride the comms "
+                    "thread under training"
+                )
+                options.ps_pipeline_depth = 1
+            if options.ps_sparse_pull:
+                # the HBM cache subsumes the dirty-row client cache (and a
+                # second full-table host mirror would double host RAM)
+                options.ps_sparse_pull = False
         # Model parallelism (-num_shards=N + -device_pipeline): the tables
         # must be born row-sharded — materializing the full (V, D) arrays
         # on one device first and re-placing them later would OOM at the
@@ -398,7 +448,13 @@ class WordEmbedding:
                 self._tab = mesh_lib.table_sharding(mesh, 2)
                 self._rep = mesh_lib.replicated_sharding(mesh)
                 self._nshards = int(mesh.shape[mesh_lib.SHARD_AXIS])
-        if self._tab is not None:
+        if self._tier:
+            # the whole point is that (V, D) never materializes as one
+            # resident device array: PS-mode training reads/writes through
+            # the tiered tables, and params fills from the host tier after
+            # training (embeddings()/save_embeddings)
+            self.params: Dict[str, jnp.ndarray] = {}
+        elif self._tab is not None:
             ns = self._nshards
 
             def _make_sharded():
@@ -582,10 +638,11 @@ class WordEmbedding:
         from multiverso_tpu.tables import (
             MatrixTableOption,
             SparseMatrixTableOption,
+            TieredMatrixTableOption,
         )
 
         V, D = self.cfg.vocab_size, self.opt.size
-        out_rows = int(self.params["emb_out"].shape[0])
+        out_rows = self._out_rows
         scale = 0.5 / D
         # Pipelined PS (-ps_pipeline_depth >= 1) with -ps_sparse_pull:
         # the weight/g2 tables become SparseMatrixTables so repeat pulls
@@ -594,10 +651,22 @@ class WordEmbedding:
         # reference does for its prefetch buffer
         # (sparse_matrix_table.cpp:187-190)
         sparse = (
-            self.opt.ps_pipeline_depth >= 1 and self.opt.ps_sparse_pull
+            not self._tier
+            and self.opt.ps_pipeline_depth >= 1
+            and self.opt.ps_sparse_pull
         )
+        # Tiered tables (-table_tier_hbm_mb): the flag is the TOTAL cache
+        # budget, split across the weight/g2 tables proportionally to
+        # their row counts (every table's rows are D floats wide)
+        tier_mb = float(self.opt.table_tier_hbm_mb)
+        tier_rows_total = (V + out_rows) * (2 if self.opt.use_adagrad else 1)
 
         def _mk(**kw):
+            if self._tier:
+                share = tier_mb * kw["num_row"] / tier_rows_total
+                return MV_CreateTable(
+                    TieredMatrixTableOption(hbm_mb=share, **kw)
+                )
             if sparse:
                 return MV_CreateTable(
                     SparseMatrixTableOption(is_pipeline=True, **kw)
@@ -666,6 +735,19 @@ class WordEmbedding:
             if self.opt.use_adagrad:
                 self._ps_cache["g2_in"] = np.zeros((V, D), np.float32)
                 self._ps_cache["g2_out"] = np.zeros((out_rows, D), np.float32)
+        # look-ahead prefetch targets (tiered mode): the block-prep
+        # thread submits the NEXT block's row unions to each tiered
+        # table's prefetch pipe, so rows land in HBM before the pull that
+        # needs them
+        self._tier_prefetch_tables = (
+            [(t, side) for _n, t, side in self._ps_entries()]
+            if self._tier else []
+        )
+        # packed pulls (pull-direction SparseFilter): engage with the
+        # push compression flag — lossless either way
+        self._ps_pull_packed = (
+            self._ps_sparse_tables and self.opt.ps_compress != "none"
+        )
 
     def _wc_push_and_read(self, inc: int) -> int:
         """Add this client's trained-pair increment and read back the global
@@ -785,6 +867,18 @@ class WordEmbedding:
             for k in remapped[0]
             if remapped[0][k] is not None
         }
+        # tiered look-ahead: this prep runs one block AHEAD of training
+        # (ASyncBuffer fill thread), so these unions are exactly the rows
+        # the pull after next will touch — submit them as prefetch
+        # tickets so they fault into the HBM cache under the current
+        # block's training (ISSUE 6 tentpole; tickets are advisory and
+        # never block the prep thread). They ride the COMMS pipe, not a
+        # per-table one: all collective dispatch on one thread
+        for table, side in getattr(self, "_tier_prefetch_tables", ()):
+            table.prefetch(
+                uin if side == "in" else uout,
+                pipe=getattr(self, "_tier_prefetch_pipe", None),
+            )
         return {
             "nbatches": len(batches), "uin": uin, "uout": uout, "xs": xs_np,
         }
@@ -829,6 +923,8 @@ class WordEmbedding:
             ids_out[:no_u] = blk["uout"]
         rows_dense = 0
         rows_wire = 0
+        bytes_wire = 0
+        row_b = self.opt.size * 4
         pulled = {}
         with monitor("ps.pull"):
             for name, table, side in self._ps_entries():
@@ -843,23 +939,38 @@ class WordEmbedding:
                         if have
                         else np.zeros(0, np.int64)
                     )
-                    stale, rows, wire = table.get_stale_rows_local(
-                        uids, GetOption(worker_id=table.client_view())
+                    stale, rows, wire, nbytes = table.get_stale_rows_local(
+                        uids, GetOption(worker_id=table.client_view()),
+                        packed=self._ps_pull_packed,
                     )
                     cache = self._ps_cache[name]
                     if stale.size:
                         cache[stale] = rows
                     W = cache[ids_b]  # fancy indexing: already a copy
                     rows_wire += wire
+                    bytes_wire += nbytes
+                elif self._tier:
+                    # tiered pull wire = the block readback (inherent to
+                    # the PS protocol) PLUS the host->device rows this
+                    # pull FAULTED into the cache (the tier's own
+                    # traffic; hits cost no extra transfer)
+                    before = table.cache_stats()["faulted_rows"]
+                    W = np.asarray(
+                        table.get_rows_local(ids_b), np.float32
+                    ).copy()
+                    faulted = table.cache_stats()["faulted_rows"] - before
+                    rows_wire += ids_b.size + faulted
+                    bytes_wire += (ids_b.size + faulted) * row_b
                 else:
                     W = np.asarray(
                         table.get_rows_local(ids_b), np.float32
                     ).copy()
                     rows_wire += ids_b.size
+                    bytes_wire += ids_b.size * row_b
                 W[n_u:] = 0.0
                 pulled[name] = W
         dt = time.perf_counter() - t0
-        self._ps_stats.add_pull(dt, rows_dense, rows_wire)
+        self._ps_stats.add_pull(dt, rows_dense, rows_wire, bytes_wire)
         return {
             "blk": blk, "ids_in": ids_in, "ids_out": ids_out,
             "n_in": ni_u, "n_out": no_u, "pulled": pulled,
@@ -1141,6 +1252,7 @@ class WordEmbedding:
             "compress": o.ps_compress,
             "sparse_pull": bool(self._ps_sparse_tables),
             "adagrad": bool(o.use_adagrad),
+            "tier_hbm_mb": float(o.table_tier_hbm_mb),
             "gp_history": {str(k): int(v) for k, v in gp_history.items()},
             "gp_last": int(self._ps_global_pairs),
         }
@@ -1185,6 +1297,15 @@ class WordEmbedding:
         # residuals) and the table set are flag-shaped: a silent mismatch
         # would either KeyError on the npz or break the bit-exact resume
         # contract — fail loudly like the fused path's params CHECK
+        # tier budgets may differ across resume (the cache refaults on
+        # demand), but tiered vs resident may not: a tiered checkpoint
+        # stores the logical host-tier table, a resident one the padded
+        # device storage
+        CHECK((float(meta.get("tier_hbm_mb", 0) or 0) > 0) == self._tier,
+              f"checkpoint {path} was written with -table_tier_hbm_mb="
+              f"{meta.get('tier_hbm_mb', 0)} but this run uses "
+              f"{o.table_tier_hbm_mb}: tiered and resident checkpoints "
+              "store different table layouts — resume in the same mode")
         for flag, current in (
             ("compress", o.ps_compress),
             ("sparse_pull", bool(self._ps_sparse_tables)),
@@ -1316,7 +1437,7 @@ class WordEmbedding:
         depth = o.ps_pipeline_depth
         S = max(1, o.steps_per_call)
         V, D = self.cfg.vocab_size, o.size
-        out_rows = int(self.params["emb_out"].shape[0])
+        out_rows = self._out_rows
         self._ps_stats = _PSCommsStats(D)
 
         def _codec(name: str, rows: int) -> DeltaCodec:
@@ -1396,11 +1517,17 @@ class WordEmbedding:
             # grouping, so block `issued` onward is bit-identical
             for _ in range(issued):
                 next(gen)
+        wd = wdg.monitor_from_flags()
+        pipe = TaskPipe(name="mv-ps-comms")
+        # tiered look-ahead tickets ride the COMMS pipe: every collective
+        # dispatch stays on that one thread (concurrent multi-device
+        # collective programs from different threads can invert
+        # per-device launch order and deadlock XLA's rendezvous) — set
+        # BEFORE the prep buffer so its fill thread never races the bind
+        self._tier_prefetch_pipe = pipe
         # one-block-ahead prep prefetch (unions/remap/presort are host
         # CPU heavy) — the reference ASyncBuffer reused as designed
         buf = ASyncBuffer(lambda: self._ps_block_prep(next(gen)))
-        wd = wdg.monitor_from_flags()
-        pipe = TaskPipe(name="mv-ps-comms")
         loss_dev = None
         log_every = o.batch_size * max(64, S * 8)
         loop_t0 = time.perf_counter()
@@ -1503,12 +1630,22 @@ class WordEmbedding:
                 wd.stop()
             pipe.close(timeout_s=5.0 if pipe.broken is not None else 60.0)
             buf.Stop()
+            self._tier_prefetch_pipe = None  # closed: prep must not use it
+            for table, _side in self._tier_prefetch_tables:
+                table.close()  # tear down any table-owned prefetch pipes
         # surface any comms-thread error parked on a drained push ticket
         for rr in sorted(push_tickets):
             push_tickets[rr].result()
         self._ps_stats.set_wall(time.perf_counter() - loop_t0)
-        self.params["emb_in"] = jnp.asarray(self._t_in.get())
-        self.params["emb_out"] = jnp.asarray(self._t_out.get())
+        if self._tier:
+            # live host-tier arrays, no copy: a tier-scale table must
+            # not round-trip HBM or double host RAM just to be written
+            # out (training is over — nothing mutates them anymore)
+            self.params["emb_in"] = self._t_in.host_array()
+            self.params["emb_out"] = self._t_out.host_array()
+        else:
+            self.params["emb_in"] = jnp.asarray(self._t_in.get())
+            self.params["emb_out"] = jnp.asarray(self._t_out.get())
         self.words_trained = pairs_done
         if o.output_file:
             self.save_embeddings(o.output_file, binary=o.binary)
@@ -2183,6 +2320,14 @@ class WordEmbedding:
               "-ps_compress applies to the pipelined PS path only: set "
               "-ps_pipeline_depth >= 1 (the depth-0 sync rounds stay the "
               "pinned bit-exact parity mode)")
+        CHECK(o.table_tier_hbm_mb >= 0,
+              "-table_tier_hbm_mb must be >= 0, got %s"
+              % o.table_tier_hbm_mb)
+        CHECK(o.table_tier_hbm_mb == 0 or jax.process_count() == 1,
+              "-table_tier_hbm_mb requires a single process: the host "
+              "tier is process-local RAM (multi-process scale-out shards "
+              "rows across ranks instead — drop the flag or the extra "
+              "ranks)")
         if o.checkpoint_dir and o.device_pipeline:
             CHECK(jax.process_count() == 1,
                   "-checkpoint_dir on the device pipeline requires a "

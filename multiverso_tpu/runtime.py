@@ -316,7 +316,21 @@ class Runtime:
 
     @property
     def tables(self) -> List[Any]:
-        return list(self._tables)
+        return [t for t in self._tables if t is not None]
+
+    def release_tables(self, tables: List[Any]) -> None:
+        """Drop the runtime's strong references to ``tables`` so their
+        storage can be reclaimed before shutdown. Id slots are
+        tombstoned (set to ``None``), never renumbered — later tables
+        still get unique ids and existing ids stay valid. For long-lived
+        processes that construct successive full-size models (the bench
+        sweeps): without this the registry pins every generation's
+        host/device arrays until ``MV_ShutDown``."""
+        drop = {id(t) for t in tables}
+        self._tables = [
+            None if (t is not None and id(t) in drop) else t
+            for t in self._tables
+        ]
 
     # ------------------------------------------------------------ serving
 

@@ -2,7 +2,8 @@
 
 Rebuilds the reference table layer (SURVEY.md §2.3) on sharded jax.Arrays:
 ArrayTable (1-D), MatrixTable (2-D row-sharded), SparseMatrixTable
-(delta-tracking), KVTable (hash-sharded).
+(delta-tracking), TieredMatrixTable (HBM-cached hot rows over a host-RAM
+logical table), KVTable (hash-sharded).
 """
 
 from multiverso_tpu.tables.array_table import ArrayTable, ArrayTableOption
@@ -13,6 +14,11 @@ from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
 from multiverso_tpu.tables.sparse_matrix_table import (
     SparseMatrixTable,
     SparseMatrixTableOption,
+)
+from multiverso_tpu.tables.tiered_matrix_table import (
+    TieredMatrixTable,
+    TieredMatrixTableOption,
+    tier_cache_stats,
 )
 
 __all__ = [
@@ -28,5 +34,8 @@ __all__ = [
     "SparseMatrixTable",
     "SparseMatrixTableOption",
     "TableOption",
+    "TieredMatrixTable",
+    "TieredMatrixTableOption",
     "create_table",
+    "tier_cache_stats",
 ]

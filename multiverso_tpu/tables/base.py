@@ -380,6 +380,38 @@ class DenseTable:
 
     # ----------------------------------------------------------- checkpoint
 
+    def checkpoint_tree(self) -> Dict[str, Any]:
+        """The pytree ``io.checkpoint.save_tables`` serializes for this
+        table. Default: the raw (shard-padded) device storage + optimizer
+        slots. Tables whose device arrays are NOT the logical truth
+        override this — ``TieredMatrixTable`` flushes its HBM cache and
+        returns the full host-tier table, so checkpoints are
+        tier-transparent (a resident restore of a tiered save, and vice
+        versa, is a shape mismatch caught at restore, not silent)."""
+        return {"storage": self.storage, "state": dict(self.state)}
+
+    def restore_checkpoint_tree(self, entry: Dict[str, Any]) -> None:
+        """Inverse of ``checkpoint_tree``: bind a restored entry back onto
+        the live table."""
+        self.storage = entry["storage"]
+        self.state = dict(entry["state"])
+
+    def checkpoint_spec(self) -> Dict[str, Any]:
+        """Shape/dtype skeleton of ``checkpoint_tree()`` — the orbax
+        restore TARGET. Never materializes payload: a tiered table's
+        ``checkpoint_tree`` flushes and copies its full host-tier array,
+        which a target derivation must not pay (at tier scale that
+        transient copy alone can OOM a restore that would otherwise
+        fit)."""
+        def spec(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+
+        return {
+            "storage": spec(self.storage),
+            "state": {k: spec(v) for k, v in self.state.items()},
+        }
+
     def _state_logical(self) -> Dict[str, np.ndarray]:
         """Updater slots with padding stripped (dim 0, or dim 1 for
         per-worker slots)."""

@@ -116,10 +116,22 @@ class MatrixTable(DenseTable):
                 f"min={ids.min()}, max={ids.max()}",
             )
 
+    def _route_rows(self, ids: np.ndarray, for_write: bool = False) -> np.ndarray:
+        """Id-space hook between the validated LOGICAL row ids and the ids
+        the compiled gather/scatter actually indexes ``self.storage``
+        with. Identity here (storage rows == logical rows, modulo shard
+        padding); ``TieredMatrixTable`` overrides it to fault the rows
+        into its fixed-budget HBM cache and return the cache slot ids.
+        Only the linear get/add paths route through it — the hook
+        contract is linear-updater tables (the tiered subclass CHECKs
+        that at construction)."""
+        return ids
+
     def get_rows_async(self, row_ids) -> jax.Array:
-        ids = jnp.asarray(row_ids, jnp.int32)
-        CHECK(ids.ndim == 1, "row_ids must be 1-D")
-        self._check_ids_in_range(np.asarray(row_ids))
+        ids_np = np.asarray(row_ids, np.int32)
+        CHECK(ids_np.ndim == 1, "row_ids must be 1-D")
+        self._check_ids_in_range(ids_np)
+        ids = jnp.asarray(self._route_rows(ids_np), jnp.int32)
         return self._get_rows_fn()(self.storage, ids)
 
     def get_rows(self, row_ids) -> np.ndarray:
@@ -279,6 +291,8 @@ class MatrixTable(DenseTable):
                         option.scalars(),
                     )
             return
+        if self.updater.linear:
+            ids_np = self._route_rows(ids_np, for_write=True)
         ids = jnp.asarray(ids_np)
         with monitor("table.add_rows"):  # dispatch latency only (async add);
             # ref instrumented site: server.cpp:37
@@ -450,6 +464,7 @@ class MatrixTable(DenseTable):
         self._check_ids_in_range(ids)
         CHECK(self.updater.linear,
               "add_rows_local_packed requires a linear updater")
+        ids = self._route_rows(ids, for_write=True)
         updater = self.updater
         if tag == "sparse":
             _, _, idx, vals, _count = payload

@@ -18,12 +18,16 @@ src/table/sparse_matrix_table.cpp). Reference semantics preserved:
   prefetcher gets its own staleness tracking (ref:
   sparse_matrix_table.cpp:187-190).
 
-What vanishes on TPU: the ``SparseFilter`` wire compression both directions
-(ref: sparse_matrix_table.cpp:148-153) — there is no server wire; the
-dirty-row bookkeeping itself lives host-side (it is control metadata,
-exactly as the reference keeps it in server RAM) while row data stays in
-HBM. (The PUSH direction's compression survives on the wires TPU
-deployments do have — see ``MatrixTable.add_rows_local_packed``.)
+The reference's ``SparseFilter`` wire compression (ref:
+sparse_matrix_table.cpp:148-153, applied both directions) survives on the
+wires TPU deployments do have: PUSH payloads pack via
+``MatrixTable.add_rows_local_packed``, and PULLs via
+``get_stale_rows_local(packed=True)`` — the padded stale bucket is
+gathered + masked + sparse-packed inside one jitted device program, so
+only (idx, val) pairs cross the device->host wire (lossless, bit-exact
+vs the unpacked pull). The dirty-row bookkeeping itself lives host-side
+(control metadata, exactly as the reference keeps it in server RAM)
+while row data stays in HBM.
 
 Cross-process (SPMD) support for the PS protocol: ``add_rows_local``
 allgathers the per-rank row-id buckets so each process can mark the rows
@@ -258,21 +262,36 @@ class SparseMatrixTable(MatrixTable):
         self,
         row_ids: np.ndarray,
         option: Optional[GetOption] = None,
-    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        packed: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray, int, int]:
         """SPMD delta-tracked pull: among ``row_ids`` (this process's
-        round union), return ``(stale_ids, rows, wire_rows)`` — only the
-        rows stale for ``option.worker_id``'s view transfer; the caller
-        serves the rest from its local row cache. Marks the returned rows
-        fresh. ``wire_rows`` is the PADDED gather size actually moved
-        (the byte-accounting truth: single-process pads to the next power
-        of two; multi-process pads to the cross-rank-agreed bucket of
-        ``round_bucket`` so the gather is one identical SPMD program on
-        every rank — a rank with nothing stale still joins it whenever
-        any rank has stale rows). Returns ``(empty, empty, 0)`` — no
-        transfer at all — only when NO rank has stale rows. Unlike
+        round union), return ``(stale_ids, rows, wire_rows, wire_bytes)``
+        — only the rows stale for ``option.worker_id``'s view transfer;
+        the caller serves the rest from its local row cache. Marks the
+        returned rows fresh. ``wire_rows`` is the PADDED gather size
+        actually moved (the byte-accounting truth: single-process pads to
+        the next power of two; multi-process pads to the cross-rank-agreed
+        bucket of ``round_bucket`` so the gather is one identical SPMD
+        program on every rank — a rank with nothing stale still joins it
+        whenever any rank has stale rows) and ``wire_bytes`` the bytes
+        that crossed the wire for it. Returns ``(empty, empty, 0, 0)`` —
+        no transfer at all — only when NO rank has stale rows. Unlike
         ``get_sparse`` this does NOT send row 0 on an all-fresh round:
         the reference's always-reply-row-0 quirk is wire-protocol parity,
-        and here an empty reply simply skips the gather."""
+        and here an empty reply simply skips the gather.
+
+        ``packed=True`` is the PULL direction of the reference's
+        SparseFilter wire compression (ref: sparse_matrix_table.cpp:
+        148-153 applies the filter both ways): the padded stale bucket is
+        gathered, masked and ``sparse_pack_jnp``-packed INSIDE one jitted
+        device program, so only (idx, val) pairs cross the device->host
+        wire — lossless (values are exact float32 copies), bit-exact vs
+        the unpacked pull, and a large cut whenever the bucket is mostly
+        padding or the rows are mostly zero (freshly-initialized output/
+        g2 tables). Falls back to the dense gather when the packed form
+        would not be smaller, and on the multi-process path (where the
+        gather is one SPMD collective program; packing there is future
+        work) — ``wire_bytes`` reports whichever form moved."""
         import jax
 
         option = option or GetOption()
@@ -282,11 +301,13 @@ class SparseMatrixTable(MatrixTable):
         CHECK(ids.ndim == 1, "row_ids must be 1-D")
         stale = ids[~self._up_to_date[w, ids]] if ids.size else ids
         stale = np.unique(stale)
+        row_b = self.num_col * self.dtype.itemsize
         if jax.process_count() == 1:
             if stale.size == 0:
                 return (
                     stale.astype(np.int64),
                     np.zeros((0, self.num_col), self.dtype),
+                    0,
                     0,
                 )
             self._up_to_date[w, stale] = True
@@ -294,13 +315,17 @@ class SparseMatrixTable(MatrixTable):
 
             n = stale.size
             padded_n = next_pow2(n)
+            if packed:
+                rows, nbytes = self._pull_rows_packed(stale, padded_n)
+                return stale, rows, padded_n, nbytes
             padded = np.pad(stale, (0, padded_n - n), mode="edge")
-            return stale, self.get_rows(padded)[:n], padded_n
+            return stale, self.get_rows(padded)[:n], padded_n, padded_n * row_b
         any_stale, bucket = self.round_bucket(int(stale.size))
         if not any_stale:
             return (
                 stale.astype(np.int64),
                 np.zeros((0, self.num_col), self.dtype),
+                0,
                 0,
             )
         n = stale.size
@@ -309,4 +334,66 @@ class SparseMatrixTable(MatrixTable):
         rows = self.get_rows_local(padded)[:n]
         if n:
             self._up_to_date[w, stale] = True
-        return stale, rows, bucket
+        return stale, rows, bucket, bucket * row_b
+
+    def _pull_rows_packed(self, stale: np.ndarray,
+                          padded_n: int) -> Tuple[np.ndarray, int]:
+        """Single-process packed stale pull: gather the power-of-two
+        bucket, zero the padding rows, count the nonzeros (one scalar
+        readback sizes the pack capacity — the DeltaCodec two-phase
+        recipe), then move only the (idx, val) pairs. Dense fallback when
+        packing would not shrink the transfer."""
+        import jax
+        import jax.numpy as jnp
+
+        from multiverso_tpu.utils import next_pow2
+        from multiverso_tpu.utils import quantization as q
+
+        n = int(stale.size)
+        C = self.num_col
+        row_b = C * self.dtype.itemsize
+        padded = np.zeros(padded_n, np.int64)
+        padded[:n] = stale
+        access = self.updater.access
+
+        def _masked(storage, ids_d, n_d):
+            rows = jnp.take(access(storage), ids_d, axis=0)
+            valid = (
+                jnp.arange(padded_n, dtype=jnp.int32) < n_d
+            ).astype(rows.dtype)
+            return rows * valid[:, None]
+
+        count_key = ("stale_count", padded_n)
+        count_fn = self._compiled.get(count_key)
+        if count_fn is None:
+            count_fn = jax.jit(
+                lambda s, i, m: jnp.count_nonzero(_masked(s, i, m)).astype(
+                    jnp.int32
+                )
+            )
+            self._compiled[count_key] = count_fn
+        ids_d = jnp.asarray(padded, jnp.int32)
+        nnz = int(count_fn(self.storage, ids_d, jnp.int32(n)))
+        cap = max(8, next_pow2(max(nnz, 1)))
+        # packed wire = (idx int32 + val fp32) x the POW-2 capacity the
+        # pack program is compiled for, + the count scalar — compare
+        # that, not nnz, against the dense gather (cap can inflate nnz
+        # up to 2x, so a mid-density bucket packs LARGER than dense)
+        if cap * 8 + 8 >= padded_n * row_b:
+            rows = self.get_rows(padded)[:n]
+            return rows, padded_n * row_b
+        pack_key = ("stale_pack", padded_n, cap)
+        pack_fn = self._compiled.get(pack_key)
+        if pack_fn is None:
+            pack_fn = jax.jit(
+                lambda s, i, m: q.sparse_pack_jnp(_masked(s, i, m), cap)
+            )
+            self._compiled[pack_key] = pack_fn
+        count, idx, vals = pack_fn(self.storage, ids_d, jnp.int32(n))
+        count = int(count)
+        idx = np.asarray(idx)
+        vals = np.asarray(vals)
+        flat = np.zeros(padded_n * C, np.float32)
+        flat[idx[:count]] = vals[:count]
+        rows = flat.reshape(padded_n, C)[:n].astype(self.dtype)
+        return rows, int(idx.nbytes + vals.nbytes + 8)

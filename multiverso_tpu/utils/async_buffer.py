@@ -247,10 +247,32 @@ class TaskPipe:
             from multiverso_tpu.resilience.watchdog import PipelineBroken
 
             raise PipelineBroken(self._broken)
-        ticket = _Ticket(self, tag)
         slot = self._free.pop()
         if slot is None:
             raise RuntimeError("TaskPipe torn down while submitting")
+        return self._enqueue(slot, fn, tag)
+
+    def submit_nowait(self, fn: Callable[[], Any], tag: str = "") -> Optional[_Ticket]:
+        """Non-blocking ``submit`` for ADVISORY work — the tiered table's
+        look-ahead prefetch tickets. A full ring, a broken pipe or a
+        closed pipe returns ``None`` instead of blocking or raising:
+        dropping a prefetch is always safe (the access path faults the
+        rows in itself), and the prep thread must never stall behind a
+        slow fault-in."""
+        if self._closed or self._broken is not None:
+            return None
+        slot = self._free.try_pop()
+        if slot is None:
+            return None
+        try:
+            return self._enqueue(slot, fn, tag)
+        except RuntimeError:
+            # close() raced between the _closed check and the ready push
+            # (ring already torn down): advisory work just drops
+            return None
+
+    def _enqueue(self, slot: int, fn: Callable[[], Any], tag: str) -> _Ticket:
+        ticket = _Ticket(self, tag)
         self._slots[slot] = (fn, ticket)
         with self._idle:
             self._inflight += 1

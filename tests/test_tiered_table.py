@@ -1,0 +1,450 @@
+"""Tiered HBM<->host MatrixTable (ISSUE 6): cached hot rows + look-ahead
+prefetch over a host-RAM logical table.
+
+Contracts pinned here:
+
+* tier transparency — a tiered table whose cache covers the whole vocab
+  is BIT-EXACT vs the resident ``MatrixTable`` (same init bits, same
+  compiled gather/scatter programs), and a small cache under zipf
+  traffic still produces the SAME final tables (rows round-trip the
+  cache losslessly; only placement differs);
+* clock/second-chance eviction: touched slots survive one sweep, dirty
+  victims write back to the host tier, and an access working set larger
+  than the cache fails LOUDLY (one CHECK naming the flag), never
+  silently corrupts;
+* prefetch tickets ride a ``TaskPipe``: prefetched rows are hits at
+  access time and counted as coverage; oversized prefetches clip
+  (advisory, never fatal);
+* checkpoint/serve transparency: ``save_tables``/``restore_tables``/
+  ``load_arrays``/``store``/``load``/``snapshot_array`` flush the cache
+  and speak the full logical table — a kill+resume through a quorum
+  checkpoint with a DIRTY cache equals the uninterrupted run bit for
+  bit;
+* the app wiring: ``-table_tier_hbm_mb`` routes training through the
+  pipelined PS block loop with tiered tables and block-prep look-ahead
+  prefetch; the ``table_cache`` Dashboard section reports hit rate /
+  faults / coverage;
+* pull-direction compression (PR 4 NEXT): ``get_stale_rows_local
+  (packed=True)`` is bit-exact vs the unpacked pull and ships fewer
+  bytes on sparse rows, with a dense fallback.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.api import MV_CreateTable
+from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.resilience import chaos
+from multiverso_tpu.tables import (
+    MatrixTableOption,
+    SparseMatrixTableOption,
+    TieredMatrixTableOption,
+    tier_cache_stats,
+)
+from multiverso_tpu.updaters import GetOption
+from multiverso_tpu.utils.configure import SetCMDFlag
+from multiverso_tpu.utils.log import FatalError
+
+
+@pytest.fixture
+def rt():
+    mv.MV_Init(["prog"])
+    yield
+    mv.MV_ShutDown(finalize=True)
+
+
+def _mb(rows, cols, dtype=np.float32):
+    """Budget (MB) that holds exactly ``rows`` rows."""
+    return rows * cols * np.dtype(dtype).itemsize / 2**20
+
+
+# ================================================================= table unit
+
+
+def _zipf_ops(V, C, n_ops=150, width=40, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_ops):
+        ids = np.unique(rng.zipf(1.6, width) % V).astype(np.int64)
+        out.append((ids, rng.randn(ids.size, C).astype(np.float32)))
+    return out
+
+
+def test_covers_all_is_resident_and_bitexact(rt):
+    V, C = 300, 8
+    init = np.random.RandomState(0).randn(V, C).astype(np.float32)
+    res = MV_CreateTable(MatrixTableOption(num_row=V, num_col=C,
+                                           init_value=init, name="res"))
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, init_value=init, hbm_mb=64.0, name="tier"))
+    assert tier._resident and tier._cache_rows == V
+    for ids, deltas in _zipf_ops(V, C):
+        np.testing.assert_array_equal(res.get_rows(ids), tier.get_rows(ids))
+        res.add_rows(ids, deltas)
+        tier.add_rows(ids, deltas)
+    np.testing.assert_array_equal(res.get(), tier.get())
+    s = tier.cache_stats()
+    assert s["resident"] == 1 and s["misses"] == 0 and s["hits"] > 0
+
+
+def test_small_cache_bitexact_with_eviction_and_writeback(rt):
+    V, C = 500, 16
+    init = np.random.RandomState(1).randn(V, C).astype(np.float32)
+    res = MV_CreateTable(MatrixTableOption(num_row=V, num_col=C,
+                                           init_value=init, name="res2"))
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, init_value=init, hbm_mb=_mb(64, C),
+        name="tier2"))
+    assert not tier._resident and tier._cache_rows == 64
+    for ids, deltas in _zipf_ops(V, C, seed=2):
+        np.testing.assert_array_equal(res.get_rows(ids), tier.get_rows(ids))
+        res.add_rows(ids, deltas)
+        tier.add_rows(ids, deltas)
+    np.testing.assert_array_equal(res.get(), tier.get())
+    s = tier.cache_stats()
+    assert s["faulted_rows"] > 0 and s["evicted_rows"] > 0
+    assert s["writeback_bytes"] > 0  # dirty victims reached the host tier
+    assert 0 < s["hit_rate_pct"] < 100
+
+
+def test_init_uniform_matches_resident_bits(rt):
+    """init_uniform generates on the CPU backend but must equal the
+    resident ctor's bits (same key, same full-array draw) — the
+    covers-all bit-exactness anchor for PS tables."""
+    V, C = 200, 8
+    res = MV_CreateTable(MatrixTableOption(
+        num_row=V, num_col=C, init_uniform=(-0.5, 0.5), seed=11, name="ru"))
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, init_uniform=(-0.5, 0.5), seed=11,
+        hbm_mb=_mb(32, C), name="tu"))
+    np.testing.assert_array_equal(res.get(), tier.get())
+
+
+def test_second_chance_spares_touched_rows(rt):
+    V, C = 100, 4
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, hbm_mb=_mb(8, C), name="clock"))
+    assert tier._cache_rows == 8
+    tier.get_rows(np.arange(8))          # fill: rows 0..7, all touched
+    tier.get_rows(np.asarray([0, 1]))    # re-touch 0, 1 (others' bits
+    # were spent when the fill's own allocation swept the clock)
+    tier._touched[:] = False
+    tier.get_rows(np.asarray([0, 1]))    # 0, 1 touched again
+    tier.get_rows(np.asarray([20, 21]))  # two faults: victims must be
+    # untouched slots, so rows 0 and 1 stay resident
+    assert tier._slot_of[0] >= 0 and tier._slot_of[1] >= 0
+    assert tier._slot_of[20] >= 0 and tier._slot_of[21] >= 0
+
+
+def test_prefetch_lands_counts_coverage_and_clips(rt):
+    V, C = 400, 8
+    init = np.random.RandomState(3).randn(V, C).astype(np.float32)
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, init_value=init, hbm_mb=_mb(64, C),
+        name="pref"))
+    try:
+        t = tier.prefetch(np.arange(100, 140))
+        assert t is not None
+        t.result(timeout=30)
+        got = tier.get_rows(np.arange(100, 140))
+        np.testing.assert_array_equal(got, init[100:140])
+        s = tier.cache_stats()
+        assert s["prefetch_rows"] == 40
+        assert s["prefetch_hits"] == 40
+        assert s["prefetch_coverage_pct"] == 100.0
+        # oversized prefetch clips instead of raising
+        t = tier.prefetch(np.arange(0, 200))
+        assert t is not None
+        t.result(timeout=30)  # must not raise
+        assert tier.cache_stats()["prefetch_rows"] <= 40 + 64
+    finally:
+        tier.close()
+
+
+def test_prefetch_rides_caller_pipe_and_swallows_errors(rt):
+    """The app rides prefetch tickets on the PS comms pipe so ALL
+    collective dispatch stays on one thread: ``prefetch(pipe=...)`` must
+    use the caller's pipe (no table-owned thread spawned), and a failing
+    prefetch must park as a DROP, never poison the shared pipe."""
+    from multiverso_tpu.utils.async_buffer import TaskPipe
+
+    V, C = 200, 8
+    init = np.random.RandomState(6).randn(V, C).astype(np.float32)
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, init_value=init, hbm_mb=_mb(32, C),
+        name="prefpipe"))
+    pipe = TaskPipe(name="test-comms")
+    try:
+        t = tier.prefetch(np.arange(10, 20), pipe=pipe)
+        assert t is not None
+        t.result(timeout=30)
+        assert tier._pipe is None  # no table-owned pipe was created
+        assert tier.cache_stats()["prefetch_rows"] == 10
+        # an advisory failure is swallowed: the shared pipe stays usable
+        orig = tier._ensure_resident
+        tier._ensure_resident = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        t = tier.prefetch(np.arange(30, 40), pipe=pipe)
+        t.result(timeout=30)  # must not raise
+        tier._ensure_resident = orig
+        assert pipe.broken is None
+        assert tier.cache_stats()["prefetch_dropped"] == 1
+        t = tier.prefetch(np.arange(50, 60), pipe=pipe)  # still works
+        t.result(timeout=30)
+        np.testing.assert_array_equal(tier.get_rows(np.arange(50, 60)),
+                                      init[50:60])
+    finally:
+        pipe.close(timeout_s=10.0)
+        tier.close()
+
+
+def test_working_set_larger_than_cache_fails_loudly(rt):
+    V, C = 400, 8
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, hbm_mb=_mb(16, C), name="toosmall"))
+    with pytest.raises(FatalError, match="table_tier_hbm_mb"):
+        tier.get_rows(np.arange(100))
+
+
+def test_linear_updater_required(rt):
+    with pytest.raises(FatalError, match="linear"):
+        MV_CreateTable(TieredMatrixTableOption(
+            num_row=10, num_col=4, updater_type="adagrad", name="bad"))
+
+
+def test_get_rows_fixed_and_pipelined_route_through_cache(rt):
+    V, C = 300, 8
+    init = np.random.RandomState(4).randn(V, C).astype(np.float32)
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, init_value=init, hbm_mb=_mb(32, C),
+        name="fixed"))
+    fixed_ids = np.asarray([3, 7, 11], np.int32)
+    np.testing.assert_array_equal(tier.get_rows_fixed(fixed_ids),
+                                  init[fixed_ids])
+    tier.add_rows(fixed_ids, np.ones((3, C), np.float32))
+    # a second fixed read must see the update even though slots moved
+    tier.get_rows(np.arange(32, 64))  # churn the cache
+    np.testing.assert_array_equal(tier.get_rows_fixed(fixed_ids),
+                                  init[fixed_ids] + 1.0)
+    np.testing.assert_array_equal(tier.get_pipelined(), tier.get())
+
+
+def test_checkpoint_roundtrip_with_dirty_cache(rt, tmp_path):
+    from multiverso_tpu.io.checkpoint import (
+        load_arrays,
+        restore_tables,
+        save_tables,
+    )
+
+    V, C = 300, 8
+    init = np.random.RandomState(5).randn(V, C).astype(np.float32)
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=V, num_col=C, init_value=init, hbm_mb=_mb(32, C),
+        name="ckpt"))
+    tier.add_rows(np.arange(10), np.ones((10, C), np.float32))  # dirty
+    want = tier.get()  # flushes; re-dirty below so the save must flush too
+    ck = str(tmp_path / "ck-1")
+    tier.add_rows(np.arange(5), np.zeros((5, C), np.float32))  # dirty again
+    save_tables(ck, [tier], step=1)
+    tier.add_rows(np.arange(10), np.full((10, C), 9.0, np.float32))
+    restore_tables(ck, [tier])
+    np.testing.assert_array_equal(tier.get(), want)
+    # serving load crops nothing (the tiered payload is already logical)
+    arrs = load_arrays(ck)
+    np.testing.assert_array_equal(arrs[f"table_{tier.table_id}"], want)
+    np.testing.assert_array_equal(np.asarray(tier.snapshot_array()), want)
+    # Stream store/load parity
+    p = str(tmp_path / "t.bin")
+    tier.store(p)
+    tier.add_rows(np.arange(10), np.ones((10, C), np.float32))
+    tier.load(p)
+    np.testing.assert_array_equal(tier.get(), want)
+
+
+def test_dashboard_table_cache_section(rt):
+    from multiverso_tpu.utils.dashboard import Dashboard
+
+    tier = MV_CreateTable(TieredMatrixTableOption(
+        num_row=100, num_col=4, hbm_mb=_mb(16, 4), name="dash"))
+    tier.get_rows(np.arange(10))
+    out = Dashboard.Display()
+    assert "[table_cache]" in out and "dash" in out
+    assert "coverage" in out
+
+
+# ============================================================ packed pulls
+
+
+def test_packed_stale_pull_bitexact_and_smaller(rt):
+    V, C = 1000, 32
+    t = MV_CreateTable(SparseMatrixTableOption(num_row=V, num_col=C,
+                                               name="sp"))
+    hot = np.arange(0, 40, dtype=np.int64)
+    t.add_rows(hot, np.random.RandomState(0).randn(40, C).astype(np.float32))
+    ids = np.arange(0, 300, dtype=np.int64)
+    s1, r1, w1, b1 = t.get_stale_rows_local(ids, GetOption(worker_id=0))
+    t._up_to_date[0, :] = False  # same stale set for the packed pull
+    s2, r2, w2, b2 = t.get_stale_rows_local(
+        ids, GetOption(worker_id=0), packed=True
+    )
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(r1, r2)  # lossless: exact fp32 copies
+    assert w1 == w2  # same padded gather
+    assert b2 < b1 / 2  # mostly-zero rows: pairs undercut dense rows
+
+
+def test_packed_stale_pull_dense_fallback_exact(rt):
+    V, C = 64, 16
+    init = np.random.RandomState(1).randn(V, C).astype(np.float32)
+    t = MV_CreateTable(SparseMatrixTableOption(num_row=V, num_col=C,
+                                               init_value=init, name="spd"))
+    ids = np.arange(V, dtype=np.int64)
+    sa, ra, wa, ba = t.get_stale_rows_local(
+        ids, GetOption(worker_id=0), packed=True
+    )
+    t._up_to_date[0, :] = False
+    sb, rb, wb, bb = t.get_stale_rows_local(ids, GetOption(worker_id=0))
+    np.testing.assert_array_equal(ra, rb)
+    assert ba == bb  # dense rows: fallback moved the same bytes
+
+
+# ================================================================== app e2e
+
+
+V_APP = 200
+
+
+def _corpus(seed=0, n=4000, vocab=V_APP):
+    rng = np.random.RandomState(seed)
+    p = rng.randint(0, vocab // 2, n) * 2
+    return (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+
+
+def _zipf_corpus(seed=0, n=5000, vocab=2000):
+    rng = np.random.RandomState(seed)
+    p = (rng.zipf(2.0, n) % (vocab // 2)) * 2
+    return (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+
+
+def _dict(ids, vocab):
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(
+        np.bincount(np.maximum(ids, 0), minlength=vocab), 1
+    ).astype(np.int64)
+    return d
+
+
+def _run_app(ids, d, **kw):
+    mv.MV_Init(["prog"])
+    try:
+        base = dict(
+            size=16, negative=3, window=2, batch_size=256, steps_per_call=2,
+            epoch=3, sample=0, alpha=0.1, output_file="", use_ps=True,
+            is_pipeline=False, train_file="unused",
+        )
+        base.update(kw)
+        we = WordEmbedding(WEOptions(**base), dictionary=d)
+        we.train(ids=ids.copy())
+        return we.embeddings().copy(), dict(tier_cache_stats())
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def test_app_tiered_covers_all_bitexact_vs_resident(tmp_path):
+    """Cache >= table: the tiered PS run must be BIT-EXACT vs the
+    resident pipelined run (same depth, sparse pull off on both — the
+    tier's comparison basis)."""
+    ids = _corpus()
+    d = _dict(ids, V_APP)
+    golden, _ = _run_app(ids, d, ps_pipeline_depth=1, ps_sparse_pull=False)
+    tiered, stats = _run_app(ids, d, table_tier_hbm_mb=64)
+    np.testing.assert_array_equal(tiered, golden)
+    assert stats["we_emb_in"]["resident"] == 1
+
+
+def test_app_tiered_small_cache_zipf_same_final_tables(tmp_path):
+    """~10%% cache under zipf traffic: rows round-trip the cache
+    losslessly, so the final tables EQUAL the resident run's — while the
+    cache actually faults/evicts and the look-ahead prefetch lands rows
+    in time (coverage on the zipf-hot input table)."""
+    ids = _zipf_corpus()
+    d = _dict(ids, 2000)
+    kw = dict(batch_size=32, epoch=1)
+    golden, _ = _run_app(ids, d, ps_pipeline_depth=1, ps_sparse_pull=False,
+                         **kw)
+    # ~13% of the tables: 256 slots each — holds one block's union
+    # (~130 rows on the negatives table) plus the look-ahead block
+    mb_small = 2 * 2000 * 16 * 4 * 0.13 / 2**20
+    tiered, stats = _run_app(ids, d, table_tier_hbm_mb=mb_small, **kw)
+    np.testing.assert_array_equal(tiered, golden)
+    s_in = stats["we_emb_in"]
+    assert s_in["resident"] == 0 and s_in["slots"] < 2000
+    assert s_in["faulted_rows"] > 0
+    assert s_in["hit_rate_pct"] > 80  # zipf working set fits the cache
+    assert s_in["prefetch_coverage_pct"] > 30  # look-ahead landed rows
+    s_out = stats["we_emb_out"]
+    assert s_out["evicted_rows"] > 0  # negatives thrash the small cache
+
+
+@pytest.fixture
+def chaos_reset():
+    chaos.reset()
+    SetCMDFlag("chaos_kill_mode", "exit")
+    SetCMDFlag("chaos_drop_rank", "")
+    yield
+    chaos.reset()
+    SetCMDFlag("chaos_kill_mode", "exit")
+    SetCMDFlag("chaos_drop_rank", "")
+
+
+def test_app_tiered_kill_resume_matches_uninterrupted(tmp_path, chaos_reset):
+    """Kill at round 8 with a DIRTY cache, resume through the quorum
+    checkpoint: the save flushed the cache and serialized the full
+    logical table, so the resumed run EQUALS the uninterrupted tiered
+    run bit for bit."""
+    ids = _corpus(seed=3)
+    d = _dict(ids, V_APP)
+    golden, _ = _run_app(ids, d, table_tier_hbm_mb=64)
+    ck = str(tmp_path / "ck")
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", "0:8")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_app(ids, d, table_tier_hbm_mb=64, checkpoint_dir=ck,
+                 checkpoint_every_steps=3)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+    resumed, _ = _run_app(ids, d, table_tier_hbm_mb=64, checkpoint_dir=ck,
+                          checkpoint_every_steps=3)
+    np.testing.assert_array_equal(resumed, golden)
+
+
+def test_app_tiered_resume_rejects_resident_checkpoint(tmp_path,
+                                                       chaos_reset):
+    """A tiered checkpoint stores the logical host-tier table, a
+    resident one the padded device storage: resuming across modes must
+    die with ONE clear CHECK."""
+    ids = _corpus(seed=5, n=2000)
+    d = _dict(ids, V_APP)
+    ck = str(tmp_path / "ck")
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", "0:6")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_app(ids, d, table_tier_hbm_mb=64, checkpoint_dir=ck,
+                 checkpoint_every_steps=2)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+    with pytest.raises(FatalError, match="tier"):
+        _run_app(ids, d, ps_pipeline_depth=1, ps_sparse_pull=False,
+                 checkpoint_dir=ck, checkpoint_every_steps=2)
