@@ -1264,6 +1264,90 @@ def _bench_ps_comms(V=20000, dim=64, toks=300_000):
     return out
 
 
+def _bench_mttr(root):
+    """MTTR drill (ISSUE 7): a REAL 2-proc pipelined pod under the
+    ``PodSupervisor``, rank 1 chaos-dropped at round 5 — wall-clock
+    decomposition of mean-time-to-recovery for both recovery shapes:
+
+    * ``detect``   — dead rank's last heartbeat beat -> the supervisor's
+      failure_detected event (rc observation + sibling grace);
+    * ``relaunch`` — failure_detected -> the next generation's launch
+      (kill sweep + jittered backoff);
+    * ``ready``    — launch -> pod_ready (every rank's MV_READY_FILE:
+      rendezvous + restore/re-shard + first training step reached).
+
+    Reported per leg: ``replace`` (relaunch at N=2 from the drained
+    checkpoint) and ``n1`` (degrade to N-1=1 via the elastic re-shard
+    resume). Skips cleanly (empty dict) when the 2-proc pod cannot run.
+    """
+    import os
+    import sys as _s
+
+    from multiverso_tpu.resilience.supervisor import PodSupervisor
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "multiprocess_ps_worker.py")
+    rng = np.random.RandomState(11)
+    p = rng.randint(0, 30, 2000) * 2
+    ids = np.stack(
+        [p, p + 1, np.full_like(p, -1)], 1
+    ).reshape(-1).astype(np.int32)
+    corpus = os.path.join(root, "mttr_corpus.npy")
+    np.save(corpus, ids)
+    out = {}
+    for leg, policy in (("replace", "replace"), ("n1", "degrade")):
+        legroot = os.path.join(root, f"mttr_{leg}")
+        os.makedirs(os.path.join(legroot, "ck"), exist_ok=True)
+
+        def make_argv(rank, world, gen, coord, legroot=legroot):
+            return [_s.executable, worker, str(rank), str(world), coord,
+                    corpus, os.path.join(legroot, f"emb_{rank}.npy"),
+                    "supervised", legroot]
+
+        sup = PodSupervisor(
+            make_argv, world=2,
+            checkpoint_dir=os.path.join(legroot, "ck"),
+            heartbeat_dir=os.path.join(legroot, "hb"),
+            heartbeat_deadline_s=30.0,
+            ready_dir=os.path.join(legroot, "ready"),
+            on_failure=policy, max_restarts=4, restart_window_s=600.0,
+            backoff_base_s=0.2, backoff_max_s=1.0, exit_grace_s=60.0,
+            log_dir=legroot,
+        )
+        res = sup.run()
+        if not res.ok or res.restarts < 1:
+            print(f"# mttr leg {leg} did not self-heal (ok={res.ok}); "
+                  "skipping its keys", file=_s.stderr, flush=True)
+            continue
+        fails = [e for e in res.events if e["event"] == "failure_detected"]
+        # the LAST failure: if an infra abort ate a relaunch, the heal is
+        # the generation after the final failure (anchoring on fails[0]
+        # would miss its pod_ready and drop the leg)
+        f = fails[-1]
+        gen_next = f["generation"] + 1
+        launch = next(e for e in res.events if e["event"] == "launch"
+                      and e["generation"] == gen_next)
+        ready = next(e for e in res.events if e["event"] == "pod_ready"
+                     and e["generation"] == gen_next)
+        # the dead rank's last beat anchors detection (real heartbeats)
+        dead = [str(r) for r, rc in f["rcs"].items() if rc == 137]
+        beacons = f.get("last_beacon_walls") or {}
+        anchor = min(
+            (beacons[r] for r in dead if r in beacons),
+            default=f["wall"],
+        )
+        out[f"resilience_mttr_{leg}_detect_ms"] = round(
+            (f["wall"] - anchor) * 1e3, 1)
+        out[f"resilience_mttr_{leg}_relaunch_ms"] = round(
+            (launch["wall"] - f["wall"]) * 1e3, 1)
+        out[f"resilience_mttr_{leg}_ready_ms"] = round(
+            (ready["wall"] - launch["wall"]) * 1e3, 1)
+        out[f"resilience_mttr_{leg}_total_ms"] = round(
+            (ready["wall"] - anchor) * 1e3, 1)
+        out[f"resilience_mttr_{leg}_final_world"] = res.final_world
+    return out
+
+
 def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
                       period_steps=50, reps=3):
     """Resilience leg: what fault tolerance costs.
@@ -1388,7 +1472,18 @@ def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
 
         _rt().release_tables([tt])  # drill table: don't pin it for the
         # rest of the bench process
+        # MTTR: the supervised self-healing drill (real processes, real
+        # heartbeats); a broken pod environment must not sink the rest
+        # of the resilience leg
+        import sys as _s2
+
+        try:
+            mttr = _bench_mttr(root)
+        except Exception as e:  # noqa: BLE001 — report, keep the leg
+            print(f"# mttr drill FAILED: {e}", file=_s2.stderr, flush=True)
+            mttr = {}
         return {
+            **mttr,
             "resilience_tier_flush_save_ms": round(tier_save_ms, 1),
             "resilience_tier_writeback_mb": round(
                 tier_stats["writeback_bytes"] / 2**20, 2

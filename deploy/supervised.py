@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Self-healing pod launcher: run any multiverso_tpu worker command line
+under the ``PodSupervisor`` (resilience/supervisor.py).
+
+Usage::
+
+    python deploy/supervised.py --world 4 --checkpoint-dir /ckpt/we \\
+        --heartbeat-dir /ckpt/we/hb --on-failure replace \\
+        --max-restarts 5 --restart-window-s 600 -- \\
+        python -m multiverso_tpu.models.wordembedding \\
+            -train_file=corpus.txt -use_ps -ps_pipeline_depth=1 \\
+            -checkpoint_dir=/ckpt/we -checkpoint_every_steps=50 \\
+            -heartbeat_dir=/ckpt/we/hb -heartbeat_deadline_s=15 \\
+            -collective_timeout_s=120
+
+Everything after ``--`` is the worker template. Per-rank substitution:
+``{rank}``, ``{world}``, ``{coordinator}`` and ``{generation}`` inside
+any template token are formatted; if the template carries none of the
+rendezvous flags, ``-process_id/-num_processes/-coordinator`` are
+appended automatically (the multihost bootstrap's surface). The
+supervisor exports ``MV_SUPERVISOR_GENERATION`` and (with
+``--ready-dir``) ``MV_READY_FILE`` to each worker.
+
+On a rank failure the pod relaunches from the latest valid checkpoint
+under ``--checkpoint-dir`` — with a replacement rank at the same world
+size (``--on-failure replace``, bit-for-bit resume) or degraded to N-1
+(``--on-failure degrade``, elastic re-shard resume) — until the restart
+budget is spent, at which point a structured ``RECOVERY-GIVEUP.json``
+lands next to the recovery log and the launcher exits nonzero. See
+DEPLOY.md "Self-healing pods" for tuning.
+"""
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from multiverso_tpu.resilience.supervisor import PodSupervisor  # noqa: E402
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(
+        description="run a worker command as a self-healing pod",
+        usage="%(prog)s [options] -- worker-cmd [worker-args ...]",
+    )
+    p.add_argument("--world", type=int, default=1,
+                   help="initial number of worker ranks")
+    p.add_argument("--min-world", type=int, default=1,
+                   help="degrade floor for --on-failure degrade")
+    p.add_argument("--on-failure", choices=("replace", "degrade"),
+                   default="replace",
+                   help="relaunch with a replacement rank at the same N "
+                        "(bit-for-bit resume) or degraded to N-1 (elastic "
+                        "re-shard resume)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="the workers' checkpoint root: resume source, "
+                        "FAILURE-report watch, recovery-log home")
+    p.add_argument("--heartbeat-dir", default=None,
+                   help="the workers' -heartbeat_dir: lets the supervisor "
+                        "kill live-but-wedged ranks")
+    p.add_argument("--heartbeat-deadline-s", type=float, default=0.0,
+                   help="supervisor-side wedge deadline (0 = rc-only "
+                        "detection)")
+    p.add_argument("--ready-dir", default=None,
+                   help="directory for per-rank MV_READY_FILE markers "
+                        "(pod_ready MTTR event)")
+    p.add_argument("--max-restarts", type=int, default=5,
+                   help="give up after this many restarts inside the "
+                        "window")
+    p.add_argument("--restart-window-s", type=float, default=600.0)
+    p.add_argument("--backoff-base-s", type=float, default=0.5)
+    p.add_argument("--backoff-max-s", type=float, default=30.0)
+    p.add_argument("--log-dir", default=None,
+                   help="recovery log + per-worker logs (default: "
+                        "--checkpoint-dir)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="restart-backoff jitter seed (default: this "
+                        "launcher's pid, so pods in a fleet decorrelate "
+                        "— a shared-infra blip must not make every pod "
+                        "relaunch on the same schedule)")
+    if "--" not in argv:
+        p.error("worker command required after '--'")
+    split = argv.index("--")
+    args = p.parse_args(argv[:split])
+    args.template = argv[split + 1:]
+    if not args.template:
+        p.error("worker command required after '--'")
+    return args
+
+
+def make_argv_factory(template):
+    # only the ACTUAL rendezvous flags suppress injection — a {rank}
+    # placeholder used for, say, an output filename must not silently
+    # cost the pod its -process_id/-num_processes/-coordinator wiring
+    has_rendezvous = any(
+        "-process_id" in t or "-coordinator" in t for t in template
+    )
+
+    def make_argv(rank, world, generation, coordinator):
+        argv = [
+            t.format(rank=rank, world=world, generation=generation,
+                     coordinator=coordinator)
+            if any(k in t for k in ("{rank}", "{world}", "{coordinator}",
+                                    "{generation}")) else t
+            for t in template
+        ]
+        if not has_rendezvous and world > 1:
+            argv += [
+                f"-process_id={rank}",
+                f"-num_processes={world}",
+                f"-coordinator={coordinator}",
+            ]
+        return argv
+
+    return make_argv
+
+
+def main(argv):
+    args = parse_args(argv)
+    sup = PodSupervisor(
+        make_argv_factory(args.template),
+        world=args.world,
+        min_world=args.min_world,
+        on_failure=args.on_failure,
+        checkpoint_dir=args.checkpoint_dir,
+        heartbeat_dir=args.heartbeat_dir,
+        heartbeat_deadline_s=args.heartbeat_deadline_s,
+        ready_dir=args.ready_dir,
+        max_restarts=args.max_restarts,
+        restart_window_s=args.restart_window_s,
+        backoff_base_s=args.backoff_base_s,
+        backoff_max_s=args.backoff_max_s,
+        seed=os.getpid() if args.seed is None else args.seed,
+        log_dir=args.log_dir,
+    )
+    result = sup.run()
+    print(
+        f"[supervised] ok={result.ok} gave_up={result.gave_up} "
+        f"generations={result.generations} restarts={result.restarts} "
+        f"final_world={result.final_world}: {result.reason}",
+        flush=True,
+    )
+    return 0 if result.ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

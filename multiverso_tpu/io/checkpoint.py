@@ -59,7 +59,7 @@ from multiverso_tpu.resilience.watchdog import (
     fd_stats,
 )
 from multiverso_tpu.runtime import runtime
-from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.log import CHECK, FatalError, Log
 
 __all__ = ["save_tables", "restore_tables", "load_arrays"]
 
@@ -270,6 +270,14 @@ def save_tables(
             ranks = _verify_quorum(tmp)
             full_meta = dict(meta or {})
             full_meta["ranks"] = ranks
+            # the writing world's topology: the elastic (N -> N') resume
+            # names it in its log line, and an operator reading a bare
+            # MANIFEST.json can tell what world wrote it (len(ranks) is
+            # the authoritative writer count the code branches on)
+            full_meta["world"] = {
+                "processes": jax.process_count(),
+                "devices": jax.device_count(),
+            }
             rckpt.commit_atomic(tmp, directory, step=step, meta=full_meta)
             fd_stats.note_quorum_commit()
         except BaseException as e:  # noqa: BLE001 — ANY commit failure
@@ -384,10 +392,80 @@ def load_arrays(directory: str) -> Dict[str, np.ndarray]:
     return out
 
 
-def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:
+def _read_logical_shapes(directory: str) -> Dict[str, List[int]]:
+    meta_path = os.path.join(directory, "logical_shapes.json")
+    if not os.path.exists(meta_path):
+        return {}
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+def _restore_dense_resharded(directory: str, dense: List[Any]) -> None:
+    """World-size-changing restore: read the stored tree as plain HOST
+    numpy (topology-independent — the orbax sharding-file path is
+    explicitly unsafe across topologies), crop the writing world's shard
+    padding via the ``logical_shapes.json`` sidecar, and re-slice each
+    table's logical rows onto the live mesh through
+    ``DenseTable.load_logical``. No full-table device copies: the only
+    device traffic is placing each table's NEW shards once."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(directory, "tables")
+    if not os.path.isdir(path):
+        Log.Fatal(
+            "checkpoint %s is incomplete or corrupt: missing the 'tables' "
+            "orbax tree (dense-table payload)", directory,
+        )
+    want = {f"table_{t.table_id}" for t in dense}
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        structure = ckptr.metadata(path)
+        item = {k: v for k, v in structure.items() if k in want}
+        missing = want - set(item)
+        CHECK(not missing,
+              f"checkpoint {directory} has no entries for {sorted(missing)}"
+              " — the table sets of the saved and resuming runs differ")
+        restore_args = jax.tree_util.tree_map(
+            lambda _leaf: ocp.RestoreArgs(restore_type=np.ndarray), item
+        )
+        restored = ckptr.restore(
+            path, item=item, restore_args=restore_args, transforms={}
+        )
+    except FatalError:
+        raise
+    except Exception as e:  # noqa: BLE001 — one clear error
+        _fatal_orbax(directory, "failed to read the 'tables' orbax tree "
+                     "for re-sharding", e)
+    logical = _read_logical_shapes(directory)
+    for t in dense:
+        key = f"table_{t.table_id}"
+        entry = restored[key]
+        storage = np.asarray(entry["storage"])
+        shape = logical.get(key, list(t.shape))
+        storage = storage[tuple(slice(0, s) for s in shape)]
+        state = {
+            k: np.asarray(v) for k, v in (entry.get("state") or {}).items()
+        }
+        t.load_logical(storage, state)
+
+
+def restore_tables(
+    directory: str,
+    tables: Optional[List[Any]] = None,
+    *,
+    reshard: bool = False,
+) -> None:
     """Restore a checkpoint into the live (already-created) tables: creation
     order defines table ids, exactly like the reference's registration
-    protocol, so shapes/updaters must match."""
+    protocol, so shapes/updaters must match.
+
+    ``reshard=True`` is the world-size-changing path: the checkpoint may
+    have been written by a run with a different process/device count, so
+    the stored PHYSICAL shard-padded arrays are re-sliced host-side onto
+    the live mesh (logical values identical; see
+    ``_restore_dense_resharded``). The default path restores the physical
+    tree straight onto the live shardings — bit-exact and zero-copy-ish,
+    but only valid when the topology matches the writer's."""
     import orbax.checkpoint as ocp
 
     from multiverso_tpu.tables.kv_table import KVTable
@@ -395,7 +473,9 @@ def restore_tables(directory: str, tables: Optional[List[Any]] = None) -> None:
     directory = os.path.abspath(directory)
     _check_readable(directory)
     dense = _dense_tables(tables)
-    if dense:
+    if dense and reshard:
+        _restore_dense_resharded(directory, dense)
+    elif dense:
         # checkpoint_spec is the shape/dtype skeleton of checkpoint_tree
         # (host-tier numpy leaves restore as numpy, device leaves onto
         # their live sharding) — building the TARGET must never pay a

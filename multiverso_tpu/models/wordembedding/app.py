@@ -1138,6 +1138,16 @@ class WordEmbedding:
         def done(self):
             return True
 
+    @staticmethod
+    def _set_ready(ready: bool, phase: str) -> None:
+        """Alive-vs-ready wiring (ISSUE 7): the training paths flip
+        readiness once their tables are created AND any resume landed, so
+        ``/readyz`` (and the supervisor's ready-file watch) can tell a
+        restoring rank from a wedged one."""
+        from multiverso_tpu.serving import http_health
+
+        http_health.set_ready(ready, phase=phase)
+
     def _ps_tables(self):
         """The PS-mode table set, in creation order (checkpoint identity:
         restore binds by the same order)."""
@@ -1288,7 +1298,13 @@ class WordEmbedding:
         CHECK(meta.get("kind") == "ps",
               f"checkpoint {path} is not a PS-mode checkpoint "
               "(the fused host-batch and PS paths do not share roots)")
-        CHECK(int(meta.get("depth", -1)) == depth,
+        # world-size-changing resume (elastic): a checkpoint written by N
+        # ranks restoring onto N' != N goes down the re-shard path — the
+        # staged per-rank pipeline window is meaningless at N', so the
+        # depth CHECK below only guards the bit-exact same-world path
+        ckpt_world = len(meta.get("ranks") or {})
+        elastic = ckpt_world > 0 and ckpt_world != jax.process_count()
+        CHECK(elastic or int(meta.get("depth", -1)) == depth,
               f"checkpoint {path} was written at -ps_pipeline_depth="
               f"{meta.get('depth')} but this run uses {depth}: the staged "
               "in-flight pull window would not line up — resume with the "
@@ -1306,16 +1322,25 @@ class WordEmbedding:
               f"{meta.get('tier_hbm_mb', 0)} but this run uses "
               f"{o.table_tier_hbm_mb}: tiered and resident checkpoints "
               "store different table layouts — resume in the same mode")
-        for flag, current in (
-            ("compress", o.ps_compress),
-            ("sparse_pull", bool(self._ps_sparse_tables)),
-            ("adagrad", bool(o.use_adagrad)),
-        ):
+        # -use_adagrad shapes the TABLE SET (g2 tables exist or not), so
+        # it must match on every path; -ps_compress/-ps_sparse_pull only
+        # shape the staged per-rank state (codec residuals, client
+        # caches), which the elastic path drops — they may change freely
+        # across a world-size change
+        flags = [("adagrad", bool(o.use_adagrad))]
+        if not elastic:
+            flags += [
+                ("compress", o.ps_compress),
+                ("sparse_pull", bool(self._ps_sparse_tables)),
+            ]
+        for flag, current in flags:
             CHECK(meta.get(flag) == current,
                   f"checkpoint {path} was written with {flag}="
                   f"{meta.get(flag)} but this run uses {current}: "
                   "-ps_compress/-ps_sparse_pull/-use_adagrad must match "
                   "the saved run to resume")
+        if elastic:
+            return self._ps_elastic_resume(path, meta)
         restore_tables(path, self._ps_tables())
         pid = jax.process_index()
         rmeta = (meta.get("ranks") or {}).get(str(pid))
@@ -1348,6 +1373,94 @@ class WordEmbedding:
                 for k, v in (meta.get("gp_history") or {}).items()
             },
             "pulls": pulls,
+        }
+
+    def _ps_elastic_resume(self, path: str, meta: Dict):
+        """World-size-changing restore: an N-rank quorum checkpoint onto
+        N' != N ranks (ISSUE 7 tentpole).
+
+        * tables re-shard host-side (``restore_tables(reshard=True)`` —
+          logical values identical, new mesh layout);
+        * the word-count limbs merge: the global trained-pair count is the
+          sum of every old rank's exact cumulative count, re-partitioned
+          into balanced per-client shares on the new world (the global sum
+          — the only number the lr schedule reads — is preserved exactly);
+        * the per-rank data cursors merge the same way: the new world
+          skips the globally-consumed batches/blocks split evenly, so
+          training continues from the committed round boundary;
+        * the staged in-flight pipeline window (depth >= 1 checkpoints) is
+          per-rank state and is DROPPED — the pipeline restarts with an
+          empty warm-up at N', seeding the lr history with the restored
+          global count. Bit-exactness is therefore not a contract here;
+          convergence-equivalence is (pinned in tests/test_elastic.py).
+        """
+        from multiverso_tpu.io.checkpoint import restore_tables
+        from multiverso_tpu.resilience import stats as _rstats
+
+        o = self.opt
+        ranks_meta = meta.get("ranks") or {}
+        n_old = len(ranks_meta)
+        n_new = jax.process_count()
+        pid = jax.process_index()
+        depth = o.ps_pipeline_depth
+        # every table except the word-count table re-shards by value; the
+        # wc table's row count is 2*nproc (topology-shaped), so its limbs
+        # merge below instead
+        restore_tables(path, self._ps_tables()[:-1], reshard=True)
+        mask = (1 << 30) - 1
+        total = sum(int(rm.get("wc_cum", 0)) for rm in ranks_meta.values())
+        shares = [
+            total * (q + 1) // n_new - total * q // n_new
+            for q in range(n_new)
+        ]
+        limbs = np.zeros((2 * n_new, 1), np.int32)
+        for q, s in enumerate(shares):
+            limbs[2 * q, 0] = s & mask
+            limbs[2 * q + 1, 0] = s >> 30
+        self._t_wc.load_logical(limbs)
+        self._wc_cum = int(shares[pid])
+        self._ps_global_pairs = total
+        # data cursors: merge, then split evenly over the new world. The
+        # block stream is per-rank, so "skip what the old world consumed"
+        # becomes "each new rank skips its even share of the globally
+        # consumed data" (exact when shards are even; convergence-level
+        # otherwise — the committed tables already hold every consumed
+        # pair's update either way)
+        S = max(1, o.steps_per_call)
+        skip_blocks = total // max(1, n_new * o.batch_size * S)
+        epoch0 = min(
+            (int(rm.get("epoch", 0)) for rm in ranks_meta.values()),
+            default=0,
+        )
+        batches_total = sum(
+            int(rm.get("batches_in_epoch", 0)) for rm in ranks_meta.values()
+        )
+        r = int(meta["round"])
+        gp_hist = (
+            {k: total for k in range(r - depth - 1, r)} if depth > 0 else {}
+        )
+        self._ps_restarts = max(
+            (int(rm.get("restarts", 0)) for rm in ranks_meta.values()),
+            default=0,
+        ) + 1
+        _rstats.note_restart(self._ps_restarts)
+        Log.Info(
+            "[WordEmbedding] resumed (elastic N=%d -> N'=%d) from %s: PS "
+            "round %d, %.1fM global pairs, restart #%d — tables re-sharded"
+            " (writer: %s device(s)), pipeline warm-up reset, cursors "
+            "re-partitioned",
+            n_old, n_new, path, r, total / 1e6, self._ps_restarts,
+            (meta.get("world") or {}).get("devices", "?"),
+        )
+        return {
+            "round": r,
+            "pairs_done": int(shares[pid]),
+            "epoch": epoch0,
+            "batches_in_epoch": batches_total // max(1, n_new),
+            "gp_history": gp_hist,
+            "pulls": [],
+            "elastic": True,
+            "skip_blocks": int(skip_blocks),
         }
 
     def _ps_await(self, ticket, round_idx: int, pipe, wd):
@@ -1507,16 +1620,27 @@ class WordEmbedding:
         resume_round = -1
         if resume is not None:
             r = resume_round = resume["round"]
-            issued = r + depth
             pairs_done = resume["pairs_done"]
-            for pull in resume["pulls"]:  # rounds r..r+depth-1, in order
-                pull_tickets.append(self._Resolved(pull))
+            if resume.get("elastic"):
+                # world-size-changing resume: the staged pull window was
+                # per-rank state of the OLD world — restart the pipeline
+                # with an empty warm-up at N' and skip this rank's even
+                # share of the globally consumed blocks
+                issued = r
+                skip = resume["skip_blocks"]
+            else:
+                issued = r + depth
+                skip = issued
+                for pull in resume["pulls"]:  # rounds r..r+depth-1, in order
+                    pull_tickets.append(self._Resolved(pull))
             for k, gp in resume["gp_history"].items():
                 push_tickets[k] = self._Resolved(gp)
             # regenerate-and-discard the consumed blocks: same seed, same
-            # grouping, so block `issued` onward is bit-identical
-            for _ in range(issued):
+            # grouping, so the next undiscarded block starts the resumed
+            # stream (bit-identical when the world size is unchanged)
+            for _ in range(skip):
                 next(gen)
+        self._set_ready(True, "training")  # tables live + resume landed
         wd = wdg.monitor_from_flags()
         pipe = TaskPipe(name="mv-ps-comms")
         # tiered look-ahead tickets ride the COMMS pipe: every collective
@@ -1827,6 +1951,7 @@ class WordEmbedding:
                 for ep in range(start_epoch):
                     for _ in source.batches(ep):
                         pass
+        self._set_ready(True, "training")  # tables live + resume landed
         for epoch in range(start_epoch, o.epoch):
             skip = resume_skip if epoch == start_epoch else 0
             it = source.batches(epoch, skip=skip) if skip else source.batches(
@@ -2122,6 +2247,7 @@ class WordEmbedding:
             )
         from multiverso_tpu.resilience import chaos
 
+        self._set_ready(True, "training")  # params live + resume landed
         for seq in range(seq_start, o.epoch * nC):
             mid_resume = res is not None and seq == seq_start
             if mid_resume:
@@ -2290,6 +2416,9 @@ class WordEmbedding:
     def train(self, ids: Optional[np.ndarray] = None) -> float:
         """Train over the corpus; returns the last logged loss."""
         o = self.opt
+        # not ready until the chosen path's tables exist and any resume
+        # landed (each path flips it back on right before its loop)
+        self._set_ready(False, "restoring")
         if ids is None:
             # each path routes by its own suffix: .npy = pre-encoded id
             # stream (synth.py / preprocess output), else tokenized text
@@ -2466,6 +2595,7 @@ class WordEmbedding:
             for ep in range(start_epoch):
                 for _ in source.batches(ep):
                     pass
+        self._set_ready(True, "training")  # params live + resume landed
         try:
             for epoch in range(start_epoch, o.epoch):
                 skip = resume_skip if epoch == start_epoch else 0

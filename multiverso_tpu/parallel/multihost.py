@@ -247,6 +247,12 @@ def initialize(
         rendezvous_should_fail,
         with_retries,
     )
+    from multiverso_tpu.serving import http_health
+
+    # alive-vs-ready: a rank stuck in the rendezvous is ALIVE (beacons,
+    # /livez) but must not read as ready — the supervisor's wedge
+    # detector and external probes key on this phase transition
+    http_health.set_ready(False, phase="rendezvous")
 
     timeout_s = max(1, int(GetFlag("rendezvous_timeout_s")))
 
@@ -295,6 +301,7 @@ def initialize(
         describe="multihost rendezvous",
     )
     _initialized = True
+    http_health.set_ready(False, phase="initialized")
     Log.Info(
         "multihost rendezvous complete: process %d/%d, %d global device(s)",
         jax.process_index(),

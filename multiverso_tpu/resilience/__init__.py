@@ -22,11 +22,26 @@ publishes *normal*, tested events:
   per-rank liveness beacons + per-ticket collective deadlines that turn
   a hung/dead peer into a structured ``RankFailure`` (and poisoned-pipe
   ``PipelineBroken`` fail-fast) instead of a silent cluster-wide hang,
-  plus the ``failure_domain`` Dashboard/health stats.
+  plus the ``failure_domain`` Dashboard/health stats;
+* ``resilience.supervisor`` — the self-healing pod supervisor that
+  closes the loop: launches the pod, watches child rcs / heartbeat
+  beacons / FAILURE reports, and relaunches from ``latest_valid`` with
+  a replacement rank (bit-for-bit) or degraded to N-1 (elastic
+  re-shard), under a full-jitter restart budget with a structured
+  recovery log.
 """
 
 from multiverso_tpu.resilience.breaker import CircuitBreaker
-from multiverso_tpu.resilience.chaos import ChaosInterrupt, with_retries
+from multiverso_tpu.resilience.chaos import (
+    ChaosInterrupt,
+    FullJitterBackoff,
+    with_retries,
+)
+from multiverso_tpu.resilience.supervisor import (
+    PodResult,
+    PodSupervisor,
+    RestartBudget,
+)
 from multiverso_tpu.resilience.watchdog import (
     HeartbeatMonitor,
     PipelineBroken,
@@ -52,10 +67,14 @@ __all__ = [
     "ChaosInterrupt",
     "CheckpointPolicy",
     "CircuitBreaker",
+    "FullJitterBackoff",
     "HeartbeatMonitor",
     "PipelineBroken",
+    "PodResult",
+    "PodSupervisor",
     "QuorumAbort",
     "RankFailure",
+    "RestartBudget",
     "fd_stats",
     "gc_checkpoints",
     "latest_valid",

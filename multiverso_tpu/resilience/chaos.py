@@ -277,6 +277,34 @@ def rendezvous_should_fail() -> bool:
 # --------------------------------------------------------------- retries
 
 
+class FullJitterBackoff:
+    """The ``with_retries`` backoff schedule as a reusable object: delay
+    for attempt i is ``min(max_delay_s, base * 2^i)`` scaled into
+    [0.5, 1.0) by a seeded xorshift32 — deterministic per seed, full
+    jitter against thundering herds. ``with_retries`` and the pod
+    supervisor's restart budget both draw from this one definition."""
+
+    def __init__(self, base_delay_s: float, max_delay_s: float,
+                 seed: int = 0):
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self._state = (seed * 2654435761 + 0x9E3779B9) & 0xFFFFFFFF or 1
+
+    def next_delay(self, attempt: int) -> float:
+        """Jittered delay for (0-based) retry ``attempt``; advances the
+        jitter stream by one draw."""
+        s = self._state
+        # xorshift32: cheap, seedable, good enough for jitter
+        s ^= (s << 13) & 0xFFFFFFFF
+        s ^= s >> 17
+        s ^= (s << 5) & 0xFFFFFFFF
+        self._state = s
+        u = s / 0xFFFFFFFF
+        return min(
+            self.max_delay_s, self.base_delay_s * (2.0 ** attempt)
+        ) * (0.5 + 0.5 * u)
+
+
 def with_retries(
     fn: Callable[[], Any],
     *,
@@ -302,7 +330,7 @@ def with_retries(
     ZMQ rendezvous simply blocks; we refuse to)."""
     assert attempts >= 1
     start = clock()
-    state = (seed * 2654435761 + 0x9E3779B9) & 0xFFFFFFFF or 1
+    backoff = FullJitterBackoff(base_delay_s, max_delay_s, seed=seed)
     last: Optional[BaseException] = None
     for i in range(attempts):
         try:
@@ -311,12 +339,7 @@ def with_retries(
             last = e
             if i == attempts - 1:
                 break
-            # xorshift32: cheap, seedable, good enough for jitter
-            state ^= (state << 13) & 0xFFFFFFFF
-            state ^= state >> 17
-            state ^= (state << 5) & 0xFFFFFFFF
-            u = state / 0xFFFFFFFF
-            delay = min(max_delay_s, base_delay_s * (2.0 ** i)) * (0.5 + 0.5 * u)
+            delay = backoff.next_delay(i)
             if deadline_s is not None and (clock() - start) + delay > deadline_s:
                 Log.Error(
                     "%s: giving up after %d attempt(s) — deadline %.1fs "

@@ -249,12 +249,49 @@ def latest_valid(root: str) -> Optional[str]:
     return None
 
 
-def gc_checkpoints(root: str, retain: int = 3) -> List[str]:
+# How long a ``.tmp-``/``.old-`` corpse must sit UNTOUCHED before the
+# sweeper may take it. The corpse sweep is no longer single-writer: a
+# supervisor-relaunched rank runs gc while its SIBLINGS may be mid-way
+# through staging the next quorum save in a live ``.tmp-<token>`` dir —
+# sweeping that would abort a healthy commit. A staging dir being
+# actively written keeps a fresh mtime (stage records and payload land
+# at its top level), so an age gate separates "crashed save's corpse"
+# from "in-progress save" without any cross-process locking.
+CORPSE_GRACE_S = 900.0
+
+
+def _corpse_age_s(path: str) -> Optional[float]:
+    """Seconds since the NEWEST write anywhere under ``path`` (the top
+    dir's own mtime included — orbax writes into nested dirs, and only
+    the deepest file's mtime proves the save is still making progress)."""
+    newest = None
+    try:
+        newest = os.path.getmtime(path)
+        for base, _dirs, files in os.walk(path):
+            for f in files + [""]:
+                m = os.path.getmtime(os.path.join(base, f) if f else base)
+                if m > newest:
+                    newest = m
+    except OSError:
+        return None  # vanished under us: someone else swept it already
+    return time.time() - newest
+
+
+def gc_checkpoints(
+    root: str, retain: int = 3, *, corpse_grace_s: float = CORPSE_GRACE_S
+) -> List[str]:
     """Bound disk: keep the newest ``retain`` VALID versions; delete every
     other version (older valid ones and torn/corrupt ones) and every
-    ``.tmp-``/``.old-`` corpse. Returns the removed paths. Single-writer
-    protocol: the saver calls this after its own commit, so any corpse
-    present is from a crashed save, never a live one."""
+    ``.tmp-``/``.old-`` corpse older than ``corpse_grace_s``. Returns the
+    removed paths.
+
+    The corpse sweep is age-gated (see ``CORPSE_GRACE_S``): under a
+    self-healing supervisor, a relaunched rank's gc runs CONCURRENTLY
+    with its siblings' in-flight quorum save, and an un-gated sweep could
+    delete the live staging directory mid-phase-1 (the race ISSUE 7
+    names). A dir younger than the grace window is left alone — if the
+    save it belongs to really crashed, the next gc after the window takes
+    it. ``corpse_grace_s=0`` restores the old eager sweep (tests)."""
     CHECK(retain >= 1, "gc_checkpoints retain must be >= 1")
     removed: List[str] = []
     if not os.path.isdir(root):
@@ -269,6 +306,16 @@ def gc_checkpoints(root: str, retain: int = 3) -> List[str]:
     for name in os.listdir(root):
         if ".tmp-" in name or ".old-" in name:
             corpse = os.path.join(root, name)
+            age = _corpse_age_s(corpse)
+            if age is None:
+                continue  # a racing sweeper got it: not a double-sweep
+            if age < corpse_grace_s:
+                Log.Info(
+                    "checkpoint gc: leaving young staging dir %s alone "
+                    "(%.0fs < %.0fs grace — may be a sibling's in-flight "
+                    "save)", corpse, age, corpse_grace_s,
+                )
+                continue
             shutil.rmtree(corpse, ignore_errors=True)
             removed.append(corpse)
     if removed:

@@ -1,0 +1,485 @@
+"""Self-healing pod supervisor: the piece that closes the failure loop.
+
+Every prior resilience layer ends at "the survivor exits rc 42 with a
+valid drained quorum checkpoint" (PR 5) — and then a human relaunches
+the pod. This module is that human: ``PodSupervisor`` launches the N
+worker processes of one pod, watches their return codes, their heartbeat
+beacons and the ``FAILURE-round<k>.json`` reports the containment path
+publishes, and on any rank failure relaunches the whole pod from
+``latest_valid`` — either with a *replacement rank* at the same world
+size (the bit-for-bit resume path) or *degraded to N-1* (the elastic
+re-shard path, ``restore_tables(reshard=True)``), exactly what
+production parameter-server pods do.
+
+Restart storms are bounded: each relaunch waits a full-jitter
+exponential backoff (``chaos.FullJitterBackoff`` — the same schedule
+``with_retries`` uses) and a sliding restart budget (at most
+``max_restarts`` restarts inside ``restart_window_s``) turns a
+crash-looping pod into a structured give-up report instead of an
+infinite loop. Every decision lands in a JSONL *recovery log*
+(``recovery.log.jsonl`` next to the checkpoints) with wall + monotonic
+stamps, which is also where the MTTR bench reads detection /
+relaunch / time-to-ready from.
+
+The supervisor is deliberately **jax-free**: it must stay alive and
+sane when every worker is wedged inside a collective, so it never
+touches the accelerator runtime itself. Worker liveness is judged the
+same way the in-process watchdog judges peers — age since the last NEW
+beacon on the supervisor's own clock — so a worker that is alive-but-
+hung (no rc, no beacons) is killed and relaunched too, not waited on
+forever.
+
+Deployment front-end: ``deploy/supervised.py`` wraps any flag-driven
+worker command line (``{rank}``/``{world}``/``{coordinator}``
+placeholders, or automatic ``-process_id/-num_processes/-coordinator``
+injection) — see DEPLOY.md "Self-healing pods".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from multiverso_tpu.resilience.chaos import FullJitterBackoff
+from multiverso_tpu.resilience.checkpoint import latest_valid
+from multiverso_tpu.resilience.watchdog import _PEER_DEATH_SIGNATURES
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["PodSupervisor", "PodResult", "RestartBudget", "free_port",
+           "GENERATION_ENV"]
+
+# exported to every worker so chaos drills can fire in generation 0 only
+# (the relaunch must not re-kill itself) and logs can be tagged
+GENERATION_ENV = "MV_SUPERVISOR_GENERATION"
+
+# transport-layer crash signatures: the watchdog's peer-death family IS
+# the infra list (its "gloo"/"barrier" substrings subsume the cluster
+# test launcher's longer markers after lowercasing) — a child whose log
+# tail matches died of the transport, not its own logic; the recovery
+# log records the classification so an operator can tell infra churn
+# from real failures
+_INFRA_SIGNATURES = _PEER_DEATH_SIGNATURES
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class RestartBudget:
+    """At most ``max_restarts`` restarts inside a sliding
+    ``window_s``-second window; every restart draws a full-jitter backoff
+    delay from the shared ``with_retries`` schedule."""
+
+    def __init__(self, max_restarts: int = 5, window_s: float = 600.0,
+                 base_delay_s: float = 0.5, max_delay_s: float = 30.0,
+                 seed: int = 0, clock: Callable[[], float] = time.monotonic):
+        CHECK(max_restarts >= 0, "max_restarts must be >= 0")
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._stamps: List[float] = []
+        self._backoff = FullJitterBackoff(base_delay_s, max_delay_s,
+                                          seed=seed)
+
+    def _prune(self) -> None:
+        now = self._clock()
+        self._stamps = [t for t in self._stamps if now - t <= self.window_s]
+
+    def exhausted(self) -> bool:
+        self._prune()
+        return len(self._stamps) >= self.max_restarts
+
+    def spend(self) -> float:
+        """Record one restart; returns the backoff delay to wait before
+        it. Caller checks ``exhausted()`` first."""
+        self._prune()
+        attempt = len(self._stamps)
+        self._stamps.append(self._clock())
+        return self._backoff.next_delay(attempt)
+
+    def used(self) -> int:
+        self._prune()
+        return len(self._stamps)
+
+
+@dataclass
+class PodResult:
+    ok: bool
+    gave_up: bool
+    generations: int
+    restarts: int
+    final_world: int
+    reason: str
+    events: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class PodSupervisor:
+    """Launch + babysit one training pod; relaunch it from the latest
+    valid checkpoint on any rank failure.
+
+    ``make_argv(rank, world, generation, coordinator)`` builds each
+    worker's command line; workers must exit 0 on success. ``on_failure``
+    picks the recovery shape: ``"replace"`` relaunches at the same world
+    size (a replacement rank joins; elastic resume is bit-for-bit),
+    ``"degrade"`` drops to world-1 per failure down to ``min_world``
+    (elastic re-shard resume; convergence-equivalent). Heartbeat files
+    under ``heartbeat_dir`` (the workers' ``-heartbeat_dir``) give the
+    supervisor a wedge detector: a worker with a live pid but no new
+    beacon for ``heartbeat_deadline_s`` is killed and counted as failed.
+    Ready markers (``MV_READY_FILE``, touched by
+    ``serving.http_health.set_ready``) stamp the pod_ready event MTTR is
+    measured to."""
+
+    def __init__(
+        self,
+        make_argv: Callable[[int, int, int, str], List[str]],
+        *,
+        world: int,
+        checkpoint_dir: Optional[str] = None,
+        heartbeat_dir: Optional[str] = None,
+        heartbeat_deadline_s: float = 0.0,
+        ready_dir: Optional[str] = None,
+        on_failure: str = "replace",
+        min_world: int = 1,
+        max_restarts: int = 5,
+        restart_window_s: float = 600.0,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        seed: int = 0,
+        poll_s: float = 0.2,
+        exit_grace_s: float = 10.0,
+        log_dir: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        CHECK(world >= 1, "world must be >= 1")
+        CHECK(on_failure in ("replace", "degrade"),
+              f"on_failure must be 'replace' or 'degrade', got {on_failure!r}")
+        CHECK(1 <= min_world <= world, "need 1 <= min_world <= world")
+        self.make_argv = make_argv
+        self.world = int(world)
+        self.checkpoint_dir = checkpoint_dir
+        self.heartbeat_dir = heartbeat_dir
+        self.heartbeat_deadline_s = float(heartbeat_deadline_s)
+        self.ready_dir = ready_dir
+        self.on_failure = on_failure
+        self.min_world = int(min_world)
+        self.budget = RestartBudget(
+            max_restarts, restart_window_s, backoff_base_s, backoff_max_s,
+            seed=seed, clock=clock,
+        )
+        self.poll_s = float(poll_s)
+        self.exit_grace_s = float(exit_grace_s)
+        self.log_dir = log_dir or checkpoint_dir
+        self.extra_env = dict(env or {})
+        self._clock = clock
+        self._sleep = sleep
+        self.events: List[Dict[str, Any]] = []
+        self._seen_reports: set = set()
+
+    # ------------------------------------------------------ recovery log
+
+    def _event(self, event_kind: str, **fields) -> Dict[str, Any]:
+        ev = {"event": event_kind, "wall": time.time(),
+              "mono": self._clock(), **fields}
+        self.events.append(ev)
+        Log.Info("[supervisor] %s %s", event_kind,
+                 json.dumps(fields, default=str, sort_keys=True))
+        if self.log_dir:
+            try:
+                os.makedirs(self.log_dir, exist_ok=True)
+                with open(os.path.join(self.log_dir, "recovery.log.jsonl"),
+                          "a") as f:
+                    f.write(json.dumps(ev, default=str) + "\n")
+            except OSError as e:
+                Log.Error("[supervisor] recovery log write failed: %s", e)
+        return ev
+
+    # ------------------------------------------------------ child helpers
+
+    def _child_log_path(self, gen: int, rank: int) -> Optional[str]:
+        if not self.log_dir:
+            return None
+        return os.path.join(self.log_dir, f"worker-g{gen}-r{rank}.log")
+
+    def _spawn(self, gen: int, world: int) -> List[Dict[str, Any]]:
+        coord = f"127.0.0.1:{free_port()}"
+        self._event("launch", generation=gen, world=world, coordinator=coord)
+        children = []
+        for rank in range(world):
+            env = {**os.environ, **self.extra_env,
+                   GENERATION_ENV: str(gen)}
+            if self.ready_dir:
+                os.makedirs(self.ready_dir, exist_ok=True)
+                env["MV_READY_FILE"] = os.path.join(
+                    self.ready_dir, f"ready-g{gen}-r{rank}.json"
+                )
+                try:  # a PRIOR supervisor run's marker must not make
+                    # pod_ready fire while this worker is still restoring
+                    os.remove(env["MV_READY_FILE"])
+                except OSError:
+                    pass
+            log_path = self._child_log_path(gen, rank)
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+            out = open(log_path, "wb") if log_path else subprocess.DEVNULL
+            proc = subprocess.Popen(
+                self.make_argv(rank, world, gen, coord),
+                stdout=out, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True,  # one killpg reaps grandchildren
+            )
+            if log_path:
+                out.close()  # the child holds its own handle now
+            children.append({
+                "rank": rank, "proc": proc, "log": log_path,
+                "hb_seq": -1, "hb_seen": self._clock(),
+                "ready_file": env.get("MV_READY_FILE"),
+            })
+        return children
+
+    @staticmethod
+    def _kill(children: List[Dict[str, Any]]) -> None:
+        for c in children:
+            proc = c["proc"]
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError, OSError):
+                    proc.kill()
+        for c in children:
+            try:
+                c["proc"].wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _classify(self, child: Dict[str, Any]) -> str:
+        """Best-effort failure classification from the child's log tail:
+        'infra' (transport-layer crash — the gloo gremlin the cluster
+        tests retry on), 'rank_failure' (structured containment ran) or
+        'crash'."""
+        path = child.get("log")
+        if not path or not os.path.exists(path):
+            return "crash"
+        try:
+            with open(path, "rb") as f:
+                f.seek(max(0, os.path.getsize(path) - 65536))
+                tail = f.read().decode("utf-8", "replace")
+        except OSError:
+            return "crash"
+        low = tail.lower()
+        if "rank_failure" in low or "rankfailure" in low:
+            return "rank_failure"
+        if any(sig.lower() in low for sig in _INFRA_SIGNATURES):
+            return "infra"
+        return "crash"
+
+    def _hb_beacon(self, rank: int) -> Optional[int]:
+        if not self.heartbeat_dir:
+            return None
+        try:
+            with open(os.path.join(self.heartbeat_dir,
+                                   f"hb-{rank}.json")) as f:
+                return int(json.load(f)["seq"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _last_beacon_walls(self) -> Dict[str, float]:
+        """Wall mtime of each rank's beacon file — the MTTR anchor
+        (detection latency is measured from the dead rank's last beat)."""
+        out: Dict[str, float] = {}
+        if not self.heartbeat_dir or not os.path.isdir(self.heartbeat_dir):
+            return out
+        for name in os.listdir(self.heartbeat_dir):
+            if name.startswith("hb-") and name.endswith(".json"):
+                try:
+                    out[name[3:-5]] = os.path.getmtime(
+                        os.path.join(self.heartbeat_dir, name)
+                    )
+                except OSError:
+                    pass
+        return out
+
+    def _new_failure_reports(self) -> List[str]:
+        if not self.checkpoint_dir or not os.path.isdir(self.checkpoint_dir):
+            return []
+        fresh = []
+        for name in sorted(os.listdir(self.checkpoint_dir)):
+            if name.startswith("FAILURE-") and name.endswith(".json") \
+                    and name not in self._seen_reports:
+                self._seen_reports.add(name)
+                fresh.append(os.path.join(self.checkpoint_dir, name))
+        return fresh
+
+    # ------------------------------------------------------ the main loop
+
+    def _watch(self, children: List[Dict[str, Any]], gen: int
+               ) -> Optional[Dict[str, Any]]:
+        """Block until the pod exits cleanly (returns None) or a failure
+        is detected (returns the failure record). Detection sources: a
+        nonzero child rc, a live-but-silent child past the heartbeat
+        deadline (wedged), a published FAILURE report."""
+        ready_logged = False
+        first_bad: Optional[Dict[str, Any]] = None
+        first_bad_t = 0.0
+        report_pending: Optional[Dict[str, Any]] = None
+        report_t = 0.0
+        while True:
+            now = self._clock()
+            for c in children:
+                rc = c["proc"].poll()
+                if rc is not None and rc != 0 and first_bad is None:
+                    first_bad = {"rank": c["rank"], "rc": rc,
+                                 "kind": self._classify(c)}
+                    first_bad_t = now
+                seq = self._hb_beacon(c["rank"])
+                if seq is not None and seq != c["hb_seq"]:
+                    c["hb_seq"], c["hb_seen"] = seq, now
+                elif (
+                    first_bad is None
+                    and self.heartbeat_deadline_s > 0
+                    and c["hb_seq"] >= 0  # deadline arms at FIRST beacon:
+                    # startup (jax import + rendezvous + a host-side
+                    # elastic restore of tier-scale tables) legitimately
+                    # exceeds any sane deadline, and the in-process
+                    # watchdog is not even running yet — a rank that dies
+                    # during startup is caught by its rc, not by silence
+                    and c["proc"].poll() is None
+                    and now - c["hb_seen"] > self.heartbeat_deadline_s
+                ):
+                    first_bad = {"rank": c["rank"], "rc": None,
+                                 "kind": "wedged"}
+                    first_bad_t = now
+            if not ready_logged and self.ready_dir and all(
+                c["ready_file"] and os.path.exists(c["ready_file"])
+                for c in children
+            ):
+                ready_logged = True
+                self._event("pod_ready", generation=gen,
+                            world=len(children))
+            reports = self._new_failure_reports()
+            for rep in reports:
+                self._event("failure_report", generation=gen, path=rep)
+                if report_pending is None:
+                    report_pending = {"rank": -1, "rc": None,
+                                      "kind": "failure_report",
+                                      "report": rep}
+                    report_t = now
+            if (
+                first_bad is None
+                and report_pending is not None
+                and now - report_t >= self.exit_grace_s
+            ):
+                # the third detection channel: containment published a
+                # FAILURE report but no child produced an rc within the
+                # grace — the publisher is wedged (e.g. a distributed
+                # teardown blocking on the dead peer) and must be killed
+                # and relaunched, not waited on (an rc arriving inside
+                # the grace takes precedence below, as usual)
+                first_bad = report_pending
+                first_bad_t = now
+            if first_bad is None and all(
+                c["proc"].poll() == 0 for c in children
+            ):
+                return None  # clean pod exit
+            if first_bad is not None:
+                # short grace for siblings to land their own structured
+                # exits (the survivor's rc-42 containment), then reap
+                done = all(c["proc"].poll() is not None for c in children)
+                if done or now - first_bad_t >= self.exit_grace_s:
+                    return first_bad
+            self._sleep(self.poll_s)
+
+    def run(self) -> PodResult:
+        gen = 0
+        world = self.world
+        restarts = 0
+        while True:
+            if self.heartbeat_dir and os.path.isdir(self.heartbeat_dir):
+                # a previous generation's beacons must not look live
+                for name in os.listdir(self.heartbeat_dir):
+                    if name.startswith("hb-"):
+                        try:
+                            os.remove(os.path.join(self.heartbeat_dir, name))
+                        except OSError:
+                            pass
+            children = self._spawn(gen, world)
+            failure = self._watch(children, gen)
+            if failure is None:
+                self._event("healthy_exit", generation=gen, world=world,
+                            restarts=restarts)
+                return PodResult(
+                    ok=True, gave_up=False, generations=gen + 1,
+                    restarts=restarts, final_world=world,
+                    reason="pod exited cleanly", events=self.events,
+                )
+            beacons = self._last_beacon_walls()
+            self._kill(children)
+            # absorb any report published between the last poll and the
+            # kill: it belongs to THIS failure, and must not arm the
+            # report channel against the next (healthy) generation
+            self._new_failure_reports()
+            rcs = {c["rank"]: c["proc"].poll() for c in children}
+            resume_from = (
+                latest_valid(self.checkpoint_dir)
+                if self.checkpoint_dir else None
+            )
+            self._event(
+                "failure_detected", generation=gen, world=world,
+                rank=failure["rank"], rc=failure["rc"],
+                kind=failure["kind"], rcs=rcs, resume_from=resume_from,
+                last_beacon_walls=beacons,
+            )
+            if self.budget.exhausted():
+                report = {
+                    "gave_up": True,
+                    "restarts_in_window": self.budget.used(),
+                    "max_restarts": self.budget.max_restarts,
+                    "restart_window_s": self.budget.window_s,
+                    "last_failure": failure,
+                    "resume_from": resume_from,
+                    "world": world,
+                    "generations": gen + 1,
+                }
+                self._event("give_up", **report)
+                if self.log_dir:
+                    try:
+                        with open(os.path.join(self.log_dir,
+                                               "RECOVERY-GIVEUP.json"),
+                                  "w") as f:
+                            json.dump(report, f, indent=1, default=str)
+                    except OSError:
+                        pass
+                return PodResult(
+                    ok=False, gave_up=True, generations=gen + 1,
+                    restarts=restarts, final_world=world,
+                    reason=(
+                        f"restart budget exhausted: {self.budget.used()} "
+                        f"restarts in {self.budget.window_s:.0f}s"
+                    ),
+                    events=self.events,
+                )
+            delay = self.budget.spend()
+            restarts += 1
+            next_world = world
+            if self.on_failure == "degrade":
+                next_world = max(self.min_world, world - 1)
+            self._event(
+                "relaunch", generation=gen + 1, world=next_world,
+                policy=self.on_failure, backoff_s=round(delay, 3),
+                resume_from=resume_from,
+            )
+            self._sleep(delay)
+            world = next_world
+            gen += 1

@@ -395,6 +395,11 @@ class _FailureDomainStats:
         self.rank_failures = 0
         self.last_failure_kind: Optional[str] = None
         self._ages_fn: Optional[Callable[[], Dict[int, float]]] = None
+        # alive-vs-ready (ISSUE 7): liveness is the process existing;
+        # readiness flips once tables are restored/published
+        # (serving.http_health.set_ready is the single writer)
+        self.ready = False
+        self.phase = "starting"
 
     def _register(self) -> None:
         # lazy + keyed: survives Dashboard.Reset() by re-adding on next note
@@ -437,6 +442,12 @@ class _FailureDomainStats:
             self.last_failure_kind = kind
         self._register()
 
+    def set_readiness(self, ready: bool, phase: str) -> None:
+        with self._lock:
+            self.ready = bool(ready)
+            self.phase = str(phase)
+        self._register()
+
     def set_heartbeat_ages_provider(
         self, fn: Optional[Callable[[], Dict[int, float]]]
     ) -> None:
@@ -457,6 +468,8 @@ class _FailureDomainStats:
         ages = self.heartbeat_ages()
         with self._lock:
             return {
+                "ready": self.ready,
+                "phase": self.phase,
                 "tickets": self.tickets,
                 "ticket_wait_p50_ms": round(self._wait_pct_locked(50), 3),
                 "ticket_wait_p99_ms": round(self._wait_pct_locked(99), 3),
@@ -485,9 +498,11 @@ class _FailureDomainStats:
             f"r{k}={v}s" for k, v in sorted(d["heartbeat_ages_s"].items())
         ) or "none"
         return [
-            "[failure_domain] tickets=%d wait_p50=%.2fms wait_p99=%.2fms "
-            "broken_pipes=%d drains=%d (timeouts=%d, avg=%.1fms)" % (
-                d["tickets"], d["ticket_wait_p50_ms"],
+            "[failure_domain] ready=%s phase=%s tickets=%d wait_p50=%.2fms "
+            "wait_p99=%.2fms broken_pipes=%d drains=%d (timeouts=%d, "
+            "avg=%.1fms)" % (
+                d["ready"], d["phase"], d["tickets"],
+                d["ticket_wait_p50_ms"],
                 d["ticket_wait_p99_ms"], d["broken_pipes"], d["drains"],
                 d["drain_timeouts"], d["drain_ms_avg"],
             ),

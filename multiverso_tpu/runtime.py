@@ -87,7 +87,18 @@ def _enable_compilation_cache() -> None:
     (empty string disables); the default lives next to the package so
     repeated runs from one checkout share it. Cache hits cut the
     WordEmbedding device-pipeline first-call cost from ~30s to ~2s
-    (same-process jit cache still applies on top)."""
+    (same-process jit cache still applies on top).
+
+    The cache is **namespaced by runtime configuration** (platform,
+    process/device counts, CPU collectives implementation + dispatch
+    mode): jaxlib's disk-cache key does NOT cover every config knob that
+    changes the compiled executable, and a supervisor that relaunches
+    the same checkout at a different world size (elastic N -> N') would
+    otherwise poison the cache across topologies — measured: a
+    single-process run loading an entry compiled by a 2-proc gloo run
+    of the same program trains to visibly different values (reduction
+    order baked into the executable). Must therefore run AFTER the
+    multihost rendezvous, when the topology is final."""
     global _compilation_cache_enabled
     if _compilation_cache_enabled:
         return
@@ -103,7 +114,24 @@ def _enable_compilation_cache() -> None:
             ".jax_cache",
         )
     try:
-        jax.config.update("jax_compilation_cache_dir", path)
+        ns = (
+            f"{jax.default_backend()}"
+            f"-p{jax.process_count()}-d{jax.device_count()}"
+        )
+        if jax.default_backend() == "cpu":
+            def read(opt, default):
+                try:  # attribute access returns None for these options
+                    val = jax.config._read(opt)
+                except Exception:  # noqa: BLE001 — option absent: default
+                    val = None
+                return default if val is None else val
+
+            impl = read("jax_cpu_collectives_implementation", "none")
+            async_d = read("jax_cpu_enable_async_dispatch", True)
+            ns += f"-{impl}-ad{int(bool(async_d))}"
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(path, ns)
+        )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:  # cache is an optimisation, never a hard failure
         Log.Info("compilation cache disabled: %s", e)
@@ -144,7 +172,6 @@ class Runtime:
         Returns the compacted argv (flags consumed), like ``ParseCMDFlags``.
         """
         remaining = ParseCMDFlags(argv)
-        _enable_compilation_cache()
         if self._started:
             if mesh is not None or num_shards not in (None, 0):
                 Log.Fatal(
@@ -160,6 +187,9 @@ class Runtime:
             # -coordinator / -machine_file driven rendezvous (no-op when
             # neither flag is set — single-process run)
             multihost.initialize_from_flags()
+        # AFTER the rendezvous: the cache namespace needs the final
+        # topology (and the rendezvous flips the CPU collectives config)
+        _enable_compilation_cache()
         if mesh is None:
             flag_shards = num_shards if num_shards is not None else GetFlag("num_shards")
             if jax.process_count() > 1:

@@ -18,19 +18,105 @@ failure was recorded (``"degraded"`` — the page an operator's prober
 keys on). ``-health_port`` wires it into flag-driven apps;
 ``examples/serving_demo.py --health-port`` demonstrates the probe end to
 end (and ci.sh asserts it).
+
+**Alive vs ready** (ISSUE 7): a supervised pod needs to tell
+"restarting" from "wedged". *Liveness* is true the moment the process
+serves HTTP at all; *readiness* flips only once tables are
+restored/published (``set_ready`` — the training paths call it after
+elastic resume lands, ``TableServer.publish`` after a snapshot is live).
+``GET /livez`` always answers 200; ``GET /readyz`` answers 200/503 on
+the readiness flag, and ``/healthz`` carries both booleans plus the
+current ``phase``. When the supervisor exports ``MV_READY_FILE``,
+``set_ready(True)`` also touches that marker — the file-based readiness
+channel the ``PodSupervisor`` (and the MTTR bench) watch without needing
+a port per rank.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from multiverso_tpu.utils.configure import MV_DEFINE_int, GetFlag
 from multiverso_tpu.utils.log import Log
 
-__all__ = ["HealthServer", "health_payload", "maybe_start_from_flags"]
+__all__ = [
+    "HealthServer",
+    "health_payload",
+    "maybe_start_from_flags",
+    "set_ready",
+    "set_serving_ready",
+    "readiness",
+    "READY_FILE_ENV",
+]
+
+READY_FILE_ENV = "MV_READY_FILE"
+
+_ready_lock = threading.Lock()
+_ready_state: Dict[str, Any] = {
+    "ready": False, "phase": "starting", "since_wall": time.time(),
+}
+
+
+# phases a TRAINING path owns: while one of these is current, a serving
+# publish in the same process must not flip readiness back on (the
+# serve-while-train layout republishes periodically, and a mid-restore
+# rank answering /readyz 200 is exactly the mistake this surface exists
+# to prevent)
+_TRAINING_NOT_READY_PHASES = ("restoring", "rendezvous")
+
+
+def set_ready(ready: bool = True, phase: Optional[str] = None) -> None:
+    """Flip process-wide readiness (liveness is implicit — a dead process
+    answers nothing). Touches the ``MV_READY_FILE`` marker on the
+    ready transition so a supervisor can watch readiness file-side."""
+    from multiverso_tpu.resilience.watchdog import fd_stats
+
+    with _ready_lock:
+        if phase is not None:
+            _ready_state["phase"] = phase
+        if bool(ready) != _ready_state["ready"]:
+            _ready_state["ready"] = bool(ready)
+            _ready_state["since_wall"] = time.time()
+        # snapshot under the lock: concurrent callers must never publish
+        # a torn (ready, phase) pair to fd_stats or the marker
+        snap_ready, snap_phase = _ready_state["ready"], _ready_state["phase"]
+    fd_stats.set_readiness(snap_ready, snap_phase)
+    marker = os.environ.get(READY_FILE_ENV)
+    if ready and marker:
+        try:
+            d = os.path.dirname(marker)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(marker, "w") as f:
+                f.write(json.dumps(
+                    {"wall": time.time(), "phase": snap_phase}
+                ))
+        except OSError as e:
+            Log.Error("ready marker %s not written: %s", marker, e)
+
+
+def set_serving_ready() -> bool:
+    """Readiness flip for a successful serving publish — DEFERS to an
+    in-progress training restore: while the trainer holds the process in
+    a not-ready phase (``restoring``/``rendezvous``), a periodic
+    publish in the serve-while-train layout must not override it.
+    Returns whether readiness was flipped."""
+    with _ready_lock:
+        blocked = _ready_state["phase"] in _TRAINING_NOT_READY_PHASES
+    if blocked:
+        return False
+    set_ready(True, phase="serving")
+    return True
+
+
+def readiness() -> Dict[str, Any]:
+    with _ready_lock:
+        return dict(_ready_state)
 
 MV_DEFINE_int(
     "health_port", 0,
@@ -53,8 +139,12 @@ def health_payload(server=None) -> Dict[str, Any]:
     degraded = bool(serving and serving.get("breakers_open")) or (
         fd["rank_failures"] > 0
     )
+    ready = readiness()
     return {
         "status": "degraded" if degraded else "ok",
+        "alive": True,  # a probed-and-answering process IS alive
+        "ready": ready["ready"],
+        "phase": ready["phase"],
         "serving": serving,
         "resilience": rstats.to_dict(),
         "failure_domain": fd,
@@ -73,20 +163,36 @@ class HealthServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                if self.path.split("?", 1)[0] != "/healthz":
-                    self.send_error(404, "only /healthz is served")
-                    return
-                try:
-                    body = json.dumps(
-                        health_payload(outer.table_server), default=str
-                    ).encode()
+                route = self.path.split("?", 1)[0]
+                if route == "/livez":
+                    # liveness: answering at all is the proof
+                    body = json.dumps({"alive": True}).encode()
                     self.send_response(200)
-                except Exception as e:  # noqa: BLE001 — a broken section
-                    # must degrade the probe, not kill the prober thread
-                    body = json.dumps(
-                        {"status": "error", "error": str(e)}
-                    ).encode()
-                    self.send_response(500)
+                elif route == "/readyz":
+                    # readiness: 503 while restoring/republishing, so an
+                    # external prober (or the supervisor) can tell a
+                    # restarting rank from a wedged one
+                    ready = readiness()
+                    body = json.dumps(ready, default=str).encode()
+                    self.send_response(200 if ready["ready"] else 503)
+                elif route != "/healthz":
+                    self.send_error(
+                        404, "only /healthz, /livez, /readyz are served"
+                    )
+                    return
+                else:
+                    try:
+                        body = json.dumps(
+                            health_payload(outer.table_server), default=str
+                        ).encode()
+                        self.send_response(200)
+                    except Exception as e:  # noqa: BLE001 — a broken
+                        # section must degrade the probe, not kill the
+                        # prober thread
+                        body = json.dumps(
+                            {"status": "error", "error": str(e)}
+                        ).encode()
+                        self.send_response(500)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -319,6 +319,14 @@ class TableServer:
             # atomic reference swap: the ONLY mutation queries can observe
             self._snapshot = snap
             self.metrics.record_swap()
+            # a successful publish = this process can serve: flip the
+            # alive/ready distinction external probes key on (defers to
+            # a training path holding the process in a not-ready phase —
+            # serve-while-train republished snapshots must not mark a
+            # mid-restore rank ready)
+            from multiverso_tpu.serving import http_health
+
+            http_health.set_serving_ready()
             Log.Info(
                 "table server %s: published weights v%d (%s)",
                 self.name,

@@ -412,6 +412,79 @@ class DenseTable:
             "state": {k: spec(v) for k, v in self.state.items()},
         }
 
+    def _put_global(self, arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
+        """Place one host array (identical on every process) onto the live
+        mesh sharding. Multi-process shardings are not fully addressable, so
+        ``device_put`` of the whole array only works single-process; the
+        callback form hands each process exactly its own shards."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    def load_logical(
+        self,
+        storage: np.ndarray,
+        state: Optional[Dict[str, np.ndarray]] = None,
+    ) -> None:
+        """Bind host-side LOGICAL arrays onto this table's live mesh — the
+        world-size-changing restore path (elastic resume at N' != N ranks).
+
+        ``checkpoint_tree`` stores the PHYSICAL shard-padded storage of the
+        world that wrote it; this inverse takes the cropped logical rows
+        (any origin topology), re-pads them for THIS mesh's shard count and
+        places them shard-by-shard — a host-side re-slice, never a
+        full-table device-to-device reshard. Updater slots ride along when
+        given: table-shaped slots re-pad like storage; per-worker slots
+        whose worker extent changed are averaged across the old workers and
+        broadcast to the new extent (convergence-level, logged — per-worker
+        momenta have no exact meaning across a world-size change)."""
+        storage = np.asarray(storage, self.dtype)
+        CHECK(
+            tuple(storage.shape) == self.shape,
+            f"load_logical storage shape {storage.shape} != logical table "
+            f"shape {self.shape}",
+        )
+        extra = self._padded0 - self.shape[0]
+
+        def pad_rows(arr: np.ndarray, axis: int) -> np.ndarray:
+            if extra == 0:
+                return arr
+            pad = [(0, 0)] * arr.ndim
+            pad[axis] = (0, extra)
+            return np.pad(arr, pad)
+
+        self.storage = self._put_global(pad_rows(storage, 0), self._sharding)
+        new_state = dict(self.state)
+        for k, live in self.state.items():
+            arr = None if state is None else state.get(k)
+            if arr is None:
+                continue  # keep the freshly initialised slot
+            arr = np.asarray(arr)
+            if arr.ndim == len(self._pshape) + 1:
+                # per-worker slots: (old_workers, old_padded_rows, ...) —
+                # crop the row padding of the writing world, remap the
+                # worker extent, re-pad for this one
+                arr = arr[:, : self.shape[0]]
+                w_new = int(live.shape[0])
+                if arr.shape[0] != w_new:
+                    Log.Info(
+                        "table %s: re-sharding per-worker slot %r from %d "
+                        "to %d workers (mean-broadcast; convergence-level)",
+                        self.name, k, arr.shape[0], w_new,
+                    )
+                    arr = np.broadcast_to(
+                        arr.mean(axis=0), (w_new,) + arr.shape[1:]
+                    )
+                arr = pad_rows(np.ascontiguousarray(arr), 1)
+            else:
+                arr = pad_rows(arr[: self.shape[0]], 0)
+            new_state[k] = self._put_global(
+                arr.astype(live.dtype), self._state_sharding(live)
+            )
+        self.state = new_state
+
     def _state_logical(self) -> Dict[str, np.ndarray]:
         """Updater slots with padding stripped (dim 0, or dim 1 for
         per-worker slots)."""

@@ -604,6 +604,14 @@ class TieredMatrixTable(MatrixTable):
             self._host[...] = arr.astype(self._host.dtype)
             self._drop_cache()
 
+    def load_logical(self, storage, state=None) -> None:
+        """World-size-changing restore hook: a tiered table's checkpoint
+        storage IS the logical host-tier table, so the elastic path lands
+        it exactly like ``restore_checkpoint_tree`` (host tier overwrite +
+        cache drop); updater slots don't exist here (linear-only CHECK)."""
+        self.restore_checkpoint_tree({"storage": np.asarray(storage),
+                                      "state": {}})
+
     def load(self, uri_or_stream, as_add: bool = False) -> None:
         """Stream restore into the HOST tier. ``as_add`` (the reference
         LogReg delta-injection protocol) degenerates to overwrite for a

@@ -40,6 +40,14 @@ import pytest  # noqa: E402
 _COMPILED_GATES = ("test_pallas_flash_compiled", "test_fused_step_compiled")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy multi-process cluster drills — excluded from the "
+        "tier-1 run (-m 'not slow'), exercised by ci.sh's full pytest",
+    )
+
+
 def pytest_collection_modifyitems(config, items):
     """Under MV_TEST_REAL_TPU=1 the fake 8-device pod is disabled, so
     every mesh-building test would fail on the one-chip host — keep only

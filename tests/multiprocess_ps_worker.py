@@ -34,6 +34,15 @@ mode=chaos_drill: the failure-domain drill (shared_root required —
 mode=chaos_resume: relaunch after the drill: every rank resumes from the
             drained quorum checkpoint and finishes ("resumed from"
             continuity + identical final tables).
+mode=supervised: the self-healing drill worker (shared_root required).
+            Same pipelined depth=1 + quorum-checkpoint + watchdog shape
+            as chaos_drill, but the chaos drop (rank 1 at round 5) fires
+            ONLY in supervisor generation 0 (MV_SUPERVISOR_GENERATION
+            env) and only when the pod has a rank 1 — the PodSupervisor
+            relaunch (replacement rank at N, or degraded to N-1 via the
+            elastic re-shard resume) must run to completion untouched.
+            Corpus shards re-derive from the CURRENT world size, so a
+            degraded pod re-partitions the data like a real redeploy.
 """
 
 import os
@@ -60,7 +69,7 @@ def main():
     from multiverso_tpu.models.wordembedding.dictionary import Dictionary
     from multiverso_tpu.resilience.watchdog import RankFailure
 
-    chaos_mode = mode.startswith("chaos_")
+    chaos_mode = mode.startswith("chaos_") or mode == "supervised"
     argv = [
         "prog",
         f"-coordinator={coord}",
@@ -68,7 +77,7 @@ def main():
         f"-num_processes={nproc}",
     ]
     if chaos_mode:
-        assert shared_root, "chaos_* modes need the shared_root argv"
+        assert shared_root, "chaos_*/supervised modes need the shared_root"
         # watchdog armed: file-backed beacons on the shared root, tight
         # deadlines so the drill detects within seconds, bounded ticket
         # waits as the backstop when the transport hangs instead of
@@ -81,6 +90,14 @@ def main():
         ]
         if mode == "chaos_drill":
             argv.append("-chaos_drop_rank=1:5")
+        if (
+            mode == "supervised"
+            and os.environ.get("MV_SUPERVISOR_GENERATION", "0") == "0"
+            and nproc > 1
+        ):
+            # the chaos drop fires in generation 0 only: the supervisor's
+            # relaunch (gen >= 1) must be a clean self-healed pod
+            argv.append("-chaos_drop_rank=1:5")
     mv.MV_Init(argv)
     assert jax.process_count() == nproc, jax.process_count()
 
@@ -92,9 +109,11 @@ def main():
     d.word2id = {w: i for i, w in enumerate(d.words)}
     d.counts = np.bincount(ids[ids >= 0], minlength=V).astype(np.int64)
 
-    if mode.startswith("shard"):
+    if mode.startswith("shard") or mode == "supervised":
         # uneven shards (weights nproc..1): block counts differ per rank,
-        # forcing dry-rank lockstep rounds at the tail
+        # forcing dry-rank lockstep rounds at the tail. Supervised pods
+        # re-derive the split from the CURRENT nproc, so a degraded
+        # relaunch re-partitions the corpus over the surviving ranks
         wts = np.arange(nproc, 0, -1, dtype=np.float64)
         cuts = np.floor(np.cumsum(wts / wts.sum()) * len(ids)).astype(int)[:-1]
         ids = np.split(ids, cuts)[pid]

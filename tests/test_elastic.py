@@ -1,0 +1,628 @@
+"""True elasticity (ISSUE 7): world-size-changing resume + the
+self-healing pod supervisor.
+
+The elastic-restore pins run single-process by *fabricating* the writer
+world: a quorum checkpoint's manifest records per-rank cursor metadata
+(``meta["ranks"]``), and the re-shard path consumes ONLY that metadata
+plus the topology-independent logical table payload — so splitting one
+rank's recorded cursors into k consistent shares produces a bona fide
+"N=k checkpoint" whose elastic restore onto N'=1 must reproduce the
+original run exactly where exactness is promised:
+
+* depth 0: kill + elastic resume == the uninterrupted run BIT FOR BIT
+  (no staleness -> the empty-warm-up restart loses nothing);
+* depth >= 1: the staged pull window is dropped (documented), so the pin
+  is convergence-equivalence (loss within tolerance, embeddings aligned)
+  plus *partition invariance*: restores of DIFFERENT fabricated
+  partitions of the same truth are bitwise identical to each other —
+  the merge math may depend only on the global state, never on how the
+  old world happened to split it.
+
+The supervisor suite drives ``PodSupervisor`` with tiny jax-free worker
+subprocesses (real pids, real kills, real recovery log); the real
+2-process chaos-drop drill lives in ci.sh (and the cluster leg below,
+``slow``-marked, covers N=2 -> N'=1/4 with real gloo pods where the
+stack supports 4-proc clusters)."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.resilience import chaos, latest_valid
+from multiverso_tpu.resilience.supervisor import (
+    GENERATION_ENV,
+    PodSupervisor,
+    RestartBudget,
+)
+from multiverso_tpu.utils.configure import ResetFlagsToDefault, SetCMDFlag
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+V = 60
+
+
+@pytest.fixture
+def chaos_reset():
+    chaos.reset()
+    ResetFlagsToDefault()
+    yield
+    chaos.reset()
+    ResetFlagsToDefault()
+
+
+def _corpus(seed=0, n=3000):
+    rng = np.random.RandomState(seed)
+    p = rng.randint(0, V // 2, n) * 2
+    return (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+
+
+def _dict(ids):
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(V)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(
+        np.bincount(np.maximum(ids, 0), minlength=V), 1
+    ).astype(np.int64)
+    return d
+
+
+def _run_ps(ids, d, **kw):
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import (
+        WEOptions,
+        WordEmbedding,
+    )
+
+    mv.MV_Init(["prog"])
+    try:
+        base = dict(
+            size=16, negative=3, window=2, batch_size=256, steps_per_call=2,
+            epoch=2, sample=0, alpha=0.1, output_file="", use_ps=True,
+            is_pipeline=False, train_file="unused",
+        )
+        base.update(kw)
+        opt = WEOptions(**base)
+        we = WordEmbedding(opt, dictionary=d)
+        loss = we.train(ids=ids)
+        return float(loss), we.embeddings().copy()
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def _fabricate_world(ck_root, parts):
+    """Rewrite the latest checkpoint's manifest so it claims ``parts``
+    writer ranks, splitting the one real rank's cursors consistently
+    (wc_cum / batches_in_epoch shares sum to the recorded truth). The
+    payload stays byte-identical — exactly what the elastic path promises
+    to be insensitive to."""
+    path = latest_valid(ck_root)
+    mpath = os.path.join(path, "MANIFEST.json")
+    with open(mpath) as f:
+        man = json.load(f)
+    rm = man["meta"]["ranks"]["0"]
+    wc, b = int(rm["wc_cum"]), int(rm["batches_in_epoch"])
+    cw = [wc * q // parts for q in range(parts + 1)]
+    cb = [b * q // parts for q in range(parts + 1)]
+    man["meta"]["ranks"] = {
+        str(q): {**rm, "wc_cum": cw[q + 1] - cw[q],
+                 "batches_in_epoch": cb[q + 1] - cb[q]}
+        for q in range(parts)
+    }
+    with open(mpath, "w") as f:
+        json.dump(man, f, indent=1)
+    return path, wc
+
+
+def _interrupt_ps(ids, d, ck, *, depth, kill_round=8, every=4, **kw):
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", f"0:{kill_round}")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_ps(ids, d, ps_pipeline_depth=depth, checkpoint_dir=ck,
+                checkpoint_every_steps=every, **kw)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+
+
+# ================================================== world-changing restore
+
+
+def test_elastic_restore_is_value_preserving(tmp_path, chaos_reset):
+    """The re-shard restore itself, unit-level: an 'N=2' checkpoint's
+    logical table values land EXACTLY on the N'=1 tables
+    (load_arrays is the topology-free truth), the wc limbs merge to the
+    exact global count, and the resume record re-partitions the cursors
+    from global truth only."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.io.checkpoint import load_arrays
+    from multiverso_tpu.models.wordembedding.app import (
+        WEOptions,
+        WordEmbedding,
+    )
+
+    ids = _corpus()
+    d = _dict(ids)
+    ck = str(tmp_path / "ck")
+    _interrupt_ps(ids, d, ck, depth=1)
+    path, total = _fabricate_world(ck, 2)
+    arrs = load_arrays(path)
+    mv.MV_Init(["prog"])
+    try:
+        opt = WEOptions(
+            size=16, negative=3, window=2, batch_size=256, steps_per_call=2,
+            epoch=2, sample=0, alpha=0.1, output_file="", use_ps=True,
+            is_pipeline=False, train_file="unused", ps_pipeline_depth=1,
+            checkpoint_dir=ck, checkpoint_every_steps=0,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        we._ps_setup()
+        rec = we._ps_maybe_resume(depth=1)
+        assert rec is not None and rec["elastic"]
+        # table values: exactly the checkpoint's logical arrays
+        np.testing.assert_array_equal(we._t_in.get(), arrs["table_0"])
+        np.testing.assert_array_equal(we._t_out.get(), arrs["table_1"])
+        # wc merge: the global count survives exactly (limb re-partition)
+        limbs = we._t_wc.get().astype(np.int64).reshape(-1)
+        assert int(limbs[0::2].sum() + (limbs[1::2].sum() << 30)) == total
+        assert we._ps_global_pairs == total
+        assert we._wc_cum == total  # N'=1: the single client owns it all
+        # cursor re-partition: derived from global truth only
+        r = rec["round"]
+        assert rec["pulls"] == []  # empty pipeline warm-up at N'
+        assert set(rec["gp_history"]) == {r - 2, r - 1}
+        assert all(v == total for v in rec["gp_history"].values())
+        assert rec["skip_blocks"] == total // (256 * 2)
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def test_elastic_depth0_resume_matches_uninterrupted_bitwise(tmp_path,
+                                                             chaos_reset):
+    """Depth 0 has no staleness, so the elastic empty-warm-up restart
+    loses nothing: kill at round 8, fabricate an N=2 world, resume at
+    N'=1 — final embeddings EQUAL the uninterrupted run bit for bit
+    (tables re-shard by value, the wc/cursor merge reconstructs the
+    exact global position)."""
+    ids = _corpus()
+    d = _dict(ids)
+    _, golden = _run_ps(ids, d)
+    ck = str(tmp_path / "ck0")
+    _interrupt_ps(ids, d, ck, depth=0)
+    _fabricate_world(ck, 2)
+    _, resumed = _run_ps(ids, d, checkpoint_dir=ck,
+                         checkpoint_every_steps=0)
+    np.testing.assert_array_equal(resumed, golden)
+
+
+def test_elastic_depth1_convergence_equivalence_and_partition_invariance(
+        tmp_path, chaos_reset):
+    """Depth 1 (the acceptance bar): the staged in-flight window is
+    dropped at N' != N, so bit-exactness is out by design — the pins are
+
+    1. *partition invariance*: elastic restores of the SAME checkpoint
+       fabricated as N=2 and as N=3 are bitwise identical to each other
+       (the merge consumes only global truth), and
+    2. *convergence-equivalence*: the resumed run's final loss and
+       embeddings stay within tight tolerance of the uninterrupted run
+       (loss |delta| < 0.1, mean per-row cosine > 0.97 — measured ~0.035
+       and ~0.997; everything is seeded/deterministic)."""
+    ids = _corpus()
+    d = _dict(ids)
+    gl, ge = _run_ps(ids, d, ps_pipeline_depth=1)
+    ck = str(tmp_path / "ck1")
+    _interrupt_ps(ids, d, ck, depth=1)
+    ck3 = str(tmp_path / "ck1_as3")
+    shutil.copytree(ck, ck3)
+    _fabricate_world(ck, 2)
+    _fabricate_world(ck3, 3)
+    l2, e2 = _run_ps(ids, d, ps_pipeline_depth=1, checkpoint_dir=ck,
+                     checkpoint_every_steps=0)
+    l3, e3 = _run_ps(ids, d, ps_pipeline_depth=1, checkpoint_dir=ck3,
+                     checkpoint_every_steps=0)
+    np.testing.assert_array_equal(e2, e3)  # partition invariance
+    assert l2 == l3
+    assert np.isfinite(l2) and abs(l2 - gl) < 0.1
+    num = (ge * e2).sum(1)
+    den = np.linalg.norm(ge, axis=1) * np.linalg.norm(e2, axis=1) + 1e-9
+    assert float((num / den).mean()) > 0.97
+
+
+def test_elastic_depth_flag_may_change_across_worlds(tmp_path, chaos_reset):
+    """At N' != N the staged window is dropped anyway, so the depth CHECK
+    relaxes: a depth-1 'N=2' checkpoint resumes onto a depth-0 N'=1 run
+    (and trains to completion, finitely)."""
+    ids = _corpus(seed=9, n=1200)
+    d = _dict(ids)
+    ck = str(tmp_path / "ckx")
+    _interrupt_ps(ids, d, ck, depth=1, kill_round=6, every=2)
+    _fabricate_world(ck, 2)
+    loss, emb = _run_ps(ids, d, ps_pipeline_depth=0, checkpoint_dir=ck,
+                        checkpoint_every_steps=0)
+    assert np.isfinite(loss)
+    assert np.isfinite(emb).all() and np.abs(emb).max() > 1e-3
+
+
+def test_elastic_adagrad_tables_reshard(tmp_path, chaos_reset):
+    """With -use_adagrad the g2 accumulator tables ride the same
+    re-shard path (4 weight/g2 tables + wc): depth-0 elastic resume
+    stays bit-for-bit."""
+    ids = _corpus(seed=5, n=1500)
+    d = _dict(ids)
+    _, golden = _run_ps(ids, d, use_adagrad=True)
+    ck = str(tmp_path / "cka")
+    _interrupt_ps(ids, d, ck, depth=0, kill_round=6, every=3,
+                  use_adagrad=True)
+    _fabricate_world(ck, 2)
+    _, resumed = _run_ps(ids, d, use_adagrad=True, checkpoint_dir=ck,
+                         checkpoint_every_steps=0)
+    np.testing.assert_array_equal(resumed, golden)
+
+
+# ====================================================== readiness surface
+
+
+def test_set_ready_touches_marker_and_probe_routes(tmp_path, chaos_reset):
+    """The alive/ready distinction end to end: /livez always 200,
+    /readyz 503 while restoring and 200 once ready, the MV_READY_FILE
+    marker lands on the ready transition (the supervisor's file-side
+    channel), and the failure_domain section carries ready/phase."""
+    import urllib.error
+    import urllib.request
+
+    from multiverso_tpu.resilience.watchdog import fd_stats
+    from multiverso_tpu.serving.http_health import (
+        HealthServer,
+        set_ready,
+    )
+
+    marker = str(tmp_path / "ready" / "r0.json")
+    os.environ["MV_READY_FILE"] = marker
+    try:
+        set_ready(False, phase="restoring")
+        hs = HealthServer(None, port=0)
+        try:
+            def get(route):
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{hs.port}{route}", timeout=5
+                    ) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            assert get("/livez") == (200, {"alive": True})
+            code, body = get("/readyz")
+            assert code == 503 and not body["ready"]
+            assert not os.path.exists(marker)
+            code, body = get("/healthz")
+            assert code == 200 and body["alive"] and not body["ready"]
+            assert body["phase"] == "restoring"
+            set_ready(True, phase="training")
+            code, body = get("/readyz")
+            assert code == 200 and body["ready"]
+            assert os.path.exists(marker)  # the supervisor's channel
+            assert fd_stats.to_dict()["ready"] is True
+            assert fd_stats.to_dict()["phase"] == "training"
+        finally:
+            hs.stop()
+    finally:
+        os.environ.pop("MV_READY_FILE", None)
+        set_ready(False, phase="starting")
+
+
+# ================================================== the pod supervisor
+
+_FAKE_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    mode, state_dir = sys.argv[1], sys.argv[2]
+    rank, world = int(sys.argv[3]), int(sys.argv[4])
+    gen = int(os.environ.get("MV_SUPERVISOR_GENERATION", "0"))
+
+    def beat(n, interval=0.05):
+        hb = os.path.join(state_dir, "hb")
+        os.makedirs(hb, exist_ok=True)
+        for s in range(n):
+            tmp = os.path.join(hb, f".t{rank}")
+            with open(tmp, "w") as f:
+                json.dump({"rank": rank, "seq": s, "wall": time.time()}, f)
+            os.replace(tmp, os.path.join(hb, f"hb-{rank}.json"))
+            time.sleep(interval)
+
+    def ready():
+        path = os.environ.get("MV_READY_FILE")
+        if path:
+            with open(path, "w") as f:
+                f.write("{}")
+
+    if mode == "fail_gen0":
+        if gen == 0 and rank == world - 1:
+            sys.exit(9)
+        ready()
+        sys.exit(0)
+    if mode == "always_fail":
+        sys.exit(5)
+    if mode == "succeed_at_world1":
+        sys.exit(0 if world == 1 else 4)
+    if mode == "wedge_gen0":
+        if gen == 0 and rank == 0:
+            beat(3)
+            time.sleep(60)  # alive but silent: the wedge detector kills us
+        beat(2)
+        ready()
+        sys.exit(0)
+    if mode == "report_then_wedge_gen0":
+        if gen == 0 and rank == 0:
+            ck = os.path.join(state_dir, "ck")
+            os.makedirs(ck, exist_ok=True)
+            with open(os.path.join(ck, "FAILURE-round3.json"), "w") as f:
+                json.dump({"kind": "collective_timeout"}, f)
+            time.sleep(60)  # containment ran but the exit wedged
+        ready()
+        sys.exit(0)
+    sys.exit(13)
+""")
+
+
+def _fake_pod(tmp_path, mode, **kw):
+    state = str(tmp_path / "state")
+    os.makedirs(state, exist_ok=True)
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(_FAKE_WORKER)
+
+    def make_argv(rank, world, gen, coord):
+        return [sys.executable, script, mode, state, str(rank), str(world)]
+
+    defaults = dict(
+        world=2, checkpoint_dir=str(tmp_path / "ck"),
+        heartbeat_dir=os.path.join(state, "hb"),
+        ready_dir=str(tmp_path / "ready"),
+        backoff_base_s=0.01, backoff_max_s=0.05, poll_s=0.02,
+        exit_grace_s=1.0, log_dir=str(tmp_path / "logs"),
+    )
+    defaults.update(kw)
+    return PodSupervisor(make_argv, **defaults)
+
+
+def _events(res, kind):
+    return [e for e in res.events if e["event"] == kind]
+
+
+def test_supervisor_relaunches_with_replacement_rank(tmp_path):
+    sup = _fake_pod(tmp_path, "fail_gen0", on_failure="replace",
+                    max_restarts=3)
+    res = sup.run()
+    assert res.ok and not res.gave_up
+    assert res.restarts == 1 and res.generations == 2
+    assert res.final_world == 2  # replacement rank, same world
+    fail = _events(res, "failure_detected")
+    assert len(fail) == 1 and fail[0]["rank"] == 1 and fail[0]["rc"] == 9
+    assert fail[0]["kind"] == "crash"
+    relaunch = _events(res, "relaunch")
+    assert len(relaunch) == 1 and relaunch[0]["world"] == 2
+    assert relaunch[0]["backoff_s"] > 0
+    assert _events(res, "pod_ready"), "gen-1 ready markers must be seen"
+    assert _events(res, "healthy_exit")
+    # the structured recovery log parses, in order
+    log = os.path.join(str(tmp_path / "logs"), "recovery.log.jsonl")
+    with open(log) as f:
+        kinds = [json.loads(line)["event"] for line in f]
+    assert kinds[0] == "launch" and kinds[-1] == "healthy_exit"
+    assert "failure_detected" in kinds and "relaunch" in kinds
+
+
+def test_supervisor_degrades_to_n_minus_1(tmp_path):
+    sup = _fake_pod(tmp_path, "succeed_at_world1", world=3,
+                    on_failure="degrade", min_world=1, max_restarts=5)
+    res = sup.run()
+    assert res.ok and res.final_world == 1 and res.restarts == 2
+    assert [e["world"] for e in _events(res, "relaunch")] == [2, 1]
+
+
+def test_supervisor_gives_up_after_restart_budget(tmp_path):
+    sup = _fake_pod(tmp_path, "always_fail", world=1, max_restarts=2,
+                    restart_window_s=600.0)
+    res = sup.run()
+    assert not res.ok and res.gave_up
+    assert res.generations == 3  # initial + 2 budgeted restarts
+    assert res.events[-1]["event"] == "give_up"
+    with open(os.path.join(str(tmp_path / "logs"),
+                           "RECOVERY-GIVEUP.json")) as f:
+        rep = json.load(f)
+    assert rep["gave_up"] and rep["restarts_in_window"] == 2
+    assert rep["max_restarts"] == 2 and rep["last_failure"]["rc"] == 5
+
+
+def test_supervisor_kills_wedged_rank_on_heartbeat_silence(tmp_path):
+    """A live-but-hung worker (pid up, beacons stopped) must be detected
+    via heartbeat age, killed, and relaunched — rc-watching alone would
+    wait on the 60s sleep forever."""
+    sup = _fake_pod(tmp_path, "wedge_gen0", world=1,
+                    heartbeat_deadline_s=1.5, max_restarts=3)
+    t0 = time.monotonic()
+    res = sup.run()
+    assert time.monotonic() - t0 < 45, "wedge not detected in time"
+    assert res.ok and res.restarts >= 1  # >=: a loaded box may take two
+    fail = _events(res, "failure_detected")
+    assert fail and fail[0]["kind"] == "wedged" and fail[0]["rc"] is None
+
+
+def test_supervisor_failure_report_channel_detects_wedged_exit(tmp_path):
+    """The third detection channel: containment publishes a
+    FAILURE-round<k>.json but the publisher wedges before producing an
+    rc (no heartbeats configured either) — after the exit grace the
+    supervisor must declare the failure from the report alone, kill the
+    pod and relaunch it."""
+    state = str(tmp_path / "state")
+    sup = _fake_pod(tmp_path, "report_then_wedge_gen0", world=1,
+                    checkpoint_dir=os.path.join(state, "ck"),
+                    heartbeat_dir=None, heartbeat_deadline_s=0.0,
+                    exit_grace_s=0.3, max_restarts=2)
+    t0 = time.monotonic()
+    res = sup.run()
+    assert time.monotonic() - t0 < 45, "report channel did not fire"
+    assert res.ok and res.restarts >= 1
+    fail = _events(res, "failure_detected")
+    assert fail and fail[0]["kind"] == "failure_report"
+    assert fail[0]["rc"] is None
+    assert _events(res, "failure_report")
+
+
+def test_serving_ready_defers_to_training_restore(chaos_reset):
+    """set_serving_ready (the TableServer.publish hook) must not flip a
+    process back to ready while the training path holds it in a
+    not-ready restore phase — the serve-while-train republish loop would
+    otherwise admit a mid-restore rank."""
+    from multiverso_tpu.serving.http_health import (
+        readiness,
+        set_ready,
+        set_serving_ready,
+    )
+
+    try:
+        set_ready(False, phase="restoring")
+        assert set_serving_ready() is False  # deferred
+        assert not readiness()["ready"]
+        assert readiness()["phase"] == "restoring"
+        set_ready(True, phase="training")  # restore landed
+        assert set_serving_ready() is True
+        r = readiness()
+        assert r["ready"] and r["phase"] == "serving"
+    finally:
+        set_ready(False, phase="starting")
+
+
+def test_restart_budget_window_slides():
+    t = [0.0]
+    budget = RestartBudget(max_restarts=2, window_s=100.0,
+                           base_delay_s=0.5, max_delay_s=30.0,
+                           clock=lambda: t[0])
+    assert not budget.exhausted()
+    d0 = budget.spend()
+    d1 = budget.spend()
+    assert 0.25 <= d0 <= 0.5 and 0.5 <= d1 <= 1.0  # full jitter bounds
+    assert budget.exhausted()
+    t[0] = 150.0  # both stamps age out of the window
+    assert not budget.exhausted()
+    assert budget.used() == 0
+
+
+def test_generation_env_reaches_workers(tmp_path):
+    """Chaos drills key on MV_SUPERVISOR_GENERATION (fire in gen 0 only);
+    pin that the supervisor actually exports it per generation."""
+    state = str(tmp_path / "state")
+    os.makedirs(state)
+    script = str(tmp_path / "w.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(f"""
+            import os, sys
+            gen = os.environ["{GENERATION_ENV}"]
+            with open(sys.argv[1] + "/gen-" + gen, "w") as fh:
+                fh.write(gen)
+            sys.exit(3 if gen == "0" else 0)
+        """))
+    sup = PodSupervisor(
+        lambda r, w, g, c: [sys.executable, script, state],
+        world=1, max_restarts=2, backoff_base_s=0.01, backoff_max_s=0.02,
+        poll_s=0.02, exit_grace_s=0.5, log_dir=str(tmp_path / "logs"),
+    )
+    res = sup.run()
+    assert res.ok and res.restarts == 1
+    assert os.path.exists(os.path.join(state, "gen-0"))
+    assert os.path.exists(os.path.join(state, "gen-1"))
+
+
+# ============================================= real cluster world change
+
+
+def _legacy_gloo_stack() -> bool:
+    import jax
+
+    return not hasattr(jax, "shard_map")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "new_world",
+    [1, pytest.param(4, marks=pytest.mark.skipif(
+        _legacy_gloo_stack(),
+        reason="4-process CPU-gloo clusters abort inside jaxlib's gloo "
+        "TCP transport on the legacy (pre-jax.shard_map) stack",
+    ))],
+)
+def test_cluster_checkpoint_resumes_on_different_world(tmp_path, new_world,
+                                                       chaos_reset):
+    """The real thing: a 2-proc pipelined depth-1 pod is chaos-dropped at
+    round 5 leaving a drained N=2 quorum checkpoint; the relaunch at
+    N'=new_world must elastic-resume ('resumed (elastic' marker), finish
+    cleanly on every rank, and land finite, rank-identical tables."""
+    import re
+    import socket
+
+    from test_multiprocess_e2e import _INFRA_SIGNATURES, _run_cluster
+
+    root = str(tmp_path)
+    rng = np.random.RandomState(11)
+    p = rng.randint(0, 30, 2000) * 2
+    ids = (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+    np.save(root + "/corpus.npy", ids)
+
+    def drill_once():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        procs = [
+            subprocess.Popen(
+                [sys.executable,
+                 os.path.join(_REPO, "tests", "multiprocess_ps_worker.py"),
+                 str(i), "2", coord, root + "/corpus.npy",
+                 f"{root}/emb_kill_{i}.npy", "chaos_drill", root],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=_REPO,
+            )
+            for i in range(2)
+        ]
+        outs = []
+        for pr in procs:
+            out, _ = pr.communicate(timeout=240)
+            outs.append(out.decode())
+        return [pr.returncode for pr in procs], outs
+
+    for _attempt in range(4):  # gloo infra-retry, as the drill tier does
+        rcs, outs = drill_once()
+        if rcs == [42, 137]:
+            break
+        if not any(s in o for o in outs for s in _INFRA_SIGNATURES):
+            raise AssertionError(f"drill rcs={rcs}:\n{outs[0][-2000:]}")
+        shutil.rmtree(root + "/ck", ignore_errors=True)
+        shutil.rmtree(root + "/hb", ignore_errors=True)
+    assert latest_valid(root + "/ck") is not None
+    outs = _run_cluster(
+        "multiprocess_ps_worker.py",
+        lambda i: [root + "/corpus.npy", f"{root}/emb_resume_{i}.npy",
+                   "chaos_resume", root],
+        nproc=new_world, timeout=300,
+    )
+    for o in outs:
+        assert "resumed (elastic" in o, o[-2000:]
+        assert "WORKER_OK" in o
+    e = [np.load(f"{root}/emb_resume_{i}.npy") for i in range(new_world)]
+    for q in range(1, new_world):
+        np.testing.assert_allclose(e[0], e[q], atol=1e-6)
+    assert np.isfinite(e[0]).all() and np.abs(e[0]).max() > 1e-3
+    rounds = [int(re.search(r"rounds=(\d+)", o).group(1)) for o in outs]
+    assert len(set(rounds)) == 1  # lockstep rounds at the new world

@@ -43,6 +43,18 @@ def chaos_reset():
     ResetFlagsToDefault()
 
 
+def _backdate_tree(path, seconds):
+    """Age every mtime under ``path`` so the gc age gate sees a stale
+    corpse (the sweep judges the NEWEST write anywhere in the tree)."""
+    import time as _time
+
+    old = _time.time() - seconds
+    for base, dirs, files in os.walk(path):
+        for n in dirs + files + ["."]:
+            os.utime(os.path.join(base, n), (old, old))
+    os.utime(path, (old, old))
+
+
 class FakeClock:
     def __init__(self, t: float = 0.0):
         self.t = float(t)
@@ -117,8 +129,24 @@ def test_torn_writer_chaos_leaves_only_a_tmp_corpse(tmp_path, chaos_reset):
     SetCMDFlag("chaos_torn_checkpoint", False)
     v1 = save_checkpoint(root, 1, arrays={"w": np.ones(3, np.float32)})
     assert latest_valid(root) == v1
+    # the corpse is FRESH: the age-gated sweep must leave it alone (it is
+    # indistinguishable from a sibling's in-progress staging dir under a
+    # supervisor-relaunched rank's concurrent gc)
+    gc_checkpoints(root, retain=1)
+    corpses = [n for n in os.listdir(root) if ".tmp-" in n]
+    assert corpses, "young corpse must survive the grace window"
+    # past the grace window it's a crashed save's corpse: swept
+    for n in corpses:
+        _backdate_tree(os.path.join(root, n), 3600.0)
     gc_checkpoints(root, retain=1)
     assert not [n for n in os.listdir(root) if ".tmp-" in n]  # corpse GC'd
+    # corpse_grace_s=0 restores the eager sweep explicitly
+    SetCMDFlag("chaos_torn_checkpoint", True)
+    with pytest.raises(ChaosInterrupt):
+        save_checkpoint(root, 2, arrays={"w": np.ones(3, np.float32)})
+    SetCMDFlag("chaos_torn_checkpoint", False)
+    gc_checkpoints(root, retain=1, corpse_grace_s=0.0)
+    assert not [n for n in os.listdir(root) if ".tmp-" in n]
 
 
 def test_corruption_chaos_is_detected(tmp_path, chaos_reset):
@@ -140,6 +168,121 @@ def test_gc_retains_newest_valid(tmp_path):
     os.remove(os.path.join(root, "ckpt-5", "MANIFEST.json"))
     gc_checkpoints(root, retain=2)
     assert [s for s, _ in list_checkpoints(root)] == [4]
+
+
+_RACING_READER = """
+import sys
+
+sys.path.insert(0, {repo!r})
+from multiverso_tpu.resilience import (
+    gc_checkpoints,
+    latest_valid,
+    load_checkpoint,
+)
+
+root = sys.argv[1]
+for _ in range(150):
+    p = latest_valid(root)
+    assert p is not None, "no valid version visible"
+    assert ".tmp-" not in p and ".old-" not in p, p
+    arrays, meta = load_checkpoint(p)  # dies with ONE FatalError on torn
+    assert "w" in arrays
+    # supervisor-relaunch shape: this process ALSO runs gc concurrently
+    gc_checkpoints(root, retain=10)
+print("READER_OK")
+"""
+
+
+def test_latest_valid_restore_race_under_concurrent_restarts(tmp_path):
+    """Supervisor-style concurrent restarts (ISSUE 7 satellite): two
+    racing processes loop discovery + restore + gc while this process
+    keeps publishing new versions, torn versions and corpses —
+
+    * a reader never observes a torn/half-renamed version (atomic
+      publish + manifest verification), including torn versions that are
+      NEWER than every valid one;
+    * a fresh ``.tmp-`` staging dir (a sibling's in-flight quorum save)
+      survives every concurrent sweep (the mtime grace gate), while a
+      stale corpse is swept exactly once with no sweeper crashing
+      (rmtree races resolve silently — never a double-sweep error)."""
+    import time
+
+    root = str(tmp_path / "ck")
+    save_checkpoint(root, 1, arrays={"w": np.ones(256, np.float32)})
+    # a sibling's in-progress staging dir: fresh mtime, partial payload
+    live_stage = os.path.join(root, "ckpt-999.tmp-livestage")
+    os.makedirs(live_stage)
+    with open(os.path.join(live_stage, "partial.bin"), "wb") as f:
+        f.write(b"x" * 128)
+    # a crashed save's corpse: same shape, but STALE
+    dead_stage = os.path.join(root, "ckpt-998.tmp-deadstage")
+    os.makedirs(dead_stage)
+    with open(os.path.join(dead_stage, "partial.bin"), "wb") as f:
+        f.write(b"y" * 128)
+    _backdate_tree(dead_stage, 3600.0)
+    readers = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RACING_READER.format(repo=_REPO), root],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for _ in range(2)
+    ]
+    try:
+        for s in range(2, 12):
+            save_checkpoint(root, s, arrays={"w": np.ones(256, np.float32)})
+            # torn version NEWER than every valid one: discovery must
+            # skip it, never return it
+            torn = os.path.join(root, f"ckpt-{5000 + s}")
+            os.makedirs(torn, exist_ok=True)
+            with open(os.path.join(torn, "arrays.npz"), "wb") as f:
+                f.write(b"torn")
+            gc_checkpoints(root, retain=10)
+            time.sleep(0.02)
+    finally:
+        outs = []
+        for r in readers:
+            out, _ = r.communicate(timeout=120)
+            outs.append(out.decode())
+    for i, (r, out) in enumerate(zip(readers, outs)):
+        assert r.returncode == 0, f"reader {i} crashed:\n{out[-2000:]}"
+        assert "READER_OK" in out
+    # the live staging dir survived every racing sweeper
+    assert os.path.isdir(live_stage), os.listdir(root)
+    # the stale corpse is gone (someone swept it; nobody crashed doing so)
+    assert not os.path.exists(dead_stage)
+
+
+def test_gc_never_sweeps_fresh_staging_even_from_two_sweepers(tmp_path):
+    """The narrow double-sweep race: two concurrent gc passes over the
+    same root with a fresh staging dir — both must leave it, and both
+    must survive racing rmtrees of the same stale corpse."""
+    import threading
+
+    root = str(tmp_path / "ck")
+    save_checkpoint(root, 1, arrays={"w": np.ones(8, np.float32)})
+    fresh = os.path.join(root, "ckpt-7.tmp-fresh")
+    os.makedirs(fresh)
+    open(os.path.join(fresh, "payload"), "w").write("p")
+    stale = os.path.join(root, "ckpt-6.tmp-stale")
+    os.makedirs(stale)
+    open(os.path.join(stale, "payload"), "w").write("p")
+    _backdate_tree(stale, 3600.0)
+    errs = []
+
+    def sweep():
+        try:
+            gc_checkpoints(root, retain=1)
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            errs.append(e)
+
+    threads = [threading.Thread(target=sweep) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert os.path.isdir(fresh)
+    assert not os.path.exists(stale)
 
 
 def test_checkpoint_policy_and_autocheckpointer(tmp_path):

@@ -89,3 +89,47 @@ def test_ma_mode_rejects_tables():
     finally:
         mv.MV_ShutDown(finalize=True)
         ResetFlagsToDefault()
+
+
+def test_compilation_cache_is_namespaced_per_topology(tmp_path):
+    """The persistent compilation cache must not mix executables across
+    runtime configurations (ISSUE 7 find): jaxlib's disk-cache key does
+    not cover the CPU collectives implementation / dispatch mode / world
+    size, and a supervisor relaunching one checkout at a different world
+    size would poison the cache across topologies — a 1-proc run loading
+    a 2-proc-gloo-compiled executable of the same program trains to
+    DIFFERENT values (reduction order is baked into the executable).
+    Pin: two processes with different device counts resolve to different
+    namespace subdirectories under the same MV_JAX_CACHE_DIR root."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    probe = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=" + sys.argv[1]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import multiverso_tpu as mv
+mv.MV_Init(["prog"])
+print("CACHE_DIR=" + (jax.config._read("jax_compilation_cache_dir") or ""))
+mv.MV_ShutDown()
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dirs = {}
+    for devices in ("2", "4"):
+        out = subprocess.run(
+            [sys.executable, "-c", probe.format(repo=repo), devices],
+            capture_output=True, timeout=180,
+            env={**os.environ, "MV_JAX_CACHE_DIR": str(tmp_path)},
+        )
+        assert out.returncode == 0, out.stderr.decode()[-2000:]
+        line = [ln for ln in out.stdout.decode().splitlines()
+                if ln.startswith("CACHE_DIR=")][0]
+        dirs[devices] = line[len("CACHE_DIR="):]
+    assert dirs["2"] != dirs["4"], dirs
+    for devices, d in dirs.items():
+        assert str(tmp_path) in d and f"-d{devices}" in os.path.basename(d), d
