@@ -1626,6 +1626,25 @@ def _probe_backend(timeout_s: int = 180):
     return None
 
 
+def _bench_lint():
+    """Analyzer cost tracking (mvlint): run the static-analysis stage
+    over the package and record its runtime + finding counts, so the CI
+    lint gate's cost rides the bench trajectory like every other
+    subsystem."""
+    import os
+
+    from multiverso_tpu.analysis.mvlint import run_lint
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    res = run_lint([os.path.join(root, "multiverso_tpu")])
+    return {
+        "lint_runtime_s": round(res.runtime_s, 3),
+        "lint_files": res.files,
+        "lint_findings": len(res.findings),
+        "lint_findings_suppressed": len(res.suppressed),
+    }
+
+
 def main():
     import sys as _sys
 
@@ -1651,6 +1670,11 @@ def main():
         return out
 
     mv.MV_Init(["-updater_type=sgd"])
+    try:
+        lint = leg("lint", _bench_lint)
+    except Exception as e:
+        print(f"# leg lint FAILED: {e}", file=_sys.stderr, flush=True)
+        lint = {"lint_error": str(e)[:200]}
     cfg = SkipGramConfig(vocab_size=100_000, dim=128, negatives=5)
     # headline: the app's default training config on REALISTIC skewed ids
     # (centers ~ unigram, negatives ~ unigram^3/4 — duplicated hot rows).
@@ -1747,6 +1771,7 @@ def main():
     out.update(resilience)
     out.update(e2e)
     out.update(quality)
+    out.update(lint)
     print(json.dumps(out))
     mv.MV_ShutDown()
 

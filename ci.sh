@@ -11,6 +11,13 @@ echo "== native build (cmake) =="
 cmake -S . -B build >/dev/null
 cmake --build build --parallel
 
+echo "== mvlint static analysis (analysis/RULES.md) =="
+# repo-aware AST rules R1-R5 (collective-dispatch threading, lock order,
+# flag hygiene, thread lifecycle, exact-path determinism) — fails on ANY
+# unsuppressed finding; the checked-in baseline is empty by contract, so
+# this is "the tree lints clean", not "the tree matches a snapshot"
+python -m multiverso_tpu.analysis multiverso_tpu/
+
 echo "== unit + integration tests (8-device CPU mesh) =="
 # the fused Pallas train-step suite (tests/test_fused_step.py) runs here
 # in INTERPRET mode — the kernel logic is tier-1 on CPU, never TPU-gated;

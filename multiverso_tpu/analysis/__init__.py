@@ -1,0 +1,40 @@
+"""Static analysis (``mvlint``) + runtime concurrency guards.
+
+``python -m multiverso_tpu.analysis multiverso_tpu/`` runs the five
+repo-aware rules (R1 collective-dispatch-thread, R2 lock-order, R3 flag
+hygiene, R4 thread lifecycle, R5 nondeterminism-in-exact-paths) described
+in ``analysis/RULES.md``; the paired runtime guards live in
+:mod:`multiverso_tpu.analysis.guards` behind ``-debug_thread_guards``.
+
+This ``__init__`` stays import-light on purpose: the tables import the
+guard decorators from here at module load, and must not drag the whole
+AST engine (or anything heavier) with them.
+"""
+
+from multiverso_tpu.analysis.guards import (  # noqa: F401
+    GuardViolation,
+    OrderedLock,
+    allow_collective_dispatch,
+    collective_dispatch,
+    register_comms_thread,
+    register_training_thread,
+    unregister_comms_thread,
+)
+
+__all__ = [
+    "GuardViolation",
+    "OrderedLock",
+    "allow_collective_dispatch",
+    "collective_dispatch",
+    "register_comms_thread",
+    "register_training_thread",
+    "unregister_comms_thread",
+    "run_lint",
+]
+
+
+def run_lint(*args, **kwargs):
+    """Lazy forward to :func:`multiverso_tpu.analysis.mvlint.run_lint`."""
+    from multiverso_tpu.analysis.mvlint import run_lint as _run
+
+    return _run(*args, **kwargs)

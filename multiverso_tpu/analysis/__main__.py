@@ -1,0 +1,115 @@
+"""CLI: ``python -m multiverso_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = bad
+invocation/baseline. ``--json`` emits the machine-readable summary the
+bench leg records; ``--flag-table`` regenerates the DEPLOY.md flag
+reference from the AST (no imports executed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+
+from multiverso_tpu.analysis import mvlint
+
+
+def _flag_table(paths) -> str:
+    """Markdown table of every ``MV_DEFINE_*`` flag (AST scan)."""
+    rows = []
+    for fp in mvlint._iter_py_files(paths):
+        with open(fp, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=fp)
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else getattr(
+                fn, "attr", ""
+            )
+            if not name.startswith("MV_DEFINE_"):
+                continue
+            a0 = node.args[0]
+            if not (isinstance(a0, ast.Constant)
+                    and isinstance(a0.value, str)):
+                continue
+            typ = name.replace("MV_DEFINE_", "")
+            default = ""
+            if len(node.args) > 1:
+                try:
+                    default = ast.unparse(node.args[1])
+                except Exception:  # noqa: BLE001
+                    default = "?"
+            help_ = ""
+            if len(node.args) > 2 and isinstance(node.args[2], ast.Constant):
+                help_ = str(node.args[2].value)
+            for kw in node.keywords:
+                if kw.arg == "help" and isinstance(kw.value, ast.Constant):
+                    help_ = str(kw.value.value)
+                if kw.arg == "default":
+                    try:
+                        default = ast.unparse(kw.value)
+                    except Exception:  # noqa: BLE001
+                        default = "?"
+            help_ = " ".join(help_.split())
+            if len(help_) > 160:
+                help_ = help_[:157] + "..."
+            rows.append((a0.value, typ, default, help_))
+    rows.sort()
+    out = ["| flag | type | default | meaning |",
+           "|---|---|---|---|"]
+    for name, typ, default, help_ in rows:
+        out.append(
+            f"| `-{name}` | {typ} | `{default}` | "
+            f"{help_.replace('|', '/')} |"
+        )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m multiverso_tpu.analysis",
+        description="mvlint: repo-aware static analysis (see "
+                    "analysis/RULES.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=["multiverso_tpu"],
+                    help="files/directories to lint")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: analysis/baseline.toml)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary on stdout")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print suppressed findings")
+    ap.add_argument("--flag-table", action="store_true",
+                    help="emit the markdown MV_DEFINE flag reference "
+                         "and exit")
+    args = ap.parse_args(argv)
+    paths = args.paths or ["multiverso_tpu"]
+    if args.flag_table:
+        print(_flag_table(paths))
+        return 0
+    try:
+        result = mvlint.run_lint(paths, baseline_path=args.baseline)
+    except ValueError as e:  # malformed baseline
+        print(f"mvlint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({
+            "files": result.files,
+            "findings": len(result.findings),
+            "suppressed": len(result.suppressed),
+            "runtime_s": round(result.runtime_s, 3),
+            "rules": sorted({f.rule for f in result.findings}),
+        }))
+    else:
+        print(mvlint.format_findings(result, verbose=args.verbose))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,303 @@
+"""Runtime concurrency guards — the dynamic half of ``mvlint``.
+
+The static rules in :mod:`multiverso_tpu.analysis.rules` prove properties
+about the code that *can* be proven without running it; this module holds
+the runtime assertions they pair with, all gated behind the
+``-debug_thread_guards`` flag (default: off, or the value of the
+``MV_DEBUG_THREAD_GUARDS`` env var — the tier-1 test suite exports it so
+every threaded test runs with the guards armed):
+
+* ``@collective_dispatch`` (pairs with rule **R1**) tags the table
+  get/add/allgather entry points. Multi-device collective programs
+  dispatched concurrently from two threads can invert per-device launch
+  order and deadlock XLA's rendezvous (the PR 6 prefetch deadlock), so
+  with the flag on every tagged call asserts it runs on an allowed
+  thread: the ``TaskPipe`` comms worker, the registered training thread,
+  the main thread, or inside an explicit ``allow_collective_dispatch``
+  sync point. A violation raises a structured :class:`GuardViolation`
+  *immediately* — a one-line error instead of a pod-scale hang.
+
+* ``OrderedLock`` (pairs with rule **R2**) wraps the repo's cross-thread
+  locks (tiered-table tier lock, batcher mutex, snapshot swap, heartbeat
+  store). With the flag on, every acquisition records the held->acquired
+  edge in a process-wide order graph; an acquisition that inverts a
+  previously recorded order raises :class:`GuardViolation` at the exact
+  second acquisition — deterministic detection of a deadlock that would
+  otherwise need the losing interleaving to strike.
+
+Both guards are no-ops (one flag read) when the flag is off, so the
+production hot path pays nothing measurable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from functools import wraps
+from typing import Dict, Optional, Set, Tuple
+
+from multiverso_tpu.utils.configure import (
+    GetFlag,
+    MV_DEFINE_bool,
+    mutation_count,
+)
+
+__all__ = [
+    "GuardViolation",
+    "collective_dispatch",
+    "allow_collective_dispatch",
+    "register_comms_thread",
+    "unregister_comms_thread",
+    "register_training_thread",
+    "OrderedLock",
+    "guards_enabled",
+    "reset_lock_order_graph",
+]
+
+# env-derived default (not a plain False): tests call
+# ResetFlagsToDefault() liberally, and the tier-1 contract is "guards ON
+# for the whole suite" — the default must survive a reset.
+MV_DEFINE_bool(
+    "debug_thread_guards",
+    os.environ.get("MV_DEBUG_THREAD_GUARDS", "") == "1",
+    "arm the runtime concurrency guards: @collective_dispatch thread "
+    "identity asserts + OrderedLock lock-order inversion detection "
+    "(GuardViolation instead of a deadlock; see analysis/RULES.md)",
+)
+
+
+_enabled_cache: Optional[bool] = None
+_enabled_gen = -1
+
+
+def guards_enabled() -> bool:
+    """Lock-free on the hot path: every tagged table op and every
+    OrderedLock acquire/release calls this, so it must NOT funnel the
+    whole process through the flag registry's global mutex. The value is
+    cached against the registry's mutation counter and re-read only when
+    a flag actually changed (SetCMDFlag/ParseCMDFlags/Reset)."""
+    global _enabled_cache, _enabled_gen
+    gen = mutation_count()
+    if _enabled_cache is None or _enabled_gen != gen:
+        _enabled_cache = bool(GetFlag("debug_thread_guards"))
+        _enabled_gen = gen
+    return _enabled_cache
+
+
+class GuardViolation(RuntimeError):
+    """Structured runtime-guard failure.
+
+    ``kind``: ``collective_dispatch`` (R1 — tagged entry point invoked
+    from a rogue thread) or ``lock_order`` (R2 — lock acquisition that
+    inverts a recorded order). Raised at the violating call, on the
+    violating thread — never a hang."""
+
+    def __init__(self, kind: str, message: str, *, thread: str = "",
+                 entry: str = ""):
+        super().__init__(f"[{kind}] {message}")
+        self.kind = kind
+        self.thread = thread
+        self.entry = entry
+
+
+# --------------------------------------------------- dispatch-thread guard
+
+_comms_threads: Set[int] = set()
+_comms_lock = threading.Lock()
+_training_thread: Optional[int] = None
+_tls = threading.local()
+
+
+def register_comms_thread() -> None:
+    """Called by the ``TaskPipe`` worker at thread start: tasks executed
+    on the pipe ARE the documented collective-dispatch channel."""
+    with _comms_lock:
+        _comms_threads.add(threading.get_ident())
+
+
+def unregister_comms_thread() -> None:
+    with _comms_lock:
+        _comms_threads.discard(threading.get_ident())
+
+
+def register_training_thread() -> None:
+    """Declare the calling thread as THE training thread (the depth-0 PS
+    sync points and the host-batch loops dispatch collectives from it).
+    Training entry points (``WordEmbedding.train``, ``LogReg.Train``)
+    call this, so a demo/test that runs training off the main thread
+    stays within the guard's contract. Last registration wins — there is
+    one training loop per process."""
+    global _training_thread
+    _training_thread = threading.get_ident()
+
+
+@contextmanager
+def allow_collective_dispatch(reason: str):
+    """Explicit, documented sync point: allow tagged entry points on the
+    current thread for the duration of the block. ``reason`` is required
+    — it is the justification string a reviewer greps for."""
+    if not reason:
+        raise ValueError("allow_collective_dispatch requires a reason")
+    depth = getattr(_tls, "allow_depth", 0)
+    _tls.allow_depth = depth + 1
+    try:
+        yield
+    finally:
+        _tls.allow_depth = depth
+
+
+def _check_dispatch_thread(entry: str) -> None:
+    ident = threading.get_ident()
+    with _comms_lock:
+        if ident in _comms_threads:
+            return
+    if getattr(_tls, "allow_depth", 0) > 0:
+        return
+    if _training_thread is not None and ident == _training_thread:
+        return
+    cur = threading.current_thread()
+    if cur is threading.main_thread():
+        return
+    raise GuardViolation(
+        "collective_dispatch",
+        f"{entry} dispatched from thread {cur.name!r} — collective table "
+        "ops may only run on the TaskPipe comms worker or the training "
+        "thread (concurrent multi-device dispatch can invert per-device "
+        "launch order and deadlock XLA's rendezvous). Route the call "
+        "through the comms TaskPipe, or wrap a documented sync point in "
+        "allow_collective_dispatch(reason).",
+        thread=cur.name,
+        entry=entry,
+    )
+
+
+def collective_dispatch(fn):
+    """Tag a table collective entry point (R1's ground truth). With
+    ``-debug_thread_guards`` on, asserts the dispatching thread identity;
+    otherwise the only cost is one flag read."""
+
+    @wraps(fn)
+    def wrapper(*args, **kwargs):
+        if guards_enabled():
+            _check_dispatch_thread(fn.__qualname__)
+        return fn(*args, **kwargs)
+
+    wrapper.__mv_collective_dispatch__ = True
+    return wrapper
+
+
+# --------------------------------------------------------- lock-order guard
+
+# process-wide acquisition-order graphs, both (held, acquired) -> first
+# thread name: one over lock CLASS names, one over instance uids (two
+# locks of the same class — e.g. every table's tier lock shares
+# "tiered_table._tier_lock" — still need a consistent relative order)
+_order_edges: Dict[Tuple[str, str], str] = {}
+_order_edges_inst: Dict[Tuple[int, int], str] = {}
+_order_mutex = threading.Lock()
+_uid_counter = 0
+
+
+def reset_lock_order_graph() -> None:
+    """Test isolation: forget every recorded edge."""
+    with _order_mutex:
+        _order_edges.clear()
+        _order_edges_inst.clear()
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "lock_stack", None)
+    if stack is None:
+        stack = []
+        _tls.lock_stack = stack
+    return stack
+
+
+class OrderedLock:
+    """Drop-in ``threading.Lock``/``RLock`` wrapper that records the
+    lock-acquisition order per thread and raises :class:`GuardViolation`
+    on an inversion (A held while taking B after B-held-while-taking-A
+    was ever recorded, in any thread) — across lock classes by NAME and
+    across same-named instances by a process-unique uid, so two tables'
+    tier locks nested in opposite orders are caught too. Flag off: pure
+    delegation (the stack pop itself is unconditional, so toggling the
+    flag while a lock is held cannot corrupt the held-stack)."""
+
+    def __init__(self, name: str, recursive: bool = False):
+        global _uid_counter
+        self.name = name
+        self._recursive = recursive
+        self._lock = threading.RLock() if recursive else threading.Lock()
+        with _order_mutex:
+            _uid_counter += 1
+            # never-reused (unlike id()): a GC'd lock's slot in the
+            # instance-order graph must not be inherited by a new lock
+            self._uid = _uid_counter
+
+    def _raise_inversion(self, held_name: str, thread: str) -> None:
+        raise GuardViolation(
+            "lock_order",
+            f"lock order inversion: acquiring {self.name!r} while "
+            f"holding {held_name!r} on thread {thread!r}, but the "
+            "opposite order was recorded earlier — a deadlock waiting "
+            "for the losing interleaving. Pick one order (see "
+            "analysis/RULES.md R2).",
+            thread=thread,
+            entry=self.name,
+        )
+
+    def _record(self) -> None:
+        stack = _held_stack()  # entries: (name, uid)
+        if any(uid == self._uid for _n, uid in stack):
+            # true re-entry of THIS instance (recursive locks)
+            stack.append((self.name, self._uid))
+            return
+        thread = threading.current_thread().name
+        with _order_mutex:
+            for held_name, held_uid in stack:
+                if held_name != self.name:
+                    if (self.name, held_name) in _order_edges:
+                        self._raise_inversion(held_name, thread)
+                    _order_edges.setdefault(
+                        (held_name, self.name), thread
+                    )
+                else:
+                    # same class, different instance: order by uid
+                    if (self._uid, held_uid) in _order_edges_inst:
+                        self._raise_inversion(
+                            f"{held_name}#{held_uid}", thread
+                        )
+                    _order_edges_inst.setdefault(
+                        (held_uid, self._uid), thread
+                    )
+        stack.append((self.name, self._uid))
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok and guards_enabled():
+            try:
+                self._record()
+            except GuardViolation:
+                self._lock.release()
+                raise
+        return ok
+
+    def release(self) -> None:
+        # pop unconditionally: if the flag was disarmed while this lock
+        # was held, the acquire-time stack entry must still come off, or
+        # it would poison every later order check on this thread
+        stack = getattr(_tls, "lock_stack", None)
+        if stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][1] == self._uid:
+                    del stack[i]
+                    break
+        self._lock.release()
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

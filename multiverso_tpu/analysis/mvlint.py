@@ -1,0 +1,321 @@
+"""mvlint — repo-aware static analysis engine.
+
+Pure stdlib (``ast`` + a minimal TOML-subset reader; the pinned
+interpreter is 3.10, before ``tomllib``). The engine parses every target
+file once, hands the module set to each rule in
+:mod:`multiverso_tpu.analysis.rules`, filters the findings through inline
+pragmas and the checked-in ``analysis/baseline.toml``, and renders
+``path:line: RULE message`` lines with a one-line fix hint.
+
+Suppression channels (both require a justification):
+
+* inline: ``# mvlint: allow[R4] <why>`` on the finding line;
+* baseline: a ``[[suppress]]`` entry in ``baseline.toml`` with ``rule``,
+  ``path`` (substring of the repo-relative path), optional ``contains``
+  (substring of the message) and a mandatory ``reason``.
+
+The baseline starts — and should stay — empty: the repo lints clean, and
+new findings are fixed, not suppressed (analysis/RULES.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "Module",
+    "LintConfig",
+    "LintResult",
+    "run_lint",
+    "load_baseline",
+    "format_findings",
+]
+
+_PRAGMA_RE = re.compile(r"#\s*mvlint:\s*allow\[(R\d|\*)\]\s*(\S.*)?$")
+_EXACT_MARKER_RE = re.compile(r"#\s*mvlint:\s*exact-module\b")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative (display) path
+    line: int
+    message: str
+    hint: str = ""
+    suppressed_by: str = ""  # "", "pragma", or the baseline reason
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+class Module:
+    """One parsed source file plus the lexical facts rules keep asking
+    for: the raw lines (pragma scan), every function def (including
+    nested) indexed by name, and class membership for methods."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.exact_marker = any(
+            _EXACT_MARKER_RE.search(ln) for ln in self.lines[:30]
+        )
+        # name -> [(class_name or "", FunctionDef)]
+        self.functions: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        self._index_functions()
+
+    def _index_functions(self) -> None:
+        def visit(node, cls: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self.functions.setdefault(child.name, []).append(
+                        (cls, child)
+                    )
+                    visit(child, cls)
+                else:
+                    visit(child, cls)
+
+        visit(self.tree, "")
+
+    def lookup_method(self, cls: str, name: str) -> Optional[ast.AST]:
+        for c, fn in self.functions.get(name, ()):
+            if c == cls:
+                return fn
+        return None
+
+    def pragma_for_line(self, line: int) -> Optional[Tuple[str, str]]:
+        """``(rule, justification)`` if the line (or the line above it)
+        carries an ``# mvlint: allow[...]`` pragma."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _PRAGMA_RE.search(self.lines[ln - 1])
+                if m:
+                    return m.group(1), (m.group(2) or "").strip()
+        return None
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Engine knobs. ``aux_read_roots`` widens rule R3's *read* index
+    (flags may legitimately be consumed only by the bench/tests/deploy
+    drivers); ``doc_files`` is where user-facing flags must be
+    documented (empty disables the doc check — fixture runs)."""
+
+    aux_read_roots: Sequence[str] = ()
+    doc_files: Sequence[str] = ()
+    repo_root: str = ""
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    files: int
+    runtime_s: float
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(dirpath, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def _find_repo_root(start: str) -> str:
+    """Nearest ancestor holding the package marker — anchors relative
+    display paths and the default doc/aux locations."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.isfile(os.path.join(cur, "multiverso_tpu", "__init__.py")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start if os.path.isdir(start) else ".")
+        cur = parent
+
+
+def default_config(paths: Sequence[str]) -> LintConfig:
+    """The repo run's configuration: aux read roots + doc files resolved
+    relative to the detected repo root, included only when present."""
+    root = _find_repo_root(paths[0] if paths else ".")
+    aux = [
+        os.path.join(root, p)
+        for p in ("tests", "examples", "deploy", "bench.py", "ci.sh")
+        if os.path.exists(os.path.join(root, p))
+    ]
+    docs = [
+        os.path.join(root, p)
+        for p in ("README.md", "DEPLOY.md")
+        if os.path.exists(os.path.join(root, p))
+    ]
+    return LintConfig(aux_read_roots=aux, doc_files=docs, repo_root=root)
+
+
+# ----------------------------------------------------------- baseline.toml
+
+_TOML_KV_RE = re.compile(r"""^([A-Za-z_][A-Za-z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*$""")
+
+
+def load_baseline(path: str) -> List[Dict[str, str]]:
+    """Read ``baseline.toml``'s ``[[suppress]]`` entries. Supported
+    subset: ``[[suppress]]`` table headers with ``key = "string"`` lines
+    and ``#`` comments — exactly what the suppression schema needs on a
+    3.10 interpreter without ``tomllib`` (and valid TOML throughout, so
+    real parsers read it too)."""
+    if not os.path.exists(path):
+        return []
+    entries: List[Dict[str, str]] = []
+    cur: Optional[Dict[str, str]] = None
+    with open(path, encoding="utf-8") as fh:
+        for ln, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[suppress]]":
+                cur = {}
+                entries.append(cur)
+                continue
+            m = _TOML_KV_RE.match(line)
+            if m and cur is not None:
+                cur[m.group(1)] = m.group(2).encode().decode(
+                    "unicode_escape"
+                )
+                continue
+            raise ValueError(
+                f"{path}:{ln}: unsupported baseline syntax {line!r} "
+                "(only [[suppress]] tables with string keys)"
+            )
+    for i, e in enumerate(entries):
+        if not e.get("rule") or not e.get("path") or not e.get("reason"):
+            raise ValueError(
+                f"{path}: suppress entry #{i + 1} needs rule, path AND "
+                "a justification reason"
+            )
+    return entries
+
+
+def _apply_suppressions(
+    findings: List[Finding],
+    modules: Dict[str, Module],
+    baseline: List[Dict[str, str]],
+) -> Tuple[List[Finding], List[Finding]]:
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        mod = modules.get(f.path)
+        pragma = mod.pragma_for_line(f.line) if mod else None
+        if pragma and pragma[0] in (f.rule, "*") and pragma[1]:
+            f.suppressed_by = f"pragma: {pragma[1]}"
+            suppressed.append(f)
+            continue
+        hit = None
+        for e in baseline:
+            if e["rule"] not in (f.rule, "*"):
+                continue
+            if e["path"] not in f.path:
+                continue
+            if e.get("contains") and e["contains"] not in f.message:
+                continue
+            hit = e
+            break
+        if hit is not None:
+            f.suppressed_by = f"baseline: {hit['reason']}"
+            suppressed.append(f)
+        else:
+            live.append(f)
+    return live, suppressed
+
+
+# ------------------------------------------------------------------ driver
+
+def run_lint(
+    paths: Sequence[str],
+    config: Optional[LintConfig] = None,
+    baseline_path: Optional[str] = None,
+) -> LintResult:
+    from multiverso_tpu.analysis import rules as rules_mod
+
+    t0 = time.perf_counter()
+    cfg = config if config is not None else default_config(paths)
+    root = cfg.repo_root or _find_repo_root(paths[0] if paths else ".")
+    files = _iter_py_files(paths)
+    modules: Dict[str, Module] = {}
+    findings: List[Finding] = []
+    for fp in files:
+        rel = os.path.relpath(fp, root)
+        if rel.startswith(".."):
+            rel = fp
+        try:
+            with open(fp, encoding="utf-8") as fh:
+                src = fh.read()
+            modules[rel.replace(os.sep, "/")] = Module(fp, rel, src)
+        except (SyntaxError, ValueError) as e:
+            # ValueError too: NUL bytes raise it (not SyntaxError) on
+            # 3.10 — one unparseable file is a per-file R0 finding, not
+            # an aborted run
+            findings.append(Finding(
+                "R0", rel.replace(os.sep, "/"),
+                getattr(e, "lineno", 0) or 0,
+                f"unparseable source: {getattr(e, 'msg', None) or e}",
+                "mvlint needs parseable sources",
+            ))
+    mods = list(modules.values())
+    for rule_fn in rules_mod.ALL_RULES:
+        findings.extend(rule_fn(mods, cfg))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    if baseline_path is None:
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "baseline.toml"
+        )
+    baseline = load_baseline(baseline_path)
+    live, suppressed = _apply_suppressions(findings, modules, baseline)
+    return LintResult(
+        findings=live,
+        suppressed=suppressed,
+        files=len(files),
+        runtime_s=time.perf_counter() - t0,
+    )
+
+
+def format_findings(result: LintResult, verbose: bool = False) -> str:
+    out = [f.render() for f in result.findings]
+    if verbose:
+        for f in result.suppressed:
+            out.append(f"[suppressed: {f.suppressed_by}] {f.render()}")
+    out.append(
+        f"mvlint: {len(result.findings)} finding(s) "
+        f"({len(result.suppressed)} suppressed) across "
+        f"{result.files} file(s) in {result.runtime_s:.2f}s"
+    )
+    return "\n".join(out)

@@ -1,0 +1,679 @@
+"""mvlint rules R1-R5 — the invariant classes PRs 2-7 paid for at runtime.
+
+Each rule is a function ``(modules, config) -> [Finding]``. The rules are
+deliberately repo-aware: they know the table entry points, the named
+locks, the flag registry idioms (``MV_DEFINE_*`` / ``GetFlag`` /
+``WEOptions.from_flags``) and the bit-exactness scopes. Approximations
+are documented in ``analysis/RULES.md`` — every one errs toward the
+runtime guards in :mod:`multiverso_tpu.analysis.guards` catching what
+static analysis cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from multiverso_tpu.analysis.mvlint import Finding, LintConfig, Module
+
+# --------------------------------------------------------------- shared
+
+# entry-point names too generic for name-based call-graph propagation
+# (every dict has .get, every list has .pop) — the RUNTIME guard still
+# covers them; only the static reachability pass skips them.
+AMBIGUOUS_DISPATCH_NAMES = {
+    "get", "add", "load", "store", "items", "wait", "pop", "push",
+    "update", "flush", "close",
+}
+
+# table collective entry points that MUST carry @collective_dispatch
+# (file suffix -> class -> methods). Subclass overrides that call
+# ``super()`` inherit the guard through the decorated base method.
+REQUIRED_DISPATCH: Dict[str, Dict[str, Tuple[str, ...]]] = {
+    "multiverso_tpu/tables/base.py": {
+        "DenseTable": ("get_async", "add", "add_per_worker"),
+    },
+    "multiverso_tpu/tables/matrix_table.py": {
+        "MatrixTable": (
+            "get_rows_async", "get_rows_fixed", "add_rows",
+            "get_rows_local", "add_rows_local", "add_rows_local_packed",
+            "add_rows_per_worker", "round_bucket",
+        ),
+    },
+    "multiverso_tpu/tables/kv_table.py": {
+        "KVTable": ("get", "add", "get_local", "add_local"),
+    },
+    "multiverso_tpu/tables/sparse_matrix_table.py": {
+        "SparseMatrixTable": ("get_stale_rows_local",),
+    },
+}
+
+# modules whose own threads ARE the sanctioned dispatch machinery
+THREAD_ENTRY_ALLOW = ("multiverso_tpu/utils/async_buffer.py",)
+
+# R5 scope: bit-exactness contract modules (whole file) ...
+EXACT_PATH_PARTS = ("multiverso_tpu/tables/", "multiverso_tpu/io/")
+# ... plus the PS round loop inside the app (function-name prefixes)
+EXACT_FUNCTION_PREFIXES = {
+    "multiverso_tpu/models/wordembedding/app.py": (
+        "_ps_", "_wc_", "_train_ps",
+    ),
+}
+
+_LOCK_ATTR_RE = re.compile(r"lock|mutex|_mu$")
+
+
+def _name_of_call(func: ast.AST) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _unparse(node: Optional[ast.AST]) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — display only
+        return ""
+
+
+def _has_dispatch_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _name_of_call(target) == "collective_dispatch" or (
+            isinstance(target, ast.Name)
+            and target.id == "collective_dispatch"
+        ):
+            return True
+    return False
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            n = _name_of_call(node.func)
+            if n:
+                out.add(n)
+    return out
+
+
+def _reach(module: Module, roots: Iterable[ast.AST]) -> Set[str]:
+    """Transitive closure of called names, resolving through same-module
+    function definitions (name-based — mvlint's documented approximation)."""
+    seen_fns: Set[int] = set()
+    names: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        fn = stack.pop()
+        if id(fn) in seen_fns:
+            continue
+        seen_fns.add(id(fn))
+        for n in _called_names(fn):
+            names.add(n)
+            for _cls, callee in module.functions.get(n, ()):
+                stack.append(callee)
+    return names
+
+
+# ------------------------------------------------------------------- R1
+
+def rule_r1_collective_dispatch(
+    modules: Sequence[Module], cfg: LintConfig
+) -> List[Finding]:
+    findings: List[Finding] = []
+    # sink names = every @collective_dispatch-tagged function in the scan
+    sinks: Set[str] = set()
+    for m in modules:
+        for name, defs in m.functions.items():
+            for _cls, fn in defs:
+                if _has_dispatch_decorator(fn):
+                    sinks.add(name)
+    graph_sinks = sinks - AMBIGUOUS_DISPATCH_NAMES
+
+    # coverage: the known table entry points must be tagged
+    for suffix, classes in REQUIRED_DISPATCH.items():
+        for m in modules:
+            if not m.relpath.endswith(suffix):
+                continue
+            for cls, methods in classes.items():
+                for meth in methods:
+                    fn = m.lookup_method(cls, meth)
+                    if fn is not None and not _has_dispatch_decorator(fn):
+                        findings.append(Finding(
+                            "R1", m.relpath, fn.lineno,
+                            f"table collective entry point {cls}.{meth} "
+                            "is not tagged @collective_dispatch",
+                            "decorate it with analysis.guards."
+                            "collective_dispatch so the thread-identity "
+                            "guard covers it",
+                        ))
+
+    # rogue thread entries: Thread targets / ASyncBuffer fill actions
+    # whose same-module call closure reaches a tagged entry point
+    for m in modules:
+        if any(m.relpath.endswith(a) for a in THREAD_ENTRY_ALLOW):
+            continue
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _name_of_call(node.func)
+            target: Optional[ast.AST] = None
+            what = ""
+            if cname == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                        what = "threading.Thread target"
+            elif cname == "ASyncBuffer":
+                if node.args:
+                    target = node.args[0]
+                    what = "ASyncBuffer fill action"
+                for kw in node.keywords:
+                    if kw.arg == "fill_buffer_action":
+                        target = kw.value
+                        what = "ASyncBuffer fill action"
+            if target is None:
+                continue
+            # resolve the entry function in this module
+            entries: List[ast.AST] = []
+            tname = ""
+            if isinstance(target, ast.Name):
+                tname = target.id
+                entries = [fn for _c, fn in m.functions.get(tname, ())]
+            elif isinstance(target, ast.Attribute):
+                tname = target.attr
+                entries = [fn for _c, fn in m.functions.get(tname, ())]
+            elif isinstance(target, ast.Lambda):
+                tname = "<lambda>"
+                entries = [target]
+            if not entries:
+                continue
+            hit = _reach(m, entries) & graph_sinks
+            if hit:
+                findings.append(Finding(
+                    "R1", m.relpath, node.lineno,
+                    f"{what} {tname!r} can reach collective dispatch "
+                    f"{sorted(hit)} off the comms/training thread",
+                    "route the collective through the PS comms TaskPipe "
+                    "(pipe.submit) or wrap a documented sync point in "
+                    "allow_collective_dispatch(reason)",
+                ))
+    return findings
+
+
+# ------------------------------------------------------------------- R2
+
+def _lock_ids_of_with(
+    node: ast.With, cls: str, modstem: str
+) -> List[str]:
+    out = []
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute) and _LOCK_ATTR_RE.search(expr.attr):
+            owner = cls or modstem
+            out.append(f"{owner}.{expr.attr}")
+        elif isinstance(expr, ast.Name) and _LOCK_ATTR_RE.search(expr.id):
+            out.append(f"{modstem}.{expr.id}")
+    return out
+
+
+def rule_r2_lock_order(
+    modules: Sequence[Module], cfg: LintConfig
+) -> List[Finding]:
+    # pass 1: per-function transitive may-acquire sets (same-module)
+    direct: Dict[int, Set[str]] = {}
+    fn_meta: Dict[int, Tuple[Module, str, ast.AST]] = {}
+    for m in modules:
+        modstem = os.path.splitext(os.path.basename(m.relpath))[0]
+        for name, defs in m.functions.items():
+            for cls, fn in defs:
+                acq: Set[str] = set()
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.With):
+                        acq.update(_lock_ids_of_with(node, cls, modstem))
+                    elif (
+                        isinstance(node, ast.Call)
+                        and _name_of_call(node.func) == "acquire"
+                        and isinstance(node.func, ast.Attribute)
+                    ):
+                        recv = node.func.value
+                        if isinstance(recv, ast.Attribute) and \
+                                _LOCK_ATTR_RE.search(recv.attr):
+                            acq.add(f"{cls or modstem}.{recv.attr}")
+                        elif isinstance(recv, ast.Name) and \
+                                _LOCK_ATTR_RE.search(recv.id):
+                            acq.add(f"{modstem}.{recv.id}")
+                direct[id(fn)] = acq
+                fn_meta[id(fn)] = (m, name, fn)
+
+    trans: Dict[int, Set[str]] = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, (m, _name, fn) in fn_meta.items():
+            for n in _called_names(fn):
+                for _cls, callee in m.functions.get(n, ()):
+                    extra = trans.get(id(callee), set()) - trans[fid]
+                    if extra:
+                        trans[fid] |= extra
+                        changed = True
+
+    # pass 2: edges = lexical nesting + calls under a held lock
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def visit(node: ast.AST, held: List[str], m: Module, cls: str,
+              modstem: str) -> None:
+        if isinstance(node, ast.With):
+            ids = _lock_ids_of_with(node, cls, modstem)
+            for lid in ids:
+                for h in held:
+                    if h != lid:
+                        edges.setdefault((h, lid), (m.relpath, node.lineno))
+            new_held = held + ids
+            for child in node.body:
+                visit(child, new_held, m, cls, modstem)
+            return
+        if isinstance(node, ast.Call) and held:
+            n = _name_of_call(node.func)
+            for _c, callee in m.functions.get(n, ()):
+                for lid in trans.get(id(callee), ()):
+                    for h in held:
+                        if h != lid:
+                            edges.setdefault(
+                                (h, lid), (m.relpath, node.lineno)
+                            )
+        if isinstance(node, ast.ClassDef):
+            cls = node.name
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            held = []  # a def's body runs later, on its own stack
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, m, cls, modstem)
+
+    for m in modules:
+        modstem = os.path.splitext(os.path.basename(m.relpath))[0]
+        visit(m.tree, [], m, "", modstem)
+
+    # pass 3: cycles in the acquisition-order graph
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    findings: List[Finding] = []
+    reported: Set[frozenset] = set()
+
+    def dfs(start: str) -> Optional[List[str]]:
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start:
+                    return path + [start]
+                if nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    for start in sorted(graph):
+        cyc = dfs(start)
+        if not cyc:
+            continue
+        key = frozenset(cyc)
+        if key in reported:
+            continue
+        reported.add(key)
+        first = min(
+            (edges[(cyc[i], cyc[i + 1])] for i in range(len(cyc) - 1)
+             if (cyc[i], cyc[i + 1]) in edges),
+            key=lambda s: (s[0], s[1]),
+        )
+        findings.append(Finding(
+            "R2", first[0], first[1],
+            "lock-order cycle: " + " -> ".join(cyc),
+            "pick ONE global order for these locks and acquire them in "
+            "it everywhere (OrderedLock enforces the order at runtime "
+            "under -debug_thread_guards)",
+        ))
+    return findings
+
+
+# ------------------------------------------------------------------- R3
+
+_DEFINE_FNS = {
+    "MV_DEFINE_int", "MV_DEFINE_bool", "MV_DEFINE_string",
+    "MV_DEFINE_double",
+}
+_AUX_READ_RE = re.compile(r"""(?:GetFlag|SetCMDFlag)\(\s*["'](\w+)["']""")
+
+
+def rule_r3_flag_hygiene(
+    modules: Sequence[Module], cfg: LintConfig
+) -> List[Finding]:
+    defs: Dict[str, Tuple[Module, int]] = {}
+    uses: Set[str] = set()
+    use_sites: List[Tuple[Module, int, str, bool]] = []
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _name_of_call(node.func)
+            if cname in _DEFINE_FNS and node.args and isinstance(
+                node.args[0], ast.Constant
+            ) and isinstance(node.args[0].value, str):
+                defs.setdefault(node.args[0].value, (m, node.lineno))
+            elif cname in ("GetFlag", "SetCMDFlag") and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                name = node.args[0].value
+                uses.add(name)
+                has_default = cname == "GetFlag" and (
+                    len(node.args) > 1 or bool(node.keywords)
+                )
+                use_sites.append((m, node.lineno, name, has_default))
+        # the WEOptions.from_flags idiom: dataclass field names ARE flag
+        # reads (GetFlag(f.name) in a loop the AST cannot unroll)
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and b.name == "from_flags"
+                for b in node.body
+            ):
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name
+                    ):
+                        uses.add(stmt.target.id)
+
+    # reads living outside the linted tree (bench/tests/examples drive
+    # flags too) — text-level scan of the configured aux roots
+    for root in cfg.aux_read_roots:
+        files = []
+        if os.path.isdir(root):
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files += [
+                    os.path.join(dirpath, f) for f in filenames
+                    if f.endswith((".py", ".sh"))
+                ]
+        elif os.path.isfile(root):
+            files.append(root)
+        for fp in files:
+            try:
+                with open(fp, encoding="utf-8", errors="replace") as fh:
+                    uses.update(_AUX_READ_RE.findall(fh.read()))
+            except OSError:
+                continue
+
+    findings: List[Finding] = []
+    for name, (m, line) in sorted(defs.items()):
+        if name not in uses:
+            findings.append(Finding(
+                "R3", m.relpath, line,
+                f"flag {name!r} is defined but never read "
+                "(dead flag surface)",
+                "wire a GetFlag read (or an explicit accepted-and-"
+                "ignored log) or delete the definition",
+            ))
+    for m, line, name, has_default in use_sites:
+        if name not in defs and not has_default:
+            findings.append(Finding(
+                "R3", m.relpath, line,
+                f"flag {name!r} is read but never defined "
+                "(GetFlag would raise KeyError)",
+                "add the MV_DEFINE_* declaration next to the owning "
+                "subsystem",
+            ))
+
+    # user-facing flags must be documented
+    if cfg.doc_files:
+        doc_text = ""
+        for doc in cfg.doc_files:
+            try:
+                with open(doc, encoding="utf-8", errors="replace") as fh:
+                    doc_text += fh.read()
+            except OSError:
+                continue
+        for name, (m, line) in sorted(defs.items()):
+            if not m.relpath.startswith("multiverso_tpu/"):
+                continue
+            if not re.search(rf"(^|[^\w-])--?{re.escape(name)}\b",
+                             doc_text):
+                findings.append(Finding(
+                    "R3", m.relpath, line,
+                    f"user-facing flag -{name} appears in neither "
+                    "README.md nor DEPLOY.md",
+                    "add it to the DEPLOY.md flag reference "
+                    "(python -m multiverso_tpu.analysis --flag-table "
+                    "regenerates the table)",
+                ))
+    return findings
+
+
+# ------------------------------------------------------------------- R4
+
+def rule_r4_thread_lifecycle(
+    modules: Sequence[Module], cfg: LintConfig
+) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def scan(node: ast.AST, m: Module, cls_node: Optional[ast.ClassDef],
+             fn_node: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            nxt_cls, nxt_fn = cls_node, fn_node
+            if isinstance(child, ast.ClassDef):
+                nxt_cls = child
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nxt_fn = child
+            if isinstance(child, ast.Call) and \
+                    _name_of_call(child.func) == "Thread":
+                _check_thread(child, m, cls_node, fn_node, node, findings)
+            scan(child, m, nxt_cls, nxt_fn)
+
+    for m in modules:
+        scan(m.tree, m, None, None)
+    return findings
+
+
+def _check_thread(call: ast.Call, m: Module,
+                  cls_node: Optional[ast.ClassDef],
+                  fn_node: Optional[ast.AST], parent: ast.AST,
+                  findings: List[Finding]) -> None:
+    daemon = False
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            daemon = bool(kw.value.value)
+    # binding: walk up via source text — find the assignment statement
+    # that contains this call (Assign targets), else the thread is
+    # unbound (started inline, unjoinable)
+    binding = ""
+    scope = cls_node or m.tree
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            if any(call is c for c in ast.walk(node.value)):
+                binding = _unparse(node.targets[0])
+                break
+    joined = _binding_joined(binding, scope) if binding else False
+    if not daemon and not joined:
+        findings.append(Finding(
+            "R4", m.relpath, call.lineno,
+            "non-daemon thread with no join on its exit paths "
+            "(interpreter shutdown can hang on it)",
+            "pass daemon=True and register a shutdown join "
+            "(stop()/close()), or join it before every return",
+        ))
+    elif not joined:
+        findings.append(Finding(
+            "R4", m.relpath, call.lineno,
+            f"thread {binding or '<unbound>'} is started but never "
+            "joined (the ASyncBuffer/flusher bug class: an exit path "
+            "that abandons a live worker)",
+            "join it on every exit path, or store it and join in the "
+            "owner's stop()/close()",
+        ))
+
+
+def _binding_joined(binding: str, scope: ast.AST) -> bool:
+    """Does any ``X.join(...)`` in scope plausibly join this binding?
+    One alias fixpoint: ``y = <expr mentioning binding>`` and
+    ``for t in <expr mentioning binding-or-alias>`` extend the alias set."""
+    token = binding.split(".")[-1]
+    aliases = {binding, token}
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(scope):
+            src = None
+            tgt = None
+            if isinstance(node, ast.Assign):
+                src = _unparse(node.value)
+                tgt = _unparse(node.targets[0])
+            elif isinstance(node, ast.For):
+                src = _unparse(node.iter)
+                tgt = _unparse(node.target)
+            if src is None or tgt is None or tgt in aliases:
+                continue
+            if any(re.search(rf"\b{re.escape(a)}\b", src)
+                   for a in aliases):
+                aliases.add(tgt)
+                aliases.add(tgt.split(".")[-1])
+                changed = True
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr == "join":
+            recv = _unparse(node.func.value)
+            if recv in aliases or recv.split(".")[-1] in aliases:
+                return True
+    return False
+
+
+# ------------------------------------------------------------------- R5
+
+_WALL_CLOCK = {
+    "time.time", "time.time_ns", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.today", "datetime.date.today", "time.strftime",
+}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and _name_of_call(node.func) in (
+        "set", "frozenset"
+    ):
+        return True
+    return False
+
+
+def _r5_scope_nodes(m: Module) -> List[ast.AST]:
+    if any(part in m.relpath for part in EXACT_PATH_PARTS) or \
+            m.exact_marker:
+        return [m.tree]
+    for suffix, prefixes in EXACT_FUNCTION_PREFIXES.items():
+        if m.relpath.endswith(suffix):
+            out = []
+            for name, defs in m.functions.items():
+                if name.startswith(tuple(prefixes)):
+                    out.extend(fn for _c, fn in defs)
+            return out
+    return []
+
+
+def rule_r5_exact_paths(
+    modules: Sequence[Module], cfg: LintConfig
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for m in modules:
+        roots = _r5_scope_nodes(m)
+        if not roots:
+            continue
+        # only flag receivers that really are the stdlib/numpy modules
+        imported: Set[str] = set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imported.add(a.asname or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                root = node.module.split(".")[0]
+                for a in node.names:
+                    if root in ("numpy", "random", "time", "datetime"):
+                        imported.add(a.asname or a.name)
+        seen: Set[int] = set()
+        for root in roots:
+            for node in ast.walk(root):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(id(node))
+                text = _unparse(node.func)
+                base = text.split(".")[0]
+                if text in _WALL_CLOCK and base in imported:
+                    findings.append(Finding(
+                        "R5", m.relpath, node.lineno,
+                        f"wall-clock call {text}() inside a "
+                        "bit-exactness scope (tables/io/PS loop)",
+                        "use a caller-injected clock or "
+                        "time.monotonic/perf_counter for stats; wall "
+                        "time may never reach collective or checkpoint "
+                        "payloads",
+                    ))
+                elif (
+                    (text.startswith("np.random.")
+                     or text.startswith("numpy.random."))
+                    and base in imported
+                    and not (
+                        text.endswith("default_rng")
+                        and (node.args or node.keywords)
+                    )
+                ) or (
+                    base == "random" and base in imported
+                    and text.startswith("random.")
+                    and not text.endswith((".Random", ".seed"))
+                ):
+                    findings.append(Finding(
+                        "R5", m.relpath, node.lineno,
+                        f"global/unseeded RNG call {text}() inside a "
+                        "bit-exactness scope",
+                        "thread an explicit seeded Generator "
+                        "(np.random.default_rng(seed)) through the "
+                        "caller",
+                    ))
+                elif _name_of_call(node.func) in (
+                    "list", "tuple", "asarray", "array", "fromiter",
+                    "enumerate",
+                ) and node.args and _is_set_expr(node.args[0]):
+                    findings.append(Finding(
+                        "R5", m.relpath, node.lineno,
+                        "set materialized in iteration order inside a "
+                        "bit-exactness scope (set order is hash-seed "
+                        "dependent)",
+                        "wrap it in sorted(...) before it can reach a "
+                        "collective or checkpoint payload",
+                    ))
+            for node in ast.walk(root):
+                it = None
+                if isinstance(node, ast.For):
+                    it = node.iter
+                elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                       ast.SetComp, ast.DictComp)):
+                    it = node.generators[0].iter
+                if it is not None and _is_set_expr(it):
+                    findings.append(Finding(
+                        "R5", m.relpath, node.lineno,
+                        "iteration over a set inside a bit-exactness "
+                        "scope (order is hash-seed dependent)",
+                        "iterate sorted(the_set) instead",
+                    ))
+    return findings
+
+
+ALL_RULES = (
+    rule_r1_collective_dispatch,
+    rule_r2_lock_order,
+    rule_r3_flag_hygiene,
+    rule_r4_thread_lifecycle,
+    rule_r5_exact_paths,
+)

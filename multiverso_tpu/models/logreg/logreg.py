@@ -34,6 +34,11 @@ class LogReg:
 
     def Train(self) -> float:
         """Run ``train_epoch`` epochs; returns the final epoch's mean loss."""
+        from multiverso_tpu.analysis.guards import register_training_thread
+
+        # this thread owns the training loop and its PS table pulls/pushes
+        # (thread-identity guard, mvlint R1)
+        register_training_thread()
         cfg = self.config
         Model.check_trainable(cfg, self.model)  # un-checkpointable? fail NOW
         last_epoch_loss = 0.0

@@ -25,6 +25,7 @@ from __future__ import annotations
 import queue
 import struct
 import threading
+import time
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -224,20 +225,42 @@ class SampleReader:
         q: queue.Queue = queue.Queue(maxsize=cap)
         DONE = object()
 
+        stop = threading.Event()
+
         def produce():
             try:
                 for b in self.iter_batches(**kw):
+                    if stop.is_set():
+                        return
                     q.put(b)
             finally:
                 q.put(DONE)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is DONE:
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    return
+                yield item
+        finally:
+            # join the producer on EVERY exit path (mvlint R4): a consumer
+            # abandoning this generator used to leak a live fill thread,
+            # possibly blocked forever on a full queue — drain until it
+            # lands its DONE and exits. BOUNDED: if the producer is stuck
+            # inside iter_batches itself (I/O, not the queue), draining
+            # cannot free it — give up after the deadline and abandon the
+            # daemon thread (stop is set, it dies with the process)
+            # rather than hang the consumer's generator close.
+            stop.set()
+            deadline = time.monotonic() + 5.0
+            while t.is_alive() and time.monotonic() < deadline:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                t.join(timeout=0.05)
 
 
 def make_reader(config) -> SampleReader:

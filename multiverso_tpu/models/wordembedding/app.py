@@ -2415,6 +2415,11 @@ class WordEmbedding:
 
     def train(self, ids: Optional[np.ndarray] = None) -> float:
         """Train over the corpus; returns the last logged loss."""
+        from multiverso_tpu.analysis.guards import register_training_thread
+
+        # this thread owns the training loop: the depth-0 PS sync points
+        # dispatch table collectives from it (thread-identity guard, R1)
+        register_training_thread()
         o = self.opt
         # not ready until the chosen path's tables exist and any resume
         # landed (each path flips it back on right before its loop)

@@ -43,6 +43,7 @@ from multiverso_tpu.utils.configure import (
     MV_DEFINE_string,
     GetFlag,
 )
+from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.utils.log import Log
 
 __all__ = [
@@ -273,7 +274,9 @@ class HeartbeatMonitor:
         self._failure: Optional[RankFailure] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        # OrderedLock (mvlint R2): the beacon thread and every
+        # watchdog-aware ticket wait read/write the peer records
+        self._lock = OrderedLock("heartbeat_store._lock")
 
     def poll_once(self) -> Optional[RankFailure]:
         """One beacon publish + one peer sweep (the thread body; also the

@@ -172,6 +172,25 @@ class Runtime:
         Returns the compacted argv (flags consumed), like ``ParseCMDFlags``.
         """
         remaining = ParseCMDFlags(argv)
+        # reference-parity knobs that have no TPU mapping are VALIDATED
+        # and acknowledged, not silently dropped (mvlint R3: a defined
+        # flag must be read — dead flag surface misleads operators)
+        role = GetFlag("ps_role")
+        if role not in ("all", "worker", "server"):
+            Log.Fatal("unknown -ps_role %r (all|worker|server)", role)
+        if role != "all":
+            Log.Info(
+                "-ps_role=%s accepted; only 'all' maps onto SPMD hardware "
+                "— every chip is worker AND server here", role,
+            )
+        backup = GetFlag("backup_worker_ratio")
+        if backup:
+            Log.Info(
+                "-backup_worker_ratio=%d accepted and ignored (the "
+                "reference declares but never reads it; a single-"
+                "controller SPMD program has no stragglers to back up)",
+                backup,
+            )
         if self._started:
             if mesh is not None or num_shards not in (None, 0):
                 Log.Fatal(

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from multiverso_tpu.serving.metrics import ServingMetrics
+from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.utils.log import CHECK
 
 __all__ = ["DynamicBatcher", "Overloaded", "Request"]
@@ -110,7 +111,8 @@ class DynamicBatcher:
         for i in range(self.max_depth):
             self._free.push(i)
         self._depth = 0  # approximate live count (metrics gauge)
-        self._depth_lock = threading.Lock()
+        # OrderedLock (mvlint R2): client threads + flusher both take it
+        self._depth_lock = OrderedLock("batcher._depth_lock")
         self._pending: Dict[str, List[Request]] = {}  # route -> open batch
         self._thread: Optional[threading.Thread] = None
         self._closed = False

@@ -64,6 +64,7 @@ from multiverso_tpu.resilience.breaker import CircuitBreaker
 from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded
 from multiverso_tpu.serving.metrics import ServingMetrics
 from multiverso_tpu.utils import next_pow2 as _next_pow2
+from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.utils.log import CHECK, Log
 
 __all__ = ["PublishRejected", "ServingSnapshot", "TableServer"]
@@ -86,7 +87,7 @@ class ServingSnapshot:
         self.arrays = dict(arrays)
         self.version = version
         self._derived: Dict[str, jax.Array] = {}
-        self._derived_lock = threading.Lock()
+        self._derived_lock = OrderedLock("snapshot._derived_lock")
 
     def names(self) -> List[str]:
         return sorted(self.arrays)
@@ -152,7 +153,8 @@ class TableServer:
         Dashboard.add_section(f"serving.{name}.{id(self)}.health",
                               self._health_lines)
         self._snapshot: Optional[ServingSnapshot] = None
-        self._publish_lock = threading.Lock()  # serialises publishers only
+        # OrderedLock (mvlint R2): serialises publishers only
+        self._publish_lock = OrderedLock("snapshot._publish_lock")
         self._version = 0
         self._jit_cache: Dict[Tuple, Any] = {}
         # per-route circuit breakers (created lazily on first traffic);

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from multiverso_tpu.analysis.guards import collective_dispatch
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.updaters import AddOption, make_updater
@@ -180,6 +181,7 @@ class DenseTable:
             self._compiled["get"] = fn
         return fn
 
+    @collective_dispatch
     def get_async(self) -> jax.Array:
         """Dispatch the all-gather; returned array is the future
         (``WorkerTable::GetAsync`` — ref: src/table.cpp:41-59)."""
@@ -292,6 +294,7 @@ class DenseTable:
             self._compiled["addW"] = fn
         return fn
 
+    @collective_dispatch
     def add(self, delta, option: Optional[AddOption] = None) -> None:
         """One logical Add (a single worker's request — ref:
         src/worker.cpp:30-57 fan-out; here one fused SPMD program).
@@ -324,6 +327,7 @@ class DenseTable:
                 f"{self.worker_state_slots} per-worker updater slots",
             )
 
+    @collective_dispatch
     def add_per_worker(self, deltas, option: Optional[AddOption] = None) -> None:
         """All workers' Adds for one round in a single SPMD program — the
         data-parallel hot path (deltas shape ``(num_workers, *table_shape)``,

@@ -34,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from multiverso_tpu.analysis.guards import collective_dispatch
 from multiverso_tpu.native.kv_index import KVIndex
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime import runtime
@@ -152,6 +153,7 @@ class KVTable:
 
     # ------------------------------------------------------------ table ops
 
+    @collective_dispatch
     def add(self, keys, vals) -> None:
         """Server ``+=`` per key (ref: kv_table.h:96-103); duplicate keys in
         one batch accumulate."""
@@ -181,6 +183,7 @@ class KVTable:
             )
         self._values = self._scatter_fn(self._values, slots_p, vals_p)
 
+    @collective_dispatch
     def get(self, keys) -> np.ndarray:
         """Values for a key set; refreshes the local cached map
         (ref: kv_table.h:70-78 ProcessReplyGet assigns into raw()).
@@ -311,6 +314,7 @@ class KVTable:
         if len(self._index) > self._capacity:
             self._grow(len(self._index))
 
+    @collective_dispatch
     def add_local(self, keys, vals) -> None:
         """Per-rank Add: every process pushes its OWN key/value batch;
         one lockstep SPMD scatter accumulates all ranks' contributions
@@ -358,6 +362,7 @@ class KVTable:
             )
         self._values = self._scatter_local_fn(self._values, slots_g, vals_g)
 
+    @collective_dispatch
     def get_local(self, keys) -> np.ndarray:
         """Per-rank Get: every process reads its OWN key batch through one
         lockstep SPMD gather (per-rank buckets stacked on the worker

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from multiverso_tpu.analysis.guards import collective_dispatch
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.tables.base import DenseTable, TableOption, register_table_type
 from multiverso_tpu.updaters import AddOption
@@ -127,6 +128,7 @@ class MatrixTable(DenseTable):
         that at construction)."""
         return ids
 
+    @collective_dispatch
     def get_rows_async(self, row_ids) -> jax.Array:
         ids_np = np.asarray(row_ids, np.int32)
         CHECK(ids_np.ndim == 1, "row_ids must be 1-D")
@@ -139,6 +141,7 @@ class MatrixTable(DenseTable):
         with monitor("table.get_rows"):  # ref: worker.cpp:31 monitor site
             return np.asarray(self.get_rows_async(row_ids))
 
+    @collective_dispatch
     def get_rows_fixed(self, row_ids) -> np.ndarray:
         """Row-subset Get with the id vector BAKED into the compiled
         program as a constant. For small recurring reads of a FIXED row
@@ -224,6 +227,7 @@ class MatrixTable(DenseTable):
             f"row deltas shape {delta_shape} != ({ids.shape[0]}, {self.num_col})",
         )
 
+    @collective_dispatch
     def add_rows(self, row_ids, deltas, option: Optional[AddOption] = None) -> None:
         """Row-set Add (ref: matrix_table.cpp:164-233 Add by row-id vector).
         ``deltas`` may be device-resident; only the (small) id vector is
@@ -307,6 +311,7 @@ class MatrixTable(DenseTable):
 
     # ------------------------------------------------- per-process row ops
 
+    @collective_dispatch
     def round_bucket(self, n_own: int) -> Tuple[bool, int]:
         """Cross-rank agreement on the padded row bucket for one
         get_rows_local/add_rows_local round: (any_rank_has_rows, bucket).
@@ -343,6 +348,7 @@ class MatrixTable(DenseTable):
         )
         return ids, ids_g
 
+    @collective_dispatch
     def get_rows_local(self, row_ids) -> np.ndarray:
         """Row-set Get where EVERY process passes its own (equally-sized,
         padded) id bucket — the multi-process PS pull. One SPMD gather runs
@@ -375,6 +381,7 @@ class MatrixTable(DenseTable):
                 multihost.global_to_host_local(rows_g, P(mesh_lib.WORKER_AXIS))
             )
 
+    @collective_dispatch
     def add_rows_local(self, row_ids, deltas) -> None:
         """Row-set Add where every process pushes its own (equally-sized)
         bucket of deltas; contributions for the same row accumulate across
@@ -422,6 +429,7 @@ class MatrixTable(DenseTable):
 
     # ------------------------------------------------- compressed row adds
 
+    @collective_dispatch
     def add_rows_local_packed(self, row_ids, payload) -> None:
         """``add_rows_local`` taking a COMPRESSED delta payload from
         ``utils.quantization.DeltaCodec`` — ``("dense", arr)``,
@@ -680,6 +688,7 @@ class MatrixTable(DenseTable):
             self._compiled["add_rowsW"] = fn
         return fn
 
+    @collective_dispatch
     def add_rows_per_worker(
         self, row_ids, deltas, option: Optional[AddOption] = None
     ) -> None:

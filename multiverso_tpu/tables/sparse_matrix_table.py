@@ -50,6 +50,7 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from multiverso_tpu.analysis.guards import collective_dispatch
 from multiverso_tpu.runtime import runtime
 from multiverso_tpu.tables.base import TableOption, register_table_type
 from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
@@ -258,6 +259,7 @@ class SparseMatrixTable(MatrixTable):
         padded = np.pad(stale, (0, padded_n - n), mode="edge")
         return stale, self.get_rows(padded)[:n]
 
+    @collective_dispatch
     def get_stale_rows_local(
         self,
         row_ids: np.ndarray,

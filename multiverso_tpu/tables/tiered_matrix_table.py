@@ -89,6 +89,7 @@ from multiverso_tpu.tables.base import (
 from multiverso_tpu.tables.matrix_table import MatrixTable, MatrixTableOption
 from multiverso_tpu.updaters import AddOption
 from multiverso_tpu.utils.dashboard import Dashboard, monitor
+from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.utils.log import CHECK
 
 __all__ = [
@@ -193,7 +194,12 @@ class TieredMatrixTable(MatrixTable):
         self.num_row = V
         self.shape = (V, C)
         self._host = host
-        self._tier_lock = threading.RLock()
+        # OrderedLock (mvlint R2): records the acquisition order under
+        # -debug_thread_guards — prefetch/comms/training all take this
+        # lock, and an inversion against the batcher/snapshot locks must
+        # surface as a structured error, not a deadlock
+        self._tier_lock = OrderedLock("tiered_table._tier_lock",
+                                      recursive=True)
         if not self._resident:
             self._slot_of = np.full(V, -1, np.int32)  # row -> slot (-1 absent)
             self._row_of = np.full(cache_rows, -1, np.int64)  # slot -> row

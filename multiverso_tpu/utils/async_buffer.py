@@ -222,6 +222,21 @@ class TaskPipe:
         fd_stats.note_broken_pipe()
 
     def _run(self) -> None:
+        # the pipe worker IS the sanctioned collective-dispatch channel:
+        # register with the runtime thread-identity guard (R1) so tagged
+        # table entry points accept tasks executed here
+        from multiverso_tpu.analysis.guards import (
+            register_comms_thread,
+            unregister_comms_thread,
+        )
+
+        register_comms_thread()
+        try:
+            self._run_loop()
+        finally:
+            unregister_comms_thread()
+
+    def _run_loop(self) -> None:
         while True:
             slot = self._ready.pop()
             if slot is None:  # exit() drained — no more tasks can arrive

@@ -31,6 +31,7 @@ __all__ = [
     "SetCMDFlag",
     "ResetFlagsToDefault",
     "AllFlags",
+    "mutation_count",
 ]
 
 
@@ -47,6 +48,18 @@ class _Flag:
 
 _lock = threading.Lock()
 _registry: Dict[str, _Flag] = {}
+# bumped on every mutation (define/set/parse/reset); lets hot paths cache
+# a flag value lock-free and re-read only when something actually changed
+_generation = 0
+
+
+def mutation_count() -> int:
+    return _generation
+
+
+def _bump() -> None:
+    global _generation
+    _generation += 1
 
 
 def _define(name: str, default: Any, type_: type, help_: str) -> None:
@@ -60,6 +73,7 @@ def _define(name: str, default: Any, type_: type, help_: str) -> None:
                 )
             return  # idempotent re-definition (module reloads)
         _registry[name] = _Flag(name, default, type_, help_)
+        _bump()
 
 
 def MV_DEFINE_int(name: str, default: int = 0, help: str = "") -> None:
@@ -108,6 +122,7 @@ def SetCMDFlag(name: str, value: Any) -> None:
         if flag is None:
             raise KeyError(f"unknown flag {name!r}")
         flag.value = _coerce(flag, value)
+        _bump()
 
 
 def ParseCMDFlags(argv: Optional[Sequence[str]]) -> List[str]:
@@ -130,6 +145,7 @@ def ParseCMDFlags(argv: Optional[Sequence[str]]) -> List[str]:
                 flag = _registry.get(key)
                 if flag is not None:
                     flag.value = _coerce(flag, val)
+                    _bump()
                     consumed = True
         if not consumed:
             remaining.append(arg)
@@ -141,6 +157,7 @@ def ResetFlagsToDefault() -> None:
     with _lock:
         for flag in _registry.values():
             flag.value = flag.default
+        _bump()
 
 
 def AllFlags() -> Dict[str, Any]:

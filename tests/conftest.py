@@ -13,6 +13,14 @@ each test, not a mock — here a real 8-device mesh with real XLA collectives.
 
 import os
 
+# The whole tier-1 suite runs with the runtime concurrency guards ARMED
+# (analysis/RULES.md): @collective_dispatch thread-identity asserts and
+# OrderedLock inversion detection raise structured GuardViolations
+# instead of deadlocking. Env (not SetCMDFlag) so the flag's DEFAULT is
+# on — ResetFlagsToDefault() in tests must not silently disarm it — and
+# so subprocess workers (multiprocess drills) inherit it.
+os.environ.setdefault("MV_DEBUG_THREAD_GUARDS", "1")
+
 # MV_TEST_REAL_TPU=1 keeps the session on the real accelerator so the
 # compiled (non-interpret) Pallas gate in test_pallas_flash_compiled.py
 # can execute: `MV_TEST_REAL_TPU=1 pytest tests/test_pallas_flash_compiled.py`

@@ -1,0 +1,34 @@
+# mvlint: exact-module
+"""mvlint fixture: negative control — threads joined, flags paired,
+locks ordered, deterministic — zero findings even with the exact-module
+marker opting it into R5."""
+
+import threading
+
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_int
+
+MV_DEFINE_int("fixture_live_flag", 3, "defined AND read")
+
+
+def read_defined():
+    return GetFlag("fixture_live_flag")
+
+
+def sorted_union(a, b):
+    return sorted(set(a) | set(b))
+
+
+class OneLock:
+    def __init__(self):
+        self._only_lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._only_lock:
+            self.n += 1
+
+
+def run_joined_worker():
+    t = threading.Thread(target=read_defined, daemon=True)
+    t.start()
+    t.join()

@@ -1,0 +1,27 @@
+"""mvlint fixture: triggers EXACTLY rule R1 (collective dispatch off the
+comms/training thread). A thread target whose call closure reaches a
+``@collective_dispatch``-tagged entry point — the PR 6 deadlock class.
+The thread itself is daemonized and joined so R4 stays quiet."""
+
+import threading
+
+from multiverso_tpu.analysis.guards import collective_dispatch
+
+
+@collective_dispatch
+def pull_rows_collective():
+    return 1
+
+
+def _helper():
+    return pull_rows_collective()
+
+
+def rogue_entry():
+    _helper()
+
+
+def start_rogue():
+    t = threading.Thread(target=rogue_entry, daemon=True)
+    t.start()
+    t.join()

@@ -1,0 +1,22 @@
+"""mvlint fixture: triggers EXACTLY rule R2 (lock-order cycle). Two
+methods acquire the same pair of locks in opposite orders — the deadlock
+needs only the losing interleaving."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._alpha_lock = threading.Lock()
+        self._beta_lock = threading.Lock()
+        self.n = 0
+
+    def forward(self):
+        with self._alpha_lock:
+            with self._beta_lock:
+                self.n += 1
+
+    def backward(self):
+        with self._beta_lock:
+            with self._alpha_lock:
+                self.n -= 1

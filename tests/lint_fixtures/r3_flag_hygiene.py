@@ -1,0 +1,10 @@
+"""mvlint fixture: triggers EXACTLY rule R3 (flag hygiene) — one flag
+defined but never read, one flag read but never defined."""
+
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_int
+
+MV_DEFINE_int("fixture_dead_flag", 7, "declared and then forgotten")
+
+
+def read_undefined():
+    return GetFlag("fixture_undefined_flag")

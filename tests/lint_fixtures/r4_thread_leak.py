@@ -1,0 +1,15 @@
+"""mvlint fixture: triggers EXACTLY rule R4 (thread lifecycle) — a
+started thread with no join on any exit path (the ASyncBuffer/flusher
+bug class)."""
+
+import threading
+
+
+def _work():
+    pass
+
+
+def leak_a_thread():
+    t = threading.Thread(target=_work, daemon=True)
+    t.start()
+    return t

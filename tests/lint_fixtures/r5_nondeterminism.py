@@ -1,0 +1,20 @@
+# mvlint: exact-module
+"""mvlint fixture: triggers EXACTLY rule R5 (nondeterminism in a
+bit-exactness scope — opted in via the exact-module marker above): wall
+clock, unseeded global RNG, and set-order iteration."""
+
+import time
+
+import numpy as np
+
+
+def stamp_payload():
+    return {"saved_at": time.time()}
+
+
+def noisy_init(n):
+    return np.random.uniform(size=n)
+
+
+def union_ids(a, b):
+    return list(set(a + b))

@@ -1,0 +1,359 @@
+"""mvlint static-analysis + runtime-guard tests (ISSUE 8).
+
+Three layers:
+
+* fixture matrix — each seeded violation file under ``tests/lint_fixtures``
+  must trigger EXACTLY its rule id, and the clean fixture none;
+* the repo itself must lint clean with zero suppressions (the same gate
+  ci.sh enforces);
+* the runtime guards the rules pair with: the rogue-thread collective
+  drill (a thread that is neither the TaskPipe worker nor the training
+  thread dispatching a table collective must raise a structured
+  GuardViolation, not hang — the PR 6 deadlock, caught in one line) and
+  the OrderedLock inversion recorder.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.analysis import guards
+from multiverso_tpu.analysis.mvlint import (
+    LintConfig,
+    load_baseline,
+    run_lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+# no aux read roots, no doc files, no baseline: fixtures are judged on
+# their own content only
+_BARE = LintConfig(aux_read_roots=(), doc_files=(), repo_root=REPO)
+
+
+def _lint_fixture(name):
+    return run_lint(
+        [os.path.join(FIXTURES, name)],
+        config=_BARE,
+        baseline_path=os.devnull,
+    )
+
+
+# ------------------------------------------------------- fixture matrix
+
+
+@pytest.mark.parametrize(
+    "fixture,rule",
+    [
+        ("r1_rogue_thread.py", "R1"),
+        ("r2_lock_cycle.py", "R2"),
+        ("r3_flag_hygiene.py", "R3"),
+        ("r4_thread_leak.py", "R4"),
+        ("r5_nondeterminism.py", "R5"),
+    ],
+)
+def test_fixture_triggers_exactly_its_rule(fixture, rule):
+    res = _lint_fixture(fixture)
+    assert res.findings, f"{fixture} produced no findings"
+    assert {f.rule for f in res.findings} == {rule}
+    # findings carry file:line + a fix hint (the operator contract)
+    for f in res.findings:
+        assert f.line > 0 and f.path.endswith(fixture)
+        assert f.hint
+
+
+def test_clean_fixture_negative_control():
+    res = _lint_fixture("clean.py")
+    assert res.findings == []
+
+
+def test_r5_fixture_covers_all_three_categories():
+    msgs = " ".join(f.message for f in _lint_fixture(
+        "r5_nondeterminism.py").findings)
+    assert "wall-clock" in msgs
+    assert "RNG" in msgs
+    assert "set" in msgs
+
+
+def test_r3_fixture_names_both_directions():
+    msgs = [f.message for f in _lint_fixture("r3_flag_hygiene.py").findings]
+    assert any("defined but never read" in m for m in msgs)
+    assert any("read but never defined" in m for m in msgs)
+
+
+# ------------------------------------------------------ repo lints clean
+
+
+def test_repo_lints_clean_with_empty_baseline():
+    """The acceptance gate: `python -m multiverso_tpu.analysis
+    multiverso_tpu/` exits 0 with ZERO unsuppressed findings — and the
+    checked-in baseline suppresses nothing (fixes land in code)."""
+    res = run_lint([os.path.join(REPO, "multiverso_tpu")])
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    assert res.suppressed == [], (
+        "baseline.toml must stay empty — fix findings, don't suppress"
+    )
+    assert res.files > 60  # the scan really covered the tree
+
+
+def test_checked_in_baseline_is_empty():
+    path = os.path.join(REPO, "multiverso_tpu", "analysis", "baseline.toml")
+    assert load_baseline(path) == []
+
+
+# ------------------------------------------------------ suppression paths
+
+
+def test_baseline_suppression_and_reason_required(tmp_path):
+    base = tmp_path / "baseline.toml"
+    base.write_text(
+        '[[suppress]]\nrule = "R4"\npath = "r4_thread_leak.py"\n'
+        'reason = "fixture exercising the suppression channel"\n'
+    )
+    res = run_lint(
+        [os.path.join(FIXTURES, "r4_thread_leak.py")],
+        config=_BARE,
+        baseline_path=str(base),
+    )
+    assert res.findings == []
+    assert res.suppressed and "suppression channel" in \
+        res.suppressed[0].suppressed_by
+    # a reasonless entry is rejected outright
+    base.write_text('[[suppress]]\nrule = "R4"\npath = "x"\n')
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(base))
+
+
+def test_inline_pragma_needs_justification(tmp_path):
+    src = (
+        "import threading\n\n\n"
+        "def leak():\n"
+        "    t = threading.Thread(target=print, daemon=True)  "
+        "# mvlint: allow[R4] {}\n"
+        "    t.start()\n"
+    )
+    justified = tmp_path / "justified.py"
+    justified.write_text(src.format("short-lived probe, exits with print"))
+    res = run_lint([str(justified)], config=_BARE, baseline_path=os.devnull)
+    assert res.findings == [] and len(res.suppressed) == 1
+    bare = tmp_path / "bare.py"
+    bare.write_text(src.format(""))
+    res = run_lint([str(bare)], config=_BARE, baseline_path=os.devnull)
+    assert len(res.findings) == 1  # pragma without a why does not count
+
+
+# --------------------------------------------------- runtime guard drills
+
+
+def _dispatch_from_thread(fn):
+    """Run fn on a fresh (rogue) thread; return what it raised, if
+    anything."""
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — the assertion target
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive(), "guard drill must never hang"
+    return box
+
+
+def test_rogue_thread_collective_raises_structured_error(mv_env):
+    """The PR 6 deadlock drill: a collective table op dispatched from a
+    thread that is neither the TaskPipe worker nor the training thread
+    raises GuardViolation IMMEDIATELY (structured — kind, entry, thread
+    — not a hang) with -debug_thread_guards on, which the whole tier-1
+    suite runs with."""
+    from multiverso_tpu.tables import MatrixTableOption, create_table
+
+    assert guards.guards_enabled()  # conftest exports the env default
+    table = create_table(MatrixTableOption(num_row=8, num_col=4))
+    box = _dispatch_from_thread(lambda: table.get_rows(np.arange(3)))
+    err = box.get("error")
+    assert isinstance(err, guards.GuardViolation)
+    assert err.kind == "collective_dispatch"
+    assert "get_rows" in err.entry
+    assert err.thread  # names the offending thread
+    # main thread (the training thread) stays allowed
+    assert table.get_rows(np.arange(3)).shape == (3, 4)
+
+
+def test_taskpipe_comms_thread_is_allowed(mv_env):
+    from multiverso_tpu.tables import MatrixTableOption, create_table
+    from multiverso_tpu.utils.async_buffer import TaskPipe
+
+    table = create_table(MatrixTableOption(num_row=8, num_col=4))
+    pipe = TaskPipe(name="mv-test-comms")
+    try:
+        out = pipe.submit(
+            lambda: table.get_rows(np.arange(4)), tag="pull"
+        ).result(timeout=60)
+        assert out.shape == (4, 4)
+    finally:
+        pipe.close()
+
+
+def test_allow_context_and_disarmed_flag(mv_env):
+    from multiverso_tpu.tables import MatrixTableOption, create_table
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault, SetCMDFlag
+
+    table = create_table(MatrixTableOption(num_row=8, num_col=4))
+
+    def via_ctx():
+        with guards.allow_collective_dispatch(
+            "test: documented sync point"
+        ):
+            return table.get_rows(np.arange(2))
+
+    assert _dispatch_from_thread(via_ctx)["value"].shape == (2, 4)
+    with pytest.raises(ValueError):
+        with guards.allow_collective_dispatch(""):
+            pass
+    # flag off: the rogue dispatch is tolerated (guards are debug-only)
+    SetCMDFlag("debug_thread_guards", False)
+    try:
+        box = _dispatch_from_thread(lambda: table.get_rows(np.arange(2)))
+        assert "error" not in box
+    finally:
+        ResetFlagsToDefault()  # env-derived default: back ON
+    assert guards.guards_enabled()
+
+
+def test_registered_training_thread_is_allowed(mv_env):
+    from multiverso_tpu.tables import MatrixTableOption, create_table
+
+    table = create_table(MatrixTableOption(num_row=8, num_col=4))
+
+    def as_training():
+        guards.register_training_thread()
+        return table.get_rows(np.arange(5))
+
+    assert _dispatch_from_thread(as_training)["value"].shape == (5, 4)
+    guards.register_training_thread()  # hand it back to the main thread
+
+
+# ------------------------------------------------------ lock-order guard
+
+
+@pytest.fixture
+def fresh_order_graph():
+    guards.reset_lock_order_graph()
+    yield
+    guards.reset_lock_order_graph()
+
+
+def test_ordered_lock_inversion_detected(fresh_order_graph):
+    a = guards.OrderedLock("drill.alpha")
+    b = guards.OrderedLock("drill.beta")
+    with a:
+        with b:
+            pass
+    with pytest.raises(guards.GuardViolation) as exc:
+        with b:
+            with a:
+                pass
+    assert exc.value.kind == "lock_order"
+    assert "drill.alpha" in str(exc.value) and "drill.beta" in str(exc.value)
+    # the failed acquire released cleanly: the order graph still guards,
+    # the locks are reusable in the recorded order
+    with a:
+        with b:
+            pass
+
+
+def test_ordered_lock_recursive_and_consistent_order(fresh_order_graph):
+    r = guards.OrderedLock("drill.reentrant", recursive=True)
+    other = guards.OrderedLock("drill.other")
+    for _ in range(3):  # same order every time: never a violation
+        with r:
+            with r:  # re-entry records no edges
+                with other:
+                    pass
+
+
+def test_ordered_lock_same_name_instances_inversion(fresh_order_graph):
+    """Two locks SHARING a class name (every table's tier lock does)
+    still need a consistent relative order — the instance-order graph
+    catches the inversion the name-level graph cannot see."""
+    a = guards.OrderedLock("drill.shared_name")
+    b = guards.OrderedLock("drill.shared_name")
+    with a:
+        with b:
+            pass
+    with pytest.raises(guards.GuardViolation) as exc:
+        with b:
+            with a:
+                pass
+    assert exc.value.kind == "lock_order"
+    # and the consistent order keeps working
+    with a:
+        with b:
+            pass
+
+
+def test_ordered_lock_disarm_while_held_keeps_stack_sane(
+    fresh_order_graph,
+):
+    """Toggling -debug_thread_guards off while a lock is held must not
+    strand its stack entry (which would fabricate phantom order edges
+    for every later acquisition on this thread)."""
+    from multiverso_tpu.utils.configure import ResetFlagsToDefault, SetCMDFlag
+
+    a = guards.OrderedLock("drill.toggle_a")
+    b = guards.OrderedLock("drill.toggle_b")
+    a.acquire()
+    SetCMDFlag("debug_thread_guards", False)
+    a.release()  # pop happens even while disarmed
+    ResetFlagsToDefault()  # env default: back ON
+    assert guards.guards_enabled()
+    with b:  # would record phantom a->b if the stack were corrupted
+        pass
+    with a:
+        pass
+    assert ("drill.toggle_a", "drill.toggle_b") not in \
+        guards._order_edges
+
+
+def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
+    bad = tmp_path / "nul.py"
+    bad.write_bytes(b"x = 1\x00\n")
+    res = run_lint([str(bad)], config=_BARE, baseline_path=os.devnull)
+    assert [f.rule for f in res.findings] == ["R0"]
+
+
+def test_ordered_lock_cross_thread_inversion(fresh_order_graph):
+    """The order graph is process-wide: thread 1 records A->B, thread 2
+    attempting B->A trips the guard deterministically (no race needed)."""
+    a = guards.OrderedLock("drill.x")
+    b = guards.OrderedLock("drill.y")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1, daemon=True)
+    th.start()
+    th.join(timeout=30)
+    box = {}
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass
+        except guards.GuardViolation as e:
+            box["error"] = e
+
+    th2 = threading.Thread(target=t2, daemon=True)
+    th2.start()
+    th2.join(timeout=30)
+    assert isinstance(box.get("error"), guards.GuardViolation)
