@@ -119,6 +119,57 @@ print("pipelined PS smoke OK: rounds", rounds[0])
 EOF
 rm -rf "$PSROOT"
 
+echo "== obs trace smoke (2-proc pipelined, merge + per-round span gate) =="
+# the observability layer end to end across REAL processes: a depth-1
+# pipelined run with -trace_dir armed on both ranks, then
+# `python -m multiverso_tpu.obs merge` aligns the two dumps on the
+# rendezvous anchor into one Perfetto-loadable trace. Gates: the merged
+# document passes the schema check, BOTH ranks' dumps merged, and each
+# rank's ps.round.train / ps.round.push complete-span counts equal its
+# reported round count (pull runs depth extra warm-up rounds).
+OBSROOT=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$OBSROOT" <<'EOF'
+import json, re, subprocess, sys
+import numpy as np
+
+sys.path.insert(0, ".")
+from tests.test_multiprocess_e2e import _run_cluster
+
+root = sys.argv[1]
+rng = np.random.RandomState(11)
+p = rng.randint(0, 30, 2000) * 2
+ids = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1).astype(np.int32)
+np.save(root + "/corpus.npy", ids)
+outs = _run_cluster(
+    "multiprocess_ps_worker.py",
+    lambda i: [root + "/corpus.npy", f"{root}/emb_{i}.npy",
+               "shard_pipelined_trace", root],
+    nproc=2, timeout=300,
+)
+rounds = [int(re.search(r"rounds=(\d+)", o).group(1)) for o in outs]
+assert rounds[0] == rounds[1] and rounds[0] > 2, rounds
+merged = root + "/pod-trace.json"
+rc = subprocess.call(
+    [sys.executable, "-m", "multiverso_tpu.obs", "merge",
+     root + "/trace", "-o", merged, "--expect-ranks", "2"],
+)
+assert rc == 0, f"obs merge exited {rc}"
+doc = json.load(open(merged))
+from multiverso_tpu.obs.trace_tools import span_counts, validate_trace
+
+assert validate_trace(doc) == []
+assert len(doc["otherData"]["ranks"]) == 2, doc["otherData"]
+counts = span_counts(doc)
+for rank in (0, 1):
+    for name in ("ps.round.train", "ps.round.push"):
+        got = counts.get((rank, name), 0)
+        assert got == rounds[rank], (rank, name, got, rounds)
+    assert counts.get((rank, "ps.round.pull"), 0) >= rounds[rank]
+print("obs trace smoke OK: rounds", rounds[0], "merged events",
+      len(doc["traceEvents"]))
+EOF
+rm -rf "$OBSROOT"
+
 echo "== tiered-table smoke (small HBM cache == resident tables) =="
 # the HBM<->host tiered MatrixTable end to end through the app: a
 # zipf corpus trains with -table_tier_hbm_mb sized to ~15% of the
@@ -254,8 +305,16 @@ assert rep["resume_from"], rep  # a valid drained checkpoint exists
 from multiverso_tpu.resilience import latest_valid
 ck = latest_valid(root + "/ck")
 assert ck is not None and ck == rep["resume_from"], (ck, rep)
+# obs: containment must leave a parseable flight recorder next to the
+# FAILURE report — rounds, the rank failure and the containment itself
+fr = os.path.join(root, "ck", "flight-recorder-rank0.jsonl")
+assert os.path.exists(fr), os.listdir(root + "/ck")
+events = [json.loads(line) for line in open(fr)]
+kinds = {e["kind"] for e in events}
+assert {"rank_failure", "containment", "round"} <= kinds, kinds
 print(f"drill OK: survivor RankFailure[{kind}] in {wall:.0f}s, "
-      f"drained checkpoint {os.path.basename(ck)}")
+      f"drained checkpoint {os.path.basename(ck)}, flight recorder "
+      f"{len(events)} events")
 
 _, outs = retried("chaos_resume", "resume", [0, 0])
 assert all("resumed from" in o and "WORKER_OK" in o for o in outs)

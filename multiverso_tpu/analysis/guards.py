@@ -99,6 +99,19 @@ class GuardViolation(RuntimeError):
         self.kind = kind
         self.thread = thread
         self.entry = entry
+        try:
+            # flight-recorder breadcrumb (obs): a guard trip is exactly
+            # the kind of event a post-mortem needs on its timeline.
+            # Guarded import: guards loads very early and must survive a
+            # broken/absent obs package.
+            from multiverso_tpu.obs.flight import recorder
+
+            recorder.record(
+                "guard_violation", violation_kind=kind, entry=entry,
+                thread=thread,
+            )
+        except Exception:  # noqa: BLE001 — never mask the violation
+            pass
 
 
 # --------------------------------------------------- dispatch-thread guard

@@ -552,6 +552,54 @@ def _binding_joined(binding: str, scope: ast.AST) -> bool:
 
 # ------------------------------------------------------------------- R5
 
+# Observability call forms whose ARGUMENTS are exempt inside exact-path
+# scopes: the tracer/flight-recorder legitimately read clocks there
+# (span timestamps, event wall stamps), and those readings annotate the
+# timeline only — they never feed trained values, collectives or
+# checkpoint payloads (analysis/RULES.md R5 "obs allowlist"). The call
+# form must END in span/event/record AND its root name must actually be
+# bound by a multiverso_tpu.obs import in the module — a local
+# ``def event(...)`` (or a local ``recorder`` object) gets no exemption,
+# and aliasing an obs call through another name forfeits it.
+_OBS_METHOD_NAMES = {"span", "event", "record"}
+
+
+def _obs_bound_names(m: Module) -> Set[str]:
+    """Names this module binds to the obs package / its members."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "multiverso_tpu.obs" or \
+                        a.name.startswith("multiverso_tpu.obs."):
+                    out.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "multiverso_tpu":
+                for a in node.names:
+                    if a.name == "obs":
+                        out.add(a.asname or "obs")
+            elif node.module == "multiverso_tpu.obs" or \
+                    node.module.startswith("multiverso_tpu.obs."):
+                for a in node.names:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _obs_allowed_nodes(root: ast.AST, obs_names: Set[str]) -> Set[int]:
+    """ids of every node inside an obs span/event/record call (the call
+    node itself included) — R5 skips findings anchored on them."""
+    allowed: Set[int] = set()
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        text = _unparse(node.func)
+        parts = text.split(".")
+        if parts[-1] in _OBS_METHOD_NAMES and parts[0] in obs_names:
+            for sub in ast.walk(node):
+                allowed.add(id(sub))
+    return allowed
+
+
 _WALL_CLOCK = {
     "time.time", "time.time_ns", "datetime.now", "datetime.utcnow",
     "datetime.datetime.now", "datetime.datetime.utcnow",
@@ -603,11 +651,15 @@ def rule_r5_exact_paths(
                     if root in ("numpy", "random", "time", "datetime"):
                         imported.add(a.asname or a.name)
         seen: Set[int] = set()
+        obs_names = _obs_bound_names(m)
         for root in roots:
+            allowed = _obs_allowed_nodes(root, obs_names)
             for node in ast.walk(root):
                 if id(node) in seen or not isinstance(node, ast.Call):
                     continue
                 seen.add(id(node))
+                if id(node) in allowed:
+                    continue
                 text = _unparse(node.func)
                 base = text.split(".")[0]
                 if text in _WALL_CLOCK and base in imported:
@@ -654,6 +706,8 @@ def rule_r5_exact_paths(
                         "collective or checkpoint payload",
                     ))
             for node in ast.walk(root):
+                if id(node) in allowed:
+                    continue
                 it = None
                 if isinstance(node, ast.For):
                     it = node.iter

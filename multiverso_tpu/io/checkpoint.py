@@ -214,6 +214,29 @@ def save_tables(
     convention under ``rank<p>/``); ``rank_meta`` rides in that rank's
     stage record and lands merged in the manifest as
     ``meta["ranks"][str(p)]``."""
+    from multiverso_tpu.obs import recorder, span
+
+    with span("ckpt.save", dir=os.path.basename(directory)):
+        path = _save_tables_impl(
+            directory, tables, step=step, meta=meta,
+            rank_payload=rank_payload, rank_meta=rank_meta,
+        )
+    recorder.record(
+        "checkpoint_saved", path=path,
+        step=-1 if step is None else int(step),
+    )
+    return path
+
+
+def _save_tables_impl(
+    directory: str,
+    tables: Optional[List[Any]],
+    *,
+    step: Optional[int],
+    meta: Optional[Dict],
+    rank_payload: Optional[Callable[[str], None]],
+    rank_meta: Optional[Dict],
+) -> str:
     import orbax.checkpoint as ocp
 
     from multiverso_tpu.tables.kv_table import KVTable
@@ -338,6 +361,13 @@ def load_arrays(directory: str) -> Dict[str, np.ndarray]:
     ``{"table_<id>": storage}`` as host arrays, ready for
     ``TableServer.publish`` / ``restore`` (optimizer slots are restored
     by ``restore_tables`` only — serving reads weights, not momenta)."""
+    from multiverso_tpu.obs import span
+
+    with span("ckpt.load_arrays", dir=os.path.basename(directory)):
+        return _load_arrays_impl(directory)
+
+
+def _load_arrays_impl(directory: str) -> Dict[str, np.ndarray]:
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
@@ -466,6 +496,22 @@ def restore_tables(
     ``_restore_dense_resharded``). The default path restores the physical
     tree straight onto the live shardings — bit-exact and zero-copy-ish,
     but only valid when the topology matches the writer's."""
+    from multiverso_tpu.obs import recorder, span
+
+    with span("ckpt.restore", dir=os.path.basename(directory),
+              reshard=reshard):
+        _restore_tables_impl(directory, tables, reshard=reshard)
+    recorder.record(
+        "checkpoint_restored", path=directory, reshard=bool(reshard)
+    )
+
+
+def _restore_tables_impl(
+    directory: str,
+    tables: Optional[List[Any]],
+    *,
+    reshard: bool,
+) -> None:
     import orbax.checkpoint as ocp
 
     from multiverso_tpu.tables.kv_table import KVTable

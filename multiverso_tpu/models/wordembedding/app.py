@@ -37,6 +37,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from multiverso_tpu import obs
+# module-level (not lazy): -health_port/-metrics_port must be REGISTERED
+# before MV_Init parses a pure trainer's argv, or the flags silently
+# pass through as unconsumed arguments
+from multiverso_tpu.serving import http_health
 from multiverso_tpu.models.wordembedding.dictionary import Dictionary
 from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
 from multiverso_tpu.models.wordembedding.pipeline import BatchPipeline, PrefetchPipeline
@@ -274,7 +279,7 @@ class _PSCommsStats:
         self.push_bytes_wire = 0   # bytes actually moved
         from multiverso_tpu.utils.dashboard import Dashboard
 
-        Dashboard.add_section("ps_comms", self.lines)
+        Dashboard.add_section("ps_comms", self.lines, snapshot=self.to_dict)
 
     def add_pull(self, dt: float, rows_dense: int, rows_wire: int,
                  bytes_wire: Optional[int] = None) -> None:
@@ -906,6 +911,10 @@ class WordEmbedding:
         from multiverso_tpu.utils.dashboard import monitor
 
         chaos.maybe_hang_collective(round_idx)  # hung-collective drills
+        with obs.span("ps.round.pull", round=round_idx):
+            return self._ps_pull_round_inner(blk, round_idx, monitor)
+
+    def _ps_pull_round_inner(self, blk, round_idx: int, monitor):
         o = self.opt
         t0 = time.perf_counter()
         have = blk is not None
@@ -1055,7 +1064,7 @@ class WordEmbedding:
         return payloads, o.batch_size * nb, loss
 
     def _ps_push_round(self, payloads, ids_in, ids_out, n_in, n_out,
-                       inc: int) -> int:
+                       inc: int, round_idx: int = -1) -> int:
         """Comms-thread push task: apply every table's (possibly packed)
         averaged delta in the fixed entry order, compensate the local row
         caches with this client's own contribution, then run the shared
@@ -1072,7 +1081,7 @@ class WordEmbedding:
         # containment path whether the drained boundary is CLEAN (no push
         # died between its first and last table collective)
         self._ps_push_entered += 1
-        with monitor("ps.push"):
+        with obs.span("ps.round.push", round=round_idx), monitor("ps.push"):
             for name, table, side in self._ps_entries():
                 ids_b = ids_in if side == "in" else ids_out
                 n_u = n_in if side == "in" else n_out
@@ -1524,6 +1533,11 @@ class WordEmbedding:
             round_idx, failure, committed, clean, drained,
             last_ckpt or "<no checkpoint>",
         )
+        obs.recorder.record(
+            "containment", round=int(round_idx),
+            failure_kind=getattr(failure, "kind", "unknown"),
+            drained=bool(drained), committed_boundary=int(committed),
+        )
         if o.checkpoint_dir:
             os.makedirs(o.checkpoint_dir, exist_ok=True)
             path = os.path.join(
@@ -1533,6 +1547,13 @@ class WordEmbedding:
             with open(tmp, "w") as f:
                 json.dump(report, f, indent=1)
             os.replace(tmp, path)
+            # the flight recorder's last-N-events timeline lands next to
+            # the FAILURE report — the ready-made post-mortem the
+            # supervisor collects into its recovery log dir
+            obs.recorder.dump_for_rank(o.checkpoint_dir)
+        # the span trace survives the failure too: dump what the rings
+        # hold so the pod-wide merge shows where every thread was
+        obs.tracer.maybe_dump_from_flags()
 
     def _train_ps_pipelined(self, source, total_pairs_est: float,
                             start: float) -> float:
@@ -1651,7 +1672,9 @@ class WordEmbedding:
         self._tier_prefetch_pipe = pipe
         # one-block-ahead prep prefetch (unions/remap/presort are host
         # CPU heavy) — the reference ASyncBuffer reused as designed
-        buf = ASyncBuffer(lambda: self._ps_block_prep(next(gen)))
+        buf = ASyncBuffer(
+            lambda: self._ps_block_prep(next(gen)), name="ps.block_prep"
+        )
         loss_dev = None
         log_every = o.batch_size * max(64, S * 8)
         loop_t0 = time.perf_counter()
@@ -1717,15 +1740,20 @@ class WordEmbedding:
                 else:
                     gp = 0
                 lr = self._lr(gp / total_global)
-                payloads, inc, loss = self._ps_train_block(pull, lr)
+                with obs.span("ps.round.train", round=r):
+                    payloads, inc, loss = self._ps_train_block(pull, lr)
                 push_tickets[r] = pipe.submit(
-                    lambda pl=payloads, p=pull, i=inc: self._ps_push_round(
-                        pl, p["ids_in"], p["ids_out"], p["n_in"],
-                        p["n_out"], i,
+                    lambda pl=payloads, p=pull, i=inc, rr=r: (
+                        self._ps_push_round(
+                            pl, p["ids_in"], p["ids_out"], p["n_in"],
+                            p["n_out"], i, rr,
+                        )
                     ),
                     tag=f"push:{r}",
                 )
                 self._ps_lr_trace.append(lr)
+                # flight recorder: round boundary (the post-mortem's spine)
+                obs.recorder.record("round", round=r, lr=round(lr, 6))
                 if loss is not None:
                     loss_dev = loss
                 prev = pairs_done
@@ -1820,28 +1848,36 @@ class WordEmbedding:
         ids_in[: len(uin)] = uin
         ids_out = np.zeros(no, np.int64)
         ids_out[: len(uout)] = uout
-        Win = np.asarray(self._t_in.get_rows_local(ids_in), np.float32).copy()
-        Win[len(uin):] = 0.0
-        Wout = np.asarray(self._t_out.get_rows_local(ids_out), np.float32).copy()
-        Wout[len(uout):] = 0.0
-        if o.use_adagrad:
-            G2in = np.asarray(
-                self._t_g2_in.get_rows_local(ids_in), np.float32
+        # obs: the sync rounds run all three legs on the training thread —
+        # the same span names as the pipelined path, so traces compare
+        with obs.span("ps.round.pull"):
+            Win = np.asarray(
+                self._t_in.get_rows_local(ids_in), np.float32
             ).copy()
-            G2in[len(uin):] = 0.0
-            G2out = np.asarray(
-                self._t_g2_out.get_rows_local(ids_out), np.float32
+            Win[len(uin):] = 0.0
+            Wout = np.asarray(
+                self._t_out.get_rows_local(ids_out), np.float32
             ).copy()
-            G2out[len(uout):] = 0.0
+            Wout[len(uout):] = 0.0
+            if o.use_adagrad:
+                G2in = np.asarray(
+                    self._t_g2_in.get_rows_local(ids_in), np.float32
+                ).copy()
+                G2in[len(uin):] = 0.0
+                G2out = np.asarray(
+                    self._t_g2_out.get_rows_local(ids_out), np.float32
+                ).copy()
+                G2out[len(uout):] = 0.0
         if not batches:
             # dry rank: participate in the pull/push collectives only
             zin = np.zeros((ni, o.size), np.float32)
             zout = np.zeros((no, o.size), np.float32)
-            self._t_in.add_rows_local(ids_in, zin)
-            self._t_out.add_rows_local(ids_out, zout)
-            if o.use_adagrad:
-                self._t_g2_in.add_rows_local(ids_in, zin)
-                self._t_g2_out.add_rows_local(ids_out, zout)
+            with obs.span("ps.round.push"):
+                self._t_in.add_rows_local(ids_in, zin)
+                self._t_out.add_rows_local(ids_out, zout)
+                if o.use_adagrad:
+                    self._t_g2_in.add_rows_local(ids_in, zin)
+                    self._t_g2_out.add_rows_local(ids_out, zout)
             return True, None
         params = {"emb_in": jnp.asarray(Win), "emb_out": jnp.asarray(Wout)}
         if o.use_adagrad:
@@ -1883,23 +1919,30 @@ class WordEmbedding:
             for k in remapped[0]
             if remapped[0][k] is not None
         }
-        new_params, loss = step(params, xs, jnp.float32(lr))
-        # AddDeltaParameter: (new - old) / num_workers back into the tables
-        # (full padded bucket; padding rows start 0 and train nothing, so
-        # their delta is exactly 0)
-        din = np.asarray(new_params["emb_in"]) - Win
-        din[len(uin):] = 0.0
-        dout = np.asarray(new_params["emb_out"]) - Wout
-        dout[len(uout):] = 0.0
-        self._t_in.add_rows_local(ids_in, din / self._num_workers)
-        self._t_out.add_rows_local(ids_out, dout / self._num_workers)
-        if o.use_adagrad:
-            dg_in = np.asarray(new_params["g2_in"]) - G2in
-            dg_in[len(uin):] = 0.0
-            dg_out = np.asarray(new_params["g2_out"]) - G2out
-            dg_out[len(uout):] = 0.0
-            self._t_g2_in.add_rows_local(ids_in, dg_in / self._num_workers)
-            self._t_g2_out.add_rows_local(ids_out, dg_out / self._num_workers)
+        with obs.span("ps.round.train"):
+            new_params, loss = step(params, xs, jnp.float32(lr))
+            # AddDeltaParameter deltas: (new - old) / num_workers
+            # (full padded bucket; padding rows start 0 and train
+            # nothing, so their delta is exactly 0)
+            din = np.asarray(new_params["emb_in"]) - Win
+            din[len(uin):] = 0.0
+            dout = np.asarray(new_params["emb_out"]) - Wout
+            dout[len(uout):] = 0.0
+            if o.use_adagrad:
+                dg_in = np.asarray(new_params["g2_in"]) - G2in
+                dg_in[len(uin):] = 0.0
+                dg_out = np.asarray(new_params["g2_out"]) - G2out
+                dg_out[len(uout):] = 0.0
+        with obs.span("ps.round.push"):
+            self._t_in.add_rows_local(ids_in, din / self._num_workers)
+            self._t_out.add_rows_local(ids_out, dout / self._num_workers)
+            if o.use_adagrad:
+                self._t_g2_in.add_rows_local(
+                    ids_in, dg_in / self._num_workers
+                )
+                self._t_g2_out.add_rows_local(
+                    ids_out, dg_out / self._num_workers
+                )
         return True, loss
 
     def _train_ps(self, source, total_pairs_est: float, start: float) -> float:
@@ -2420,6 +2463,21 @@ class WordEmbedding:
         # this thread owns the training loop: the depth-0 PS sync points
         # dispatch table collectives from it (thread-identity guard, R1)
         register_training_thread()
+        # obs: a pure trainer answers /healthz, /readyz and /metrics
+        # itself when -health_port is armed (a TableServer in the same
+        # process starts its own endpoint through start(); a taken port
+        # logs and degrades, it never kills training)
+        health = http_health.maybe_start_from_flags(None)
+        try:
+            return self._train_dispatch(ids)
+        finally:
+            # the span trace dumps whether training finished or raised —
+            # crash traces are the ones worth reading
+            obs.tracer.maybe_dump_from_flags()
+            if health is not None:
+                health.stop()
+
+    def _train_dispatch(self, ids: Optional[np.ndarray] = None) -> float:
         o = self.opt
         # not ready until the chosen path's tables exist and any resume
         # landed (each path flips it back on right before its loop)

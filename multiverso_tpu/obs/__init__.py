@@ -1,0 +1,31 @@
+"""Observability layer: span tracer, metrics registry, flight recorder.
+
+The cross-cutting layer every subsystem attaches to once (ISSUE 9):
+
+* :mod:`multiverso_tpu.obs.tracer` — thread-local event rings recording
+  begin/end spans with no locks on the hot path; ``-trace_dir`` dumps
+  per-rank Chrome-trace/Perfetto JSON and
+  ``python -m multiverso_tpu.obs merge`` aligns rank clocks (via the
+  anchor stamped at ``multihost.initialize``) into one pod-wide trace.
+* :mod:`multiverso_tpu.obs.metrics` — dict-valued Dashboard section
+  twins rendered as Prometheus text at ``GET /metrics`` on the
+  ``HealthServer``, with interval rates; ``registry.observe()`` is the
+  same feed the staleness-adaptive depth controller will consume.
+* :mod:`multiverso_tpu.obs.flight` — a bounded ring of recent
+  structured events dumped as ``flight-recorder-rank<p>.jsonl`` next to
+  the FAILURE report on containment, collected by the ``PodSupervisor``.
+"""
+
+from multiverso_tpu.obs import flight, metrics, tracer
+from multiverso_tpu.obs.flight import recorder
+from multiverso_tpu.obs.tracer import event, span, tracing_enabled
+
+__all__ = [
+    "tracer",
+    "metrics",
+    "flight",
+    "span",
+    "event",
+    "tracing_enabled",
+    "recorder",
+]

@@ -1,0 +1,85 @@
+"""CLI: ``python -m multiverso_tpu.obs <merge|validate|summary> ...``.
+
+* ``merge <dir-or-files...> -o pod.json`` — align per-rank dumps on the
+  shared anchor and emit one pod-wide Perfetto-loadable trace (exit 2 if
+  ``--expect-ranks`` is given and fewer rank dumps were found, exit 1 if
+  the merged document fails validation).
+* ``validate <file.json>`` — schema-check a dump (exit 1 on problems).
+* ``summary <file.json>`` — per-rank complete-span counts, one
+  ``rank=<p> name=<span> count=<n>`` line each (what the ci smoke
+  parses).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from multiverso_tpu.obs.trace_tools import (
+    load_trace,
+    merge_traces,
+    resolve_inputs,
+    span_counts,
+    validate_trace,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m multiverso_tpu.obs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="merge per-rank dumps into one trace")
+    mp.add_argument("inputs", nargs="+",
+                    help="trace files or directories of trace-rank*.json")
+    mp.add_argument("-o", "--out", required=True)
+    mp.add_argument("--expect-ranks", type=int, default=0,
+                    help="fail unless at least this many rank dumps merge")
+    vp = sub.add_parser("validate", help="schema-check one trace file")
+    vp.add_argument("file")
+    sp = sub.add_parser("summary", help="per-rank span counts")
+    sp.add_argument("file")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "merge":
+        paths = resolve_inputs(args.inputs)
+        if not paths:
+            print("no trace files found", file=sys.stderr)
+            return 2
+        docs = [load_trace(p) for p in paths]
+        merged = merge_traces(docs)
+        nranks = len(merged["otherData"]["ranks"])
+        if args.expect_ranks and nranks < args.expect_ranks:
+            print(
+                f"expected >= {args.expect_ranks} ranks, merged {nranks}",
+                file=sys.stderr,
+            )
+            return 2
+        problems = validate_trace(merged)
+        if problems:
+            for p in problems[:20]:
+                print(f"invalid: {p}", file=sys.stderr)
+            return 1
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(
+            f"merged {len(paths)} dump(s), {nranks} rank(s), "
+            f"{len(merged['traceEvents'])} events -> {args.out}"
+        )
+        return 0
+
+    doc = load_trace(args.file)
+    if args.cmd == "validate":
+        problems = validate_trace(doc)
+        for p in problems[:50]:
+            print(f"invalid: {p}", file=sys.stderr)
+        print("valid" if not problems else f"{len(problems)} problem(s)")
+        return 1 if problems else 0
+
+    # summary
+    for (rank, name), n in sorted(span_counts(doc).items()):
+        print(f"rank={rank} name={name} count={n}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Flight recorder: a bounded ring of recent structured events.
+
+Every failure post-mortem so far (the XLA rendezvous deadlock, the
+compilation-cache poisoning, the gloo aborts) was reconstructed by hand
+from interleaved logs. The flight recorder keeps the reconstruction
+ready-made: subsystems append small structured events — round
+boundaries, ticket-wait p99 breaches, serving hot-swaps, breaker
+transitions, GuardViolations, heartbeat gaps, quorum commits/aborts —
+into one process-wide bounded deque (oldest evicted), and on any
+RankFailure / containment / supervisor give-up the ring is dumped as
+``flight-recorder-rank<p>.jsonl`` next to the FAILURE report. The
+``PodSupervisor`` collects the dumps into its recovery log dir per
+failed generation.
+
+Recording is always on (it is a *crash* recorder — by the time you know
+you need it, it is too late to arm it): one lock + deque append per
+event, and events fire at round/failure granularity, never per element.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from multiverso_tpu.utils.log import Log
+
+__all__ = ["FlightRecorder", "recorder", "DUMP_PREFIX"]
+
+DUMP_PREFIX = "flight-recorder-rank"
+
+
+class FlightRecorder:
+    """Bounded ring of ``{"seq", "wall", "mono_ns", "kind", ...}`` events.
+
+    ``wall`` is for the human reading the dump next to log lines;
+    correlation with the span trace goes through ``mono_ns`` (same clock
+    as the tracer). Injectable clocks keep tests deterministic."""
+
+    def __init__(
+        self,
+        capacity: int = 1024,
+        wall: Callable[[], float] = time.time,
+        mono_ns: Callable[[], int] = time.monotonic_ns,
+    ):
+        self._events: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._wall = wall
+        self._mono_ns = mono_ns
+
+    def record(self, kind: str, **fields: Any) -> None:
+        ev = {
+            "seq": 0, "wall": self._wall(), "mono_ns": self._mono_ns(),
+            "kind": str(kind), **fields,
+        }
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+
+    def dump(self, path: str) -> str:
+        """Write the ring as JSONL (atomic tmp+rename); oldest first."""
+        events = self.snapshot()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev, default=str) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    def dump_for_rank(
+        self, directory: str, rank: Optional[int] = None
+    ) -> Optional[str]:
+        """``<directory>/flight-recorder-rank<p>.jsonl`` — the name the
+        supervisor's collection pass and the triage runbook look for.
+        Never raises: the dump rides failure paths that must not be
+        masked by a full disk."""
+        if rank is None:
+            try:
+                import jax
+
+                rank = int(jax.process_index())
+            except Exception:  # noqa: BLE001 — recorder works without jax
+                rank = 0
+        path = os.path.join(directory, f"{DUMP_PREFIX}{rank}.jsonl")
+        try:
+            self.dump(path)
+        except OSError as e:
+            Log.Error("flight recorder dump to %s failed: %s", path, e)
+            return None
+        Log.Info("flight recorder dumped: %s (%d events)",
+                 path, len(self.snapshot()))
+        return path
+
+
+recorder = FlightRecorder()

@@ -1,0 +1,186 @@
+"""Structured metrics registry: Dashboard snapshots -> Prometheus text.
+
+The Dashboard's display sections are human strings; this module is
+their machine-readable twin. ``Dashboard.add_section(name, fn,
+snapshot=...)`` registers a dict-valued snapshot next to the display
+callable, and the registry here:
+
+* collects every snapshot plus the always-present module singletons
+  (``failure_domain``, ``resilience``) and the Monitor/Counter core
+  into named **families**;
+* computes **interval deltas** between successive collections —
+  ``*_rate_per_s`` for every numeric that moved monotonically up since
+  the last scrape (QPS-style rates, not just lifetime totals);
+* renders the whole thing as Prometheus text exposition, served at
+  ``GET /metrics`` on the existing ``HealthServer``.
+
+``registry.observe()`` is also the programmatic feed: the
+staleness-adaptive depth controller consumes the same
+``{families, flat, rates, interval_s}`` snapshot the scraper sees.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from multiverso_tpu.utils.log import Log
+
+__all__ = [
+    "MetricsRegistry",
+    "registry",
+    "render_prometheus",
+    "CONTENT_TYPE",
+]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    out = _NAME_SANITIZE_RE.sub("_", name).strip("_")
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out or "unnamed"
+
+
+def _family_of(section: str) -> str:
+    """Section name -> stable family name: drop pure-numeric components
+    (the ``serving.<name>.<id(self)>`` instance key must not leak an
+    address into metric names), collapse consecutive repeats
+    (``serving.serving`` -> ``serving``)."""
+    parts = [p for p in section.split(".") if p and not p.isdigit()]
+    collapsed: List[str] = []
+    for p in parts:
+        if not collapsed or collapsed[-1] != p:
+            collapsed.append(p)
+    return _sanitize("_".join(collapsed) or section)
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> List[Tuple[str, float]]:
+    """Numeric leaves of a (possibly nested) snapshot dict; bools count
+    as 0/1, strings/None are skipped (they are labels, not samples).
+    Keys sort by str() so a mixed-key dict (int ranks next to string
+    names) cannot throw out of a scrape."""
+    out: List[Tuple[str, float]] = []
+    for k in sorted(d, key=str):
+        v = d[k]
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.extend(_flatten(v, prefix=f"{key}_"))
+        elif isinstance(v, bool):
+            out.append((key, 1.0 if v else 0.0))
+        elif isinstance(v, (int, float)):
+            out.append((key, float(v)))
+    return out
+
+
+class MetricsRegistry:
+    """Collects Dashboard snapshot families and keeps the previous
+    collection so successive scrapes carry interval rates."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._prev: Dict[str, float] = {}
+        self._prev_t: Optional[float] = None
+
+    def families(self) -> Dict[str, Dict[str, Any]]:
+        from multiverso_tpu.resilience import stats as rstats
+        from multiverso_tpu.resilience.watchdog import fd_stats
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        fams: Dict[str, Dict[str, Any]] = {
+            # always present, registered section or not: the operator's
+            # scrape must see these families from the first request
+            "failure_domain": fd_stats.to_dict(),
+            "resilience": rstats.to_dict(),
+            "core": Dashboard.core_metrics(),
+        }
+        for section, snap in Dashboard.snapshots().items():
+            fam = _family_of(section)
+            if fam in fams:
+                fams[fam].update(snap)  # e.g. two serving bundles
+            else:
+                fams[fam] = snap
+        return fams
+
+    def observe(self) -> Dict[str, Any]:
+        """One collection: ``families`` (raw snapshot dicts), ``flat``
+        (``family:key -> value`` numeric view), ``rates`` (per-second
+        delta for every numeric that moved monotonically up since the
+        previous call), ``interval_s``. This is both the /metrics
+        payload and the depth controller's observation input."""
+        fams = self.families()
+        flat: Dict[str, float] = {}
+        for fam, d in fams.items():
+            try:
+                for key, val in _flatten(d):
+                    flat[f"{fam}:{key}"] = val
+            except Exception as e:  # noqa: BLE001 — one bad section must
+                # not take the whole scrape down
+                Log.Error("metrics family %s failed to flatten: %s", fam, e)
+        now = self._clock()
+        with self._lock:
+            dt = 0.0 if self._prev_t is None else max(
+                now - self._prev_t, 1e-9
+            )
+            rates: Dict[str, float] = {}
+            if self._prev_t is not None:
+                for k, v in flat.items():
+                    pv = self._prev.get(k)
+                    if pv is not None and v > pv:
+                        rates[k] = (v - pv) / dt
+            self._prev = flat
+            self._prev_t = now
+        return {
+            "families": fams, "flat": flat, "rates": rates,
+            "interval_s": dt,
+        }
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._prev = {}
+            self._prev_t = None
+
+
+registry = MetricsRegistry()
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def render_prometheus(reg: Optional[MetricsRegistry] = None) -> str:
+    """Prometheus text exposition of one ``observe()`` collection:
+    gauges ``mv_<family>_<key>`` plus ``..._rate_per_s`` interval
+    deltas. Duplicate names (two same-named serving bundles) keep the
+    first sample — a scrape must never 500 on a name collision."""
+    obs = (reg or registry).observe()
+    lines: List[str] = []
+    seen: set = set()
+    # render from observe()'s already-flattened view: it carries the
+    # per-family error guard (a broken provider is skipped there, and a
+    # second _flatten here could throw past it) and halves the work
+    for k in sorted(obs["flat"]):
+        fam, _, key = k.partition(":")
+        metric = "mv_" + _sanitize(f"{fam}_{key}")
+        if metric in seen:
+            continue
+        seen.add(metric)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {_fmt(obs['flat'][k])}")
+    for k in sorted(obs["rates"]):
+        metric = "mv_" + _sanitize(k.replace(":", "_")) + "_rate_per_s"
+        if metric in seen:
+            continue
+        seen.add(metric)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {repr(obs['rates'][k])}")
+    lines.append(f"mv_scrape_interval_s {repr(obs['interval_s'])}")
+    return "\n".join(lines) + "\n"

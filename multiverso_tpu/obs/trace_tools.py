@@ -1,0 +1,115 @@
+"""Pod-wide trace assembly: merge / validate / summarize rank dumps.
+
+A per-rank ``trace-rank<p>.json`` carries raw monotonic timestamps plus
+the rank's anchor (stamped at the ``multihost.initialize`` rendezvous
+barrier — the one instant every rank shares). ``merge_traces`` subtracts
+each rank's anchor so the pod lands on one timeline: round k's pull /
+train / push spans line up across ranks, and the overlap (or its
+absence) is visible per round per rank in Perfetto.
+
+Kept jax-free and stdlib-only: the merge runs on a laptop against dumps
+scp'd off a pod.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, Iterable, List, Tuple
+
+__all__ = [
+    "load_trace",
+    "merge_traces",
+    "validate_trace",
+    "span_counts",
+    "resolve_inputs",
+]
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def resolve_inputs(paths: Iterable[str]) -> List[str]:
+    """Each input is a trace file or a directory of ``trace-rank*.json``."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "trace-rank*.json"))))
+        else:
+            out.append(p)
+    return out
+
+
+def merge_traces(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Align every rank's events onto one timeline (ts -> microseconds
+    since that rank's anchor) and concatenate. ``pid`` stays the rank,
+    so Perfetto shows one process lane per rank with its real threads."""
+    events: List[dict] = []
+    ranks: Dict[str, Dict[str, Any]] = {}
+    for doc in docs:
+        other = doc.get("otherData", {})
+        rank = int(other.get("rank", 0))
+        anchor_us = float(other.get("anchor_mono_us", 0.0))
+        ranks[str(rank)] = {
+            "anchor_wall": other.get("anchor_wall"),
+            "anchor_source": other.get("anchor_source"),
+            "dropped_events": other.get("dropped_events", 0),
+            "unmatched_ends": other.get("unmatched_ends", 0),
+        }
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) - anchor_us
+            ev["pid"] = rank
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"merged": True, "ranks": ranks},
+    }
+
+
+def validate_trace(doc: Dict[str, Any]) -> List[str]:
+    """Schema check for the Chrome-trace subset we emit (what the ci
+    smoke and the dump tests gate on). Empty list = valid."""
+    problems: List[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i} is not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            problems.append(f"event {i} has no name")
+        ph = ev.get("ph")
+        if ph not in ("X", "B", "E", "i", "M"):
+            problems.append(f"event {i} has unknown ph {ph!r}")
+            continue
+        if ph != "M":
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"event {i} ({ev.get('name')}) has no ts")
+            if "pid" not in ev or "tid" not in ev:
+                problems.append(f"event {i} ({ev.get('name')}) missing pid/tid")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(
+                    f"event {i} ({ev.get('name')}) has bad dur {dur!r}"
+                )
+    return problems
+
+
+def span_counts(doc: Dict[str, Any]) -> Dict[Tuple[int, str], int]:
+    """(rank, span name) -> complete-span count; the ci smoke checks the
+    per-rank ``ps.round.*`` counts against the round count."""
+    counts: Dict[Tuple[int, str], int] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "X":
+            key = (int(ev.get("pid", 0)), ev["name"])
+            counts[key] = counts.get(key, 0) + 1
+    return counts

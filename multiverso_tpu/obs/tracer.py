@@ -1,0 +1,387 @@
+"""Low-overhead span tracer: thread-local event rings -> Chrome trace.
+
+The Dashboard answers "how much time, cumulatively" — the three carried
+ROADMAP mysteries (the fused leg's roofline gap, the 0.14x weak-scaling
+number, the staleness-adaptive depth controller's observation input) are
+*timeline* questions across three threads and N ranks: did pull k+1
+actually overlap train k, on every rank, every round? This module
+answers those:
+
+* ``span(name, **args)`` / ``event(name, **args)`` record
+  ``(monotonic_ns, tid, name, args)`` begin/end (or instant) entries
+  into a **thread-local preallocated ring** — no locks on the hot path
+  (each ring has exactly one writer; readers snapshot under the GIL),
+  overflow drops-oldest by construction (modular write index). Tracing
+  off is one cached-bool check; no ring is touched.
+* ``dump()`` renders every ring as Chrome-trace / Perfetto JSON
+  (``ph: "X"`` complete events from paired begin/end, ``"i"`` instants,
+  ``"B"`` for spans still open at dump time) with ``pid`` = rank and
+  ``tid`` = OS thread id, so the comms worker / training thread /
+  ASyncBuffer fill thread land as separate tracks.
+* timestamps stay RAW monotonic microseconds; the dump carries this
+  rank's **anchor** (the monotonic reading taken at the
+  ``multihost.initialize`` rendezvous barrier — the one instant all
+  ranks share). ``python -m multiverso_tpu.obs merge`` subtracts each
+  rank's anchor to align the clocks into one pod-wide timeline.
+
+Flags: ``-trace_dir`` arms tracing and names the per-rank dump
+directory (``trace-rank<p>.json``); ``-trace_ring_events`` sizes the
+per-thread ring. ``enable()`` arms ring recording programmatically
+without a dump directory (the bench's ring-only overhead leg).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from multiverso_tpu.utils.configure import (
+    GetFlag,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    mutation_count,
+)
+from multiverso_tpu.utils.log import Log
+
+__all__ = [
+    "span",
+    "event",
+    "tracing_enabled",
+    "enable",
+    "disable",
+    "set_anchor",
+    "exchange_anchor",
+    "anchor",
+    "dump",
+    "maybe_dump_from_flags",
+    "reset_for_tests",
+]
+
+MV_DEFINE_string(
+    "trace_dir", "",
+    "arm the span tracer and dump each rank's Chrome-trace/Perfetto JSON "
+    "to this directory as trace-rank<p>.json at the end of training (and "
+    "on rank-failure containment); merge the per-rank dumps with "
+    "`python -m multiverso_tpu.obs merge <dir>` (empty = tracing off)",
+)
+MV_DEFINE_int(
+    "trace_ring_events", 65536,
+    "per-thread preallocated trace ring capacity in events; overflow "
+    "drops the OLDEST events (the dump records how many were dropped)",
+)
+
+# enabled is checked on every span/event — cache it against the flag
+# registry's mutation counter (same pattern as guards.guards_enabled)
+_enabled_cache: Optional[bool] = None
+_enabled_gen = -1
+_force_enabled = False
+
+
+def tracing_enabled() -> bool:
+    global _enabled_cache, _enabled_gen
+    if _force_enabled:
+        return True
+    gen = mutation_count()
+    if _enabled_cache is None or _enabled_gen != gen:
+        _enabled_cache = bool(GetFlag("trace_dir"))
+        _enabled_gen = gen
+    return _enabled_cache
+
+
+def enable() -> None:
+    """Arm ring recording without a dump directory (ring-only mode —
+    the bench overhead leg, tests)."""
+    global _force_enabled
+    _force_enabled = True
+
+
+def disable() -> None:
+    global _force_enabled
+    _force_enabled = False
+
+
+# ----------------------------------------------------------------- rings
+
+
+class _Ring:
+    """One thread's preallocated event ring. Single writer (the owning
+    thread); ``slots[i % cap] = tuple`` is atomic under the GIL, so a
+    dumper reading a snapshot can at worst observe a half-rotated window
+    — never a torn event. Overflow overwrites the oldest slot."""
+
+    __slots__ = ("thread_name", "ident", "cap", "slots", "idx", "gen")
+
+    def __init__(self, thread_name: str, ident: int, cap: int, gen: int):
+        self.thread_name = thread_name
+        self.ident = ident
+        self.cap = cap
+        self.slots: List[Optional[tuple]] = [None] * cap
+        self.idx = 0
+        self.gen = gen
+
+    def record(self, ph: str, ts_ns: int, name: str,
+               args: Optional[Dict[str, Any]]) -> None:
+        i = self.idx
+        self.slots[i % self.cap] = (ts_ns, ph, name, args)
+        self.idx = i + 1
+
+    def chronological(self) -> Tuple[List[tuple], int]:
+        """Snapshot -> (events oldest-first, dropped_count)."""
+        idx = self.idx
+        slots = list(self.slots)
+        if idx <= self.cap:
+            evs = [e for e in slots[:idx] if e is not None]
+            return evs, 0
+        start = idx % self.cap
+        evs = [e for e in slots[start:] + slots[:start] if e is not None]
+        return evs, idx - self.cap
+
+
+_registry: List[_Ring] = []
+_registry_lock = threading.Lock()
+_tls = threading.local()
+_generation = 0
+
+
+def _ring() -> _Ring:
+    r = getattr(_tls, "ring", None)
+    if r is None or r.gen != _generation:
+        cap = max(16, int(GetFlag("trace_ring_events")))
+        t = threading.current_thread()
+        ident = threading.get_ident()
+        with _registry_lock:
+            # recycle a DEAD thread's ring instead of growing the
+            # registry: ASyncBuffer spawns one fill thread per block, and
+            # a preallocated ring per block would leak ~cap slots each
+            # (multi-GB over a long run). A dead thread can never write
+            # again, so single-writer stays intact; its surviving events
+            # keep riding the recycled ring and land on the inheriting
+            # thread's track at dump time (for the serial fill threads
+            # that is one continuous track — the readable rendering).
+            live = {th.ident for th in threading.enumerate()}
+            r = next(
+                (x for x in _registry
+                 if x.cap == cap and x.ident not in live),
+                None,
+            )
+            if r is not None:
+                r.ident = ident
+                r.thread_name = t.name
+                r.gen = _generation
+            else:
+                r = _Ring(t.name, ident, cap, _generation)
+                _registry.append(r)
+        _tls.ring = r
+    return r
+
+
+# ------------------------------------------------------------- span/event
+
+
+class span:
+    """``with span("ps.round.train", round=r):`` — records a begin/end
+    pair on this thread's ring. Exceptions propagate unchanged (the end
+    event still lands, so a crash dump shows where the time went)."""
+
+    __slots__ = ("_name", "_args", "_on")
+
+    def __init__(self, name: str, **args: Any):
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "span":
+        on = tracing_enabled()
+        self._on = on
+        if on:
+            _ring().record(
+                "B", time.monotonic_ns(), self._name, self._args or None
+            )
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._on:
+            _ring().record("E", time.monotonic_ns(), self._name, None)
+        return False
+
+
+def event(name: str, **args: Any) -> None:
+    """Instant event on this thread's timeline."""
+    if tracing_enabled():
+        _ring().record("i", time.monotonic_ns(), name, args or None)
+
+
+# ----------------------------------------------------------------- anchor
+
+_anchor: Dict[str, Any] = {
+    "mono_ns": time.monotonic_ns(),
+    "wall": time.time(),
+    "source": "import",
+}
+
+
+def set_anchor(source: str = "local") -> None:
+    """Stamp this rank's clock anchor: the monotonic reading taken at a
+    moment all ranks share (the rendezvous barrier). The merge tool
+    subtracts each rank's anchor to align timelines."""
+    _anchor["mono_ns"] = time.monotonic_ns()
+    _anchor["wall"] = time.time()
+    _anchor["source"] = source
+
+
+def anchor() -> Dict[str, Any]:
+    return dict(_anchor)
+
+
+def exchange_anchor(timeout_s: float = 60.0) -> None:
+    """Cross-rank anchor exchange at ``multihost.initialize``: wait on
+    the coordination service's barrier so every rank stamps its anchor
+    at (approximately) the same instant, then stamp. Best-effort — with
+    no KV barrier available the local stamp still anchors the dump
+    (merge alignment degrades to wall-clock skew, which the merged
+    trace's otherData records)."""
+    try:
+        from multiverso_tpu.parallel.multihost import kv_client
+
+        client = kv_client()
+        if client is not None and hasattr(client, "wait_at_barrier"):
+            client.wait_at_barrier(
+                "mv_trace_anchor", int(timeout_s * 1000)
+            )
+    except Exception as e:  # noqa: BLE001 — anchor quality is best-effort
+        Log.Info("trace anchor barrier unavailable (%s); local stamp", e)
+    set_anchor("multihost")
+
+
+# ------------------------------------------------------------------ dump
+
+
+def _pair_ring(ring_events: List[tuple]) -> Tuple[List[dict], int]:
+    """B/E pairs -> 'X' complete events (ts/dur in raw monotonic us);
+    unmatched ends (their begin was dropped by overflow) are discarded
+    and counted; spans still open at dump time stay as 'B'."""
+    out: List[dict] = []
+    stack: List[tuple] = []
+    unmatched = 0
+    for ts_ns, ph, name, args in ring_events:
+        if ph == "B":
+            stack.append((ts_ns, name, args))
+        elif ph == "E":
+            if stack and stack[-1][1] == name:
+                b_ts, b_name, b_args = stack.pop()
+                ev = {
+                    "name": b_name, "ph": "X", "cat": "mv",
+                    "ts": b_ts / 1e3, "dur": (ts_ns - b_ts) / 1e3,
+                }
+                if b_args:
+                    ev["args"] = b_args
+                out.append(ev)
+            else:
+                unmatched += 1  # begin fell off the ring
+        else:  # instant
+            ev = {
+                "name": name, "ph": "i", "cat": "mv", "ts": ts_ns / 1e3,
+                "s": "t",
+            }
+            if args:
+                ev["args"] = args
+            out.append(ev)
+    for b_ts, b_name, b_args in stack:  # open at dump time (crash dumps)
+        ev = {"name": b_name, "ph": "B", "cat": "mv", "ts": b_ts / 1e3}
+        if b_args:
+            ev["args"] = b_args
+        out.append(ev)
+    out.sort(key=lambda e: e["ts"])
+    return out, unmatched
+
+
+def _infer_rank() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — tracer must work without a backend
+        return 0
+
+
+def dump(path: Optional[str] = None, rank: Optional[int] = None) -> Dict:
+    """Render every thread's ring as one Chrome-trace JSON document;
+    write it atomically when ``path`` is given. Returns the document."""
+    if rank is None:
+        rank = _infer_rank()
+    with _registry_lock:
+        rings = list(_registry)
+    events: List[dict] = []
+    dropped = 0
+    unmatched = 0
+    events.append({
+        "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+        "args": {"name": f"rank{rank}"},
+    })
+    for r in rings:
+        evs, drop = r.chronological()
+        dropped += drop
+        paired, open_unmatched = _pair_ring(evs)
+        unmatched += open_unmatched
+        for ev in paired:
+            ev["pid"] = rank
+            ev["tid"] = r.ident
+        events.extend(paired)
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": rank, "tid": r.ident,
+            "args": {"name": r.thread_name},
+        })
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "rank": rank,
+            "pid": os.getpid(),
+            "anchor_mono_us": _anchor["mono_ns"] / 1e3,
+            "anchor_wall": _anchor["wall"],
+            "anchor_source": _anchor["source"],
+            "dropped_events": dropped,
+            "unmatched_ends": unmatched,
+        },
+    }
+    if path is not None:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        Log.Info("trace dumped: %s (%d events, %d dropped)",
+                 path, len(events), dropped)
+    return doc
+
+
+def maybe_dump_from_flags(rank: Optional[int] = None) -> Optional[str]:
+    """Dump ``trace-rank<p>.json`` into ``-trace_dir`` when armed."""
+    d = GetFlag("trace_dir")
+    if not d:
+        return None
+    if rank is None:
+        rank = _infer_rank()
+    path = os.path.join(d, f"trace-rank{rank}.json")
+    try:
+        dump(path, rank=rank)
+    except Exception as e:  # noqa: BLE001 — a failed dump must never
+        # mask the (possibly failing) training path that triggered it
+        Log.Error("trace dump to %s failed: %s", path, e)
+        return None
+    return path
+
+
+def reset_for_tests() -> None:
+    """Forget every ring and programmatic arm state (test isolation).
+    Live threads re-create their ring lazily on the next record."""
+    global _generation, _force_enabled, _enabled_cache
+    with _registry_lock:
+        _generation += 1
+        _registry.clear()
+    _force_enabled = False
+    _enabled_cache = None
+    set_anchor("reset")

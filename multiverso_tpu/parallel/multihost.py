@@ -302,6 +302,13 @@ def initialize(
     )
     _initialized = True
     http_health.set_ready(False, phase="initialized")
+    # obs: stamp the trace-clock anchor at the rendezvous — the one
+    # instant every rank shares. `python -m multiverso_tpu.obs merge`
+    # subtracts each rank's anchor to align the pod's monotonic clocks
+    # onto one timeline.
+    from multiverso_tpu.obs import tracer as _tracer
+
+    _tracer.exchange_anchor()
     Log.Info(
         "multihost rendezvous complete: process %d/%d, %d global device(s)",
         jax.process_index(),

@@ -36,16 +36,30 @@ class CircuitBreaker:
         threshold: int = 5,
         cooldown_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        name: str = "",
     ):
         assert threshold >= 1
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
+        self.name = name
         self._clock = clock
         self._lock = threading.Lock()
         self._state = "closed"
         self._failures = 0
         self._opened_at = 0.0
         self._probe_inflight = False
+
+    def _note_transition(self, prev: str, new: str) -> None:
+        """Flight-recorder breadcrumb for every state change — the shed
+        storm's timeline next to the rank/round events. Called OUTSIDE
+        the breaker lock."""
+        if prev == new:
+            return
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record(
+            "breaker_transition", breaker=self.name, prev=prev, new=new
+        )
 
     @property
     def state(self) -> str:
@@ -57,21 +71,28 @@ class CircuitBreaker:
         cooldown transitions to ``half_open`` and claims the probe slot —
         the caller that got True MUST follow with record_success/failure."""
         now = self._clock()
+        trans = None
         with self._lock:
             if self._state == "closed":
-                return True, 0.0
-            if self._state == "open":
+                out = (True, 0.0)
+            elif self._state == "open":
                 elapsed = now - self._opened_at
                 if elapsed < self.cooldown_s:
-                    return False, self.cooldown_s - elapsed
-                self._state = "half_open"
-                self._probe_inflight = True
-                return True, 0.0
+                    out = (False, self.cooldown_s - elapsed)
+                else:
+                    trans = ("open", "half_open")
+                    self._state = "half_open"
+                    self._probe_inflight = True
+                    out = (True, 0.0)
             # half_open: one probe at a time
-            if self._probe_inflight:
-                return False, self.cooldown_s
-            self._probe_inflight = True
-            return True, 0.0
+            elif self._probe_inflight:
+                out = (False, self.cooldown_s)
+            else:
+                self._probe_inflight = True
+                out = (True, 0.0)
+        if trans is not None:
+            self._note_transition(*trans)
+        return out
 
     def peek(self) -> Tuple[bool, float]:
         """Like ``allow`` but WITHOUT claiming the half-open probe slot or
@@ -93,19 +114,27 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            prev = self._state
             self._state = "closed"
             self._failures = 0
             self._probe_inflight = False
+        self._note_transition(prev, "closed")
 
     def record_failure(self) -> None:
         now = self._clock()
+        trans = None
         with self._lock:
             self._probe_inflight = False
             if self._state == "half_open":
                 self._state = "open"  # probe failed: full new cooldown
                 self._opened_at = now
-                return
-            self._failures += 1
-            if self._failures >= self.threshold:
-                self._state = "open"
-                self._opened_at = now
+                trans = ("half_open", "open")
+            else:
+                self._failures += 1
+                if self._failures >= self.threshold:
+                    if self._state != "open":
+                        trans = (self._state, "open")
+                    self._state = "open"
+                    self._opened_at = now
+        if trans is not None:
+            self._note_transition(*trans)

@@ -518,7 +518,8 @@ class _ResilienceStats:
         # lazy + keyed: survives Dashboard.Reset() by re-adding on next note
         from multiverso_tpu.utils.dashboard import Dashboard
 
-        Dashboard.add_section("resilience", self.lines)
+        Dashboard.add_section("resilience", self.lines,
+                              snapshot=self.to_dict)
 
     def note_save(self, step: int, path: str) -> None:
         with self._lock:

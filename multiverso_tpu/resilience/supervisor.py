@@ -311,6 +311,38 @@ class PodSupervisor:
                     pass
         return out
 
+    def _collect_flight_recorders(self, gen: int) -> List[str]:
+        """Move the ranks' ``flight-recorder-rank<p>.jsonl`` dumps (the
+        containment path writes them next to the FAILURE report) into
+        the recovery log dir, tagged with the failed generation — the
+        next generation's containment must start from a clean slate, and
+        the post-mortem wants the rings keyed by failure, not
+        overwritten by it."""
+        out: List[str] = []
+        src_dir = self.checkpoint_dir
+        if not src_dir or not os.path.isdir(src_dir) or not self.log_dir:
+            return out
+        for name in sorted(os.listdir(src_dir)):
+            if not (name.startswith("flight-recorder-rank")
+                    and name.endswith(".jsonl")):
+                continue
+            dst = os.path.join(
+                self.log_dir,
+                name.replace(".jsonl", f"-g{gen}.jsonl"),
+            )
+            try:
+                import shutil
+
+                os.makedirs(self.log_dir, exist_ok=True)
+                if os.path.exists(dst):
+                    os.remove(dst)
+                shutil.move(os.path.join(src_dir, name), dst)
+                out.append(dst)
+            except OSError as e:
+                Log.Error("[supervisor] flight recorder collect failed "
+                          "for %s: %s", name, e)
+        return out
+
     def _new_failure_reports(self) -> List[str]:
         if not self.checkpoint_dir or not os.path.isdir(self.checkpoint_dir):
             return []
@@ -441,6 +473,14 @@ class PodSupervisor:
                 kind=failure["kind"], rcs=rcs, resume_from=resume_from,
                 last_beacon_walls=beacons,
             )
+            # collect the ranks' flight-recorder dumps into the recovery
+            # log dir, keyed by the failed generation (obs subsystem)
+            collected = self._collect_flight_recorders(gen)
+            if collected:
+                self._event(
+                    "flight_recorder_collected", generation=gen,
+                    paths=collected,
+                )
             if self.budget.exhausted():
                 report = {
                     "gave_up": True,

@@ -267,9 +267,11 @@ class HeartbeatMonitor:
         self._sleep = sleep
         self._seq = 0
         now = clock()
-        # peers get a full deadline from monitor start to their first beacon
+        # peers get a full deadline from monitor start to their first
+        # beacon; [last_seq, last_seen_mono, gap_recorded] — the latch
+        # gives the flight recorder ONE heartbeat_gap event per silence
         self._peers: Dict[int, List] = {
-            p: [-1, now] for p in range(self.world) if p != self.rank
+            p: [-1, now, False] for p in range(self.world) if p != self.rank
         }
         self._failure: Optional[RankFailure] = None
         self._stop = threading.Event()
@@ -291,8 +293,18 @@ class HeartbeatMonitor:
             for peer, rec in self._peers.items():
                 seq = self.store.latest_seq(peer, hint=rec[0])
                 if seq is not None and seq != rec[0]:
-                    rec[0], rec[1] = seq, now
-                elif now - rec[1] > self.deadline_s and self._failure is None:
+                    rec[0], rec[1], rec[2] = seq, now, False
+                    continue
+                age = now - rec[1]
+                if age > self.deadline_s / 2.0 and not rec[2]:
+                    rec[2] = True
+                    from multiverso_tpu.obs.flight import recorder
+
+                    recorder.record(
+                        "heartbeat_gap", rank=peer, age_s=round(age, 3),
+                        deadline_s=self.deadline_s,
+                    )
+                if age > self.deadline_s and self._failure is None:
                     self._failure = RankFailure(
                         "heartbeat_lost",
                         f"no beacon from peer for {now - rec[1]:.2f}s "
@@ -389,6 +401,9 @@ class _FailureDomainStats:
         self._lock = threading.Lock()
         self.tickets = 0
         self._waits_ms: deque = deque(maxlen=4096)
+        # running p99 refreshed every 128 tickets: the flight recorder's
+        # breach detector must not sort 4096 floats on every wait
+        self._wait_p99_cache_ms = 0.0
         self.broken_pipes = 0
         self.drains = 0
         self.drain_timeouts = 0
@@ -408,17 +423,43 @@ class _FailureDomainStats:
         # lazy + keyed: survives Dashboard.Reset() by re-adding on next note
         from multiverso_tpu.utils.dashboard import Dashboard
 
-        Dashboard.add_section("failure_domain", self.lines)
+        Dashboard.add_section("failure_domain", self.lines,
+                              snapshot=self.to_dict)
 
     def note_ticket_wait(self, wait_s: float) -> None:
+        wait_ms = wait_s * 1e3
+        breach = False
         with self._lock:
             self.tickets += 1
-            self._waits_ms.append(wait_s * 1e3)
+            # breach check BEFORE this sample joins the window (a spike
+            # must not raise the bar it is judged against), against a bar
+            # of 3x the cached p99 with a 1ms floor — "p99 breach" in the
+            # flight recorder means "far outside the recent distribution",
+            # not the definitional 1% of samples above p99
+            p99 = self._wait_p99_cache_ms
+            if (
+                self.tickets > 128
+                and wait_ms > max(1.0, 3.0 * p99)
+            ):
+                breach = True
+            self._waits_ms.append(wait_ms)
+            if self.tickets % 128 == 0:
+                self._wait_p99_cache_ms = self._wait_pct_locked(99)
+        if breach:
+            from multiverso_tpu.obs.flight import recorder
+
+            recorder.record(
+                "ticket_wait_p99_breach", wait_ms=round(wait_ms, 3),
+                p99_ms=round(p99, 3),
+            )
         self._register()
 
     def note_broken_pipe(self) -> None:
         with self._lock:
             self.broken_pipes += 1
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record("broken_pipe")
         self._register()
 
     def note_drain(self, seconds: float, ok: bool) -> None:
@@ -427,22 +468,35 @@ class _FailureDomainStats:
             self.drain_ms_total += seconds * 1e3
             if not ok:
                 self.drain_timeouts += 1
+        if not ok:
+            from multiverso_tpu.obs.flight import recorder
+
+            recorder.record("drain_timeout", drain_s=round(seconds, 3))
         self._register()
 
     def note_quorum_commit(self) -> None:
         with self._lock:
             self.quorum_commits += 1
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record("quorum_commit")
         self._register()
 
     def note_quorum_abort(self) -> None:
         with self._lock:
             self.quorum_aborts += 1
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record("quorum_abort")
         self._register()
 
     def note_rank_failure(self, kind: str) -> None:
         with self._lock:
             self.rank_failures += 1
             self.last_failure_kind = kind
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record("rank_failure", failure_kind=kind)
         self._register()
 
     def set_readiness(self, ready: bool, phase: str) -> None:

@@ -394,6 +394,12 @@ class Runtime:
     def detach_server(self, server: Any) -> None:
         if server in self._servers:
             self._servers.remove(server)
+        # a detached-but-never-stopped server must not keep leaking its
+        # id()-keyed Dashboard sections (serving section leak, ISSUE 9);
+        # the hook is idempotent, so detach-then-stop stays safe
+        detach = getattr(server, "_detach_dashboard", None)
+        if detach is not None:
+            detach()
 
     @property
     def servers(self) -> List[Any]:

@@ -31,6 +31,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from multiverso_tpu.obs import tracer as _tracer
 from multiverso_tpu.serving.metrics import ServingMetrics
 from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.utils.log import CHECK
@@ -256,7 +257,12 @@ class DynamicBatcher:
             self.metrics.set_queue_depth(self._depth)
         payloads = [r.payload for r in reqs]
         try:
-            results = self._flush_fn(route, payloads)
+            # obs: one span per micro-batch flush — the serving twin of
+            # the PS round spans (fill ratio + route ride in args)
+            with _tracer.span(
+                "serving.flush", route=route, size=len(reqs)
+            ):
+                results = self._flush_fn(route, payloads)
             CHECK(
                 len(results) == len(payloads),
                 f"flush_fn returned {len(results)} results for "

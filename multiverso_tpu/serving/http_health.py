@@ -121,9 +121,18 @@ def readiness() -> Dict[str, Any]:
 MV_DEFINE_int(
     "health_port", 0,
     "serve GET /healthz (TableServer.health() + resilience + "
-    "failure_domain sections as JSON) on this port, started/stopped with "
-    "TableServer.start()/stop() (0 = off; flags cannot express an "
-    "ephemeral port — the demo's --health-port 0 can)",
+    "failure_domain sections as JSON), /livez, /readyz and the "
+    "Prometheus GET /metrics exposition on this port, started/stopped "
+    "with TableServer.start()/stop() or the training entry point "
+    "(0 = off; flags cannot express an ephemeral port — the demo's "
+    "--health-port 0 can)",
+)
+MV_DEFINE_int(
+    "metrics_port", 0,
+    "port for GET /metrics when -health_port is 0 (the metrics route "
+    "always RIDES the health endpoint — this flag just names the port "
+    "for metrics-first deployments; when -health_port is also set it "
+    "wins and -metrics_port is ignored with a log line)",
 )
 
 
@@ -175,9 +184,39 @@ class HealthServer:
                     ready = readiness()
                     body = json.dumps(ready, default=str).encode()
                     self.send_response(200 if ready["ready"] else 503)
+                elif route == "/metrics":
+                    # Prometheus text exposition: the Dashboard's
+                    # structured snapshot twins + interval rates
+                    # (obs.metrics) — scrapeable from any prom agent
+                    try:
+                        from multiverso_tpu.obs import metrics as obs_metrics
+
+                        body = obs_metrics.render_prometheus().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", obs_metrics.CONTENT_TYPE
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except Exception as e:  # noqa: BLE001 — a broken
+                        # section degrades the scrape, never the prober
+                        body = json.dumps(
+                            {"status": "error", "error": str(e)}
+                        ).encode()
+                        self.send_response(500)
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    return
                 elif route != "/healthz":
                     self.send_error(
-                        404, "only /healthz, /livez, /readyz are served"
+                        404,
+                        "only /healthz, /livez, /readyz, /metrics are "
+                        "served",
                     )
                     return
                 else:
@@ -222,8 +261,27 @@ class HealthServer:
 
 
 def maybe_start_from_flags(server=None) -> Optional[HealthServer]:
-    """Start the health endpoint when ``-health_port`` is armed."""
+    """Start the health endpoint when ``-health_port`` (or, for
+    metrics-first deployments, ``-metrics_port``) is armed. The
+    /metrics route always rides the same server. A taken port logs and
+    returns ``None`` — two subsystems arming the same flag (a trainer
+    plus a TableServer in one process) must not crash the second."""
     port = int(GetFlag("health_port"))
+    metrics_port = int(GetFlag("metrics_port"))
+    if port > 0 and metrics_port > 0 and metrics_port != port:
+        Log.Info(
+            "-metrics_port=%d ignored: /metrics rides the -health_port=%d "
+            "endpoint", metrics_port, port,
+        )
+    if port <= 0:
+        port = metrics_port
     if port <= 0:
         return None
-    return HealthServer(server, port=port)
+    try:
+        return HealthServer(server, port=port)
+    except OSError as e:
+        Log.Error(
+            "health endpoint on port %d not started (%s) — another "
+            "endpoint in this process likely owns it", port, e,
+        )
+        return None

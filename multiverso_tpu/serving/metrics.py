@@ -138,6 +138,9 @@ class ServingMetrics:
         with self._lock:
             self.swaps += 1
             self.last_swap_t = time.monotonic()
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record("hot_swap", server=self.name)
 
     def record_publish_reject(self) -> None:
         with self._lock:
@@ -208,15 +211,25 @@ class ServingMetrics:
             )
         return lines
 
+    def _section_key(self) -> str:
+        return f"serving.{self.name}.{id(self)}"
+
     def register_dashboard(self) -> None:
-        """Hook this bundle into ``Dashboard.Display()``. Keyed add is
+        """Hook this bundle into ``Dashboard.Display()`` (and, via the
+        dict-valued snapshot twin, into ``GET /metrics``). Keyed add is
         naturally idempotent — no guard flag, so re-registering after a
         ``Dashboard.Reset()`` (which wipes sections) just works."""
         from multiverso_tpu.utils.dashboard import Dashboard
 
-        Dashboard.add_section(f"serving.{self.name}.{id(self)}", self.info_lines)
+        Dashboard.add_section(
+            self._section_key(), self.info_lines, snapshot=self.report
+        )
 
     def unregister_dashboard(self) -> None:
+        """Idempotent detach — every teardown path (``stop()``,
+        ``detach()``, a failed ``start``) may call it; an ``id(self)``-
+        keyed section left behind pins this bundle (and whatever owns
+        it) in the process-global Dashboard forever."""
         from multiverso_tpu.utils.dashboard import Dashboard
 
-        Dashboard.remove_section(f"serving.{self.name}.{id(self)}")
+        Dashboard.remove_section(self._section_key())

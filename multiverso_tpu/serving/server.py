@@ -151,7 +151,7 @@ class TableServer:
         from multiverso_tpu.utils.dashboard import Dashboard
 
         Dashboard.add_section(f"serving.{name}.{id(self)}.health",
-                              self._health_lines)
+                              self._health_lines, snapshot=self.health)
         self._snapshot: Optional[ServingSnapshot] = None
         # OrderedLock (mvlint R2): serialises publishers only
         self._publish_lock = OrderedLock("snapshot._publish_lock")
@@ -207,19 +207,34 @@ class TableServer:
         return self
 
     def stop(self) -> None:
-        if self._health_http is not None:
-            self._health_http.stop()
-            self._health_http = None
-        self._batcher.close()
+        """Idempotent teardown. The dashboard detach runs in a
+        ``finally`` chain: the sections are keyed by ``id(self)``, so a
+        health-endpoint or batcher teardown error that skipped them used
+        to leak a section (and pin this server) in the process-global
+        Dashboard per register/stop cycle."""
+        try:
+            if self._health_http is not None:
+                self._health_http.stop()
+                self._health_http = None
+        finally:
+            try:
+                self._batcher.close()
+            finally:
+                self._detach_dashboard()
+                if self._registered:
+                    from multiverso_tpu.runtime import runtime
+
+                    runtime().detach_server(self)
+                    self._registered = False
+
+    def _detach_dashboard(self) -> None:
+        """Remove every ``id(self)``-keyed Dashboard section (idempotent
+        — stop(), a second stop(), and runtime shutdown all funnel
+        here)."""
         self.metrics.unregister_dashboard()
         from multiverso_tpu.utils.dashboard import Dashboard
 
         Dashboard.remove_section(f"serving.{self.name}.{id(self)}.health")
-        if self._registered:
-            from multiverso_tpu.runtime import runtime
-
-            runtime().detach_server(self)
-            self._registered = False
 
     # ------------------------------------------------------------ publish
 
@@ -663,6 +678,7 @@ class TableServer:
                     threshold=self._breaker_threshold,
                     cooldown_s=self._breaker_cooldown_s,
                     clock=self._breaker_clock,
+                    name=f"{self.name}.{route}",
                 )
                 self._breakers[route] = br
             return br

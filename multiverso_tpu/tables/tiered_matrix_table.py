@@ -221,7 +221,8 @@ class TieredMatrixTable(MatrixTable):
             if old.name == self.name:
                 _TABLES.discard(old)
         _TABLES.add(self)
-        Dashboard.add_section("table_cache", _section_lines)
+        Dashboard.add_section("table_cache", _section_lines,
+                              snapshot=tier_cache_stats)
 
     @staticmethod
     def _build_host_init(option, V: int, C: int, np_dtype) -> np.ndarray:

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Any, Callable, Generic, Optional, TypeVar
 
+from multiverso_tpu.obs import tracer as _tracer
+
 T = TypeVar("T")
 
 __all__ = ["ASyncBuffer", "TaskPipe"]
@@ -45,8 +47,10 @@ class ASyncBuffer(Generic[T]):
     """``fill_buffer_action()`` produces the next value; ``Get()`` returns the
     ready value and kicks off the next fill in the background."""
 
-    def __init__(self, fill_buffer_action: Callable[[], T]):
+    def __init__(self, fill_buffer_action: Callable[[], T],
+                 name: str = "asyncbuffer"):
         self._fill = fill_buffer_action
+        self._span_name = f"fill.{name}"
         self._lock = threading.Lock()
         self._ready = threading.Event()
         self._value: Optional[T] = None
@@ -59,7 +63,10 @@ class ASyncBuffer(Generic[T]):
 
         def run():
             try:
-                value = self._fill()
+                # obs: the fill thread's block-prep/prefetch work lands
+                # on its own track in the span trace
+                with _tracer.span(self._span_name):
+                    value = self._fill()
                 with self._lock:
                     self._value = value
             except BaseException as e:  # surfaced (sticky) on next Get()
@@ -245,7 +252,18 @@ class TaskPipe:
             self._slots[slot] = None
             self._free.push(slot)
             try:
-                ticket._value = fn()
+                if _tracer.tracing_enabled():
+                    # ticket execution on the comms worker: the span name
+                    # is the tag's kind prefix ("pull:17" -> "pipe.pull")
+                    # so the track stays low-cardinality; the full tag
+                    # rides in args
+                    kind = ticket.tag.split(":", 1)[0] if ticket.tag else ""
+                    with _tracer.span(
+                        f"pipe.{kind or 'task'}", tag=ticket.tag
+                    ):
+                        ticket._value = fn()
+                else:
+                    ticket._value = fn()
             except BaseException as e:  # surfaced at ticket.result()
                 ticket._error = e
             finally:

@@ -81,12 +81,19 @@ class Dashboard:
     Extension: ``add_section(name, fn)`` registers a callable returning
     extra display lines — the serving subsystem plugs its histogram /
     QPS / shed report in through this, so ``Display()`` stays the one
-    process-wide dump."""
+    process-wide dump.
+
+    Structured twin (obs subsystem): ``add_section(name, fn,
+    snapshot=...)`` additionally registers a dict-valued snapshot
+    callable; ``snapshots()`` collects them all, and
+    ``obs.metrics`` renders that collection as Prometheus text at
+    ``GET /metrics`` (and feeds the depth controller)."""
 
     _lock = threading.Lock()
     _monitors: Dict[str, Monitor] = {}
     _counters: Dict[str, Counter] = {}
     _sections: Dict[str, object] = {}  # name -> () -> List[str]
+    _snapshots: Dict[str, object] = {}  # name -> () -> Dict
 
     @classmethod
     def get(cls, name: str) -> Monitor:
@@ -107,14 +114,55 @@ class Dashboard:
             return ctr
 
     @classmethod
-    def add_section(cls, name: str, fn) -> None:
+    def add_section(cls, name: str, fn, snapshot=None) -> None:
         with cls._lock:
             cls._sections[name] = fn
+            if snapshot is not None:
+                cls._snapshots[name] = snapshot
+            else:
+                # re-registering without a snapshot drops any stale twin
+                cls._snapshots.pop(name, None)
 
     @classmethod
     def remove_section(cls, name: str) -> None:
         with cls._lock:
             cls._sections.pop(name, None)
+            cls._snapshots.pop(name, None)
+
+    @classmethod
+    def snapshots(cls) -> Dict[str, Dict]:
+        """Every registered dict-valued section snapshot (the structured
+        twin of ``Display()``). Snapshot callables run OUTSIDE the lock
+        (they take their own); one failing section is skipped, never
+        fatal — a broken stats provider must not take the scrape down."""
+        with cls._lock:
+            fns = list(cls._snapshots.items())
+        out: Dict[str, Dict] = {}
+        for name, fn in fns:
+            try:
+                snap = fn()
+            except Exception:  # noqa: BLE001 — skip broken providers
+                continue
+            if isinstance(snap, dict):
+                out[name] = snap
+        return out
+
+    @classmethod
+    def core_metrics(cls) -> Dict[str, float]:
+        """Monitors/Counters as one flat numeric dict (the ``core``
+        metrics family): ``<name>_count`` / ``<name>_total_ms`` per
+        Monitor, ``<name>_count`` / ``<name>_total`` per Counter."""
+        with cls._lock:
+            monitors = list(cls._monitors.values())
+            counters = list(cls._counters.values())
+        out: Dict[str, float] = {}
+        for m in monitors:
+            out[f"{m.name}_count"] = float(m.count)
+            out[f"{m.name}_total_ms"] = float(m.elapsed_ms)
+        for c in counters:
+            out[f"{c.name}_count"] = float(c.count)
+            out[f"{c.name}_total"] = float(c.total)
+        return out
 
     @classmethod
     def Display(cls) -> str:
@@ -135,6 +183,7 @@ class Dashboard:
             cls._monitors.clear()
             cls._counters.clear()
             cls._sections.clear()
+            cls._snapshots.clear()
 
 
 @contextmanager

@@ -1,0 +1,23 @@
+# mvlint: exact-module
+"""mvlint fixture: the R5 obs allowlist. A wall-clock read INSIDE an
+``obs.event``/``obs.span``/``recorder.record`` call form is exempt
+(timestamps annotate the timeline, they never feed trained values);
+the SAME read outside one still fires R5. This file must trigger
+EXACTLY one R5 finding — the bare ``time.time()`` in
+``stamp_payload`` — and none for the obs-form calls."""
+
+import time
+
+from multiverso_tpu import obs
+from multiverso_tpu.obs.flight import recorder
+
+
+def traced_round(r):
+    obs.event("round", wall=time.time(), round=r)
+    with obs.span("work", started_wall=time.time()):
+        recorder.record("round", wall=time.time(), round=r)
+    return r
+
+
+def stamp_payload():
+    return {"saved_at": time.time()}

@@ -24,6 +24,10 @@ mode=shard_pipelined: uneven shards through the PIPELINED PS path
             the reference's -is_pipeline Communicator.
 mode=shard_pipelined_sparse: same plus -ps_compress=sparse (packed delta
             pushes unpacked inside the SPMD scatter program).
+mode=shard_pipelined_trace: shard_pipelined with the span tracer armed
+            (-trace_dir=<shared_root>/trace; shared_root required) — the
+            obs smoke merges both ranks' dumps and checks the per-rank
+            round-span counts against the round count.
 mode=chaos_drill: the failure-domain drill (shared_root required —
             holds <root>/ck checkpoints + <root>/hb heartbeat beacons).
             Pipelined depth=1 with quorum checkpoints every 2 rounds,
@@ -76,6 +80,9 @@ def main():
         f"-process_id={pid}",
         f"-num_processes={nproc}",
     ]
+    if mode == "shard_pipelined_trace":
+        assert shared_root, "shard_pipelined_trace needs the shared_root"
+        argv.append(f"-trace_dir={shared_root}/trace")
     if chaos_mode:
         assert shared_root, "chaos_*/supervised modes need the shared_root"
         # watchdog armed: file-backed beacons on the shared root, tight

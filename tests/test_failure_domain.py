@@ -603,8 +603,14 @@ def test_http_health_endpoint_serves_all_sections():
         for k in ("tickets", "broken_pipes", "drains", "quorum_aborts",
                   "rank_failures", "ticket_wait_p99_ms"):
             assert k in payload["failure_domain"], k
-        # anything but /healthz is a 404
-        bad = urllib.request.Request(h.url.replace("/healthz", "/metrics"))
+        # /metrics is a real route since ISSUE 9 (Prometheus exposition)
+        with urllib.request.urlopen(
+            h.url.replace("/healthz", "/metrics"), timeout=10
+        ) as resp:
+            assert resp.status == 200
+            assert "mv_failure_domain" in resp.read().decode()
+        # anything else stays a 404
+        bad = urllib.request.Request(h.url.replace("/healthz", "/nope"))
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(bad, timeout=10)
     finally:

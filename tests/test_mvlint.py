@@ -84,6 +84,43 @@ def test_r3_fixture_names_both_directions():
     assert any("read but never defined" in m for m in msgs)
 
 
+def test_r5_obs_allowlist_exempts_span_event_args():
+    """The obs interplay (ISSUE 9): wall-clock reads inside
+    obs.span/obs.event/recorder.record call forms are timeline
+    annotations, not trained values — R5 must pass them and still fire
+    on the bare read in the same exact-module file."""
+    res = _lint_fixture("r5_obs_allow.py")
+    assert {f.rule for f in res.findings} == {"R5"}
+    assert len(res.findings) == 1, "\n".join(
+        f.render() for f in res.findings
+    )
+    # the surviving finding is the bare stamp_payload read, not an obs arg
+    assert "wall-clock" in res.findings[0].message
+
+
+def test_r5_obs_allowlist_cannot_be_spoofed_by_local_names(tmp_path):
+    """A module-local ``def event(...)`` (no obs import) must get NO
+    exemption — otherwise any exact-path module could launder a
+    wall-clock read into a payload by naming its helper 'event'."""
+    p = tmp_path / "spoof.py"
+    p.write_text(
+        "# mvlint: exact-module\n"
+        "import time\n"
+        "\n"
+        "\n"
+        "def event(payload):\n"
+        "    return payload\n"
+        "\n"
+        "\n"
+        "def build():\n"
+        "    return event({'stamp': time.time()})\n"
+    )
+    res = run_lint([str(p)], config=_BARE, baseline_path=os.devnull)
+    assert any(
+        f.rule == "R5" and "wall-clock" in f.message for f in res.findings
+    ), [f.render() for f in res.findings]
+
+
 # ------------------------------------------------------ repo lints clean
 
 

@@ -1,0 +1,518 @@
+"""Observability layer (ISSUE 9): span tracer, metrics registry, flight
+recorder, merge tool, and the serving section-leak fix.
+
+Contracts pinned here:
+
+* tracer ring: overflow drops the OLDEST events without corrupting the
+  dump (the survivors are the newest, the schema stays valid, the drop
+  count is reported);
+* begin/end nesting renders as valid Chrome-trace JSON (paired "X"
+  complete events with containment), instants as "i";
+* cross-thread spans land on distinct ``tid`` tracks;
+* the merge tool aligns two fabricated rank dumps onto one timeline via
+  the per-rank monotonic anchor (same-instant events coincide after the
+  merge even though the raw clocks differ);
+* a contained RankFailure dumps ``flight-recorder-rank<p>.jsonl`` next
+  to the FAILURE report (the in-process hung-collective drill; the
+  real-process ``-chaos_drop_rank`` leg lives in the ci.sh drill);
+* ``GET /metrics`` serves Prometheus text with the ps_comms, serving
+  and failure_domain families plus interval rates;
+* serving section leak: register/stop/register-again leaves ZERO
+  ``id()``-keyed Dashboard sections behind, including stop-without-
+  start, double-stop and detach-without-stop.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from multiverso_tpu import obs
+from multiverso_tpu.obs import flight, tracer
+from multiverso_tpu.obs.trace_tools import (
+    merge_traces,
+    span_counts,
+    validate_trace,
+)
+from multiverso_tpu.utils.configure import SetCMDFlag
+from multiverso_tpu.utils.dashboard import Dashboard
+
+
+@pytest.fixture
+def fresh_tracer():
+    tracer.reset_for_tests()
+    yield tracer
+    tracer.reset_for_tests()
+    SetCMDFlag("trace_ring_events", 65536)
+    SetCMDFlag("trace_dir", "")
+
+
+# ===================================================== tracer core
+
+
+def test_tracing_off_records_nothing(fresh_tracer):
+    with obs.span("never"):
+        pass
+    obs.event("never")
+    doc = tracer.dump()
+    assert [e for e in doc["traceEvents"] if e["ph"] != "M"] == []
+
+
+def test_ring_overflow_drops_oldest_without_corruption(fresh_tracer):
+    tracer.enable()
+    SetCMDFlag("trace_ring_events", 16)
+    for i in range(200):
+        with obs.span("s", i=i):
+            pass
+    doc = tracer.dump()
+    assert validate_trace(doc) == []
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert 1 <= len(xs) <= 16
+    # survivors are the NEWEST spans (drop-oldest, not drop-newest)
+    survivor_ids = sorted(e["args"]["i"] for e in xs)
+    assert survivor_ids[-1] == 199
+    assert min(survivor_ids) >= 200 - 16
+    # 200 spans x 2 events into a 16-slot ring
+    assert doc["otherData"]["dropped_events"] == 2 * 200 - 16
+    # events stay chronologically ordered
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+
+
+def test_nesting_produces_valid_chrome_trace(fresh_tracer, tmp_path):
+    tracer.enable()
+    with obs.span("outer", kind="a"):
+        with obs.span("mid"):
+            with obs.span("inner"):
+                obs.event("tick", n=1)
+    path = str(tmp_path / "t.json")
+    tracer.dump(path)
+    with open(path) as f:
+        doc = json.load(f)  # valid JSON on disk
+    assert validate_trace(doc) == []
+    by_name = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert set(by_name) == {"outer", "mid", "inner"}
+    # nesting containment: inner inside mid inside outer
+    for child, parent in (("inner", "mid"), ("mid", "outer")):
+        c, p = by_name[child], by_name[parent]
+        assert p["ts"] <= c["ts"]
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-6
+    assert by_name["outer"]["args"] == {"kind": "a"}
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert [e["name"] for e in instants] == ["tick"]
+
+
+def test_span_exception_propagates_and_still_closes(fresh_tracer):
+    tracer.enable()
+    with pytest.raises(ValueError, match="boom"):
+        with obs.span("failing"):
+            raise ValueError("boom")
+    doc = tracer.dump()
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["failing"]  # E landed on the way out
+
+
+def test_cross_thread_spans_land_on_distinct_tids(fresh_tracer):
+    tracer.enable()
+
+    def worker():
+        with obs.span("side-span"):
+            pass
+
+    with obs.span("main-span"):
+        pass
+    t = threading.Thread(target=worker, name="obs-side")
+    t.start()
+    t.join()
+    doc = tracer.dump()
+    tid_of = {
+        e["name"]: e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"
+    }
+    assert tid_of["main-span"] != tid_of["side-span"]
+    thread_names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert "obs-side" in thread_names
+
+
+def test_maybe_dump_from_flags_names_the_rank_file(fresh_tracer, tmp_path):
+    tracer.enable()
+    with obs.span("x"):
+        pass
+    SetCMDFlag("trace_dir", str(tmp_path / "tr"))
+    path = tracer.maybe_dump_from_flags()
+    assert path is not None and os.path.basename(path) == "trace-rank0.json"
+    assert validate_trace(json.load(open(path))) == []
+    SetCMDFlag("trace_dir", "")
+    assert tracer.maybe_dump_from_flags() is None
+
+
+# ===================================================== merge tool
+
+
+def _fabricate_dump(rank, anchor_us, events):
+    """A rank dump as the tracer writes it: raw monotonic ts + anchor."""
+    evs = []
+    for name, rel_ts, dur in events:
+        evs.append({
+            "name": name, "ph": "X", "cat": "mv",
+            "ts": anchor_us + rel_ts, "dur": dur, "pid": rank, "tid": 1,
+        })
+    return {
+        "traceEvents": evs,
+        "otherData": {"rank": rank, "anchor_mono_us": anchor_us,
+                      "anchor_wall": 0.0, "anchor_source": "test",
+                      "dropped_events": 0, "unmatched_ends": 0},
+    }
+
+
+def test_merge_aligns_rank_clocks_on_the_anchor():
+    """Two ranks whose monotonic clocks differ wildly (different boot
+    times) but whose anchors were stamped at the same barrier instant:
+    after the merge, the same-round events COINCIDE on one timeline."""
+    d0 = _fabricate_dump(0, 1_000_000.0, [("round", 500.0, 100.0)])
+    d1 = _fabricate_dump(1, 999_000_000.0, [("round", 500.0, 100.0)])
+    merged = merge_traces([d0, d1])
+    assert validate_trace(merged) == []
+    ts = {e["pid"]: e["ts"] for e in merged["traceEvents"]}
+    assert ts[0] == pytest.approx(ts[1])  # aligned despite clock skew
+    assert ts[0] == pytest.approx(500.0)
+    assert set(merged["otherData"]["ranks"]) == {"0", "1"}
+    assert span_counts(merged) == {(0, "round"): 1, (1, "round"): 1}
+
+
+def test_merge_cli_end_to_end(tmp_path):
+    for rank, anchor in ((0, 5000.0), (1, 7000.0)):
+        with open(tmp_path / f"trace-rank{rank}.json", "w") as f:
+            json.dump(
+                _fabricate_dump(rank, anchor, [("work", 10.0, 2.0)]), f
+            )
+    out = str(tmp_path / "pod.json")
+    rc = subprocess.call(
+        [sys.executable, "-m", "multiverso_tpu.obs", "merge",
+         str(tmp_path), "-o", out, "--expect-ranks", "2"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert rc == 0
+    doc = json.load(open(out))
+    assert len(doc["otherData"]["ranks"]) == 2
+    # --expect-ranks gates on missing dumps
+    rc = subprocess.call(
+        [sys.executable, "-m", "multiverso_tpu.obs", "merge",
+         str(tmp_path / "trace-rank0.json"), "-o", out,
+         "--expect-ranks", "2"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert rc == 2
+
+
+# ===================================================== flight recorder
+
+
+def test_flight_recorder_bounded_ring_and_jsonl_dump(tmp_path):
+    rec = flight.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("round", round=i)
+    events = rec.snapshot()
+    assert len(events) == 8
+    assert [e["round"] for e in events] == list(range(12, 20))  # newest
+    path = rec.dump(str(tmp_path / "fr.jsonl"))
+    lines = [json.loads(line) for line in open(path)]
+    assert [e["round"] for e in lines] == list(range(12, 20))
+    assert all(
+        {"seq", "wall", "mono_ns", "kind"} <= set(e) for e in lines
+    )
+    p = rec.dump_for_rank(str(tmp_path), rank=3)
+    assert os.path.basename(p) == "flight-recorder-rank3.jsonl"
+
+
+def test_ticket_wait_p99_breach_recorded():
+    from multiverso_tpu.resilience.watchdog import fd_stats
+
+    flight.recorder.clear()
+    for _ in range(300):  # establish a tight distribution + cached p99
+        fd_stats.note_ticket_wait(0.001)
+    fd_stats.note_ticket_wait(5.0)  # far outside: must hit the recorder
+    kinds = [e["kind"] for e in flight.recorder.snapshot()]
+    assert "ticket_wait_p99_breach" in kinds
+
+
+def test_breaker_transitions_recorded():
+    from multiverso_tpu.resilience.breaker import CircuitBreaker
+
+    flight.recorder.clear()
+    t = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=lambda: t[0],
+                        name="demo.lookup")
+    br.record_failure()
+    br.record_failure()  # closed -> open
+    t[0] = 11.0
+    assert br.allow()[0]  # open -> half_open (probe)
+    br.record_success()  # half_open -> closed
+    trans = [
+        (e["prev"], e["new"]) for e in flight.recorder.snapshot()
+        if e["kind"] == "breaker_transition"
+    ]
+    assert trans == [
+        ("closed", "open"), ("open", "half_open"), ("half_open", "closed")
+    ]
+
+
+# ===================================================== metrics registry
+
+
+def test_dashboard_snapshot_twin_lifecycle():
+    Dashboard.add_section("obs_test", lambda: ["[x] line"],
+                          snapshot=lambda: {"a": 1})
+    try:
+        assert Dashboard.snapshots()["obs_test"] == {"a": 1}
+    finally:
+        Dashboard.remove_section("obs_test")
+    assert "obs_test" not in Dashboard.snapshots()
+    # a broken snapshot provider is skipped, never fatal
+    Dashboard.add_section("obs_bad", lambda: [],
+                          snapshot=lambda: 1 / 0)
+    try:
+        assert "obs_bad" not in Dashboard.snapshots()
+    finally:
+        Dashboard.remove_section("obs_bad")
+
+
+def test_prometheus_families_and_interval_rates():
+    from multiverso_tpu.models.wordembedding.app import _PSCommsStats
+    from multiverso_tpu.obs.metrics import MetricsRegistry, render_prometheus
+    from multiverso_tpu.serving.metrics import ServingMetrics
+
+    stats = _PSCommsStats(dim=8)  # registers the ps_comms section
+    sm = ServingMetrics("serving")
+    sm.register_dashboard()
+    try:
+        stats.add_pull(0.01, rows_dense=10, rows_wire=10, bytes_wire=320)
+        sm.record_batch("lookup", 4, 8, [0.001] * 4)
+        clock = [100.0]
+        reg = MetricsRegistry(clock=lambda: clock[0])
+        txt = render_prometheus(reg)
+        assert "# TYPE mv_ps_comms_rounds gauge" in txt
+        assert "mv_ps_comms_rounds 1" in txt
+        assert "mv_serving_served 4" in txt
+        assert "mv_failure_domain_tickets" in txt
+        assert "mv_resilience_saves" in txt
+        # second scrape after more traffic: interval rate appears
+        stats.add_pull(0.01, rows_dense=10, rows_wire=10, bytes_wire=320)
+        clock[0] = 102.0
+        txt2 = render_prometheus(reg)
+        assert "mv_ps_comms_rounds_rate_per_s 0.5" in txt2
+    finally:
+        sm.unregister_dashboard()
+        Dashboard.remove_section("ps_comms")
+
+
+def test_mixed_key_snapshot_cannot_break_the_scrape():
+    """A snapshot dict with int keys next to string keys (per-rank maps)
+    must flatten — and a provider whose dict still defeats _flatten is
+    skipped by observe(), never surfaced to render_prometheus."""
+    from multiverso_tpu.obs.metrics import MetricsRegistry, render_prometheus
+
+    Dashboard.add_section(
+        "obs_mixed", lambda: [],
+        snapshot=lambda: {0: 1.5, "name": "x", "nested": {3: 4, "b": 5}},
+    )
+    try:
+        txt = render_prometheus(MetricsRegistry())
+        assert "mv_obs_mixed_0 1.5" in txt
+        assert "mv_obs_mixed_nested_3 4" in txt
+    finally:
+        Dashboard.remove_section("obs_mixed")
+
+
+def test_fill_thread_rings_are_recycled_not_leaked(fresh_tracer):
+    """One short-lived thread per block (the ASyncBuffer fill pattern)
+    must not grow the ring registry unboundedly — dead threads' rings
+    are recycled."""
+    from multiverso_tpu.obs.tracer import _registry
+
+    tracer.enable()
+    for i in range(32):
+        t = threading.Thread(
+            target=lambda: obs.event("fill", i=1), name=f"fill-{i}"
+        )
+        t.start()
+        t.join()
+    # serial dead threads collapse onto recycled rings; a handful of
+    # non-recycles are legitimate (a dead ring's OS ident can be
+    # reused by an unrelated LIVE thread, which blocks that recycle),
+    # but nothing near one-ring-per-thread
+    assert len(_registry) <= 10, len(_registry)
+    doc = tracer.dump()
+    fills = [e for e in doc["traceEvents"] if e["name"] == "fill"]
+    assert len(fills) == 32  # recycled rings KEEP their events
+
+
+def test_http_metrics_route(mv_env):
+    from multiverso_tpu.serving.http_health import HealthServer
+
+    hs = HealthServer(None, port=0)
+    try:
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{hs.port}/metrics", timeout=5
+        ).read().decode()
+        assert "mv_failure_domain_rank_failures" in txt
+        assert "mv_resilience_restarts" in txt
+        assert txt.strip().splitlines()[-1].startswith("mv_scrape_interval_s")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{hs.port}/nope", timeout=5
+            )
+        assert ei.value.code == 404
+    finally:
+        hs.stop()
+
+
+def test_observe_feed_shape():
+    """The depth controller's observation input: families + flat view +
+    rates + interval, from one call."""
+    from multiverso_tpu.obs.metrics import MetricsRegistry
+
+    clock = [0.0]
+    reg = MetricsRegistry(clock=lambda: clock[0])
+    first = reg.observe()
+    assert first["interval_s"] == 0.0 and first["rates"] == {}
+    assert "failure_domain" in first["families"]
+    assert any(k.startswith("failure_domain:") for k in first["flat"])
+    clock[0] = 1.0
+    second = reg.observe()
+    assert second["interval_s"] == pytest.approx(1.0)
+
+
+# ===================================================== serving leak pin
+
+
+def test_serving_sections_do_not_leak_across_register_stop_cycles(mv_env):
+    """Register/stop/register-again: every cycle must return the
+    Dashboard to its baseline section set — the id(self)-keyed sections
+    used to leak when a teardown path skipped remove_section."""
+    from multiverso_tpu.serving.server import TableServer
+
+    baseline = set(Dashboard._sections)
+    arrays = {"emb": np.ones((8, 4), np.float32)}
+    for _ in range(3):
+        srv = TableServer(arrays, register_runtime=False)
+        assert set(Dashboard._sections) - baseline  # registered
+        srv.stop()
+        assert set(Dashboard._sections) == baseline, "sections leaked"
+    # stop() without start, twice — still clean
+    srv = TableServer(arrays, register_runtime=False)
+    srv.stop()
+    srv.stop()
+    assert set(Dashboard._sections) == baseline
+    # detach-without-stop (runtime teardown ordering) also detaches
+    srv = TableServer(arrays, register_runtime=True)
+    mv_env.runtime().detach_server(srv)
+    assert set(Dashboard._sections) == baseline
+    srv.stop()  # idempotent after detach
+
+
+def test_serving_sections_detach_even_when_teardown_raises(
+    mv_env, monkeypatch
+):
+    from multiverso_tpu.serving.server import TableServer
+
+    baseline = set(Dashboard._sections)
+    srv = TableServer({"emb": np.ones((8, 4), np.float32)},
+                      register_runtime=False)
+    monkeypatch.setattr(
+        srv._batcher, "close",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        srv.stop()
+    assert set(Dashboard._sections) == baseline, (
+        "teardown error leaked the dashboard sections"
+    )
+
+
+# ===================================================== containment e2e
+
+
+def _corpus(V=40, n=3000, seed=0):
+    rng = np.random.RandomState(seed)
+    p = rng.randint(0, V // 2, n) * 2
+    return (
+        np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1)
+        .astype(np.int32)
+    )
+
+
+def _dict(ids):
+    from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+
+    V = int(ids.max()) + 1
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(V)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(
+        np.bincount(np.maximum(ids, 0), minlength=V), 1
+    ).astype(np.int64)
+    return d
+
+
+def test_containment_dumps_flight_recorder_next_to_failure_report(
+    tmp_path,
+):
+    """The in-process drill: a chaos-hung collective under an armed
+    ticket deadline raises RankFailure -> containment runs -> the
+    flight recorder lands as flight-recorder-rank0.jsonl next to the
+    FAILURE report, carrying the rounds, the rank failure and the
+    containment event. (The real-process -chaos_drop_rank variant is
+    the ci.sh failure-domain drill.)"""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.wordembedding.app import (
+        WEOptions,
+        WordEmbedding,
+    )
+    from multiverso_tpu.resilience import chaos
+    from multiverso_tpu.resilience.watchdog import RankFailure
+
+    ids = _corpus()
+    d = _dict(ids)
+    ck = str(tmp_path / "ck")
+    flight.recorder.clear()
+    chaos.reset()
+    mv.MV_Init(["prog"])
+    try:
+        SetCMDFlag("chaos_hang_collective", "5:30")
+        SetCMDFlag("collective_timeout_s", 0.5)
+        opt = WEOptions(
+            size=16, negative=3, window=2, batch_size=256,
+            steps_per_call=2, epoch=3, sample=0, alpha=0.1,
+            output_file="", use_ps=True, is_pipeline=False,
+            train_file="unused", ps_pipeline_depth=1,
+            checkpoint_dir=ck, checkpoint_every_steps=3,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        with pytest.raises(RankFailure):
+            we.train(ids=ids)
+    finally:
+        SetCMDFlag("chaos_hang_collective", "")
+        SetCMDFlag("collective_timeout_s", 0.0)
+        chaos.reset()
+        mv.MV_ShutDown(finalize=True)
+    assert any(f.startswith("FAILURE-") for f in os.listdir(ck))
+    fr = os.path.join(ck, "flight-recorder-rank0.jsonl")
+    assert os.path.exists(fr), os.listdir(ck)
+    events = [json.loads(line) for line in open(fr)]
+    kinds = {e["kind"] for e in events}
+    assert {"round", "rank_failure", "containment"} <= kinds, kinds
+    cont = [e for e in events if e["kind"] == "containment"][0]
+    assert cont["failure_kind"] == "collective_timeout"
+    # events are a usable timeline: seq strictly increasing, clocks set
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
