@@ -284,16 +284,21 @@ def _bench_e2e(dim=128, device_tokens=None, host_tokens=None):
 
 
 def _bench_multidevice(ns=(1, 8)):
-    """Sharded-training scaling shape on the virtual CPU mesh (the only
-    multi-device fabric this bench host exposes — one real TPU chip).
+    """Multi-device weak scaling of the PIPELINED PS path on the virtual
+    CPU mesh (the only multi-device fabric this bench host exposes — one
+    real TPU chip).
 
-    Weak scaling: per-worker batch is fixed, tables shard over the shard
-    axis, the batch shards over the worker axis (exactly the
-    dryrun_multichip/pod layout). READ WITH benchmarks/MULTIDEVICE.md:
-    virtual CPU devices run XLA collectives over serialized host memcpys,
-    so the ratio measures the fabric, not the design — it is recorded to
-    keep the sharded path's perf on the books (and to catch regressions
-    in its collective volume), not as an ICI prediction. CPU absolute
+    Since round 7 this leg drives the production training loop — the
+    WordEmbedding APP in pipelined-PS mode (-use_ps -ps_pipeline_depth=1
+    -ps_sparse_pull -ps_compress=1bit: comms thread hides pull/push
+    under compute, dirty-row sparse pulls, 1bit packed delta pushes) —
+    instead of the raw sharded skipgram step, so the scaling number on
+    the books is the path pods actually run. Weak scaling: per-worker
+    token budget is fixed, tables shard over the shard axis. READ WITH
+    benchmarks/MULTIDEVICE.md: virtual CPU devices run XLA collectives
+    over serialized host memcpys, so the ratio measures the fabric, not
+    the design — recorded to catch regressions in the pipelined path's
+    collective/comms volume, not as an ICI prediction. CPU absolute
     throughput is not comparable to the TPU legs. Runs in subprocesses
     because the parent process owns the axon TPU backend."""
     import subprocess
@@ -306,54 +311,46 @@ os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
-import numpy as np, jax.numpy as jnp
+import numpy as np
 sys.path.insert(0, sys.argv[2])
 import multiverso_tpu as mv
-from jax.sharding import NamedSharding, PartitionSpec as P
 from multiverso_tpu.parallel import mesh as mesh_lib
-from multiverso_tpu.models.wordembedding.skipgram import (
-    SkipGramConfig, init_params, make_batch, make_sorted_superbatch_step,
-    presort_batch)
+from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.models.wordembedding.synth import zipf_probs
 mesh = mesh_lib.build_mesh(devices=jax.devices()[:n],
                            num_shards=2 if n > 1 else 1)
 mv.MV_Init(mesh=mesh)
 nw = mv.MV_NumWorkers()
-cfg = SkipGramConfig(vocab_size=20_000, dim=128, negatives=5)
-tab = mesh_lib.table_sharding(mesh, 2)
-rep = mesh_lib.replicated_sharding(mesh)
-params = {k: jax.device_put(v, tab) for k, v in init_params(cfg).items()}
-B, S = 8192 * nw, 4  # weak scaling: fixed per-worker batch
+V, toks = 20_000, 150_000 * max(nw, 1)  # weak: fixed per-worker tokens
 rng = np.random.RandomState(0)
-mbs = []
-for s in range(S):
-    c, o, _ = make_batch(rng, cfg, B)
-    mbs.append(presort_batch({"centers": c, "outputs": o}))
-xs = {}
-for k in mbs[0]:
-    stacked = jnp.asarray(np.stack([b[k] for b in mbs]))
-    spec = P(None, "worker") if stacked.ndim >= 2 else P(None)
-    xs[k] = jax.device_put(stacked, NamedSharding(mesh, spec))
-step = jax.jit(make_sorted_superbatch_step(cfg),
-               out_shardings=({"emb_in": tab, "emb_out": tab}, rep),
-               donate_argnums=(0,))
-lr = jnp.float32(0.025)
-for _ in range(2):
-    params, loss = step(params, xs, lr)
-float(loss)
-best = 0.0
-for _ in range(3):
-    t0 = time.perf_counter()
-    for _ in range(4):
-        params, loss = step(params, xs, lr)
-    float(loss)
-    best = max(best, B * S * 4 / (time.perf_counter() - t0))
-print(json.dumps({"n": n, "pairs_per_sec": round(best, 1)}))
+ids = rng.choice(V, size=toks, p=zipf_probs(V)).astype(np.int32)
+d = Dictionary()
+d.words = [str(i) for i in range(V)]
+d.word2id = {}
+d.counts = np.bincount(ids, minlength=V).astype(np.int64)
+opt = WEOptions(size=64, negative=5, window=5, batch_size=4096,
+                steps_per_call=8, epoch=1, sample=0, min_count=0,
+                output_file="", train_file="x", use_ps=True,
+                is_pipeline=False, ps_pipeline_depth=1,
+                ps_sparse_pull=True, ps_compress="1bit")
+we = WordEmbedding(opt, dictionary=d)
+t0 = time.perf_counter()
+loss = we.train(ids=ids.copy())
+dt = time.perf_counter() - t0
+assert np.isfinite(loss), loss
+stats = getattr(we, "_ps_stats", None)
+print(json.dumps({
+    "n": n, "pairs_per_sec": round(we.words_trained / max(dt, 1e-9), 1),
+    "overlap_pct": None if stats is None else stats.to_dict()["overlap_pct"],
+}))
 mv.MV_ShutDown()
 """
     import os
 
     repo = os.path.dirname(os.path.abspath(__file__))
     out = {}
+    overlap = {}
     for n in ns:
         r = subprocess.run(
             [sys.executable, "-c", code, str(n), repo],
@@ -361,10 +358,13 @@ mv.MV_ShutDown()
         )
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
         try:
-            out[n] = json.loads(line)["pairs_per_sec"]
+            doc = json.loads(line)
+            out[n] = doc["pairs_per_sec"]
+            overlap[n] = doc.get("overlap_pct")
         except Exception:
-            # a crash of the sharded step is a regression this leg exists
-            # to catch — surface it instead of silently reporting null
+            # a crash of the pipelined PS path under a sharded mesh is a
+            # regression this leg exists to catch — surface it instead of
+            # silently reporting null
             print(
                 f"multi-device leg FAILED (n={n}, rc={r.returncode}):\n"
                 f"{r.stderr[-2000:]}",
@@ -374,6 +374,10 @@ mv.MV_ShutDown()
     fields = {
         f"multi_device_cpu{n}_pairs_per_sec": v for n, v in out.items()
     }
+    # semantics tag: the measured path changed in round 7 (raw sharded
+    # step -> pipelined PS app); cross-round tooling must not conflate
+    fields["multi_device_path"] = "ps_pipelined_sparse_1bit"
+    fields["multi_device_overlap_pct"] = overlap.get(ns[-1])
     if all(out.get(n) for n in ns) and out[ns[0]]:
         fields["multi_device_weak_scaling_x"] = round(
             out[ns[-1]] / out[ns[0]], 2
@@ -1673,7 +1677,57 @@ def _bench_serving(cfg, queries=4000, clients=4, topk_every=8,
         if deadline_ms == deadlines_ms[1]:
             headline = entry
     headline = headline or next(iter(sweep.values()))
-    return {
+
+    # top-k impl sweep: replicated (full (Q, V) score matmul) vs sharded
+    # (per-shard partial top-k, unreplicated scores) on the SAME table
+    # and traffic — the evidence behind TableServer's topk_impl='auto'
+    # default (auto picks sharded whenever the mesh/table allow it)
+    impls = {}
+    for impl in ("replicated", "sharded"):
+        srv = TableServer(
+            {"emb": emb}, max_batch=64,
+            max_delay_s=deadlines_ms[1] * 1e-3,
+            name=f"bench_topk_{impl}", topk_impl=impl,
+            register_runtime=False,
+        ).start()
+        try:
+            b = 2
+            while b <= 64 * 2:  # warm every padded bucket before timing
+                srv.topk("emb", np.tile(emb[:1], (b, 1)), k=10)
+                b <<= 1
+        except Exception as e:  # sharded needs a multi-shard mesh: on a
+            # single-device bench host record the refusal, not a crash
+            impls[impl] = {"error": str(e)[:160]}
+            srv.stop()
+            continue
+
+        def topk_client(seed):
+            r = np.random.RandomState(seed)
+            for _ in range(queries // clients // topk_every):
+                ids = r.randint(0, cfg.vocab_size, size=2)
+                try:
+                    srv.topk_async("emb", emb[ids], k=10).result(timeout=60)
+                except Overloaded:
+                    pass
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=topk_client, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        rep = srv.metrics.report()
+        srv.stop()
+        n_q = (queries // clients // topk_every) * clients
+        impls[impl] = {
+            "qps": round(n_q / wall, 1),
+            "p99_ms": rep.get("topk:emb:10_p99_ms"),
+        }
+    out = {
         "serving_qps": headline["qps"],
         "serving_lookup_p50_ms": headline["lookup_p50_ms"],
         "serving_lookup_p99_ms": headline["lookup_p99_ms"],
@@ -1682,6 +1736,168 @@ def _bench_serving(cfg, queries=4000, clients=4, topk_every=8,
         "serving_shed": headline["shed"],
         "serving_deadline_sweep": sweep,
     }
+    for impl, entry in impls.items():
+        for k, v in entry.items():
+            out[f"serving_topk_{impl}_{k}"] = v
+    return out
+
+
+def _bench_fleet(root, replicas=2, clients=3, per_client=150):
+    """Serving-fleet leg: the replicated HTTP read path end to end — N
+    ``serving.replica`` processes under ``ServingFleet`` over a real
+    checkpoint root, closed-loop ``ServingClient`` traffic from
+    ``clients`` tenants, plus one deliberately noisy tenant whose
+    2048-row lookups blow the per-tenant admission budget (shed rate =
+    its 429s). Mid-load a trainer subprocess commits ckpt-2 and the leg
+    times the snapshot rollout: manifest commit -> every replica's
+    ``/healthz`` reporting the new serving version. Replicas run on CPU
+    (the parent owns the TPU). The kill/heal drill is ci.sh's fleet
+    stage; this leg records the steady-state numbers. MV_BENCH_FLEET=0
+    skips."""
+    import os
+    import subprocess
+    import sys as _s
+    import threading
+    import urllib.request
+
+    if os.environ.get("MV_BENCH_FLEET", "1") == "0":
+        return {}
+    from multiverso_tpu.serving.client import ServingClient
+    from multiverso_tpu.serving.fleet import ServingFleet
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ck_code = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[3])
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.io.checkpoint import save_tables
+step, root = int(sys.argv[1]), sys.argv[2]
+mv.MV_Init()
+t = mv.MV_CreateTable(MatrixTableOption(num_row=4096, num_col=64))
+t.add(np.random.RandomState(step).randn(4096, 64).astype(np.float32) * 0.1)
+t.wait()
+save_tables(os.path.join(root, f"ckpt-{step}"), step=step)
+mv.MV_ShutDown()
+"""
+
+    def commit_ckpt(step):
+        r = subprocess.run(
+            [_s.executable, "-c", ck_code, str(step), root, repo],
+            capture_output=True, text=True, timeout=300,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"fleet leg ckpt-{step} writer failed: {r.stderr[-800:]}"
+            )
+
+    commit_ckpt(1)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    fleet = ServingFleet(
+        replicas, root, log_dir=os.path.join(root, "fleet"),
+        extra_argv=[
+            "-serve_tables=emb", "-serve_poll_s=0.25",
+            "-admission_tenant_qps=500",
+        ],
+        env=env,
+    ).start()
+    try:
+        if not fleet.wait_ready(timeout_s=120):
+            raise RuntimeError("fleet replicas never became ready")
+        urls = fleet.endpoints()
+        lat = [[] for _ in range(clients)]
+        cls = []
+        stop_noisy = threading.Event()
+
+        def normal(i):
+            c = ServingClient(urls, tenant=f"bench-{i}", deadline_s=30.0)
+            cls.append(c)
+            r = np.random.RandomState(i)
+            for _ in range(per_client):
+                ids = r.randint(0, 4096, size=8)
+                t0 = time.perf_counter()
+                c.lookup("emb", ids)
+                lat[i].append(time.perf_counter() - t0)
+
+        def noisy():
+            # 512-row lookups in a tight loop: thousands of rows/s
+            # sustained, far over each replica's 500 rows/s tenant
+            # budget (admission is per replica, so the effective
+            # budget is replicas x qps)
+            c = ServingClient(urls, tenant="noisy", deadline_s=30.0)
+            cls.append(c)
+            r = np.random.RandomState(99)
+            while not stop_noisy.is_set():
+                try:
+                    c.lookup("emb", r.randint(0, 4096, size=512))
+                except Exception:  # noqa: BLE001 — the noisy tenant only
+                    pass           # exists to exercise admission shed
+
+        threads = [
+            threading.Thread(target=normal, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        noisy_th = threading.Thread(target=noisy, daemon=True)
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        noisy_th.start()
+
+        # mid-load snapshot rollout: commit ckpt-2, time commit -> every
+        # replica serving v2 (anchored at the manifest's mtime — the
+        # atomic-rename commit instant)
+        commit_ckpt(2)
+        manifest = os.path.join(root, "ckpt-2", "MANIFEST.json")
+        commit_wall = os.path.getmtime(manifest)
+
+        def version_of(url):
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/healthz", timeout=2
+                ) as resp:
+                    doc = json.loads(resp.read())
+                return int((doc.get("serving") or {}).get("version") or 0)
+            except Exception:  # noqa: BLE001
+                return 0
+
+        rollout_ms = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(version_of(u) >= 2 for u in urls):
+                rollout_ms = (time.time() - commit_wall) * 1e3
+                break
+            time.sleep(0.05)
+
+        for th in threads:
+            th.join(timeout=300)
+        stop_noisy.set()
+        noisy_th.join(timeout=30)
+        wall = time.perf_counter() - t0
+        all_lat = sorted(x for per in lat for x in per)
+        n_ok = len(all_lat)
+        requests = sum(c.stats()["requests"] for c in cls)
+        shed = sum(c.stats()["shed_429"] for c in cls)
+        unrecovered = sum(c.stats()["unrecovered"] for c in cls)
+        out = {
+            "fleet_replicas": replicas,
+            "fleet_qps": round(n_ok / wall, 1),
+            "fleet_lookup_p50_ms": round(
+                all_lat[n_ok // 2] * 1e3, 2) if all_lat else None,
+            "fleet_lookup_p99_ms": round(
+                all_lat[int(n_ok * 0.99)] * 1e3, 2) if all_lat else None,
+            "fleet_shed_rate_pct": round(100.0 * shed / max(requests, 1), 2),
+            "fleet_rollout_ms": (
+                None if rollout_ms is None else round(rollout_ms, 1)
+            ),
+            "fleet_unrecovered": unrecovered,
+        }
+    finally:
+        fleet.stop()
+    return out
 
 
 def _probe_backend(timeout_s: int = 180):
@@ -1820,6 +2036,14 @@ def main():
         print(f"# leg serving FAILED: {e}", file=_sys.stderr, flush=True)
         serving = {"serving_error": str(e)[:200]}
     try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="mv_bench_fleet_") as d:
+            fleet_leg = leg("fleet", lambda: _bench_fleet(d))
+    except Exception as e:
+        print(f"# leg fleet FAILED: {e}", file=_sys.stderr, flush=True)
+        fleet_leg = {"fleet_error": str(e)[:200]}
+    try:
         resilience = leg(
             "resilience", lambda: _bench_resilience(cfg, fused)
         )
@@ -1856,6 +2080,7 @@ def main():
     out.update(bigvocab)
     out.update(ring)
     out.update(serving)
+    out.update(fleet_leg)
     out.update(resilience)
     out.update(e2e)
     out.update(quality)

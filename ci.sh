@@ -37,14 +37,179 @@ command -v luajit >/dev/null 2>&1 \
 { command -v mono >/dev/null 2>&1 || command -v dotnet >/dev/null 2>&1; } \
     && echo "C# toolchain present" || echo "C# toolchain absent (C# test skips)"
 
-echo "== serving smoke e2e (train tiny -> hot-swap -> serve) =="
+echo "== serving smoke e2e (train tiny -> hot-swap -> serve over HTTP) =="
 # the online-serving path end to end on the CPU mesh: tiny skip-gram
 # trains while a TableServer hot-swaps its weights and serves batched
-# lookup + top-k traffic; --assert-clean fails the run unless p99 is
-# finite, shed == 0 at this low load, ZERO torn reads were observed, and
-# the /healthz HTTP self-probe (--health-port 0 = ephemeral) returns ok
+# lookup + top-k traffic — routed through the HTTP data plane
+# (--data-port 0 = ephemeral), so the torn-read oracle checks responses
+# that crossed a real network hop; --assert-clean fails the run unless
+# p99 is finite, shed == 0 at this low load, ZERO torn reads were
+# observed, and the /healthz self-probe (--health-port 0) returns ok
 JAX_PLATFORMS=cpu python examples/serving_demo.py \
-    --queries 2000 --health-port 0 --assert-clean
+    --queries 2000 --health-port 0 --data-port 0 --assert-clean
+
+echo "== serving fleet drill (2 replicas, kill one mid-load + rollout) =="
+# the replicated serving fleet end to end with REAL process death: 2
+# serving.replica processes under the ServingFleet restart budget serve
+# a checkpoint root to concurrent ServingClient load; mid-load the
+# trainer commits a NEW snapshot (both replicas must roll to it) and one
+# replica is chaos-killed (SIGKILL). Gates: ZERO unrecovered client
+# errors across the kill + rollout, the noisy tenant's 429s carry a
+# Retry-After header, and the relaunched replica reaches /readyz 200
+# serving the NEWEST version.
+FLROOT=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$FLROOT" <<'EOF'
+import json, os, signal, sys, threading, time, urllib.error, urllib.request
+import numpy as np
+
+sys.path.insert(0, ".")
+import multiverso_tpu as mv
+from multiverso_tpu.io.checkpoint import save_tables
+from multiverso_tpu.serving.client import ServingClient
+from multiverso_tpu.serving.fleet import ServingFleet
+from multiverso_tpu.tables import MatrixTableOption
+
+root = sys.argv[1]
+
+
+def commit(step, value):
+    mv.MV_Init(["prog"])
+    try:
+        t = mv.MV_CreateTable(MatrixTableOption(num_row=64, num_col=8))
+        t.add(np.full((64, 8), value, np.float32))
+        t.wait()
+        save_tables(os.path.join(root, f"ckpt-{step}"), step=step)
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+commit(1, 1.0)
+fleet = ServingFleet(
+    2, root, log_dir=os.path.join(root, "fleet"),
+    extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25",
+                "-admission_tenant_qps=500"],
+    backoff_base_s=0.1, backoff_max_s=0.5,
+).start()
+assert fleet.wait_ready(timeout_s=120), "replicas never became ready"
+fleet.watch()  # self-healing runs concurrently with the load
+urls = fleet.endpoints()
+assert len(urls) == 2, urls
+
+stop = threading.Event()
+errors, clients = [], []
+
+
+def load(i):
+    c = ServingClient(urls, tenant=f"ci-{i}", deadline_s=30.0)
+    clients.append(c)
+    r = np.random.RandomState(i)
+    while not stop.is_set():
+        ids = r.randint(0, 64, size=4)
+        try:
+            rows = np.asarray(c.lookup("emb", ids), np.float32)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+            return
+        # every response equals ONE committed version's rows
+        if not any(np.allclose(rows, v) for v in (1.0, 2.0)):
+            errors.append(f"torn/wrong rows: {rows[0][:2]}")
+            return
+        time.sleep(0.005)
+
+
+threads = [threading.Thread(target=load, args=(i,)) for i in range(3)]
+for th in threads:
+    th.start()
+
+# noisy tenant: 512-row lookups against a 500 rows/s budget — must shed
+# with 429 + Retry-After (posted raw so the header itself is asserted)
+body = json.dumps({"table": "emb", "ids": list(range(64)) * 8,
+                   "tenant": "ci-noisy"}).encode()
+retry_after = None
+for _ in range(12):
+    req = urllib.request.Request(
+        urls[0] + "/v1/lookup", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=10).read()
+    except urllib.error.HTTPError as e:
+        if e.code == 429:
+            retry_after = e.headers.get("Retry-After")
+            break
+assert retry_after is not None and float(retry_after) > 0, \
+    "noisy tenant never shed with a Retry-After hint"
+
+# trainer publishes a new snapshot mid-load...
+commit(2, 2.0)
+# ...and one replica dies mid-load (SIGKILL the whole process group)
+victim = fleet.pid(0)
+os.killpg(victim, signal.SIGKILL)
+
+deadline = time.monotonic() + 120
+healed = False
+while time.monotonic() < deadline:
+    doc = fleet.endpoint(0)
+    if doc and fleet.pid(0) is not None:
+        try:
+            with urllib.request.urlopen(
+                    doc["url"] + "/healthz", timeout=2) as resp:
+                h = json.loads(resp.read())
+            if h.get("ready") and (h.get("serving") or {}).get(
+                    "version", 0) >= 1:
+                with urllib.request.urlopen(
+                        doc["url"] + "/readyz", timeout=2) as resp:
+                    assert resp.status == 200
+                healed = True
+                break
+        except Exception:  # noqa: BLE001 — still coming up
+            pass
+    time.sleep(0.2)
+assert healed, "killed replica never returned to /readyz 200"
+assert fleet.restarts >= 1, fleet.restarts
+
+# both replicas must end up serving the NEWEST snapshot (ckpt-2)
+deadline = time.monotonic() + 60
+on_v2 = 0
+while time.monotonic() < deadline:
+    on_v2 = 0
+    for i in range(2):
+        doc = fleet.endpoint(i)
+        try:
+            with urllib.request.urlopen(
+                    doc["url"] + "/healthz", timeout=2) as resp:
+                h = json.loads(resp.read())
+            rows = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    doc["url"] + "/v1/lookup",
+                    data=json.dumps({"table": "emb", "ids": [0]}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST"), timeout=10).read())["rows"]
+            if h.get("ready") and abs(rows[0][0] - 2.0) < 1e-6:
+                on_v2 += 1
+        except Exception:  # noqa: BLE001
+            pass
+    if on_v2 == 2:
+        break
+    time.sleep(0.2)
+assert on_v2 == 2, f"only {on_v2}/2 replicas rolled to ckpt-2"
+
+time.sleep(1.0)  # keep load running a beat past the full recovery
+stop.set()
+for th in threads:
+    th.join(timeout=60)
+unrecovered = sum(c.stats()["unrecovered"] for c in clients)
+requests = sum(c.stats()["requests"] for c in clients)
+failovers = sum(c.stats()["failovers"] for c in clients)
+assert not errors, errors[:3]
+assert unrecovered == 0, unrecovered
+assert requests > 50, requests
+fleet.stop()
+assert fleet.alive() == 0
+print(f"fleet drill OK: {requests} requests, 0 unrecovered "
+      f"({failovers} failovers), kill+heal with rollout to ckpt-2, "
+      f"429 Retry-After={retry_after}s")
+EOF
+rm -rf "$FLROOT"
 
 echo "== crash-recovery smoke (chaos kill -> elastic resume) =="
 # fault-tolerance end to end with a REAL process death: the WordEmbedding

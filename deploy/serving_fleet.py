@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Launch a self-healing serving fleet: N replicas over one checkpoint
+root, each on ephemeral ports, relaunched on death under a restart
+budget (the training supervisor's machinery on the read path).
+
+    python deploy/serving_fleet.py \
+        --replicas 2 --checkpoint-dir /ckpts/we --log-dir /tmp/fleet \
+        -- -serve_tables=emb_in,emb_out -admission_tenant_qps=50000
+
+Everything after ``--`` is passed to every replica verbatim
+(``multiverso_tpu.serving.replica`` flags). The fleet prints each
+replica's discovered data-plane URL once it is ready, then supervises
+until Ctrl-C (graceful drain: replicas flip unready, finish in-flight
+requests, exit). Endpoint files (JSON with bound ports) land under
+``<log-dir>/endpoints/``; supervision events in
+``<log-dir>/fleet.log.jsonl``. See DEPLOY.md "Serving fleet".
+"""
+
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    replica_argv = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, replica_argv = argv[:split], argv[split + 1:]
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--checkpoint-dir", required=True,
+                    help="checkpoint root the replicas watch (ckpt-<step> "
+                         "dirs published by the trainer)")
+    ap.add_argument("--log-dir", required=True)
+    ap.add_argument("--max-restarts", type=int, default=5)
+    ap.add_argument("--restart-window-s", type=float, default=600.0)
+    ap.add_argument("--ready-timeout-s", type=float, default=120.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from multiverso_tpu.serving.fleet import ServingFleet
+
+    fleet = ServingFleet(
+        args.replicas, args.checkpoint_dir,
+        log_dir=args.log_dir, extra_argv=replica_argv,
+        max_restarts=args.max_restarts,
+        restart_window_s=args.restart_window_s, seed=args.seed,
+    ).start()
+    try:
+        if fleet.wait_ready(timeout_s=args.ready_timeout_s):
+            for url in fleet.endpoints():
+                print(f"replica ready: {url}", flush=True)
+        else:
+            print(
+                "WARNING: not all replicas ready within "
+                f"{args.ready_timeout_s:.0f}s (is there a valid "
+                "checkpoint under the root yet?)", flush=True,
+            )
+        fleet.watch()
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining fleet...", flush=True)
+    finally:
+        fleet.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

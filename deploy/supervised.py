@@ -28,6 +28,12 @@ size (``--on-failure replace``, bit-for-bit resume) or degraded to N-1
 budget is spent, at which point a structured ``RECOVERY-GIVEUP.json``
 lands next to the recovery log and the launcher exits nonzero. See
 DEPLOY.md "Self-healing pods" for tuning.
+
+This is the *training-side* launcher. Its read-path twin is
+``deploy/serving_fleet.py``: N serving replicas under the same
+``RestartBudget`` machinery, relaunched from the newest valid snapshot
+in the shared checkpoint dir this supervisor's workers publish to
+(DEPLOY.md "Serving fleet").
 """
 
 import argparse

@@ -1,0 +1,169 @@
+"""Replicated serving-fleet demo — CPU-runnable, real process death.
+
+The operator's view of DEPLOY.md "Serving fleet" in one script: a
+trainer-side helper commits a checkpoint, ``ServingFleet`` launches N
+``serving.replica`` processes against that root, ``ServingClient``
+traffic runs through the HTTP data plane with failover, a NEW snapshot
+is committed mid-load (every replica must roll to it), and one replica
+is SIGKILLed to show the restart budget relaunching it from the newest
+snapshot. Finishes with a JSON summary: client stats (requests,
+failovers, shed, unrecovered), fleet restarts, and rollout latency
+measured from the checkpoint's atomic-rename commit instant.
+
+    JAX_PLATFORMS=cpu python examples/fleet_demo.py
+    python examples/fleet_demo.py --replicas 3 --queries 600 --no-kill
+
+Zero ``unrecovered`` across the kill + rollout is the point — the same
+gate ci.sh's fleet drill enforces (this demo is the tunable, narrated
+version of that drill).
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.io.checkpoint import save_tables
+from multiverso_tpu.serving.client import ServingClient
+from multiverso_tpu.serving.fleet import ServingFleet
+from multiverso_tpu.tables import MatrixTableOption
+
+
+def commit(root, step, value, rows=256, cols=32):
+    """Trainer-side stand-in: publish ckpt-<step> filled with `value`."""
+    mv.MV_Init(["prog"])
+    try:
+        t = mv.MV_CreateTable(MatrixTableOption(num_row=rows, num_col=cols))
+        t.add(np.full((rows, cols), value, np.float32))
+        t.wait()
+        save_tables(os.path.join(root, f"ckpt-{step}"), step=step)
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--queries", type=int, default=300,
+                    help="lookups per client")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="snapshot root (default: fresh temp dir)")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="skip the SIGKILL-one-replica chaos step")
+    args = ap.parse_args(argv)
+
+    root = args.checkpoint_dir or tempfile.mkdtemp(prefix="mv_fleet_demo_")
+    log_dir = os.path.join(root, "fleet-logs")
+    commit(root, 1, 1.0)
+    print(f"committed ckpt-1 under {root}")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # replicas serve on a plain 1-device mesh
+    fleet = ServingFleet(
+        args.replicas, root, log_dir=log_dir,
+        extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25"],
+        backoff_base_s=0.1, backoff_max_s=0.5, env=env,
+    ).start()
+    try:
+        if not fleet.wait_ready(timeout_s=120.0):
+            print("fleet never became ready", file=sys.stderr)
+            return 1
+        fleet.watch()
+        urls = fleet.endpoints()
+        print(f"{args.replicas} replicas ready: {urls}")
+
+        stop = threading.Event()
+        clients = [ServingClient(urls, tenant=f"demo-{i}", deadline_s=10.0)
+                   for i in range(args.clients)]
+
+        def run(c, seed):
+            rng = np.random.RandomState(seed)
+            for _ in range(args.queries):
+                if stop.is_set():
+                    return
+                rows = c.lookup("emb", rng.randint(0, 256, size=4))
+                # every row is a full ckpt-1 (1.0) or ckpt-2 (2.0) row:
+                # anything else would be a torn rollout
+                assert np.allclose(rows, rows[0, 0]), rows
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=run, args=(c, 7 + i), daemon=True)
+                   for i, c in enumerate(clients)]
+        for th in threads:
+            th.start()
+
+        # mid-load rollout: commit ckpt-2, time until every replica serves it
+        commit(root, 2, 2.0)
+        t_commit = os.path.getmtime(os.path.join(root, "ckpt-2",
+                                                 "MANIFEST.json"))
+        print("committed ckpt-2 mid-load, waiting for fleet-wide rollout...")
+
+        def version_of(url):
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=2) as resp:
+                    doc = json.loads(resp.read().decode())
+                return int((doc.get("serving") or {}).get("version") or 0)
+            except Exception:
+                return 0
+
+        rollout_ms = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(version_of(u) >= 2 for u in fleet.endpoints()):
+                rollout_ms = (time.time() - t_commit) * 1e3
+                break
+            time.sleep(0.1)
+        print(f"rollout to ckpt-2 fleet-wide in {rollout_ms:.0f} ms"
+              if rollout_ms is not None else "rollout timed out")
+
+        if not args.no_kill and args.replicas >= 2:
+            victim = fleet.pid(0)
+            print(f"SIGKILL replica 0 (pid {victim}) — clients fail over, "
+                  "the budget relaunches it from ckpt-2")
+            os.killpg(victim, signal.SIGKILL)
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                fleet.poll_once()
+                if fleet.alive() == args.replicas and all(
+                        version_of(u) >= 2 for u in fleet.endpoints()):
+                    break
+                time.sleep(0.25)
+            print(f"healed: {fleet.alive()}/{args.replicas} alive, "
+                  f"{fleet.restarts} restart(s)")
+
+        for th in threads:
+            th.join(timeout=120)
+        stop.set()
+
+        totals = {k: sum(c.stats()[k] for c in clients)
+                  for k in clients[0].stats()}
+        summary = {
+            "replicas": args.replicas,
+            "requests": totals["requests"],
+            "failovers": totals["failovers"],
+            "shed_429": totals["shed_429"],
+            "unrecovered": totals["unrecovered"],
+            "fleet_restarts": fleet.restarts,
+            "rollout_ms": None if rollout_ms is None else round(rollout_ms, 1),
+        }
+        print(json.dumps(summary, indent=2))
+        return 0 if totals["unrecovered"] == 0 else 1
+    finally:
+        fleet.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

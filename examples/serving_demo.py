@@ -10,6 +10,7 @@ report: p50/p99 latency per route, QPS, batch-fill ratio, shed count.
 
     JAX_PLATFORMS=cpu python examples/serving_demo.py
     python examples/serving_demo.py --queries 3000 --assert-clean  # CI
+    python examples/serving_demo.py --data-port 0  # oracle over HTTP
 
 ``--assert-clean`` exits non-zero unless torn == 0, shed == 0 and the
 p99s are finite — the ci.sh serving smoke gate.
@@ -49,6 +50,12 @@ def main(argv=None):
                     help="serve GET /healthz while the demo runs "
                          "(-1 = off, 0 = ephemeral port, >0 explicit); "
                          "the summary reports a self-probe of it")
+    ap.add_argument("--data-port", type=int, default=-1,
+                    help="serve the HTTP data plane and route ALL client "
+                         "traffic through it (-1 = off/in-process, 0 = "
+                         "ephemeral port, >0 explicit) — the torn-read "
+                         "oracle then checks responses that crossed a "
+                         "real network hop")
     ap.add_argument("--assert-clean", action="store_true",
                     help="exit 1 unless torn==0, shed==0, p99 finite "
                          "(and the /healthz self-probe returned ok when "
@@ -73,6 +80,14 @@ def main(argv=None):
         from multiverso_tpu.serving import HealthServer
 
         health_srv = HealthServer(srv, port=args.health_port)
+
+    data_srv = None
+    http_client = None
+    if args.data_port >= 0:
+        from multiverso_tpu.serving import DataPlaneServer, ServingClient
+
+        data_srv = DataPlaneServer(srv, port=args.data_port)
+        http_client = ServingClient([data_srv.url], deadline_s=30.0)
 
     # version registry: the torn-read oracle. version -> full table copy.
     history = {srv.version: np.asarray(params["emb_in"]).copy()}
@@ -111,13 +126,22 @@ def main(argv=None):
                 if q % 8 == 7:  # 1-in-8 queries is a top-k
                     with history_lock:
                         some = history[max(history)]
-                    f = srv.topk_async("emb", some[ids[:2]], k=5)
-                    f.result(timeout=30)
+                    if http_client is not None:
+                        http_client.topk("emb", some[ids[:2]], k=5)
+                    else:
+                        f = srv.topk_async("emb", some[ids[:2]], k=5)
+                        f.result(timeout=30)
                     with counters_lock:
                         counters["topk"] += 1
                     continue
-                f = srv.lookup_async("emb", ids)
-                rows = f.result(timeout=30)
+                if http_client is not None:
+                    # the HTTP hop is float32-exact: JSON carries float32
+                    # values through float64 losslessly, so the torn-read
+                    # oracle below applies unchanged
+                    rows = http_client.lookup("emb", ids)
+                else:
+                    f = srv.lookup_async("emb", ids)
+                    rows = f.result(timeout=30)
             except Overloaded as e:
                 with counters_lock:
                     counters["shed_client"] += 1
@@ -172,6 +196,7 @@ def main(argv=None):
         "p99_ms": r.get("lookup:emb_p99_ms"),
         "topk_p99_ms": r.get("topk:emb:5_p99_ms"),
         "wall_s": round(wall, 2),
+        "data_plane": None if data_srv is None else data_srv.url,
         "healthz_status": None if healthz is None else healthz.get("status"),
         "healthz_version": (
             None if healthz is None
@@ -179,6 +204,8 @@ def main(argv=None):
         ),
     }
     print(json.dumps(summary, indent=2))
+    if data_srv is not None:
+        data_srv.stop()
     if health_srv is not None:
         health_srv.stop()
     srv.stop()

@@ -8,7 +8,10 @@ AdaGrad / FTRL), model-averaging allreduce, checkpointing, Python table
 handlers and framework param-manager hooks, the two reference
 applications (WordEmbedding, LogisticRegression), and an online serving
 subsystem (``multiverso_tpu.serving``: dynamic-batching ``TableServer``
-with hot-swap weights over frozen table snapshots).
+with hot-swap weights over frozen table snapshots, deployable as a
+replicated self-healing fleet — HTTP data plane, per-replica snapshot
+rollout from trainer checkpoints, per-tenant admission control, and a
+failover client; see ``serving.replica`` / ``deploy/serving_fleet.py``).
 
 Architecture (see SURVEY.md §7): tables are sharded ``jax.Array``s in HBM over
 a device mesh; Get/Add lower to XLA collectives over ICI/DCN; updaters are

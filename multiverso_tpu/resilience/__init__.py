@@ -29,6 +29,13 @@ publishes *normal*, tested events:
   a replacement rank (bit-for-bit) or degraded to N-1 (elastic
   re-shard), under a full-jitter restart budget with a structured
   recovery log.
+
+The same primitives run unchanged on the read path: the serving fleet
+(``serving.fleet``) supervises replicas under ``RestartBudget``, the
+fleet client (``serving.client``) retries through ``FullJitterBackoff``,
+and each replica's ``SnapshotWatcher`` discovers rollout candidates via
+``latest_valid`` — training-side robustness reused as serving-side
+robustness.
 """
 
 from multiverso_tpu.resilience.breaker import CircuitBreaker

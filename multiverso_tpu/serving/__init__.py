@@ -15,7 +15,21 @@ package is the read path sized for that traffic:
   depth, batch-fill ratio and shed counts, wired into the Dashboard;
 * ``http_health`` — stdlib HTTP surface: ``GET /healthz`` answers with
   ``TableServer.health()`` + the resilience and failure_domain sections
-  as one JSON document (``-health_port`` flag).
+  as one JSON document (``-health_port`` flag);
+* ``http_data`` — the query routes over HTTP (``POST /v1/lookup``,
+  ``/v1/topk``, ``/v1/predict``): shed maps to 429 + ``Retry-After``,
+  breaker-open/warming to 503 (``-data_port`` flag);
+* ``client``   — fleet client: deadline propagation, full-jitter retry,
+  multi-endpoint failover (zero unrecovered errors through a replica
+  kill is the ci.sh fleet-drill gate);
+* ``admission`` — per-tenant token buckets in front of the batcher: a
+  noisy tenant sheds against its own budget, not the fleet's;
+* ``rollout``  — per-replica snapshot version-watch: poll
+  ``latest_valid``, publish new checkpoints through the validation
+  gate, keep serving N-1 on a bad rollout;
+* ``replica`` / ``fleet`` — the deployable unit (data plane + health +
+  watcher + graceful drain) and the N-replica self-healing launcher
+  behind ``deploy/serving_fleet.py``.
 
 Degradation (resilience subsystem): ``publish`` validates staged weights
 and rejects poisoned tables with ``PublishRejected`` (previous snapshot
@@ -26,24 +40,36 @@ Everything is CPU-runnable (the fake 8-device mesh used by tier-1 tests);
 on TPU the same jitted programs shard the score matmuls over the mesh.
 """
 
+from multiverso_tpu.serving.admission import AdmissionController, TokenBucket
 from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded, Request
+from multiverso_tpu.serving.client import ServingClient, Unrecovered
+from multiverso_tpu.serving.http_data import DataPlaneServer
 from multiverso_tpu.serving.http_health import HealthServer, health_payload
 from multiverso_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from multiverso_tpu.serving.rollout import SnapshotWatcher
 from multiverso_tpu.serving.server import (
     PublishRejected,
+    RouteUnavailable,
     ServingSnapshot,
     TableServer,
 )
 
 __all__ = [
+    "AdmissionController",
+    "DataPlaneServer",
     "DynamicBatcher",
     "HealthServer",
     "Overloaded",
     "PublishRejected",
     "Request",
+    "RouteUnavailable",
     "LatencyHistogram",
     "ServingMetrics",
+    "ServingClient",
     "ServingSnapshot",
+    "SnapshotWatcher",
     "TableServer",
+    "TokenBucket",
+    "Unrecovered",
     "health_payload",
 ]

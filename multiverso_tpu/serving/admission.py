@@ -1,0 +1,241 @@
+"""Per-tenant admission control: token buckets in front of the batcher.
+
+The DynamicBatcher's shed is *global* — when the ticket ring fills, every
+caller sheds, so one tenant replaying its corpus at line rate starves
+everyone sharing the replica. Admission control moves the first gate
+per-key: each tenant draws from its own token bucket (``rate`` units/s,
+``burst`` capacity; one unit = one query row, so a 512-row lookup costs
+512× a single-row one) and a tenant over budget sheds with
+``Overloaded(retry_after)`` — the same exception the batcher raises, so
+clients and the HTTP data plane (429 + ``Retry-After``) treat both
+identically — while other tenants' buckets are untouched.
+
+Buckets are lazy (first request creates the tenant's bucket) and
+refill continuously from an injectable monotonic clock, so tests drive
+them deterministically. ``-admission_tenant_qps`` /
+``-admission_tenant_burst`` arm a controller in flag-driven replicas
+(``serving/replica.py``); library users pass
+``TableServer(admission=...)`` directly.
+
+Observability: per-tenant admitted/shed counters land in a Dashboard
+section (snapshot twin → Prometheus ``/metrics``), and the first shed of
+each saturation episode records an ``admission_shed`` flight event so a
+post-mortem names the noisy tenant.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from multiverso_tpu.analysis.guards import OrderedLock
+from multiverso_tpu.serving.batcher import Overloaded
+from multiverso_tpu.utils.configure import MV_DEFINE_double, GetFlag
+from multiverso_tpu.utils.log import CHECK
+
+__all__ = [
+    "AdmissionController",
+    "TokenBucket",
+    "controller_from_flags",
+]
+
+MV_DEFINE_double(
+    "admission_tenant_qps", 0.0,
+    "per-tenant admission budget for serving replicas, in query ROWS "
+    "per second (a 512-row lookup costs 512 units); a tenant over "
+    "budget is shed with 429 + Retry-After while other tenants are "
+    "untouched (0 = admission control off)",
+)
+MV_DEFINE_double(
+    "admission_tenant_burst", 0.0,
+    "per-tenant token-bucket burst capacity in query rows — how far a "
+    "tenant can spike above -admission_tenant_qps before shedding "
+    "(0 = auto: 2x the per-second budget)",
+)
+
+
+class TokenBucket:
+    """Continuous-refill token bucket. NOT thread-safe on its own — the
+    controller serialises access; standalone users must too."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        CHECK(rate > 0.0, "token bucket rate must be > 0")
+        CHECK(burst > 0.0, "token bucket burst must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = self.burst  # start full: first burst admits
+        self._last = clock()
+
+    def _refill(self, now: float) -> None:
+        if now > self._last:
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+        self._last = now
+
+    def try_take(self, cost: float = 1.0) -> Tuple[bool, float]:
+        """Admit while the balance is positive, charging the FULL cost —
+        the balance may go negative (debt). Debt-based accounting keeps
+        variable-cost requests sane: a single request larger than the
+        burst still admits (then its tenant sheds until the debt
+        refills) instead of being permanently inadmissible. Returns
+        ``(admitted, retry_after_s)``; the shed hint is the exact refill
+        time back to a positive balance."""
+        now = self._clock()
+        self._refill(now)
+        if self._tokens > 0.0:
+            self._tokens -= float(cost)
+            return True, 0.0
+        return False, max(-self._tokens / self.rate, 1e-4)
+
+    @property
+    def tokens(self) -> float:
+        self._refill(self._clock())
+        return self._tokens
+
+
+class AdmissionController:
+    """Per-tenant token buckets with lazy creation and shared defaults.
+
+    ``admit(tenant, cost)`` raises ``Overloaded(retry_after)`` when the
+    tenant is over budget; ``try_admit`` is the non-raising form. Tenant
+    budgets default to (``default_qps``, ``default_burst``) and can be
+    pinned per tenant with ``set_tenant_budget`` (a paid tier, an
+    internal bulk reader)."""
+
+    def __init__(
+        self,
+        default_qps: float,
+        default_burst: Optional[float] = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "admission",
+    ):
+        CHECK(default_qps > 0.0, "admission default_qps must be > 0")
+        self.name = name
+        self.default_qps = float(default_qps)
+        self.default_burst = float(
+            default_burst if default_burst else 2.0 * default_qps
+        )
+        self._clock = clock
+        # OrderedLock (mvlint R2): every HTTP handler thread funnels here
+        self._lock = OrderedLock(f"admission.{name}._lock")
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._budgets: Dict[str, Tuple[float, float]] = {}
+        self._admitted: Dict[str, int] = {}
+        self._shed: Dict[str, int] = {}
+        # per-tenant saturation latch: one flight event per episode, not
+        # one per shed (a saturating tenant sheds thousands of times)
+        self._shedding: Dict[str, bool] = {}
+        self._registered_key: Optional[str] = None
+
+    # ------------------------------------------------------------ budgets
+
+    def set_tenant_budget(self, tenant: str, qps: float,
+                          burst: Optional[float] = None) -> None:
+        with self._lock:
+            self._budgets[tenant] = (
+                float(qps), float(burst if burst else 2.0 * qps)
+            )
+            self._buckets.pop(tenant, None)  # rebuild on next request
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            qps, burst = self._budgets.get(
+                tenant, (self.default_qps, self.default_burst)
+            )
+            b = TokenBucket(qps, burst, clock=self._clock)
+            self._buckets[tenant] = b
+        return b
+
+    # ------------------------------------------------------------ admit
+
+    def try_admit(self, tenant: str, cost: float = 1.0
+                  ) -> Tuple[bool, float]:
+        with self._lock:
+            ok, retry_after = self._bucket(tenant).try_take(cost)
+            if ok:
+                self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+                self._shedding[tenant] = False
+                return True, 0.0
+            self._shed[tenant] = self._shed.get(tenant, 0) + 1
+            first_of_episode = not self._shedding.get(tenant, False)
+            self._shedding[tenant] = True
+        if first_of_episode:
+            from multiverso_tpu.obs import recorder
+
+            recorder.record(
+                "admission_shed", controller=self.name, tenant=tenant,
+                retry_after_s=round(retry_after, 4),
+            )
+        return False, retry_after
+
+    def admit(self, tenant: str, cost: float = 1.0) -> None:
+        """Gate one request; raises ``Overloaded`` (the batcher's shed
+        exception — clients already handle it) when over budget."""
+        ok, retry_after = self.try_admit(tenant, cost)
+        if not ok:
+            raise Overloaded(retry_after)
+
+    # ------------------------------------------------------------ obs
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            tenants = sorted(set(self._admitted) | set(self._shed))
+            return {
+                "default_qps": self.default_qps,
+                "default_burst": self.default_burst,
+                "tenants": {
+                    t: {
+                        "admitted": self._admitted.get(t, 0),
+                        "shed": self._shed.get(t, 0),
+                    }
+                    for t in tenants
+                },
+                "admitted_total": sum(self._admitted.values()),
+                "shed_total": sum(self._shed.values()),
+            }
+
+    def _lines(self) -> List[str]:
+        s = self.stats()
+        noisy = sorted(
+            s["tenants"].items(), key=lambda kv: -kv[1]["shed"]
+        )[:3]
+        noisy_str = " ".join(
+            f"{t}:{v['shed']}" for t, v in noisy if v["shed"]
+        ) or "none"
+        return [
+            f"[Admission:{self.name}] tenants={len(s['tenants'])} "
+            f"admitted={s['admitted_total']} shed={s['shed_total']} "
+            f"noisiest={noisy_str}"
+        ]
+
+    def register_dashboard(self) -> None:
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        self._registered_key = f"serving.admission.{self.name}.{id(self)}"
+        Dashboard.add_section(
+            self._registered_key, self._lines, snapshot=self.stats
+        )
+
+    def unregister_dashboard(self) -> None:
+        if self._registered_key is not None:
+            from multiverso_tpu.utils.dashboard import Dashboard
+
+            Dashboard.remove_section(self._registered_key)
+            self._registered_key = None
+
+
+def controller_from_flags(name: str = "admission"
+                          ) -> Optional[AdmissionController]:
+    """Build a controller from ``-admission_tenant_qps`` /
+    ``-admission_tenant_burst`` (None when the feature is off)."""
+    qps = float(GetFlag("admission_tenant_qps"))
+    if qps <= 0.0:
+        return None
+    burst = float(GetFlag("admission_tenant_burst"))
+    return AdmissionController(qps, burst if burst > 0.0 else None,
+                               name=name)

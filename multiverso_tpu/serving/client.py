@@ -1,0 +1,218 @@
+"""Serving client: deadline-bounded, fail-over HTTP access to a fleet.
+
+The data plane (``serving/http_data.py``) makes each replica an HTTP
+endpoint; this client makes N replicas *one service*. Per call it:
+
+* propagates the remaining deadline (``deadline_ms`` in the body +
+  socket timeout), so the whole retry tree shares one budget;
+* honours **429 + Retry-After** (tenant/queue shed) by sleeping the
+  server's hint — capped by the remaining budget — and retrying;
+* treats **503** (breaker open, warming replica, drain) and transport
+  errors as *endpoint* failures: fail over to the next endpoint with
+  full-jitter backoff (``chaos.FullJitterBackoff`` — the training
+  side's retry curve, reused verbatim on the read path);
+* treats **400** as a client bug: raise immediately, never retry;
+* raises ``Unrecovered`` only when the deadline or attempt budget is
+  exhausted across all endpoints — the fleet drill's gate is exactly
+  ``stats()["unrecovered"] == 0`` through a replica kill.
+
+Endpoints rotate round-robin across calls so a multi-thread load
+generator spreads naturally; a failed endpoint is only skipped for the
+current call (the fleet relaunches replicas — permanent blacklisting
+would fight the supervisor's self-healing).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu.resilience.chaos import FullJitterBackoff
+from multiverso_tpu.utils.log import CHECK
+
+__all__ = ["ServingClient", "Unrecovered"]
+
+
+class Unrecovered(RuntimeError):
+    """Every endpoint/retry within the deadline failed; ``last_error``
+    carries the final failure."""
+
+    def __init__(self, msg: str, last_error: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.last_error = last_error
+
+
+class _Shed(Exception):
+    """Internal: 429 — retryable on the same fleet after Retry-After."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(f"shed; retry after {retry_after_s:.4f}s")
+        self.retry_after_s = retry_after_s
+
+
+class _EndpointDown(Exception):
+    """Internal: 503 / 5xx / transport error — fail over."""
+
+
+class ServingClient:
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        *,
+        tenant: str = "default",
+        deadline_s: float = 5.0,
+        max_attempts: int = 8,
+        backoff_base_s: float = 0.02,
+        backoff_max_s: float = 0.5,
+        seed: int = 0,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        CHECK(len(endpoints) >= 1, "ServingClient needs >= 1 endpoint")
+        self.endpoints = [e.rstrip("/") for e in endpoints]
+        self.tenant = tenant
+        self.deadline_s = float(deadline_s)
+        self.max_attempts = int(max_attempts)
+        self._backoff = FullJitterBackoff(
+            base_delay_s=backoff_base_s, max_delay_s=backoff_max_s, seed=seed
+        )
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._stats = {
+            "requests": 0, "ok": 0, "retries": 0, "failovers": 0,
+            "shed_429": 0, "unavailable_503": 0, "deadline_504": 0,
+            "unrecovered": 0,
+        }
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    def _next_start(self) -> int:
+        with self._lock:
+            i = self._rr
+            self._rr = (self._rr + 1) % len(self.endpoints)
+            return i
+
+    # ------------------------------------------------------------ transport
+
+    def _post_once(self, endpoint: str, route: str, body: Dict[str, Any],
+                   timeout_s: float) -> Dict[str, Any]:
+        data = json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"{endpoint}{route}", data=data,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            retry_after = float(e.headers.get("Retry-After") or 0.0)
+            payload = b""
+            try:
+                payload = e.read()
+            except OSError:
+                pass
+            if e.code == 429:
+                self._bump("shed_429")
+                raise _Shed(retry_after) from None
+            if e.code in (503, 502, 504, 500):
+                if e.code == 503:
+                    self._bump("unavailable_503")
+                if e.code == 504:
+                    self._bump("deadline_504")
+                raise _EndpointDown(
+                    f"{endpoint}{route} -> {e.code}: {payload[:200]!r}"
+                ) from None
+            # 400/404: a client bug — retrying cannot help
+            raise ValueError(
+                f"{endpoint}{route} -> {e.code}: {payload[:200]!r}"
+            ) from None
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise _EndpointDown(f"{endpoint}{route}: {e!r}") from None
+
+    def _call(self, route: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        self._bump("requests")
+        body = dict(body)
+        body.setdefault("tenant", self.tenant)
+        deadline = self._clock() + self.deadline_s
+        start = self._next_start()
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            remaining = deadline - self._clock()
+            if remaining <= 0.0:
+                break
+            endpoint = self.endpoints[(start + attempt) % len(self.endpoints)]
+            body["deadline_ms"] = max(remaining * 1e3, 1.0)
+            try:
+                out = self._post_once(endpoint, route, body, remaining)
+                self._bump("ok")
+                return out
+            except _Shed as e:
+                # server's own hint wins; never sleep past the deadline
+                last = e
+                pause = min(e.retry_after_s, deadline - self._clock())
+            except _EndpointDown as e:
+                last = e
+                self._bump("failovers")
+                pause = min(
+                    self._backoff.next_delay(attempt),
+                    deadline - self._clock(),
+                )
+            if attempt + 1 < self.max_attempts and pause > 0.0:
+                self._bump("retries")
+                self._sleep(pause)
+        self._bump("unrecovered")
+        raise Unrecovered(
+            f"{route} failed after {self.max_attempts} attempts / "
+            f"{self.deadline_s:.2f}s deadline across "
+            f"{len(self.endpoints)} endpoint(s): {last!r}",
+            last_error=last,
+        )
+
+    # ------------------------------------------------------------ routes
+
+    def lookup(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = self._call("/v1/lookup", {"table": table, "ids": ids.tolist()})
+        return np.asarray(out["rows"], np.float32)
+
+    def topk(self, table: str, queries, k: int = 10
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        q = np.asarray(queries, np.float32)
+        out = self._call(
+            "/v1/topk", {"table": table, "queries": q.tolist(), "k": int(k)}
+        )
+        return (
+            np.asarray(out["ids"], np.int64),
+            np.asarray(out["scores"], np.float32),
+        )
+
+    def predict(self, table: str, X) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        out = self._call(
+            "/v1/predict", {"table": table, "features": X.tolist()}
+        )
+        return np.asarray(out["scores"], np.float32)
+
+    def health(self, endpoint_index: int = 0,
+               timeout_s: float = 2.0) -> Dict[str, Any]:
+        """One endpoint's /healthz (no retry — a probe, not a query)."""
+        url = f"{self.endpoints[endpoint_index]}/healthz"
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read())

@@ -1,0 +1,285 @@
+"""Serving fleet: N self-healing replicas on one host.
+
+``PodSupervisor`` (resilience/supervisor.py) babysits a *training* pod —
+a gang that lives or dies together. A serving fleet is the opposite
+shape: replicas are independent, so the unit of recovery is ONE replica,
+not the pod. ``ServingFleet`` spawns N ``serving.replica`` processes
+(each on ephemeral ports, each watching the same checkpoint root) and
+relaunches exactly the replica that died, under the *same*
+``RestartBudget`` machinery the training supervisor uses — full-jitter
+backoff, sliding-window restart cap, structured JSONL event log
+(``fleet.log.jsonl``) and flight-recorder events. A relaunched replica
+needs no state handoff: its ``SnapshotWatcher`` loads the newest valid
+checkpoint and ``/readyz`` flips when the publish lands.
+
+The fleet is deliberately jax-free (like the supervisor): it shells out
+to ``python -m multiverso_tpu.serving.replica`` and talks to replicas
+only through endpoint files and HTTP probes — exactly what an external
+orchestrator would do, which keeps the drill honest.
+
+``stop()`` is a graceful drain: SIGTERM (the replica flips unready,
+drains the batcher, exits 0), escalating to SIGKILL only after
+``exit_grace_s``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from multiverso_tpu.resilience.supervisor import RestartBudget
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["ServingFleet"]
+
+_REPLICA_MODULE = "multiverso_tpu.serving.replica"
+
+
+class ServingFleet:
+    """Spawn/supervise N serving replicas over one checkpoint root."""
+
+    def __init__(
+        self,
+        replicas: int,
+        checkpoint_root: str,
+        *,
+        log_dir: str,
+        extra_argv: Sequence[str] = (),
+        python: str = sys.executable,
+        max_restarts: int = 5,
+        restart_window_s: float = 600.0,
+        backoff_base_s: float = 0.25,
+        backoff_max_s: float = 5.0,
+        seed: int = 0,
+        poll_s: float = 0.25,
+        exit_grace_s: float = 10.0,
+        env: Optional[Dict[str, str]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        CHECK(replicas >= 1, "fleet needs >= 1 replica")
+        self.n = int(replicas)
+        self.root = str(checkpoint_root)
+        self.log_dir = str(log_dir)
+        self.extra_argv = list(extra_argv)
+        self.python = python
+        self.poll_s = float(poll_s)
+        self.exit_grace_s = float(exit_grace_s)
+        self._env = dict(env) if env is not None else dict(os.environ)
+        self._clock = clock
+        self._sleep = sleep
+        self._budget = RestartBudget(
+            max_restarts=max_restarts, window_s=restart_window_s,
+            base_delay_s=backoff_base_s, max_delay_s=backoff_max_s,
+            seed=seed, clock=clock,
+        )
+        self._procs: List[Optional[subprocess.Popen]] = [None] * self.n
+        # replica slots the budget gave up on: stay down, fleet degrades
+        self._abandoned: List[bool] = [False] * self.n
+        self.restarts = 0
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        os.makedirs(self.log_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.log_dir, "endpoints"), exist_ok=True)
+
+    # ------------------------------------------------------------ events
+
+    def _event(self, kind: str, **fields: Any) -> None:
+        rec = {"wall": time.time(), "event": kind, **fields}
+        try:
+            with open(os.path.join(self.log_dir, "fleet.log.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            Log.Error("fleet event log write failed: %s", e)
+        from multiverso_tpu.obs import recorder
+
+        recorder.record(f"fleet_{kind}", **fields)
+
+    # ------------------------------------------------------------ spawn
+
+    def endpoint_file(self, index: int) -> str:
+        return os.path.join(
+            self.log_dir, "endpoints", f"replica-{index}.json"
+        )
+
+    def _spawn(self, index: int) -> None:
+        ep = self.endpoint_file(index)
+        try:
+            os.remove(ep)  # stale file must not advertise a dead port
+        except OSError:
+            pass
+        argv = [
+            self.python, "-m", _REPLICA_MODULE,
+            f"-serve_checkpoint_dir={self.root}",
+            "-data_port=-1",    # ephemeral: co-hosted replicas never
+            "-health_port=-1",  # race a fixed port (endpoint file tells)
+            *self.extra_argv,
+        ]
+        env = dict(self._env)
+        env["MV_ENDPOINT_FILE"] = ep
+        env.pop("MV_READY_FILE", None)  # readiness is probed over HTTP
+        log_path = os.path.join(self.log_dir, f"replica-{index}.log")
+        logf = open(log_path, "a")
+        # own session: SIGTERM/SIGKILL reach the whole replica group
+        self._procs[index] = subprocess.Popen(
+            argv, stdout=logf, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True,
+        )
+        logf.close()
+        self._event(
+            "replica_spawn", replica=index,
+            pid=self._procs[index].pid, log=log_path,
+        )
+
+    def start(self) -> "ServingFleet":
+        for i in range(self.n):
+            self._spawn(i)
+        return self
+
+    # ------------------------------------------------------------ discovery
+
+    def endpoint(self, index: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.endpoint_file(index)) as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def endpoints(self) -> List[str]:
+        """Data-plane URLs of replicas that have come up (order-stable)."""
+        urls = []
+        for i in range(self.n):
+            doc = self.endpoint(i)
+            if doc and doc.get("url"):
+                urls.append(doc["url"])
+        return urls
+
+    def _ready(self, index: int, timeout_s: float = 1.0) -> bool:
+        doc = self.endpoint(index)
+        if not doc:
+            return False
+        try:
+            with urllib.request.urlopen(
+                f"{doc['url']}/readyz", timeout=timeout_s
+            ) as resp:
+                return resp.status == 200
+        except Exception:  # noqa: BLE001 — any probe failure = not ready
+            return False
+
+    def wait_ready(self, timeout_s: float = 60.0) -> bool:
+        """Block until every non-abandoned replica answers /readyz 200
+        (i.e. has published its first snapshot)."""
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
+            self.poll_once()
+            if all(
+                self._abandoned[i] or self._ready(i) for i in range(self.n)
+            ):
+                return True
+            self._sleep(self.poll_s)
+        return False
+
+    def pid(self, index: int) -> Optional[int]:
+        p = self._procs[index]
+        return p.pid if p is not None and p.poll() is None else None
+
+    def alive(self) -> int:
+        return sum(1 for i in range(self.n) if self.pid(i) is not None)
+
+    # ------------------------------------------------------------ healing
+
+    def poll_once(self) -> None:
+        """One supervision pass: relaunch every replica that died (within
+        budget). Deterministic for tests — no sleeping beyond the spent
+        backoff delay."""
+        for i in range(self.n):
+            p = self._procs[i]
+            if p is None or self._abandoned[i]:
+                continue
+            rc = p.poll()
+            if rc is None:
+                continue
+            self._event("replica_exit", replica=i, rc=rc)
+            if self._stop.is_set():
+                continue  # shutdown in progress: exits are expected
+            if self._budget.exhausted():
+                self._abandoned[i] = True
+                self._event(
+                    "replica_give_up", replica=i,
+                    restarts_in_window=self._budget.used(),
+                )
+                Log.Error(
+                    "fleet: restart budget exhausted, replica %d stays "
+                    "down (fleet degrades to %d)", i, self.alive(),
+                )
+                continue
+            delay = self._budget.spend()
+            self.restarts += 1
+            self._event(
+                "replica_relaunch", replica=i, rc=rc,
+                backoff_s=round(delay, 3),
+            )
+            self._sleep(delay)
+            self._spawn(i)
+
+    def watch(self) -> "ServingFleet":
+        """Run the supervision loop on a background thread (joined by
+        ``stop()``)."""
+        CHECK(self._watch_thread is None, "fleet watch already running")
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — the healer never
+                    # dies; a dead watch turns one crash into an outage
+                    Log.Error("fleet watch survived internal error: %r", e)
+                self._stop.wait(self.poll_s)
+
+        self._watch_thread = threading.Thread(
+            target=run, daemon=True, name="mv-fleet-watch"
+        )
+        self._watch_thread.start()
+        return self
+
+    # ------------------------------------------------------------ shutdown
+
+    def stop(self) -> None:
+        """Graceful drain: SIGTERM everyone, escalate to SIGKILL after
+        ``exit_grace_s``; joins the watch thread."""
+        self._stop.set()
+        th = self._watch_thread
+        if th is not None:
+            th.join(timeout=self.poll_s * 8 + 5.0)
+            self._watch_thread = None
+        for i, p in enumerate(self._procs):
+            if p is not None and p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+        deadline = self._clock() + self.exit_grace_s
+        for i, p in enumerate(self._procs):
+            if p is None:
+                continue
+            while p.poll() is None and self._clock() < deadline:
+                self._sleep(0.05)
+            if p.poll() is None:
+                self._event("replica_kill", replica=i, pid=p.pid)
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+                p.wait(timeout=5)
+        self._event(
+            "stopped", restarts=self.restarts,
+            abandoned=sum(self._abandoned),
+        )

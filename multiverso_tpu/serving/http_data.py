@@ -1,0 +1,251 @@
+"""HTTP data plane: the TableServer's query routes over stdlib HTTP.
+
+``http_health.py`` proved the pattern — a daemon-thread
+``ThreadingHTTPServer``, zero new dependencies — and this module extends
+it to the read path itself, promoting ``TableServer`` from in-process
+library to network service:
+
+* ``POST /v1/lookup``  — ``{"table", "ids": [int...]}``
+  → ``{"rows": [[...]...]}``;
+* ``POST /v1/topk``    — ``{"table", "queries": [[...]...], "k"}``
+  → ``{"ids", "scores"}``;
+* ``POST /v1/predict`` — ``{"table", "features": [[...]...]}``
+  → ``{"scores"}``.
+
+Every request body may carry ``"tenant"`` (admission-control key,
+default ``"default"``) and ``"deadline_ms"`` (remaining client budget —
+the handler waits at most that long on the batcher future and answers
+504 on expiry, so a slow flush can never pin a client past its SLO).
+
+**Error contract** (what ``serving/client.py`` keys on):
+
+* queue/admission shed (``Overloaded``)          → **429** +
+  ``Retry-After`` (seconds, fractional) — client pressure: back off and
+  retry *this* endpoint;
+* breaker open / no snapshot yet (``RouteUnavailable``, unpublished
+  server) → **503** (+ ``Retry-After`` when the breaker knows its
+  cooldown) — server fault: fail over to another replica;
+* malformed JSON / validation ``CHECK`` failures  → **400** — client
+  bug: do not retry;
+* deadline expiry                                 → **504**.
+
+Each handler thread blocks on its own batcher future, so concurrent
+HTTP requests co-batch through the DynamicBatcher exactly like
+in-process ``*_async`` callers — the micro-batching economics survive
+the network hop. GET requests delegate to ``http_health``'s shared
+handler: one replica port serves probes and data alike.
+
+``-data_port`` wires it into flag-driven replicas (0 = off, -1 =
+ephemeral with the bound port registered in the health payload's
+``ports`` map — the co-hosted-replica contract).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.serving import http_health
+from multiverso_tpu.serving.batcher import Overloaded
+from multiverso_tpu.serving.server import RouteUnavailable
+from multiverso_tpu.utils.configure import MV_DEFINE_int, GetFlag
+from multiverso_tpu.utils.log import FatalError, Log
+
+__all__ = ["DataPlaneServer", "maybe_start_data_plane_from_flags"]
+
+MV_DEFINE_int(
+    "data_port", 0,
+    "serve the HTTP data plane (POST /v1/lookup, /v1/topk, /v1/predict "
+    "as batched JSON; GET health routes ride along) on this port — the "
+    "replica entry point and serve-while-train layouts arm it "
+    "(0 = off; -1 = ephemeral, bound port lands in the health "
+    "payload's 'ports' map and the replica endpoint file)",
+)
+
+_MAX_BODY_BYTES = 8 << 20  # one POST can never balloon handler memory
+
+
+def _np2d(obj: Any, dtype) -> np.ndarray:
+    arr = np.asarray(obj, dtype=dtype)
+    if arr.ndim != 2:
+        raise ValueError(f"expected a 2-D array, got shape {arr.shape}")
+    return arr
+
+
+class DataPlaneServer:
+    """The query routes of one ``TableServer`` over HTTP, daemon-thread
+    stdlib server. ``port=0`` binds ephemeral (read ``.port`` back)."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
+                 *, default_deadline_s: float = 5.0):
+        self.table_server = server
+        self.default_deadline_s = float(default_deadline_s)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # one connection, many requests: load generators reuse sockets
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                route = self.path.split("?", 1)[0]
+                if not http_health.handle_health_get(
+                    self, route, outer.table_server
+                ):
+                    self.send_error(404, "data plane serves POST /v1/*")
+
+            def do_POST(self):  # noqa: N802
+                route = self.path.split("?", 1)[0]
+                code, payload, retry_after = outer._handle_post(
+                    route, self
+                )
+                body = json.dumps(payload, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                if retry_after is not None:
+                    # fractional seconds: the batcher's hints are ms-scale
+                    # and rounding up to 1s would overdamp clients
+                    self.send_header("Retry-After", f"{retry_after:.4f}")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # traffic must not spam stdout
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        http_health.register_bound_port("data", self.port)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="mv-dataplane"
+        )
+        self._thread.start()
+        Log.Info("data plane: http://%s:%d/v1/*", self.host, self.port)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        http_health.unregister_bound_port("data")
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------ dispatch
+
+    def _handle_post(
+        self, route: str, handler: BaseHTTPRequestHandler
+    ) -> Tuple[int, Dict[str, Any], Optional[float]]:
+        """Returns ``(status, json_payload, retry_after_s_or_None)``.
+        Never raises — every failure mode maps to a status code here so
+        a handler thread cannot die mid-response."""
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            if length <= 0 or length > _MAX_BODY_BYTES:
+                return 400, {"error": f"bad Content-Length {length}"}, None
+            body = json.loads(handler.rfile.read(length))
+            if not isinstance(body, dict):
+                return 400, {"error": "request body must be a JSON object"}, None
+        except (ValueError, OSError) as e:
+            return 400, {"error": f"malformed request: {e}"}, None
+
+        tenant = str(body.get("tenant", "default"))
+        try:
+            deadline_s = float(
+                body.get("deadline_ms", self.default_deadline_s * 1e3)
+            ) * 1e-3
+        except (TypeError, ValueError):
+            return 400, {"error": "deadline_ms must be a number"}, None
+
+        srv = self.table_server
+        try:
+            if route == "/v1/lookup":
+                fut = srv.lookup_async(
+                    body["table"], body["ids"], tenant=tenant
+                )
+                rows = fut.result(timeout=deadline_s)
+                out = {"rows": np.asarray(rows).tolist()}
+            elif route == "/v1/topk":
+                fut = srv.topk_async(
+                    body["table"], _np2d(body["queries"], np.float32),
+                    k=int(body.get("k", 10)), tenant=tenant,
+                )
+                ids, scores = fut.result(timeout=deadline_s)
+                out = {
+                    "ids": np.asarray(ids).tolist(),
+                    "scores": np.asarray(scores).tolist(),
+                }
+            elif route == "/v1/predict":
+                fut = srv.predict_async(
+                    body["table"], _np2d(body["features"], np.float32),
+                    tenant=tenant,
+                )
+                scores = fut.result(timeout=deadline_s)
+                out = {"scores": np.asarray(scores).tolist()}
+            else:
+                return 404, {
+                    "error": "routes: /v1/lookup /v1/topk /v1/predict"
+                }, None
+        except RouteUnavailable as e:
+            # breaker open: server-side fault — clients should fail over
+            return 503, {
+                "error": str(e), "reason": "route_unavailable"
+            }, e.retry_after_s
+        except Overloaded as e:
+            # queue or per-tenant admission shed: client pressure
+            return 429, {
+                "error": str(e), "reason": "overloaded", "tenant": tenant,
+            }, e.retry_after_s
+        except (TimeoutError, _FutureTimeout):
+            return 504, {
+                "error": f"deadline of {deadline_s * 1e3:.1f} ms expired",
+                "reason": "deadline",
+            }, None
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": f"bad request: {e!r}"}, None
+        except FatalError as e:
+            # CHECK failures: validation (bad ids/shapes — client bug,
+            # 400) or "no weights published yet" (replica still warming:
+            # 503 so a fleet client retries elsewhere instead of failing)
+            msg = str(e)
+            if "no weights published" in msg or "no table" in msg:
+                return 503, {"error": msg, "reason": "not_ready"}, None
+            return 400, {"error": msg}, None
+        except RuntimeError as e:
+            if "batcher closed" in str(e):
+                # drain in progress: tell clients to move to a peer
+                return 503, {"error": str(e), "reason": "draining"}, None
+            # a failed flush (dispatch error, chaos): 500 — repeated ones
+            # open the breaker, which answers 503 from then on
+            Log.Error("data plane %s flush failed: %r", route, e)
+            return 500, {"error": str(e)}, None
+        except Exception as e:  # noqa: BLE001 — last-resort: a handler
+            # thread must answer, not die with the socket open
+            Log.Error("data plane %s failed: %r", route, e)
+            return 500, {"error": repr(e)}, None
+        out["version"] = int(srv.health()["version"])  # informational
+        return 200, out, None
+
+
+def maybe_start_data_plane_from_flags(server) -> Optional[DataPlaneServer]:
+    """Start the data plane when ``-data_port`` is armed (0 = off,
+    -1 = ephemeral). A taken port logs and returns ``None`` — matching
+    ``http_health.maybe_start_from_flags``."""
+    port = http_health.flag_port(int(GetFlag("data_port")))
+    if port is None:
+        return None
+    try:
+        return DataPlaneServer(server, port=port)
+    except OSError as e:
+        Log.Error(
+            "data plane on port %d not started (%s) — another endpoint "
+            "in this process likely owns it", port, e,
+        )
+        return None

@@ -46,15 +46,46 @@ from multiverso_tpu.utils.log import Log
 
 __all__ = [
     "HealthServer",
+    "bound_ports",
+    "flag_port",
+    "handle_health_get",
     "health_payload",
     "maybe_start_from_flags",
+    "register_bound_port",
     "set_ready",
     "set_serving_ready",
     "readiness",
+    "unregister_bound_port",
     "READY_FILE_ENV",
 ]
 
 READY_FILE_ENV = "MV_READY_FILE"
+
+# ---------------------------------------------------------------- ports
+# Ephemeral-port discovery: when co-hosted replicas bind port 0 (flag
+# value -1), the kernel picks the port — this registry is how the bound
+# ports become visible. Every HTTP surface registers its (name, port) on
+# bind and the health payload carries the map, so one probe of any known
+# port reveals the rest (and the fleet launcher's endpoint files quote
+# them without parsing logs).
+
+_ports_lock = threading.Lock()
+_bound_ports: Dict[str, int] = {}
+
+
+def register_bound_port(name: str, port: int) -> None:
+    with _ports_lock:
+        _bound_ports[name] = int(port)
+
+
+def unregister_bound_port(name: str) -> None:
+    with _ports_lock:
+        _bound_ports.pop(name, None)
+
+
+def bound_ports() -> Dict[str, int]:
+    with _ports_lock:
+        return dict(_bound_ports)
 
 _ready_lock = threading.Lock()
 _ready_state: Dict[str, Any] = {
@@ -124,15 +155,18 @@ MV_DEFINE_int(
     "failure_domain sections as JSON), /livez, /readyz and the "
     "Prometheus GET /metrics exposition on this port, started/stopped "
     "with TableServer.start()/stop() or the training entry point "
-    "(0 = off; flags cannot express an ephemeral port — the demo's "
-    "--health-port 0 can)",
+    "(0 = off; -1 = ephemeral — the kernel picks a free port, read it "
+    "back from the health payload's 'ports' map or the replica "
+    "endpoint file; co-hosted replicas use -1 so N processes on one "
+    "host never race a fixed port)",
 )
 MV_DEFINE_int(
     "metrics_port", 0,
     "port for GET /metrics when -health_port is 0 (the metrics route "
     "always RIDES the health endpoint — this flag just names the port "
     "for metrics-first deployments; when -health_port is also set it "
-    "wins and -metrics_port is ignored with a log line)",
+    "wins and -metrics_port is ignored with a log line; -1 = ephemeral "
+    "like -health_port)",
 )
 
 
@@ -154,17 +188,79 @@ def health_payload(server=None) -> Dict[str, Any]:
         "alive": True,  # a probed-and-answering process IS alive
         "ready": ready["ready"],
         "phase": ready["phase"],
+        "ports": bound_ports(),  # ephemeral-port discovery (see above)
         "serving": serving,
         "resilience": rstats.to_dict(),
         "failure_domain": fd,
     }
 
 
+def handle_health_get(handler: BaseHTTPRequestHandler, route: str,
+                      table_server=None) -> bool:
+    """Serve one health-surface GET (``/livez`` ``/readyz`` ``/metrics``
+    ``/healthz``) on an arbitrary ``BaseHTTPRequestHandler``. Returns
+    whether the route was recognised (response written) — the data-plane
+    server shares the exact probe semantics by delegating here, so a
+    one-port-per-replica deployment needs no separate health port."""
+    if route == "/livez":
+        # liveness: answering at all is the proof
+        body = json.dumps({"alive": True}).encode()
+        code = 200
+    elif route == "/readyz":
+        # readiness: 503 while restoring/republishing, so an external
+        # prober (or the supervisor) can tell a restarting rank from a
+        # wedged one
+        ready = readiness()
+        body = json.dumps(ready, default=str).encode()
+        code = 200 if ready["ready"] else 503
+    elif route == "/metrics":
+        # Prometheus text exposition: the Dashboard's structured
+        # snapshot twins + interval rates (obs.metrics) — scrapeable
+        # from any prom agent
+        try:
+            from multiverso_tpu.obs import metrics as obs_metrics
+
+            body = obs_metrics.render_prometheus().encode()
+            handler.send_response(200)
+            handler.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        except Exception as e:  # noqa: BLE001 — a broken section
+            # degrades the scrape, never the prober
+            body = json.dumps({"status": "error", "error": str(e)}).encode()
+            handler.send_response(500)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        return True
+    elif route == "/healthz":
+        try:
+            # default=str: numpy scalars riding in the health dicts must
+            # never 500 the prober
+            body = json.dumps(
+                health_payload(table_server), default=str
+            ).encode()
+            code = 200
+        except Exception as e:  # noqa: BLE001 — a broken section must
+            # degrade the probe, not kill the prober thread
+            body = json.dumps({"status": "error", "error": str(e)}).encode()
+            code = 500
+    else:
+        return False
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+    return True
+
+
 class HealthServer:
     """``GET /healthz`` on a daemon thread. ``port=0`` binds an ephemeral
-    port (read it back from ``.port``); anything but ``/healthz`` is 404.
-    Responses serialize with ``default=str`` so numpy scalars riding in
-    the health dicts can never 500 the prober."""
+    port (read it back from ``.port``); anything but the health routes
+    is 404."""
 
     def __init__(self, server=None, host: str = "127.0.0.1", port: int = 0):
         self.table_server = server
@@ -173,69 +269,12 @@ class HealthServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 route = self.path.split("?", 1)[0]
-                if route == "/livez":
-                    # liveness: answering at all is the proof
-                    body = json.dumps({"alive": True}).encode()
-                    self.send_response(200)
-                elif route == "/readyz":
-                    # readiness: 503 while restoring/republishing, so an
-                    # external prober (or the supervisor) can tell a
-                    # restarting rank from a wedged one
-                    ready = readiness()
-                    body = json.dumps(ready, default=str).encode()
-                    self.send_response(200 if ready["ready"] else 503)
-                elif route == "/metrics":
-                    # Prometheus text exposition: the Dashboard's
-                    # structured snapshot twins + interval rates
-                    # (obs.metrics) — scrapeable from any prom agent
-                    try:
-                        from multiverso_tpu.obs import metrics as obs_metrics
-
-                        body = obs_metrics.render_prometheus().encode()
-                        self.send_response(200)
-                        self.send_header(
-                            "Content-Type", obs_metrics.CONTENT_TYPE
-                        )
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                    except Exception as e:  # noqa: BLE001 — a broken
-                        # section degrades the scrape, never the prober
-                        body = json.dumps(
-                            {"status": "error", "error": str(e)}
-                        ).encode()
-                        self.send_response(500)
-                        self.send_header(
-                            "Content-Type", "application/json"
-                        )
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                    return
-                elif route != "/healthz":
+                if not handle_health_get(self, route, outer.table_server):
                     self.send_error(
                         404,
                         "only /healthz, /livez, /readyz, /metrics are "
                         "served",
                     )
-                    return
-                else:
-                    try:
-                        body = json.dumps(
-                            health_payload(outer.table_server), default=str
-                        ).encode()
-                        self.send_response(200)
-                    except Exception as e:  # noqa: BLE001 — a broken
-                        # section must degrade the probe, not kill the
-                        # prober thread
-                        body = json.dumps(
-                            {"status": "error", "error": str(e)}
-                        ).encode()
-                        self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
 
             def log_message(self, *args):  # probes must not spam stdout
                 pass
@@ -244,6 +283,7 @@ class HealthServer:
         self._httpd.daemon_threads = True
         self.host = host
         self.port = int(self._httpd.server_address[1])
+        register_bound_port("health", self.port)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="mv-healthz"
         )
@@ -255,9 +295,20 @@ class HealthServer:
         return f"http://{self.host}:{self.port}/healthz"
 
     def stop(self) -> None:
+        unregister_bound_port("health")
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
+
+
+def flag_port(value: int) -> Optional[int]:
+    """Decode the shared port-flag convention: ``0`` = off (None),
+    ``-1`` (any negative) = ephemeral (bind 0, kernel picks), positive =
+    that port."""
+    value = int(value)
+    if value == 0:
+        return None
+    return 0 if value < 0 else value
 
 
 def maybe_start_from_flags(server=None) -> Optional[HealthServer]:
@@ -266,16 +317,17 @@ def maybe_start_from_flags(server=None) -> Optional[HealthServer]:
     /metrics route always rides the same server. A taken port logs and
     returns ``None`` — two subsystems arming the same flag (a trainer
     plus a TableServer in one process) must not crash the second."""
-    port = int(GetFlag("health_port"))
-    metrics_port = int(GetFlag("metrics_port"))
-    if port > 0 and metrics_port > 0 and metrics_port != port:
+    raw = int(GetFlag("health_port"))
+    raw_metrics = int(GetFlag("metrics_port"))
+    if raw != 0 and raw_metrics != 0 and raw_metrics != raw:
         Log.Info(
             "-metrics_port=%d ignored: /metrics rides the -health_port=%d "
-            "endpoint", metrics_port, port,
+            "endpoint", raw_metrics, raw,
         )
-    if port <= 0:
-        port = metrics_port
-    if port <= 0:
+    port = flag_port(raw)
+    if port is None:
+        port = flag_port(raw_metrics)
+    if port is None:
         return None
     try:
         return HealthServer(server, port=port)

@@ -1,0 +1,204 @@
+"""Serving replica: one deployable read-path process.
+
+``python -m multiverso_tpu.serving.replica -serve_checkpoint_dir=...``
+composes the serving pieces into the unit the fleet launcher
+(``deploy/serving_fleet.py``) spawns N of:
+
+* a ``TableServer`` (no training runtime — the mesh is whatever this
+  host has, typically 1 CPU/TPU device; per-tenant admission from
+  ``-admission_tenant_qps``);
+* the HTTP **data plane** (``-data_port``, default ephemeral here) and
+  **health** endpoint (``-health_port``);
+* a ``SnapshotWatcher`` on ``-serve_checkpoint_dir`` — weights arrive
+  only through published quorum checkpoints, so a replica needs zero
+  coordination with the trainer or its peers. ``/readyz`` answers 503
+  until the first successful publish.
+
+**Port discovery**: co-hosted replicas bind ephemeral ports; the bound
+ports are written to the JSON file named by ``$MV_ENDPOINT_FILE``
+(atomic tmp+rename, like the supervisor's ready markers) and surfaced
+in the health payload's ``ports`` map.
+
+**Graceful drain** (SIGTERM/SIGINT): readiness flips off first (the
+balancer stops routing), the watcher and HTTP servers stop, then the
+batcher drains in-flight tickets before exit — a rolling restart loses
+zero accepted requests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_double,
+    MV_DEFINE_string,
+    GetFlag,
+    ParseCMDFlags,
+)
+from multiverso_tpu.utils.log import Log
+
+__all__ = ["ENDPOINT_FILE_ENV", "Replica", "main"]
+
+ENDPOINT_FILE_ENV = "MV_ENDPOINT_FILE"
+
+MV_DEFINE_string(
+    "serve_checkpoint_dir", "",
+    "serving replicas: checkpoint root to watch — the newest valid "
+    "ckpt-<step> under it is loaded and published, and every later "
+    "version rolls out automatically (required by "
+    "multiverso_tpu.serving.replica)",
+)
+MV_DEFINE_string(
+    "serve_tables", "",
+    "serving replicas: comma-separated serving names for the "
+    "checkpoint's tables in table-id order (empty = serve as "
+    "table_<id>)",
+)
+MV_DEFINE_double(
+    "serve_max_seconds", 0.0,
+    "serving replicas: exit cleanly (graceful drain) after this many "
+    "seconds — drills and benches bound a replica's lifetime with it "
+    "(0 = serve until SIGTERM)",
+)
+
+
+class Replica:
+    """The composed serving unit; ``run()`` blocks until drain."""
+
+    def __init__(self):
+        root = str(GetFlag("serve_checkpoint_dir"))
+        if not root:
+            Log.Fatal("-serve_checkpoint_dir is required for a replica")
+        names_flag = str(GetFlag("serve_tables")).strip()
+        self.names: Optional[List[str]] = (
+            [n for n in names_flag.split(",") if n] if names_flag else None
+        )
+        self.root = root
+        self._stop = threading.Event()
+        self.server = None
+        self.watcher = None
+        self.data_http = None
+        self.admission = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Replica":
+        from multiverso_tpu.serving import http_health
+        from multiverso_tpu.serving.admission import controller_from_flags
+        from multiverso_tpu.serving.http_data import (
+            maybe_start_data_plane_from_flags,
+        )
+        from multiverso_tpu.serving.rollout import SnapshotWatcher
+        from multiverso_tpu.serving.server import TableServer
+
+        http_health.set_ready(False, phase="starting")
+        self.admission = controller_from_flags()
+        if self.admission is not None:
+            self.admission.register_dashboard()
+        # no training runtime in a replica: register_runtime=False keeps
+        # the server off the (non-started) runtime's attach list
+        self.server = TableServer(
+            register_runtime=False, name="replica",
+            admission=self.admission,
+        ).start()  # also arms -health_port
+        self.data_http = maybe_start_data_plane_from_flags(self.server)
+        if self.data_http is None:
+            Log.Fatal(
+                "-data_port is off or taken — a replica without a data "
+                "plane serves nothing (use -data_port=-1 for ephemeral)"
+            )
+        self.watcher = SnapshotWatcher(
+            self.server, self.root, names=self.names
+        ).start()
+        self._write_endpoint_file()
+        return self
+
+    def _write_endpoint_file(self) -> None:
+        """Atomic (tmp+rename) JSON with the bound ports — the fleet
+        launcher's discovery channel for ephemeral ports."""
+        from multiverso_tpu.serving import http_health
+
+        marker = os.environ.get(ENDPOINT_FILE_ENV)
+        if not marker:
+            return
+        doc: Dict[str, Any] = {
+            "pid": os.getpid(),
+            "host": self.data_http.host,
+            "ports": http_health.bound_ports(),
+            "url": self.data_http.url,
+        }
+        try:
+            d = os.path.dirname(marker)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{marker}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(doc))
+            os.replace(tmp, marker)
+        except OSError as e:
+            Log.Error("endpoint file %s not written: %s", marker, e)
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        """Serve until SIGTERM/SIGINT or ``-serve_max_seconds``."""
+        max_s = float(GetFlag("serve_max_seconds"))
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self._stop.set())
+        Log.Info(
+            "replica serving %s at %s (pid %d)",
+            self.root, self.data_http.url, os.getpid(),
+        )
+        self._stop.wait(timeout=max_s if max_s > 0 else None)
+        self.drain()
+
+    def drain(self, grace_s: float = 0.5) -> None:
+        """Graceful shutdown: unready first, then stop intake, then let
+        the batcher flush what it already accepted."""
+        from multiverso_tpu.serving import http_health
+
+        http_health.set_ready(False, phase="draining")
+        if self.watcher is not None:
+            self.watcher.stop()
+            self.watcher = None
+        # the readiness flip needs a beat to reach a balancer's prober
+        # before the listener closes; in-flight handler threads keep
+        # their sockets through server_close (daemon threads finish the
+        # response they hold)
+        import time as _time
+
+        _time.sleep(grace_s)
+        if self.data_http is not None:
+            self.data_http.stop()
+            self.data_http = None
+        if self.server is not None:
+            self.server.stop()  # closes batcher (drain) + health endpoint
+            self.server = None
+        if self.admission is not None:
+            self.admission.unregister_dashboard()
+            self.admission = None
+        Log.Info("replica drained (pid %d)", os.getpid())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    leftover = ParseCMDFlags(list(sys.argv if argv is None else argv))
+    if len(leftover) > 1:
+        Log.Error("replica: unrecognised argv %s", leftover[1:])
+        return 2
+    # deterministic hostname-free default: replicas serve loopback unless
+    # fronted by a real ingress (the fleet launcher is host-local)
+    socket.setdefaulttimeout(None)
+    replica = Replica().start()
+    replica.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

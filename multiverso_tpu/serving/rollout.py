@@ -1,0 +1,222 @@
+"""Snapshot distribution: the per-replica version-watch loop.
+
+The trainer publishes quorum checkpoints (``io/checkpoint.save_tables``
+→ manifest-sealed ``ckpt-<step>`` dirs); replicas never talk to the
+trainer. Each replica runs a ``SnapshotWatcher`` that polls
+``resilience.checkpoint.latest_valid(root)`` and, when a new version
+appears, loads it host-side (``load_arrays`` — no live tables needed)
+and publishes through ``TableServer.publish`` — which means every
+rollout passes the existing validation gate for free:
+
+* a **torn/corrupt** newest checkpoint never surfaces at all —
+  ``latest_valid`` skips it and keeps returning N-1;
+* a **poisoned** checkpoint (NaN/Inf that slipped past training) is
+  rejected by ``publish`` (``PublishRejected``) and the previous
+  snapshot keeps serving — the watcher marks the path bad and will not
+  retry it (a newer version clears the block).
+
+``/readyz`` flips only after the first successful publish
+(``publish`` → ``set_serving_ready``), so a fleet load balancer never
+routes to a replica that has not loaded weights yet.
+
+Observability: rollout count/latency land in a Dashboard section
+(snapshot twin → Prometheus) and each publish/reject records a flight
+event. ``check_now()`` runs one poll inline for deterministic tests;
+``start()`` runs the poll loop on a joined daemon thread.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from multiverso_tpu.utils.configure import MV_DEFINE_double, GetFlag
+from multiverso_tpu.utils.log import Log
+
+__all__ = ["SnapshotWatcher"]
+
+MV_DEFINE_double(
+    "serve_poll_s", 2.0,
+    "serving replicas: seconds between latest_valid() polls of "
+    "-serve_checkpoint_dir — the snapshot-rollout cadence (lower = "
+    "fresher weights, more directory scans)",
+)
+
+
+class SnapshotWatcher:
+    """Polls a checkpoint root and publishes new valid versions into a
+    ``TableServer``. One watcher per server."""
+
+    def __init__(
+        self,
+        server,
+        root: str,
+        *,
+        names: Optional[Sequence[str]] = None,
+        poll_s: Optional[float] = None,
+        allow_reshape: bool = True,
+    ):
+        self.server = server
+        self.root = str(root)
+        self.names = list(names) if names is not None else None
+        self.poll_s = float(
+            GetFlag("serve_poll_s") if poll_s is None else poll_s
+        )
+        # reshape allowed by default: a rollback to a pre-resize version
+        # (or an elastic re-shard changing padded physical rows) is a
+        # normal rollout, not an error
+        self.allow_reshape = bool(allow_reshape)
+        self._loaded_path: Optional[str] = None
+        self._rejected: set = set()
+        self._stats_lock = threading.Lock()
+        self._rollouts = 0
+        self._rejects = 0
+        self._last_rollout_s: Optional[float] = None
+        self._last_staleness_s: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._dash_key: Optional[str] = None
+
+    # ------------------------------------------------------------ polling
+
+    def check_now(self) -> Optional[int]:
+        """One poll: publish the newest valid checkpoint if it is new.
+        Returns the published serving version, or None when nothing
+        changed (or the candidate was rejected)."""
+        from multiverso_tpu.resilience.checkpoint import latest_valid
+        from multiverso_tpu.serving.server import PublishRejected
+
+        try:
+            path = latest_valid(self.root)
+        except OSError as e:
+            Log.Error("snapshot watch: cannot scan %s: %s", self.root, e)
+            return None
+        if path is None or path == self._loaded_path:
+            return None
+        if path in self._rejected:
+            return None
+        t0 = time.monotonic()
+        try:
+            version = self.server.restore(
+                path, names=self.names, allow_reshape=self.allow_reshape
+            )
+        except PublishRejected as e:
+            # validation said no: previous snapshot keeps serving, and
+            # this path is poisoned forever — only a NEWER checkpoint
+            # clears the route (retrying the same bytes cannot succeed)
+            self._rejected.add(path)
+            with self._stats_lock:
+                self._rejects += 1
+            from multiverso_tpu.obs import recorder
+
+            recorder.record(
+                "rollout_rejected", path=os.path.basename(path),
+                error=str(e)[:200],
+            )
+            Log.Error(
+                "snapshot watch: %s REJECTED, keeping v%s serving: %s",
+                path, self._loaded_path or "none", e,
+            )
+            return None
+        except Exception as e:  # noqa: BLE001 — a half-written sidecar or
+            # IO race must not kill the watch loop; next poll retries
+            Log.Error("snapshot watch: load of %s failed: %r", path, e)
+            return None
+        rollout_s = time.monotonic() - t0
+        staleness = self._checkpoint_age_s(path)
+        self._loaded_path = path
+        with self._stats_lock:
+            self._rollouts += 1
+            self._last_rollout_s = rollout_s
+            self._last_staleness_s = staleness
+        from multiverso_tpu.obs import recorder
+
+        recorder.record(
+            "rollout_published", path=os.path.basename(path),
+            version=version, rollout_s=round(rollout_s, 4),
+        )
+        Log.Info(
+            "snapshot watch: published %s as serving v%d (%.0f ms load)",
+            os.path.basename(path), version, rollout_s * 1e3,
+        )
+        return version
+
+    @staticmethod
+    def _checkpoint_age_s(path: str) -> Optional[float]:
+        """Commit-to-serve staleness: the manifest's mtime is the commit
+        instant (the rename target), wall-clock now minus that."""
+        try:
+            return max(
+                0.0,
+                time.time() - os.path.getmtime(
+                    os.path.join(path, "MANIFEST.json")
+                ),
+            )
+        except OSError:
+            return None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "SnapshotWatcher":
+        from multiverso_tpu.utils.log import CHECK
+
+        CHECK(self._thread is None, "snapshot watcher already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="mv-snapshot-watch"
+        )
+        self._thread.start()
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        self._dash_key = f"serving.rollout.{id(self)}"
+        Dashboard.add_section(self._dash_key, self._lines,
+                              snapshot=self.stats)
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=timeout_s)
+            self._thread = None
+        if self._dash_key is not None:
+            from multiverso_tpu.utils.dashboard import Dashboard
+
+            Dashboard.remove_section(self._dash_key)
+            self._dash_key = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.check_now()
+            except Exception as e:  # noqa: BLE001 — the watch NEVER dies:
+                # a dead watcher pins the replica on stale weights forever
+                Log.Error("snapshot watch survived internal error: %r", e)
+            self._stop.wait(self.poll_s)
+
+    # ------------------------------------------------------------ obs
+
+    def stats(self) -> Dict[str, Any]:
+        with self._stats_lock:
+            return {
+                "root": self.root,
+                "loaded": (
+                    os.path.basename(self._loaded_path)
+                    if self._loaded_path else None
+                ),
+                "rollouts": self._rollouts,
+                "rejects": self._rejects,
+                "last_rollout_s": self._last_rollout_s,
+                "last_staleness_s": self._last_staleness_s,
+            }
+
+    def _lines(self) -> List[str]:
+        s = self.stats()
+        last = s["last_rollout_s"]
+        return [
+            f"[Rollout] loaded={s['loaded'] or 'none'} "
+            f"rollouts={s['rollouts']} rejects={s['rejects']} "
+            f"last_load={'-' if last is None else f'{last * 1e3:.0f}ms'}"
+        ]

@@ -67,12 +67,24 @@ from multiverso_tpu.utils import next_pow2 as _next_pow2
 from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.utils.log import CHECK, Log
 
-__all__ = ["PublishRejected", "ServingSnapshot", "TableServer"]
+__all__ = [
+    "PublishRejected",
+    "RouteUnavailable",
+    "ServingSnapshot",
+    "TableServer",
+]
 
 
 class PublishRejected(RuntimeError):
     """A staged weights publish failed validation; the previous snapshot
     is untouched and keeps serving."""
+
+
+class RouteUnavailable(Overloaded):
+    """Shed because the route's circuit breaker is OPEN — a server-side
+    fault (route keeps failing), not client pressure. Subclasses
+    ``Overloaded`` so every existing catch site keeps working; the HTTP
+    data plane keys on the distinction (503 vs 429 + ``Retry-After``)."""
 
 
 class ServingSnapshot:
@@ -119,7 +131,8 @@ class TableServer:
         breaker_threshold: int = 5,
         breaker_cooldown_s: float = 5.0,
         breaker_clock=None,
-        topk_impl: str = "replicated",
+        topk_impl: str = "auto",
+        admission=None,
     ):
         CHECK(topk_impl in ("replicated", "sharded", "auto"),
               f"topk_impl must be replicated|sharded|auto, got {topk_impl!r}")
@@ -130,8 +143,14 @@ class TableServer:
         #   the merge sees k*num_shards candidates instead of V columns.
         #   Requires a multi-shard mesh and shard-divisible table rows
         #   (fails loudly otherwise).
-        # 'auto': sharded when those conditions hold, else replicated.
+        # 'auto': sharded when those conditions hold, else replicated —
+        #   the DEFAULT since the serving bench leg showed sharded winning
+        #   on shardable tables (BENCH serving_topk_* keys record both).
         self.topk_impl = topk_impl
+        # optional per-tenant admission gate (serving/admission.py): the
+        # *_async front door charges each request's row count against its
+        # tenant's token bucket BEFORE it can cost a ticket
+        self.admission = admission
         if mesh is None:
             from multiverso_tpu.runtime import runtime
 
@@ -626,9 +645,12 @@ class TableServer:
     # whole micro-batch it would have ridden in (the in-flush CHECKs stay
     # as a backstop, e.g. a hot-swap shrinking the table mid-flight).
 
-    def lookup_async(self, name: str, ids, block: bool = False):
+    def lookup_async(self, name: str, ids, block: bool = False,
+                     tenant: str = "default"):
         """Enqueue a lookup through the dynamic batcher; returns a Future
-        of the (n, D) rows. Raises ``Overloaded`` when shedding."""
+        of the (n, D) rows. Raises ``Overloaded`` when shedding (tenant
+        over admission budget, full queue, or — the ``RouteUnavailable``
+        subclass — an open breaker)."""
         self._require_started()
         ids = np.asarray(ids, np.int32).reshape(-1)
         table = self._table(self.snapshot, name)
@@ -638,10 +660,12 @@ class TableServer:
             f"lookup ids out of range for table {name!r} "
             f"({table.shape[0]} rows)",
         )
+        self._admit(tenant, ids.size)
         self._shed_if_open(f"lookup:{name}")
         return self._batcher.submit(f"lookup:{name}", ids, block=block)
 
-    def topk_async(self, name: str, queries, k: int = 10, block: bool = False):
+    def topk_async(self, name: str, queries, k: int = 10, block: bool = False,
+                   tenant: str = "default"):
         self._require_started()
         q = np.asarray(queries, np.float32)
         table = self._table(self.snapshot, name)
@@ -651,10 +675,12 @@ class TableServer:
             f"{table.shape[1]}",
         )
         CHECK(1 <= k <= table.shape[0], f"k={k} out of range")
+        self._admit(tenant, q.shape[0])
         self._shed_if_open(f"topk:{name}:{int(k)}")
         return self._batcher.submit(f"topk:{name}:{int(k)}", q, block=block)
 
-    def predict_async(self, name: str, X, block: bool = False):
+    def predict_async(self, name: str, X, block: bool = False,
+                      tenant: str = "default"):
         self._require_started()
         X = np.asarray(X, np.float32)
         W = self._table(self.snapshot, name)
@@ -662,11 +688,24 @@ class TableServer:
             X.ndim == 2 and X.shape[0] >= 1 and X.shape[1] == W.shape[1],
             f"features shape {X.shape} does not match weights {W.shape}",
         )
+        self._admit(tenant, X.shape[0])
         self._shed_if_open(f"predict:{name}")
         return self._batcher.submit(f"predict:{name}", X, block=block)
 
     def _require_started(self) -> None:
         CHECK(self._started, "TableServer.start() the batcher before *_async")
+
+    def _admit(self, tenant: str, rows: int) -> None:
+        """Per-tenant admission gate, FIRST in the shed order: a tenant
+        over budget must shed against its own bucket before it can touch
+        a shared ticket (cost = query rows — big batches pay for their
+        size). Raises ``Overloaded(retry_after)``; counted in the shared
+        shed metric so /healthz pressure totals include admission."""
+        if self.admission is not None:
+            ok, retry_after = self.admission.try_admit(tenant, float(rows))
+            if not ok:
+                self.metrics.record_shed()
+                raise Overloaded(retry_after)
 
     # ------------------------------------------------------------ degradation
 
@@ -691,7 +730,7 @@ class TableServer:
         allowed, retry_after = self._breaker(route).peek()
         if not allowed:
             self.metrics.record_shed()
-            raise Overloaded(retry_after)
+            raise RouteUnavailable(retry_after)
 
     def health(self) -> Dict[str, Any]:
         """Operator-facing status struct: weights freshness, per-route
@@ -739,7 +778,7 @@ class TableServer:
         allowed, retry_after = br.allow()
         if not allowed:
             self.metrics.record_shed(len(payloads))
-            raise Overloaded(retry_after)
+            raise RouteUnavailable(retry_after)
         try:
             if chaos.should_fail_route(route):
                 raise RuntimeError(f"chaos: injected failure on route {route!r}")

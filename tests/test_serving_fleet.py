@@ -1,0 +1,450 @@
+"""Serving fleet: admission control, snapshot rollout/rollback, replica
+supervision, ephemeral-port plumbing.
+
+The unit/property layers of the fleet story run here (the process-level
+kill-one-of-two drill is ci.sh's fleet stage): token-bucket math under
+an injected clock, tenant isolation under a saturating co-tenant, the
+version-watch loop rolling forward/refusing poisoned checkpoints while
+N-1 keeps serving, /readyz gating on the first publish, and the
+port-flag conventions co-hosted replicas rely on.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.serving import Overloaded, TableServer
+from multiverso_tpu.serving.admission import (
+    AdmissionController,
+    TokenBucket,
+    controller_from_flags,
+)
+from multiverso_tpu.serving.rollout import SnapshotWatcher
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ============================================================= admission
+
+
+def test_token_bucket_refills_at_rate():
+    clk = FakeClock()
+    b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    ok, _ = b.try_take(5.0)  # burst admits, balance -> 0
+    assert ok
+    ok, retry = b.try_take(1.0)
+    assert not ok and retry == pytest.approx(1e-4)
+    clk.advance(0.3)  # +3 tokens
+    ok, _ = b.try_take(1.0)
+    assert ok
+    # never refills past burst
+    clk.advance(100.0)
+    assert b.tokens == pytest.approx(5.0)
+
+
+def test_token_bucket_debt_admits_oversize_then_blocks():
+    """Debt accounting: one request bigger than the burst still admits,
+    then the tenant sheds until the debt refills — with an exact
+    retry-after hint."""
+    clk = FakeClock()
+    b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    ok, _ = b.try_take(25.0)  # oversize: admitted, balance -> -20
+    assert ok
+    ok, retry = b.try_take(1.0)
+    assert not ok and retry == pytest.approx(2.0)  # 20 tokens / 10 per s
+    clk.advance(2.01)
+    ok, _ = b.try_take(1.0)
+    assert ok
+
+
+def test_admission_isolates_tenants():
+    clk = FakeClock()
+    adm = AdmissionController(10.0, 5.0, clock=clk)
+    # tenant A burns its budget...
+    assert adm.try_admit("A", 5.0)[0]
+    assert not adm.try_admit("A", 1.0)[0]
+    # ...tenant B's bucket is untouched
+    assert adm.try_admit("B", 5.0)[0]
+    with pytest.raises(Overloaded):
+        adm.admit("A", 1.0)
+    s = adm.stats()
+    assert s["tenants"]["A"]["shed"] == 2
+    assert s["tenants"]["B"]["shed"] == 0
+
+
+def test_admission_per_tenant_budget_override():
+    clk = FakeClock()
+    adm = AdmissionController(1.0, 1.0, clock=clk)
+    adm.set_tenant_budget("bulk", 1000.0, 500.0)
+    # bulk's budget absorbs repeated 400-row requests…
+    assert adm.try_admit("bulk", 400.0)[0]
+    clk.advance(0.5)  # +500 tokens for bulk, +0.5 for everyone else
+    assert adm.try_admit("bulk", 400.0)[0]
+    # …while a default tenant admits one (debt) then sheds for ~400 s
+    assert adm.try_admit("default-ish", 400.0)[0]
+    ok, retry = adm.try_admit("default-ish", 1.0)
+    assert not ok and retry > 300.0
+
+
+def test_admission_controller_from_flags(mv_env):
+    from multiverso_tpu.utils.configure import SetCMDFlag
+
+    assert controller_from_flags() is None  # default: off
+    SetCMDFlag("admission_tenant_qps", 100.0)
+    adm = controller_from_flags()
+    assert adm is not None
+    assert adm.default_qps == 100.0 and adm.default_burst == 200.0
+    SetCMDFlag("admission_tenant_burst", 50.0)
+    assert controller_from_flags().default_burst == 50.0
+    SetCMDFlag("admission_tenant_qps", 0.0)
+    SetCMDFlag("admission_tenant_burst", 0.0)
+
+
+def test_tenant_isolation_under_saturation(mv_env):
+    """Property: tenant A saturating its budget must not move tenant B's
+    latency beyond a bound, and B is never shed. A sheds against its own
+    bucket (the whole point of per-tenant admission)."""
+    emb = np.random.RandomState(0).randn(64, 16).astype(np.float32)
+    adm = AdmissionController(4000.0, 400.0, name="iso")
+    srv = TableServer(
+        {"emb": emb}, register_runtime=False, admission=adm,
+        max_batch=32, max_delay_s=0.001,
+    ).start()
+    stats = {"a_shed": 0, "a_ok": 0, "b_shed": 0}
+    b_lat = []
+    stop = threading.Event()
+    try:
+
+        def tenant_a():
+            ids = np.arange(64)
+            while not stop.is_set():
+                try:
+                    srv.lookup_async("emb", ids, tenant="A").result(
+                        timeout=30
+                    )
+                    stats["a_ok"] += 1
+                except Overloaded:
+                    stats["a_shed"] += 1  # no sleep: true saturation
+
+        th = threading.Thread(target=tenant_a, daemon=True)
+        th.start()
+        for i in range(50):
+            t0 = time.monotonic()
+            try:
+                rows = srv.lookup_async(
+                    "emb", [i % 64, (i + 7) % 64], tenant="B"
+                ).result(timeout=30)
+                np.testing.assert_array_equal(
+                    rows, emb[[i % 64, (i + 7) % 64]]
+                )
+            except Overloaded:
+                stats["b_shed"] += 1
+            b_lat.append(time.monotonic() - t0)
+            time.sleep(0.002)
+        stop.set()
+        th.join(timeout=30)
+    finally:
+        stop.set()
+        srv.stop()
+    assert stats["a_shed"] > 0, "A never saturated — test vacuous"
+    assert stats["b_shed"] == 0, f"B shed {stats['b_shed']} times"
+    p99 = float(np.percentile(b_lat, 99))
+    assert p99 < 0.5, f"B p99 {p99 * 1e3:.1f} ms under A's saturation"
+
+
+# =============================================================== rollout
+
+
+def _save_version(mv_env, root, step):
+    from multiverso_tpu.io.checkpoint import save_tables
+
+    return save_tables(os.path.join(root, f"ckpt-{step}"), step=step)
+
+
+@pytest.fixture
+def ckpt_table(mv_env):
+    from multiverso_tpu.tables import MatrixTableOption
+
+    t = mv_env.MV_CreateTable(MatrixTableOption(num_row=16, num_col=4))
+    t.add(np.ones((16, 4), np.float32))
+    t.wait()
+    return t
+
+
+def test_watcher_rolls_forward_and_readyz_gates(mv_env, ckpt_table,
+                                                tmp_path):
+    from multiverso_tpu.serving import http_health
+
+    root = str(tmp_path / "ck")
+    _save_version(mv_env, root, 1)
+    http_health.set_ready(False, phase="starting")
+    srv = TableServer(register_runtime=False)
+    watcher = SnapshotWatcher(srv, root, names=["emb"], poll_s=60.0)
+    try:
+        assert http_health.readiness()["ready"] is False
+        assert watcher.check_now() == 1  # first publish
+        assert http_health.readiness()["ready"] is True  # /readyz flips
+        np.testing.assert_array_equal(
+            srv.lookup("emb", [0]), np.ones((1, 4), np.float32)
+        )
+        assert watcher.check_now() is None  # no new version: no-op
+        # trainer publishes v2
+        ckpt_table.add(np.ones((16, 4), np.float32))
+        ckpt_table.wait()
+        _save_version(mv_env, root, 2)
+        assert watcher.check_now() == 2
+        np.testing.assert_array_equal(
+            srv.lookup("emb", [3]), np.full((1, 4), 2.0, np.float32)
+        )
+        assert watcher.stats()["rollouts"] == 2
+    finally:
+        srv.stop()
+        http_health.set_ready(False, phase="starting")
+
+
+def test_watcher_keeps_serving_n_minus_1_on_poisoned_newest(
+        mv_env, ckpt_table, tmp_path):
+    """A NaN-poisoned newest checkpoint passes manifest checks (the
+    bytes are intact) but fails publish validation: the watcher must
+    reject it ONCE, keep serving N-1, and not retry the same path."""
+    root = str(tmp_path / "ck")
+    _save_version(mv_env, root, 1)
+    srv = TableServer(register_runtime=False)
+    watcher = SnapshotWatcher(srv, root, names=["emb"], poll_s=60.0)
+    try:
+        assert watcher.check_now() == 1
+        ckpt_table.add(np.full((16, 4), np.nan, np.float32))
+        ckpt_table.wait()
+        _save_version(mv_env, root, 2)
+        assert watcher.check_now() is None  # rejected
+        assert srv.version == 1  # N-1 keeps serving
+        np.testing.assert_array_equal(
+            srv.lookup("emb", [5]), np.ones((1, 4), np.float32)
+        )
+        assert watcher.check_now() is None  # poisoned path not retried
+        assert watcher.stats()["rejects"] == 1
+        assert srv.health()["publish_rejects"] == 1
+    finally:
+        srv.stop()
+
+
+def test_watcher_skips_corrupted_newest_entirely(mv_env, ckpt_table,
+                                                 tmp_path):
+    """A byte-flipped newest checkpoint fails the manifest checksum, so
+    latest_valid never surfaces it — the watcher stays on N-1 without
+    even counting a reject."""
+    root = str(tmp_path / "ck")
+    v1 = _save_version(mv_env, root, 1)
+    srv = TableServer(register_runtime=False)
+    watcher = SnapshotWatcher(srv, root, names=["emb"], poll_s=60.0)
+    try:
+        assert watcher.check_now() == 1
+        # forge ckpt-2 from v1's bytes, then flip one payload byte in a
+        # file the manifest checksums
+        v2 = os.path.join(root, "ckpt-2")
+        shutil.copytree(v1, v2)
+        with open(os.path.join(v2, "MANIFEST.json")) as f:
+            listed = sorted(json.load(f)["files"])
+        target = os.path.join(v2, listed[0])
+        size = os.path.getsize(target)
+        with open(target, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        assert watcher.check_now() is None
+        assert srv.version == 1
+        assert watcher.stats()["rejects"] == 0  # never surfaced at all
+    finally:
+        srv.stop()
+
+
+def test_watcher_thread_lifecycle(mv_env, ckpt_table, tmp_path):
+    root = str(tmp_path / "ck")
+    srv = TableServer(register_runtime=False)
+    watcher = SnapshotWatcher(srv, root, names=["emb"], poll_s=0.05)
+    watcher.start()
+    try:
+        _save_version(mv_env, root, 1)  # appears AFTER the watch began
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and srv._snapshot is None:
+            time.sleep(0.02)
+        assert srv.version == 1
+    finally:
+        watcher.stop()
+        assert watcher._thread is None  # joined (mvlint R4 contract)
+        srv.stop()
+
+
+# ================================================================= ports
+
+
+def test_port_flag_conventions():
+    from multiverso_tpu.serving.http_health import flag_port
+
+    assert flag_port(0) is None       # off
+    assert flag_port(-1) == 0         # ephemeral
+    assert flag_port(8080) == 8080    # explicit
+
+
+def test_health_flag_ephemeral_binds_and_surfaces_port(mv_env):
+    from multiverso_tpu.serving import http_health
+    from multiverso_tpu.utils.configure import SetCMDFlag
+
+    SetCMDFlag("health_port", -1)
+    hs = http_health.maybe_start_from_flags(None)
+    try:
+        assert hs is not None and hs.port > 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{hs.port}/healthz", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["ports"]["health"] == hs.port
+    finally:
+        SetCMDFlag("health_port", 0)
+        if hs is not None:
+            hs.stop()
+    assert "health" not in http_health.bound_ports()  # unregistered
+
+
+def test_data_flag_ephemeral_binds(mv_env):
+    from multiverso_tpu.serving import http_health
+    from multiverso_tpu.serving.http_data import (
+        maybe_start_data_plane_from_flags,
+    )
+    from multiverso_tpu.utils.configure import SetCMDFlag
+
+    emb = np.eye(4, dtype=np.float32)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    assert maybe_start_data_plane_from_flags(srv) is None  # default off
+    SetCMDFlag("data_port", -1)
+    dp = maybe_start_data_plane_from_flags(srv)
+    try:
+        assert dp is not None and dp.port > 0
+        assert http_health.bound_ports()["data"] == dp.port
+    finally:
+        SetCMDFlag("data_port", 0)
+        if dp is not None:
+            dp.stop()
+        srv.stop()
+
+
+def test_two_servers_same_host_no_port_race(mv_env):
+    """Co-hosting regression: two TableServers arming ephemeral health +
+    data ports in one process must both bind (distinct ports)."""
+    from multiverso_tpu.serving import DataPlaneServer, HealthServer
+
+    emb = np.eye(4, dtype=np.float32)
+    a = TableServer({"emb": emb}, register_runtime=False, name="a").start()
+    b = TableServer({"emb": emb}, register_runtime=False, name="b").start()
+    sa, sb = HealthServer(a, port=0), HealthServer(b, port=0)
+    da, db = DataPlaneServer(a, port=0), DataPlaneServer(b, port=0)
+    try:
+        ports = {sa.port, sb.port, da.port, db.port}
+        assert len(ports) == 4  # all distinct, nobody raced
+    finally:
+        for x in (da, db, sa, sb):
+            x.stop()
+        a.stop()
+        b.stop()
+
+
+# ================================================================= fleet
+
+
+@pytest.mark.slow
+def test_fleet_end_to_end_kill_and_heal(mv_env, ckpt_table, tmp_path):
+    """Process-level drill (the ci.sh fleet stage runs the full version
+    under load): 2 replicas serve a checkpoint root; SIGKILL one; the
+    fleet relaunches it from the newest snapshot and the client sees
+    zero unrecovered errors throughout."""
+    import signal
+
+    from multiverso_tpu.serving.client import ServingClient
+    from multiverso_tpu.serving.fleet import ServingFleet
+
+    root = str(tmp_path / "ck")
+    _save_version(mv_env, root, 1)
+    fleet = ServingFleet(
+        2, root, log_dir=str(tmp_path / "fleet"),
+        extra_argv=["-serve_tables=emb"],
+        backoff_base_s=0.05, backoff_max_s=0.2,
+    ).start()
+    try:
+        assert fleet.wait_ready(timeout_s=120), "replicas never ready"
+        client = ServingClient(fleet.endpoints(), deadline_s=15.0)
+        np.testing.assert_array_equal(
+            client.lookup("emb", [0, 15]), np.ones((2, 4), np.float32)
+        )
+        victim = fleet.pid(0)
+        os.killpg(victim, signal.SIGKILL)
+        for i in range(30):  # keep load on through the kill
+            client.lookup("emb", [i % 16])
+            fleet.poll_once()
+            time.sleep(0.05)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not fleet._ready(0):
+            fleet.poll_once()
+            time.sleep(0.2)
+        assert fleet._ready(0), "killed replica never healed"
+        assert fleet.restarts == 1
+        assert client.stats()["unrecovered"] == 0
+        # the relaunched replica serves the NEWEST version
+        doc = fleet.endpoint(0)
+        with urllib.request.urlopen(
+            f"{doc['url']}/healthz", timeout=10
+        ) as resp:
+            h = json.loads(resp.read())
+        assert h["serving"]["version"] >= 1 and h["ready"]
+        # event log tells the story
+        events = [
+            json.loads(line)["event"]
+            for line in open(
+                os.path.join(str(tmp_path / "fleet"), "fleet.log.jsonl")
+            )
+        ]
+        assert "replica_exit" in events and "replica_relaunch" in events
+    finally:
+        fleet.stop()
+    assert fleet.alive() == 0
+
+
+@pytest.mark.slow
+def test_fleet_gives_up_after_budget(mv_env, tmp_path):
+    """A replica that cannot start (bad flags) must exhaust the restart
+    budget and be abandoned — the fleet degrades instead of crash-looping
+    forever."""
+    from multiverso_tpu.serving.fleet import ServingFleet
+
+    fleet = ServingFleet(
+        1, str(tmp_path / "nonexistent-root"),
+        log_dir=str(tmp_path / "fleet"),
+        # missing -serve_checkpoint_dir contents is fine (watch loop just
+        # idles); an unparseable flag kills the replica at startup
+        extra_argv=["-this_flag_does_not_exist=1"],
+        max_restarts=2, backoff_base_s=0.01, backoff_max_s=0.02,
+    ).start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not fleet._abandoned[0]:
+            fleet.poll_once()
+            time.sleep(0.05)
+        assert fleet._abandoned[0]
+        assert fleet.restarts == 2
+    finally:
+        fleet.stop()

@@ -1,0 +1,315 @@
+"""HTTP data plane + fleet client: status mapping, deadline
+propagation, failover, and the torn-read oracle across the network hop.
+
+The in-process batcher tests (test_serving.py) pin the serving
+semantics; these tests pin that NONE of them are lost in translation to
+HTTP: shed → 429 + Retry-After, breaker-open/warming → 503, validation
+→ 400, deadline → 504, and a response that crossed the wire still
+matches exactly one published weights version during concurrent
+hot-swaps.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.serving import (
+    DataPlaneServer,
+    ServingClient,
+    TableServer,
+    Unrecovered,
+)
+
+
+def _post(url, route, body, timeout=10.0):
+    req = urllib.request.Request(
+        f"{url}{route}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post_err(url, route, body, timeout=10.0):
+    try:
+        _post(url, route, body, timeout)
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Retry-After"), json.loads(e.read())
+    raise AssertionError("expected an HTTP error")
+
+
+@pytest.fixture
+def served(mv_env):
+    emb = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        yield srv, dp, emb
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+# --------------------------------------------------------------- routes
+
+
+def test_http_lookup_topk_predict_roundtrip(served):
+    srv, dp, emb = served
+    code, out = _post(dp.url, "/v1/lookup", {"table": "emb", "ids": [0, 5]})
+    assert code == 200
+    assert np.allclose(np.asarray(out["rows"], np.float32), emb[[0, 5]])
+    assert out["version"] == 1
+
+    code, out = _post(
+        dp.url, "/v1/topk",
+        {"table": "emb", "queries": emb[[3]].tolist(), "k": 2},
+    )
+    assert code == 200
+    assert out["ids"][0][0] == 3  # a row is its own nearest neighbour
+
+    code, out = _post(
+        dp.url, "/v1/predict",
+        {"table": "emb", "features": np.ones((2, 4)).tolist()},
+    )
+    assert code == 200
+    probs = np.asarray(out["scores"], np.float32)
+    assert probs.shape == (2, 16) and (probs >= 0).all() and (probs <= 1).all()
+
+
+def test_http_get_serves_health_routes(served):
+    _, dp, _ = served
+    with urllib.request.urlopen(f"{dp.url}/healthz", timeout=10) as resp:
+        doc = json.loads(resp.read())
+    assert doc["serving"]["version"] == 1
+    # ephemeral bound port surfaced for discovery (co-hosted replicas)
+    assert doc["ports"]["data"] == dp.port
+    with urllib.request.urlopen(f"{dp.url}/livez", timeout=10) as resp:
+        assert resp.status == 200
+
+
+# -------------------------------------------------------- error contract
+
+
+def test_http_maps_validation_to_400(served):
+    _, dp, _ = served
+    code, _, _ = _post_err(
+        dp.url, "/v1/lookup", {"table": "emb", "ids": [999]}
+    )
+    assert code == 400
+    code, _, _ = _post_err(dp.url, "/v1/lookup", {"ids": [1]})  # no table
+    assert code == 400
+    code, _, _ = _post_err(dp.url, "/v1/nope", {"table": "emb"})
+    assert code == 404
+
+
+def test_http_maps_overload_to_429_with_retry_after(mv_env):
+    from multiverso_tpu.serving.admission import AdmissionController
+
+    fake = [0.0]
+    adm = AdmissionController(10.0, 10.0, clock=lambda: fake[0])
+    emb = np.eye(8, dtype=np.float32)
+    srv = TableServer(
+        {"emb": emb}, register_runtime=False, admission=adm
+    ).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        body = {"table": "emb", "ids": list(range(8)), "tenant": "noisy"}
+        code, _ = _post(dp.url, "/v1/lookup", body)  # burst admits
+        assert code == 200
+        # bucket now in debt (cost 8 on burst 10, then next shed)
+        _post(dp.url, "/v1/lookup", body)
+        code, retry_after, payload = _post_err(dp.url, "/v1/lookup", body)
+        assert code == 429
+        assert payload["reason"] == "overloaded"
+        assert retry_after is not None and float(retry_after) > 0
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+def test_http_maps_breaker_open_to_503(mv_env):
+    from multiverso_tpu.resilience import chaos
+    from multiverso_tpu.utils.configure import SetCMDFlag
+
+    emb = np.eye(8, dtype=np.float32)
+    srv = TableServer(
+        {"emb": emb}, register_runtime=False,
+        breaker_threshold=2, breaker_cooldown_s=60.0,
+    ).start()
+    dp = DataPlaneServer(srv, port=0)
+    SetCMDFlag("chaos_route_errors", "lookup:2")
+    chaos.reset()
+    try:
+        body = {"table": "emb", "ids": [1]}
+        for _ in range(2):  # chaos fails the flushes, opening the breaker
+            code, _, _ = _post_err(dp.url, "/v1/lookup", body)
+            assert code == 500
+        code, retry_after, payload = _post_err(dp.url, "/v1/lookup", body)
+        assert code == 503
+        assert payload["reason"] == "route_unavailable"
+        assert retry_after is not None and float(retry_after) > 0
+    finally:
+        SetCMDFlag("chaos_route_errors", "")
+        chaos.reset()
+        dp.stop()
+        srv.stop()
+
+
+def test_http_unpublished_server_answers_503_not_ready(mv_env):
+    srv = TableServer(register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        code, _, payload = _post_err(
+            dp.url, "/v1/lookup", {"table": "emb", "ids": [0]}
+        )
+        assert code == 503
+        assert payload["reason"] == "not_ready"
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+def test_http_deadline_expiry_is_504(mv_env):
+    emb = np.eye(8, dtype=np.float32)
+    # a batcher that is started but never flushes within the deadline:
+    # huge max_delay + max_batch means the 1ms client budget expires
+    srv = TableServer(
+        {"emb": emb}, register_runtime=False,
+        max_delay_s=5.0, max_batch=512,
+    ).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        code, _, payload = _post_err(
+            dp.url, "/v1/lookup",
+            {"table": "emb", "ids": [0], "deadline_ms": 1.0},
+        )
+        assert code == 504
+        assert payload["reason"] == "deadline"
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+# --------------------------------------------------------------- client
+
+
+def test_client_fails_over_to_live_endpoint(served):
+    srv, dp, emb = served
+    # first endpoint: nothing listens there (closed port) — the client
+    # must fail over to the live one and record it
+    from multiverso_tpu.resilience.supervisor import free_port
+
+    dead = f"http://127.0.0.1:{free_port()}"
+    c = ServingClient([dead, dp.url], deadline_s=10.0, backoff_base_s=0.01)
+    rows = c.lookup("emb", [2, 7])
+    assert np.allclose(rows, emb[[2, 7]])
+    s = c.stats()
+    assert s["ok"] == 1 and s["failovers"] >= 1 and s["unrecovered"] == 0
+
+
+def test_client_unrecovered_when_all_endpoints_dead(mv_env):
+    from multiverso_tpu.resilience.supervisor import free_port
+
+    c = ServingClient(
+        [f"http://127.0.0.1:{free_port()}"],
+        deadline_s=0.5, max_attempts=3, backoff_base_s=0.01,
+    )
+    with pytest.raises(Unrecovered):
+        c.lookup("emb", [0])
+    assert c.stats()["unrecovered"] == 1
+
+
+def test_client_does_not_retry_client_bugs(served):
+    srv, dp, _ = served
+    c = ServingClient([dp.url], deadline_s=5.0)
+    with pytest.raises(ValueError):
+        c.lookup("emb", [999])  # out of range: 400, no retry
+    s = c.stats()
+    assert s["retries"] == 0 and s["unrecovered"] == 0
+
+
+def test_client_honors_retry_after_hint(served):
+    srv, dp, _ = served
+    from multiverso_tpu.serving.admission import AdmissionController
+
+    fake = [0.0]
+    # burst exactly one 8-row lookup: the second request must shed once
+    srv.admission = AdmissionController(10.0, 8.0, clock=lambda: fake[0])
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        fake[0] += s  # sleeping refills the bucket
+
+    c = ServingClient(
+        [dp.url], deadline_s=30.0, sleep=fake_sleep, backoff_base_s=0.01
+    )
+    try:
+        c.lookup("emb", np.arange(8))   # drains burst into debt
+        c.lookup("emb", np.arange(8))   # shed once, retried after hint
+        s = c.stats()
+        assert s["shed_429"] >= 1 and s["unrecovered"] == 0
+        assert any(x > 0 for x in sleeps)
+    finally:
+        srv.admission = None
+
+
+# --------------------------------------------------- torn reads over HTTP
+
+
+def test_http_no_torn_reads_during_hot_swaps(served):
+    """The zero-torn-reads oracle ACROSS the data plane: every HTTP
+    response must equal some single published version's rows, while a
+    publisher hot-swaps concurrently (registry-first ordering)."""
+    srv, dp, emb0 = served
+    vocab, dim = emb0.shape
+    history = {1: emb0.copy()}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def publisher():
+        rng = np.random.RandomState(0)
+        while not stop.is_set():
+            emb = rng.randn(vocab, dim).astype(np.float32)
+            with lock:
+                history[max(history) + 1] = emb
+            srv.publish({"emb": emb})
+            time.sleep(0.002)
+
+    torn = []
+    errors = []
+
+    def reader(seed):
+        c = ServingClient([dp.url], deadline_s=30.0)
+        rng = np.random.RandomState(seed)
+        for _ in range(60):
+            ids = rng.randint(0, vocab, size=rng.randint(1, 6))
+            try:
+                rows = c.lookup("emb", ids)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            with lock:
+                versions = list(history.values())
+            if not any(np.array_equal(rows, e[ids]) for e in versions):
+                torn.append(ids)
+
+    pub = threading.Thread(target=publisher)
+    readers = [
+        threading.Thread(target=reader, args=(i,)) for i in range(3)
+    ]
+    pub.start()
+    for th in readers:
+        th.start()
+    for th in readers:
+        th.join(timeout=120)
+    stop.set()
+    pub.join(timeout=30)
+    assert not errors, errors[:3]
+    assert not torn, f"torn reads over HTTP: {torn[:5]}"
+    assert max(history) > 2, "publisher never swapped — oracle vacuous"
