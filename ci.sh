@@ -13,10 +13,19 @@ cmake --build build --parallel
 
 echo "== mvlint static analysis (analysis/RULES.md) =="
 # repo-aware AST rules R1-R5 (collective-dispatch threading, lock order,
-# flag hygiene, thread lifecycle, exact-path determinism) — fails on ANY
+# flag hygiene, thread lifecycle, exact-path determinism) plus the
+# interprocedural SPMD/JAX pack R6-R9 (rank-divergent collectives,
+# donation aliasing, retrace churn, cross-thread state) — fails on ANY
 # unsuppressed finding; the checked-in baseline is empty by contract, so
-# this is "the tree lints clean", not "the tree matches a snapshot"
-python -m multiverso_tpu.analysis multiverso_tpu/
+# this is "the tree lints clean", not "the tree matches a snapshot".
+# MVLINT_DIFF_REF=<git ref> switches to the pre-push fast path: the full
+# tree is still parsed (cross-file rules stay sound) but only findings
+# in files changed vs the ref are reported.
+if [ -n "${MVLINT_DIFF_REF:-}" ]; then
+    python -m multiverso_tpu.analysis --diff "$MVLINT_DIFF_REF" multiverso_tpu/
+else
+    python -m multiverso_tpu.analysis multiverso_tpu/
+fi
 
 echo "== unit + integration tests (8-device CPU mesh) =="
 # the fused Pallas train-step suite (tests/test_fused_step.py) runs here
@@ -193,6 +202,17 @@ while time.monotonic() < deadline:
     time.sleep(0.2)
 assert on_v2 == 2, f"only {on_v2}/2 replicas rolled to ckpt-2"
 
+# fleet-level observability: ONE command joins every replica's /metrics
+# into a single replica-labeled Prometheus dump (obs scrape)
+import subprocess
+scrape = subprocess.run(
+    [sys.executable, "-m", "multiverso_tpu.obs", "scrape",
+     os.path.join(root, "fleet"), "--expect", "2"],
+    capture_output=True, text=True)
+assert scrape.returncode == 0, scrape.stderr[-500:]
+assert 'replica="0"' in scrape.stdout and 'replica="1"' in scrape.stdout, \
+    scrape.stdout[:300]
+
 time.sleep(1.0)  # keep load running a beat past the full recovery
 stop.set()
 for th in threads:
@@ -207,7 +227,7 @@ fleet.stop()
 assert fleet.alive() == 0
 print(f"fleet drill OK: {requests} requests, 0 unrecovered "
       f"({failovers} failovers), kill+heal with rollout to ckpt-2, "
-      f"429 Retry-After={retry_after}s")
+      f"429 Retry-After={retry_after}s, 2-replica /metrics scrape")
 EOF
 rm -rf "$FLROOT"
 

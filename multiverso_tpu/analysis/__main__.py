@@ -2,8 +2,10 @@
 
 Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = bad
 invocation/baseline. ``--json`` emits the machine-readable summary the
-bench leg records; ``--flag-table`` regenerates the DEPLOY.md flag
-reference from the AST (no imports executed).
+bench leg records; ``--diff REF`` lints the whole tree but reports only
+findings in files changed since the git ref (the pre-push fast path);
+``--flag-table`` regenerates the DEPLOY.md flag reference from the AST
+(no imports executed).
 """
 
 from __future__ import annotations
@@ -11,9 +13,33 @@ from __future__ import annotations
 import argparse
 import ast
 import json
+import os
+import subprocess
 import sys
 
 from multiverso_tpu.analysis import mvlint
+
+
+def _changed_paths(ref: str, root: str) -> list:
+    """Repo-relative ``.py`` paths changed vs ``ref`` (committed diff +
+    working-tree edits + untracked files) — what ``--diff`` restricts
+    finding emission to. The PARSE still covers the full tree: a changed
+    callee can create a finding in an unchanged caller, and rules R6-R9
+    resolve calls across files."""
+    out = set()
+    cmds = [
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ]
+    for cmd in cmds:
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True, check=True
+        )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(line.replace(os.sep, "/"))
+    return sorted(out)
 
 
 def _flag_table(paths) -> str:
@@ -83,6 +109,10 @@ def main(argv=None) -> int:
                     help="suppression file (default: analysis/baseline.toml)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary on stdout")
+    ap.add_argument("--diff", metavar="REF", default=None,
+                    help="report findings only for files changed vs this "
+                         "git ref (full tree still parsed — cross-file "
+                         "rules stay sound)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print suppressed findings")
     ap.add_argument("--flag-table", action="store_true",
@@ -93,18 +123,40 @@ def main(argv=None) -> int:
     if args.flag_table:
         print(_flag_table(paths))
         return 0
+    cfg = mvlint.default_config(paths)
+    if args.diff is not None:
+        try:
+            cfg.restrict_paths = _changed_paths(
+                args.diff, cfg.repo_root or "."
+            )
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"mvlint: --diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+        if not cfg.restrict_paths:
+            if args.json:
+                print(json.dumps({
+                    "files": 0, "findings": 0, "suppressed": 0,
+                    "runtime_s": 0.0, "rules": {},
+                }))
+            else:
+                print(f"mvlint: no .py files changed vs {args.diff}")
+            return 0
     try:
-        result = mvlint.run_lint(paths, baseline_path=args.baseline)
+        result = mvlint.run_lint(paths, config=cfg,
+                                 baseline_path=args.baseline)
     except ValueError as e:  # malformed baseline
         print(f"mvlint: {e}", file=sys.stderr)
         return 2
     if args.json:
+        per_rule: dict = {}
+        for f in result.findings:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
         print(json.dumps({
             "files": result.files,
             "findings": len(result.findings),
             "suppressed": len(result.suppressed),
             "runtime_s": round(result.runtime_s, 3),
-            "rules": sorted({f.rule for f in result.findings}),
+            "rules": {r: per_rule[r] for r in sorted(per_rule)},
         }))
     else:
         print(mvlint.format_findings(result, verbose=args.verbose))

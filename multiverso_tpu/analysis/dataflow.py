@@ -1,0 +1,603 @@
+"""Interprocedural dataflow engine for mvlint (rules R6-R9 + R1 v2).
+
+PR 8's rules resolved calls by *name* within one module — enough for the
+lexical rules, but blind to exactly the bug classes this repo has paid
+for interprocedurally (the PR 6 cross-thread dispatch deadlock crossed a
+``self._pipe = TaskPipe(...)`` binding; the PR 5 donated-snapshot alias
+crossed a ``self._step = jax.jit(..., donate_argnums=...)`` binding).
+This module builds the repo-wide facts those rules need:
+
+* a **module graph**: every scanned file keyed by its dotted module
+  name, with per-module import tables (``import x.y as z`` /
+  ``from x import y as z``) resolved against the scanned set;
+* a **class index**: methods (through scanned base classes), plus
+  **attribute type bindings** inferred from ``self._x = ClassName(...)``
+  and ``self._x = jax.jit(...)``-style assignments anywhere in the
+  class — the ``self._x = Thread(...)`` idiom the issue names;
+* **local variable bindings** per function (``t = KVTable(...)`` makes
+  ``t.get`` resolve to ``KVTable.get``);
+* a **call graph** over all of it, with a documented resolution order
+  (local scope, ``self``, typed receivers, imports, then a
+  *unique-name* fallback: an unqualified method name resolves globally
+  only when exactly one scanned definition carries it — which is what
+  retires R1's hand-kept ambiguous-name exclusion list: ``get``/``add``
+  now propagate through **typed** receivers and nothing else);
+* **fixpoint reachability** queries with memoisation and cycle
+  tolerance (``reaches``, ``reachable_set``);
+* **thread entry discovery**: ``Thread(target=...)`` targets,
+  ``ASyncBuffer`` fill actions, and closures submitted to ``TaskPipe``
+  (``.submit``/``.submit_nowait``) — the inputs R1 v2 and R9 share.
+
+Everything is pure-``ast``; nothing here imports the code under
+analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from multiverso_tpu.analysis.mvlint import Module
+
+__all__ = [
+    "FuncInfo",
+    "ClassInfo",
+    "ProjectGraph",
+    "call_name",
+    "receiver_of",
+]
+
+# constructor names that bind a *synchronization primitive* — R9 treats
+# attributes holding these as safe to touch cross-thread (they carry
+# their own locking), and R2's lock regex already covers the lock-ish
+SYNC_PRIMITIVE_TYPES = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "OrderedLock", "TaskPipe", "ASyncBuffer", "local",
+}
+
+# thread-spawning constructors: (ctor name, how the entry is passed)
+_THREAD_CTORS = {"Thread"}
+_PIPE_SUBMIT_METHODS = {"submit", "submit_nowait"}
+
+# Method names carried by builtin containers / files / sync primitives.
+# The unique-name fallback must NEVER resolve an untyped ``x.items()``
+# to a scanned def: ``state.items()`` on a plain dict would link to
+# ``KVTable.items`` the moment the repo holds exactly one ``items``
+# def. Typed receivers are unaffected — ``self._t.get(...)`` with
+# ``self._t = KVTable(...)`` still resolves — which is precisely the
+# improvement over the retired AMBIGUOUS_DISPATCH_NAMES hand-list: the
+# generic names propagate through *evidence*, never through luck.
+BUILTIN_METHOD_NAMES: Set[str] = set()
+for _t in (dict, list, set, tuple, str, bytes, frozenset):
+    BUILTIN_METHOD_NAMES.update(
+        n for n in dir(_t) if not n.startswith("__")
+    )
+BUILTIN_METHOD_NAMES |= {
+    "close", "flush", "read", "write", "readline", "readlines", "seek",
+    "tell", "open", "start", "run", "is_alive", "put", "get_nowait",
+    "put_nowait", "qsize", "empty", "full", "task_done",
+    "acquire", "release", "locked", "wait", "notify", "notify_all",
+    "set", "clear", "is_set", "submit", "result", "cancel", "done",
+    "send", "recv", "connect", "bind", "listen", "accept", "shutdown",
+}
+
+
+def call_name(func: ast.AST) -> str:
+    """Rightmost name of a call target (``a.b.c()`` -> ``c``)."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def receiver_of(func: ast.AST) -> Optional[ast.AST]:
+    return func.value if isinstance(func, ast.Attribute) else None
+
+
+def _dotted(node: ast.AST) -> str:
+    """``a.b.c`` attribute chains as text; "" when not a pure chain."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class FuncInfo:
+    """One function/method/lambda in the scanned universe."""
+
+    __slots__ = ("module", "cls", "name", "node", "uid")
+
+    def __init__(self, module: Module, cls: str, name: str, node: ast.AST):
+        self.module = module
+        self.cls = cls  # "" for module-level
+        self.name = name
+        self.node = node
+        self.uid = id(node)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+    def __repr__(self) -> str:  # debugging/messages only
+        return f"<{self.module.relpath}::{self.qualname}>"
+
+
+class ClassInfo:
+    __slots__ = ("name", "module", "node", "bases", "methods",
+                 "attr_types")
+
+    def __init__(self, name: str, module: Module, node: ast.ClassDef):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.bases: List[str] = []  # textual base refs, resolved lazily
+        self.methods: Dict[str, FuncInfo] = {}
+        # attr -> set of bound constructor/type names observed anywhere
+        # in the class body ("Thread", "TaskPipe", "jit", ...)
+        self.attr_types: Dict[str, Set[str]] = {}
+
+
+def _module_dotted_name(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    p = p.replace("/", ".")
+    if p.endswith(".__init__"):
+        p = p[: -len(".__init__")]
+    return p
+
+
+class ProjectGraph:
+    """Repo-wide call graph + binding facts over a set of ``Module``s."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = list(modules)
+        self.by_name: Dict[str, Module] = {
+            _module_dotted_name(m.relpath): m for m in self.modules
+        }
+        # (module relpath, class name) -> ClassInfo; plus name -> [infos]
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        # per-module import table: local alias -> dotted target
+        self.imports: Dict[str, Dict[str, str]] = {}
+        # module-level functions: (module relpath, name) -> FuncInfo
+        self.mod_funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        # global name -> all defs carrying it (unique-name fallback)
+        self._defs_by_name: Dict[str, List[FuncInfo]] = {}
+        # every FuncInfo by node id (incl. nested + lambdas-on-demand)
+        self.funcs: Dict[int, FuncInfo] = {}
+        # function uid -> enclosing FuncInfo uid (closure scope chain)
+        self._parent: Dict[int, int] = {}
+        self._callee_cache: Dict[int, Tuple[FuncInfo, ...]] = {}
+        self._local_cache: Dict[int, Dict[str, Set[str]]] = {}
+        for m in self.modules:
+            self._index_module(m)
+        self._link_bases()
+
+    # --------------------------------------------------------- indexing
+
+    def _index_module(self, m: Module) -> None:
+        imp: Dict[str, str] = {}
+        self.imports[m.relpath] = imp
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imp[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    imp[a.asname or a.name] = f"{node.module}.{a.name}"
+
+        def visit(node: ast.AST, cls: Optional[ClassInfo],
+                  parent_fn: Optional[FuncInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    ci = ClassInfo(child.name, m, child)
+                    for b in child.bases:
+                        ref = _dotted(b)
+                        if ref:
+                            ci.bases.append(ref)
+                    self.classes[(m.relpath, child.name)] = ci
+                    self.classes_by_name.setdefault(
+                        child.name, []
+                    ).append(ci)
+                    visit(child, ci, parent_fn)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    fi = FuncInfo(
+                        m, cls.name if cls else "", child.name, child
+                    )
+                    self.funcs[fi.uid] = fi
+                    if parent_fn is not None:
+                        self._parent[fi.uid] = parent_fn.uid
+                    if cls is not None and parent_fn is None:
+                        cls.methods.setdefault(child.name, fi)
+                    elif cls is None and parent_fn is None:
+                        self.mod_funcs[(m.relpath, child.name)] = fi
+                    self._defs_by_name.setdefault(
+                        child.name, []
+                    ).append(fi)
+                    visit(child, cls, fi)
+                else:
+                    visit(child, cls, parent_fn)
+
+        visit(m.tree, None, None)
+
+        # attribute type bindings: self.X = Ctor(...) anywhere in a class
+        for (relpath, _cname), ci in list(self.classes.items()):
+            if relpath != m.relpath:
+                continue
+            for node in ast.walk(ci.node):
+                tgt = None
+                val = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    tgt, val = node.target, node.value
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                for t in self._value_type_names(val):
+                    ci.attr_types.setdefault(tgt.attr, set()).add(t)
+
+    @staticmethod
+    def _value_type_names(val: Optional[ast.AST]) -> List[str]:
+        """Constructor-ish names an assigned value binds (``Thread(...)``
+        -> Thread; ``jax.jit(...)`` -> jit; ``Foo(...).start()`` -> Foo
+        — the ``.start()`` fluent idiom must not hide the type)."""
+        out: List[str] = []
+        if isinstance(val, ast.Call):
+            n = call_name(val.func)
+            if n in ("start", "result"):  # fluent: Foo(...).start()
+                recv = receiver_of(val.func)
+                if isinstance(recv, ast.Call):
+                    n = call_name(recv.func)
+            if n:
+                out.append(n)
+        return out
+
+    def _link_bases(self) -> None:
+        """Resolve each class's textual base refs to ClassInfos once."""
+        self._base_infos: Dict[Tuple[str, str], List[ClassInfo]] = {}
+        for key, ci in self.classes.items():
+            resolved: List[ClassInfo] = []
+            for ref in ci.bases:
+                leaf = ref.split(".")[-1]
+                target = self._resolve_class(ci.module, leaf) or \
+                    (self.classes_by_name.get(leaf) or [None])[0]
+                if target is not None:
+                    resolved.append(target)
+            self._base_infos[key] = resolved
+
+    # ------------------------------------------------------- resolution
+
+    def _resolve_class(self, m: Module, name: str) -> Optional[ClassInfo]:
+        ci = self.classes.get((m.relpath, name))
+        if ci is not None:
+            return ci
+        dotted = self.imports.get(m.relpath, {}).get(name)
+        if dotted:
+            modname, _, leaf = dotted.rpartition(".")
+            target = self.by_name.get(modname)
+            if target is not None:
+                return self.classes.get((target.relpath, leaf))
+            # ``from multiverso_tpu.tables import KVTable`` re-export:
+            # fall through to the global registry by leaf name
+            hits = self.classes_by_name.get(leaf, [])
+            if len(hits) == 1:
+                return hits[0]
+        return None
+
+    def lookup_method(self, ci: ClassInfo, name: str,
+                      _seen: Optional[Set[int]] = None
+                      ) -> Optional[FuncInfo]:
+        """Method resolution through scanned bases (C3-ish, depth-first
+        in declaration order — enough for this repo's single-inheritance
+        trees)."""
+        seen = _seen if _seen is not None else set()
+        if id(ci) in seen:
+            return None
+        seen.add(id(ci))
+        fi = ci.methods.get(name)
+        if fi is not None:
+            return fi
+        for base in self._base_infos.get((ci.module.relpath, ci.name), ()):
+            fi = self.lookup_method(base, name, seen)
+            if fi is not None:
+                return fi
+        return None
+
+    def class_of_func(self, fn: FuncInfo) -> Optional[ClassInfo]:
+        if not fn.cls:
+            return None
+        return self.classes.get((fn.module.relpath, fn.cls))
+
+    def _local_bindings(self, fn: FuncInfo) -> Dict[str, Set[str]]:
+        """var name -> constructor names bound inside this function."""
+        cached = self._local_cache.get(fn.uid)
+        if cached is not None:
+            return cached
+        out: Dict[str, Set[str]] = {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                for t in self._value_type_names(node.value):
+                    out.setdefault(node.targets[0].id, set()).add(t)
+        self._local_cache[fn.uid] = out
+        return out
+
+    def receiver_types(self, fn: FuncInfo, recv: ast.AST) -> List[ClassInfo]:
+        """Scanned classes a call receiver may be an instance of."""
+        names: Set[str] = set()
+        if isinstance(recv, ast.Attribute) and isinstance(
+            recv.value, ast.Name
+        ) and recv.value.id == "self" and fn.cls:
+            ci = self.class_of_func(fn)
+            search: List[ClassInfo] = []
+            if ci is not None:
+                search = [ci] + self._base_infos.get(
+                    (ci.module.relpath, ci.name), []
+                )
+            for c in search:
+                names |= c.attr_types.get(recv.attr, set())
+        elif isinstance(recv, ast.Name):
+            names |= self._local_bindings(fn).get(recv.id, set())
+        out: List[ClassInfo] = []
+        for n in sorted(names):
+            ci = self._resolve_class(fn.module, n)
+            if ci is None:
+                hits = self.classes_by_name.get(n, [])
+                ci = hits[0] if len(hits) == 1 else None
+            if ci is not None:
+                out.append(ci)
+        return out
+
+    def resolve_callable_ref(self, fn: FuncInfo,
+                             target: ast.AST) -> List[FuncInfo]:
+        """Resolve a *reference* to a callable (a ``target=`` kwarg, a
+        submitted closure) — not a call."""
+        if isinstance(target, ast.Lambda):
+            fi = self.funcs.get(id(target))
+            if fi is None:
+                fi = FuncInfo(fn.module, fn.cls, "<lambda>", target)
+                self.funcs[fi.uid] = fi
+                self._parent[fi.uid] = fn.uid
+            return [fi]
+        if isinstance(target, ast.Call):
+            # functools.partial(f, ...) / wraps: resolve the first arg
+            if call_name(target.func) == "partial" and target.args:
+                return self.resolve_callable_ref(fn, target.args[0])
+            return []
+        return self._resolve_name_or_attr(fn, target)
+
+    def _resolve_name_or_attr(self, fn: FuncInfo,
+                              target: ast.AST) -> List[FuncInfo]:
+        if isinstance(target, ast.Name):
+            name = target.id
+            # closure scope chain: nested def in this or enclosing fns
+            cur: Optional[FuncInfo] = fn
+            while cur is not None:
+                for child in ast.walk(cur.node):
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and child.name == name and id(child) in self.funcs:
+                        return [self.funcs[id(child)]]
+                cur = self.funcs.get(self._parent.get(cur.uid, -1))
+            mf = self.mod_funcs.get((fn.module.relpath, name))
+            if mf is not None:
+                return [mf]
+            dotted = self.imports.get(fn.module.relpath, {}).get(name)
+            if dotted:
+                hit = self._resolve_dotted(dotted)
+                if hit is not None:
+                    return [hit]
+            ci = self._resolve_class(fn.module, name)
+            if ci is not None:  # constructor call -> __init__
+                init = self.lookup_method(ci, "__init__")
+                return [init] if init is not None else []
+            # unique-name fallback (see module docstring)
+            hits = self._defs_by_name.get(name, [])
+            return [hits[0]] if len(hits) == 1 else []
+        if isinstance(target, ast.Attribute):
+            recv = target.value
+            meth = target.attr
+            if isinstance(recv, ast.Name) and recv.id == "self" and fn.cls:
+                ci = self.class_of_func(fn)
+                if ci is not None:
+                    hit = self.lookup_method(ci, meth)
+                    if hit is not None:
+                        return [hit]
+                return []
+            if isinstance(recv, ast.Call) and call_name(recv.func) == \
+                    "super" and fn.cls:
+                ci = self.class_of_func(fn)
+                if ci is not None:
+                    for base in self._base_infos.get(
+                        (ci.module.relpath, ci.name), ()
+                    ):
+                        hit = self.lookup_method(base, meth)
+                        if hit is not None:
+                            return [hit]
+                return []
+            for ci in self.receiver_types(fn, recv):
+                hit = self.lookup_method(ci, meth)
+                if hit is not None:
+                    return [hit]
+            # module-qualified: mod.func()
+            ref = _dotted(recv)
+            if ref:
+                dotted = self.imports.get(fn.module.relpath, {}).get(
+                    ref.split(".")[0]
+                )
+                if dotted:
+                    full = dotted + ref[len(ref.split(".")[0]):] + \
+                        "." + meth
+                    hit = self._resolve_dotted(full)
+                    if hit is not None:
+                        return [hit]
+                cls = self._resolve_class(fn.module, ref)
+                if cls is not None:  # ClassName.meth
+                    hit = self.lookup_method(cls, meth)
+                    if hit is not None:
+                        return [hit]
+            # unique-name fallback for unknown receivers: propagate only
+            # when the name is unambiguous repo-wide AND is not a
+            # builtin-container method (an untyped ``x.items()`` is a
+            # dict far more often than the one scanned ``items`` def;
+            # typed receivers above already handled the real one)
+            if meth in BUILTIN_METHOD_NAMES:
+                return []
+            hits = self._defs_by_name.get(meth, [])
+            return [hits[0]] if len(hits) == 1 else []
+        return []
+
+    def _resolve_dotted(self, dotted: str) -> Optional[FuncInfo]:
+        modname, _, leaf = dotted.rpartition(".")
+        m = self.by_name.get(modname)
+        if m is not None:
+            fi = self.mod_funcs.get((m.relpath, leaf))
+            if fi is not None:
+                return fi
+            ci = self.classes.get((m.relpath, leaf))
+            if ci is not None:
+                return self.lookup_method(ci, "__init__")
+        return None
+
+    # ------------------------------------------------------- call graph
+
+    def own_nodes(self, fn: FuncInfo,
+                  root: Optional[ast.AST] = None) -> Iterable[ast.AST]:
+        """Nodes lexically inside ``fn`` (or ``root``), NOT descending
+        into nested defs that carry their own FuncInfo — defining a
+        closure is not executing it (the thread boundary R1/R6/R9 all
+        hinge on). Lambdas have no indexed FuncInfo, so their bodies
+        stay attributed to the enclosing function."""
+        start = root if root is not None else fn.node
+        stack: List[ast.AST] = [start]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and child is not start and id(child) in self.funcs:
+                    continue
+                stack.append(child)
+
+    def callees(self, fn: FuncInfo) -> Tuple[FuncInfo, ...]:
+        """Functions this one may CALL on its own thread of execution:
+        resolved calls in its own nodes, plus nested defs it invokes by
+        name (already covered — a called nested def resolves through the
+        closure scope chain)."""
+        cached = self._callee_cache.get(fn.uid)
+        if cached is not None:
+            return cached
+        out: List[FuncInfo] = []
+        seen: Set[int] = set()
+        for node in self.own_nodes(fn):
+            if isinstance(node, ast.Call):
+                for hit in self._resolve_name_or_attr(fn, node.func):
+                    if hit.uid not in seen:
+                        seen.add(hit.uid)
+                        out.append(hit)
+        result = tuple(out)
+        self._callee_cache[fn.uid] = result
+        return result
+
+    def reachable_set(self, roots: Iterable[FuncInfo]) -> Set[int]:
+        """uids of every function reachable from ``roots`` (inclusive)."""
+        out: Set[int] = set()
+        stack = list(roots)
+        while stack:
+            fn = stack.pop()
+            if fn.uid in out:
+                continue
+            out.add(fn.uid)
+            stack.extend(self.callees(fn))
+        return out
+
+    def reachers_of(self, sink_uids: Set[int]) -> Set[int]:
+        """uids of every function from which some sink is reachable
+        (sinks included) — one reverse-BFS over the whole graph, so
+        rules can test membership instead of re-walking per call site."""
+        rev: Dict[int, List[int]] = {}
+        for fn in list(self.funcs.values()):
+            for callee in self.callees(fn):
+                rev.setdefault(callee.uid, []).append(fn.uid)
+        out: Set[int] = set()
+        stack = [u for u in sink_uids]
+        while stack:
+            uid = stack.pop()
+            if uid in out:
+                continue
+            out.add(uid)
+            stack.extend(rev.get(uid, ()))
+        return out
+
+    def calls_in(self, fn: FuncInfo, node: Optional[ast.AST] = None
+                 ) -> List[Tuple[ast.Call, List[FuncInfo]]]:
+        """(call node, resolved callees) for every call lexically inside
+        ``node`` (default: the whole function), own nodes only."""
+        out = []
+        for n in self.own_nodes(fn, node):
+            if isinstance(n, ast.Call):
+                out.append((n, self._resolve_name_or_attr(fn, n.func)))
+        return out
+
+    # ---------------------------------------------------- thread entries
+
+    def thread_entries(self) -> List[Tuple[FuncInfo, ast.Call, str, FuncInfo]]:
+        """Every (spawning fn, spawn call, kind, entry fn) in the scan:
+        ``Thread(target=...)``, ``ASyncBuffer(fill)``, and closures
+        handed to ``TaskPipe.submit``/``submit_nowait``. The TaskPipe
+        worker is the *sanctioned* collective channel (R1 allows it) but
+        R9 still needs to know its closures run off-thread."""
+        out: List[Tuple[FuncInfo, ast.Call, str, FuncInfo]] = []
+        for fn in list(self.funcs.values()):
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            for node in self.own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node.func)
+                target: Optional[ast.AST] = None
+                kind = ""
+                if cname in _THREAD_CTORS:
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                            kind = "thread_target"
+                elif cname == "ASyncBuffer":
+                    if node.args:
+                        target = node.args[0]
+                    for kw in node.keywords:
+                        if kw.arg == "fill_buffer_action":
+                            target = kw.value
+                    kind = "fill_action"
+                elif cname in _PIPE_SUBMIT_METHODS and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    recv = receiver_of(node.func)
+                    types = {c.name for c in self.receiver_types(
+                        fn, recv
+                    )} if recv is not None else set()
+                    recv_text = _dotted(recv) if recv is not None else ""
+                    if "TaskPipe" in types or "pipe" in recv_text.lower():
+                        if node.args:
+                            target = node.args[0]
+                            kind = "pipe_submit"
+                if target is None or not kind:
+                    continue
+                for entry in self.resolve_callable_ref(fn, target):
+                    out.append((fn, node, kind, entry))
+        return out

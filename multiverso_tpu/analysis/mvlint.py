@@ -119,6 +119,12 @@ class LintConfig:
     aux_read_roots: Sequence[str] = ()
     doc_files: Sequence[str] = ()
     repo_root: str = ""
+    # --diff mode: when not None, findings are reported only for these
+    # repo-relative paths. The parse and the interprocedural graph still
+    # cover the FULL tree (a change in a callee can create a finding in
+    # its caller's file — cross-file analysis must not go blind), only
+    # the emission is restricted.
+    restrict_paths: Optional[Sequence[str]] = None
 
 
 @dataclasses.dataclass
@@ -291,8 +297,18 @@ def run_lint(
                 "mvlint needs parseable sources",
             ))
     mods = list(modules.values())
+    graph = None
     for rule_fn in rules_mod.ALL_RULES:
-        findings.extend(rule_fn(mods, cfg))
+        if getattr(rule_fn, "needs_graph", False):
+            if graph is None:
+                from multiverso_tpu.analysis.dataflow import ProjectGraph
+                graph = ProjectGraph(mods)
+            findings.extend(rule_fn(mods, cfg, graph))
+        else:
+            findings.extend(rule_fn(mods, cfg))
+    if cfg.restrict_paths is not None:
+        keep = {p.replace(os.sep, "/") for p in cfg.restrict_paths}
+        findings = [f for f in findings if f.path in keep]
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     if baseline_path is None:
         baseline_path = os.path.join(

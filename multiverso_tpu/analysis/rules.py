@@ -20,14 +20,6 @@ from multiverso_tpu.analysis.mvlint import Finding, LintConfig, Module
 
 # --------------------------------------------------------------- shared
 
-# entry-point names too generic for name-based call-graph propagation
-# (every dict has .get, every list has .pop) — the RUNTIME guard still
-# covers them; only the static reachability pass skips them.
-AMBIGUOUS_DISPATCH_NAMES = {
-    "get", "add", "load", "store", "items", "wait", "pop", "push",
-    "update", "flush", "close",
-}
-
 # table collective entry points that MUST carry @collective_dispatch
 # (file suffix -> class -> methods). Subclass overrides that call
 # ``super()`` inherit the guard through the decorated base method.
@@ -103,38 +95,20 @@ def _called_names(fn: ast.AST) -> Set[str]:
     return out
 
 
-def _reach(module: Module, roots: Iterable[ast.AST]) -> Set[str]:
-    """Transitive closure of called names, resolving through same-module
-    function definitions (name-based — mvlint's documented approximation)."""
-    seen_fns: Set[int] = set()
-    names: Set[str] = set()
-    stack = list(roots)
-    while stack:
-        fn = stack.pop()
-        if id(fn) in seen_fns:
-            continue
-        seen_fns.add(id(fn))
-        for n in _called_names(fn):
-            names.add(n)
-            for _cls, callee in module.functions.get(n, ()):
-                stack.append(callee)
-    return names
-
-
 # ------------------------------------------------------------------- R1
 
 def rule_r1_collective_dispatch(
-    modules: Sequence[Module], cfg: LintConfig
+    modules: Sequence[Module], cfg: LintConfig, graph=None
 ) -> List[Finding]:
+    """v2: reachability runs on the interprocedural call graph
+    (analysis/dataflow.py) — typed receivers resolve ``self._t.get(...)``
+    through the ``self._t = KVTable(...)`` binding, which is what retired
+    the old AMBIGUOUS_DISPATCH_NAMES exclusion list: generic names like
+    ``get``/``add`` now propagate only through a *typed* receiver or a
+    repo-unique definition, never by bare name."""
+    from multiverso_tpu.analysis import rules_spmd
+
     findings: List[Finding] = []
-    # sink names = every @collective_dispatch-tagged function in the scan
-    sinks: Set[str] = set()
-    for m in modules:
-        for name, defs in m.functions.items():
-            for _cls, fn in defs:
-                if _has_dispatch_decorator(fn):
-                    sinks.add(name)
-    graph_sinks = sinks - AMBIGUOUS_DISPATCH_NAMES
 
     # coverage: the known table entry points must be tagged
     for suffix, classes in REQUIRED_DISPATCH.items():
@@ -155,56 +129,63 @@ def rule_r1_collective_dispatch(
                         ))
 
     # rogue thread entries: Thread targets / ASyncBuffer fill actions
-    # whose same-module call closure reaches a tagged entry point
-    for m in modules:
+    # that can reach a tagged entry point through the call graph.
+    # TaskPipe submissions are the sanctioned dispatch channel and are
+    # exempt here (R9 still treats their closures as thread-side).
+    sink_uids = {
+        fn.uid for fn in graph.funcs.values()
+        if _has_dispatch_decorator(fn.node)
+    }
+    sink_names = {
+        fn.uid: fn.qualname for fn in graph.funcs.values()
+        if fn.uid in sink_uids
+    }
+    for spawner, call, kind, entry in graph.thread_entries():
+        if kind == "pipe_submit":
+            continue
+        m = spawner.module
         if any(m.relpath.endswith(a) for a in THREAD_ENTRY_ALLOW):
             continue
-        for node in ast.walk(m.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            cname = _name_of_call(node.func)
-            target: Optional[ast.AST] = None
-            what = ""
-            if cname == "Thread":
-                for kw in node.keywords:
-                    if kw.arg == "target":
-                        target = kw.value
-                        what = "threading.Thread target"
-            elif cname == "ASyncBuffer":
-                if node.args:
-                    target = node.args[0]
-                    what = "ASyncBuffer fill action"
-                for kw in node.keywords:
-                    if kw.arg == "fill_buffer_action":
-                        target = kw.value
-                        what = "ASyncBuffer fill action"
-            if target is None:
-                continue
-            # resolve the entry function in this module
-            entries: List[ast.AST] = []
-            tname = ""
-            if isinstance(target, ast.Name):
-                tname = target.id
-                entries = [fn for _c, fn in m.functions.get(tname, ())]
-            elif isinstance(target, ast.Attribute):
-                tname = target.attr
-                entries = [fn for _c, fn in m.functions.get(tname, ())]
-            elif isinstance(target, ast.Lambda):
-                tname = "<lambda>"
-                entries = [target]
-            if not entries:
-                continue
-            hit = _reach(m, entries) & graph_sinks
-            if hit:
-                findings.append(Finding(
-                    "R1", m.relpath, node.lineno,
-                    f"{what} {tname!r} can reach collective dispatch "
-                    f"{sorted(hit)} off the comms/training thread",
-                    "route the collective through the PS comms TaskPipe "
-                    "(pipe.submit) or wrap a documented sync point in "
-                    "allow_collective_dispatch(reason)",
-                ))
+        what = "threading.Thread target" if kind == "thread_target" \
+            else "ASyncBuffer fill action"
+        hit = _graph_reach_sinks(graph, entry, sink_uids, rules_spmd)
+        if hit:
+            names = sorted({sink_names[u] for u in hit})
+            findings.append(Finding(
+                "R1", m.relpath, call.lineno,
+                f"{what} {entry.qualname!r} can reach collective "
+                f"dispatch {names} off the comms/training thread",
+                "route the collective through the PS comms TaskPipe "
+                "(pipe.submit) or wrap a documented sync point in "
+                "allow_collective_dispatch(reason)",
+            ))
     return findings
+
+
+rule_r1_collective_dispatch.needs_graph = True
+
+
+def _graph_reach_sinks(graph, entry, sink_uids, rules_spmd) -> Set[int]:
+    """Sinks reachable from ``entry`` over the call graph, skipping
+    calls lexically inside ``with allow_collective_dispatch(...)``
+    blocks (the documented sync-point escape hatch)."""
+    hits: Set[int] = set()
+    seen: Set[int] = set()
+    stack = [entry]
+    while stack:
+        fn = stack.pop()
+        if fn.uid in seen:
+            continue
+        seen.add(fn.uid)
+        if fn.uid in sink_uids:
+            hits.add(fn.uid)
+            continue  # the decorated entry re-checks at runtime anyway
+        allowed = rules_spmd.allow_region_node_ids(graph, fn)
+        for call, resolved in graph.calls_in(fn):
+            if id(call) in allowed:
+                continue
+            stack.extend(resolved)
+    return hits
 
 
 # ------------------------------------------------------------------- R2
@@ -724,10 +705,16 @@ def rule_r5_exact_paths(
     return findings
 
 
+from multiverso_tpu.analysis import rules_spmd as _spmd  # noqa: E402
+
 ALL_RULES = (
     rule_r1_collective_dispatch,
     rule_r2_lock_order,
     rule_r3_flag_hygiene,
     rule_r4_thread_lifecycle,
     rule_r5_exact_paths,
+    _spmd.rule_r6_rank_divergent_collective,
+    _spmd.rule_r7_donation_aliasing,
+    _spmd.rule_r8_retrace_churn,
+    _spmd.rule_r9_cross_thread_state,
 )

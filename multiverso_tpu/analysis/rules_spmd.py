@@ -1,0 +1,1087 @@
+"""mvlint rules R6-R9 — the flow-sensitive SPMD/JAX rule pack.
+
+Each rule here is ``(modules, config, graph) -> [Finding]`` and carries
+``needs_graph = True``: the driver builds one
+:class:`~multiverso_tpu.analysis.dataflow.ProjectGraph` per run and
+hands it to every rule in this module. The four rules are the static
+halves of bugs this repo has already paid for at runtime:
+
+* **R6 rank-divergent-collective** — a call that can reach a collective
+  (an ``@collective_dispatch`` entry point, a ``parallel/collectives``
+  op, or a raw ``multihost_utils`` barrier) *inside a branch conditioned
+  on the process rank*. Every rank must execute the identical collective
+  sequence; ``if rank == 0: table.store(...)`` deadlocks ranks 1..n-1
+  (the PR 6 incident class, generalized across calls).
+* **R7 donation-aliasing** — a value handed to a ``donate_argnums``
+  jitted callable (or ``device_put(..., donate=True)``) whose prior
+  binding is read afterwards. Donated buffers are invalidated in place;
+  the PR 5 zero-copy snapshot served garbage exactly this way.
+* **R8 retrace-churn** — ``jax.jit`` constructed inside a loop, a
+  per-round loop variable reaching a *static* jit argument, or argument
+  shapes derived from the loop variable: each one recompiles every
+  iteration (the PR 7 compile-cache churn class). A varying Python
+  scalar at a *dynamic* position is fine — jax caches on
+  shape/dtype/weak_type, not value — and is deliberately not flagged.
+* **R9 unguarded-cross-thread-state** — ``self.X`` state with a
+  read-modify-write on a thread path (``Thread`` target, ``ASyncBuffer``
+  fill action, ``TaskPipe``-submitted closure) and any access from
+  training-thread code, with no common lock on both sides. Single-store
+  publication (``self._ready = True``) is GIL-atomic and stays legal;
+  what fires is the lost-update shape the four hand-named runtime-
+  guarded locks exist to prevent.
+
+Approximations are documented per-rule in analysis/RULES.md; each errs
+toward the runtime guards (:mod:`multiverso_tpu.analysis.guards`)
+catching what static analysis cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from multiverso_tpu.analysis.mvlint import Finding, LintConfig, Module
+from multiverso_tpu.analysis.dataflow import (
+    SYNC_PRIMITIVE_TYPES,
+    ClassInfo,
+    FuncInfo,
+    ProjectGraph,
+    call_name,
+    receiver_of,
+)
+
+__all__ = [
+    "rule_r6_rank_divergent_collective",
+    "rule_r7_donation_aliasing",
+    "rule_r8_retrace_churn",
+    "rule_r9_cross_thread_state",
+    "allow_region_node_ids",
+    "SpmdFacts",
+]
+
+# ------------------------------------------------------- shared helpers
+
+import re as _re
+
+_LOCK_ATTR_RE = _re.compile(r"lock|mutex|_mu$|_cv$")
+
+# jax collective/barrier entry points that live OUTSIDE the scanned tree
+# but still block until every process arrives
+EXTERNAL_COLLECTIVE_NAMES = {
+    "sync_global_devices", "broadcast_one_to_all", "process_allgather",
+    "assert_equal", "psum", "pmean", "pmax", "pmin", "all_gather",
+    "all_to_all", "ppermute", "host_local_array_to_global_array",
+    "global_array_to_host_local_array",
+}
+
+# rank-valued call/attribute spellings (jax.process_index(), runtime
+# helpers, coordinator predicates)
+_RANK_CALL_NAMES = {"process_index", "is_coordinator"}
+_RANK_ATTR_NAMES = {"rank", "_rank", "process_index"}
+_RANK_BARE_NAMES = {"rank", "is_coordinator"}
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _dotted_text(node: ast.AST) -> str:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _has_dispatch_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if call_name(target) == "collective_dispatch":
+            return True
+    return False
+
+
+def allow_region_node_ids(graph: ProjectGraph, fn: FuncInfo) -> Set[int]:
+    """ids of every node lexically under a
+    ``with allow_collective_dispatch(...)`` block in ``fn`` — the
+    sanctioned sync-point escape hatch R1 and R6 both honor."""
+    out: Set[int] = set()
+    for node in graph.own_nodes(fn):
+        if not isinstance(node, ast.With):
+            continue
+        if not any(
+            isinstance(item.context_expr, ast.Call)
+            and call_name(item.context_expr.func)
+            == "allow_collective_dispatch"
+            for item in node.items
+        ):
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                out.add(id(sub))
+    return out
+
+
+class SpmdFacts:
+    """Derived whole-program facts shared by R6-R9, computed lazily and
+    cached on the graph (one graph per lint run)."""
+
+    def __init__(self, graph: ProjectGraph):
+        self.graph = graph
+        self._collective_reachers: Optional[Set[int]] = None
+        self._thread_uids: Optional[Set[int]] = None
+        self._main_uids: Optional[Set[int]] = None
+        self._entries: Optional[List[Tuple[FuncInfo, ast.Call, str, FuncInfo]]] = None
+
+    # -- collectives ---------------------------------------------------
+
+    def collective_sink_uids(self) -> Set[int]:
+        g = self.graph
+        sinks: Set[int] = set()
+        for fn in list(g.funcs.values()):
+            node = fn.node
+            if _has_dispatch_decorator(node):
+                sinks.add(fn.uid)
+                continue
+            if fn.module.relpath.endswith(
+                "multiverso_tpu/parallel/collectives.py"
+            ) and not fn.name.startswith("_"):
+                sinks.add(fn.uid)
+                continue
+            for n in g.own_nodes(fn):
+                if isinstance(n, ast.Call) and call_name(n.func) in \
+                        EXTERNAL_COLLECTIVE_NAMES:
+                    sinks.add(fn.uid)
+                    break
+        return sinks
+
+    def collective_reachers(self) -> Set[int]:
+        """uids of every function from which a collective is reachable."""
+        if self._collective_reachers is None:
+            self._collective_reachers = self.graph.reachers_of(
+                self.collective_sink_uids()
+            )
+        return self._collective_reachers
+
+    # -- thread sides --------------------------------------------------
+
+    def thread_entries(self):
+        if self._entries is None:
+            self._entries = self.graph.thread_entries()
+        return self._entries
+
+    def thread_uids(self) -> Set[int]:
+        """Everything reachable from a thread entry (the entry's code
+        runs OFF the spawning thread)."""
+        if self._thread_uids is None:
+            self._thread_uids = self.graph.reachable_set(
+                entry for _fn, _call, _kind, entry in self.thread_entries()
+            )
+        return self._thread_uids
+
+    def main_uids(self) -> Set[int]:
+        """Everything reachable without crossing a thread spawn: roots
+        are all functions that are not already thread-side. A helper
+        called from BOTH (``poll_once`` from the fleet watch thread and
+        from ``wait_ready`` on main) lands in both sets — that is the
+        dual-use shape R9 exists for."""
+        if self._main_uids is None:
+            tuids = self.thread_uids()
+            roots = [
+                fn for fn in self.graph.funcs.values()
+                if fn.uid not in tuids
+            ]
+            self._main_uids = self.graph.reachable_set(roots)
+        return self._main_uids
+
+
+def spmd_facts(graph: ProjectGraph) -> SpmdFacts:
+    facts = getattr(graph, "_spmd_facts", None)
+    if facts is None:
+        facts = SpmdFacts(graph)
+        graph._spmd_facts = facts
+    return facts
+
+
+def _iter_funcs(graph: ProjectGraph,
+                modules: Sequence[Module]) -> List[FuncInfo]:
+    """FuncInfos belonging to the linted module set, def-ordered."""
+    rels = {m.relpath for m in modules}
+    return [
+        fn for fn in graph.funcs.values()
+        if fn.module.relpath in rels
+        and not isinstance(fn.node, ast.Lambda)
+    ]
+
+
+# ------------------------------------------------------------------- R6
+
+def _rank_tainted_names(graph: ProjectGraph, fn: FuncInfo) -> Set[str]:
+    """Local names bound (directly) to a rank value: ``rank =
+    jax.process_index()``, tuple-aligned where possible."""
+    tainted: Set[str] = set()
+
+    def value_is_rank(val: ast.AST) -> bool:
+        for n in ast.walk(val):
+            if isinstance(n, ast.Call) and call_name(n.func) in \
+                    _RANK_CALL_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _RANK_ATTR_NAMES:
+                return True
+        return False
+
+    for node in graph.own_nodes(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        if isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple) \
+                and len(tgt.elts) == len(val.elts):
+            # rank, world = process_index(), process_count(): taint only
+            # the aligned element — ``world`` must NOT become rank-ish
+            for t, v in zip(tgt.elts, val.elts):
+                if isinstance(t, ast.Name) and value_is_rank(v):
+                    tainted.add(t.id)
+        elif isinstance(tgt, ast.Name) and value_is_rank(val):
+            tainted.add(tgt.id)
+    return tainted
+
+
+def _test_is_rank_conditioned(test: ast.AST, tainted: Set[str]) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call) and call_name(n.func) in \
+                _RANK_CALL_NAMES:
+            return True
+        if isinstance(n, ast.Name) and (
+            n.id in tainted or n.id in _RANK_BARE_NAMES
+        ):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_ATTR_NAMES:
+            return True
+    return False
+
+
+def _own_blocks(graph: ProjectGraph,
+                fn: FuncInfo) -> Iterable[List[ast.stmt]]:
+    """Every statement list lexically owned by ``fn`` (not descending
+    into nested indexed defs)."""
+
+    def rec(stmts: List[ast.stmt]) -> Iterable[List[ast.stmt]]:
+        yield stmts
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(s) in graph.funcs:
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(s, field, None)
+                if sub:
+                    yield from rec(sub)
+            for h in getattr(s, "handlers", ()):
+                yield from rec(h.body)
+
+    body = getattr(fn.node, "body", None)
+    if isinstance(body, list):
+        yield from rec(body)
+
+
+def _terminates(stmts: List[ast.stmt]) -> bool:
+    return any(
+        isinstance(s, (ast.Return, ast.Raise, ast.Break, ast.Continue))
+        for s in stmts
+    )
+
+
+def rule_r6_rank_divergent_collective(
+    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
+) -> List[Finding]:
+    facts = spmd_facts(graph)
+    reach = facts.collective_reachers()
+    findings: List[Finding] = []
+    for fn in _iter_funcs(graph, modules):
+        tainted = _rank_tainted_names(graph, fn)
+        allowed = allow_region_node_ids(graph, fn)
+        regions: List[Tuple[int, List[ast.stmt]]] = []
+        for block in _own_blocks(graph, fn):
+            for i, stmt in enumerate(block):
+                if not isinstance(stmt, ast.If):
+                    continue
+                if not _test_is_rank_conditioned(stmt.test, tainted):
+                    continue
+                regions.append((stmt.lineno, stmt.body))
+                if stmt.orelse:
+                    regions.append((stmt.lineno, stmt.orelse))
+                elif _terminates(stmt.body):
+                    # ``if rank != 0: return`` — everything after the
+                    # guard runs on one side of the rank split too
+                    rest = block[i + 1:]
+                    if rest:
+                        regions.append((stmt.lineno, rest))
+        if not regions:
+            continue
+        seen: Set[int] = set()
+        for guard_line, stmts in regions:
+            for stmt in stmts:
+                for call, hits in graph.calls_in(fn, stmt):
+                    if id(call) in seen or id(call) in allowed:
+                        continue
+                    target = ""
+                    if any(h.uid in reach for h in hits):
+                        target = " / ".join(sorted(
+                            h.qualname for h in hits if h.uid in reach
+                        ))
+                    elif call_name(call.func) in EXTERNAL_COLLECTIVE_NAMES:
+                        target = call_name(call.func)
+                    if not target:
+                        continue
+                    seen.add(id(call))
+                    findings.append(Finding(
+                        "R6", fn.module.relpath, call.lineno,
+                        f"collective {target} is reachable inside a "
+                        f"rank-conditioned branch (guard at line "
+                        f"{guard_line}) — ranks that skip the branch "
+                        "never post the matching collective "
+                        "(SPMD desync/deadlock)",
+                        "hoist the collective above the rank gate (the "
+                        "store()/quorum idiom: every rank gathers, only "
+                        "rank 0 touches the filesystem), or wrap a "
+                        "documented sync point in "
+                        "allow_collective_dispatch(reason)",
+                    ))
+    return findings
+
+
+rule_r6_rank_divergent_collective.needs_graph = True
+
+
+# ------------------------------------------------------------------- R7
+
+def _donate_spec(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    """(positions, argnames) donated by a jit/pjit construction call."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums.extend(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                )
+        elif kw.arg == "donate_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names.extend(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+    return tuple(nums), tuple(names)
+
+
+def _static_spec(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums.extend(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                )
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.append(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names.extend(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+    return tuple(nums), tuple(names)
+
+
+class _JitRegistry:
+    """Where jitted callables live: ``self.X = jax.jit(...)`` class
+    attributes, ``fn = jax.jit(...)`` locals, decorated defs, and
+    helpers that *return* a jitted callable. Each entry carries its
+    donate and static specs."""
+
+    def __init__(self, graph: ProjectGraph, modules: Sequence[Module]):
+        self.graph = graph
+        # (module relpath, class, attr) -> spec
+        self.attr: Dict[Tuple[str, str, str], Tuple] = {}
+        # (fn uid, local name) -> spec
+        self.local: Dict[Tuple[int, str], Tuple] = {}
+        # def uid -> spec (decorated with @partial(jit, ...))
+        self.direct: Dict[int, Tuple] = {}
+        # helper uid -> spec (returns a jitted callable)
+        self.returns: Dict[int, Tuple] = {}
+        self._build(modules)
+
+    def _build(self, modules: Sequence[Module]) -> None:
+        g = self.graph
+        rels = {m.relpath for m in modules}
+        for fn in g.funcs.values():
+            if fn.module.relpath not in rels:
+                continue
+            # decorators: @partial(jax.jit, ...) / @jax.jit
+            for dec in getattr(fn.node, "decorator_list", ()):
+                if isinstance(dec, ast.Call):
+                    if call_name(dec.func) == "partial" and dec.args and \
+                            call_name(dec.args[0]) in _JIT_NAMES:
+                        self.direct[fn.uid] = self._spec_of(dec)
+                    elif call_name(dec.func) in _JIT_NAMES:
+                        self.direct[fn.uid] = self._spec_of(dec)
+            if isinstance(fn.node, ast.Lambda):
+                continue
+            jit_locals: Dict[str, Tuple] = {}
+            for node in g.own_nodes(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                    spec = self._jit_value_spec(val)
+                    if spec is None:
+                        continue
+                    if isinstance(tgt, ast.Name):
+                        jit_locals[tgt.id] = spec
+                        self.local[(fn.uid, tgt.id)] = spec
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self" and fn.cls:
+                        self.attr[
+                            (fn.module.relpath, fn.cls, tgt.attr)
+                        ] = spec
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    spec = self._jit_value_spec(node.value)
+                    if spec is None and isinstance(node.value, ast.Name):
+                        spec = jit_locals.get(node.value.id)
+                    if spec is not None:
+                        self.returns[fn.uid] = spec
+
+    @staticmethod
+    def _spec_of(call: ast.Call) -> Tuple:
+        return _donate_spec(call) + _static_spec(call)
+
+    def _jit_value_spec(self, val: ast.AST) -> Optional[Tuple]:
+        if isinstance(val, ast.Call) and call_name(val.func) in _JIT_NAMES:
+            return self._spec_of(val)
+        return None
+
+    def spec_for_call(self, fn: FuncInfo,
+                      call: ast.Call) -> Optional[Tuple]:
+        """Donate/static spec when ``call`` invokes a known jitted
+        callable; None otherwise."""
+        func = call.func
+        if isinstance(func, ast.Call):
+            # helper()(args): helper returns a jitted callable
+            for hit in self.graph._resolve_name_or_attr(fn, func.func):
+                spec = self.returns.get(hit.uid)
+                if spec is not None:
+                    return spec
+            if call_name(func.func) in _JIT_NAMES:
+                return self._spec_of(func)  # jax.jit(f)(args) inline
+            return None
+        if isinstance(func, ast.Name):
+            # walk the closure chain for the binding
+            cur: Optional[FuncInfo] = fn
+            while cur is not None:
+                spec = self.local.get((cur.uid, func.id))
+                if spec is not None:
+                    return spec
+                cur = self.graph.funcs.get(
+                    self.graph._parent.get(cur.uid, -1)
+                )
+        elif isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ) and func.value.id == "self" and fn.cls:
+            ci = self.graph.class_of_func(fn)
+            if ci is not None:
+                search = [ci] + self.graph._base_infos.get(
+                    (ci.module.relpath, ci.name), []
+                )
+                for c in search:
+                    spec = self.attr.get(
+                        (c.module.relpath, c.name, func.attr)
+                    )
+                    if spec is not None:
+                        return spec
+        for hit in self.graph._resolve_name_or_attr(fn, func):
+            spec = self.direct.get(hit.uid)
+            if spec is not None:
+                return spec
+        return None
+
+
+def _r7_donated_exprs(reg: _JitRegistry, fn: FuncInfo,
+                      call: ast.Call) -> List[str]:
+    """Texts of the value bindings this call donates."""
+    out: List[str] = []
+    spec = reg.spec_for_call(fn, call)
+    if spec is not None:
+        dnums, dnames = spec[0], spec[1]
+        for p in dnums:
+            if p < len(call.args) and not isinstance(
+                call.args[p], ast.Starred
+            ):
+                t = _dotted_text(call.args[p])
+                if t:
+                    out.append(t)
+        for kw in call.keywords:
+            if kw.arg in dnames:
+                t = _dotted_text(kw.value)
+                if t:
+                    out.append(t)
+    if call_name(call.func) == "device_put":
+        donate = any(
+            kw.arg == "donate" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in call.keywords
+        )
+        if donate and call.args:
+            t = _dotted_text(call.args[0])
+            if t:
+                out.append(t)
+    return out
+
+
+def rule_r7_donation_aliasing(
+    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
+) -> List[Finding]:
+    reg = _JitRegistry(graph, modules)
+    findings: List[Finding] = []
+    for fn in _iter_funcs(graph, modules):
+        # donating calls + every load/store of interesting texts. A call
+        # nested under an If shows up while walking both the If and its
+        # inner statement — keep the INNERMOST statement (blocks iterate
+        # outer-first, so later matches are deeper) and dedup the call.
+        don_stmt: Dict[int, ast.stmt] = {}
+        don_call: Dict[int, Tuple[ast.Call, List[str]]] = {}
+        for block in _own_blocks(graph, fn):
+            for stmt in block:
+                for node in graph.own_nodes(fn, stmt):
+                    if isinstance(node, ast.Call):
+                        texts = _r7_donated_exprs(reg, fn, node)
+                        if texts:
+                            don_stmt[id(node)] = stmt
+                            don_call[id(node)] = (node, texts)
+        donations = [
+            (call, text, don_stmt[cid])
+            for cid, (call, texts) in don_call.items()
+            for text in dict.fromkeys(texts)
+        ]
+        if not donations:
+            continue
+        loads: List[Tuple[str, int, ast.AST]] = []
+        stores: List[Tuple[str, int]] = []
+        texts = {t for _c, t, _s in donations}
+        for node in graph.own_nodes(fn):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                t = _dotted_text(node)
+                if t not in texts:
+                    continue
+                ctx = getattr(node, "ctx", None)
+                if isinstance(ctx, ast.Store):
+                    stores.append((t, node.lineno))
+                elif isinstance(ctx, ast.Load):
+                    loads.append((t, node.lineno, node))
+        for call, text, stmt in donations:
+            # rebinding at the donation statement itself
+            # (``self.storage = fn(self.storage, ...)`` — also through a
+            # tuple target like ``self.W, loss = step(self.W, ...)``) is
+            # the sanctioned idiom: post-donation reads get the new value
+            flat_targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        flat_targets.extend(t.elts)
+                    else:
+                        flat_targets.append(t)
+            rebound_here = any(
+                _dotted_text(t) == text for t in flat_targets
+            )
+            inside_call = {id(n) for n in ast.walk(call)}
+            first_kill = min(
+                (ln for t, ln in stores
+                 if t == text and ln > call.lineno
+                 and not (rebound_here and ln == stmt.lineno)),
+                default=None,
+            )
+            if rebound_here:
+                # safe unless another read sneaks in before a later use
+                continue
+            # loop back-edge: donation inside a loop with no rebinding
+            # anywhere in the loop — iteration 2 feeds the call a
+            # buffer iteration 1 already invalidated (the call's own
+            # argument load is excluded from the forward scan, so this
+            # case needs its own check)
+            loop = _enclosing_loop(fn, graph, call)
+            if loop is not None and not any(
+                t == text and _contains(loop, ln)
+                for t, ln in stores
+            ):
+                findings.append(Finding(
+                    "R7", fn.module.relpath, call.lineno,
+                    f"{text!r} is donated here and re-read on the next "
+                    "loop iteration without being rebound — the buffer "
+                    "is invalidated after the first pass",
+                    "rebind the donated value from the call's result "
+                    f"({text} = fn({text}, ...)), the zero-copy "
+                    "snapshot idiom from the PR 5 fix",
+                ))
+                continue
+            offenders = [
+                (t, ln) for t, ln, node in loads
+                if t == text and ln > call.lineno
+                and (first_kill is None or ln <= first_kill)
+                and id(node) not in inside_call
+            ]
+            if offenders:
+                ln = min(ln for _t, ln in offenders)
+                findings.append(Finding(
+                    "R7", fn.module.relpath, call.lineno,
+                    f"{text!r} is donated to a jitted call here but "
+                    f"read again at line {ln} — donated buffers are "
+                    "invalidated in place (the PR 5 snapshot-aliasing "
+                    "class)",
+                    "rebind the name from the call's result before any "
+                    "further read, or drop it from donate_argnums",
+                ))
+    return findings
+
+
+rule_r7_donation_aliasing.needs_graph = True
+
+
+def _enclosing_loop(fn: FuncInfo, graph: ProjectGraph,
+                    target: ast.AST) -> Optional[ast.AST]:
+    """Innermost For/While in ``fn`` lexically containing ``target``."""
+    best: Optional[ast.AST] = None
+    for node in graph.own_nodes(fn):
+        if isinstance(node, (ast.For, ast.While)):
+            if any(sub is target for sub in ast.walk(node)):
+                if best is None or any(
+                    s is node for s in ast.walk(best)
+                ):
+                    best = node
+    return best
+
+
+def _contains(root: ast.AST, line: int) -> bool:
+    end = getattr(root, "end_lineno", None)
+    return root.lineno <= line <= (end if end is not None else line)
+
+
+# ------------------------------------------------------------------- R8
+
+def _loop_tainted_names(graph: ProjectGraph, fn: FuncInfo) -> Set[str]:
+    """Loop variables plus one step of derived assignments."""
+    tainted: Set[str] = set()
+    for node in graph.own_nodes(fn):
+        if isinstance(node, ast.For):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    tainted.add(t.id)
+    for node in graph.own_nodes(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            if any(
+                isinstance(n, ast.Name) and n.id in tainted
+                for n in ast.walk(node.value)
+            ):
+                tainted.add(node.targets[0].id)
+    return tainted
+
+
+_SHAPE_CTORS = {"arange", "zeros", "ones", "empty", "full", "linspace"}
+
+
+def _expr_mentions(expr: ast.AST, names: Set[str]) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in names
+        for n in ast.walk(expr)
+    )
+
+
+def _shape_churn(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does this argument's SHAPE vary with a loop variable? (slices
+    with tainted bounds, arange/zeros-style ctors with tainted sizes)"""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Subscript) and isinstance(n.slice, ast.Slice):
+            for bound in (n.slice.lower, n.slice.upper, n.slice.step):
+                if bound is not None and _expr_mentions(bound, tainted):
+                    return True
+        elif isinstance(n, ast.Call) and call_name(n.func) in \
+                _SHAPE_CTORS:
+            if any(_expr_mentions(a, tainted) for a in n.args):
+                return True
+    return False
+
+
+def rule_r8_retrace_churn(
+    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
+) -> List[Finding]:
+    reg = _JitRegistry(graph, modules)
+    findings: List[Finding] = []
+    for fn in _iter_funcs(graph, modules):
+        tainted = _loop_tainted_names(graph, fn)
+        for node in graph.own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # (a) jit constructed inside a loop (fresh callable = fresh
+            # trace every iteration) — a Subscript store is a deliberate
+            # per-key compile cache and stays legal
+            if call_name(node.func) in _JIT_NAMES and \
+                    _enclosing_loop(fn, graph, node) is not None:
+                stmt = _stmt_of(fn, graph, node)
+                cached = isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Subscript) for t in stmt.targets
+                )
+                if not cached:
+                    findings.append(Finding(
+                        "R8", fn.module.relpath, node.lineno,
+                        "jax.jit constructed inside a loop — every "
+                        "iteration builds a fresh callable and "
+                        "retraces from scratch",
+                        "hoist the jit out of the loop, or store it in "
+                        "a keyed compile cache (self._compiled[key] = "
+                        "jax.jit(...)) like the tables do",
+                    ))
+                continue
+            spec = reg.spec_for_call(fn, node)
+            if spec is None:
+                continue
+            if _enclosing_loop(fn, graph, node) is None:
+                continue
+            _dn, _dm, snums, snames = spec
+            # (b) per-round loop variable at a STATIC position: every
+            # new value is a new cache key -> retrace per iteration
+            for p in snums:
+                if p < len(node.args) and _expr_mentions(
+                    node.args[p], tainted
+                ):
+                    findings.append(Finding(
+                        "R8", fn.module.relpath, node.lineno,
+                        f"loop-varying value at static_argnums position "
+                        f"{p} of a jitted call — each iteration is a "
+                        "new cache key and retraces (the PR 7 "
+                        "compile-churn class)",
+                        "pass round-varying values as dynamic (traced) "
+                        "arguments; keep static_argnums for genuinely "
+                        "fixed topology/config",
+                    ))
+            for kw in node.keywords:
+                if kw.arg in snames and _expr_mentions(kw.value, tainted):
+                    findings.append(Finding(
+                        "R8", fn.module.relpath, node.lineno,
+                        f"loop-varying value at static_argnames "
+                        f"{kw.arg!r} of a jitted call — each iteration "
+                        "is a new cache key and retraces",
+                        "pass round-varying values as dynamic (traced) "
+                        "arguments; keep static_argnames for genuinely "
+                        "fixed topology/config",
+                    ))
+            # (c) loop-varying argument SHAPES retrace at any position
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if _shape_churn(arg, tainted):
+                    findings.append(Finding(
+                        "R8", fn.module.relpath, node.lineno,
+                        "argument shape varies with the loop variable "
+                        "at a jitted call — every distinct shape "
+                        "retraces",
+                        "pad/bucket to a fixed shape before the jitted "
+                        "boundary (the round_bucket idiom), or mask "
+                        "inside the kernel",
+                    ))
+                    break
+    return findings
+
+
+rule_r8_retrace_churn.needs_graph = True
+
+
+def _stmt_of(fn: FuncInfo, graph: ProjectGraph,
+             target: ast.AST) -> Optional[ast.stmt]:
+    """INNERMOST statement owning ``target`` — blocks iterate
+    outer-first, so the last match is the deepest. Returning the first
+    match would hand R8 the enclosing ``For`` instead of the
+    ``cache[key] = jax.jit(...)`` assign and break the keyed-cache
+    exemption."""
+    best: Optional[ast.stmt] = None
+    for block in _own_blocks(graph, fn):
+        for stmt in block:
+            if any(n is target for n in graph.own_nodes(fn, stmt)):
+                best = stmt
+    return best
+
+
+# ------------------------------------------------------------------- R9
+
+class _Access:
+    __slots__ = ("attr", "kind", "line", "fn", "held")
+
+    def __init__(self, attr: str, kind: str, line: int, fn: FuncInfo,
+                 held: FrozenSet[str]):
+        self.attr = attr
+        self.kind = kind  # "read" | "write" | "aug"
+        self.line = line
+        self.fn = fn
+        self.held = held
+
+
+def _is_lock_attr(ci: Optional[ClassInfo], attr: str) -> bool:
+    if _LOCK_ATTR_RE.search(attr):
+        return True
+    if ci is not None and ci.attr_types.get(attr, set()) & \
+            SYNC_PRIMITIVE_TYPES:
+        return True
+    return False
+
+
+def _fn_accesses(graph: ProjectGraph, fn: FuncInfo,
+                 entry_held: FrozenSet[str]) -> Tuple[
+                     List[_Access], List[Tuple[int, FrozenSet[str]]]]:
+    """Self-attribute accesses in ``fn`` with the lock set lexically
+    held at each, plus (callee uid, held) pairs for one level of
+    caller-holds-the-lock propagation."""
+    ci = graph.class_of_func(fn)
+    accesses: List[_Access] = []
+    callsites: List[Tuple[int, FrozenSet[str]]] = []
+
+    def locks_of(with_node: ast.With) -> Set[str]:
+        out: Set[str] = set()
+        for item in with_node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func  # with self._lock.acquire_timeout(...)
+            if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name
+            ) and expr.value.id == "self" and _is_lock_attr(ci, expr.attr):
+                out.add(expr.attr)
+        return out
+
+    def rec(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn.node and id(node) in graph.funcs:
+            return
+        if isinstance(node, ast.With):
+            nh = held | frozenset(locks_of(node))
+            for item in node.items:
+                rec(item.context_expr, held)
+            for child in node.body:
+                rec(child, nh)
+            return
+        if isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Attribute
+        ) and isinstance(node.target.value, ast.Name) and \
+                node.target.value.id == "self":
+            accesses.append(_Access(
+                node.target.attr, "aug", node.lineno, fn, held
+            ))
+            rec(node.value, held)
+            return
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "self":
+            ctx = node.ctx
+            if isinstance(ctx, ast.Store):
+                accesses.append(_Access(
+                    node.attr, "write", node.lineno, fn, held
+                ))
+            elif isinstance(ctx, ast.Load):
+                accesses.append(_Access(
+                    node.attr, "read", node.lineno, fn, held
+                ))
+        if isinstance(node, ast.Call):
+            for hit in graph._resolve_name_or_attr(fn, node.func):
+                callsites.append((hit.uid, held))
+        for child in ast.iter_child_nodes(node):
+            rec(child, held)
+
+    rec(fn.node, entry_held)
+    return accesses, callsites
+
+
+def rule_r9_cross_thread_state(
+    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
+) -> List[Finding]:
+    facts = spmd_facts(graph)
+    tuids = facts.thread_uids()
+    muids = facts.main_uids()
+    fns = [
+        fn for fn in _iter_funcs(graph, modules)
+        if fn.cls and fn.name != "__del__"  # finalizers cannot race
+    ]
+    # @collective_dispatch is a *virtual lock*: the runtime guard pins
+    # every decorated entry point to one thread (GuardViolation on any
+    # other), so table state touched under it is serialized by
+    # construction — the decorator, not a Lock, is the synchronization
+    _DISPATCH_LOCK = "<collective_dispatch>"
+    # "caller holds the lock" propagation: a helper ALWAYS called with
+    # some lock held inherits it at entry. Must-analysis iterated to a
+    # fixpoint — entry_held[f] = ∩ over call sites of (locks lexically
+    # held at the site ∪ locks the caller itself entered with) — so the
+    # flush -> _ensure_resident -> _fill_slots chain resolves through
+    # any call depth. Starting from ∅ this converges from below, which
+    # is the conservative direction: a call cycle with an unlocked
+    # entry inherits nothing. __init__ call sites are excluded
+    # (happens-before any thread the object spawns).
+    per_fn: Dict[int, Tuple[List[_Access], List[Tuple[int, FrozenSet[str]]]]] = {}
+    sites: Dict[int, List[Tuple[int, FrozenSet[str]]]] = {}
+    for fn in fns:
+        base = frozenset({_DISPATCH_LOCK}) if \
+            _has_dispatch_decorator(fn.node) else frozenset()
+        per_fn[fn.uid] = _fn_accesses(graph, fn, base)
+        if fn.name == "__init__":
+            continue
+        for uid, held in per_fn[fn.uid][1]:
+            sites.setdefault(uid, []).append((fn.uid, held))
+    entry_held: Dict[int, Optional[FrozenSet[str]]] = {
+        uid: None for uid in sites  # None = TOP (no caller seen yet)
+    }
+    for _ in range(len(sites) + 1):
+        changed = False
+        for uid, callers in sites.items():
+            acc: Optional[FrozenSet[str]] = None
+            for caller_uid, lex_held in callers:
+                inherited = entry_held.get(caller_uid)
+                term = lex_held | (
+                    inherited if inherited is not None else frozenset()
+                )
+                acc = term if acc is None else (acc & term)
+            if acc != entry_held[uid]:
+                entry_held[uid] = acc
+                changed = True
+        if not changed:
+            break
+    # group accesses per class
+    by_class: Dict[Tuple[str, str], Dict[str, List[_Access]]] = {}
+    for fn in fns:
+        eh = entry_held.get(fn.uid) or frozenset()
+        accesses, _calls = per_fn[fn.uid]
+        if eh:
+            accesses = [
+                _Access(a.attr, a.kind, a.line, a.fn, a.held | eh)
+                for a in accesses
+            ]
+        ci = graph.class_of_func(fn)
+        if ci is None:
+            continue
+        bucket = by_class.setdefault(
+            (ci.module.relpath, ci.name), {}
+        )
+        for a in accesses:
+            # __init__ runs happens-before any thread this object spawns
+            if a.fn.name == "__init__" or _is_lock_attr(ci, a.attr):
+                continue
+            bucket.setdefault(a.attr, []).append(a)
+
+    findings: List[Finding] = []
+    for (relpath, clsname), attrs in sorted(by_class.items()):
+        for attr, accs in sorted(attrs.items()):
+            # a read AT OR BEFORE a write in the same function is a
+            # read-modify-write even without an AugAssign
+            # (``if self._n > k: self._n = 0``). Write-then-read-later
+            # is NOT (publication + use, e.g. setup building a cache
+            # it then consults).
+            rmw_fns: Set[int] = set()
+            first_read: Dict[int, int] = {}
+            for a in accs:
+                if a.kind == "aug":
+                    rmw_fns.add(a.fn.uid)
+                elif a.kind == "read":
+                    first_read[a.fn.uid] = min(
+                        first_read.get(a.fn.uid, a.line), a.line
+                    )
+            for a in accs:
+                if a.kind == "write" and \
+                        first_read.get(a.fn.uid, a.line + 1) <= a.line:
+                    rmw_fns.add(a.fn.uid)
+
+            def side(a: _Access) -> Tuple[bool, bool]:
+                return a.fn.uid in tuids, a.fn.uid in muids
+
+            writes = [
+                a for a in accs
+                if a.kind in ("write", "aug") and a.fn.name != "__init__"
+            ]
+            if not writes:
+                continue
+            # Writer-serialized publication: every write — and every
+            # read inside a fn that also writes the attr (the reads
+            # that make a check-then-act) — holds one common lock.
+            # Whatever accesses remain lock-free are pure reads in
+            # reader-only fns: single reference loads of a published
+            # value, atomic under the GIL (the TableServer._snapshot
+            # swap pattern). A broken double-checked lazy-init does
+            # NOT qualify — its lock-free check read lives in a
+            # writer fn and empties the intersection.
+            writer_uids = {a.fn.uid for a in writes}
+            guard_accs = writes + [
+                a for a in accs
+                if a.kind == "read" and a.fn.uid in writer_uids
+            ]
+            if frozenset.intersection(*(a.held for a in guard_accs)):
+                continue
+            t_rmw = [
+                a for a in writes
+                if side(a)[0] and (a.kind == "aug" or a.fn.uid in rmw_fns)
+            ]
+            m_rmw = [
+                a for a in writes
+                if side(a)[1] and (a.kind == "aug" or a.fn.uid in rmw_fns)
+            ]
+            t_acc = [a for a in accs if side(a)[0]]
+            m_acc = [a for a in accs if side(a)[1]]
+            t_w = [a for a in writes if side(a)[0]]
+            m_w = [a for a in writes if side(a)[1]]
+
+            conflict: Optional[Tuple[_Access, List[_Access], str]] = None
+            if t_rmw and m_acc:
+                conflict = (t_rmw[0], m_acc,
+                            "read-modify-write on a thread path")
+            elif m_rmw and t_acc:
+                conflict = (m_rmw[0], t_acc,
+                            "read-modify-write racing a thread-path "
+                            "access")
+            elif any(
+                w1.line != w2.line for w1 in t_w for w2 in m_w
+            ):
+                conflict = (t_w[0], m_w,
+                            "written from both a thread path and "
+                            "training-thread code")
+            if conflict is None:
+                continue
+            anchor, others, why = conflict
+            involved = [anchor] + [a for a in others if a is not anchor]
+            common = frozenset.intersection(
+                *(a.held for a in involved)
+            ) if involved else frozenset()
+            if common:
+                continue  # a shared lock guards every involved access
+            other_fns = sorted({
+                a.fn.qualname for a in others if a.fn is not anchor.fn
+            }) or [anchor.fn.qualname]
+            findings.append(Finding(
+                "R9", relpath, anchor.line,
+                f"{clsname}.{attr}: {why} "
+                f"({anchor.fn.qualname}, line {anchor.line}) with "
+                f"unsynchronized access from {', '.join(other_fns)} — "
+                "no common lock covers both sides",
+                "guard every access with one OrderedLock attribute "
+                "held on both paths (single-assignment publication "
+                "needs none; counters and check-then-set do)",
+            ))
+    return findings
+
+
+rule_r9_cross_thread_state.needs_graph = True

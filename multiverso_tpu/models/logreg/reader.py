@@ -102,6 +102,9 @@ class SampleReader:
             CHECK(self.sparse, "bsparse reader requires sparse=true")
         self.files = [f for f in str(config.train_file).split(";") if f]
         self._truncation_warned = False
+        # _batch_of runs on the async produce thread AND foreground
+        # iter_batches: warn-once is a check-then-set (mvlint R9)
+        self._warn_lock = threading.Lock()
 
     # -- sample iteration -------------------------------------------------
 
@@ -180,13 +183,16 @@ class SampleReader:
         touched = set()
         for i, s in enumerate(samples):
             k = min(len(s.keys), max_keys)
-            if len(s.keys) > max_keys and not self._truncation_warned:
-                Log.Error(
-                    "[SampleReader] sample has %d features, truncating to "
-                    "max_sparse_features=%d (raise it in the config)",
-                    len(s.keys), max_keys,
-                )
-                self._truncation_warned = True
+            if len(s.keys) > max_keys:
+                with self._warn_lock:
+                    if not self._truncation_warned:
+                        Log.Error(
+                            "[SampleReader] sample has %d features, "
+                            "truncating to max_sparse_features=%d (raise "
+                            "it in the config)",
+                            len(s.keys), max_keys,
+                        )
+                        self._truncation_warned = True
             idx[i, :k] = s.keys[:k]
             val[i, :k] = s.values[:k]
             touched.update(s.keys[:k].tolist())

@@ -313,32 +313,52 @@ class _PSCommsStats:
         with self._lock:
             self.wall_s = seconds
 
-    def overlap_pct(self) -> float:
+    @staticmethod
+    def _overlap_pct(pull_s: float, train_s: float, push_s: float,
+                     wall_s: float) -> float:
         """How much of the serialized stage time the pipeline hid:
         ``(sum(stages) - wall) / sum(stages)``. 0 when the stages ran
         strictly back to back (the sync path's shape), higher the more
         pull/push rode under training."""
-        stages = self.pull_s + self.train_s + self.push_s
-        if stages <= 0 or self.wall_s <= 0:
+        stages = pull_s + train_s + push_s
+        if stages <= 0 or wall_s <= 0:
             return 0.0
-        return max(0.0, 100.0 * (stages - self.wall_s) / stages)
+        return max(0.0, 100.0 * (stages - wall_s) / stages)
+
+    def overlap_pct(self) -> float:
+        with self._lock:
+            return self._overlap_pct(
+                self.pull_s, self.train_s, self.push_s, self.wall_s
+            )
 
     def to_dict(self) -> Dict[str, float]:
-        r = max(self.rounds, 1)
-        row_b = self.dim * 4
-        return {
-            "rounds": self.rounds,
-            "pull_ms_per_round": round(1e3 * self.pull_s / r, 3),
-            "train_ms_per_round": round(1e3 * self.train_s / r, 3),
-            "push_ms_per_round": round(1e3 * self.push_s / r, 3),
-            "overlap_pct": round(self.overlap_pct(), 1),
-            "pull_bytes_dense_per_round": round(
-                self.pull_rows_dense * row_b / r, 1
-            ),
-            "pull_bytes_wire_per_round": round(self.pull_bytes_wire / r, 1),
-            "push_bytes_dense_per_round": round(self.push_bytes_dense / r, 1),
-            "push_bytes_wire_per_round": round(self.push_bytes_wire / r, 1),
-        }
+        with self._lock:
+            # comms + training threads both record: snapshot under the
+            # same lock the writers hold (mvlint R9)
+            rounds = self.rounds
+            r = max(rounds, 1)
+            row_b = self.dim * 4
+            return {
+                "rounds": rounds,
+                "pull_ms_per_round": round(1e3 * self.pull_s / r, 3),
+                "train_ms_per_round": round(1e3 * self.train_s / r, 3),
+                "push_ms_per_round": round(1e3 * self.push_s / r, 3),
+                "overlap_pct": round(self._overlap_pct(
+                    self.pull_s, self.train_s, self.push_s, self.wall_s
+                ), 1),
+                "pull_bytes_dense_per_round": round(
+                    self.pull_rows_dense * row_b / r, 1
+                ),
+                "pull_bytes_wire_per_round": round(
+                    self.pull_bytes_wire / r, 1
+                ),
+                "push_bytes_dense_per_round": round(
+                    self.push_bytes_dense / r, 1
+                ),
+                "push_bytes_wire_per_round": round(
+                    self.push_bytes_wire / r, 1
+                ),
+            }
 
     def lines(self) -> list:
         d = self.to_dict()
@@ -362,6 +382,14 @@ class _PSCommsStats:
 class WordEmbedding:
     def __init__(self, options: WEOptions, dictionary: Optional[Dictionary] = None):
         self.opt = options
+        from multiverso_tpu.analysis.guards import OrderedLock
+
+        # leaf lock for the PS progress counters (_wc_cum,
+        # _ps_global_pairs, _ps_push_entered, _ps_rounds_pushed): the
+        # comms pipe thread commits rounds while the training thread
+        # reads them for lr/checkpoint/containment (mvlint R9). No calls
+        # run under it, so it cannot participate in an R2 inversion.
+        self._ps_state_lock = OrderedLock("we._ps_state_lock")
         CHECK(options.train_file or dictionary is not None,
               "need -train_file or a prebuilt dictionary")
         if dictionary is None:
@@ -719,12 +747,14 @@ class WordEmbedding:
         ))
         self._wc_bucket = max(2, self._t_wc.num_workers // nproc)
         self._wc_row_ids = np.arange(2 * nproc, dtype=np.int32)
-        self._wc_cum = 0  # this client's exact cumulative count (host int)
-        self._ps_global_pairs = 0
-        # failure-domain round accounting (comms thread increments;
-        # containment reads after drain): pushes entered vs committed
-        self._ps_push_entered = 0
-        self._ps_rounds_pushed = 0
+        with self._ps_state_lock:
+            # exact cumulative count (host int) + failure-domain round
+            # accounting (comms thread increments; containment reads
+            # after drain): pushes entered vs committed
+            self._wc_cum = 0
+            self._ps_global_pairs = 0
+            self._ps_push_entered = 0
+            self._ps_rounds_pushed = 0
         self._ps_restarts = 0
         self._ps_codecs: Dict[str, object] = {}
         self._ps_deadline_s = None
@@ -767,8 +797,9 @@ class WordEmbedding:
         global count stays exact far past int32 (up to 2^61 pairs)."""
         p = jax.process_index()
         mask = (1 << 30) - 1
-        c_old, c_new = self._wc_cum, self._wc_cum + int(inc)
-        self._wc_cum = c_new
+        with self._ps_state_lock:
+            c_old, c_new = self._wc_cum, self._wc_cum + int(inc)
+            self._wc_cum = c_new
         lw = self._wc_bucket
         ids = np.full(lw, 2 * p, np.int64)
         deltas = np.zeros((lw, 1), np.int32)
@@ -1080,7 +1111,8 @@ class WordEmbedding:
         # failure-domain accounting: entered vs completed tells the
         # containment path whether the drained boundary is CLEAN (no push
         # died between its first and last table collective)
-        self._ps_push_entered += 1
+        with self._ps_state_lock:
+            self._ps_push_entered += 1
         with obs.span("ps.round.push", round=round_idx), monitor("ps.push"):
             for name, table, side in self._ps_entries():
                 ids_b = ids_in if side == "in" else ids_out
@@ -1106,8 +1138,9 @@ class WordEmbedding:
                     else:
                         table.add_rows_local_packed(ids_b, pl)
             new_global = self._wc_push_and_read(inc)
-        self._ps_global_pairs = new_global
-        self._ps_rounds_pushed += 1  # this round's boundary is committed
+        with self._ps_state_lock:
+            self._ps_global_pairs = new_global
+            self._ps_rounds_pushed += 1  # round boundary committed
         self._ps_stats.add_push(
             time.perf_counter() - t0, bytes_dense, bytes_wire
         )
@@ -1273,10 +1306,12 @@ class WordEmbedding:
             "adagrad": bool(o.use_adagrad),
             "tier_hbm_mb": float(o.table_tier_hbm_mb),
             "gp_history": {str(k): int(v) for k, v in gp_history.items()},
-            "gp_last": int(self._ps_global_pairs),
         }
+        with self._ps_state_lock:
+            meta["gp_last"] = int(self._ps_global_pairs)
+            wc_cum = int(self._wc_cum)
         rank_meta = {
-            "pairs_done": int(pairs_done), "wc_cum": int(self._wc_cum),
+            "pairs_done": int(pairs_done), "wc_cum": wc_cum,
             "epoch": int(epoch), "batches_in_epoch": int(batches_in_epoch),
             "restarts": int(self._ps_restarts),
         }
@@ -1362,8 +1397,9 @@ class WordEmbedding:
             with np.load(os.path.join(path, f"rank{pid}", "state.npz"),
                          allow_pickle=False) as data:
                 pulls = self._ps_restore_rank_state(data, depth)
-        self._wc_cum = int(rmeta["wc_cum"])
-        self._ps_global_pairs = int(meta.get("gp_last", 0))
+        with self._ps_state_lock:
+            self._wc_cum = int(rmeta["wc_cum"])
+            self._ps_global_pairs = int(meta.get("gp_last", 0))
         self._ps_restarts = int(rmeta.get("restarts", 0)) + 1
         _rstats.note_restart(self._ps_restarts)
         Log.Info(
@@ -1427,8 +1463,9 @@ class WordEmbedding:
             limbs[2 * q, 0] = s & mask
             limbs[2 * q + 1, 0] = s >> 30
         self._t_wc.load_logical(limbs)
-        self._wc_cum = int(shares[pid])
-        self._ps_global_pairs = total
+        with self._ps_state_lock:
+            self._wc_cum = int(shares[pid])
+            self._ps_global_pairs = total
         # data cursors: merge, then split evenly over the new world. The
         # block stream is per-rank, so "skip what the old world consumed"
         # becomes "each new rank skips its even share of the globally
@@ -1507,8 +1544,9 @@ class WordEmbedding:
         o = self.opt
         pipe.break_pipe(failure)
         drained = pipe.drain(timeout_s=max(5.0, self._ps_deadline_s or 0.0))
-        committed = self._ps_rounds_pushed
-        clean = committed == self._ps_push_entered
+        with self._ps_state_lock:
+            committed = self._ps_rounds_pushed
+            clean = committed == self._ps_push_entered
         last_ckpt = (
             latest_valid(o.checkpoint_dir) if o.checkpoint_dir else None
         )
@@ -2012,16 +2050,18 @@ class WordEmbedding:
                             done = True
                             break
                         group.append(batch)
-                lr = self._lr(self._ps_global_pairs / total_global)
+                with self._ps_state_lock:
+                    gp = self._ps_global_pairs
+                lr = self._lr(gp / total_global)
                 # every rank joins the round while ANY rank has data (dry
                 # ranks push zero deltas — lockstep SPMD rounds)
                 any_data, loss = self._run_superbatch_ps(group, lr)
                 if not any_data:
                     break
                 self._ps_lr_trace.append(lr)
-                self._ps_global_pairs = self._wc_push_and_read(
-                    o.batch_size * len(group)
-                )
+                gp_new = self._wc_push_and_read(o.batch_size * len(group))
+                with self._ps_state_lock:
+                    self._ps_global_pairs = gp_new
                 if loss is not None:
                     loss_dev = loss
                 prev = pairs_done

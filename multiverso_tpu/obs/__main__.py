@@ -1,4 +1,4 @@
-"""CLI: ``python -m multiverso_tpu.obs <merge|validate|summary> ...``.
+"""CLI: ``python -m multiverso_tpu.obs <merge|validate|summary|scrape>``.
 
 * ``merge <dir-or-files...> -o pod.json`` — align per-rank dumps on the
   shared anchor and emit one pod-wide Perfetto-loadable trace (exit 2 if
@@ -8,13 +8,21 @@
 * ``summary <file.json>`` — per-rank complete-span counts, one
   ``rank=<p> name=<span> count=<n>`` line each (what the ci smoke
   parses).
+* ``scrape <fleet-log-dir>`` — read the ``ServingFleet`` endpoint files
+  (``endpoints/replica-*.json``), fetch each live replica's
+  ``GET /metrics``, and emit ONE Prometheus dump with every sample
+  labeled ``replica="<i>"`` — fleet-level observability from one
+  command/scrape target (exit 2 if ``--expect`` replicas didn't answer).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import sys
+import urllib.request
 
 from multiverso_tpu.obs.trace_tools import (
     load_trace,
@@ -23,6 +31,50 @@ from multiverso_tpu.obs.trace_tools import (
     span_counts,
     validate_trace,
 )
+
+_ENDPOINT_RE = re.compile(r"^replica-(\d+)\.json$")
+
+
+def _scrape_fleet(log_dir: str, timeout_s: float) -> list:
+    """``[(replica_index, exposition_text), ...]`` from every endpoint
+    file whose replica answers ``GET /metrics``. A missing or dead
+    replica is skipped (the fleet degrades; so does the scrape) — the
+    caller decides whether partial coverage is an error (``--expect``)."""
+    epdir = os.path.join(log_dir, "endpoints")
+    found = []
+    try:
+        names = sorted(os.listdir(epdir))
+    except OSError as e:
+        raise SystemExit(f"scrape: cannot read {epdir}: {e}")
+    for name in names:
+        m = _ENDPOINT_RE.match(name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(epdir, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # half-written endpoint file: replica still booting
+        # prefer the dedicated health port; the data-plane URL serves
+        # the same probe routes when health rides the single port
+        url = None
+        host, ports = doc.get("host"), doc.get("ports") or {}
+        if host and ports.get("health"):
+            url = f"http://{host}:{ports['health']}/metrics"
+        elif doc.get("url"):
+            url = doc["url"].rstrip("/") + "/metrics"
+        if not url:
+            continue
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                text = resp.read().decode("utf-8", "replace")
+        except Exception as e:  # noqa: BLE001 — a dead replica degrades
+            # the scrape, never kills it
+            print(f"scrape: replica {m.group(1)} unreachable at {url}: "
+                  f"{e}", file=sys.stderr)
+            continue
+        found.append((m.group(1), text))
+    return found
 
 
 def main(argv=None) -> int:
@@ -38,7 +90,37 @@ def main(argv=None) -> int:
     vp.add_argument("file")
     sp = sub.add_parser("summary", help="per-rank span counts")
     sp.add_argument("file")
+    sc = sub.add_parser(
+        "scrape", help="join a serving fleet's per-replica /metrics"
+    )
+    sc.add_argument("log_dir",
+                    help="the ServingFleet log_dir (holds endpoints/)")
+    sc.add_argument("-o", "--out", default=None,
+                    help="write the merged dump here (default: stdout)")
+    sc.add_argument("--timeout", type=float, default=5.0,
+                    help="per-replica HTTP timeout, seconds")
+    sc.add_argument("--expect", type=int, default=0,
+                    help="fail unless at least this many replicas answered")
     args = ap.parse_args(argv)
+
+    if args.cmd == "scrape":
+        from multiverso_tpu.obs.metrics import merge_prometheus
+
+        dumps = _scrape_fleet(args.log_dir, args.timeout)
+        if args.expect and len(dumps) < args.expect:
+            print(
+                f"scrape: expected >= {args.expect} replicas, "
+                f"got {len(dumps)}", file=sys.stderr,
+            )
+            return 2
+        merged = merge_prometheus(dumps)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(merged)
+            print(f"scraped {len(dumps)} replica(s) -> {args.out}")
+        else:
+            sys.stdout.write(merged)
+        return 0
 
     if args.cmd == "merge":
         paths = resolve_inputs(args.inputs)

@@ -32,6 +32,7 @@ __all__ = [
     "MetricsRegistry",
     "registry",
     "render_prometheus",
+    "merge_prometheus",
     "CONTENT_TYPE",
 ]
 
@@ -184,3 +185,48 @@ def render_prometheus(reg: Optional[MetricsRegistry] = None) -> str:
         lines.append(f"{metric} {repr(obs['rates'][k])}")
     lines.append(f"mv_scrape_interval_s {repr(obs['interval_s'])}")
     return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------- fleet-level aggregation
+
+def merge_prometheus(dumps: "List[Tuple[str, str]]") -> str:
+    """Join per-replica Prometheus dumps into ONE exposition.
+
+    ``dumps`` is ``[(replica_label, exposition_text), ...]`` — what
+    ``python -m multiverso_tpu.obs scrape`` fetched from each replica's
+    ``GET /metrics``. Every sample line gains a ``replica="<label>"``
+    label (first, so relabel rules can match on it); ``# HELP``/``# TYPE``
+    comment lines are kept once per metric name (Prometheus rejects
+    duplicate metadata), other comments and blanks are dropped. Pure
+    text-level merge: no value math, one replica's malformed line is
+    skipped, never the whole scrape.
+    """
+    meta_seen: set = set()
+    out: List[str] = []
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S.*)$"
+    )
+    for label, text in dumps:
+        esc = str(label).replace("\\", r"\\").replace('"', r"\"")
+        for raw in text.splitlines():
+            line = raw.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                # "# TYPE <name> <kind>" / "# HELP <name> <text>"
+                if len(parts) >= 3 and parts[1] in ("TYPE", "HELP"):
+                    key = (parts[1], parts[2])
+                    if key in meta_seen:
+                        continue
+                    meta_seen.add(key)
+                    out.append(line)
+                continue
+            m = sample_re.match(line)
+            if m is None:
+                continue  # malformed sample: skip the line, keep the scrape
+            name, labels, value = m.group(1), m.group(2), m.group(3)
+            inner = labels[1:-1].strip() if labels else ""
+            merged = f'replica="{esc}"' + (f",{inner}" if inner else "")
+            out.append(f"{name}{{{merged}}} {value}")
+    return "\n".join(out) + ("\n" if out else "")

@@ -289,16 +289,21 @@ class FullJitterBackoff:
         self.base_delay_s = float(base_delay_s)
         self.max_delay_s = float(max_delay_s)
         self._state = (seed * 2654435761 + 0x9E3779B9) & 0xFFFFFFFF or 1
+        # one instance feeds retries on arbitrary threads (the fleet
+        # watch thread and the training thread share the supervisor's
+        # budget): the stream advance is a read-modify-write
+        self._state_lock = threading.Lock()
 
     def next_delay(self, attempt: int) -> float:
         """Jittered delay for (0-based) retry ``attempt``; advances the
         jitter stream by one draw."""
-        s = self._state
-        # xorshift32: cheap, seedable, good enough for jitter
-        s ^= (s << 13) & 0xFFFFFFFF
-        s ^= s >> 17
-        s ^= (s << 5) & 0xFFFFFFFF
-        self._state = s
+        with self._state_lock:
+            s = self._state
+            # xorshift32: cheap, seedable, good enough for jitter
+            s ^= (s << 13) & 0xFFFFFFFF
+            s ^= s >> 17
+            s ^= (s << 5) & 0xFFFFFFFF
+            self._state = s
         u = s / 0xFFFFFFFF
         return min(
             self.max_delay_s, self.base_delay_s * (2.0 ** attempt)

@@ -39,6 +39,7 @@ import uuid
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.resilience import chaos
 from multiverso_tpu.utils.log import CHECK, Log
 
@@ -448,6 +449,9 @@ class AutoCheckpointer:
         self.async_ = bool(async_)
         self.last_error: Optional[BaseException] = None
         self.saves = 0
+        # the async writer thread mutates saves/last_error while the
+        # training thread polls them (mvlint R9)
+        self._state_lock = OrderedLock("checkpointer._state_lock")
         self._thread: Optional[threading.Thread] = None
 
     def maybe_save(self, step: int, build: Callable[[], Callable[[], str]]) -> bool:
@@ -470,20 +474,24 @@ class AutoCheckpointer:
             self._thread.start()
         else:
             self._run(step, job)
-            if self.last_error is not None:
-                raise self.last_error
+            with self._state_lock:
+                err = self.last_error
+            if err is not None:
+                raise err
         return True
 
     def _run(self, step: int, job: Callable[[], str]) -> None:
         try:
             path = job()
             gc_checkpoints(self.root, self.retain)
-            self.saves += 1
-            self.last_error = None
+            with self._state_lock:
+                self.saves += 1
+                self.last_error = None
             stats.note_save(step, path)
             Log.Info("checkpoint published: %s (step %d)", path, step)
         except BaseException as e:  # noqa: BLE001 — surface, don't kill training
-            self.last_error = e
+            with self._state_lock:
+                self.last_error = e
             stats.note_save_failure()
             Log.Error("checkpoint save at step %d FAILED: %s", step, e)
 

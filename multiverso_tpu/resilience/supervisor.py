@@ -47,6 +47,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.resilience.chaos import FullJitterBackoff
 from multiverso_tpu.resilience.checkpoint import latest_valid
 from multiverso_tpu.resilience.watchdog import _PEER_DEATH_SIGNATURES
@@ -89,28 +90,35 @@ class RestartBudget:
         self.window_s = float(window_s)
         self._clock = clock
         self._stamps: List[float] = []
+        # shared between the fleet watch thread and direct callers: the
+        # prune-then-append window scan is a read-modify-write
+        self._stamps_lock = OrderedLock("restart_budget._stamps_lock")
         self._backoff = FullJitterBackoff(base_delay_s, max_delay_s,
                                           seed=seed)
 
-    def _prune(self) -> None:
+    def _prune_locked(self) -> None:
         now = self._clock()
         self._stamps = [t for t in self._stamps if now - t <= self.window_s]
 
     def exhausted(self) -> bool:
-        self._prune()
-        return len(self._stamps) >= self.max_restarts
+        with self._stamps_lock:
+            self._prune_locked()
+            return len(self._stamps) >= self.max_restarts
 
     def spend(self) -> float:
         """Record one restart; returns the backoff delay to wait before
         it. Caller checks ``exhausted()`` first."""
-        self._prune()
-        attempt = len(self._stamps)
-        self._stamps.append(self._clock())
+        with self._stamps_lock:
+            self._prune_locked()
+            attempt = len(self._stamps)
+            self._stamps.append(self._clock())
+        # the jitter draw takes the backoff's own lock: keep it outside
         return self._backoff.next_delay(attempt)
 
     def used(self) -> int:
-        self._prune()
-        return len(self._stamps)
+        with self._stamps_lock:
+            self._prune_locked()
+            return len(self._stamps)
 
 
 @dataclass

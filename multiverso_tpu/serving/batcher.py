@@ -162,14 +162,19 @@ class DynamicBatcher:
         """Client hint: time to drain the live backlog at the deadline
         cadence — depth/max_batch flush rounds of max_delay each, floored
         at one round."""
-        rounds = max(1.0, self._depth / float(self.max_batch))
+        with self._depth_lock:
+            depth = self._depth
+        rounds = max(1.0, depth / float(self.max_batch))
         return rounds * max(self.max_delay_s, 1e-4)
 
     def close(self, timeout_s: float = 5.0) -> None:
         """Drain-and-stop: in-flight tickets flush, then the thread exits."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._depth_lock:
+            # check-then-set under the lock: two racing close() calls
+            # must not both run the drain below
+            if self._closed:
+                return
+            self._closed = True
         self._ready.exit()
         th = self._thread
         if th is not None:
@@ -232,7 +237,9 @@ class DynamicBatcher:
                 Log.Error("serving flusher survived internal error: %r", e)
                 time.sleep(0.01)  # if the queue itself is broken: no hot spin
                 ticket = None
-            if ticket is None and self._closed:
+            with self._depth_lock:
+                closed = self._closed
+            if ticket is None and closed:
                 # drain whatever arrived before the poison, then leave
                 while True:
                     t2 = self._ready.try_pop()

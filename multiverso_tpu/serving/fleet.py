@@ -34,6 +34,7 @@ import time
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.resilience.supervisor import RestartBudget
 from multiverso_tpu.utils.log import CHECK, Log
 
@@ -84,6 +85,9 @@ class ServingFleet:
         # replica slots the budget gave up on: stay down, fleet degrades
         self._abandoned: List[bool] = [False] * self.n
         self.restarts = 0
+        # watch thread increments, stop() reads after a bounded join
+        # that can time out — counter needs the lock (mvlint R9)
+        self._restart_lock = OrderedLock("fleet._restart_lock")
         self._stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         os.makedirs(self.log_dir, exist_ok=True)
@@ -222,7 +226,8 @@ class ServingFleet:
                 )
                 continue
             delay = self._budget.spend()
-            self.restarts += 1
+            with self._restart_lock:
+                self.restarts += 1
             self._event(
                 "replica_relaunch", replica=i, rc=rc,
                 backoff_s=round(delay, 3),
@@ -279,7 +284,9 @@ class ServingFleet:
                 except (ProcessLookupError, PermissionError, OSError):
                     pass
                 p.wait(timeout=5)
+        with self._restart_lock:
+            restarts = self.restarts
         self._event(
-            "stopped", restarts=self.restarts,
+            "stopped", restarts=restarts,
             abandoned=sum(self._abandoned),
         )

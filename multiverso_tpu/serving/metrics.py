@@ -176,17 +176,26 @@ class ServingMetrics:
 
     def report(self) -> Dict[str, object]:
         """Snapshot dict — the BENCH/demo/ci JSON payload."""
-        out: Dict[str, object] = {
-            "served": self.served,
-            "shed": self.shed,
-            "batches": self.batches,
-            "batch_fill": round(self.batch_fill(), 4),
-            "queue_depth": self.queue_depth,
-            "qps": round(self.qps(), 1),
-            "swaps": self.swaps,
-            "publish_rejects": self.publish_rejects,
-        }
-        for route, hist in sorted(self.route_latency.items()):
+        with self._lock:
+            # counters mutate on the batcher thread; snapshot them under
+            # the same lock so the report is a consistent cut (qps() and
+            # the histograms take their own locks — keep them outside,
+            # threading.Lock is not reentrant)
+            batches = self.batches
+            fill = self.batch_fill_sum / batches if batches else 0.0
+            snap = {
+                "served": self.served,
+                "shed": self.shed,
+                "batches": batches,
+                "batch_fill": round(fill, 4),
+                "queue_depth": self.queue_depth,
+                "swaps": self.swaps,
+                "publish_rejects": self.publish_rejects,
+            }
+            routes = sorted(self.route_latency.items())
+        out: Dict[str, object] = dict(snap)
+        out["qps"] = round(self.qps(), 1)
+        for route, hist in routes:
             out[f"{route}_p50_ms"] = round(hist.percentile(50) * 1e3, 4)
             out[f"{route}_p99_ms"] = round(hist.percentile(99) * 1e3, 4)
             out[f"{route}_mean_ms"] = round(hist.mean_s * 1e3, 4)

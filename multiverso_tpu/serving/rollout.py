@@ -93,7 +93,11 @@ class SnapshotWatcher:
         except OSError as e:
             Log.Error("snapshot watch: cannot scan %s: %s", self.root, e)
             return None
-        if path is None or path == self._loaded_path:
+        with self._stats_lock:
+            # stats() reads the serving path from the caller's thread;
+            # every touch here goes through the same lock (mvlint R9)
+            loaded = self._loaded_path
+        if path is None or path == loaded:
             return None
         if path in self._rejected:
             return None
@@ -117,7 +121,7 @@ class SnapshotWatcher:
             )
             Log.Error(
                 "snapshot watch: %s REJECTED, keeping v%s serving: %s",
-                path, self._loaded_path or "none", e,
+                path, loaded or "none", e,
             )
             return None
         except Exception as e:  # noqa: BLE001 — a half-written sidecar or
@@ -126,8 +130,8 @@ class SnapshotWatcher:
             return None
         rollout_s = time.monotonic() - t0
         staleness = self._checkpoint_age_s(path)
-        self._loaded_path = path
         with self._stats_lock:
+            self._loaded_path = path
             self._rollouts += 1
             self._last_rollout_s = rollout_s
             self._last_staleness_s = staleness

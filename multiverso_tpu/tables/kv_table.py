@@ -211,6 +211,7 @@ class KVTable:
         """Worker-local cached map (ref: kv_table.h:44)."""
         return self._local
 
+    @collective_dispatch
     def items(self) -> Tuple[np.ndarray, np.ndarray]:
         """All (key, value) pairs currently stored server-side. SPMD
         collective under multi-process (every rank calls; the values

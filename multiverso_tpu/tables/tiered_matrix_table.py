@@ -416,13 +416,17 @@ class TieredMatrixTable(MatrixTable):
             return None
         self._check_ids_in_range(ids)
         if pipe is None:
-            pipe = self._pipe
-            if pipe is None:
-                from multiverso_tpu.utils.async_buffer import TaskPipe
+            with self._tier_lock:
+                # lazy init under the tier lock: a concurrent close()
+                # (or a second prefetch) racing the check-then-set
+                # would leak a pipe and its worker thread (mvlint R9)
+                pipe = self._pipe
+                if pipe is None:
+                    from multiverso_tpu.utils.async_buffer import TaskPipe
 
-                pipe = self._pipe = TaskPipe(
-                    capacity=8, name=f"mv-tier-{self.name}"
-                )
+                    pipe = self._pipe = TaskPipe(
+                        capacity=8, name=f"mv-tier-{self.name}"
+                    )
         ticket = pipe.submit_nowait(
             lambda: self._prefetch_now(ids), tag=f"prefetch:{self.name}"
         )
@@ -449,7 +453,8 @@ class TieredMatrixTable(MatrixTable):
     def close(self) -> None:
         """Tear down the prefetch pipe (idempotent; the cache itself
         needs no teardown)."""
-        pipe, self._pipe = self._pipe, None
+        with self._tier_lock:
+            pipe, self._pipe = self._pipe, None
         if pipe is not None:
             pipe.close(timeout_s=5.0)
 

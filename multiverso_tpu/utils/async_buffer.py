@@ -343,8 +343,11 @@ class TaskPipe:
         Exceptions from drained tasks stay parked on their tickets. On a
         broken pipe the join is best-effort under ``timeout_s`` — the
         worker may be stuck inside a hung collective."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._state_lock:
+            # check-then-set under the lock: two racing close() calls
+            # must not both run the teardown below
+            if self._closed:
+                return
+            self._closed = True
         self._ready.exit()  # pop() returns queued items, then None
         self._thread.join(timeout=timeout_s)

@@ -1,0 +1,77 @@
+"""Negative control for the SPMD pack (R6-R9): every sanctioned idiom
+next to the shapes the seeded fixtures fire on. Must lint completely
+clean.
+
+* R6: every rank posts the collective; only rank 0 touches the
+  filesystem afterwards (the store()/quorum idiom).
+* R7: rebind-at-donation — ``self.weights = self._step(self.weights)``
+  gives post-donation readers the new value.
+* R8: the keyed compile cache (``cache[key] = jax.jit(...)``) is the
+  sanctioned per-topology shape.
+* R9: the counter holds one lock on BOTH sides; single-assignment
+  publication needs none.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.analysis.guards import OrderedLock, collective_dispatch
+
+
+@collective_dispatch
+def gather_all():
+    return 1
+
+
+def _write_blob(path, blob):
+    return (path, blob)
+
+
+def quorum_save(rank, path):
+    blob = gather_all()  # every rank posts the collective...
+    if rank == 0:
+        _write_blob(path, blob)  # ...only rank 0 touches the filesystem
+    return blob
+
+
+def _apply(w, g):
+    return w - g
+
+
+class CleanOptimizer:
+    def __init__(self):
+        self._step = jax.jit(_apply, donate_argnums=(0,))
+        self.weights = jnp.zeros((4,))
+        self._lock = OrderedLock("fixture.clean_spmd")
+        self.rounds = 0
+        self._t = threading.Thread(target=self._tick, daemon=True)
+
+    def round(self, grad):
+        # rebind-at-donation: the sanctioned zero-copy idiom
+        self.weights = self._step(self.weights, grad)
+        return self.weights
+
+    def _tick(self):
+        with self._lock:
+            self.rounds += 1  # counter: locked on the thread path...
+
+    def progress(self):
+        with self._lock:
+            return self.rounds  # ...and on the training-thread path
+
+    def run(self):
+        self._t.start()
+        self._t.join()
+
+
+def keyed_cache(xs):
+    cache = {}
+    outs = []
+    for x in xs:
+        key = int(x)
+        if key not in cache:
+            cache[key] = jax.jit(_apply)  # per-key compile cache: legal
+        outs.append(cache[key](x, x))
+    return outs

@@ -1,0 +1,32 @@
+"""mvlint fixture: triggers EXACTLY rule R1 *through a typed receiver*.
+
+``get`` sat on the retired AMBIGUOUS_DISPATCH_NAMES hand list — the v1
+name-based propagation refused to match it (any dict read would have
+become a collective), so a thread calling ``self._table.get(...)`` was
+R1's documented blind spot. The dataflow engine resolves the receiver
+through the ``self._table = _KVTable()`` binding instead of the bare
+name, and the rogue entry fires. Thread daemonized + joined (R4 quiet);
+``_table`` is written only in ``__init__`` (R9 quiet)."""
+
+import threading
+
+from multiverso_tpu.analysis.guards import collective_dispatch
+
+
+class _KVTable:
+    @collective_dispatch
+    def get(self, keys):
+        return keys
+
+
+class Puller:
+    def __init__(self):
+        self._table = _KVTable()
+        self._t = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self):
+        return self._table.get([1, 2])
+
+    def run(self):
+        self._t.start()
+        self._t.join()

@@ -1,0 +1,22 @@
+"""mvlint historical-bug fixture for R6: the PR 6 incident class.
+
+The checkpoint commit posted its multihost barrier from rank 0 only —
+``_commit`` reaches ``sync_global_devices`` one call away, so every
+other rank never arrived at the barrier and the pod hung. The bug is
+*interprocedural*: the rank-gated call site looks like plain file I/O;
+only resolving ``_commit`` through the call graph reveals the
+collective behind it."""
+
+from jax.experimental.multihost_utils import sync_global_devices
+
+
+def _commit(step):
+    sync_global_devices(f"mv-ckpt-{step}")
+    return step
+
+
+def save_checkpoint(step, rank):
+    payload = {"step": step}  # every rank builds the payload
+    if rank == 0:
+        _commit(step)  # ...but only rank 0 reaches the barrier
+    return payload

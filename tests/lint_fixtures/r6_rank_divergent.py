@@ -1,0 +1,26 @@
+"""mvlint fixture: triggers EXACTLY rule R6 (rank-divergent
+collective). A ``@collective_dispatch`` entry point is reachable inside
+a branch conditioned on the process rank — ranks that skip the branch
+never post the matching collective, and the pod deadlocks. Covers both
+the direct ``if rank-expr:`` body and the guard-then-fallthrough form
+(``if rank != 0: return``)."""
+
+import jax
+
+from multiverso_tpu.analysis.guards import collective_dispatch
+
+
+@collective_dispatch
+def gather_rows():
+    return 1
+
+
+def leaky_round():
+    if jax.process_index() == 0:
+        gather_rows()
+
+
+def guarded_tail(rank):
+    if rank != 0:
+        return None
+    return gather_rows()

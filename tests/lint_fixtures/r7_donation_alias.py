@@ -1,0 +1,24 @@
+"""mvlint fixture: triggers EXACTLY rule R7 (donation aliasing). The
+optimizer step donates its weights buffer (``donate_argnums=(0,)``
+bound through the ``self._step = jax.jit(...)`` attribute — the
+interprocedural link), then reads the dead binding before rebinding
+it. Donated buffers are invalidated in place."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _apply(w, g):
+    return w - 0.1 * g
+
+
+class Optimizer:
+    def __init__(self):
+        self._step = jax.jit(_apply, donate_argnums=(0,))
+        self.weights = jnp.zeros((4,))
+
+    def round(self, grad):
+        new_w = self._step(self.weights, grad)
+        stale = float(self.weights.sum())  # reads the donated buffer
+        self.weights = new_w
+        return stale

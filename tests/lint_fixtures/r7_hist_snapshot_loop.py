@@ -1,0 +1,20 @@
+"""mvlint historical-bug fixture for R7: the PR 5 zero-copy snapshot
+incident. The serving snapshot handed the SAME table buffer to a
+donating fused step on every round of the loop without rebinding it —
+iteration 2 read (and served) a buffer iteration 1 had already
+invalidated in place. R7's loop back-edge check must fire."""
+
+import jax
+
+
+def _fused_apply(table, delta):
+    return table + delta
+
+
+def serve_rounds(table, deltas):
+    step = jax.jit(_fused_apply, donate_argnums=(0,))
+    snapshots = []
+    for delta in deltas:
+        out = step(table, delta)  # donates `table`, never rebinds it
+        snapshots.append(out)
+    return snapshots

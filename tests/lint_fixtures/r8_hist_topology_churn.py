@@ -1,0 +1,22 @@
+"""mvlint historical-bug fixture for R8: the PR 7 compile-cache churn
+incident. The elastic resume path re-sharded with a round-varying row
+count, so every round handed the jitted apply a NEW argument shape —
+a full XLA retrace per round instead of one compile per topology
+bucket. R8's loop-varying-shape check must fire."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit)
+def _apply(block):
+    return block * 2.0
+
+
+def elastic_rounds(table, n_rounds):
+    outs = []
+    for r in range(n_rounds):
+        rows = 8 + r  # shard size drifts with the round
+        outs.append(_apply(table[:rows]))  # new shape -> full retrace
+    return outs

@@ -1,0 +1,26 @@
+"""mvlint fixture: triggers EXACTLY rule R8 (retrace churn). Two of
+the three churn shapes: a jit constructed inside the round loop (fresh
+callable = fresh trace every iteration) and a per-round loop variable
+at a static argument position (every value is a new cache key)."""
+
+import jax
+
+
+def _kernel(x, bucket):
+    return x * bucket
+
+
+def churn_fresh_jit(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(_kernel)  # rebuilt (and retraced) every iteration
+        outs.append(f(x, 1))
+    return outs
+
+
+def churn_static_key(xs):
+    f = jax.jit(_kernel, static_argnums=(1,))
+    outs = []
+    for i, x in enumerate(xs):
+        outs.append(f(x, i))  # i is a brand-new cache key every round
+    return outs

@@ -1,0 +1,24 @@
+"""mvlint fixture: triggers EXACTLY rule R9 (unguarded cross-thread
+state). A counter read-modify-written on the thread path and read from
+training-thread code with no common lock — the lost-update shape. The
+thread is daemonized and joined so R4 stays quiet."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self.pushed = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.pushed += 1  # RMW on the thread path, no lock
+
+    def start(self):
+        self._t.start()
+
+    def progress(self):
+        return self.pushed  # training-thread read, no lock
+
+    def stop(self):
+        self._t.join()

@@ -1,0 +1,27 @@
+"""mvlint historical-bug fixture for R9: the threaded-PS lost-update
+class the runtime OrderedLocks exist for. The word-count cumulator was
+read-modify-written by closures running on the PS comms TaskPipe while
+the training thread read it for the LR schedule — lost updates skewed
+the decay. The comms pipe is the *sanctioned* R1 channel, but R9 must
+still see its closures as thread-side."""
+
+from multiverso_tpu.utils.async_buffer import TaskPipe
+
+
+class WordCounter:
+    def __init__(self):
+        self.word_count = 0
+        self._pipe = TaskPipe(name="fixture-ps-comms")
+
+    def push_round(self):
+        return self._pipe.submit(self._bump, tag="push")
+
+    def _bump(self):
+        new = self.word_count + 1  # read...
+        self.word_count = new  # ...then write: the lost-update window
+
+    def lr(self, base):
+        return base * (1.0 - self.word_count / 1e6)
+
+    def close(self):
+        self._pipe.close()

@@ -49,10 +49,19 @@ def _lint_fixture(name):
     "fixture,rule",
     [
         ("r1_rogue_thread.py", "R1"),
+        ("r1_alias_dispatch.py", "R1"),
         ("r2_lock_cycle.py", "R2"),
         ("r3_flag_hygiene.py", "R3"),
         ("r4_thread_leak.py", "R4"),
         ("r5_nondeterminism.py", "R5"),
+        ("r6_rank_divergent.py", "R6"),
+        ("r6_hist_rank0_barrier.py", "R6"),
+        ("r7_donation_alias.py", "R7"),
+        ("r7_hist_snapshot_loop.py", "R7"),
+        ("r8_retrace_churn.py", "R8"),
+        ("r8_hist_topology_churn.py", "R8"),
+        ("r9_cross_thread.py", "R9"),
+        ("r9_hist_ps_counter.py", "R9"),
     ],
 )
 def test_fixture_triggers_exactly_its_rule(fixture, rule):
@@ -68,6 +77,72 @@ def test_fixture_triggers_exactly_its_rule(fixture, rule):
 def test_clean_fixture_negative_control():
     res = _lint_fixture("clean.py")
     assert res.findings == []
+
+
+def test_clean_spmd_fixture_negative_control():
+    """The sanctioned idioms next to each R6-R9 firing shape: quorum
+    save (collective above the rank gate), rebind-at-donation, the
+    keyed compile cache inside a loop, and the both-sides-locked
+    counter. All must pass."""
+    res = _lint_fixture("clean_spmd.py")
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+
+
+def test_r1_alias_fires_through_typed_receiver():
+    """The retired AMBIGUOUS_DISPATCH_NAMES blind spot: ``get`` via a
+    ``self._table = _KVTable()`` binding must resolve to the decorated
+    method and fire — by receiver type, not by bare name."""
+    res = _lint_fixture("r1_alias_dispatch.py")
+    assert len(res.findings) == 1
+    f = res.findings[0]
+    assert f.rule == "R1"
+    assert "_KVTable.get" in f.message  # the resolved sink, by qualname
+    assert "Puller._drain" in f.message  # the rogue entry
+
+
+def test_historical_fixture_messages_name_their_bug_class():
+    """Each historical repro must fire via the code path that matches
+    its incident, not an unrelated branch of the same rule."""
+    (f6,) = _lint_fixture("r6_hist_rank0_barrier.py").findings
+    assert "rank-conditioned" in f6.message and "_commit" in f6.message
+    (f7,) = _lint_fixture("r7_hist_snapshot_loop.py").findings
+    assert "loop iteration" in f7.message  # the back-edge check
+    (f8,) = _lint_fixture("r8_hist_topology_churn.py").findings
+    assert "shape" in f8.message  # the shape-churn check
+    (f9,) = _lint_fixture("r9_hist_ps_counter.py").findings
+    assert "read-modify-write" in f9.message
+    assert "word_count" in f9.message and "WordCounter.lr" in f9.message
+
+
+def test_restrict_paths_filters_emission_not_parsing():
+    """The --diff core: both fixtures are PARSED (the graph spans the
+    module set) but findings are emitted only for the restricted
+    file."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        _BARE,
+        restrict_paths=["tests/lint_fixtures/r7_donation_alias.py"],
+    )
+    res = run_lint(
+        [
+            os.path.join(FIXTURES, "r6_rank_divergent.py"),
+            os.path.join(FIXTURES, "r7_donation_alias.py"),
+        ],
+        config=cfg,
+        baseline_path=os.devnull,
+    )
+    assert res.files == 2  # full set parsed
+    assert {f.rule for f in res.findings} == {"R7"}  # emission filtered
+    assert all(f.path.endswith("r7_donation_alias.py")
+               for f in res.findings)
+
+
+def test_diff_cli_rejects_bad_ref():
+    from multiverso_tpu.analysis.__main__ import main
+
+    assert main(["--diff", "no-such-ref-xyzzy",
+                 os.path.join(REPO, "multiverso_tpu", "analysis")]) == 2
 
 
 def test_r5_fixture_covers_all_three_categories():

@@ -516,3 +516,45 @@ def test_containment_dumps_flight_recorder_next_to_failure_report(
     # events are a usable timeline: seq strictly increasing, clocks set
     seqs = [e["seq"] for e in events]
     assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+# -------------------------------------------------- fleet metrics merge
+
+
+def test_merge_prometheus_labels_and_dedups_metadata():
+    """Fleet aggregation contract: every sample gains ``replica="<i>"``
+    as the FIRST label (relabel rules match on it), existing labels
+    survive behind it, HELP/TYPE metadata is kept once per metric name,
+    and a malformed line drops alone — never the whole scrape."""
+    from multiverso_tpu.obs.metrics import merge_prometheus
+
+    r0 = (
+        "# HELP mv_core_up whether the replica is live\n"
+        "# TYPE mv_core_up gauge\n"
+        "mv_core_up 1\n"
+        'mv_serving_served{route="get_rows"} 7\n'
+        "not a sample line !!!\n"
+    )
+    r1 = (
+        "# TYPE mv_core_up gauge\n"
+        "mv_core_up 1\n"
+        'mv_serving_served{route="get_rows"} 9\n'
+    )
+    out = merge_prometheus([("0", r0), ("1", r1)])
+    lines = out.splitlines()
+    assert lines.count("# TYPE mv_core_up gauge") == 1
+    assert lines.count("# HELP mv_core_up whether the replica is live") == 1
+    assert 'mv_core_up{replica="0"} 1' in lines
+    assert 'mv_core_up{replica="1"} 1' in lines
+    # replica label first, original labels preserved after it
+    assert 'mv_serving_served{replica="0",route="get_rows"} 7' in lines
+    assert 'mv_serving_served{replica="1",route="get_rows"} 9' in lines
+    assert not any("not a sample" in ln for ln in lines)
+
+
+def test_merge_prometheus_escapes_label_and_handles_empty():
+    from multiverso_tpu.obs.metrics import merge_prometheus
+
+    assert merge_prometheus([]) == ""
+    out = merge_prometheus([('we"ird\\host', "m 1\n")])
+    assert out == 'm{replica="we\\"ird\\\\host"} 1\n'
