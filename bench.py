@@ -908,6 +908,7 @@ def _bench_ring_attention():
     # reduction-order differences land ~1e-3, not the CPU's 1e-4
     f32_tol = 1e-4 if jax.devices()[0].platform == "cpu" else 5e-3
     for bf16, tol in ((False, f32_tol), (True, 5e-2)):
+        # mvlint: allow[R8] each iteration jits a DIFFERENT variant exactly once (validation, not a timed loop)
         got = jax.jit(make_blockwise(256, 64, bf16))(qc, kc, vc)
         err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref)))
         if err > tol:
@@ -1157,13 +1158,19 @@ def _bench_ps_loop(cfg, steps=10, warmup=2, batch=8192):
     from multiverso_tpu.updaters import AddOption
 
     _sgd = AddOption()
-    for _ in range(warmup):
-        one_step()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        one_step()
-    dt = time.perf_counter() - t0
-    return batch * steps / dt
+    try:
+        for _ in range(warmup):
+            one_step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            one_step()
+        dt = time.perf_counter() - t0
+        return batch * steps / dt
+    finally:
+        from multiverso_tpu.runtime import runtime as _rt
+
+        _rt().release_tables([t_in, t_out])  # don't pin the shards for
+        # the rest of the bench process (the PR 6 leak class)
 
 
 def _bench_ps_comms(V=20000, dim=64, toks=300_000):
@@ -1526,12 +1533,17 @@ def _bench_resilience(cfg, fused_pairs_per_sec, batch=8192, scan_steps=64,
         drain_ms = {}
         for depth in (1, 2, 4, 8):
             pipe = TaskPipe()
-            for _ in range(depth):
-                pipe.submit(lambda: time.sleep(1e-3))
-            t0 = time.perf_counter()
-            assert pipe.drain(timeout_s=30)
-            drain_ms[depth] = round((time.perf_counter() - t0) * 1e3, 2)
-            pipe.close()
+            try:
+                for _ in range(depth):
+                    pipe.submit(lambda: time.sleep(1e-3))
+                t0 = time.perf_counter()
+                assert pipe.drain(timeout_s=30)
+                drain_ms[depth] = round(
+                    (time.perf_counter() - t0) * 1e3, 2
+                )
+            finally:
+                # a failed drain assert must not abandon the worker
+                pipe.close()
         # tiered-table checkpoint drill (ISSUE 6): what flushing a dirty
         # HBM cache adds to an atomic save — the cost of checkpoint
         # tier-transparency
@@ -1932,13 +1944,19 @@ def _bench_lint():
     engine (interprocedural graph + rules R6-R9) — the number that
     regresses if the dataflow fixpoint or the call-graph build blows up;
     per-rule counts pin WHICH rule started firing when a regression
-    lands findings."""
+    lands findings. v3 adds ``lint_v3_incremental_runtime_s``: a warm
+    run against the content-hash parse cache (the ``--diff`` pre-push
+    path), plus per-rule-family timing so a fixpoint blowup names the
+    family that caused it."""
+    import dataclasses
     import os
+    import tempfile
 
-    from multiverso_tpu.analysis.mvlint import run_lint
+    from multiverso_tpu.analysis.mvlint import default_config, run_lint
 
     root = os.path.dirname(os.path.abspath(__file__))
-    res = run_lint([os.path.join(root, "multiverso_tpu")])
+    paths = [os.path.join(root, "multiverso_tpu")]
+    res = run_lint(paths)
     per_rule = {}
     for f in res.findings:
         per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
@@ -1953,6 +1971,24 @@ def _bench_lint():
     }
     for rule in sorted(per_rule):
         out[f"lint_findings_{rule.lower()}"] = per_rule[rule]
+    for family, dt in sorted(res.rule_times.items()):
+        out[f"lint_time_{family.lower()}_s"] = round(dt, 3)
+    # the incremental path: cold run populates the cache, warm run
+    # re-parses nothing (what a pre-push --diff with one edit feels like)
+    with tempfile.TemporaryDirectory() as td:
+        cfg = dataclasses.replace(
+            default_config(paths),
+            parse_cache_path=os.path.join(td, "cache.pkl"),
+        )
+        run_lint(paths, config=cfg)  # cold: fills the cache
+        warm = run_lint(paths, config=cfg)
+        assert warm.files_cached == warm.files, (
+            warm.files_cached, warm.files,
+        )
+        out["lint_v3_incremental_runtime_s"] = round(warm.runtime_s, 3)
+        out["lint_v3_cache_parse_s"] = round(
+            warm.rule_times.get("parse", 0.0), 3
+        )
     return out
 
 

@@ -13,18 +13,26 @@ cmake --build build --parallel
 
 echo "== mvlint static analysis (analysis/RULES.md) =="
 # repo-aware AST rules R1-R5 (collective-dispatch threading, lock order,
-# flag hygiene, thread lifecycle, exact-path determinism) plus the
+# flag hygiene, thread lifecycle, exact-path determinism), the
 # interprocedural SPMD/JAX pack R6-R9 (rank-divergent collectives,
-# donation aliasing, retrace churn, cross-thread state) — fails on ANY
-# unsuppressed finding; the checked-in baseline is empty by contract, so
-# this is "the tree lints clean", not "the tree matches a snapshot".
+# donation aliasing, retrace churn, cross-thread state), and the
+# lifecycle/protocol pack R10-R12 (resource typestate, checkpoint/publish
+# protocol order, flag-constraint drift) — fails on ANY unsuppressed
+# finding; the checked-in baseline is empty by contract, so this is "the
+# tree lints clean", not "the tree matches a snapshot". bench.py is in
+# the scan: its threads and pipes extend the reachability the
+# interprocedural rules reason over. --sarif lands next to the terminal
+# output for CI annotation surfaces.
 # MVLINT_DIFF_REF=<git ref> switches to the pre-push fast path: the full
-# tree is still parsed (cross-file rules stay sound) but only findings
-# in files changed vs the ref are reported.
+# tree is still parsed (cross-file rules stay sound; unchanged files come
+# out of the content-hash parse cache) but only findings in files changed
+# vs the ref are reported.
 if [ -n "${MVLINT_DIFF_REF:-}" ]; then
-    python -m multiverso_tpu.analysis --diff "$MVLINT_DIFF_REF" multiverso_tpu/
+    python -m multiverso_tpu.analysis --diff "$MVLINT_DIFF_REF" \
+        --sarif mvlint.sarif multiverso_tpu/ bench.py
 else
-    python -m multiverso_tpu.analysis multiverso_tpu/
+    python -m multiverso_tpu.analysis --sarif mvlint.sarif \
+        multiverso_tpu/ bench.py
 fi
 
 echo "== unit + integration tests (8-device CPU mesh) =="

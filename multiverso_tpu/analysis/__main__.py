@@ -2,10 +2,15 @@
 
 Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = bad
 invocation/baseline. ``--json`` emits the machine-readable summary the
-bench leg records; ``--diff REF`` lints the whole tree but reports only
-findings in files changed since the git ref (the pre-push fast path);
-``--flag-table`` regenerates the DEPLOY.md flag reference from the AST
-(no imports executed).
+bench leg records; ``--sarif OUT.json`` additionally writes a SARIF
+2.1.0 log for CI annotation surfaces; ``--diff REF`` lints the whole
+tree but reports only findings in files changed since the git ref (the
+pre-push fast path — it also engages the on-disk parse cache, so only
+changed files are re-parsed); ``--flag-table`` regenerates the DEPLOY.md
+flag reference from the AST (no imports executed) and
+``--constraint-table`` renders the flag-constraint block from
+``config/constraints.py`` (the single source of truth R12 checks
+against).
 """
 
 from __future__ import annotations
@@ -97,6 +102,54 @@ def _flag_table(paths) -> str:
     return "\n".join(out)
 
 
+def _rule_metadata() -> list:
+    """SARIF ``tool.driver.rules`` — id + one-line description pulled
+    from each rule function's docstring (no second source of truth)."""
+    from multiverso_tpu.analysis import rules as rules_mod
+
+    seen = {}
+    for rule_fn in rules_mod.ALL_RULES:
+        m = mvlint._RULE_ID_RE.search(rule_fn.__name__)
+        rid = f"R{m.group(1)}" if m else rule_fn.__name__
+        doc = (rule_fn.__doc__ or "").strip().splitlines()
+        seen.setdefault(rid, doc[0] if doc else rid)
+    return [
+        {"id": rid, "shortDescription": {"text": text}}
+        for rid, text in sorted(seen.items())
+    ]
+
+
+def _sarif(result) -> dict:
+    """Minimal SARIF 2.1.0 log: one run, one result per live finding."""
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mvlint",
+                "informationUri": "analysis/RULES.md",
+                "rules": _rule_metadata(),
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": "error",
+                    "message": {"text": f.message
+                                + (f" (hint: {f.hint})" if f.hint else "")},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": max(f.line, 1)},
+                        },
+                    }],
+                }
+                for f in result.findings
+            ],
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m multiverso_tpu.analysis",
@@ -115,13 +168,24 @@ def main(argv=None) -> int:
                          "rules stay sound)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print suppressed findings")
+    ap.add_argument("--sarif", metavar="OUT", default=None,
+                    help="also write a SARIF 2.1.0 log to this path "
+                         "(CI annotation surfaces)")
     ap.add_argument("--flag-table", action="store_true",
                     help="emit the markdown MV_DEFINE flag reference "
                          "and exit")
+    ap.add_argument("--constraint-table", action="store_true",
+                    help="emit the markdown flag-constraint block from "
+                         "config/constraints.py and exit")
     args = ap.parse_args(argv)
     paths = args.paths or ["multiverso_tpu"]
     if args.flag_table:
         print(_flag_table(paths))
+        return 0
+    if args.constraint_table:
+        from multiverso_tpu.config import constraints
+
+        print(constraints.render_markdown())
         return 0
     cfg = mvlint.default_config(paths)
     if args.diff is not None:
@@ -132,6 +196,11 @@ def main(argv=None) -> int:
         except (subprocess.CalledProcessError, OSError) as e:
             print(f"mvlint: --diff {args.diff}: {e}", file=sys.stderr)
             return 2
+        # the pre-push fast path: unchanged files come out of the parse
+        # cache (content-hash keyed), only the diff is re-parsed
+        cfg.parse_cache_path = os.path.join(
+            cfg.repo_root or ".", ".mvlint-cache.pkl"
+        )
         if not cfg.restrict_paths:
             if args.json:
                 print(json.dumps({
@@ -147,6 +216,10 @@ def main(argv=None) -> int:
     except ValueError as e:  # malformed baseline
         print(f"mvlint: {e}", file=sys.stderr)
         return 2
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(_sarif(result), fh, indent=2, sort_keys=True)
+            fh.write("\n")
     if args.json:
         per_rule: dict = {}
         for f in result.findings:
@@ -157,6 +230,12 @@ def main(argv=None) -> int:
             "suppressed": len(result.suppressed),
             "runtime_s": round(result.runtime_s, 3),
             "rules": {r: per_rule[r] for r in sorted(per_rule)},
+            "rule_times_s": {
+                k: round(v, 4)
+                for k, v in sorted(result.rule_times.items())
+            },
+            "files_cached": result.files_cached,
+            "files_reparsed": result.files_reparsed,
         }))
     else:
         print(mvlint.format_findings(result, verbose=args.verbose))

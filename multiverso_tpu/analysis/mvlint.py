@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import os
+import pickle
 import re
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -37,7 +39,7 @@ __all__ = [
     "format_findings",
 ]
 
-_PRAGMA_RE = re.compile(r"#\s*mvlint:\s*allow\[(R\d|\*)\]\s*(\S.*)?$")
+_PRAGMA_RE = re.compile(r"#\s*mvlint:\s*allow\[(R\d+|\*)\]\s*(\S.*)?$")
 _EXACT_MARKER_RE = re.compile(r"#\s*mvlint:\s*exact-module\b")
 
 
@@ -125,6 +127,10 @@ class LintConfig:
     # its caller's file — cross-file analysis must not go blind), only
     # the emission is restricted.
     restrict_paths: Optional[Sequence[str]] = None
+    # incremental parse cache: a pickle of {relpath: (sha256, Module)}.
+    # Parsing is the only thing cached — rules always re-run, so a rule
+    # change needs no invalidation, only a content change does.
+    parse_cache_path: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -133,6 +139,11 @@ class LintResult:
     suppressed: List[Finding]
     files: int
     runtime_s: float
+    # per-rule-id wall time ("R10" -> seconds; "graph" = ProjectGraph
+    # construction, "parse" = file parsing) — the bench leg records it
+    rule_times: Dict[str, float] = dataclasses.field(default_factory=dict)
+    files_reparsed: int = 0
+    files_cached: int = 0
 
     @property
     def clean(self) -> bool:
@@ -265,6 +276,41 @@ def _apply_suppressions(
 
 # ------------------------------------------------------------------ driver
 
+# bump when the pickled Module shape changes (the cache stores parse
+# results only — rules re-run every time, so rule edits need no bump)
+_PARSE_CACHE_SCHEMA = 1
+
+_RULE_ID_RE = re.compile(r"_r(\d+)")
+
+
+def _load_parse_cache(path: str) -> Dict[str, Tuple[str, Module]]:
+    try:
+        with open(path, "rb") as fh:
+            payload = pickle.load(fh)
+        if payload.get("schema") == _PARSE_CACHE_SCHEMA:
+            return payload["modules"]
+    except Exception:  # noqa: BLE001 - any stale/corrupt cache: reparse
+        pass
+    return {}
+
+
+def _save_parse_cache(path: str,
+                      cache: Dict[str, Tuple[str, Module]]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            pickle.dump(
+                {"schema": _PARSE_CACHE_SCHEMA, "modules": cache}, fh,
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def run_lint(
     paths: Sequence[str],
     config: Optional[LintConfig] = None,
@@ -278,34 +324,67 @@ def run_lint(
     files = _iter_py_files(paths)
     modules: Dict[str, Module] = {}
     findings: List[Finding] = []
+    rule_times: Dict[str, float] = {}
+    cache: Dict[str, Tuple[str, Module]] = (
+        _load_parse_cache(cfg.parse_cache_path)
+        if cfg.parse_cache_path else {}
+    )
+    reused = 0
+    reparsed = 0
     for fp in files:
         rel = os.path.relpath(fp, root)
         if rel.startswith(".."):
             rel = fp
+        key = rel.replace(os.sep, "/")
         try:
             with open(fp, encoding="utf-8") as fh:
                 src = fh.read()
-            modules[rel.replace(os.sep, "/")] = Module(fp, rel, src)
+            hit = cache.get(key) if cfg.parse_cache_path else None
+            if hit is not None and hit[0] == hashlib.sha256(
+                src.encode("utf-8")
+            ).hexdigest():
+                modules[key] = hit[1]
+                reused += 1
+                continue
+            mod = Module(fp, rel, src)
+            modules[key] = mod
+            reparsed += 1
+            if cfg.parse_cache_path:
+                cache[key] = (
+                    hashlib.sha256(src.encode("utf-8")).hexdigest(), mod
+                )
         except (SyntaxError, ValueError) as e:
             # ValueError too: NUL bytes raise it (not SyntaxError) on
             # 3.10 — one unparseable file is a per-file R0 finding, not
             # an aborted run
             findings.append(Finding(
-                "R0", rel.replace(os.sep, "/"),
+                "R0", key,
                 getattr(e, "lineno", 0) or 0,
                 f"unparseable source: {getattr(e, 'msg', None) or e}",
                 "mvlint needs parseable sources",
             ))
+    rule_times["parse"] = time.perf_counter() - t0
+    if cfg.parse_cache_path:
+        _save_parse_cache(cfg.parse_cache_path, cache)
     mods = list(modules.values())
     graph = None
     for rule_fn in rules_mod.ALL_RULES:
+        t_rule = time.perf_counter()
         if getattr(rule_fn, "needs_graph", False):
             if graph is None:
                 from multiverso_tpu.analysis.dataflow import ProjectGraph
+                t_graph = time.perf_counter()
                 graph = ProjectGraph(mods)
+                dt = time.perf_counter() - t_graph
+                rule_times["graph"] = dt
+                t_rule += dt  # the graph is shared, not this rule's cost
             findings.extend(rule_fn(mods, cfg, graph))
         else:
             findings.extend(rule_fn(mods, cfg))
+        m = _RULE_ID_RE.search(rule_fn.__name__)
+        rid = f"R{m.group(1)}" if m else rule_fn.__name__
+        rule_times[rid] = rule_times.get(rid, 0.0) \
+            + (time.perf_counter() - t_rule)
     if cfg.restrict_paths is not None:
         keep = {p.replace(os.sep, "/") for p in cfg.restrict_paths}
         findings = [f for f in findings if f.path in keep]
@@ -321,6 +400,9 @@ def run_lint(
         suppressed=suppressed,
         files=len(files),
         runtime_s=time.perf_counter() - t0,
+        rule_times=rule_times,
+        files_reparsed=reparsed,
+        files_cached=reused,
     )
 
 

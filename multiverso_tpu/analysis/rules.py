@@ -706,6 +706,7 @@ def rule_r5_exact_paths(
 
 
 from multiverso_tpu.analysis import rules_spmd as _spmd  # noqa: E402
+from multiverso_tpu.analysis import rules_lifecycle as _life  # noqa: E402
 
 ALL_RULES = (
     rule_r1_collective_dispatch,
@@ -717,4 +718,7 @@ ALL_RULES = (
     _spmd.rule_r7_donation_aliasing,
     _spmd.rule_r8_retrace_churn,
     _spmd.rule_r9_cross_thread_state,
+    _life.rule_r10_resource_typestate,
+    _life.rule_r11_protocol_order,
+    _life.rule_r12_flag_constraints,
 )

@@ -1,0 +1,771 @@
+"""mvlint rules R10-R12 — the lifecycle/protocol families (v3).
+
+R1-R9 reason about reachability and races; the bugs this repo paid for
+in PRs 6, 8, 9 and 12 were *protocol* violations: resources whose
+state machine was driven out of order or never driven to its final
+state on some exit path, checkpoints committed out of protocol order,
+readiness flipped before restore landed, and flag implications
+re-implemented by hand until code and docs drifted apart.  These three
+families close that class on top of :mod:`analysis.typestate`:
+
+* **R10** — resource typestate: TaskPipe / ASyncBuffer / HealthServer /
+  TableServer / non-daemon Thread / ``MV_CreateTable`` bindings must
+  reach their final state on EVERY exit path (path-sensitive, with
+  ``with``/``finally`` recognition and interprocedural must-call
+  summaries), plus class-attribute and dashboard attach↔detach pairing;
+* **R11** — checkpoint/publish protocol order: ``commit_atomic`` must
+  be dominated by a verify in staging functions, ``publish`` must pass
+  the validation gate before installing a snapshot, ``drain()`` must
+  dominate any pipelined-depth save, and readiness may only flip to
+  True *after* restore/publish work, never before;
+* **R12** — flag-constraint conformance: ``config/constraints.py`` is
+  the single source of flag implications; a hand-rolled implication or
+  requirement CHECK elsewhere, or drift between the model and the
+  generated DEPLOY.md block, is a finding.
+
+Approximations err toward the runtime guards (``analysis/guards.py``,
+``config.constraints.check_options``) catching what static analysis
+cannot; suppression contracts live in ``analysis/RULES.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from multiverso_tpu.analysis.mvlint import Finding, LintConfig, Module
+from multiverso_tpu.analysis.dataflow import (
+    ClassInfo, FuncInfo, ProjectGraph, call_name,
+)
+from multiverso_tpu.analysis import typestate as ts
+
+# ------------------------------------------------------------------- R10
+
+_PIPE_SPEC = ts.ResourceSpec(
+    rtype="TaskPipe",
+    ctors=("TaskPipe",),
+    finalizers=("close", "break_pipe"),
+    uses=("submit", "submit_nowait"),
+    leak_hint=(
+        "close it in a finally (the worker thread and its queue outlive "
+        "the function otherwise — the bench drain-drill bug class)"
+    ),
+)
+_BUFFER_SPEC = ts.ResourceSpec(
+    rtype="ASyncBuffer",
+    ctors=("ASyncBuffer",),
+    finalizers=("Stop", "stop"),
+    leak_hint=(
+        "Stop() it on every exit path — the PR 8 reader bug left its "
+        "fill thread producing into an abandoned queue"
+    ),
+)
+_THREAD_SPEC = ts.ResourceSpec(
+    rtype="Thread",
+    ctors=("Thread",),
+    finalizers=("join",),
+    arm_methods=("start",),
+    daemon_exempt=True,
+    leak_hint=(
+        "join it on every exit path (R4 checks that a join EXISTS; this "
+        "is the path R4's lexical check cannot see)"
+    ),
+)
+_HEALTH_SPEC = ts.ResourceSpec(
+    rtype="HealthServer",
+    ctors=("HealthServer",),
+    finalizers=("stop",),
+    leak_hint="stop() it in a finally — it binds a TCP port and a thread",
+)
+_SERVER_SPEC = ts.ResourceSpec(
+    rtype="TableServer",
+    ctors=("TableServer",),
+    finalizers=("stop",),
+    arm_methods=("start",),
+    leak_hint="stop() every start()ed TableServer on every exit path",
+)
+_TABLE_SPEC = ts.ResourceSpec(
+    rtype="table handle",
+    ctors=("MV_CreateTable",),
+    finalizers=("release_tables",),
+    region_finalizers=("release_tables",),
+    allow_escape=False,
+    leak_hint=(
+        "pass it to release_tables() before returning — the PR 6 "
+        "registry leak pinned ~8 GB of host shards per bench sweep"
+    ),
+)
+
+_R10_SPECS = (
+    _PIPE_SPEC, _BUFFER_SPEC, _THREAD_SPEC, _HEALTH_SPEC, _SERVER_SPEC,
+    _TABLE_SPEC,
+)
+
+
+def _leak_finding(fn: FuncInfo, spec: ts.ResourceSpec,
+                  v: ts.Violation) -> Finding:
+    fins = "/".join(spec.finalizers)
+    return Finding(
+        "R10", fn.module.relpath, v.line,
+        f"{spec.rtype} {v.var!r} is created here but some exit path "
+        f"(return, raise, or a failing assert) never calls {fins}",
+        spec.leak_hint or f"call {fins} on every exit path",
+    )
+
+
+def _use_after_finding(fn: FuncInfo, spec: ts.ResourceSpec,
+                       v: ts.Violation) -> Finding:
+    return Finding(
+        "R10", fn.module.relpath, v.line,
+        f"use after finalize: {v.detail}",
+        "finalize exactly once, on the exit paths only",
+    )
+
+
+def rule_r10_resource_typestate(
+    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
+) -> List[Finding]:
+    # function-scope import: rules.py imports this module to build
+    # ALL_RULES, so a module-level import back would be a cycle
+    from multiverso_tpu.analysis.rules import _binding_joined
+
+    findings: List[Finding] = []
+    summaries = ts.Summaries(graph, _R10_SPECS)
+    mod_ids = {id(m) for m in modules}
+    for fn in graph.funcs.values():
+        if isinstance(fn.node, ast.Lambda) or id(fn.module) not in mod_ids:
+            continue
+        for spec in _R10_SPECS:
+            for v in ts.check_function(graph, fn, spec, summaries):
+                if spec is _THREAD_SPEC and v.kind == "leak":
+                    # R4 owns threads with NO join anywhere in scope; R10
+                    # only upgrades the check when a join exists lexically
+                    # but some path misses it — firing both would double-
+                    # report one bug.
+                    ci = graph.class_of_func(fn)
+                    scope = ci.node if ci is not None else fn.module.tree
+                    if not _binding_joined(v.var, scope):
+                        continue
+                if v.kind == "leak":
+                    findings.append(_leak_finding(fn, spec, v))
+                else:
+                    findings.append(_use_after_finding(fn, spec, v))
+    findings.extend(_attr_pairing(modules, graph))
+    findings.extend(_dashboard_pairing(modules))
+    return findings
+
+
+rule_r10_resource_typestate.needs_graph = True  # type: ignore[attr-defined]
+
+
+# class attribute -> the finalizer names that discharge it.  Threads are
+# deliberately absent: R4's lexical join check already owns attr-held
+# threads.
+_ATTR_FINALIZERS: Dict[str, Tuple[str, ...]] = {
+    "TaskPipe": ("close", "break_pipe"),
+    "ASyncBuffer": ("Stop", "stop"),
+    "HealthServer": ("stop", "close"),
+    "TableServer": ("stop",),
+}
+
+
+def _class_own_walk(cls: ast.ClassDef) -> Iterable[ast.AST]:
+    """Walk a class body without descending into nested classes (their
+    resources are their own problem)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(cls))
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            stack.append(child)
+
+
+def _attr_finalized(ci: ClassInfo, attr: str,
+                    fins: Tuple[str, ...]) -> bool:
+    """Loose pairing: SOME method both mentions ``self.<attr>`` and
+    calls a finalizer name.  Deliberately receiver-insensitive — the
+    repo's teardown idiom swaps the attribute into a local first
+    (``pipe, self._pipe = self._pipe, None; pipe.close()``)."""
+    for meth in _class_own_walk(ci.node):
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mentions = False
+        finalizes = False
+        for n in ast.walk(meth):
+            if isinstance(n, ast.Attribute) and n.attr == attr \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                mentions = True
+            if isinstance(n, ast.Call) and call_name(n.func) in fins:
+                finalizes = True
+        if mentions and finalizes:
+            return True
+    return False
+
+
+def _attr_armed(ci: ClassInfo, attr: str) -> bool:
+    """Is ``self.<attr>.start()`` ever driven (directly or fluently at
+    the assignment)?  An armless TableServer needs no stop."""
+    for n in _class_own_walk(ci.node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "start":
+            recv = n.func.value
+            if isinstance(recv, ast.Attribute) and recv.attr == attr:
+                return True
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Attribute) and t.attr == attr
+            for t in n.targets
+        ):
+            v = n.value
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                    and v.func.attr == "start":
+                return True
+    return False
+
+
+def _attr_daemon(ci: ClassInfo, attr: str, rtype: str) -> bool:
+    for n in _class_own_walk(ci.node):
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Attribute) and t.attr == attr
+            for t in n.targets
+        ):
+            for c in ast.walk(n.value):
+                if isinstance(c, ast.Call) and call_name(c.func) == rtype:
+                    for kw in c.keywords:
+                        if kw.arg == "daemon" and isinstance(
+                            kw.value, ast.Constant
+                        ) and kw.value.value is True:
+                            return True
+    return False
+
+
+def _attr_assign_line(ci: ClassInfo, attr: str, rtype: str) -> int:
+    for n in _class_own_walk(ci.node):
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Attribute) and t.attr == attr
+            for t in n.targets
+        ) and any(
+            isinstance(c, ast.Call) and call_name(c.func) == rtype
+            for c in ast.walk(n.value)
+        ):
+            return n.lineno
+    return ci.node.lineno
+
+
+def _attr_pairing(modules: Sequence[Module],
+                  graph: ProjectGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    mod_ids = {id(m) for m in modules}
+    for ci in graph.classes.values():
+        if id(ci.module) not in mod_ids:
+            continue
+        for attr in sorted(ci.attr_types):
+            for rtype in sorted(
+                ci.attr_types[attr] & set(_ATTR_FINALIZERS)
+            ):
+                fins = _ATTR_FINALIZERS[rtype]
+                if rtype == "TableServer" and not _attr_armed(ci, attr):
+                    continue
+                if _attr_daemon(ci, attr, rtype):
+                    continue
+                if _attr_finalized(ci, attr, fins):
+                    continue
+                findings.append(Finding(
+                    "R10", ci.module.relpath,
+                    _attr_assign_line(ci, attr, rtype),
+                    f"{ci.name}.{attr} holds a {rtype} but no method of "
+                    f"the class finalizes it ({'/'.join(fins)}) — the "
+                    "worker outlives its owner",
+                    f"call self.{attr}.{fins[0]}() from the owner's "
+                    "close()/stop()",
+                ))
+    return findings
+
+
+_TEARDOWN_NAMES = {
+    "close", "stop", "shutdown", "detach", "__exit__", "release",
+    "unregister",
+}
+
+
+def _section_key_is_per_instance(call: ast.Call) -> bool:
+    exprs = list(call.args[:1]) + [
+        kw.value for kw in call.keywords if kw.arg in ("key", "name")
+    ]
+    for e in exprs:
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call) and call_name(n.func) == "id":
+                return True
+    return False
+
+
+def _dashboard_pairing(modules: Sequence[Module]) -> List[Finding]:
+    """``Dashboard.add_section`` without a ``remove_section`` anywhere in
+    the same class leaks a section per instance — the PR 9 serving leak.
+    Process-lifetime singletons (no teardown method, constant key) are
+    exempt: their one section dies with the process by design."""
+    findings: List[Finding] = []
+    for m in modules:
+        for cls in ast.walk(m.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            adds = [
+                n for n in _class_own_walk(cls)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "add_section"
+            ]
+            if not adds:
+                continue
+            if any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "remove_section"
+                for n in _class_own_walk(cls)
+            ):
+                continue
+            has_teardown = any(
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name in _TEARDOWN_NAMES
+                for n in cls.body
+            )
+            for add in adds:
+                per_instance = _section_key_is_per_instance(add)
+                if not (has_teardown or per_instance):
+                    continue
+                why = (
+                    "per-instance key: every construction leaks a section"
+                    if per_instance else
+                    "the class has a teardown method that never detaches it"
+                )
+                findings.append(Finding(
+                    "R10", m.relpath, add.lineno,
+                    f"{cls.name} attaches a dashboard section with no "
+                    f"matching remove_section ({why}) — the PR 9 serving "
+                    "dashboard leak class",
+                    "call Dashboard.remove_section(key) from the owner's "
+                    "close()/stop()",
+                ))
+    return findings
+
+
+# ------------------------------------------------------------------- R11
+
+_READY_NAMES = ("set_ready", "_set_ready")
+_GATE_SUBSTRINGS = ("resume", "restore", "publish", "validate", "rollback")
+_SAVE_NAMES = (
+    "_ps_save_checkpoint", "save_checkpoint", "save_tables", "maybe_save",
+)
+
+
+def _stmt_line(cfg: ts.CFG, n: int) -> int:
+    stmt = cfg.stmt_of[n]
+    return stmt.lineno if stmt is not None else 0
+
+
+def _receiver_leaf(call: ast.Call) -> str:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            return recv.id
+        if isinstance(recv, ast.Attribute):
+            return recv.attr
+    return ""
+
+
+def _is_ready_flip(call: ast.Call) -> bool:
+    cn = call_name(call.func)
+    if cn == "set_serving_ready":
+        return True
+    if cn in _READY_NAMES:
+        return bool(call.args) and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value is True
+    return False
+
+
+def _is_gate_call(call: ast.Call) -> bool:
+    cn = call_name(call.func).lower()
+    return any(s in cn for s in _GATE_SUBSTRINGS)
+
+
+def _reachable_from(cfg: ts.CFG, start: int) -> Set[int]:
+    seen: Set[int] = set()
+    stack = list(cfg.succ[start])
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(cfg.succ[n])
+    return seen
+
+
+def _assigns_snapshot(stmt: Optional[ast.stmt]) -> bool:
+    if not isinstance(stmt, ast.Assign):
+        return False
+    return any(
+        isinstance(t, ast.Attribute) and "snapshot" in t.attr.lower()
+        for t in stmt.targets
+    )
+
+
+def rule_r11_protocol_order(
+    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
+) -> List[Finding]:
+    findings: List[Finding] = []
+    mod_ids = {id(m) for m in modules}
+    for fn in graph.funcs.values():
+        if isinstance(fn.node, ast.Lambda) or id(fn.module) not in mod_ids:
+            continue
+        called = {
+            call_name(n.func) for n in graph.own_nodes(fn)
+            if isinstance(n, ast.Call)
+        }
+        commits = "commit_atomic" in called
+        stages = any("stage" in c.lower() for c in called)
+        submits = bool(called & {"submit", "submit_nowait"})
+        readies = bool(called & (set(_READY_NAMES)
+                                 | {"set_serving_ready"}))
+        publishes = fn.name.startswith("publish")
+        if not (commits or submits or readies or publishes):
+            continue
+        fcfg = ts.build_cfg(fn.node)
+
+        # (a) stage -> verify -> commit: in a function that stages a
+        # checkpoint record, the atomic commit must be dominated by a
+        # verify of what was staged (quorum-commit protocol).
+        if commits and stages:
+            verify_nodes = ts.nodes_where(
+                fcfg, lambda c: "verify" in call_name(c.func).lower()
+            )
+            for n in sorted(ts.nodes_where(
+                fcfg, lambda c: call_name(c.func) == "commit_atomic"
+            )):
+                if not ts.must_pass(fcfg, n, verify_nodes):
+                    findings.append(Finding(
+                        "R11", fn.module.relpath, _stmt_line(fcfg, n),
+                        "commit_atomic is reachable without passing a "
+                        "verify of the staged checkpoint (stage -> "
+                        "verify -> commit is the quorum protocol)",
+                        "verify the staged payload on every path into "
+                        "the commit",
+                    ))
+
+        # (b) publish installs a snapshot only past the validation gate.
+        if publishes:
+            gate_nodes = ts.nodes_where(
+                fcfg, lambda c: any(
+                    s in call_name(c.func).lower()
+                    for s in ("validate", "verify")
+                )
+            )
+            for n in range(len(fcfg.stmt_of)):
+                if not _assigns_snapshot(fcfg.stmt_of[n]):
+                    continue
+                if not ts.must_pass(fcfg, n, gate_nodes):
+                    findings.append(Finding(
+                        "R11", fn.module.relpath, _stmt_line(fcfg, n),
+                        f"{fn.name}() installs a serving snapshot on a "
+                        "path that skips the validation gate (a bad "
+                        "snapshot must be rejected, not served)",
+                        "route every install through _validate_host() "
+                        "(raise PublishRejected on problems)",
+                    ))
+
+        # (c) drain() dominates any pipelined-depth save: a checkpoint
+        # taken with submitted work still in flight captures a torn
+        # round boundary.
+        if submits:
+            gen = ts.nodes_where(fcfg, lambda c: (
+                call_name(c.func) in ("submit", "submit_nowait")
+                and "pipe" in _receiver_leaf(c).lower()
+            ))
+            kill = ts.nodes_where(fcfg, lambda c: (
+                call_name(c.func) in ("drain", "close", "break_pipe")
+                and "pipe" in _receiver_leaf(c).lower()
+            ))
+            saves = ts.nodes_where(
+                fcfg, lambda c: call_name(c.func) in _SAVE_NAMES
+            )
+            if gen and saves:
+                for n in sorted(ts.may_pending(fcfg, gen, kill, saves)):
+                    findings.append(Finding(
+                        "R11", fn.module.relpath, _stmt_line(fcfg, n),
+                        "checkpoint save is reachable with submitted "
+                        "pipe work still in flight — drain() must "
+                        "dominate every pipelined-depth save",
+                        "pipe.drain() on every path into the save (the "
+                        "planned-checkpoint boundary idiom)",
+                    ))
+
+        # (d) readiness may only flip to True AFTER restore/publish
+        # work: a True flip from which a gate call is still reachable
+        # serves traffic from a rank that is still restoring.
+        if readies and not publishes \
+                and fn.name not in ("set_ready", "_set_ready",
+                                    "set_serving_ready"):
+            gate_nodes = ts.nodes_where(fcfg, _is_gate_call)
+            for n in sorted(ts.nodes_where(fcfg, _is_ready_flip)):
+                hit = _reachable_from(fcfg, n) & gate_nodes
+                if not hit:
+                    continue
+                gname = next((
+                    call_name(c.func)
+                    for c in ts.node_calls(fcfg, sorted(hit)[0])
+                    if _is_gate_call(c)
+                ), "restore")
+                findings.append(Finding(
+                    "R11", fn.module.relpath, _stmt_line(fcfg, n),
+                    "readiness flips to True while "
+                    f"{gname}() work is still ahead — probes can route "
+                    "traffic to a rank that has not finished restoring",
+                    "flip readiness after the restore/publish path "
+                    "completes (alive-vs-ready wiring, ISSUE 7)",
+                ))
+    return findings
+
+
+rule_r11_protocol_order.needs_graph = True  # type: ignore[attr-defined]
+
+
+# ------------------------------------------------------------------- R12
+
+class _FlagModel:
+    __slots__ = ("module", "line", "implications", "requirements",
+                 "all_flags")
+
+    def __init__(self, module: Module, line: int,
+                 implications: List[Tuple[str, str, str]],
+                 requirements: List[Tuple[str, Tuple[str, ...]]]) -> None:
+        self.module = module
+        self.line = line
+        self.implications = implications  # (name, trigger, flag)
+        self.requirements = requirements  # (name, sorted flags)
+        self.all_flags: Set[str] = set()
+        for _n, trig, flag in implications:
+            self.all_flags |= {trig, flag}
+        for _n, flags in requirements:
+            self.all_flags |= set(flags)
+
+
+def _const_kw(call: ast.Call, name: str) -> Optional[object]:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value
+    return None
+
+
+def _extract_flag_model(modules: Sequence[Module]) -> Optional[_FlagModel]:
+    """AST-read the first IMPLICATIONS/REQUIREMENTS declarations in the
+    scan — no import, so fixture models work standalone."""
+    for m in modules:
+        imps: List[Tuple[str, str, str]] = []
+        reqs: List[Tuple[str, Tuple[str, ...]]] = []
+        line = 0
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tname = node.targets[0].id
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                tname = node.target.id
+            else:
+                continue
+            if tname not in ("IMPLICATIONS", "REQUIREMENTS"):
+                continue
+            line = line or node.lineno
+            for call in ast.walk(node.value):
+                if not isinstance(call, ast.Call):
+                    continue
+                cn = call_name(call.func)
+                if cn == "Implication":
+                    name = _const_kw(call, "name")
+                    trig = _const_kw(call, "trigger")
+                    flag = _const_kw(call, "flag")
+                    if isinstance(trig, str) and isinstance(flag, str):
+                        imps.append((str(name or flag), trig, flag))
+                elif cn == "Requirement":
+                    name = _const_kw(call, "name")
+                    flags: Tuple[str, ...] = ()
+                    for kw in call.keywords:
+                        if kw.arg == "flags" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)
+                        ):
+                            flags = tuple(sorted(
+                                e.value for e in kw.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)
+                            ))
+                    if flags:
+                        reqs.append((str(name or "/".join(flags)), flags))
+        if imps or reqs:
+            return _FlagModel(m, line or 1, imps, reqs)
+    return None
+
+
+def _attrs_in(node: ast.AST) -> Set[str]:
+    return {
+        n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)
+    }
+
+
+def _r12_reimplemented_implications(
+    m: Module, model: _FlagModel
+) -> List[Finding]:
+    """An assignment to an implied flag, inside an ``if`` over its
+    trigger flag, re-implements the model by hand (the exact shape the
+    old app.py tier block had).  Unconditional writes — bench sweeps
+    configuring an option set — are legitimate."""
+    findings: List[Finding] = []
+    forced_by: Dict[str, Set[str]] = {}
+    for _name, trig, flag in model.implications:
+        forced_by.setdefault(flag, set()).add(trig)
+    triggers = {t for _n, t, _f in model.implications}
+
+    def visit(node: ast.AST, active: Set[str]) -> None:
+        if isinstance(node, ast.If):
+            tested = _attrs_in(node.test) & triggers
+            for child in node.body + node.orelse:
+                visit(child, active | tested)
+            return
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if not isinstance(t, ast.Attribute):
+                continue
+            trigs = forced_by.get(t.attr, set()) & active
+            if trigs:
+                findings.append(Finding(
+                    "R12", m.relpath, node.lineno,
+                    f"hand-written implication: {t.attr} is forced "
+                    f"under a test of -{sorted(trigs)[0]}, which "
+                    "config/constraints.py already owns",
+                    "delete the inline rewrite; "
+                    "constraints.apply_implications() is the single "
+                    "source",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, active)
+
+    visit(m.tree, set())
+    return findings
+
+
+def _r12_reimplemented_requirements(
+    m: Module, model: _FlagModel
+) -> List[Finding]:
+    findings: List[Finding] = []
+    multi = [(n, set(f)) for n, f in model.requirements if len(f) > 1]
+    if not multi:
+        return findings
+    for node in ast.walk(m.tree):
+        expr: Optional[ast.AST] = None
+        if isinstance(node, ast.Call) and call_name(node.func) == "CHECK":
+            expr = node
+        elif isinstance(node, ast.Assert):
+            expr = node.test
+        if expr is None:
+            continue
+        mentioned = _attrs_in(expr)
+        for name, flags in multi:
+            if flags <= mentioned:
+                findings.append(Finding(
+                    "R12", m.relpath, node.lineno,
+                    f"hand-written CHECK couples {'+'.join(sorted(flags))}"
+                    f" — requirement '{name}' in config/constraints.py "
+                    "already owns that pair",
+                    "delete the inline CHECK; "
+                    "constraints.check_options() enforces the model",
+                ))
+                break
+    return findings
+
+
+_REAL_MODEL_RELPATH = "multiverso_tpu/config/constraints.py"
+
+
+def _r12_doc_drift(model: _FlagModel, cfg: LintConfig) -> List[Finding]:
+    """The DEPLOY.md block between the mvlint markers must be byte-equal
+    to ``render_markdown()`` — regenerated, never hand-edited.  Only the
+    real repo model is importable; fixture models skip the doc check."""
+    if model.module.relpath != _REAL_MODEL_RELPATH:
+        return []
+    try:
+        from multiverso_tpu.config import constraints as live
+    except ImportError:  # pragma: no cover - the real model always imports
+        return []
+    findings: List[Finding] = []
+    rendered = live.render_markdown()
+    for doc in cfg.doc_files:
+        if os.path.basename(doc) != "DEPLOY.md" or not os.path.exists(doc):
+            continue
+        with open(doc, encoding="utf-8") as fh:
+            text = fh.read()
+        if live.MARKER_BEGIN not in text or live.MARKER_END not in text:
+            findings.append(Finding(
+                "R12", model.module.relpath, model.line,
+                "DEPLOY.md has no generated flag-constraints block — "
+                "the implications/requirements in the model are "
+                "undocumented",
+                "insert the output of `python -m multiverso_tpu.analysis "
+                "--constraint-table` into DEPLOY.md",
+            ))
+            continue
+        start = text.index(live.MARKER_BEGIN)
+        end = text.index(live.MARKER_END) + len(live.MARKER_END)
+        if text[start:end] != rendered:
+            findings.append(Finding(
+                "R12", model.module.relpath, model.line,
+                "DEPLOY.md flag-constraints block drifted from "
+                "config/constraints.py",
+                "regenerate it: `python -m multiverso_tpu.analysis "
+                "--constraint-table` (edit the model, not the block)",
+            ))
+    return findings
+
+
+def _r12_registry_drift(modules: Sequence[Module],
+                        model: _FlagModel) -> List[Finding]:
+    defined: Set[str] = set()
+    for m in modules:
+        for n in ast.walk(m.tree):
+            if isinstance(n, ast.Call) \
+                    and call_name(n.func).startswith("MV_DEFINE") \
+                    and n.args and isinstance(n.args[0], ast.Constant):
+                defined.add(n.args[0].value)
+    if not defined:
+        return []
+    return [
+        Finding(
+            "R12", model.module.relpath, model.line,
+            f"constraint model references flag -{flag}, which no "
+            "MV_DEFINE_* in the scan registers",
+            "fix the flag name in the model (or register the flag)",
+        )
+        for flag in sorted(model.all_flags - defined)
+    ]
+
+
+def rule_r12_flag_constraints(
+    modules: Sequence[Module], cfg: LintConfig
+) -> List[Finding]:
+    model = _extract_flag_model(modules)
+    if model is None:
+        return []
+    findings: List[Finding] = []
+    for m in modules:
+        if m is model.module:
+            continue
+        findings.extend(_r12_reimplemented_implications(m, model))
+        findings.extend(_r12_reimplemented_requirements(m, model))
+    findings.extend(_r12_doc_drift(model, cfg))
+    findings.extend(_r12_registry_drift(modules, model))
+    return findings

@@ -1,0 +1,882 @@
+"""Typestate engine for mvlint v3 (rules R10-R11).
+
+R1-R9 reason about reachability and data races; the bug classes this
+repo has actually paid for in PRs 6, 8, 9 and 12 were *protocol* bugs:
+a resource whose finite-state machine (created -> armed -> finalized)
+was driven out of order, or never driven to its final state on some
+exit path.  This module checks those machines statically:
+
+* a **per-function CFG** over statements, with explicit ``raise`` /
+  ``assert`` edges and *continuation-aware* ``try/finally`` + ``with``
+  lowering — the ``finally`` body is copied per continuation (normal,
+  return, raise, break, continue), so a ``close()`` in a ``finally``
+  dominates every exit without fabricating close-then-loop-again paths
+  that would flag the pipelined PS loop's own idiom;
+* a **resource dataflow**: each tracked binding carries a state set
+  {UNARMED, OPEN, CLOSED, ESCAPED} through the CFG; a finalizer call
+  moves OPEN to CLOSED, a ``use`` while possibly CLOSED is a
+  use-after-finalize violation, OPEN reaching EXIT is a leak;
+* **interprocedural must-call summaries** via the same fixpoint shape
+  ``dataflow.py`` uses: a helper that finalizes its parameter on every
+  exit path counts as a finalizer at its call sites, and a helper that
+  unconditionally calls a *region* finalizer (``release_tables``)
+  discharges the region at its call sites;
+* **path queries** for the protocol-ordering rules: ``must_pass``
+  (every ENTRY->target path crosses a blocker — stage->verify->commit,
+  drain-dominates-save) and ``may_pending`` (gen/kill reachability —
+  submitted-but-not-drained at a save site).
+
+Everything is pure-``ast`` over ``dataflow.ProjectGraph`` facts;
+nothing imports the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional, \
+    Sequence, Set, Tuple
+
+from multiverso_tpu.analysis.dataflow import (
+    FuncInfo, ProjectGraph, call_name, receiver_of,
+)
+
+__all__ = [
+    "CFG",
+    "build_cfg",
+    "ResourceSpec",
+    "Violation",
+    "Summaries",
+    "local_resources",
+    "check_function",
+    "must_pass",
+    "may_pending",
+    "nodes_where",
+]
+
+# resource states
+UNARMED = "unarmed"
+OPEN = "open"
+CLOSED = "closed"
+ESCAPED = "escaped"
+
+
+# ---------------------------------------------------------------------------
+# CFG
+# ---------------------------------------------------------------------------
+
+class CFG:
+    """Statement-level control-flow graph of one function body.
+
+    Nodes are ints; ``stmt_of[n]`` is the AST statement the node stands
+    for (``None`` for ENTRY/EXIT and synthetic join nodes).  A statement
+    can back several nodes — ``finally`` bodies are copied once per
+    continuation kind — so queries go node -> stmt, and ``nodes_of``
+    maps a statement back to every copy.  ``with_exit_vars[n]`` lists
+    the context-manager variable names whose ``__exit__`` runs at node
+    ``n`` (the ``with``/``finally`` recognition R10 needs)."""
+
+    ENTRY = 0
+    EXIT = 1
+
+    def __init__(self) -> None:
+        self.stmt_of: List[Optional[ast.stmt]] = [None, None]
+        self.succ: List[Set[int]] = [set(), set()]
+        self.nodes_of: Dict[int, List[int]] = {}  # id(stmt) -> nodes
+        self.with_exit_vars: Dict[int, Tuple[str, ...]] = {}
+
+    def new_node(self, stmt: Optional[ast.stmt]) -> int:
+        n = len(self.stmt_of)
+        self.stmt_of.append(stmt)
+        self.succ.append(set())
+        if stmt is not None:
+            self.nodes_of.setdefault(id(stmt), []).append(n)
+        return n
+
+    def connect(self, frontier: Iterable[int], node: int) -> None:
+        for f in frontier:
+            self.succ[f].add(node)
+
+    def preds(self) -> List[Set[int]]:
+        out: List[Set[int]] = [set() for _ in self.stmt_of]
+        for n, succs in enumerate(self.succ):
+            for s in succs:
+                out[s].add(n)
+        return out
+
+
+class _Frame:
+    """One entry of the builder's unwind stack.
+
+    ``finally`` and ``with`` frames carry a cleanup body that every
+    continuation leaving the frame must execute; ``except`` frames
+    catch in-flight raises; ``loop`` frames anchor break/continue."""
+
+    __slots__ = ("kind", "stmts", "with_stmt", "with_vars",
+                 "header", "after")
+
+    def __init__(self, kind: str, *, stmts: Sequence[ast.stmt] = (),
+                 with_stmt: Optional[ast.stmt] = None,
+                 with_vars: Tuple[str, ...] = (),
+                 header: int = -1, after: int = -1) -> None:
+        self.kind = kind  # "finally" | "with" | "except" | "loop"
+        self.stmts = list(stmts)
+        self.with_stmt = with_stmt
+        self.with_vars = with_vars
+        self.header = header  # loop: continue target
+        self.after = after    # loop: break target (join node)
+
+
+class _Builder:
+    def __init__(self, fn_node: ast.AST) -> None:
+        self.cfg = CFG()
+        self.frames: List[_Frame] = []
+        body = getattr(fn_node, "body", [])
+        frontier = self._seq(body, {CFG.ENTRY})
+        self.cfg.connect(frontier, CFG.EXIT)
+
+    # -- continuation routing -------------------------------------------
+
+    def _cleanup_node(self, frame: _Frame, target: int) -> int:
+        """A fresh copy of ``frame``'s cleanup whose exit goes to
+        ``target``; returns the copy's entry node."""
+        if frame.kind == "with":
+            n = self.cfg.new_node(frame.with_stmt)
+            self.cfg.with_exit_vars[n] = frame.with_vars
+            self.cfg.succ[n].add(target)
+            return n
+        # finally: rebuild the body with fresh nodes.  The body runs
+        # OUTSIDE the frame it cleans (a raise inside a finally leaves
+        # through the outer frames), which the recursion models by the
+        # frame already being popped conceptually — we splice around it
+        # by temporarily dropping it from the stack.
+        idx = self.frames.index(frame)
+        saved = self.frames
+        self.frames = saved[:idx]
+        entry_mark = len(self.cfg.stmt_of)
+        frontier = self._seq(frame.stmts, set())
+        self.frames = saved
+        if entry_mark == len(self.cfg.stmt_of):  # empty finally body
+            return target
+        self.cfg.connect(frontier, target)
+        # entry is the first node the sequence created
+        return entry_mark
+
+    def _route(self, kind: str, jumpers: Set[int]) -> None:
+        """Connect ``jumpers`` to the continuation ``kind`` ("return",
+        "raise", "break", "continue") through every intervening cleanup
+        frame (innermost first)."""
+        cleanups: List[_Frame] = []
+        target = CFG.EXIT
+        for frame in reversed(self.frames):
+            if frame.kind in ("finally", "with"):
+                cleanups.append(frame)
+            elif frame.kind == "except" and kind == "raise":
+                # caught here: handler entries were wired when the try
+                # body was built; an explicit raise just flows to them
+                target = -1
+                break
+            elif frame.kind == "loop" and kind in ("break", "continue"):
+                target = frame.after if kind == "break" else frame.header
+                break
+        if target == -1:
+            return
+        for frame in cleanups:  # innermost cleanup runs first
+            target = self._cleanup_node(frame, target)
+        self.cfg.connect(jumpers, target)
+
+    def _handler_entries(self) -> List[int]:
+        """Pending-handler entry nodes of the innermost except frame (a
+        statement that may raise flows there), crossing with/finally
+        cleanups on the way."""
+        out: List[int] = []
+        cleanups: List[_Frame] = []
+        for frame in reversed(self.frames):
+            if frame.kind in ("finally", "with"):
+                cleanups.append(frame)
+            elif frame.kind == "except":
+                for entry in frame.stmts:  # reused: handler entry nodes
+                    tgt = entry
+                    for c in cleanups:
+                        tgt = self._cleanup_node(c, tgt)
+                    out.append(tgt)
+                break
+        return out
+
+    # -- structure -------------------------------------------------------
+
+    def _seq(self, stmts: Sequence[ast.stmt], frontier: Set[int]
+             ) -> Set[int]:
+        for stmt in stmts:
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _stmt(self, stmt: ast.stmt, frontier: Set[int]) -> Set[int]:
+        cfg = self.cfg
+        if isinstance(stmt, (ast.If,)):
+            n = cfg.new_node(stmt)
+            cfg.connect(frontier, n)
+            out = self._seq(stmt.body, {n})
+            out |= self._seq(stmt.orelse, {n}) if stmt.orelse else {n}
+            return out
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            header = cfg.new_node(stmt)
+            after = cfg.new_node(None)  # join for breaks + loop exit
+            cfg.connect(frontier, header)
+            self.frames.append(_Frame("loop", header=header, after=after))
+            body_out = self._seq(stmt.body, {header})
+            self.frames.pop()
+            cfg.connect(body_out, header)  # back edge
+            else_out = self._seq(stmt.orelse, {header}) if stmt.orelse \
+                else {header}
+            cfg.connect(else_out, after)
+            return {after}
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = cfg.new_node(stmt)
+            cfg.connect(frontier, n)
+            wvars = tuple(
+                v for item in stmt.items
+                for v in _with_item_vars(item)
+            )
+            frame = _Frame("with", with_stmt=stmt, with_vars=wvars)
+            self.frames.append(frame)
+            body_out = self._seq(stmt.body, {n})
+            self.frames.pop()
+            exit_n = cfg.new_node(stmt)
+            cfg.with_exit_vars[exit_n] = wvars
+            cfg.connect(body_out, exit_n)
+            return {exit_n}
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+        if isinstance(stmt, ast.Return):
+            n = cfg.new_node(stmt)
+            cfg.connect(frontier, n)
+            self._route("return", {n})
+            return set()
+        if isinstance(stmt, ast.Raise):
+            n = cfg.new_node(stmt)
+            cfg.connect(frontier, n)
+            handlers = self._handler_entries()
+            if handlers:
+                for h in handlers:
+                    cfg.succ[n].add(h)
+            else:
+                self._route("raise", {n})
+            return set()
+        if isinstance(stmt, ast.Assert):
+            n = cfg.new_node(stmt)
+            cfg.connect(frontier, n)
+            handlers = self._handler_entries()
+            if handlers:
+                for h in handlers:
+                    cfg.succ[n].add(h)
+            else:
+                self._route("raise", {n})
+            return {n}  # and the passing case falls through
+        if isinstance(stmt, ast.Break):
+            n = cfg.new_node(stmt)
+            cfg.connect(frontier, n)
+            self._route("break", {n})
+            return set()
+        if isinstance(stmt, ast.Continue):
+            n = cfg.new_node(stmt)
+            cfg.connect(frontier, n)
+            self._route("continue", {n})
+            return set()
+        # simple statement (incl. nested def/class headers)
+        n = cfg.new_node(stmt)
+        cfg.connect(frontier, n)
+        return {n}
+
+    def _try(self, stmt: ast.Try, frontier: Set[int]) -> Set[int]:
+        cfg = self.cfg
+        fin_frame = _Frame("finally", stmts=stmt.finalbody) \
+            if stmt.finalbody else None
+        # handler entry placeholders so body raises have a target
+        handler_entries: List[int] = []
+        exc_frame = None
+        if stmt.handlers:
+            handler_entries = [cfg.new_node(None) for _ in stmt.handlers]
+            exc_frame = _Frame("except", stmts=handler_entries)
+        if fin_frame is not None:
+            self.frames.append(fin_frame)
+        if exc_frame is not None:
+            self.frames.append(exc_frame)
+        body_mark = len(cfg.stmt_of)
+        body_out = self._seq(stmt.body, set(frontier))
+        body_nodes = range(body_mark, len(cfg.stmt_of))
+        # any statement of the body may raise into the handlers
+        for bn in body_nodes:
+            for h in handler_entries:
+                cfg.succ[bn].add(h)
+        if handler_entries and frontier:
+            # the first body statement may raise before running at all
+            for f in frontier:
+                for h in handler_entries:
+                    cfg.succ[f].add(h)
+        if exc_frame is not None:
+            self.frames.pop()  # handlers do not catch their own raises
+        out = self._seq(stmt.orelse, body_out) if stmt.orelse else body_out
+        for entry, handler in zip(handler_entries, stmt.handlers):
+            h_out = self._seq(handler.body, {entry})
+            out |= h_out
+        if fin_frame is not None:
+            self.frames.pop()
+            # normal continuation runs the finally once
+            fin_entry_mark = len(cfg.stmt_of)
+            fin_out = self._seq(stmt.finalbody, set())
+            if fin_entry_mark == len(cfg.stmt_of):
+                return out
+            cfg.connect(out, fin_entry_mark)
+            return fin_out
+        return out
+
+
+def _with_item_vars(item: ast.withitem) -> Tuple[str, ...]:
+    names: List[str] = []
+    if isinstance(item.optional_vars, ast.Name):
+        names.append(item.optional_vars.id)
+    if isinstance(item.context_expr, ast.Name):
+        names.append(item.context_expr.id)
+    return tuple(names)
+
+
+# keyed by id() but holding the node itself: the reference pins the AST
+# alive, so a cached id can never be recycled by a different node (tests
+# run many lints in one process)
+_CFG_CACHE: Dict[int, Tuple[ast.AST, CFG]] = {}
+
+
+def build_cfg(fn_node: ast.AST) -> CFG:
+    cached = _CFG_CACHE.get(id(fn_node))
+    if cached is not None and cached[0] is fn_node:
+        return cached[1]
+    if len(_CFG_CACHE) > 8192:
+        _CFG_CACHE.clear()
+        _PRED_CACHE.clear()
+    cfg = _Builder(fn_node).cfg
+    _CFG_CACHE[id(fn_node)] = (fn_node, cfg)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Statement event extraction
+# ---------------------------------------------------------------------------
+
+def _header_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a CFG node for ``stmt`` actually evaluates —
+    compound statements contribute only their header (their bodies are
+    separate nodes)."""
+    if isinstance(stmt, ast.If):
+        return [stmt.test]
+    if isinstance(stmt, ast.While):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value else []
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [e for e in (stmt.test, stmt.msg) if e is not None]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Try)):
+        return []
+    return [stmt]
+
+
+def _walk_no_defs(roots: Iterable[ast.AST]) -> Iterable[ast.AST]:
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def node_calls(cfg: CFG, n: int) -> List[ast.Call]:
+    stmt = cfg.stmt_of[n]
+    if stmt is None:
+        return []
+    out = [c for c in _walk_no_defs(_header_exprs(stmt))
+           if isinstance(c, ast.Call)]
+    out.sort(key=lambda c: (c.lineno, c.col_offset))
+    return out
+
+
+def nodes_where(cfg: CFG, pred: Callable[[ast.Call], bool]) -> Set[int]:
+    """Nodes containing at least one call matching ``pred``."""
+    out: Set[int] = set()
+    for n in range(len(cfg.stmt_of)):
+        if any(pred(c) for c in node_calls(cfg, n)):
+            out.add(n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Resource specs + dataflow
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    """One protocol the typestate checker enforces.
+
+    ``arm_methods`` empty means the resource is live from construction
+    (TaskPipe spawns its worker in ``__init__``); otherwise it only
+    needs finalizing once armed (a never-``start()``ed Thread needs no
+    join).  ``region_finalizers`` discharge EVERY live resource of the
+    spec at the call site regardless of receiver — the
+    ``release_tables``-by-registry-diff idiom can't be tracked through
+    a variable.  ``allow_escape`` controls whether passing the binding
+    to an unresolved callee transfers ownership (True for thread-like
+    resources; False for registry-pinned tables, where only an explicit
+    release or a return discharges)."""
+
+    rtype: str
+    ctors: Tuple[str, ...]
+    finalizers: Tuple[str, ...]
+    uses: Tuple[str, ...] = ()
+    arm_methods: Tuple[str, ...] = ()
+    region_finalizers: Tuple[str, ...] = ()
+    allow_escape: bool = True
+    daemon_exempt: bool = False
+    leak_hint: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    kind: str          # "leak" | "use_after_finalize"
+    spec: ResourceSpec
+    var: str
+    line: int
+    detail: str
+
+
+def _call_has_true_kwarg(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+def _ctor_call_of(value: ast.AST, spec: ResourceSpec
+                  ) -> Optional[Tuple[ast.Call, bool]]:
+    """``(ctor_call, armed_at_birth)`` if ``value`` constructs ``spec``
+    — either plainly (``TaskPipe(...)``) or fluently through an arm
+    method (``TableServer(...).start()``, which binds an already-armed
+    resource)."""
+    if not isinstance(value, ast.Call):
+        return None
+    if call_name(value.func) in spec.ctors:
+        return value, not spec.arm_methods
+    if isinstance(value.func, ast.Attribute) \
+            and value.func.attr in spec.arm_methods \
+            and isinstance(value.func.value, ast.Call) \
+            and call_name(value.func.value.func) in spec.ctors:
+        return value.func.value, True
+    return None
+
+
+def local_resources(graph: ProjectGraph, fn: FuncInfo, spec: ResourceSpec
+                    ) -> List[Tuple[str, ast.stmt, ast.Call, bool]]:
+    """``var = Ctor(...)`` bindings of ``spec`` owned by ``fn`` itself
+    (``var, stmt, ctor_call, armed_at_birth`` tuples).  Multi-target
+    assigns (``a = self._b = Ctor()``) escape at birth and are left to
+    the class-level pairing checks."""
+    out: List[Tuple[str, ast.stmt, ast.Call, bool]] = []
+    for node in graph.own_nodes(fn):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        hit = _ctor_call_of(node.value, spec)
+        if hit is None:
+            continue
+        call, armed = hit
+        if spec.daemon_exempt and _call_has_true_kwarg(call, "daemon"):
+            continue
+        out.append((node.targets[0].id, node, call, armed))
+    return out
+
+
+def _param_names(fn_node: ast.AST) -> List[str]:
+    args = getattr(fn_node, "args", None)
+    if args is None:
+        return []
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names + [a.arg for a in args.kwonlyargs]
+
+
+class Summaries:
+    """Interprocedural must-call facts, one fixpoint per rule run.
+
+    ``closes_param[uid]`` maps a function to the parameter names it
+    finalizes (per spec rtype) on EVERY exit path; ``region_always``
+    holds the functions that unconditionally reach a region finalizer.
+    Both feed back into the intraprocedural transfer, so a
+    ``_teardown(pipe)`` helper counts exactly like ``pipe.close()``."""
+
+    def __init__(self, graph: ProjectGraph,
+                 specs: Sequence[ResourceSpec]) -> None:
+        self.graph = graph
+        self.specs = list(specs)
+        # uid -> rtype -> frozenset(param names always finalized)
+        self.closes_param: Dict[int, Dict[str, FrozenSet[str]]] = {}
+        # rtype -> set of uids that always region-finalize
+        self.region_always: Dict[str, Set[int]] = {
+            s.rtype: set() for s in specs
+        }
+        self._called_names: Dict[int, FrozenSet[str]] = {}
+        self._fixpoint()
+
+    def _names_called(self, fn: FuncInfo) -> FrozenSet[str]:
+        cached = self._called_names.get(fn.uid)
+        if cached is None:
+            cached = frozenset(
+                call_name(n.func) for n in self.graph.own_nodes(fn)
+                if isinstance(n, ast.Call)
+            )
+            self._called_names[fn.uid] = cached
+        return cached
+
+    def _may_finalize(self, fn: FuncInfo, spec: ResourceSpec) -> bool:
+        """Cheap prescreen: can this function possibly finalize anything
+        of ``spec``, directly or through a currently-summarized callee?
+        Monotone, so a False that turns True is caught next pass."""
+        called = self._names_called(fn)
+        if called & set(spec.finalizers + spec.region_finalizers):
+            return True
+        for callee in self.graph.callees(fn):
+            if self.closes_param.get(callee.uid, {}).get(spec.rtype):
+                return True
+            if callee.uid in self.region_always.get(spec.rtype, ()):
+                return True
+        return False
+
+    def _fixpoint(self) -> None:
+        funcs = [
+            fn for fn in self.graph.funcs.values()
+            if not isinstance(fn.node, ast.Lambda)
+        ]
+        for _ in range(6):  # call chains deeper than this don't occur
+            changed = False
+            for fn in funcs:
+                for spec in self.specs:
+                    changed |= self._summarize(fn, spec)
+            if not changed:
+                return
+
+    def _summarize(self, fn: FuncInfo, spec: ResourceSpec) -> bool:
+        changed = False
+        if not self._may_finalize(fn, spec):
+            return False
+        params = _param_names(fn.node)
+        cfg = build_cfg(fn.node)
+        closed: Set[str] = set()
+        names_used = {
+            n.id for n in self.graph.own_nodes(fn)
+            if isinstance(n, ast.Name)
+        }
+        for p in params:
+            if p not in names_used:
+                continue
+            states = _flow(self.graph, fn, cfg, spec, p,
+                           start_nodes=(CFG.ENTRY,), summaries=self,
+                           collect=None)
+            exit_states = states.get(CFG.EXIT, frozenset())
+            if exit_states and exit_states <= {CLOSED}:
+                closed.add(p)
+        prev = self.closes_param.setdefault(fn.uid, {})
+        new = frozenset(closed)
+        if prev.get(spec.rtype) != new:
+            prev[spec.rtype] = new
+            changed = True
+        if spec.region_finalizers:
+            states = _flow(self.graph, fn, cfg, spec, None,
+                           start_nodes=(CFG.ENTRY,), summaries=self,
+                           collect=None)
+            exit_states = states.get(CFG.EXIT, frozenset())
+            always = bool(exit_states) and exit_states <= {CLOSED}
+            reg = self.region_always[spec.rtype]
+            if always and fn.uid not in reg:
+                reg.add(fn.uid)
+                changed = True
+        return changed
+
+    # -- call-site queries ----------------------------------------------
+
+    def call_finalizes_arg(self, fn: FuncInfo, call: ast.Call,
+                           spec: ResourceSpec, var: str
+                           ) -> Optional[bool]:
+        """Does passing ``var`` to ``call`` finalize it?  True = yes on
+        all callee paths; False = resolved callee does not; None = the
+        callee is outside the scan (ownership unknown)."""
+        callees = self.graph._resolve_name_or_attr(fn, call.func)
+        if not callees:
+            return None
+        ok = False
+        for callee in callees:
+            params = _param_names(callee.node)
+            summary = self.closes_param.get(callee.uid, {}).get(
+                spec.rtype, frozenset()
+            )
+            name = None
+            for i, a in enumerate(call.args):
+                if isinstance(a, ast.Name) and a.id == var \
+                        and i < len(params):
+                    name = params[i]
+            for kw in call.keywords:
+                if isinstance(kw.value, ast.Name) and kw.value.id == var:
+                    name = kw.arg
+            if name is not None and name in summary:
+                ok = True
+        return ok
+
+    def call_region_finalizes(self, fn: FuncInfo, call: ast.Call,
+                              spec: ResourceSpec) -> bool:
+        if call_name(call.func) in spec.region_finalizers:
+            return True
+        for callee in self.graph._resolve_name_or_attr(fn, call.func):
+            if callee.uid in self.region_always.get(spec.rtype, ()):
+                return True
+        return False
+
+
+def _name_in(expr: Optional[ast.AST], var: str) -> bool:
+    if expr is None:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == var
+               for n in _walk_no_defs([expr]))
+
+
+def _transfer(graph: ProjectGraph, fn: FuncInfo, cfg: CFG, n: int,
+              spec: ResourceSpec, var: Optional[str],
+              state: FrozenSet[str], summaries: Optional["Summaries"],
+              collect: Optional[List[Violation]]) -> FrozenSet[str]:
+    """One node's effect on one resource's state set.  ``var=None``
+    tracks the whole *region* (only region finalizers apply)."""
+    stmt = cfg.stmt_of[n]
+    if var is not None and isinstance(stmt, ast.Assign) \
+            and len(stmt.targets) == 1 \
+            and isinstance(stmt.targets[0], ast.Name) \
+            and stmt.targets[0].id == var:
+        rebirth = _ctor_call_of(stmt.value, spec)
+        if rebirth is not None:
+            # re-running the creation (loop back edge): a FRESH
+            # resource — the previous iteration's state must not bleed
+            # into it
+            return frozenset({OPEN if rebirth[1] else UNARMED})
+    out = set(state)
+    wvars = cfg.with_exit_vars.get(n)
+    if wvars is not None:
+        if var is not None and var in wvars and OPEN in out:
+            out.discard(OPEN)
+            out.add(CLOSED)
+        return frozenset(out)
+    if stmt is None:
+        return frozenset(out)
+    for call in node_calls(cfg, n):
+        cn = call_name(call.func)
+        recv = receiver_of(call.func)
+        on_var = var is not None and isinstance(recv, ast.Name) \
+            and recv.id == var
+        if spec.region_finalizers and summaries is not None \
+                and summaries.call_region_finalizes(fn, call, spec):
+            if OPEN in out:
+                out.discard(OPEN)
+                out.add(CLOSED)
+            continue
+        if on_var:
+            if cn in spec.finalizers:
+                out.discard(OPEN)
+                out.discard(UNARMED)
+                out.add(CLOSED)
+            elif cn in spec.arm_methods:
+                if UNARMED in out:
+                    out.discard(UNARMED)
+                    out.add(OPEN)
+            elif cn in spec.uses and CLOSED in out and collect is not None:
+                collect.append(Violation(
+                    "use_after_finalize", spec, var, call.lineno,
+                    f"{var}.{cn}() is reachable after "
+                    f"{var}.{spec.finalizers[0]}()",
+                ))
+            continue
+        if var is not None and any(
+            _name_in(a, var) for a in list(call.args)
+            + [kw.value for kw in call.keywords]
+        ):
+            fin = summaries.call_finalizes_arg(fn, call, spec, var) \
+                if summaries is not None else None
+            if fin:
+                out.discard(OPEN)
+                out.discard(UNARMED)
+                out.add(CLOSED)
+            elif fin is None and spec.allow_escape and OPEN in out:
+                out.discard(OPEN)
+                out.add(ESCAPED)
+            # resolved callee that does NOT finalize: state unchanged
+    if var is not None and stmt is not None:
+        # ownership transfers: return/yield, alias, store into a field
+        if isinstance(stmt, ast.Return) and _name_in(stmt.value, var):
+            out.discard(OPEN)
+            out.add(ESCAPED)
+        elif isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, (ast.Yield, ast.YieldFrom)
+        ) and _name_in(stmt.value, var):
+            out.discard(OPEN)
+            out.add(ESCAPED)
+        elif isinstance(stmt, ast.Assign) and _name_in(stmt.value, var) \
+                and not isinstance(stmt.value, ast.Call):
+            out.discard(OPEN)
+            out.add(ESCAPED)
+        elif isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == var for t in stmt.targets
+        ) and _ctor_call_of(stmt.value, spec) is None:
+            # rebound to something else: the old binding is gone
+            out.discard(OPEN)
+            out.add(ESCAPED)
+    return frozenset(out)
+
+
+def _flow(graph: ProjectGraph, fn: FuncInfo, cfg: CFG, spec: ResourceSpec,
+          var: Optional[str], start_nodes: Sequence[int],
+          summaries: Optional["Summaries"],
+          collect: Optional[List[Violation]],
+          init_state: FrozenSet[str] = frozenset({OPEN}),
+          ) -> Dict[int, FrozenSet[str]]:
+    """Worklist union-dataflow of one resource's states over the CFG.
+    Returns the IN-state per node (EXIT's in-state is the verdict)."""
+    in_states: Dict[int, FrozenSet[str]] = {}
+    out_states: Dict[int, FrozenSet[str]] = {}
+    work: List[int] = []
+    for s in start_nodes:
+        out_states[s] = init_state
+        work.extend(cfg.succ[s])
+    seen_pairs: Set[Tuple[int, FrozenSet[str]]] = set()
+    while work:
+        n = work.pop()
+        preds_in = frozenset().union(*(
+            out_states.get(p, frozenset()) for p in _preds_of(cfg, n)
+        )) if _preds_of(cfg, n) else frozenset()
+        if not preds_in:
+            continue
+        if in_states.get(n) == preds_in and n in out_states:
+            continue
+        in_states[n] = preds_in
+        key = (n, preds_in)
+        if key in seen_pairs:
+            continue
+        seen_pairs.add(key)
+        out = _transfer(graph, fn, cfg, n, spec, var, preds_in,
+                        summaries, None)
+        if out_states.get(n) != out:
+            out_states[n] = out
+            work.extend(cfg.succ[n])
+    if collect is not None:
+        # one reporting pass with the converged states, deduped
+        for n, state in sorted(in_states.items()):
+            _transfer(graph, fn, cfg, n, spec, var, state, summaries,
+                      collect)
+    return in_states
+
+
+_PRED_CACHE: Dict[int, List[Set[int]]] = {}
+
+
+def _preds_of(cfg: CFG, n: int) -> Set[int]:
+    preds = _PRED_CACHE.get(id(cfg))
+    if preds is None or len(preds) != len(cfg.stmt_of):
+        preds = cfg.preds()
+        _PRED_CACHE[id(cfg)] = preds
+    return preds[n]
+
+
+def check_function(graph: ProjectGraph, fn: FuncInfo, spec: ResourceSpec,
+                   summaries: Summaries) -> List[Violation]:
+    """Every typestate violation for ``spec`` resources ``fn`` owns."""
+    out: List[Violation] = []
+    resources = local_resources(graph, fn, spec)
+    if not resources:
+        return out
+    cfg = build_cfg(fn.node)
+    for var, stmt, ctor_call, armed in resources:
+        creation_nodes = cfg.nodes_of.get(id(stmt), [])
+        if not creation_nodes:
+            continue
+        init = frozenset({OPEN if armed else UNARMED})
+        seen: Set[Tuple[str, str, int]] = set()
+        for cn in creation_nodes:
+            viol: List[Violation] = []
+            states = _flow(graph, fn, cfg, spec, var, (cn,), summaries,
+                           viol, init_state=init)
+            exit_states = states.get(CFG.EXIT, frozenset())
+            if OPEN in exit_states:
+                viol.append(Violation(
+                    "leak", spec, var, stmt.lineno,
+                    f"{spec.rtype} {var!r} is created here but some "
+                    f"exit path never calls "
+                    f"{'/'.join(spec.finalizers)}"
+                    + (f" (or {'/'.join(spec.region_finalizers)})"
+                       if spec.region_finalizers else ""),
+                ))
+            for v in viol:
+                key = (v.kind, v.var, v.line)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Path queries (R11)
+# ---------------------------------------------------------------------------
+
+def must_pass(cfg: CFG, target: int, blockers: Set[int]) -> bool:
+    """True iff every ENTRY->``target`` path crosses some blocker node
+    (collective dominance — any-of, which a plain dominator tree can't
+    answer)."""
+    if target in blockers:
+        return True
+    seen = {CFG.ENTRY}
+    stack = [CFG.ENTRY]
+    while stack:
+        n = stack.pop()
+        if n == target:
+            return False
+        for s in cfg.succ[n]:
+            if s not in seen and s not in blockers:
+                seen.add(s)
+                stack.append(s)
+    return True
+
+
+def may_pending(cfg: CFG, gen: Set[int], kill: Set[int],
+                queries: Set[int]) -> Set[int]:
+    """Query nodes reachable with the gen/kill bit still set — e.g.
+    a submit (gen) not yet drained (kill) when a save (query) runs.
+    The bit is evaluated on the state ENTERING the query node, so a
+    node that both drains and saves is clean."""
+    pending_in: Set[int] = set()
+    work: List[int] = []
+    for g in gen:
+        for s in cfg.succ[g]:
+            if s not in kill and s not in pending_in:
+                pending_in.add(s)
+                work.append(s)
+    while work:
+        n = work.pop()
+        if n in kill:
+            continue
+        for s in cfg.succ[n]:
+            if s not in pending_in:
+                pending_in.add(s)
+                work.append(s)
+    return queries & (pending_in | set(gen))

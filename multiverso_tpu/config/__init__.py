@@ -1,0 +1,12 @@
+"""Declarative configuration layer: the flag-constraint model.
+
+``config.constraints`` is the single source of truth for cross-flag
+implications and validity requirements.  Runtime validation
+(``apply_implications`` / ``check_options``), the mvlint R12 rule, and
+the generated DEPLOY.md constraint table all derive from the same
+declarations — hand-rolled implication code anywhere else is lint drift.
+"""
+
+from multiverso_tpu.config import constraints
+
+__all__ = ["constraints"]

@@ -1,0 +1,410 @@
+"""Single source of truth for cross-flag implications and requirements.
+
+The reference's flag semantics couple flags to each other: a tiered
+table budget re-routes training through the pipelined PS loop, wire
+compression only exists on the pipelined path, the device pipeline and
+the PS tables are mutually exclusive.  Before this module those rules
+lived as hand-written ``if``/``CHECK`` blocks inside ``app.py`` — which
+is exactly how the DEPLOY.md flag table and the code drifted apart.
+
+Three consumers read these declarations and nothing else:
+
+* **runtime validation** — ``WordEmbedding`` calls
+  ``apply_implications`` (flag rewrites, with the same log lines the old
+  inline block emitted) and ``check_options`` (hard ``CHECK``
+  failures);
+* **mvlint R12** — flags any module outside this one that re-implements
+  an implication (writes to an implied flag on an options object, or a
+  ``CHECK`` over a constrained flag pair), and any drift between these
+  declarations and the generated DEPLOY.md block;
+* **DEPLOY.md** — the "Flag constraints" section between the
+  ``mvlint:flag-constraints`` markers is ``render_markdown()`` output,
+  regenerated via ``python -m multiverso_tpu.analysis
+  --constraint-table``.
+
+Declarations are data, not code paths: an ``Implication`` names the
+trigger flag, the forced flag, the forced value, and the guard under
+which the rewrite (and its log line) applies; a ``Requirement`` names
+the flags it couples and a predicate over ``(options, Env)``.  Keeping
+the flag names as strings is what lets R12 and the doc generator reason
+about the model without executing it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+__all__ = [
+    "Env",
+    "Implication",
+    "Requirement",
+    "IMPLICATIONS",
+    "REQUIREMENTS",
+    "apply_implications",
+    "check_options",
+    "constrained_flags",
+    "implied_flags",
+    "render_markdown",
+    "MARKER_BEGIN",
+    "MARKER_END",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Env:
+    """Facts about the launch environment that requirements may read.
+
+    Kept separate from the options object so the model stays importable
+    (and testable) without jax: the caller samples the environment once
+    and passes it in."""
+
+    process_count: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Implication:
+    """``trigger`` active (``when``) forces ``flag`` to ``value``.
+
+    ``guard`` narrows the rewrite to the current-value states where it
+    (and its log line) should apply — e.g. the depth bump only fires
+    when the user left ``-ps_pipeline_depth`` at 0.  ``log`` is emitted
+    through the caller-supplied logger exactly when the rewrite
+    happens, preserving the historical inline-block messages."""
+
+    name: str
+    trigger: str
+    when: Callable[[Any], bool]
+    flag: str
+    value: Any
+    doc: str
+    guard: Optional[Callable[[Any], bool]] = None
+    log: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Requirement:
+    """``predicate(options, env)`` must hold or the run is invalid.
+
+    ``flags`` names every flag the predicate couples — that tuple is
+    what R12 uses to claim ownership of the pair: a hand-written CHECK
+    over the same flags anywhere else is drift."""
+
+    name: str
+    flags: Tuple[str, ...]
+    predicate: Callable[[Any, Env], bool]
+    message: Callable[[Any, Env], str]
+    doc: str
+
+
+# ---------------------------------------------------------------------------
+# The model.  Order matters for implications: rewrites run top to
+# bottom, and later guards read the values earlier rewrites produced.
+# ---------------------------------------------------------------------------
+
+IMPLICATIONS: Tuple[Implication, ...] = (
+    Implication(
+        name="tier_replaces_device_pipeline",
+        trigger="table_tier_hbm_mb",
+        when=lambda o: o.table_tier_hbm_mb > 0,
+        flag="device_pipeline",
+        value=False,
+        guard=lambda o: o.device_pipeline,
+        log=(
+            "[WordEmbedding] -table_tier_hbm_mb: the fully "
+            "HBM-resident device pipeline assumes the whole table "
+            "fits — routing through the tiered PS block loop "
+            "instead"
+        ),
+        doc=(
+            "the HBM-resident device pipeline assumes the whole table "
+            "fits; tiered runs route through the PS block loop instead"
+        ),
+    ),
+    Implication(
+        name="tier_implies_use_ps",
+        trigger="table_tier_hbm_mb",
+        when=lambda o: o.table_tier_hbm_mb > 0,
+        flag="use_ps",
+        value=True,
+        doc=(
+            "tiered tables train block-structured, so the run goes "
+            "through the PS table path"
+        ),
+    ),
+    Implication(
+        name="tier_implies_pipelined_depth",
+        trigger="table_tier_hbm_mb",
+        when=lambda o: o.table_tier_hbm_mb > 0,
+        flag="ps_pipeline_depth",
+        value=1,
+        guard=lambda o: o.ps_pipeline_depth == 0,
+        log=(
+            "[WordEmbedding] -table_tier_hbm_mb: raising "
+            "-ps_pipeline_depth to 1 so row faults ride the comms "
+            "thread under training"
+        ),
+        doc=(
+            "row faults must ride the comms thread under training, so "
+            "depth 0 is raised to 1"
+        ),
+    ),
+    Implication(
+        name="tier_disables_sparse_pull",
+        trigger="table_tier_hbm_mb",
+        when=lambda o: o.table_tier_hbm_mb > 0,
+        flag="ps_sparse_pull",
+        value=False,
+        guard=lambda o: o.ps_sparse_pull,
+        doc=(
+            "the HBM cache subsumes the dirty-row client cache (and a "
+            "second full-table host mirror would double host RAM)"
+        ),
+    ),
+)
+
+REQUIREMENTS: Tuple[Requirement, ...] = (
+    Requirement(
+        name="device_pipeline_xor_use_ps",
+        flags=("device_pipeline", "use_ps"),
+        predicate=lambda o, e: not (o.device_pipeline and o.use_ps),
+        message=lambda o, e: (
+            "-device_pipeline and -use_ps are mutually exclusive "
+            "(fused HBM tables vs parameter-server tables)"
+        ),
+        doc="mutually exclusive (fused HBM tables vs PS tables)",
+    ),
+    Requirement(
+        name="row_mean_exact_needs_device_pipeline",
+        flags=("scale_mode", "device_pipeline"),
+        predicate=lambda o, e: (
+            o.scale_mode != "row_mean_exact" or o.device_pipeline
+        ),
+        message=lambda o, e: (
+            "-scale_mode=row_mean_exact exists only for -device_pipeline "
+            "(the host presort path computes realized counts already — "
+            "use row_mean there)"
+        ),
+        doc=(
+            "`row_mean_exact` exists only on the device pipeline; the "
+            "host presort path computes realized counts already"
+        ),
+    ),
+    Requirement(
+        name="walk_domain",
+        flags=("walk",),
+        predicate=lambda o, e: o.walk in ("perm", "iid"),
+        message=lambda o, e: (
+            "-walk must be 'perm' or 'iid', got '%s'" % o.walk
+        ),
+        doc="must be `perm` or `iid`",
+    ),
+    Requirement(
+        name="ps_pipeline_depth_nonnegative",
+        flags=("ps_pipeline_depth",),
+        predicate=lambda o, e: o.ps_pipeline_depth >= 0,
+        message=lambda o, e: (
+            "-ps_pipeline_depth must be >= 0, got %d" % o.ps_pipeline_depth
+        ),
+        doc="must be >= 0",
+    ),
+    Requirement(
+        name="ps_compress_domain",
+        flags=("ps_compress",),
+        predicate=lambda o, e: o.ps_compress in ("none", "sparse", "1bit"),
+        message=lambda o, e: (
+            "-ps_compress must be none|sparse|1bit, got '%s'"
+            % o.ps_compress
+        ),
+        doc="must be `none`, `sparse`, or `1bit`",
+    ),
+    Requirement(
+        name="ps_compress_needs_pipelined_depth",
+        flags=("ps_compress", "ps_pipeline_depth"),
+        predicate=lambda o, e: (
+            o.ps_compress == "none" or o.ps_pipeline_depth >= 1
+        ),
+        message=lambda o, e: (
+            "-ps_compress applies to the pipelined PS path only: set "
+            "-ps_pipeline_depth >= 1 (the depth-0 sync rounds stay the "
+            "pinned bit-exact parity mode)"
+        ),
+        doc=(
+            "compression applies to the pipelined PS path only "
+            "(depth >= 1); depth-0 sync rounds stay the pinned "
+            "bit-exact parity mode"
+        ),
+    ),
+    Requirement(
+        name="table_tier_nonnegative",
+        flags=("table_tier_hbm_mb",),
+        predicate=lambda o, e: o.table_tier_hbm_mb >= 0,
+        message=lambda o, e: (
+            "-table_tier_hbm_mb must be >= 0, got %s"
+            % o.table_tier_hbm_mb
+        ),
+        doc="must be >= 0",
+    ),
+    Requirement(
+        name="table_tier_single_process",
+        flags=("table_tier_hbm_mb",),
+        predicate=lambda o, e: (
+            o.table_tier_hbm_mb == 0 or e.process_count == 1
+        ),
+        message=lambda o, e: (
+            "-table_tier_hbm_mb requires a single process: the host "
+            "tier is process-local RAM (multi-process scale-out shards "
+            "rows across ranks instead — drop the flag or the extra "
+            "ranks)"
+        ),
+        doc=(
+            "requires a single process: the host tier is process-local "
+            "RAM (multi-process scale-out shards rows across ranks "
+            "instead)"
+        ),
+    ),
+    Requirement(
+        name="device_ckpt_single_process",
+        flags=("checkpoint_dir", "device_pipeline"),
+        predicate=lambda o, e: (
+            not (o.checkpoint_dir and o.device_pipeline)
+            or e.process_count == 1
+        ),
+        message=lambda o, e: (
+            "-checkpoint_dir on the device pipeline requires a "
+            "single process (multi-process training goes through "
+            "-use_ps, whose checkpoints are quorum-committed)"
+        ),
+        doc=(
+            "device-pipeline checkpoints require a single process "
+            "(multi-process training goes through `-use_ps`, whose "
+            "checkpoints are quorum-committed)"
+        ),
+    ),
+    Requirement(
+        name="device_ckpt_steps_only",
+        flags=("checkpoint_dir", "device_pipeline",
+               "checkpoint_every_seconds"),
+        predicate=lambda o, e: (
+            not (o.checkpoint_dir and o.device_pipeline)
+            or o.checkpoint_every_seconds == 0
+        ),
+        message=lambda o, e: (
+            "-checkpoint_every_seconds is wall-clock driven and "
+            "would perturb the device pipeline's deterministic "
+            "resume; use -checkpoint_every_steps (dispatch calls)"
+        ),
+        doc=(
+            "wall-clock checkpoints would perturb the device "
+            "pipeline's deterministic resume; use "
+            "`-checkpoint_every_steps`"
+        ),
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# Runtime API
+# ---------------------------------------------------------------------------
+
+def apply_implications(options: Any, log: Optional[Callable[[str], None]] = None
+                       ) -> Tuple[str, ...]:
+    """Rewrite ``options`` in place per ``IMPLICATIONS``; returns the
+    names of the implications that fired.  ``log`` (e.g. ``Log.Info``)
+    receives each fired implication's message, when it has one."""
+    fired = []
+    for imp in IMPLICATIONS:
+        if not imp.when(options):
+            continue
+        if imp.guard is not None and not imp.guard(options):
+            continue
+        if imp.log and log is not None:
+            log(imp.log)
+        setattr(options, imp.flag, imp.value)
+        fired.append(imp.name)
+    return tuple(fired)
+
+
+def check_options(options: Any, env: Optional[Env] = None,
+                  check: Optional[Callable[[bool, str], None]] = None) -> None:
+    """Enforce every ``Requirement``.  ``check`` defaults to raising
+    ``ValueError``; the app passes ``utils.log.CHECK`` so violations die
+    the same way the old inline block did."""
+    env = env if env is not None else Env()
+    if check is None:
+        def check(cond: bool, msg: str) -> None:
+            if not cond:
+                raise ValueError(msg)
+    for req in REQUIREMENTS:
+        ok = bool(req.predicate(options, env))
+        check(ok, req.message(options, env) if not ok else req.name)
+
+
+def implied_flags() -> Tuple[str, ...]:
+    """Flags some implication writes — the set R12 claims write
+    ownership of: an options-object assignment to one of these outside
+    this module is drift."""
+    return tuple(sorted({imp.flag for imp in IMPLICATIONS}))
+
+
+def constrained_flags() -> Tuple[str, ...]:
+    """Every flag the model mentions (triggers, targets, requirement
+    members) — must all exist in the MV flag registry."""
+    names = set()
+    for imp in IMPLICATIONS:
+        names.add(imp.trigger)
+        names.add(imp.flag)
+    for req in REQUIREMENTS:
+        names.update(req.flags)
+    return tuple(sorted(names))
+
+
+def requirement_flag_pairs() -> Tuple[Tuple[str, ...], ...]:
+    """The multi-flag couplings requirements own, as sorted tuples.  A
+    hand-written CHECK over one of these exact flag sets outside this
+    module re-implements the model and is R12 drift."""
+    return tuple(sorted(
+        {tuple(sorted(req.flags)) for req in REQUIREMENTS if len(req.flags) > 1}
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Documentation rendering (DEPLOY.md "Flag constraints" block)
+# ---------------------------------------------------------------------------
+
+MARKER_BEGIN = "<!-- mvlint:flag-constraints:begin -->"
+MARKER_END = "<!-- mvlint:flag-constraints:end -->"
+
+
+def render_markdown() -> str:
+    """The generated DEPLOY.md block, markers included.  R12 compares
+    the checked-in block against this text byte-for-byte; regenerate
+    with ``python -m multiverso_tpu.analysis --constraint-table``."""
+    lines = [
+        MARKER_BEGIN,
+        "Generated from `multiverso_tpu/config/constraints.py` by",
+        "`python -m multiverso_tpu.analysis --constraint-table` — edit",
+        "the model, not this block (mvlint R12 flags drift).",
+        "",
+        "**Implications** (applied in order before validation):",
+        "",
+        "| when | forces | why |",
+        "|---|---|---|",
+    ]
+    for imp in IMPLICATIONS:
+        val = repr(imp.value) if not isinstance(imp.value, bool) else str(imp.value)
+        lines.append(
+            f"| `-{imp.trigger}` active | `-{imp.flag}` = `{val}` | {imp.doc} |"
+        )
+    lines += [
+        "",
+        "**Requirements** (violations fail startup with `CHECK`):",
+        "",
+        "| flags | rule |",
+        "|---|---|",
+    ]
+    for req in REQUIREMENTS:
+        flags = " + ".join(f"`-{f}`" for f in req.flags)
+        lines.append(f"| {flags} | {req.doc} |")
+    lines.append(MARKER_END)
+    return "\n".join(lines)

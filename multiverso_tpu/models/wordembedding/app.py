@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from multiverso_tpu import obs
+from multiverso_tpu.config import constraints
 # module-level (not lazy): -health_port/-metrics_port must be REGISTERED
 # before MV_Init parses a pure trainer's argv, or the flags silently
 # pass through as unconsumed arguments
@@ -436,27 +437,10 @@ class WordEmbedding:
         # block trains, and the block-prep look-ahead prefetches the next
         # block's unions on top of that.
         self._tier = options.table_tier_hbm_mb > 0
-        if self._tier:
-            if options.device_pipeline:
-                Log.Info(
-                    "[WordEmbedding] -table_tier_hbm_mb: the fully "
-                    "HBM-resident device pipeline assumes the whole table "
-                    "fits — routing through the tiered PS block loop "
-                    "instead"
-                )
-                options.device_pipeline = False
-            options.use_ps = True
-            if options.ps_pipeline_depth == 0:
-                Log.Info(
-                    "[WordEmbedding] -table_tier_hbm_mb: raising "
-                    "-ps_pipeline_depth to 1 so row faults ride the comms "
-                    "thread under training"
-                )
-                options.ps_pipeline_depth = 1
-            if options.ps_sparse_pull:
-                # the HBM cache subsumes the dirty-row client cache (and a
-                # second full-table host mirror would double host RAM)
-                options.ps_sparse_pull = False
+        # Flag implications live in config/constraints.py (the single
+        # source mvlint R12 and the DEPLOY.md constraint table also
+        # read) — re-implementing a rewrite inline here is lint drift.
+        constraints.apply_implications(options, log=Log.Info)
         # Model parallelism (-num_shards=N + -device_pipeline): the tables
         # must be born row-sharded — materializing the full (V, D) arrays
         # on one device first and re-placing them later would OOM at the
@@ -2534,41 +2518,12 @@ class WordEmbedding:
             ids = np.concatenate(chunks)
         ids = np.ascontiguousarray(ids, np.int32)
         keep = subsample_keep_probs(self.dict.counts, o.sample)
-        CHECK(not (o.device_pipeline and o.use_ps),
-              "-device_pipeline and -use_ps are mutually exclusive "
-              "(fused HBM tables vs parameter-server tables)")
-        CHECK(o.scale_mode != "row_mean_exact" or o.device_pipeline,
-              "-scale_mode=row_mean_exact exists only for -device_pipeline "
-              "(the host presort path computes realized counts already — "
-              "use row_mean there)")
-        CHECK(o.walk in ("perm", "iid"),
-              "-walk must be 'perm' or 'iid', got '%s'" % o.walk)
-        CHECK(o.ps_pipeline_depth >= 0,
-              "-ps_pipeline_depth must be >= 0, got %d" % o.ps_pipeline_depth)
-        CHECK(o.ps_compress in ("none", "sparse", "1bit"),
-              "-ps_compress must be none|sparse|1bit, got '%s'"
-              % o.ps_compress)
-        CHECK(o.ps_compress == "none" or o.ps_pipeline_depth >= 1,
-              "-ps_compress applies to the pipelined PS path only: set "
-              "-ps_pipeline_depth >= 1 (the depth-0 sync rounds stay the "
-              "pinned bit-exact parity mode)")
-        CHECK(o.table_tier_hbm_mb >= 0,
-              "-table_tier_hbm_mb must be >= 0, got %s"
-              % o.table_tier_hbm_mb)
-        CHECK(o.table_tier_hbm_mb == 0 or jax.process_count() == 1,
-              "-table_tier_hbm_mb requires a single process: the host "
-              "tier is process-local RAM (multi-process scale-out shards "
-              "rows across ranks instead — drop the flag or the extra "
-              "ranks)")
-        if o.checkpoint_dir and o.device_pipeline:
-            CHECK(jax.process_count() == 1,
-                  "-checkpoint_dir on the device pipeline requires a "
-                  "single process (multi-process training goes through "
-                  "-use_ps, whose checkpoints are quorum-committed)")
-            CHECK(o.checkpoint_every_seconds == 0,
-                  "-checkpoint_every_seconds is wall-clock driven and "
-                  "would perturb the device pipeline's deterministic "
-                  "resume; use -checkpoint_every_steps (dispatch calls)")
+        # Flag validity lives in config/constraints.py (same model the
+        # implications, mvlint R12, and the DEPLOY.md table read);
+        # CHECK keeps the historical die-on-violation behavior.
+        constraints.check_options(
+            o, constraints.Env(process_count=jax.process_count()), CHECK
+        )
         if o.device_pipeline:
             return self._train_ondevice(ids, keep)
         def make_pipeline(shard_ids, seed):

@@ -285,9 +285,17 @@ class HeartbeatMonitor:
         deterministic test entry point)."""
         from multiverso_tpu.resilience import chaos
 
-        if not chaos.heartbeats_dropped(self._seq):
-            self.store.beat(self._seq)
-            self._seq += 1
+        # the seq bump is a read-modify-write: the monitor thread and a
+        # deterministic test/bench driver may both run poll_once (mvlint
+        # R9); beat() publishes outside the lock (its store serialises
+        # itself, and nesting the two would pin a lock order for nothing)
+        with self._lock:
+            seq = self._seq
+            publish = not chaos.heartbeats_dropped(seq)
+            if publish:
+                self._seq = seq + 1
+        if publish:
+            self.store.beat(seq)
         now = self._clock()
         with self._lock:
             for peer, rec in self._peers.items():

@@ -380,6 +380,14 @@ class Runtime:
             None if (t is not None and id(t) in drop) else t
             for t in self._tables
         ]
+        for t in tables:
+            # releasing ends the table's lifecycle: tables with workers
+            # (the tiered prefetch pipe) or dashboard registrations tear
+            # them down here, not at interpreter exit. release() is the
+            # full teardown; close() alone only quiesces workers.
+            closer = getattr(t, "release", None) or getattr(t, "close", None)
+            if callable(closer):
+                closer()
 
     # ------------------------------------------------------------ serving
 

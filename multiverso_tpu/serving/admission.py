@@ -55,8 +55,10 @@ MV_DEFINE_double(
 
 
 class TokenBucket:
-    """Continuous-refill token bucket. NOT thread-safe on its own — the
-    controller serialises access; standalone users must too."""
+    """Continuous-refill token bucket, self-synchronized: ``try_take``
+    and ``tokens`` hold the bucket's own OrderedLock, so standalone
+    users (and the controller's lock) are both safe — the nesting
+    controller-lock -> bucket-lock is one-directional and R2-clean."""
 
     def __init__(self, rate: float, burst: float,
                  clock: Callable[[], float] = time.monotonic):
@@ -65,10 +67,12 @@ class TokenBucket:
         self.rate = float(rate)
         self.burst = float(burst)
         self._clock = clock
+        self._lock = OrderedLock("admission.bucket._lock")
         self._tokens = self.burst  # start full: first burst admits
         self._last = clock()
 
     def _refill(self, now: float) -> None:
+        # caller holds self._lock
         if now > self._last:
             self._tokens = min(
                 self.burst, self._tokens + (now - self._last) * self.rate
@@ -83,17 +87,19 @@ class TokenBucket:
         refills) instead of being permanently inadmissible. Returns
         ``(admitted, retry_after_s)``; the shed hint is the exact refill
         time back to a positive balance."""
-        now = self._clock()
-        self._refill(now)
-        if self._tokens > 0.0:
-            self._tokens -= float(cost)
-            return True, 0.0
-        return False, max(-self._tokens / self.rate, 1e-4)
+        with self._lock:
+            now = self._clock()
+            self._refill(now)
+            if self._tokens > 0.0:
+                self._tokens -= float(cost)
+                return True, 0.0
+            return False, max(-self._tokens / self.rate, 1e-4)
 
     @property
     def tokens(self) -> float:
-        self._refill(self._clock())
-        return self._tokens
+        with self._lock:
+            self._refill(self._clock())
+            return self._tokens
 
 
 class AdmissionController:

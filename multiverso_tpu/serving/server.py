@@ -195,6 +195,10 @@ class TableServer:
             name=name,
         )
         self._started = False
+        # OrderedLock (mvlint R9): start() races *_async handler
+        # threads' _require_started/health reads once a fleet driver
+        # starts servers while traffic is live
+        self._lifecycle_lock = OrderedLock("table_server._lifecycle_lock")
         self._registered = False
         self._health_http = None  # -health_port endpoint (start()/stop())
         if arrays:
@@ -214,15 +218,16 @@ class TableServer:
         without it; ``*_async`` need it). When ``-health_port`` is armed
         the HTTP health endpoint (``GET /healthz``) starts alongside and
         stops with the server."""
-        if not self._started:
-            self._batcher.start()
-            self._started = True
-            if self._health_http is None:
-                from multiverso_tpu.serving.http_health import (
-                    maybe_start_from_flags,
-                )
+        with self._lifecycle_lock:
+            if not self._started:
+                self._batcher.start()
+                self._started = True
+                if self._health_http is None:
+                    from multiverso_tpu.serving.http_health import (
+                        maybe_start_from_flags,
+                    )
 
-                self._health_http = maybe_start_from_flags(self)
+                    self._health_http = maybe_start_from_flags(self)
         return self
 
     def stop(self) -> None:
@@ -693,7 +698,9 @@ class TableServer:
         return self._batcher.submit(f"predict:{name}", X, block=block)
 
     def _require_started(self) -> None:
-        CHECK(self._started, "TableServer.start() the batcher before *_async")
+        with self._lifecycle_lock:
+            started = self._started
+        CHECK(started, "TableServer.start() the batcher before *_async")
 
     def _admit(self, tenant: str, rows: int) -> None:
         """Per-tenant admission gate, FIRST in the shed order: a tenant
@@ -739,9 +746,11 @@ class TableServer:
         snap = self._snapshot
         with self._breakers_lock:
             breakers = {r: b.state for r, b in sorted(self._breakers.items())}
+        with self._lifecycle_lock:
+            started = self._started
         return {
             "name": self.name,
-            "started": self._started,
+            "started": started,
             "version": snap.version if snap is not None else 0,
             "tables": snap.names() if snap is not None else [],
             "last_swap_age_s": self.metrics.last_swap_age_s(),

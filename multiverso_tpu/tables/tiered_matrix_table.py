@@ -451,12 +451,27 @@ class TieredMatrixTable(MatrixTable):
             self._stats["prefetch_dropped"] += 1
 
     def close(self) -> None:
-        """Tear down the prefetch pipe (idempotent; the cache itself
-        needs no teardown)."""
+        """Quiesce the table's workers: tear down the prefetch pipe
+        (idempotent).  The table itself stays live — the host tier,
+        cache stats and dashboard registration survive, so training
+        loops may close the pipe at a phase boundary and keep reading
+        ``host_array()``/``tier_cache_stats()``.  ``release()`` ends the
+        lifecycle for real."""
         with self._tier_lock:
             pipe, self._pipe = self._pipe, None
         if pipe is not None:
             pipe.close(timeout_s=5.0)
+
+    def release(self) -> None:
+        """End of lifecycle (idempotent): quiesce workers and drop this
+        table from the dashboard registry.  The shared "table_cache"
+        section detaches with the last live table — each table
+        re-attaching in ``__init__`` keeps it present while any
+        exists."""
+        self.close()
+        _TABLES.discard(self)
+        if not _TABLES:
+            Dashboard.remove_section("table_cache")
 
     # ------------------------------------------------------- flush / drop
 

@@ -1,0 +1,19 @@
+"""Historical repro (PR 8): the shard reader joined its fill thread at
+the end of the happy path, but the truncated-shard early return skipped
+the join — the thread kept producing into an abandoned queue. A join
+EXISTS lexically (so R4 is satisfied); only the path-sensitive R10
+check sees the miss."""
+
+import threading
+
+
+def read_shards(paths, queue):
+    rows = []
+    filler = threading.Thread(target=queue.fill)
+    filler.start()
+    for p in paths:
+        if p is None:
+            return rows  # truncated shard: bails without joining filler
+        rows.append(p)
+    filler.join()
+    return rows

@@ -1,0 +1,10 @@
+"""Historical repro (PR 6): bench legs created tables and never passed
+them to release_tables(), so the runtime registry pinned ~8 GB of host
+shards per sweep until the process died."""
+
+
+def bench_leg(runtime, rows, cols):
+    handle = MV_CreateTable(rows, cols)  # noqa: F821 - fixture shape
+    total = runtime.pull(handle).sum()
+    runtime.barrier()
+    return total  # the handle stays pinned in the registry forever

@@ -1,0 +1,12 @@
+"""Historical repro (PR 9): every serving replica registered a
+dashboard section keyed by id(self) and never removed it — each
+replica restart leaked a section, and /metrics grew without bound."""
+
+
+class ReplicaExporter:
+    def __init__(self, dashboard):
+        self._dash = dashboard
+        dashboard.add_section(f"serving.replica.{id(self)}", self._lines)
+
+    def _lines(self):
+        return ["[Replica] up"]

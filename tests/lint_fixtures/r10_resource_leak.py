@@ -1,0 +1,27 @@
+"""Seeded R10 violations: a TaskPipe whose close() is skipped on the
+early-return path, and a submit after close. The clean twin lives in
+clean_lifecycle.py (try/finally discharges the same shapes)."""
+
+
+class TaskPipe:
+    def submit(self, task):
+        pass
+
+    def close(self):
+        pass
+
+
+def leaky_drain_drill(tasks):
+    pipe = TaskPipe()
+    for task in tasks:
+        pipe.submit(task)
+        if task is None:
+            return 0  # the worker thread and its queue outlive us here
+    pipe.close()
+    return 1
+
+
+def submit_after_close(task):
+    pipe = TaskPipe()
+    pipe.close()
+    pipe.submit(task)  # the worker is gone; this enqueues into the void

@@ -1,0 +1,30 @@
+"""Seeded R11 violations, one per protocol family:
+
+* commit_atomic in a staging function with no verify dominating it;
+* publish_* installing a snapshot without passing the validation gate;
+* a checkpoint save reachable with submitted pipe work still in flight;
+* readiness flipped to True with restore work still ahead.
+
+The pipe arrives as a parameter (not a local ctor) so R10 stays silent —
+this fixture is about ORDER, not lifecycle."""
+
+
+def commit_unverified(path, payload):
+    record = _write_stage_record(path, payload)  # noqa: F821
+    commit_atomic(path, record)  # noqa: F821 - nothing verified the stage
+
+
+class SnapshotRegistry:
+    def publish_snapshot(self, snap):
+        self._snapshot = snap  # installed without _validate_host()
+
+
+def save_with_pending_work(pipe, state):
+    pipe.submit(state.step)
+    save_checkpoint(state)  # noqa: F821 - in-flight work tears the round
+    pipe.drain()
+
+
+def bring_up(health, ckpt_dir):
+    health.set_serving_ready()
+    _restore_tables(ckpt_dir)  # noqa: F821 - probes already route here

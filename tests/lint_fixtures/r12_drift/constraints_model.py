@@ -1,0 +1,31 @@
+"""R12 fixture model: a standalone IMPLICATIONS/REQUIREMENTS pair that
+tier_setup.py (same directory) re-implements by hand. The model module
+itself is exempt — applying the implications IS its job."""
+
+
+class Implication:
+    def __init__(self, name=None, trigger=None, flag=None, value=None,
+                 why=""):
+        self.name = name
+
+
+class Requirement:
+    def __init__(self, name=None, flags=(), why=""):
+        self.name = name
+
+
+IMPLICATIONS = (
+    Implication(
+        name="tier_implies_ps", trigger="table_tier_hbm_mb",
+        flag="use_ps", value=True,
+        why="tiered tables train through the PS path",
+    ),
+)
+
+REQUIREMENTS = (
+    Requirement(
+        name="pipeline_exclusive",
+        flags=("device_pipeline", "use_ps"),
+        why="fused HBM tables and PS tables are mutually exclusive",
+    ),
+)

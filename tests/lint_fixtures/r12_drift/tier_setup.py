@@ -1,0 +1,16 @@
+"""Seeded R12 violations against the model next door: a hand-rolled
+implication (write to the implied flag under a test of its trigger) and
+a hand-rolled requirement CHECK coupling the same flag pair."""
+
+
+def configure(opts):
+    if opts.table_tier_hbm_mb:
+        opts.use_ps = True  # the model owns this implication
+    return opts
+
+
+def validate(opts):
+    CHECK(  # noqa: F821 - the model owns this requirement
+        not (opts.device_pipeline and opts.use_ps),
+        "device_pipeline and use_ps are mutually exclusive",
+    )

@@ -62,6 +62,11 @@ def _lint_fixture(name):
         ("r8_hist_topology_churn.py", "R8"),
         ("r9_cross_thread.py", "R9"),
         ("r9_hist_ps_counter.py", "R9"),
+        ("r10_resource_leak.py", "R10"),
+        ("r10_hist_section_leak.py", "R10"),
+        ("r10_hist_registry_leak.py", "R10"),
+        ("r10_hist_reader_thread.py", "R10"),
+        ("r11_protocol_order.py", "R11"),
     ],
 )
 def test_fixture_triggers_exactly_its_rule(fixture, rule):
@@ -112,6 +117,66 @@ def test_historical_fixture_messages_name_their_bug_class():
     (f9,) = _lint_fixture("r9_hist_ps_counter.py").findings
     assert "read-modify-write" in f9.message
     assert "word_count" in f9.message and "WordCounter.lr" in f9.message
+
+
+# ------------------------------------------------- lifecycle rules (v3)
+
+
+def test_r10_historical_fixtures_name_their_incidents():
+    """PR 9 (dashboard section leak), PR 6 (table registry leak), PR 8
+    (reader fill thread) must each fire via the code path that matches
+    the incident."""
+    (f_sec,) = _lint_fixture("r10_hist_section_leak.py").findings
+    assert "PR 9" in f_sec.message and "remove_section" in f_sec.message
+    (f_reg,) = _lint_fixture("r10_hist_registry_leak.py").findings
+    assert "release_tables" in f_reg.message and "PR 6" in f_reg.hint
+    (f_thr,) = _lint_fixture("r10_hist_reader_thread.py").findings
+    assert "join" in f_thr.message
+
+
+def test_r10_reader_thread_is_r10_not_r4():
+    """A lexical join EXISTS in the PR 8 repro, so R4 must stay silent —
+    only the path-sensitive upgrade may claim it (no double report)."""
+    res = _lint_fixture("r10_hist_reader_thread.py")
+    assert {f.rule for f in res.findings} == {"R10"}
+
+
+def test_r10_fixture_covers_leak_and_use_after_close():
+    msgs = [f.message for f in _lint_fixture("r10_resource_leak.py").findings]
+    assert any("never calls close" in m for m in msgs)
+    assert any("use after finalize" in m for m in msgs)
+
+
+def test_r11_fixture_covers_all_four_protocols():
+    msgs = " ".join(
+        f.message for f in _lint_fixture("r11_protocol_order.py").findings
+    )
+    assert "commit_atomic" in msgs          # stage -> verify -> commit
+    assert "validation gate" in msgs        # publish past the gate
+    assert "in flight" in msgs              # drain dominates the save
+    assert "readiness flips" in msgs        # flip only after restore
+
+
+def test_r12_drift_package_fires_both_families():
+    """The two-file fixture: the model module is exempt, the offender
+    fires one hand-rolled implication and one hand-rolled CHECK."""
+    res = run_lint(
+        [os.path.join(FIXTURES, "r12_drift")],
+        config=_BARE,
+        baseline_path=os.devnull,
+    )
+    assert {f.rule for f in res.findings} == {"R12"}
+    assert all(f.path.endswith("tier_setup.py") for f in res.findings)
+    msgs = [f.message for f in res.findings]
+    assert any("hand-written implication" in m for m in msgs)
+    assert any("hand-written CHECK" in m for m in msgs)
+
+
+def test_clean_lifecycle_fixture_negative_control():
+    """Every R10/R11 firing shape discharged correctly (try/finally,
+    protocol order) must pass — under ALL rules, not just R10/R11."""
+    res = _lint_fixture("clean_lifecycle.py")
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
 
 
 def test_restrict_paths_filters_emission_not_parsing():
@@ -469,3 +534,108 @@ def test_ordered_lock_cross_thread_inversion(fresh_order_graph):
     th2.start()
     th2.join(timeout=30)
     assert isinstance(box.get("error"), guards.GuardViolation)
+
+
+# ------------------------------------------- v3: cache, SARIF, constraints
+
+
+def test_parse_cache_reuses_unchanged_files(tmp_path):
+    """The --diff fast path: a warm run re-parses nothing; touching one
+    file re-parses exactly that file (content-hash keyed, not mtime)."""
+    src = tmp_path / "mod_a.py"
+    src.write_text("def a():\n    return 1\n")
+    other = tmp_path / "mod_b.py"
+    other.write_text("def b():\n    return 2\n")
+    cfg = LintConfig(
+        aux_read_roots=(), doc_files=(), repo_root=str(tmp_path),
+        parse_cache_path=str(tmp_path / "cache.pkl"),
+    )
+    cold = run_lint([str(tmp_path)], config=cfg, baseline_path=os.devnull)
+    assert (cold.files_reparsed, cold.files_cached) == (2, 0)
+    warm = run_lint([str(tmp_path)], config=cfg, baseline_path=os.devnull)
+    assert (warm.files_reparsed, warm.files_cached) == (0, 2)
+    src.write_text("def a():\n    return 3\n")
+    touched = run_lint([str(tmp_path)], config=cfg,
+                       baseline_path=os.devnull)
+    assert (touched.files_reparsed, touched.files_cached) == (1, 1)
+
+
+def test_corrupt_parse_cache_is_ignored(tmp_path):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    cache = tmp_path / "cache.pkl"
+    cache.write_bytes(b"not a pickle")
+    cfg = LintConfig(
+        aux_read_roots=(), doc_files=(), repo_root=str(tmp_path),
+        parse_cache_path=str(cache),
+    )
+    res = run_lint([str(tmp_path)], config=cfg, baseline_path=os.devnull)
+    assert res.files_reparsed == 1  # reparsed, not crashed
+
+
+def test_rule_times_cover_every_family():
+    res = run_lint(
+        [os.path.join(FIXTURES, "clean.py")],
+        config=_BARE, baseline_path=os.devnull,
+    )
+    for key in ["parse"] + [f"R{i}" for i in range(1, 13)]:
+        assert key in res.rule_times, key
+        assert res.rule_times[key] >= 0.0
+
+
+def test_sarif_output_schema(tmp_path):
+    """--sarif writes a SARIF 2.1.0 log CI annotators accept: version,
+    tool.driver.name, per-result ruleId + physicalLocation."""
+    import json
+
+    from multiverso_tpu.analysis.__main__ import main
+
+    out = tmp_path / "lint.sarif"
+    rc = main([os.path.join(FIXTURES, "r10_resource_leak.py"),
+               "--sarif", str(out)])
+    assert rc == 1  # findings present
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mvlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {f"R{i}" for i in range(1, 13)} <= rule_ids
+    assert run["results"], "seeded fixture must produce SARIF results"
+    for r in run["results"]:
+        assert r["ruleId"] == "R10"
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(
+            "r10_resource_leak.py"
+        )
+        assert loc["region"]["startLine"] >= 1
+
+
+def test_deploy_md_constraints_block_matches_model():
+    """The single-source pin R12 enforces, asserted directly: the
+    DEPLOY.md block between the markers is byte-equal to
+    render_markdown() — regenerate, never hand-edit."""
+    from multiverso_tpu.config import constraints
+
+    text = open(os.path.join(REPO, "DEPLOY.md"), encoding="utf-8").read()
+    assert constraints.MARKER_BEGIN in text, (
+        "DEPLOY.md lost its generated flag-constraints block"
+    )
+    start = text.index(constraints.MARKER_BEGIN)
+    end = text.index(constraints.MARKER_END) + len(constraints.MARKER_END)
+    assert text[start:end] == constraints.render_markdown()
+
+
+def test_constraints_model_flags_are_registered():
+    """Every flag the model names must exist in the MV_DEFINE registry
+    (the R12 registry-drift direction, pinned without the linter)."""
+    import multiverso_tpu.models.wordembedding.app  # noqa: F401 - flags
+    from multiverso_tpu.config import constraints
+    from multiverso_tpu.utils import configure
+
+    named = set()
+    for imp in constraints.IMPLICATIONS:
+        named |= {imp.trigger, imp.flag}
+    for req in constraints.REQUIREMENTS:
+        named |= set(req.flags)
+    registered = set(configure.AllFlags())
+    missing = named - registered
+    assert not missing, f"constraints.py names unregistered flags: {missing}"
