@@ -1357,6 +1357,68 @@ def _bench_obs(V=20000, dim=64, toks=200_000):
     }
 
 
+def _bench_race(V=20000, dim=64, toks=200_000):
+    """mvtsan overhead leg (ISSUE 14): the SAME pipelined PS training
+    run two ways — race detector disarmed (the production default:
+    every hook left in the hot path is one cached bool check) and
+    armed (plan-driven attribute descriptors + the vector-clock
+    engine) — armed overhead reported as % of the disarmed pairs/sec.
+    ``race_instrumented_attrs`` tracks how many (class, attr) pairs the
+    static plan put descriptors on — the number that jumps when new
+    shared state lands. A clean run must also finish with ZERO race
+    reports: the bench leg double-checks what the ci race drill gates.
+    MV_BENCH_RACE=0 skips."""
+    import os as _os
+    import sys
+
+    if _os.environ.get("MV_BENCH_RACE", "1") == "0":
+        return {}
+    from multiverso_tpu.analysis import mvtsan
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+
+    ids, d = _zipf_app_corpus(V, toks, seed=9)
+
+    def one():
+        opt = WEOptions(
+            size=dim, negative=5, window=5, batch_size=4096,
+            steps_per_call=8, epoch=1, sample=0, min_count=0,
+            output_file="", use_ps=True, is_pipeline=False,
+            train_file="x", ps_pipeline_depth=1,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        t0 = time.perf_counter()
+        loss = we.train(ids=ids.copy())
+        dt = time.perf_counter() - t0
+        assert np.isfinite(loss), loss
+        return we.words_trained / max(dt, 1e-9)
+
+    one()  # warmup: first run pays jit compiles for this shape set
+    # best-of-2 per mode (same rationale as the obs leg: single-run CPU
+    # scheduler noise exceeds the effect being measured)
+    off = max(one(), one())
+    installed = mvtsan.arm()  # plan="auto" honors a prebuilt MV_RACE_PLAN
+    try:
+        armed = max(one(), one())
+        reports = len(mvtsan.reports())
+    finally:
+        mvtsan.disarm()
+        mvtsan.reset()
+    pct = 100.0 * (off - armed) / max(off, 1e-9)
+    if reports:
+        print(
+            f"# race GATE MISS: {reports} race report(s) during the "
+            "armed bench run — triage: DEPLOY.md 'Race detector'",
+            file=sys.stderr, flush=True,
+        )
+    return {
+        "race_off_pairs_per_sec": round(off, 1),
+        "race_armed_pairs_per_sec": round(armed, 1),
+        "race_detector_overhead_pct": round(pct, 2),
+        "race_instrumented_attrs": installed,
+        "race_reports": reports,
+    }
+
+
 def _bench_mttr(root):
     """MTTR drill (ISSUE 7): a REAL 2-proc pipelined pod under the
     ``PodSupervisor``, rank 1 chaos-dropped at round 5 — wall-clock
@@ -2067,6 +2129,11 @@ def main():
     except Exception as e:
         print(f"# leg obs FAILED: {e}", file=_sys.stderr, flush=True)
         obs_leg = {"obs_error": str(e)[:200]}
+    try:
+        race_leg = leg("race", _bench_race)
+    except Exception as e:
+        print(f"# leg race FAILED: {e}", file=_sys.stderr, flush=True)
+        race_leg = {"race_error": str(e)[:200]}
     multidev = leg("multidevice", _bench_multidevice)
     sharded = leg("sharded_vocab", _bench_sharded_vocab)
     try:
@@ -2124,6 +2191,7 @@ def main():
     out.update(fusedp)
     out.update(ps_comms)
     out.update(obs_leg)
+    out.update(race_leg)
     out.update(multidev)
     out.update(sharded)
     out.update(bigvocab)

@@ -363,6 +363,151 @@ print("obs trace smoke OK: rounds", rounds[0], "merged events",
 EOF
 rm -rf "$OBSROOT"
 
+echo "== race detector drill (mvtsan armed: pipelined PS + serving fleet) =="
+# the vector-clock race detector (analysis/mvtsan.py) armed over the
+# two most thread-heavy production paths: a 2-proc depth-1 pipelined PS
+# run (comms thread + pipelined rounds) and a 2-replica serving fleet
+# under concurrent client load with a snapshot rollout mid-drill. The
+# instrumentation plan is prebuilt once (MV_RACE_PLAN) so each armed
+# process skips the whole-repo static analysis; MV_SCHED_FUZZ stirs
+# thread interleavings. Every armed process dumps
+# race-report-rank<p>.json at exit and `--race-report` gates ZERO
+# unsuppressed dynamic findings through mvlint's baseline/pragma
+# machinery (analysis/baseline.toml carries no D1 entries — a race
+# here is fixed in code, never suppressed; triage: DEPLOY.md
+# "Race detector").
+RACEROOT=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$RACEROOT" <<'EOF'
+import sys
+
+sys.path.insert(0, ".")
+from multiverso_tpu.analysis import instrument
+
+plan = instrument.build_plan()
+instrument.save_plan(plan, sys.argv[1] + "/plan.json")
+print("race plan:", len(plan.entries), "shared attributes")
+EOF
+
+# leg 1: pipelined PS — the cluster launcher's workers inherit the
+# armed env; each rank's Runtime.start arms before the comms thread
+# exists and dumps through the app's end-of-train hook
+JAX_PLATFORMS=cpu MV_RACE_DETECTOR=1 MV_SCHED_FUZZ=11 \
+MV_RACE_PLAN="$RACEROOT/plan.json" MV_RACE_DIR="$RACEROOT/ps" \
+python - "$RACEROOT" <<'EOF'
+import re, sys
+import numpy as np
+
+sys.path.insert(0, ".")
+from tests.test_multiprocess_e2e import _run_cluster
+
+root = sys.argv[1]
+rng = np.random.RandomState(13)
+p = rng.randint(0, 30, 1200) * 2
+ids = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1).astype(np.int32)
+np.save(root + "/corpus.npy", ids)
+outs = _run_cluster(
+    "multiprocess_ps_worker.py",
+    lambda i: [root + "/corpus.npy", f"{root}/emb_{i}.npy",
+               "shard_pipelined"],
+    nproc=2, timeout=300,
+)
+rounds = [int(re.search(r"rounds=(\d+)", o).group(1)) for o in outs]
+assert rounds[0] == rounds[1] and rounds[0] > 2, rounds
+print("race drill (ps) OK: rounds", rounds[0])
+EOF
+for r in 0 1; do
+    test -f "$RACEROOT/ps/race-report-rank$r.json" \
+        || { echo "PS rank $r never dumped a race report (arming failed?)"; exit 1; }
+done
+
+# leg 2: serving fleet — replicas arm in serving.replica main and dump
+# per-slot (fleet pins MV_RANK to the slot index); the drill driver is
+# armed too (MV_Init -> Runtime.start) and dumps to its own directory
+JAX_PLATFORMS=cpu MV_RACE_DETECTOR=1 MV_SCHED_FUZZ=11 \
+MV_RACE_PLAN="$RACEROOT/plan.json" MV_RACE_DIR="$RACEROOT/fleet-driver" \
+python - "$RACEROOT" <<'EOF'
+import os, sys, threading, time
+import numpy as np
+
+sys.path.insert(0, ".")
+import multiverso_tpu as mv
+from multiverso_tpu.io.checkpoint import save_tables
+from multiverso_tpu.serving.client import ServingClient
+from multiverso_tpu.serving.fleet import ServingFleet
+from multiverso_tpu.tables import MatrixTableOption
+
+root = sys.argv[1]
+
+
+def commit(step, value):
+    mv.MV_Init(["prog"])
+    try:
+        t = mv.MV_CreateTable(MatrixTableOption(num_row=64, num_col=8))
+        t.add(np.full((64, 8), value, np.float32))
+        t.wait()
+        save_tables(os.path.join(root, f"ckpt-{step}"), step=step)
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+commit(1, 1.0)
+fleet = ServingFleet(
+    2, root, log_dir=os.path.join(root, "fleet-logs"),
+    extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25"],
+    env={**os.environ, "MV_RACE_DIR": os.path.join(root, "fleet")},
+    backoff_base_s=0.1, backoff_max_s=0.5,
+).start()
+assert fleet.wait_ready(timeout_s=120), "replicas never became ready"
+urls = fleet.endpoints()
+assert len(urls) == 2, urls
+
+stop = threading.Event()
+errors = []
+
+
+def load(i):
+    c = ServingClient(urls, tenant=f"race-{i}", deadline_s=30.0)
+    r = np.random.RandomState(i)
+    while not stop.is_set():
+        ids = r.randint(0, 64, size=4)
+        try:
+            rows = np.asarray(c.lookup("emb", ids), np.float32)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+            return
+        if not any(np.allclose(rows, v) for v in (1.0, 2.0)):
+            errors.append(f"torn/wrong rows: {rows[0][:2]}")
+            return
+        time.sleep(0.005)
+
+
+threads = [threading.Thread(target=load, args=(i,)) for i in range(3)]
+for th in threads:
+    th.start()
+time.sleep(1.0)
+commit(2, 2.0)  # rollout under load: the SnapshotWatcher thread swaps
+time.sleep(3.0)
+stop.set()
+for th in threads:
+    th.join(timeout=60)
+fleet.stop()
+assert not errors, errors[:3]
+print("race drill (fleet) OK")
+EOF
+for r in 0 1; do
+    test -f "$RACEROOT/fleet/race-report-rank$r.json" \
+        || { echo "fleet replica $r never dumped a race report (arming failed?)"; exit 1; }
+done
+test -f "$RACEROOT/fleet-driver/race-report-rank0.json" \
+    || { echo "fleet drill driver never dumped a race report"; exit 1; }
+
+echo "-- race gate: zero unsuppressed dynamic findings --"
+JAX_PLATFORMS=cpu python -m multiverso_tpu.analysis \
+    --race-report "$RACEROOT"/ps/race-report-rank*.json \
+                  "$RACEROOT"/fleet/race-report-rank*.json \
+                  "$RACEROOT"/fleet-driver/race-report-rank*.json
+rm -rf "$RACEROOT"
+
 echo "== tiered-table smoke (small HBM cache == resident tables) =="
 # the HBM<->host tiered MatrixTable end to end through the app: a
 # zipf corpus trains with -table_tier_hbm_mb sized to ~15% of the

@@ -10,13 +10,19 @@ changed files are re-parsed); ``--flag-table`` regenerates the DEPLOY.md
 flag reference from the AST (no imports executed) and
 ``--constraint-table`` renders the flag-constraint block from
 ``config/constraints.py`` (the single source of truth R12 checks
-against).
+against); ``--shared-state-report`` renders the mvtsan instrumentation
+plan as a table — every (class, attr, guarding locks, reaching
+threads) the ProjectGraph proves shared; ``--race-report FILE...``
+re-reads ``race-report-rank*.json`` dumps from an armed run through
+the same baseline/pragma/SARIF machinery as static findings (rule
+**D1**) — the ci ``race`` stage's gate.
 """
 
 from __future__ import annotations
 
 import argparse
 import ast
+import dataclasses
 import json
 import os
 import subprocess
@@ -113,6 +119,13 @@ def _rule_metadata() -> list:
         rid = f"R{m.group(1)}" if m else rule_fn.__name__
         doc = (rule_fn.__doc__ or "").strip().splitlines()
         seen.setdefault(rid, doc[0] if doc else rid)
+    # D1 is the dynamic detector's rule id (RaceReport → Finding via
+    # mvtsan.findings_from_reports) — same SARIF log, different engine
+    seen.setdefault(
+        "D1",
+        "mvtsan dynamic race: two unordered accesses to shared state "
+        "with no common lock (analysis/RULES.md: Dynamic analysis)",
+    )
     return [
         {"id": rid, "shortDescription": {"text": text}}
         for rid, text in sorted(seen.items())
@@ -150,6 +163,97 @@ def _sarif(result) -> dict:
     }
 
 
+def _race_report_main(args, paths) -> int:
+    """``--race-report``: gate on dynamic RaceReports. Loads the rank
+    dumps an armed run wrote (``MV_RACE_DIR``), converts each report to
+    a rule-D1 Finding, and pushes them through the SAME pragma/baseline
+    suppression pass as static findings — so the repo's empty-baseline
+    contract covers dynamic races too. Exit 0 only when every dump was
+    written by an actually-armed process AND no unsuppressed race
+    remains."""
+    from multiverso_tpu.analysis import mvtsan
+
+    reports: list = []
+    dumps = 0
+    for fp in args.race_report:
+        try:
+            with open(fp, encoding="utf-8") as fh:
+                payload = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"mvlint: --race-report {fp}: {e}", file=sys.stderr)
+            return 2
+        if payload.get("schema") != 1:
+            print(
+                f"mvlint: --race-report {fp}: schema "
+                f"{payload.get('schema')!r} != 1", file=sys.stderr,
+            )
+            return 2
+        if not payload.get("stats", {}).get("armed"):
+            # a dump from a disarmed process means the drill never
+            # actually ran under the detector — a false green, fail loud
+            print(
+                f"mvlint: --race-report {fp}: process was not armed "
+                "(MV_RACE_DETECTOR did not take) — refusing to gate on "
+                "it", file=sys.stderr,
+            )
+            return 2
+        dumps += 1
+        reports.extend(payload.get("reports", []))
+    findings = mvtsan.findings_from_reports(reports)
+    root = mvlint._find_repo_root(paths[0] if paths else ".")
+    modules: dict = {}
+    for f in findings:
+        if f.path in modules:
+            continue
+        full = os.path.join(root, f.path)
+        if not os.path.isfile(full):
+            continue
+        try:
+            with open(full, encoding="utf-8") as fh:
+                modules[f.path] = mvlint.Module(full, f.path, fh.read())
+        except (SyntaxError, ValueError, OSError):
+            continue
+    baseline_path = args.baseline or os.path.join(
+        os.path.dirname(os.path.abspath(mvlint.__file__)),
+        "baseline.toml",
+    )
+    try:
+        baseline = mvlint.load_baseline(baseline_path)
+    except ValueError as e:
+        print(f"mvlint: {e}", file=sys.stderr)
+        return 2
+    live, suppressed = mvlint._apply_suppressions(
+        findings, modules, baseline
+    )
+    if args.sarif:
+        result = mvlint.LintResult(
+            findings=live, suppressed=suppressed, files=len(modules),
+            runtime_s=0.0,
+        )
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(_sarif(result), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    if args.json:
+        print(json.dumps({
+            "dumps": dumps,
+            "reports": len(reports),
+            "findings": len(live),
+            "suppressed": len(suppressed),
+        }))
+    else:
+        for f in live:
+            print(f.render())
+        if args.verbose:
+            for f in suppressed:
+                print(f"[suppressed: {f.suppressed_by}] {f.render()}")
+        print(
+            f"mvtsan: {len(live)} race finding(s) "
+            f"({len(suppressed)} suppressed) across {dumps} rank "
+            "dump(s)"
+        )
+    return 1 if live else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m multiverso_tpu.analysis",
@@ -177,8 +281,34 @@ def main(argv=None) -> int:
     ap.add_argument("--constraint-table", action="store_true",
                     help="emit the markdown flag-constraint block from "
                          "config/constraints.py and exit")
+    ap.add_argument("--shared-state-report", action="store_true",
+                    help="render the mvtsan instrumentation plan: every "
+                         "(class, attr, guarding locks, reaching "
+                         "threads) the ProjectGraph proves shared")
+    ap.add_argument("--race-report", metavar="FILE", nargs="+",
+                    default=None,
+                    help="gate on race-report-rank*.json dumps from an "
+                         "armed run (rule D1 through the baseline/"
+                         "pragma machinery); exit 1 on unsuppressed "
+                         "races")
     args = ap.parse_args(argv)
     paths = args.paths or ["multiverso_tpu"]
+    if args.race_report:
+        return _race_report_main(args, paths)
+    if args.shared_state_report:
+        from multiverso_tpu.analysis import instrument
+
+        plan = instrument.build_plan(paths)
+        if args.json:
+            print(json.dumps({
+                "root": plan.root,
+                "entries": [
+                    dataclasses.asdict(e) for e in plan.entries
+                ],
+            }, indent=1, sort_keys=True))
+        else:
+            print(instrument.render_report(plan))
+        return 0
     if args.flag_table:
         print(_flag_table(paths))
         return 0

@@ -37,6 +37,7 @@ from contextlib import contextmanager
 from functools import wraps
 from typing import Dict, Optional, Set, Tuple
 
+import multiverso_tpu.analysis.mvtsan as _mvtsan
 from multiverso_tpu.utils.configure import (
     GetFlag,
     MV_DEFINE_bool,
@@ -194,6 +195,12 @@ def collective_dispatch(fn):
     def wrapper(*args, **kwargs):
         if guards_enabled():
             _check_dispatch_thread(fn.__qualname__)
+        if _mvtsan._ACTIVE:
+            # mvtsan mirrors mvlint R9's credit: the thread-identity
+            # guard serializes tagged entries, so table state touched
+            # here holds the same VIRTUAL lock the static rule assumes
+            with _mvtsan.virtual_lock("<collective_dispatch>"):
+                return fn(*args, **kwargs)
         return fn(*args, **kwargs)
 
     wrapper.__mv_collective_dispatch__ = True
@@ -242,6 +249,9 @@ class OrderedLock:
         self.name = name
         self._recursive = recursive
         self._lock = threading.RLock() if recursive else threading.Lock()
+        # mvtsan happens-before cell: release publishes the holder's
+        # vector clock here, acquire joins it (armed runs only)
+        self._mv_sync = _mvtsan.SyncClock()
         with _order_mutex:
             _uid_counter += 1
             # never-reused (unlike id()): a GC'd lock's slot in the
@@ -294,6 +304,8 @@ class OrderedLock:
             except GuardViolation:
                 self._lock.release()
                 raise
+        if ok and _mvtsan._ACTIVE:
+            _mvtsan.lock_acquired(self._mv_sync, self.name, self._uid)
         return ok
 
     def release(self) -> None:
@@ -306,6 +318,10 @@ class OrderedLock:
                 if stack[i][1] == self._uid:
                     del stack[i]
                     break
+        if _mvtsan._ACTIVE:
+            # publish while still holding: the next acquirer must see
+            # every write made inside this critical section
+            _mvtsan.lock_released(self._mv_sync, self.name, self._uid)
         self._lock.release()
 
     def __enter__(self) -> "OrderedLock":

@@ -1,0 +1,334 @@
+"""Static instrumentation plan for the mvtsan dynamic race detector.
+
+The detector (:mod:`multiverso_tpu.analysis.mvtsan`) does NOT wrap
+every Python attribute access — that would be a tracing profiler, not
+a bounded-overhead debug mode. Instead mvlint's interprocedural
+``ProjectGraph`` proves, per (class, attribute), which fields are
+reachable from more than one thread entry (the same analysis behind
+rule R9), and only those attributes get a data descriptor that feeds
+the vector-clock engine. The plan carries the static verdict along —
+``race`` entries cross-reference the R9 finding a dynamic RaceReport
+confirms; ``writer-serialized``/``publication``/``lock-guarded``
+entries are the exemption set the dynamic verdict must agree with.
+
+The same plan, rendered as a table, is the
+``python -m multiverso_tpu.analysis --shared-state-report`` CLI mode:
+every (class, attr, guarding locks, reaching threads) triple the graph
+knows about.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from multiverso_tpu.analysis import mvlint
+from multiverso_tpu.analysis.dataflow import ProjectGraph
+from multiverso_tpu.analysis.rules_spmd import (
+    class_access_buckets,
+    classify_attr,
+    spmd_facts,
+)
+
+__all__ = [
+    "PlanEntry",
+    "Plan",
+    "build_plan",
+    "load_plan",
+    "save_plan",
+    "render_report",
+    "apply_plan",
+    "remove_all",
+    "instrument_class",
+    "instrumented_count",
+]
+
+PLAN_SCHEMA = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanEntry:
+    """One instrumented (class, attribute) pair."""
+
+    relpath: str          # module file, repo-relative
+    cls: str              # class name
+    attr: str             # attribute name
+    classification: str   # AttrVerdict.classification
+    locks: Tuple[str, ...]        # statically-proven common locks
+    threads: Tuple[str, ...]      # thread entries reaching an accessor
+    rmw: bool             # some write is a read-modify-write
+    line: int             # representative access line (report anchor)
+
+    @property
+    def dotted_module(self) -> str:
+        p = self.relpath[:-3] if self.relpath.endswith(".py") else \
+            self.relpath
+        return p.replace("/", ".")
+
+
+@dataclasses.dataclass
+class Plan:
+    entries: List[PlanEntry]
+    root: str = ""
+
+    def by_key(self) -> Dict[Tuple[str, str], PlanEntry]:
+        return {(e.cls, e.attr): e for e in self.entries}
+
+
+def _reaching_threads(graph: ProjectGraph, facts,
+                      acc_uids: set) -> Tuple[str, ...]:
+    """Names of the thread entries whose reachable set intersects the
+    accessor functions — the "who can touch this" column. Per-entry
+    reachable sets are cached on the graph (one BFS per distinct
+    entry, shared across all attributes)."""
+    cache = getattr(graph, "_mv_entry_reach", None)
+    if cache is None:
+        cache = {}
+        for _fn, _call, kind, entry in facts.thread_entries():
+            label = f"{kind}:{entry.qualname}"
+            if label not in cache:
+                cache[label] = graph.reachable_set([entry])
+        graph._mv_entry_reach = cache
+    out = sorted(
+        label for label, reach in cache.items() if reach & acc_uids
+    )
+    return tuple(out)
+
+
+def build_plan(paths: Optional[Sequence[str]] = None) -> Plan:
+    """Parse ``paths`` (default: the installed ``multiverso_tpu``
+    package), build the ProjectGraph, and emit one entry per attribute
+    the graph proves reachable from both a thread entry and main-side
+    code. Reads-only and single-side attributes are omitted — they
+    cannot race, and every skipped attribute is armed-mode overhead
+    saved."""
+    if paths is None:
+        pkg_dir = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))
+        paths = [pkg_dir]
+    root = mvlint._find_repo_root(paths[0])
+    modules: Dict[str, mvlint.Module] = {}
+    for fp in mvlint._iter_py_files(paths):
+        rel = os.path.relpath(fp, root)
+        if rel.startswith(".."):
+            rel = fp
+        key = rel.replace(os.sep, "/")
+        try:
+            with open(fp, encoding="utf-8") as fh:
+                src = fh.read()
+            modules[key] = mvlint.Module(fp, rel, src)
+        except (SyntaxError, ValueError, OSError):
+            continue
+    mods = list(modules.values())
+    graph = ProjectGraph(mods)
+    facts = spmd_facts(graph)
+    tuids = facts.thread_uids()
+    muids = facts.main_uids()
+    entries: List[PlanEntry] = []
+    for (relpath, clsname), attrs in sorted(
+        class_access_buckets(mods, graph).items()
+    ):
+        for attr, accs in sorted(attrs.items()):
+            v = classify_attr(accs, tuids, muids)
+            if not v.cross_thread or v.classification in (
+                "reads-only", "one-side"
+            ):
+                continue
+            entries.append(PlanEntry(
+                relpath=relpath,
+                cls=clsname,
+                attr=attr,
+                classification=v.classification,
+                locks=tuple(sorted(v.locks)),
+                threads=_reaching_threads(
+                    graph, facts, {a.fn.uid for a in accs}
+                ),
+                rmw=v.rmw,
+                line=min(a.line for a in accs),
+            ))
+    return Plan(entries=entries, root=root)
+
+
+def save_plan(plan: Plan, path: str) -> None:
+    payload = {
+        "schema": PLAN_SCHEMA,
+        "root": plan.root,
+        "entries": [dataclasses.asdict(e) for e in plan.entries],
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_plan(path: str) -> Plan:
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("schema") != PLAN_SCHEMA:
+        raise ValueError(
+            f"instrumentation plan {path}: schema "
+            f"{payload.get('schema')!r} != {PLAN_SCHEMA}"
+        )
+    entries = [
+        PlanEntry(
+            relpath=e["relpath"], cls=e["cls"], attr=e["attr"],
+            classification=e["classification"],
+            locks=tuple(e["locks"]), threads=tuple(e["threads"]),
+            rmw=bool(e["rmw"]), line=int(e["line"]),
+        )
+        for e in payload["entries"]
+    ]
+    return Plan(entries=entries, root=payload.get("root", ""))
+
+
+def render_report(plan: Plan) -> str:
+    """The ``--shared-state-report`` table: every (class, attr,
+    guarding locks, reaching threads) triple the graph knows."""
+    rows = [("class.attr", "verdict", "locks", "rmw",
+             "reaching threads")]
+    for e in sorted(plan.entries,
+                    key=lambda e: (e.relpath, e.cls, e.attr)):
+        rows.append((
+            f"{e.cls}.{e.attr}",
+            e.classification,
+            ",".join(e.locks) or "-",
+            "rmw" if e.rmw else "-",
+            ", ".join(e.threads) or "-",
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    out = []
+    for i, r in enumerate(rows):
+        out.append("  ".join(
+            [r[j].ljust(widths[j]) for j in range(4)] + [r[4]]
+        ).rstrip())
+        if i == 0:
+            out.append("  ".join("-" * w for w in widths) + "  " + "-" * 16)
+    n_race = sum(1 for e in plan.entries
+                 if e.classification == "race")
+    out.append("")
+    out.append(
+        f"{len(plan.entries)} shared attributes "
+        f"({n_race} statically unguarded [R9], "
+        f"{len(plan.entries) - n_race} exempt); "
+        "instrumented by mvtsan when MV_RACE_DETECTOR=1 "
+        "(analysis/RULES.md: Dynamic analysis)"
+    )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------- descriptor install
+#
+# Armed mode only: apply_plan swaps a data descriptor into each planned
+# class for each planned attribute. The descriptor stores the value
+# where it always lived (the instance ``__dict__``) and keeps the race
+# shadow state next to it under a non-identifier key, so object
+# lifetime carries the shadow with no global map and no id() reuse
+# hazard. Disarmed processes never install anything — the production
+# hot path cost of this module is zero.
+
+_installed: List[Tuple[type, str, bool, object]] = []
+
+
+def _resolve_class(entry: PlanEntry) -> Optional[type]:
+    import importlib
+
+    try:
+        mod = importlib.import_module(entry.dotted_module)
+    except Exception:  # noqa: BLE001 — scripts/examples may not import
+        return None
+    obj = getattr(mod, entry.cls, None)
+    return obj if isinstance(obj, type) else None
+
+
+_CONST_DEFAULTS = (int, float, str, bool, bytes, tuple, frozenset,
+                   type(None))
+
+
+def _instrument_one(cls: type, attr: str, entry: Optional[PlanEntry],
+                    relpath: str) -> bool:
+    import inspect
+
+    from multiverso_tpu.analysis import mvtsan
+
+    # slotted classes keep values in slot descriptors, not the
+    # instance dict — our descriptor has nowhere to store
+    if not any("__dict__" in k.__dict__ for k in cls.__mro__
+               if k is not object):
+        return False
+    missing = object()
+    try:
+        existing = inspect.getattr_static(cls, attr)
+    except AttributeError:
+        existing = missing
+    if existing is not missing and not isinstance(
+        existing, _CONST_DEFAULTS
+    ):
+        # attr name collides with a method/property/slot descriptor
+        # (own or inherited) — wrapping would change semantics, skip
+        return False
+    had_own = attr in cls.__dict__
+    orig_own = cls.__dict__.get(attr)
+    try:
+        desc = mvtsan.InstrumentedAttr(
+            cls.__name__, attr, relpath, entry,
+            default=mvtsan._NO_DEFAULT if existing is missing
+            else existing,
+        )
+        setattr(cls, attr, desc)
+    except (AttributeError, TypeError):
+        return False
+    _installed.append((cls, attr, had_own, orig_own))
+    return True
+
+
+def apply_plan(plan: Plan) -> Tuple[int, List[PlanEntry]]:
+    """Install descriptors for every resolvable plan entry. Returns
+    (installed count, skipped entries). Import failures and descriptor
+    collisions skip the entry rather than failing the arm — a partial
+    plan still catches races on everything it covers."""
+    installed = 0
+    skipped: List[PlanEntry] = []
+    for entry in plan.entries:
+        cls = _resolve_class(entry)
+        if cls is None or not _instrument_one(
+            cls, entry.attr, entry, entry.relpath
+        ):
+            skipped.append(entry)
+            continue
+        installed += 1
+    return installed, skipped
+
+
+def instrument_class(cls: type, attrs: Sequence[str],
+                     relpath: str = "<test>") -> int:
+    """Directly instrument ``attrs`` on ``cls`` — the fixture/test
+    entry point that bypasses the static plan."""
+    n = 0
+    for attr in attrs:
+        if _instrument_one(cls, attr, None, relpath):
+            n += 1
+    return n
+
+
+def remove_all(down_to: int = 0) -> None:
+    """Uninstall descriptors apply_plan/instrument_class put in (test
+    isolation and disarm). ``down_to`` keeps the first N installs — a
+    test that instrumented its own fixture class on an already-armed
+    session removes only its own additions."""
+    while len(_installed) > down_to:
+        cls, attr, had, orig = _installed.pop()
+        try:
+            if had:
+                setattr(cls, attr, orig)
+            else:
+                delattr(cls, attr)
+        except (AttributeError, TypeError):
+            pass
+
+
+def instrumented_count() -> int:
+    return len(_installed)

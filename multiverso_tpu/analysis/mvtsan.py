@@ -1,0 +1,999 @@
+"""mvtsan — hybrid lockset + vector-clock race detector for the
+repo's own threaded runtime (the dynamic complement of mvlint R9).
+
+Armed via ``-debug_race_detector`` / ``MV_RACE_DETECTOR=1`` (same
+env-derived-default pattern as the PR 8 guards: ``ResetFlagsToDefault``
+cannot disarm a suite that exported the env var). Disarmed, the entire
+subsystem costs the callers one module-bool read per hook — no
+descriptors are installed and no threading primitive is patched.
+
+Armed, three things happen:
+
+* **Instrumentation plan** — mvlint's ProjectGraph proves which
+  (class, attr) pairs are reachable from more than one thread entry
+  (:mod:`multiverso_tpu.analysis.instrument`); only those attributes
+  get a data descriptor feeding the detector. Bounded overhead by
+  construction, not blanket ``__setattr__`` wrapping.
+
+* **Sync edges** — happens-before comes from the primitives the repo
+  already owns: ``OrderedLock`` acquire/release, ``TaskPipe``
+  submit→run and run→wait_result, ``ASyncBuffer`` fill→get,
+  ``Waiter`` notify→wait, ``MtQueue`` push→pop (native path included),
+  ``threading.Thread`` start/join, plus ``threading.Lock``/``RLock``/
+  ``Event`` created after arming (the factories are patched so plain
+  stdlib locks used by the runtime still order the clocks).
+  Mutex hand-offs transfer the releaser's clock exactly (FastTrack
+  style); queues/events/latches *merge* — an over-approximation that
+  can only hide races, never invent them.
+
+* **Verdicts** — a pair of unordered accesses races only under the
+  same rules R9 applies statically, so static and dynamic findings
+  agree on the same field: unordered write/write with no common lock
+  races; a read-modify-write racing any access with no common lock
+  races; a plain store racing a plain load is *publication* (exempt,
+  GIL-atomic); writes serialized under one common lock make lock-free
+  pure reads exempt (*writer-serialized publication*); and
+  ``@collective_dispatch`` entries hold the same virtual lock R9
+  credits them with.
+
+Races surface as structured :class:`RaceReport` objects: both access
+stacks, both thread names, both locksets, and the vector-clock
+witness. They land in the obs flight recorder, in
+``race-report-rank<p>.json`` (``MV_RACE_DIR``), and — through
+``python -m multiverso_tpu.analysis --race-report`` — in mvlint's
+Finding/baseline/pragma/SARIF machinery under rule id **D1**, where a
+dynamic race and the static R9 verdict on the same field
+cross-reference each other.
+
+Schedule fuzz: ``MV_SCHED_FUZZ=<seed>`` shrinks
+``sys.setswitchinterval`` and injects seeded sleeps at sync points so
+the ci ``race`` stage explores more interleavings. The seed makes the
+*jitter* reproducible, not the OS scheduler — a fuzzed run that found
+a race is evidence, a fuzzed run that found none is not a proof.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import random
+import sys
+import threading
+import time
+import zlib
+from contextlib import contextmanager
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from multiverso_tpu.utils.configure import (
+    GetFlag,
+    MV_DEFINE_bool,
+    mutation_count,
+)
+
+__all__ = [
+    "RaceReport",
+    "race_detector_enabled",
+    "arm",
+    "disarm",
+    "maybe_arm_from_flags",
+    "maybe_dump_from_flags",
+    "is_armed",
+    "publish",
+    "join",
+    "SyncClock",
+    "sync_release",
+    "sync_acquire",
+    "virtual_lock",
+    "lock_acquired",
+    "lock_released",
+    "reports",
+    "reset",
+    "stats",
+    "dump_reports",
+    "findings_from_reports",
+    "InstrumentedAttr",
+]
+
+# env-derived default, like -debug_thread_guards: the race ci stage and
+# armed test runs export MV_RACE_DETECTOR=1, and the default must
+# survive ResetFlagsToDefault()
+MV_DEFINE_bool(
+    "debug_race_detector",
+    os.environ.get("MV_RACE_DETECTOR", "") == "1",
+    "arm mvtsan, the lockset + vector-clock dynamic race detector: "
+    "instruments the shared attributes mvlint's plan proves "
+    "cross-thread and reports unordered conflicting accesses as "
+    "RaceReports (see analysis/RULES.md: Dynamic analysis)",
+)
+
+_enabled_cache: Optional[bool] = None
+_enabled_gen = -1
+
+
+def race_detector_enabled() -> bool:
+    """Cached against the flag registry's mutation counter — the
+    disarmed hot path never takes the registry mutex (the
+    ``guards_enabled()`` pattern)."""
+    global _enabled_cache, _enabled_gen
+    gen = mutation_count()
+    if _enabled_cache is None or _enabled_gen != gen:
+        _enabled_cache = bool(GetFlag("debug_race_detector"))
+        _enabled_gen = gen
+    return _enabled_cache
+
+
+# module-level armed bool: every sync hook in utils/native/guards reads
+# this ONE attribute and bails — the entire disarmed cost of the hooks
+_ACTIVE = False
+
+
+def is_armed() -> bool:
+    return _ACTIVE
+
+
+# --------------------------------------------------------- thread state
+
+_tls = threading.local()
+_tid_mutex = threading.Lock()
+_next_tid = 0
+MAX_REPORTS = 200
+
+
+class _ThreadState:
+    __slots__ = ("tid", "clock", "locks", "busy", "rng", "name")
+
+    def __init__(self, tid: int, name: str):
+        self.tid = tid
+        self.clock: Dict[int, int] = {tid: 1}
+        self.locks: List[Tuple[str, int]] = []  # (name, uid) stack
+        self.busy = False  # reentrancy guard for detector internals
+        self.rng: Optional[random.Random] = None
+        self.name = name
+
+
+def _state() -> _ThreadState:
+    st = getattr(_tls, "st", None)
+    if st is None:
+        global _next_tid
+        with _tid_mutex:
+            _next_tid += 1
+            tid = _next_tid
+        # threading.current_thread() is OFF LIMITS here: during thread
+        # bootstrap it would fabricate a _DummyThread whose __init__
+        # sets a (tracked) Event → sync_release → _state → recursion.
+        # _active is registration-only — None during bootstrap, and
+        # the run() wrapper fixes the name up right after.
+        cur = threading._active.get(threading.get_ident())
+        st = _ThreadState(
+            tid, cur.name if cur is not None else f"thread-{tid}"
+        )
+        if _fuzz_seed is not None:
+            st.rng = random.Random(_fuzz_seed ^ (tid * 0x9E3779B9))
+        _tls.st = st
+        # spawner → child edge: Thread.start (patched) stashed the
+        # parent's clock on the thread object (the run() wrapper also
+        # joins it — this covers states born before run())
+        parent = getattr(cur, "_mv_hb_parent", None) \
+            if cur is not None else None
+        if parent:
+            _join_into(st, parent)
+    return st
+
+
+def _join_into(st: _ThreadState, clock: Dict[int, int]) -> None:
+    mine = st.clock
+    for t, c in clock.items():
+        if mine.get(t, 0) < c:
+            mine[t] = c
+
+
+def publish() -> Optional[Dict[int, int]]:
+    """Snapshot the calling thread's clock for a happens-before edge
+    and advance its own component (the snapshot names a distinct
+    epoch). Returns ``None`` disarmed — ``join(None)`` no-ops, so call
+    sites stay one line."""
+    if not _ACTIVE:
+        return None
+    st = _state()
+    if st.busy:
+        return None
+    snap = dict(st.clock)
+    st.clock[st.tid] += 1
+    _counters["sync_publish"] += 1
+    return snap
+
+
+def join(clock: Optional[Dict[int, int]]) -> None:
+    """Acquire side of an edge: element-wise max into the calling
+    thread's clock."""
+    if not _ACTIVE or not clock:
+        return
+    st = _state()
+    if st.busy:
+        return
+    _join_into(st, clock)
+    _counters["sync_join"] += 1
+    _maybe_fuzz(st)
+
+
+class SyncClock:
+    """Per-sync-object clock cell (one per MtQueue / Waiter / tracked
+    lock). Lock hand-offs *replace* (exact, FastTrack); queue/latch
+    traffic *merges* (sound over-approximation for multi-producer)."""
+
+    __slots__ = ("clock",)
+
+    def __init__(self):
+        self.clock: Optional[Dict[int, int]] = None
+
+
+def sync_release(cell: SyncClock, merge: bool = True) -> None:
+    snap = publish()
+    if snap is None:
+        return
+    if merge and cell.clock:
+        base = cell.clock
+        for t, c in snap.items():
+            if base.get(t, 0) < c:
+                base[t] = c
+    else:
+        cell.clock = snap
+
+
+def sync_acquire(cell: SyncClock) -> None:
+    if not _ACTIVE:
+        return
+    join(cell.clock)
+
+
+def sync_of(obj: Any, slot: str = "_mv_sync") -> SyncClock:
+    """Lazily attach a SyncClock to ``obj`` (GIL-atomic setdefault —
+    safe to call from racing hookpoints)."""
+    cell = obj.__dict__.get(slot)
+    if cell is None:
+        cell = obj.__dict__.setdefault(slot, SyncClock())
+    return cell
+
+
+# ------------------------------------------------------------- locksets
+
+_lock_uid_counter = 0
+
+
+def _next_lock_uid() -> int:
+    global _lock_uid_counter
+    with _tid_mutex:
+        _lock_uid_counter += 1
+        return _lock_uid_counter
+
+
+def lock_acquired(cell: SyncClock, name: str, uid: int) -> None:
+    """An owned lock (OrderedLock or a tracked stdlib lock) was
+    acquired: join its clock (exact transfer) and push it on the
+    calling thread's lockset."""
+    if not _ACTIVE:
+        return
+    st = _state()
+    if st.busy:
+        return
+    if cell.clock:
+        _join_into(st, cell.clock)
+    st.locks.append((name, uid))
+    _counters["lock_edges"] += 1
+    _maybe_fuzz(st)
+
+
+def lock_released(cell: SyncClock, name: str, uid: int) -> None:
+    """Release side: publish the clock INTO the lock (call while still
+    holding it) and pop the lockset entry."""
+    if not _ACTIVE:
+        return
+    st = _state()
+    if st.busy:
+        return
+    snap = dict(st.clock)
+    st.clock[st.tid] += 1
+    cell.clock = snap  # exact hand-off: acquirer's join saw history
+    locks = st.locks
+    for i in range(len(locks) - 1, -1, -1):
+        if locks[i][1] == uid:
+            del locks[i]
+            break
+
+
+@contextmanager
+def virtual_lock(name: str):
+    """Treat a code region as serialized by a virtual lock — the
+    runtime mirror of R9's ``@collective_dispatch`` credit (the guard
+    pins those entries to one thread, so the decorator IS the
+    synchronization)."""
+    if not _ACTIVE:
+        yield
+        return
+    st = _state()
+    key = (name, 0)
+    st.locks.append(key)
+    try:
+        yield
+    finally:
+        for i in range(len(st.locks) - 1, -1, -1):
+            if st.locks[i] == key:
+                del st.locks[i]
+                break
+
+
+# -------------------------------------------------------- schedule fuzz
+
+_fuzz_seed: Optional[int] = None
+_fuzz_prev_interval: Optional[float] = None
+
+
+def _install_fuzz() -> None:
+    global _fuzz_seed, _fuzz_prev_interval
+    spec = os.environ.get("MV_SCHED_FUZZ", "")
+    if not spec:
+        return
+    _fuzz_seed = int(spec) if spec.isdigit() else zlib.crc32(
+        spec.encode("utf-8")
+    )
+    _fuzz_prev_interval = sys.getswitchinterval()
+    # tiny switch interval: force the interpreter to preempt between
+    # bytecodes far more often, widening the explored interleavings
+    sys.setswitchinterval(1e-5)
+
+
+def _uninstall_fuzz() -> None:
+    global _fuzz_seed, _fuzz_prev_interval
+    if _fuzz_prev_interval is not None:
+        sys.setswitchinterval(_fuzz_prev_interval)
+    _fuzz_seed = None
+    _fuzz_prev_interval = None
+
+
+def _maybe_fuzz(st: _ThreadState) -> None:
+    rng = st.rng
+    if rng is not None and rng.random() < 0.05:
+        time.sleep(rng.random() * 5e-4)
+
+
+# ------------------------------------------------------------ reporting
+
+class RaceReport:
+    """One detected race: the two unordered accesses, with thread
+    names, short stacks, locksets, and the vector-clock witness."""
+
+    __slots__ = ("cls", "attr", "kind", "path", "line",
+                 "a_thread", "a_where", "a_locks",
+                 "b_thread", "b_where", "b_locks",
+                 "vc_current", "vc_prior", "static")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RaceReport":
+        return cls(**{k: d.get(k) for k in cls.__slots__})
+
+    def message(self) -> str:
+        a_at = self.a_where[0] if self.a_where else "?"
+        b_at = self.b_where[0] if self.b_where else "?"
+        return (
+            f"{self.cls}.{self.attr}: {self.kind} — "
+            f"{self.a_thread!r} ({a_at}) unordered with "
+            f"{self.b_thread!r} ({b_at}); locks "
+            f"{sorted(self.a_locks or [])} vs "
+            f"{sorted(self.b_locks or [])}; "
+            f"vc witness {self.vc_prior} ⋠ {self.vc_current}"
+            + (f"; static verdict: {self.static}" if self.static else "")
+        )
+
+
+_reports: List[RaceReport] = []
+_reported_keys: set = set()
+_report_mutex = threading.Lock()
+_counters: Dict[str, int] = {
+    "accesses": 0, "sync_publish": 0, "sync_join": 0,
+    "lock_edges": 0, "races": 0,
+}
+_repo_root = ""
+
+
+def reports() -> List[RaceReport]:
+    return list(_reports)
+
+
+def reset() -> None:
+    """Forget reports and counters (test isolation). Armed state and
+    instrumentation are untouched."""
+    with _report_mutex:
+        _reports.clear()
+        _reported_keys.clear()
+        for k in _counters:
+            _counters[k] = 0
+
+
+def _where(skip: int, limit: int = 4) -> List[str]:
+    out: List[str] = []
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return out
+    while f is not None and len(out) < limit:
+        fname = f.f_code.co_filename
+        if _repo_root and fname.startswith(_repo_root):
+            fname = fname[len(_repo_root):].lstrip(os.sep)
+        out.append(
+            f"{fname}:{f.f_lineno} in {f.f_code.co_name}"
+        )
+        f = f.f_back
+    return out
+
+
+def _emit(report: RaceReport) -> None:
+    key = (report.cls, report.attr, report.kind)
+    with _report_mutex:
+        if key in _reported_keys or len(_reports) >= MAX_REPORTS:
+            return
+        _reported_keys.add(key)
+        _reports.append(report)
+        _counters["races"] += 1
+    try:
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record(
+            "race_report", cls=report.cls, attr=report.attr,
+            kind=report.kind, a_thread=report.a_thread,
+            b_thread=report.b_thread, where=report.a_where[:1],
+        )
+    except Exception:  # noqa: BLE001 — never mask the report
+        pass
+    print(f"mvtsan: RACE {report.message()}", file=sys.stderr)
+
+
+# ------------------------------------------------- instrumented attrs
+
+_NO_DEFAULT = object()
+
+
+class _Shadow:
+    """Per-(instance, attr) race metadata, stored in the instance
+    ``__dict__`` under a non-identifier key so lifetime and GC are the
+    object's own. Field updates are GIL-atomic dict/slot ops; a torn
+    interleaving can at worst drop one historical access (a missed
+    race), never a false positive or a crash."""
+
+    __slots__ = ("w_tid", "w_clk", "w_name", "w_where", "w_locks",
+                 "w_rmw", "w_common", "reads")
+
+    def __init__(self):
+        self.w_tid: Optional[int] = None
+        self.w_clk = 0
+        self.w_name = ""
+        self.w_where: List[str] = []
+        self.w_locks: FrozenSet = frozenset()
+        self.w_rmw = False
+        # running ∩ of every write's lockset: non-empty == the writes
+        # are serialized by one common lock (writer-serialized
+        # publication, R9's exemption)
+        self.w_common: Optional[FrozenSet] = None
+        # tid -> (clk, thread name, where, lockset)
+        self.reads: Dict[int, Tuple[int, str, List[str], FrozenSet]] = {}
+
+
+class InstrumentedAttr:
+    """Data descriptor the instrumentation plan installs per shared
+    (class, attr). Values live where they always did — the instance
+    ``__dict__`` — so pickling, ``vars()`` and reprs stay sane; the
+    descriptor only observes."""
+
+    __slots__ = ("cls_name", "attr", "relpath", "entry", "default",
+                 "shadow_key")
+
+    def __init__(self, cls_name: str, attr: str, relpath: str,
+                 entry=None, default=_NO_DEFAULT):
+        self.cls_name = cls_name
+        self.attr = attr
+        self.relpath = relpath
+        self.entry = entry  # instrument.PlanEntry (static verdict)
+        self.default = default
+        self.shadow_key = "\x00mv:" + attr
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        if _ACTIVE:
+            _on_access(self, obj, False)
+        try:
+            return obj.__dict__[self.attr]
+        except KeyError:
+            if self.default is not _NO_DEFAULT:
+                return self.default
+            raise AttributeError(
+                f"{type(obj).__name__!r} object has no attribute "
+                f"{self.attr!r}"
+            ) from None
+
+    def __set__(self, obj, value):
+        if _ACTIVE:
+            _on_access(self, obj, True)
+        obj.__dict__[self.attr] = value
+
+    def __delete__(self, obj):
+        if _ACTIVE:
+            _on_access(self, obj, True)
+        try:
+            del obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(self.attr) from None
+
+
+def _static_note(desc: InstrumentedAttr) -> str:
+    e = desc.entry
+    if e is None:
+        return ""
+    if e.classification == "race":
+        return (f"race (mvlint R9 finding at {e.relpath}:{e.line} — "
+                "dynamic confirmation of the static report)")
+    return (f"{e.classification} at {e.relpath}:{e.line} "
+            "(statically exempt — dynamic schedule contradicts the "
+            "static model; check for an untracked sync path)")
+
+
+def _on_access(desc: InstrumentedAttr, obj, is_write: bool) -> None:
+    st = _state()
+    if st.busy:
+        return
+    st.busy = True
+    try:
+        _counters["accesses"] += 1
+        d = obj.__dict__
+        sh = d.get(desc.shadow_key)
+        if sh is None:
+            sh = d.setdefault(desc.shadow_key, _Shadow())
+        locks = frozenset(st.locks)
+        my_clk = st.clock[st.tid]
+        _maybe_fuzz(st)
+        if not is_write:
+            # read racing a prior RMW write? plain store vs plain load
+            # is publication (GIL-atomic) — exempt, like R9
+            w_tid = sh.w_tid
+            if (w_tid is not None and w_tid != st.tid
+                    and st.clock.get(w_tid, 0) < sh.w_clk
+                    and sh.w_rmw
+                    and not (locks & sh.w_locks)
+                    and not sh.w_common):
+                _emit(RaceReport(
+                    cls=desc.cls_name, attr=desc.attr,
+                    kind="read racing a read-modify-write",
+                    path=desc.relpath, line=_line_of(desc),
+                    a_thread=st.name, a_where=_where(3),
+                    a_locks=_lock_names(locks),
+                    b_thread=sh.w_name, b_where=list(sh.w_where),
+                    b_locks=_lock_names(sh.w_locks),
+                    vc_current=dict(st.clock),
+                    vc_prior=f"{w_tid}@{sh.w_clk}",
+                    static=_static_note(desc),
+                ))
+            sh.reads[st.tid] = (my_clk, st.name, _where(3), locks)
+            return
+        # ---- write path
+        rmw = st.tid in sh.reads  # this thread read since last write
+        # single-owner phase: the attribute has only ever been touched
+        # by this thread (constructor / pre-publication setup). Such
+        # writes are program-ordered, so they don't constrain the
+        # writers' common-lock intersection — the dynamic mirror of R9
+        # excluding __init__ accesses from the static buckets.
+        single_owner = (
+            (sh.w_tid is None or sh.w_tid == st.tid)
+            and all(t == st.tid for t in sh.reads)
+        )
+        if single_owner:
+            w_common = sh.w_common
+        else:
+            w_common = locks if sh.w_common is None else \
+                (sh.w_common & locks)
+        w_tid = sh.w_tid
+        if (w_tid is not None and w_tid != st.tid
+                and st.clock.get(w_tid, 0) < sh.w_clk
+                and not (locks & sh.w_locks)):
+            _emit(RaceReport(
+                cls=desc.cls_name, attr=desc.attr,
+                kind="unordered write-write",
+                path=desc.relpath, line=_line_of(desc),
+                a_thread=st.name, a_where=_where(3),
+                a_locks=_lock_names(locks),
+                b_thread=sh.w_name, b_where=list(sh.w_where),
+                b_locks=_lock_names(sh.w_locks),
+                vc_current=dict(st.clock),
+                vc_prior=f"{w_tid}@{sh.w_clk}",
+                static=_static_note(desc),
+            ))
+        if rmw and not w_common:
+            for r_tid, (r_clk, r_name, r_where, r_locks) in \
+                    list(sh.reads.items()):
+                if r_tid == st.tid:
+                    continue
+                if st.clock.get(r_tid, 0) >= r_clk:
+                    continue  # ordered before this write
+                if locks & r_locks:
+                    continue  # common lock covers the pair
+                _emit(RaceReport(
+                    cls=desc.cls_name, attr=desc.attr,
+                    kind="read-modify-write racing a read",
+                    path=desc.relpath, line=_line_of(desc),
+                    a_thread=st.name, a_where=_where(3),
+                    a_locks=_lock_names(locks),
+                    b_thread=r_name, b_where=list(r_where),
+                    b_locks=_lock_names(r_locks),
+                    vc_current=dict(st.clock),
+                    vc_prior=f"{r_tid}@{r_clk}",
+                    static=_static_note(desc),
+                ))
+                break
+        sh.w_tid = st.tid
+        sh.w_clk = my_clk
+        sh.w_name = st.name
+        sh.w_where = _where(3)
+        sh.w_locks = locks
+        sh.w_rmw = rmw
+        sh.w_common = w_common
+        sh.reads = {}
+    finally:
+        st.busy = False
+
+
+def _lock_names(locks: FrozenSet) -> List[str]:
+    return sorted(
+        name if uid == 0 else f"{name}#{uid}" for name, uid in locks
+    )
+
+
+def _line_of(desc: InstrumentedAttr) -> int:
+    return desc.entry.line if desc.entry is not None else 0
+
+
+# -------------------------------------------------- threading patches
+
+_patches: List[Tuple[Any, str, Any]] = []
+
+
+def _patch(obj: Any, name: str, new: Any) -> None:
+    _patches.append((obj, name, getattr(obj, name)))
+    setattr(obj, name, new)
+
+
+def _unpatch_all() -> None:
+    while _patches:
+        obj, name, orig = _patches.pop()
+        try:
+            setattr(obj, name, orig)
+        except (AttributeError, TypeError):
+            pass
+
+
+class _TrackedLock:
+    """``threading.Lock()`` replacement handed out while armed: exact
+    clock hand-off on release→acquire plus lockset membership. The
+    factories are patched at ``arm()`` — locks created before arming
+    stay plain (arm early: the race drills arm before building any app
+    object)."""
+
+    _mv_kind = "Lock"
+
+    def __init__(self):
+        self._inner = _ORIG["lock"]()
+        self._mv_sync = SyncClock()
+        self._mv_name = self._mv_kind
+        self._mv_uid = _next_lock_uid()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            lock_acquired(self._mv_sync, self._mv_name, self._mv_uid)
+        return ok
+
+    acquire_lock = acquire
+
+    def release(self):
+        lock_released(self._mv_sync, self._mv_name, self._mv_uid)
+        self._inner.release()
+
+    release_lock = release
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+
+    def __repr__(self):
+        return f"<mvtsan tracked {self._mv_kind} of {self._inner!r}>"
+
+
+class _TrackedRLock(_TrackedLock):
+    _mv_kind = "RLock"
+
+    def __init__(self):
+        self._inner = _ORIG["rlock"]()
+        self._mv_sync = SyncClock()
+        self._mv_name = self._mv_kind
+        self._mv_uid = _next_lock_uid()
+
+    # Condition protocol: wait() parks via _release_save and returns
+    # via _acquire_restore — the clock must ride both, or a waiter
+    # would appear to hold history it released
+    def _release_save(self):
+        lock_released(self._mv_sync, self._mv_name, self._mv_uid)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        lock_acquired(self._mv_sync, self._mv_name, self._mv_uid)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+_ORIG: Dict[str, Any] = {}
+
+
+def _patch_threading() -> None:
+    _ORIG["lock"] = threading.Lock
+    _ORIG["rlock"] = threading.RLock
+    orig_event = threading.Event
+    orig_start = threading.Thread.start
+    orig_join = threading.Thread.join
+
+    class _TrackedEvent(orig_event):
+        """set()→wait() publication edge (merge: multiple setters)."""
+
+        def __init__(self):
+            super().__init__()
+            self._mv_sync = SyncClock()
+
+        def set(self):
+            sync_release(self._mv_sync, merge=True)
+            super().set()
+
+        def wait(self, timeout: Optional[float] = None):
+            got = super().wait(timeout)
+            if got:
+                sync_acquire(self._mv_sync)
+            return got
+
+    def _tracked_start(self):
+        if _ACTIVE:
+            # spawner → child: the child's first detector touch joins
+            # this snapshot (_state); wrap run() so joiners can join
+            # the child's FINAL clock
+            self._mv_hb_parent = publish()
+            orig_run = self.run
+
+            def _mv_run():
+                # the child's state may have been born mid-bootstrap
+                # (before _active registration) with a placeholder
+                # name and no parent edge — fix both here
+                st = _state()
+                st.name = self.name
+                join(self._mv_hb_parent)
+                try:
+                    orig_run()
+                finally:
+                    self._mv_hb_final = publish()
+
+            self.run = _mv_run
+        return orig_start(self)
+
+    def _tracked_join(self, timeout: Optional[float] = None):
+        orig_join(self, timeout)
+        if _ACTIVE and not self.is_alive():
+            join(getattr(self, "_mv_hb_final", None))
+
+    _patch(threading, "Lock", lambda: _TrackedLock())
+    _patch(threading, "RLock", lambda: _TrackedRLock())
+    _patch(threading, "Event", _TrackedEvent)
+    _patch(threading.Thread, "start", _tracked_start)
+    _patch(threading.Thread, "join", _tracked_join)
+
+
+# ---------------------------------------------------------- arm / dump
+
+_atexit_registered = False
+
+
+def arm(plan: Any = "auto",
+        paths: Optional[List[str]] = None) -> int:
+    """Arm the detector: build/load the instrumentation plan, install
+    the attribute descriptors, patch the threading factories, start
+    the fuzz hook if requested. Idempotent. ``plan=None`` arms the
+    engine without static instrumentation (fixture tests instrument
+    their own classes via ``instrument.instrument_class``). Returns
+    the number of instrumented attributes."""
+    global _ACTIVE, _repo_root, _atexit_registered
+    from multiverso_tpu.analysis import instrument
+
+    if _ACTIVE:
+        return instrument.instrumented_count()
+    plan_obj = None
+    if plan == "auto":
+        plan_path = os.environ.get("MV_RACE_PLAN", "")
+        if plan_path and os.path.exists(plan_path):
+            plan_obj = instrument.load_plan(plan_path)
+        else:
+            plan_obj = instrument.build_plan(paths)
+    elif plan is not None:
+        plan_obj = plan
+    installed = 0
+    if plan_obj is not None:
+        _repo_root = plan_obj.root or _repo_root
+        installed, _skipped = instrument.apply_plan(plan_obj)
+    if not _repo_root:
+        _repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+    _patch_threading()
+    _install_fuzz()
+    try:
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.add_section(
+            "race_detector",
+            lambda: [f"{k}={v}" for k, v in sorted(stats().items())],
+            snapshot=stats,
+        )
+    except Exception:  # noqa: BLE001 — obs is optional at arm time
+        pass
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(_atexit_dump)
+    _ACTIVE = True
+    return installed
+
+
+def disarm() -> None:
+    """Tear everything down (test isolation): descriptors out,
+    threading factories restored, fuzz interval restored. Reports are
+    kept until ``reset()``."""
+    global _ACTIVE
+    from multiverso_tpu.analysis import instrument
+
+    _ACTIVE = False
+    instrument.remove_all()
+    _unpatch_all()
+    _uninstall_fuzz()
+    try:
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        Dashboard.remove_section("race_detector")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def maybe_arm_from_flags() -> bool:
+    """Runtime.start / conftest hook: arm iff the flag (or its env
+    default) says so. One cached-bool check when off."""
+    if race_detector_enabled() and not _ACTIVE:
+        arm()
+        return True
+    return False
+
+
+def stats() -> Dict[str, Any]:
+    from multiverso_tpu.analysis import instrument
+
+    out: Dict[str, Any] = dict(_counters)
+    out["armed"] = _ACTIVE
+    out["instrumented_attrs"] = instrument.instrumented_count()
+    out["reports"] = len(_reports)
+    if _fuzz_seed is not None:
+        out["fuzz_seed"] = _fuzz_seed
+    return out
+
+
+def dump_reports(directory: str, rank: int = 0) -> str:
+    """Write ``race-report-rank<p>.json`` — the artifact the ci race
+    stage gates on and ``--race-report`` re-reads through the
+    baseline/pragma machinery."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"race-report-rank{rank}.json")
+    payload = {
+        "schema": 1,
+        "stats": stats(),
+        "reports": [r.to_dict() for r in _reports],
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    print(
+        f"mvtsan: race report ({len(_reports)} finding(s)) -> {path}",
+        file=sys.stderr,
+    )
+    return path
+
+
+def _guess_rank() -> int:
+    # sys.modules, not an import: the package __init__ re-exports the
+    # runtime() FUNCTION under the submodule's name (so `from
+    # multiverso_tpu import runtime` yields the function), and a dump
+    # from a process that never started the runtime must not drag the
+    # whole jax stack in just to learn it has no rank
+    try:
+        rt_mod = sys.modules.get("multiverso_tpu.runtime")
+        if rt_mod is not None:
+            rt = rt_mod.runtime()
+            if rt.started:
+                return rt.rank
+    except Exception:  # noqa: BLE001
+        pass
+    for var in ("MV_RANK", "RANK"):
+        v = os.environ.get(var, "")
+        if v.isdigit():
+            return int(v)
+    return 0
+
+
+def maybe_dump_from_flags(directory: Optional[str] = None,
+                          rank: Optional[int] = None) -> Optional[str]:
+    """End-of-train / containment hook (the ``tracer`` dump pattern):
+    when armed and ``MV_RACE_DIR`` (or ``directory``) names a target,
+    write the rank's report file — empty reports included, so the ci
+    gate can distinguish "clean run" from "never armed"."""
+    if not _ACTIVE:
+        return None
+    directory = directory or os.environ.get("MV_RACE_DIR", "")
+    if not directory:
+        return None
+    return dump_reports(directory, _guess_rank() if rank is None
+                        else rank)
+
+
+def _atexit_dump() -> None:
+    if not _ACTIVE:
+        return
+    try:
+        maybe_dump_from_flags()
+    except Exception:  # noqa: BLE001
+        pass
+    if _reports:
+        print(
+            f"mvtsan: {len(_reports)} race report(s) at exit — "
+            "see race-report-rank*.json (MV_RACE_DIR) or the flight "
+            "recorder; triage: DEPLOY.md 'Race detector'",
+            file=sys.stderr,
+        )
+
+
+# -------------------------------------------------- Finding conversion
+
+def findings_from_reports(report_dicts: List[Dict[str, Any]]) -> List:
+    """RaceReports → mvlint Findings under rule id **D1**, so the
+    baseline/pragma/SARIF machinery (and the empty-baseline contract)
+    applies to dynamic findings exactly as to static ones."""
+    from multiverso_tpu.analysis.mvlint import Finding
+
+    out = []
+    for d in report_dicts:
+        r = RaceReport.from_dict(d)
+        out.append(Finding(
+            "D1", r.path or "<unknown>", int(r.line or 0),
+            r.message(),
+            "order the accesses through an owned sync primitive "
+            "(OrderedLock / TaskPipe / ASyncBuffer / Waiter) or prove "
+            "publication; fix the code, do not suppress "
+            "(analysis/RULES.md: Dynamic analysis)",
+        ))
+    return out

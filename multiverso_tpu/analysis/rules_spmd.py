@@ -911,21 +911,29 @@ def _fn_accesses(graph: ProjectGraph, fn: FuncInfo,
     return accesses, callsites
 
 
-def rule_r9_cross_thread_state(
-    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
-) -> List[Finding]:
-    facts = spmd_facts(graph)
-    tuids = facts.thread_uids()
-    muids = facts.main_uids()
+# @collective_dispatch is a *virtual lock*: the runtime guard pins
+# every decorated entry point to one thread (GuardViolation on any
+# other), so table state touched under it is serialized by
+# construction — the decorator, not a Lock, is the synchronization.
+# mvtsan mirrors it at runtime (analysis/mvtsan.py pushes the same
+# name into the dynamic lockset inside the decorator), so static and
+# dynamic verdicts agree on dispatch-serialized state.
+DISPATCH_LOCK = "<collective_dispatch>"
+
+
+def class_access_buckets(
+    modules: Sequence[Module], graph: ProjectGraph
+) -> Dict[Tuple[str, str], Dict[str, List[_Access]]]:
+    """Per-class, per-attribute ``self.X`` access lists with the lock
+    set held at each access — the shared substrate of the static R9
+    verdict AND the mvtsan instrumentation plan
+    (:mod:`multiverso_tpu.analysis.instrument`). ``__init__`` accesses
+    and lock-typed attributes are excluded; entry-held locks from the
+    caller-holds-the-lock fixpoint are folded into each access."""
     fns = [
         fn for fn in _iter_funcs(graph, modules)
         if fn.cls and fn.name != "__del__"  # finalizers cannot race
     ]
-    # @collective_dispatch is a *virtual lock*: the runtime guard pins
-    # every decorated entry point to one thread (GuardViolation on any
-    # other), so table state touched under it is serialized by
-    # construction — the decorator, not a Lock, is the synchronization
-    _DISPATCH_LOCK = "<collective_dispatch>"
     # "caller holds the lock" propagation: a helper ALWAYS called with
     # some lock held inherits it at entry. Must-analysis iterated to a
     # fixpoint — entry_held[f] = ∩ over call sites of (locks lexically
@@ -938,7 +946,7 @@ def rule_r9_cross_thread_state(
     per_fn: Dict[int, Tuple[List[_Access], List[Tuple[int, FrozenSet[str]]]]] = {}
     sites: Dict[int, List[Tuple[int, FrozenSet[str]]]] = {}
     for fn in fns:
-        base = frozenset({_DISPATCH_LOCK}) if \
+        base = frozenset({DISPATCH_LOCK}) if \
             _has_dispatch_decorator(fn.node) else frozenset()
         per_fn[fn.uid] = _fn_accesses(graph, fn, base)
         if fn.name == "__init__":
@@ -984,90 +992,151 @@ def rule_r9_cross_thread_state(
             if a.fn.name == "__init__" or _is_lock_attr(ci, a.attr):
                 continue
             bucket.setdefault(a.attr, []).append(a)
+    return by_class
 
+
+class AttrVerdict:
+    """The static R9 verdict on one (class, attr) bucket — also the
+    instrumentation plan's classification record."""
+
+    __slots__ = ("classification", "locks", "rmw", "cross_thread",
+                 "anchor", "others", "why")
+
+    def __init__(self, classification: str, locks: FrozenSet[str],
+                 rmw: bool, cross_thread: bool,
+                 anchor: Optional[_Access] = None,
+                 others: Optional[List[_Access]] = None, why: str = ""):
+        self.classification = classification
+        self.locks = locks
+        self.rmw = rmw
+        self.cross_thread = cross_thread
+        self.anchor = anchor
+        self.others = others or []
+        self.why = why
+
+
+def classify_attr(accs: List[_Access], tuids: Set[int],
+                  muids: Set[int]) -> AttrVerdict:
+    """One attribute's cross-thread verdict. Classifications:
+    ``reads-only`` (no writes outside ``__init__``),
+    ``writer-serialized`` (every write and every check-then-act read
+    holds one common lock — lock-free pure reads are GIL-atomic loads
+    of a published value), ``one-side`` (never touched from both
+    sides), ``publication`` (cross-thread but only plain stores race
+    plain loads — single-assignment publication), ``lock-guarded``
+    (the conflicting accesses share a lock), ``race`` (the R9
+    finding). mvtsan's dynamic exemption set mirrors exactly these —
+    static and dynamic verdicts must agree on the same field."""
+    # a read AT OR BEFORE a write in the same function is a
+    # read-modify-write even without an AugAssign
+    # (``if self._n > k: self._n = 0``). Write-then-read-later
+    # is NOT (publication + use, e.g. setup building a cache
+    # it then consults).
+    rmw_fns: Set[int] = set()
+    first_read: Dict[int, int] = {}
+    for a in accs:
+        if a.kind == "aug":
+            rmw_fns.add(a.fn.uid)
+        elif a.kind == "read":
+            first_read[a.fn.uid] = min(
+                first_read.get(a.fn.uid, a.line), a.line
+            )
+    for a in accs:
+        if a.kind == "write" and \
+                first_read.get(a.fn.uid, a.line + 1) <= a.line:
+            rmw_fns.add(a.fn.uid)
+
+    def side(a: _Access) -> Tuple[bool, bool]:
+        return a.fn.uid in tuids, a.fn.uid in muids
+
+    t_acc = [a for a in accs if side(a)[0]]
+    m_acc = [a for a in accs if side(a)[1]]
+    cross = bool(t_acc) and bool(m_acc)
+    writes = [
+        a for a in accs
+        if a.kind in ("write", "aug") and a.fn.name != "__init__"
+    ]
+    has_rmw = any(
+        a.kind == "aug" or a.fn.uid in rmw_fns for a in writes
+    )
+    if not writes:
+        return AttrVerdict("reads-only", frozenset(), False, cross)
+    # Writer-serialized publication: every write — and every
+    # read inside a fn that also writes the attr (the reads
+    # that make a check-then-act) — holds one common lock.
+    # Whatever accesses remain lock-free are pure reads in
+    # reader-only fns: single reference loads of a published
+    # value, atomic under the GIL (the TableServer._snapshot
+    # swap pattern). A broken double-checked lazy-init does
+    # NOT qualify — its lock-free check read lives in a
+    # writer fn and empties the intersection.
+    writer_uids = {a.fn.uid for a in writes}
+    guard_accs = writes + [
+        a for a in accs
+        if a.kind == "read" and a.fn.uid in writer_uids
+    ]
+    serial = frozenset.intersection(*(a.held for a in guard_accs))
+    if serial:
+        return AttrVerdict(
+            "writer-serialized", serial, has_rmw, cross
+        )
+    t_rmw = [
+        a for a in writes
+        if side(a)[0] and (a.kind == "aug" or a.fn.uid in rmw_fns)
+    ]
+    m_rmw = [
+        a for a in writes
+        if side(a)[1] and (a.kind == "aug" or a.fn.uid in rmw_fns)
+    ]
+    t_w = [a for a in writes if side(a)[0]]
+    m_w = [a for a in writes if side(a)[1]]
+
+    conflict: Optional[Tuple[_Access, List[_Access], str]] = None
+    if t_rmw and m_acc:
+        conflict = (t_rmw[0], m_acc,
+                    "read-modify-write on a thread path")
+    elif m_rmw and t_acc:
+        conflict = (m_rmw[0], t_acc,
+                    "read-modify-write racing a thread-path "
+                    "access")
+    elif any(
+        w1.line != w2.line for w1 in t_w for w2 in m_w
+    ):
+        conflict = (t_w[0], m_w,
+                    "written from both a thread path and "
+                    "training-thread code")
+    if conflict is None:
+        kind = "publication" if cross else "one-side"
+        return AttrVerdict(kind, frozenset(), has_rmw, cross)
+    anchor, others, why = conflict
+    involved = [anchor] + [a for a in others if a is not anchor]
+    common = frozenset.intersection(
+        *(a.held for a in involved)
+    ) if involved else frozenset()
+    if common:
+        # a shared lock guards every involved access
+        return AttrVerdict(
+            "lock-guarded", common, has_rmw, cross, anchor, others, why
+        )
+    return AttrVerdict(
+        "race", frozenset(), has_rmw, cross, anchor, others, why
+    )
+
+
+def rule_r9_cross_thread_state(
+    modules: Sequence[Module], cfg: LintConfig, graph: ProjectGraph
+) -> List[Finding]:
+    facts = spmd_facts(graph)
+    tuids = facts.thread_uids()
+    muids = facts.main_uids()
+    by_class = class_access_buckets(modules, graph)
     findings: List[Finding] = []
     for (relpath, clsname), attrs in sorted(by_class.items()):
         for attr, accs in sorted(attrs.items()):
-            # a read AT OR BEFORE a write in the same function is a
-            # read-modify-write even without an AugAssign
-            # (``if self._n > k: self._n = 0``). Write-then-read-later
-            # is NOT (publication + use, e.g. setup building a cache
-            # it then consults).
-            rmw_fns: Set[int] = set()
-            first_read: Dict[int, int] = {}
-            for a in accs:
-                if a.kind == "aug":
-                    rmw_fns.add(a.fn.uid)
-                elif a.kind == "read":
-                    first_read[a.fn.uid] = min(
-                        first_read.get(a.fn.uid, a.line), a.line
-                    )
-            for a in accs:
-                if a.kind == "write" and \
-                        first_read.get(a.fn.uid, a.line + 1) <= a.line:
-                    rmw_fns.add(a.fn.uid)
-
-            def side(a: _Access) -> Tuple[bool, bool]:
-                return a.fn.uid in tuids, a.fn.uid in muids
-
-            writes = [
-                a for a in accs
-                if a.kind in ("write", "aug") and a.fn.name != "__init__"
-            ]
-            if not writes:
+            v = classify_attr(accs, tuids, muids)
+            if v.classification != "race":
                 continue
-            # Writer-serialized publication: every write — and every
-            # read inside a fn that also writes the attr (the reads
-            # that make a check-then-act) — holds one common lock.
-            # Whatever accesses remain lock-free are pure reads in
-            # reader-only fns: single reference loads of a published
-            # value, atomic under the GIL (the TableServer._snapshot
-            # swap pattern). A broken double-checked lazy-init does
-            # NOT qualify — its lock-free check read lives in a
-            # writer fn and empties the intersection.
-            writer_uids = {a.fn.uid for a in writes}
-            guard_accs = writes + [
-                a for a in accs
-                if a.kind == "read" and a.fn.uid in writer_uids
-            ]
-            if frozenset.intersection(*(a.held for a in guard_accs)):
-                continue
-            t_rmw = [
-                a for a in writes
-                if side(a)[0] and (a.kind == "aug" or a.fn.uid in rmw_fns)
-            ]
-            m_rmw = [
-                a for a in writes
-                if side(a)[1] and (a.kind == "aug" or a.fn.uid in rmw_fns)
-            ]
-            t_acc = [a for a in accs if side(a)[0]]
-            m_acc = [a for a in accs if side(a)[1]]
-            t_w = [a for a in writes if side(a)[0]]
-            m_w = [a for a in writes if side(a)[1]]
-
-            conflict: Optional[Tuple[_Access, List[_Access], str]] = None
-            if t_rmw and m_acc:
-                conflict = (t_rmw[0], m_acc,
-                            "read-modify-write on a thread path")
-            elif m_rmw and t_acc:
-                conflict = (m_rmw[0], t_acc,
-                            "read-modify-write racing a thread-path "
-                            "access")
-            elif any(
-                w1.line != w2.line for w1 in t_w for w2 in m_w
-            ):
-                conflict = (t_w[0], m_w,
-                            "written from both a thread path and "
-                            "training-thread code")
-            if conflict is None:
-                continue
-            anchor, others, why = conflict
-            involved = [anchor] + [a for a in others if a is not anchor]
-            common = frozenset.intersection(
-                *(a.held for a in involved)
-            ) if involved else frozenset()
-            if common:
-                continue  # a shared lock guards every involved access
+            anchor, others, why = v.anchor, v.others, v.why
             other_fns = sorted({
                 a.fn.qualname for a in others if a.fn is not anchor.fn
             }) or [anchor.fn.qualname]

@@ -37,6 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import multiverso_tpu.analysis.mvtsan as _mvtsan
 from multiverso_tpu import obs
 from multiverso_tpu.config import constraints
 # module-level (not lazy): -health_port/-metrics_port must be REGISTERED
@@ -1576,6 +1577,9 @@ class WordEmbedding:
         # the span trace survives the failure too: dump what the rings
         # hold so the pod-wide merge shows where every thread was
         obs.tracer.maybe_dump_from_flags()
+        # armed race-detector runs dump next to it — a race report that
+        # coincides with a contained failure is usually the cause
+        _mvtsan.maybe_dump_from_flags()
 
     def _train_ps_pipelined(self, source, total_pairs_est: float,
                             start: float) -> float:
@@ -2498,6 +2502,7 @@ class WordEmbedding:
             # the span trace dumps whether training finished or raised —
             # crash traces are the ones worth reading
             obs.tracer.maybe_dump_from_flags()
+            _mvtsan.maybe_dump_from_flags()
             if health is not None:
                 health.stop()
 

@@ -20,6 +20,7 @@ from typing import Optional
 
 import numpy as np
 
+import multiverso_tpu.analysis.mvtsan as _mvtsan
 from multiverso_tpu.native import build_native_lib
 from multiverso_tpu.utils.log import CHECK
 
@@ -88,6 +89,11 @@ class MtQueue:
             self._alive = True
 
     def push(self, value: int) -> bool:
+        if _mvtsan._ACTIVE:
+            # push→pop edge: the popper sees everything the pusher did.
+            # The native queue has no tracked internals, so the edge is
+            # recorded on the Python wrapper for both backends.
+            _mvtsan.sync_release(_mvtsan.sync_of(self))
         if self._lib is not None:
             return bool(self._lib.mvq_push(self._q, value))
         if not self._alive:
@@ -100,6 +106,8 @@ class MtQueue:
         if self._lib is not None:
             out = ctypes.c_uint64()
             if self._lib.mvq_pop(self._q, ctypes.byref(out), timeout_ms):
+                if _mvtsan._ACTIVE:
+                    _mvtsan.sync_acquire(_mvtsan.sync_of(self))
                 return out.value
             return None
         timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
@@ -112,16 +120,22 @@ class MtQueue:
         waited = 0.0
         while True:
             try:
-                return self._q.get(timeout=deadline_step)
+                value = self._q.get(timeout=deadline_step)
+                if _mvtsan._ACTIVE:
+                    _mvtsan.sync_acquire(_mvtsan.sync_of(self))
+                return value
             except _pyqueue.Empty:
                 if not self._alive:
                     # exit-and-drained contract (native MtQueue::Pop drains
                     # remaining items after Exit): one final non-blocking
                     # check closes the put-then-exit race
                     try:
-                        return self._q.get_nowait()
+                        value = self._q.get_nowait()
                     except _pyqueue.Empty:
                         return None
+                    if _mvtsan._ACTIVE:
+                        _mvtsan.sync_acquire(_mvtsan.sync_of(self))
+                    return value
                 waited += deadline_step
                 if timeout is not None and waited >= timeout:
                     return None
@@ -130,12 +144,17 @@ class MtQueue:
         if self._lib is not None:
             out = ctypes.c_uint64()
             if self._lib.mvq_try_pop(self._q, ctypes.byref(out)):
+                if _mvtsan._ACTIVE:
+                    _mvtsan.sync_acquire(_mvtsan.sync_of(self))
                 return out.value
             return None
         try:
-            return self._q.get_nowait()
+            value = self._q.get_nowait()
         except _pyqueue.Empty:
             return None
+        if _mvtsan._ACTIVE:
+            _mvtsan.sync_acquire(_mvtsan.sync_of(self))
+        return value
 
     def exit(self) -> None:
         if self._lib is not None:
@@ -172,12 +191,21 @@ class Waiter:
 
     def wait(self, timeout_ms: int = -1) -> bool:
         if self._lib is not None:
-            return bool(self._lib.mvw_wait(self._w, timeout_ms))
-        with self._cv:
-            timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
-            return self._cv.wait_for(lambda: self._count <= 0, timeout)
+            ok = bool(self._lib.mvw_wait(self._w, timeout_ms))
+        else:
+            with self._cv:
+                timeout = None if timeout_ms < 0 else timeout_ms / 1000.0
+                ok = self._cv.wait_for(
+                    lambda: self._count <= 0, timeout
+                )
+        if ok and _mvtsan._ACTIVE:
+            # latch edge: the waiter sees everything every notifier did
+            _mvtsan.sync_acquire(_mvtsan.sync_of(self))
+        return ok
 
     def notify(self) -> None:
+        if _mvtsan._ACTIVE:
+            _mvtsan.sync_release(_mvtsan.sync_of(self))
         if self._lib is not None:
             self._lib.mvw_notify(self._w)
         else:

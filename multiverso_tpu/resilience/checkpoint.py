@@ -514,7 +514,12 @@ class _ResilienceStats:
     the number an operator actually pages on."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # OrderedLock, not threading.Lock: this is an import-time
+        # singleton, and a stdlib lock born before mvtsan arms is
+        # invisible to the race detector — the counter updates would
+        # report as unordered (see DEPLOY.md "Race detector"). The
+        # owned primitive is tracked for its whole lifetime.
+        self._lock = OrderedLock("checkpoint.resilience_stats")
         self.restarts = 0
         self.saves = 0
         self.save_failures = 0

@@ -406,7 +406,13 @@ class _FailureDomainStats:
     the same record."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # OrderedLock, not threading.Lock: fd_stats is an import-time
+        # singleton, and a stdlib lock born before mvtsan arms is
+        # invisible to the race detector (the lock-factory patch only
+        # covers locks created after arming) — the readiness writes
+        # then report as unordered. The owned primitive is tracked for
+        # its whole lifetime and adds R2 order coverage for free.
+        self._lock = OrderedLock("watchdog.fd_stats")
         self.tickets = 0
         self._waits_ms: deque = deque(maxlen=4096)
         # running p99 refreshed every 128 tickets: the flight recorder's

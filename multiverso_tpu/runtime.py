@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import multiverso_tpu.analysis.mvtsan as _mvtsan
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.parallel import multihost  # registers -machine_file/-coordinator flags
 from multiverso_tpu.resilience import chaos as _chaos  # noqa: F401 — registers -chaos_* fault flags
@@ -172,6 +173,11 @@ class Runtime:
         Returns the compacted argv (flags consumed), like ``ParseCMDFlags``.
         """
         remaining = ParseCMDFlags(argv)
+        # Arm the dynamic race detector BEFORE tables/servers/pipes spin
+        # up their threads, so no cross-thread access predates the
+        # instrumentation (-debug_race_detector or MV_RACE_DETECTOR=1;
+        # no-op — not even a plan build — otherwise).
+        _mvtsan.maybe_arm_from_flags()
         # reference-parity knobs that have no TPU mapping are VALIDATED
         # and acknowledged, not silently dropped (mvlint R3: a defined
         # flag must be read — dead flag surface misleads operators)

@@ -130,6 +130,10 @@ class ServingFleet:
         env = dict(self._env)
         env["MV_ENDPOINT_FILE"] = ep
         env.pop("MV_READY_FILE", None)  # readiness is probed over HTTP
+        # replicas have no runtime rank; the slot index keys their
+        # race-report-rank<i>.json so co-hosted dumps never collide
+        # (overrides any inherited MV_RANK — that one names the parent)
+        env["MV_RANK"] = str(index)
         log_path = os.path.join(self.log_dir, f"replica-{index}.log")
         logf = open(log_path, "a")
         # own session: SIGTERM/SIGKILL reach the whole replica group

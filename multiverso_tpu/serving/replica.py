@@ -192,6 +192,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if len(leftover) > 1:
         Log.Error("replica: unrecognised argv %s", leftover[1:])
         return 2
+    # replicas have no training Runtime.start, so the race-detector arm
+    # hook lives here: before any serving thread spins up, and its
+    # atexit dump fires after drain() has joined them all
+    import multiverso_tpu.analysis.mvtsan as _mvtsan
+
+    _mvtsan.maybe_arm_from_flags()
     # deterministic hostname-free default: replicas serve loopback unless
     # fronted by a real ingress (the fleet launcher is host-local)
     socket.setdefaulttimeout(None)

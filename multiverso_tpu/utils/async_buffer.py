@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Callable, Generic, Optional, TypeVar
 
+import multiverso_tpu.analysis.mvtsan as _mvtsan
 from multiverso_tpu.obs import tracer as _tracer
 
 T = TypeVar("T")
@@ -60,8 +61,12 @@ class ASyncBuffer(Generic[T]):
 
     def _start_fill(self) -> None:
         self._ready.clear()
+        # mvtsan consumer→fill edge (armed runs): the fill closure
+        # inherits everything the consumer did before kicking it off
+        hb_to_fill = _mvtsan.publish() if _mvtsan._ACTIVE else None
 
         def run():
+            _mvtsan.join(hb_to_fill)
             try:
                 # obs: the fill thread's block-prep/prefetch work lands
                 # on its own track in the span trace
@@ -73,6 +78,10 @@ class ASyncBuffer(Generic[T]):
                 with self._lock:
                     self._error = e
             finally:
+                if _mvtsan._ACTIVE:
+                    # fill→Get edge: publish BEFORE releasing the
+                    # consumer through _ready
+                    self._mv_hb_from_fill = _mvtsan.publish()
                 self._ready.set()
 
         self._thread = threading.Thread(target=run, daemon=True)
@@ -86,6 +95,8 @@ class ASyncBuffer(Generic[T]):
         if self._stopped:
             raise RuntimeError("ASyncBuffer already stopped")
         self._ready.wait()
+        if _mvtsan._ACTIVE:
+            _mvtsan.join(getattr(self, "_mv_hb_from_fill", None))
         with self._lock:
             if self._error is not None:
                 raise self._error
@@ -104,7 +115,8 @@ class ASyncBuffer(Generic[T]):
 class _Ticket:
     """Result handle for one ``TaskPipe`` submission."""
 
-    __slots__ = ("_done", "_value", "_error", "_pipe", "tag")
+    __slots__ = ("_done", "_value", "_error", "_pipe", "tag",
+                 "_mv_hb_submit", "_mv_hb_done")
 
     def __init__(self, pipe: Optional["TaskPipe"] = None, tag: str = ""):
         self._done = threading.Event()
@@ -112,6 +124,10 @@ class _Ticket:
         self._error: Optional[BaseException] = None
         self._pipe = pipe
         self.tag = tag
+        # mvtsan submit→run and run→wait_result edge payloads (clock
+        # snapshots; None disarmed)
+        self._mv_hb_submit = None
+        self._mv_hb_done = None
 
     def result(self, timeout: Optional[float] = None) -> Any:
         """Block until the task ran on the pipe thread; re-raise its
@@ -119,6 +135,8 @@ class _Ticket:
         be read any number of times."""
         if not self._done.wait(timeout):
             raise TimeoutError("TaskPipe task did not complete in time")
+        if _mvtsan._ACTIVE:
+            _mvtsan.join(self._mv_hb_done)
         if self._error is not None:
             raise self._error
         return self._value
@@ -173,6 +191,8 @@ class _Ticket:
                     pipe.break_pipe(rf)
                 raise rf
         fd_stats.note_ticket_wait(clock() - start)
+        if _mvtsan._ACTIVE:
+            _mvtsan.join(self._mv_hb_done)
         if self._error is not None:
             raise self._error
         return self._value
@@ -251,6 +271,9 @@ class TaskPipe:
             fn, ticket = self._slots[slot]
             self._slots[slot] = None
             self._free.push(slot)
+            if _mvtsan._ACTIVE:
+                # submit→run: the task sees everything its submitter did
+                _mvtsan.join(ticket._mv_hb_submit)
             try:
                 if _tracer.tracing_enabled():
                     # ticket execution on the comms worker: the span name
@@ -267,6 +290,9 @@ class TaskPipe:
             except BaseException as e:  # surfaced at ticket.result()
                 ticket._error = e
             finally:
+                if _mvtsan._ACTIVE:
+                    # run→wait_result: publish BEFORE releasing waiters
+                    ticket._mv_hb_done = _mvtsan.publish()
                 ticket._done.set()
                 with self._idle:
                     self._inflight -= 1
@@ -306,6 +332,8 @@ class TaskPipe:
 
     def _enqueue(self, slot: int, fn: Callable[[], Any], tag: str) -> _Ticket:
         ticket = _Ticket(self, tag)
+        if _mvtsan._ACTIVE:
+            ticket._mv_hb_submit = _mvtsan.publish()
         self._slots[slot] = (fn, ticket)
         with self._idle:
             self._inflight += 1

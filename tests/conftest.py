@@ -54,6 +54,15 @@ def pytest_configure(config):
         "slow: heavy multi-process cluster drills — excluded from the "
         "tier-1 run (-m 'not slow'), exercised by ci.sh's full pytest",
     )
+    # MV_RACE_DETECTOR=1 runs the whole suite under the mvtsan dynamic
+    # race detector (analysis/RULES.md: Dynamic analysis). Armed here —
+    # before any test spawns a thread — rather than per-test, so the
+    # thread patches and instrumentation descriptors cover every test;
+    # the env-derived flag default survives ResetFlagsToDefault().
+    if os.environ.get("MV_RACE_DETECTOR") == "1":
+        from multiverso_tpu.analysis import mvtsan
+
+        mvtsan.arm()
 
 
 def pytest_collection_modifyitems(config, items):

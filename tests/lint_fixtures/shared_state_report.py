@@ -1,0 +1,65 @@
+"""mvtsan instrumentation-plan fixture: one class per static verdict
+the ``--shared-state-report`` table must show. ``RacyCounter.counter``
+is the R9 lost-update shape (verdict ``race``),
+``GuardedCounter.count`` holds one OrderedLock on both sides
+(``writer-serialized`` — every write and RMW-read is under the lock),
+and ``Publisher.value`` is single-assignment publication
+(``publication``). Threads are daemonized and joined so R4
+stays quiet."""
+
+import threading
+
+from multiverso_tpu.analysis.guards import OrderedLock
+
+
+class RacyCounter:
+    def __init__(self):
+        self.counter = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.counter += 1  # RMW on the thread path, no lock
+
+    def start(self):
+        self._t.start()
+
+    def progress(self):
+        return self.counter  # main-side read, no lock
+
+    def stop(self):
+        self._t.join()
+
+
+class GuardedCounter:
+    def __init__(self):
+        self.count = 0
+        self._lock = OrderedLock("fixture.shared_state_report")
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self.count += 1  # locked on the thread path...
+
+    def progress(self):
+        with self._lock:
+            return self.count  # ...and on the main path
+
+    def run(self):
+        self._t.start()
+        self._t.join()
+
+
+class Publisher:
+    def __init__(self):
+        self.value = None
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.value = 42  # plain store (GIL-atomic publication)
+
+    def latest(self):
+        return self.value  # plain load, main side
+
+    def run(self):
+        self._t.start()
+        self._t.join()
