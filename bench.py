@@ -1357,6 +1357,111 @@ def _bench_obs(V=20000, dim=64, toks=200_000):
     }
 
 
+def _bench_ps_depth_auto(V=20000, dim=64, toks=300_000):
+    """Adaptive-depth leg (ISSUE 15): the ps_comms zipf workload with
+    ``-ps_pipeline_depth=auto`` — same corpus/batch geometry as the
+    fixed pipelined leg so pairs/sec and overlap%% are directly
+    comparable, plus where the controller landed (final depth,
+    decision/widen counts). The leg is informative, not gated: on a
+    shared CPU the controller may legitimately hold at 1 when comms
+    are already hidden. MV_BENCH_PS_DEPTH_AUTO=0 skips."""
+    import os as _os
+
+    if _os.environ.get("MV_BENCH_PS_DEPTH_AUTO", "1") == "0":
+        return {}
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+
+    ids, d = _zipf_app_corpus(V, toks, seed=7)
+    opt = WEOptions(
+        size=dim, negative=5, window=5, batch_size=4096,
+        steps_per_call=8, epoch=1, sample=0, min_count=0,
+        output_file="", use_ps=True, is_pipeline=False, train_file="x",
+        ps_pipeline_depth=1, ps_depth_auto=True,
+        ps_pipeline_depth_max=4, ps_depth_decide_rounds=2,
+    )
+    we = WordEmbedding(opt, dictionary=d)
+    t0 = time.perf_counter()
+    loss = we.train(ids=ids.copy())
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), loss
+    stats = we._ps_stats.to_dict()
+    decs = we._ps_depth_decisions
+    return {
+        "ps_depth_auto_pairs_per_sec": round(
+            we.words_trained / max(dt, 1e-9), 1
+        ),
+        "ps_depth_auto_overlap_pct": stats["overlap_pct"],
+        "ps_depth_auto_final_depth": int(we._ps_depth_final),
+        "ps_depth_auto_decisions": len(decs),
+        "ps_depth_auto_widens": sum(
+            1 for x in decs if x.get("action") == "widen"
+        ),
+    }
+
+
+def _bench_slo(V=20000, dim=64, toks=200_000):
+    """SLO engine overhead leg (ISSUE 15): the SAME pipelined PS run
+    unarmed vs armed — a PeriodicEvaluator ticking the stock rule set
+    (scrape + multi-window burn verdicts) every 0.1 s, 50x faster than
+    the -slo_eval_interval_s deployments would use. Gate: armed costs
+    <= 1%% of pairs/sec, recorded as ``slo_eval_overhead_ok`` (logged
+    loudly on a miss; the driver's trajectory judges it).
+    MV_BENCH_SLO=0 skips."""
+    import os as _os
+    import sys as _sys
+
+    if _os.environ.get("MV_BENCH_SLO", "1") == "0":
+        return {}
+    from multiverso_tpu.models.wordembedding.app import WEOptions, WordEmbedding
+    from multiverso_tpu.obs import slo as _slo
+
+    ids, d = _zipf_app_corpus(V, toks, seed=9)
+
+    def one(armed):
+        ev = None
+        if armed:
+            # a private engine: the bench must not leave rules armed on
+            # the process-wide singleton for later legs
+            eng = _slo.SLOEngine(rules=_slo.default_rules())
+            ev = _slo.PeriodicEvaluator(eng, interval_s=0.1).start()
+        try:
+            opt = WEOptions(
+                size=dim, negative=5, window=5, batch_size=4096,
+                steps_per_call=8, epoch=1, sample=0, min_count=0,
+                output_file="", use_ps=True, is_pipeline=False,
+                train_file="x", ps_pipeline_depth=1,
+            )
+            we = WordEmbedding(opt, dictionary=d)
+            t0 = time.perf_counter()
+            loss = we.train(ids=ids.copy())
+            dt = time.perf_counter() - t0
+            assert np.isfinite(loss), (armed, loss)
+            return we.words_trained / max(dt, 1e-9)
+        finally:
+            if ev is not None:
+                ev.stop()
+
+    one(False)  # warmup: first run pays jit compiles for this shape set
+    # best-of-2 per mode (same rationale as the obs leg: single-run CPU
+    # scheduler noise swamps a <1% effect)
+    off = max(one(False), one(False))
+    armed = max(one(True), one(True))
+    pct = 100.0 * (off - armed) / max(off, 1e-9)
+    ok = pct <= 1.0
+    if not ok:
+        print(
+            f"# slo GATE MISS: armed SLO evaluation overhead {pct:.2f}% "
+            "> 1% of pairs/sec", file=_sys.stderr, flush=True,
+        )
+    return {
+        "slo_off_pairs_per_sec": round(off, 1),
+        "slo_armed_pairs_per_sec": round(armed, 1),
+        "slo_eval_overhead_pct": round(pct, 2),
+        "slo_eval_overhead_ok": ok,
+        "slo_eval_rules": len(_slo.default_rules()),
+    }
+
+
 def _bench_race(V=20000, dim=64, toks=200_000):
     """mvtsan overhead leg (ISSUE 14): the SAME pipelined PS training
     run two ways — race detector disarmed (the production default:
@@ -2130,6 +2235,17 @@ def main():
         print(f"# leg obs FAILED: {e}", file=_sys.stderr, flush=True)
         obs_leg = {"obs_error": str(e)[:200]}
     try:
+        depth_auto_leg = leg("ps_depth_auto", _bench_ps_depth_auto)
+    except Exception as e:
+        print(f"# leg ps_depth_auto FAILED: {e}", file=_sys.stderr,
+              flush=True)
+        depth_auto_leg = {"ps_depth_auto_error": str(e)[:200]}
+    try:
+        slo_leg = leg("slo", _bench_slo)
+    except Exception as e:
+        print(f"# leg slo FAILED: {e}", file=_sys.stderr, flush=True)
+        slo_leg = {"slo_error": str(e)[:200]}
+    try:
         race_leg = leg("race", _bench_race)
     except Exception as e:
         print(f"# leg race FAILED: {e}", file=_sys.stderr, flush=True)
@@ -2191,6 +2307,8 @@ def main():
     out.update(fusedp)
     out.update(ps_comms)
     out.update(obs_leg)
+    out.update(depth_auto_leg)
+    out.update(slo_leg)
     out.update(race_leg)
     out.update(multidev)
     out.update(sharded)

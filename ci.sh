@@ -73,7 +73,11 @@ echo "== serving fleet drill (2 replicas, kill one mid-load + rollout) =="
 # replica is chaos-killed (SIGKILL). Gates: ZERO unrecovered client
 # errors across the kill + rollout, the noisy tenant's 429s carry a
 # Retry-After header, and the relaunched replica reaches /readyz 200
-# serving the NEWEST version.
+# serving the NEWEST version. Request tracing rides the same drill: the
+# driver's client rings and both replicas' -trace_dir dumps merge into
+# one fleet trace, and `obs summary --list-requests` must show >=1
+# request whose span tree crosses the client AND a replica process;
+# `obs scrape --watch` tails the live fleet into fleet-metrics.jsonl.
 FLROOT=$(mktemp -d)
 JAX_PLATFORMS=cpu python - "$FLROOT" <<'EOF'
 import json, os, signal, sys, threading, time, urllib.error, urllib.request
@@ -101,10 +105,17 @@ def commit(step, value):
 
 
 commit(1, 1.0)
+# -trace_dir arms the replicas' span rings (each dumps
+# trace-rank<1+index>.json on drain); the driver's client spans record
+# ring-only (tracer.enable) and dump as rank 0 after the fleet stops
+trace_dir = os.path.join(root, "trace")
+from multiverso_tpu.obs import tracer
+tracer.enable()
 fleet = ServingFleet(
     2, root, log_dir=os.path.join(root, "fleet"),
     extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25",
-                "-admission_tenant_qps=500"],
+                "-admission_tenant_qps=500",
+                f"-trace_dir={trace_dir}"],
     backoff_base_s=0.1, backoff_max_s=0.5,
 ).start()
 assert fleet.wait_ready(timeout_s=120), "replicas never became ready"
@@ -221,6 +232,24 @@ assert scrape.returncode == 0, scrape.stderr[-500:]
 assert 'replica="0"' in scrape.stdout and 'replica="1"' in scrape.stdout, \
     scrape.stdout[:300]
 
+# scrape --watch: the same join as a daemon, one JSONL line per tick
+# into fleet-metrics.jsonl — both (healed) replicas must appear on
+# every tick while the load is still running
+watch = subprocess.run(
+    [sys.executable, "-m", "multiverso_tpu.obs", "scrape",
+     os.path.join(root, "fleet"), "--watch", "--interval", "0.2",
+     "--count", "2", "--expect", "2"],
+    capture_output=True, text=True)
+assert watch.returncode == 0, watch.stderr[-500:]
+metrics_path = os.path.join(root, "fleet", "fleet-metrics.jsonl")
+with open(metrics_path) as f:
+    ticks = [json.loads(ln) for ln in f if ln.strip()]
+assert len(ticks) >= 2, ticks
+for tick in ticks:
+    assert len(tick["replicas"]) == 2, tick
+    for samples in tick["replicas"].values():
+        assert any(k.startswith("mv_") for k in samples), list(samples)[:5]
+
 time.sleep(1.0)  # keep load running a beat past the full recovery
 stop.set()
 for th in threads:
@@ -231,11 +260,45 @@ failovers = sum(c.stats()["failovers"] for c in clients)
 assert not errors, errors[:3]
 assert unrecovered == 0, unrecovered
 assert requests > 50, requests
-fleet.stop()
+fleet.stop()  # replicas drain and dump trace-rank1/2.json
 assert fleet.alive() == 0
+
+# cross-process request tracing: merge the driver's client rings (rank
+# 0) with both replicas' dumps, then require >=1 request whose linked
+# span tree covers the client AND a replica process. The SIGKILLed
+# gen-0 replica never dumps, so its in-flight requests may surface as
+# client-only trees — the surviving/healed replicas carry the rest.
+tracer.dump(os.path.join(trace_dir, "trace-rank0.json"), rank=0)
+merged = os.path.join(root, "fleet-trace.json")
+mg = subprocess.run(
+    [sys.executable, "-m", "multiverso_tpu.obs", "merge", trace_dir,
+     "-o", merged, "--expect-ranks", "3"],
+    capture_output=True, text=True)
+assert mg.returncode == 0, (mg.stdout[-300:], mg.stderr[-500:])
+lr = subprocess.run(
+    [sys.executable, "-m", "multiverso_tpu.obs", "summary", merged,
+     "--list-requests"],
+    capture_output=True, text=True)
+assert lr.returncode == 0, lr.stderr[-500:]
+import re
+cross = [ln for ln in lr.stdout.splitlines()
+         if ln.startswith("trace=") and re.search(r"pids=0,[12]", ln)]
+assert cross, f"no request spans both processes:\n{lr.stdout[:1500]}"
+# and the per-request tree renders the full client->replica chain
+tid = cross[0].split()[0].split("=", 1)[1]
+tree = subprocess.run(
+    [sys.executable, "-m", "multiverso_tpu.obs", "summary", merged,
+     "--request", tid],
+    capture_output=True, text=True)
+assert tree.returncode == 0, tree.stderr[-500:]
+for name in ("client.request", "client.attempt", "serving.request"):
+    assert name in tree.stdout, (name, tree.stdout[:1500])
+
 print(f"fleet drill OK: {requests} requests, 0 unrecovered "
       f"({failovers} failovers), kill+heal with rollout to ckpt-2, "
-      f"429 Retry-After={retry_after}s, 2-replica /metrics scrape")
+      f"429 Retry-After={retry_after}s, 2-replica /metrics scrape, "
+      f"{len(ticks)} watch ticks, {len(cross)} cross-process request "
+      f"trace(s)")
 EOF
 rm -rf "$FLROOT"
 
@@ -311,6 +374,53 @@ assert np.isfinite(e[0]).all() and np.abs(e[0]).max() > 1e-3
 print("pipelined PS smoke OK: rounds", rounds[0])
 EOF
 rm -rf "$PSROOT"
+
+echo "== adaptive-depth PS drill (2-proc, -ps_pipeline_depth=auto) =="
+# the staleness-adaptive depth controller end to end across REAL
+# processes: depth starts at 1 and the controller widens within [1, 3]
+# at pod-agreed (allgather-min) round boundaries. Gates: >=1 widen
+# actually happened, every rank took the same number of decisions and
+# ended at the same depth, rounds stay lockstep with identical lr
+# traces, and the final tables still agree — adaptivity must never
+# break the cross-rank contract, only the run-to-run bit-exactness
+# (decisions are wall-clock driven; DEPLOY.md "SLOs and the depth
+# controller").
+ADROOT=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$ADROOT" <<'EOF'
+import re, sys
+import numpy as np
+
+sys.path.insert(0, ".")
+from tests.test_multiprocess_e2e import _run_cluster
+
+root = sys.argv[1]
+rng = np.random.RandomState(11)
+p = rng.randint(0, 30, 2000) * 2
+ids = np.stack([p, p + 1, np.full_like(p, -1)], 1).reshape(-1).astype(np.int32)
+np.save(root + "/corpus.npy", ids)
+outs = _run_cluster(
+    "multiprocess_ps_worker.py",
+    lambda i: [root + "/corpus.npy", f"{root}/emb_{i}.npy",
+               "shard_pipelined_auto"],
+    nproc=2, timeout=300,
+)
+rounds = [int(re.search(r"rounds=(\d+)", o).group(1)) for o in outs]
+assert rounds[0] == rounds[1] and rounds[0] > 2, rounds  # lockstep rounds
+traces = [re.search(r"lr_trace=(\S+)", o).group(1) for o in outs]
+assert traces[0] == traces[1], "lr traces diverged across ranks"
+finals = [int(re.search(r"depth_final=(\d+)", o).group(1)) for o in outs]
+decs = [int(re.search(r"decisions=(\d+)", o).group(1)) for o in outs]
+widens = [int(re.search(r"widens=(\d+)", o).group(1)) for o in outs]
+assert finals[0] == finals[1] and 1 <= finals[0] <= 3, finals
+assert decs[0] == decs[1] and decs[0] >= 1, decs
+assert widens[0] >= 1, f"controller never widened: {outs[0][-400:]}"
+e = [np.load(f"{root}/emb_{i}.npy") for i in range(2)]
+np.testing.assert_allclose(e[0], e[1], atol=1e-6)
+assert np.isfinite(e[0]).all() and np.abs(e[0]).max() > 1e-3
+print("adaptive-depth PS drill OK: rounds", rounds[0], "decisions",
+      decs[0], "widens", widens[0], "final depth", finals[0])
+EOF
+rm -rf "$ADROOT"
 
 echo "== obs trace smoke (2-proc pipelined, merge + per-round span gate) =="
 # the observability layer end to end across REAL processes: a depth-1

@@ -209,6 +209,44 @@ REQUIREMENTS: Tuple[Requirement, ...] = (
         doc="must be >= 0",
     ),
     Requirement(
+        name="ps_pipeline_depth_max_positive",
+        flags=("ps_pipeline_depth_max",),
+        predicate=lambda o, e: o.ps_pipeline_depth_max >= 1,
+        message=lambda o, e: (
+            "-ps_pipeline_depth_max must be >= 1, got %d"
+            % o.ps_pipeline_depth_max
+        ),
+        doc="must be >= 1 (the auto controller's widest staleness bound)",
+    ),
+    Requirement(
+        name="ps_depth_decide_rounds_positive",
+        flags=("ps_depth_decide_rounds",),
+        predicate=lambda o, e: o.ps_depth_decide_rounds >= 1,
+        message=lambda o, e: (
+            "-ps_depth_decide_rounds must be >= 1, got %d"
+            % o.ps_depth_decide_rounds
+        ),
+        doc="must be >= 1 (controller decision cadence in PS rounds)",
+    ),
+    Requirement(
+        name="ps_depth_auto_within_max",
+        flags=("ps_pipeline_depth", "ps_pipeline_depth_max"),
+        predicate=lambda o, e: (
+            not getattr(o, "ps_depth_auto", False)
+            or 1 <= o.ps_pipeline_depth <= o.ps_pipeline_depth_max
+        ),
+        message=lambda o, e: (
+            "-ps_pipeline_depth=auto starts at depth %d, outside "
+            "[1, -ps_pipeline_depth_max=%d] — raise the max or set an "
+            "explicit depth" % (o.ps_pipeline_depth, o.ps_pipeline_depth_max)
+        ),
+        doc=(
+            "`auto` keeps the effective depth within "
+            "[1, `-ps_pipeline_depth_max`]; the starting depth must "
+            "already lie in that range"
+        ),
+    ),
+    Requirement(
         name="ps_compress_domain",
         flags=("ps_compress",),
         predicate=lambda o, e: o.ps_compress in ("none", "sparse", "1bit"),

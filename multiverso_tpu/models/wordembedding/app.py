@@ -165,14 +165,32 @@ MV_DEFINE_string(
 # PS comms pipeline (the reference's -is_pipeline Communicator overlap,
 # ref: communicator.cpp:117-249 + async_buffer.h, rebuilt for the PS
 # table path): see README "PS comms" / DEPLOY.md for the tuning guide.
-MV_DEFINE_int(
-    "ps_pipeline_depth", 0,
+MV_DEFINE_string(
+    "ps_pipeline_depth", "0",
     "PS-mode software pipeline depth: 0 (default) = fully synchronous "
     "rounds, bit-exact with prior releases; d >= 1 overlaps each block's "
     "training with the NEXT d blocks' pulls and the previous block's "
     "push on a comms thread — bounded staleness of exactly d rounds "
     "(block k trains on tables missing pushes k-d..k-1; 1 = the "
-    "reference's -is_pipeline semantics)",
+    "reference's -is_pipeline semantics). 'auto' starts at depth 1 and "
+    "lets the staleness-adaptive controller widen/narrow the effective "
+    "depth at drained round boundaries within "
+    "[1, -ps_pipeline_depth_max], backing off on SLO burn or a loss "
+    "regression (DEPLOY.md \"SLOs and the depth controller\")",
+)
+MV_DEFINE_int(
+    "ps_pipeline_depth_max", 4,
+    "-ps_pipeline_depth=auto only: the widest effective depth the "
+    "controller may reach — the staleness bound the run is willing to "
+    "pay (block k may train on tables missing up to this many rounds' "
+    "pushes)",
+)
+MV_DEFINE_int(
+    "ps_depth_decide_rounds", 8,
+    "-ps_pipeline_depth=auto only: take one controller decision every "
+    "this many PS rounds — each decision reads the window's measured "
+    "overlap%% and is agreed pod-wide (allgather-min) before the depth "
+    "changes, so every rank's collective sequence stays identical",
 )
 MV_DEFINE_string(
     "ps_compress", "none",
@@ -237,6 +255,12 @@ class WEOptions:
     upload_chunk_tokens: int = 0
     walk: str = "perm"
     ps_pipeline_depth: int = 0
+    # derived from -ps_pipeline_depth=auto (from_flags); programmatic
+    # callers set it directly. auto starts at depth 1 and the controller
+    # adapts within [1, ps_pipeline_depth_max].
+    ps_depth_auto: bool = False
+    ps_pipeline_depth_max: int = 4
+    ps_depth_decide_rounds: int = 8
     ps_compress: str = "none"
     ps_sparse_pull: bool = True
     # float so tests/benches can request sub-MB caches; the CLI flag is
@@ -252,8 +276,25 @@ class WEOptions:
 
     @classmethod
     def from_flags(cls) -> "WEOptions":
-        names = [f.name for f in dataclasses.fields(cls) if f.name != "seed"]
-        return cls(**{n: GetFlag(n) for n in names})
+        # seed has no flag; ps_depth_auto/ps_pipeline_depth derive from
+        # the one string-valued -ps_pipeline_depth ("auto" or an int)
+        derived = ("seed", "ps_depth_auto", "ps_pipeline_depth")
+        names = [
+            f.name for f in dataclasses.fields(cls) if f.name not in derived
+        ]
+        kw = {n: GetFlag(n) for n in names}
+        raw = str(GetFlag("ps_pipeline_depth")).strip().lower()
+        if raw == "auto":
+            kw["ps_depth_auto"] = True
+            kw["ps_pipeline_depth"] = 1
+        else:
+            try:
+                kw["ps_pipeline_depth"] = int(raw)
+            except ValueError:
+                CHECK(False,
+                      f"-ps_pipeline_depth must be an integer or 'auto', "
+                      f"got {raw!r}")
+        return cls(**kw)
 
 
 class _PSCommsStats:
@@ -279,6 +320,10 @@ class _PSCommsStats:
         # ship (idx, val) pairs, so bytes can undercut rows * row_bytes)
         self.push_bytes_dense = 0  # pre-compression delta bytes
         self.push_bytes_wire = 0   # bytes actually moved
+        # last completed round's timers — the straggler detector's
+        # piggyback payload (_ps_round_meta allgathers them per round)
+        self.last_train_us = 0.0
+        self.last_push_us = 0.0
         from multiverso_tpu.utils.dashboard import Dashboard
 
         Dashboard.add_section("ps_comms", self.lines, snapshot=self.to_dict)
@@ -301,12 +346,14 @@ class _PSCommsStats:
     def add_train(self, dt: float) -> None:
         with self._lock:
             self.train_s += dt
+            self.last_train_us = dt * 1e6
 
     def add_push(self, dt: float, bytes_dense: int, bytes_wire: int) -> None:
         with self._lock:
             self.push_s += dt
             self.push_bytes_dense += bytes_dense
             self.push_bytes_wire += bytes_wire
+            self.last_push_us = dt * 1e6
         from multiverso_tpu.utils.dashboard import Dashboard
 
         Dashboard.counter("ps.push_bytes_wire").add(bytes_wire)
@@ -314,6 +361,20 @@ class _PSCommsStats:
     def set_wall(self, seconds: float) -> None:
         with self._lock:
             self.wall_s = seconds
+
+    def last_round_timers_us(self) -> tuple:
+        """(train_us, push_us) of the most recently completed stages —
+        what this rank contributes to the round-meta timer allgather."""
+        with self._lock:
+            return self.last_train_us, self.last_push_us
+
+    def stage_seconds(self) -> tuple:
+        """(pull_s, train_s, push_s, rounds) cumulative snapshot — the
+        depth controller diffs two snapshots to get a decision window's
+        overlap% (``wall_s`` is only set after the loop, so the run-wide
+        ``overlap_pct()`` cannot serve a live decision)."""
+        with self._lock:
+            return self.pull_s, self.train_s, self.push_s, self.rounds
 
     @staticmethod
     def _overlap_pct(pull_s: float, train_s: float, push_s: float,
@@ -802,7 +863,8 @@ class WordEmbedding:
         )
         return int(vals[0::2].sum() + (vals[1::2].sum() << 30))
 
-    def _ps_round_meta(self, have: int, ni: int, no: int):
+    def _ps_round_meta(self, have: int, ni: int, no: int,
+                       timers_us=None, round_idx: int = -1):
         """Per-round cross-process agreement (the fix the round-2 CHECK
         sketched): every process contributes its block's union sizes, ranks
         agree on the padded power-of-two bucket, and the round's pull/push
@@ -810,19 +872,142 @@ class WordEmbedding:
         (get_rows_local/add_rows_local stack the per-process buckets along
         the worker axis). Returns (any_rank_has_data, bucket_in,
         bucket_out); one tiny host allgather per round, single-process
-        short-circuits."""
+        short-circuits.
+
+        ``timers_us`` (pipelined path only): this rank's last-round
+        (train_us, push_us) piggyback on the SAME allgather — widened to
+        5 int64s, still one collective — and the gathered per-rank round
+        timers feed the straggler detector. The sync path never passes
+        timers, so its 3-wide wire shape (and bit-exact trace) is
+        untouched."""
         if jax.process_count() == 1:
             return have > 0, self._bucket(max(ni, 1)), self._bucket(max(no, 1))
         from jax.experimental import multihost_utils
 
-        meta = multihost_utils.process_allgather(
-            np.asarray([have, ni, no], np.int64)
-        ).reshape(-1, 3)
+        if timers_us is None:
+            meta = multihost_utils.process_allgather(
+                np.asarray([have, ni, no], np.int64)
+            ).reshape(-1, 3)
+        else:
+            meta = multihost_utils.process_allgather(
+                np.asarray(
+                    [have, ni, no, int(timers_us[0]), int(timers_us[1])],
+                    np.int64,
+                )
+            ).reshape(-1, 5)
+            st = getattr(self, "_ps_straggler", None)
+            if st is not None:
+                # per-rank round timer = train + push (the stages a slow
+                # host inflates); runs on the comms thread, bounded work
+                st.feed(
+                    (meta[:, 3] + meta[:, 4]).astype(np.float64),
+                    round_idx,
+                )
         return (
             bool(meta[:, 0].any()),
             self._bucket(max(int(meta[:, 1].max()), 1)),
             self._bucket(max(int(meta[:, 2].max()), 1)),
         )
+
+    def _ps_depth_decide(self, round_idx: int, proposal: int) -> int:
+        """Pod-wide depth agreement (comms-pipe task): allgather every
+        rank's controller proposal and take the MIN — the conservative
+        depth every rank can honor. Proposals are computed from
+        rank-local windows, so they can disagree; the min keeps the
+        widen/narrow collective and the per-rank pull issue sequences
+        identical. Single-process short-circuits."""
+        if jax.process_count() == 1:
+            return int(proposal)
+        from jax.experimental import multihost_utils
+
+        got = multihost_utils.process_allgather(
+            np.asarray([proposal], np.int64)
+        )
+        return int(got.min())
+
+    def _ps_depth_decision(self, r: int, ctl, pipe, wd, snap, rounds0: int,
+                           t0: float, loss_dev) -> None:
+        """One controller decision at a drained round boundary: window
+        overlap% from the stage-clock deltas since the last decision, an
+        in-loop SLO verdict, a rank-local proposal, then the pod-agreed
+        depth (awaiting the decide ticket orders it after every
+        previously-submitted pull/push on the FIFO comms pipe — that IS
+        the drained boundary). Every decision, hold included, lands in
+        the flight recorder as a ``depth_decision`` event."""
+        from multiverso_tpu.obs import slo as _slo
+
+        pull_s, train_s, push_s, rounds = self._ps_stats.stage_seconds()
+        d_rounds = rounds - rounds0
+        old = ctl.depth
+        overlap = 0.0
+        dec = None
+        # d_rounds counts COMMS-THREAD pull completions since the last
+        # decision — at a dry tail (this rank out of blocks) or under
+        # scheduler skew it can be 0 on one rank while positive on
+        # another. The judgment is skippable; the decide collective is
+        # NOT: every rank reaches `decide:{r}` at the same pipe position
+        # or the next rank's round-meta allgather pairs against this
+        # rank's decide allgather and gloo dies on the size mismatch.
+        if d_rounds > 0:
+            wall = max(time.perf_counter() - t0, 1e-9)
+            d_pull = pull_s - snap[0]
+            d_train = train_s - snap[1]
+            d_push = push_s - snap[2]
+            overlap = _PSCommsStats._overlap_pct(
+                d_pull, d_train, d_push, wall
+            )
+            # SLO verdict rides the decision cadence (deterministic
+            # rounds, benchable overhead); an unarmed engine costs one
+            # empty check
+            breached = bool(
+                _slo.engine.rules
+                and _slo.engine.evaluate(ingest=True)["breached"]
+            )
+            if loss_dev is not None:
+                # device sync only at decision rounds — never per round
+                ctl.observe_loss(float(loss_dev))
+            dec = ctl.propose(
+                overlap_pct=overlap,
+                pull_ms=1e3 * d_pull / d_rounds,
+                train_ms=1e3 * d_train / d_rounds,
+                push_ms=1e3 * d_push / d_rounds,
+                slo_breached=breached,
+            )
+        agreed = self._ps_await(
+            pipe.submit(
+                lambda rr=r, p=(dec.depth if dec is not None else old): (
+                    self._ps_depth_decide(rr, p)
+                ),
+                tag=f"decide:{r}",
+            ),
+            r, pipe, wd,
+        )
+        ctl.depth = agreed
+        if dec is not None:
+            rec = dec.to_dict()
+            reason = dec.reason
+        else:
+            rec = {
+                "action": "hold", "depth": int(agreed),
+                "reason": "dry_window", "overlap_pct": 0.0,
+                "pull_ms": 0.0, "train_ms": 0.0, "push_ms": 0.0,
+                "loss_ema": ctl._loss_ema,
+                "best_loss_ema": ctl._best_loss_ema,
+                "slo_breached": False,
+            }
+            reason = "dry_window"
+        rec.update(
+            round=int(r), old_depth=int(old), agreed_depth=int(agreed),
+        )
+        self._ps_depth_decisions.append(rec)
+        obs.recorder.record("depth_decision", **rec)
+        if agreed != old:
+            Log.Info(
+                "[WordEmbedding] depth controller: %s %d -> %d at round "
+                "%d (%s, window overlap %.1f%%)",
+                "narrow" if agreed < old else "widen", old, agreed, r,
+                reason, overlap,
+            )
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -936,8 +1121,14 @@ class WordEmbedding:
         have = blk is not None
         ni_u = int(blk["uin"].size) if have else 0
         no_u = int(blk["uout"].size) if have else 0
+        timers = (
+            self._ps_stats.last_round_timers_us()
+            if getattr(self, "_ps_straggler", None) is not None
+            else None
+        )
         any_data, ni, no = self._ps_round_meta(
-            1 if have else 0, ni_u, no_u
+            1 if have else 0, ni_u, no_u,
+            timers_us=timers, round_idx=round_idx,
         )
         if not any_data:
             return None
@@ -1266,6 +1457,7 @@ class WordEmbedding:
         self, round_idx: int, pairs_done: int, *, depth: int,
         pulls=(), gp_history: Optional[Dict[int, int]] = None,
         epoch: int = 0, batches_in_epoch: int = 0,
+        extra_rank_meta: Optional[Dict] = None,
     ) -> None:
         """Quorum-committed PS checkpoint at a drained round boundary.
         Every rank calls this at the SAME round (rounds are lockstep);
@@ -1300,16 +1492,26 @@ class WordEmbedding:
             "epoch": int(epoch), "batches_in_epoch": int(batches_in_epoch),
             "restarts": int(self._ps_restarts),
         }
+        if extra_rank_meta:
+            # depth=auto bookkeeping (controller state, staged lr-source
+            # map) — per-rank, JSON-safe, ignored by older readers
+            rank_meta.update(extra_rank_meta)
         path = os.path.join(o.checkpoint_dir, f"ckpt-{int(round_idx)}")
         save_tables(path, self._ps_tables(), step=round_idx, meta=meta,
                     rank_payload=rank_payload, rank_meta=rank_meta)
         if pid == 0:
             gc_checkpoints(o.checkpoint_dir, o.checkpoint_retain)
 
-    def _ps_maybe_resume(self, depth: int):
+    def _ps_maybe_resume(self, depth: int, auto: bool = False):
         """Restore the latest valid PS checkpoint (tables + this rank's
         private state); returns the resume record or None. Collective:
-        every rank must call this together."""
+        every rank must call this together.
+
+        ``auto`` (-ps_pipeline_depth=auto): the staged pull window's
+        length is whatever the controller had widened to at save time —
+        accept the checkpoint's own ``depth`` as the window length
+        instead of requiring it to match, and surface the per-rank meta
+        so the caller can restore the controller state."""
         from multiverso_tpu.io.checkpoint import restore_tables
         from multiverso_tpu.resilience import latest_valid
         from multiverso_tpu.resilience import stats as _rstats
@@ -1333,11 +1535,12 @@ class WordEmbedding:
         # depth CHECK below only guards the bit-exact same-world path
         ckpt_world = len(meta.get("ranks") or {})
         elastic = ckpt_world > 0 and ckpt_world != jax.process_count()
-        CHECK(elastic or int(meta.get("depth", -1)) == depth,
+        CHECK(elastic or auto or int(meta.get("depth", -1)) == depth,
               f"checkpoint {path} was written at -ps_pipeline_depth="
               f"{meta.get('depth')} but this run uses {depth}: the staged "
               "in-flight pull window would not line up — resume with the "
-              "same depth")
+              "same depth (or -ps_pipeline_depth=auto, which adopts the "
+              "checkpoint's window)")
         # the staged rank state (pull payloads, client caches, codec
         # residuals) and the table set are flag-shaped: a silent mismatch
         # would either KeyError on the npz or break the bit-exact resume
@@ -1377,11 +1580,14 @@ class WordEmbedding:
               f"checkpoint {path} has no rank {pid} state: it was written "
               "by a different world size — relaunch with the original "
               "process count")
+        # auto adopts the saved window length (the controller may have
+        # widened past this run's initial depth before the save)
+        window = int(meta.get("depth", depth)) if auto else depth
         pulls = []
-        if depth > 0:
+        if window > 0:
             with np.load(os.path.join(path, f"rank{pid}", "state.npz"),
                          allow_pickle=False) as data:
-                pulls = self._ps_restore_rank_state(data, depth)
+                pulls = self._ps_restore_rank_state(data, window)
         with self._ps_state_lock:
             self._wc_cum = int(rmeta["wc_cum"])
             self._ps_global_pairs = int(meta.get("gp_last", 0))
@@ -1403,6 +1609,7 @@ class WordEmbedding:
                 for k, v in (meta.get("gp_history") or {}).items()
             },
             "pulls": pulls,
+            "rank_meta": rmeta,
         }
 
     def _ps_elastic_resume(self, path: str, meta: Dict):
@@ -1652,12 +1859,33 @@ class WordEmbedding:
         ckpt_every = (
             o.checkpoint_every_steps if o.checkpoint_dir else 0
         )
+        # -ps_pipeline_depth=auto: the staleness-adaptive controller.
+        # ``depth`` becomes mutable — widened/narrowed only at pod-agreed
+        # decision rounds (``_ps_depth_decide`` min-agreement on the
+        # comms pipe), so every rank's pull-issue and collective
+        # sequences stay identical. The fixed-depth path below is
+        # untouched: ``auto`` gates every behavioral change.
+        from multiverso_tpu.obs import slo as _slo
+        from multiverso_tpu.obs.controller import DepthController
+
+        auto = bool(o.ps_depth_auto)
+        ctl = None
+        lr_src_for: Dict[int, int] = {}  # round -> newest pre-pull push
+        gp_carry = 0  # last awaited global pair count (lr input)
+        decide_every = max(1, o.ps_depth_decide_rounds)
+        self._ps_depth_decisions: list = []
+        if auto:
+            ctl = DepthController(
+                min_depth=1, max_depth=max(1, o.ps_pipeline_depth_max),
+            )
+            ctl.depth = max(1, min(ctl.max_depth, depth))
+            depth = ctl.depth
         # elastic resume (collective): restore tables + wc state + this
         # rank's staged in-flight pulls, then advance the block stream to
         # the drained boundary — the resumed loop replays the exact
         # pipeline warm-up the checkpoint left in flight, so kill +
         # restart == uninterrupted bit for bit at any depth
-        resume = self._ps_maybe_resume(depth)
+        resume = self._ps_maybe_resume(depth, auto=auto)
         gen = gen_blocks()
         r = 0
         issued = 0
@@ -1672,14 +1900,26 @@ class WordEmbedding:
                 # world-size-changing resume: the staged pull window was
                 # per-rank state of the OLD world — restart the pipeline
                 # with an empty warm-up at N' and skip this rank's even
-                # share of the globally consumed blocks
+                # share of the globally consumed blocks (auto: the
+                # controller restarts fresh at the initial depth too)
                 issued = r
                 skip = resume["skip_blocks"]
             else:
-                issued = r + depth
+                # auto adopts the saved window length — the controller
+                # may have widened past this run's initial depth
+                issued = r + (len(resume["pulls"]) if auto else depth)
                 skip = issued
-                for pull in resume["pulls"]:  # rounds r..r+depth-1, in order
+                for pull in resume["pulls"]:  # rounds r..issued-1, in order
                     pull_tickets.append(self._Resolved(pull))
+                if auto:
+                    rm = resume.get("rank_meta") or {}
+                    ctl.load_state_dict(rm.get("depth_controller"))
+                    depth = ctl.depth
+                    lr_src_for = {
+                        int(k): int(v)
+                        for k, v in (rm.get("lr_src_for") or {}).items()
+                    }
+                    gp_carry = int(rm.get("gp_lr_carry", 0))
             for k, gp in resume["gp_history"].items():
                 push_tickets[k] = self._Resolved(gp)
             # regenerate-and-discard the consumed blocks: same seed, same
@@ -1689,6 +1929,13 @@ class WordEmbedding:
                 next(gen)
         self._set_ready(True, "training")  # tables live + resume landed
         wd = wdg.monitor_from_flags()
+        # straggler detection (multi-process pipelined rounds): per-rank
+        # train+push timers piggyback on the round-meta allgather and a
+        # drifting rank raises a `straggler` flight event well before a
+        # heartbeat deadline would — the rank is slow, not dead
+        self._ps_straggler = (
+            _slo.StragglerDetector() if jax.process_count() > 1 else None
+        )
         pipe = TaskPipe(name="mv-ps-comms")
         # tiered look-ahead tickets ride the COMMS pipe: every collective
         # dispatch stays on that one thread (concurrent multi-device
@@ -1704,6 +1951,13 @@ class WordEmbedding:
         loss_dev = None
         log_every = o.batch_size * max(64, S * 8)
         loop_t0 = time.perf_counter()
+        # decision-window baselines (auto): overlap% is measured per
+        # window by diffing the cumulative stage clocks against the
+        # training thread's wall — the run-wide overlap_pct() only
+        # becomes meaningful after set_wall at the end
+        decide_snap = (0.0, 0.0, 0.0)
+        decide_rounds0 = 0
+        decide_t0 = loop_t0
         try:
             while True:
                 chaos.maybe_drop_rank(r)  # failure-domain drills
@@ -1729,7 +1983,11 @@ class WordEmbedding:
                     # transport error parked on a drained ticket must hit
                     # the containment handler, not escape raw
                     self._ps_save_checkpoint(
-                        r, pairs_done, depth=depth,
+                        r, pairs_done,
+                        # auto: the staged window length IS the depth a
+                        # resume must adopt (a narrow still in flight
+                        # can leave window > controller depth)
+                        depth=len(pull_tickets) if auto else depth,
                         pulls=[
                             self._ps_await(t, r, pipe, wd)
                             for t in pull_tickets
@@ -1738,12 +1996,40 @@ class WordEmbedding:
                             k: self._ps_await(t, r, pipe, wd)
                             for k, t in push_tickets.items()
                         },
+                        extra_rank_meta={
+                            "depth_controller": ctl.state_dict(),
+                            "lr_src_for": {
+                                str(k): int(v)
+                                for k, v in lr_src_for.items()
+                            },
+                            "gp_lr_carry": int(gp_carry),
+                        } if auto else None,
                     )
+                if (
+                    auto and r > 0 and r % decide_every == 0
+                    and r != resume_round
+                ):
+                    self._ps_depth_decision(
+                        r, ctl, pipe, wd,
+                        decide_snap, decide_rounds0, decide_t0,
+                        loss_dev,
+                    )
+                    depth = ctl.depth
+                    ps_s, tr_s, pu_s, rnds = self._ps_stats.stage_seconds()
+                    decide_snap = (ps_s, tr_s, pu_s)
+                    decide_rounds0 = rnds
+                    decide_t0 = time.perf_counter()
                 # keep pulls for rounds r..r+depth in flight: pull k+d is
                 # submitted BEFORE push k..k+d-1, which is the whole
                 # overlap (and the whole staleness)
                 while issued <= r + depth:
                     blk = buf.Get()
+                    if auto:
+                        # newest push ordered before this pull — the lr
+                        # source a fixed depth derives as r - depth - 1;
+                        # recorded at issue time so depth changes never
+                        # skew the schedule
+                        lr_src_for[issued] = r - 1
                     pull_tickets.append(
                         pipe.submit(
                             lambda b=blk, rr=issued: self._ps_pull_round(
@@ -1758,10 +2044,21 @@ class WordEmbedding:
                     break
                 # deterministic lr: the newest wc round whose completion
                 # is ORDERED before this round's pull on the comms thread
-                lr_src = r - depth - 1
-                if lr_src in push_tickets:  # absent only in the warm-up
+                if auto:
+                    src = lr_src_for.pop(r, r - depth - 1)
+                    # a widen can leave a round with no newly-eligible
+                    # push (its predecessor consumed the same source):
+                    # the carry keeps the schedule monotone
+                    for k in [kk for kk in sorted(push_tickets)
+                              if kk <= src]:
+                        gp_carry = self._ps_await(
+                            push_tickets.pop(k), r, pipe, wd
+                        )
+                    gp = gp_carry
+                elif (r - depth - 1) in push_tickets:
+                    # absent only in the warm-up
                     gp = self._ps_await(
-                        push_tickets.pop(lr_src), r, pipe, wd
+                        push_tickets.pop(r - depth - 1), r, pipe, wd
                     )
                 else:
                     gp = 0
@@ -1809,12 +2106,16 @@ class WordEmbedding:
             pipe.close(timeout_s=5.0 if pipe.broken is not None else 60.0)
             buf.Stop()
             self._tier_prefetch_pipe = None  # closed: prep must not use it
+            self._ps_straggler = None  # meta allgather back to 3-wide
             for table, _side in self._tier_prefetch_tables:
                 table.close()  # tear down any table-owned prefetch pipes
         # surface any comms-thread error parked on a drained push ticket
         for rr in sorted(push_tickets):
             push_tickets[rr].result()
         self._ps_stats.set_wall(time.perf_counter() - loop_t0)
+        # bench/test surface: where the controller landed (fixed runs
+        # report their static depth; decisions list stays empty)
+        self._ps_depth_final = depth
         if self._tier:
             # live host-tier arrays, no copy: a tier-scale table must
             # not round-trip HBM or double host RAM just to be written
@@ -1982,7 +2283,7 @@ class WordEmbedding:
         self._ps_setup()
         self._ps_steps: Dict = {}
         self._ps_lr_trace: list = []  # per-round lr (tests assert ranks agree)
-        if o.ps_pipeline_depth >= 1:
+        if o.ps_pipeline_depth >= 1 or o.ps_depth_auto:
             return self._train_ps_pipelined(source, total_pairs_est, start)
         S = max(1, o.steps_per_call)
         loss_dev = None

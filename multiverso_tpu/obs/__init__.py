@@ -14,9 +14,18 @@ The cross-cutting layer every subsystem attaches to once (ISSUE 9):
 * :mod:`multiverso_tpu.obs.flight` — a bounded ring of recent
   structured events dumped as ``flight-recorder-rank<p>.jsonl`` next to
   the FAILURE report on containment, collected by the ``PodSupervisor``.
+* :mod:`multiverso_tpu.obs.timeseries` — a bounded ring of
+  ``observe()`` scrapes answering window queries (the burn-rate input).
+* :mod:`multiverso_tpu.obs.slo` — declarative SLO rules with
+  multi-window burn-rate evaluation; breaches emit flight events and
+  flip ``/healthz`` degraded. Plus the straggler detector over per-rank
+  round timers.
+* :mod:`multiverso_tpu.obs.controller` — the staleness-adaptive
+  pipeline-depth controller's decision table (``-ps_pipeline_depth=auto``
+  wiring lives in the PS round loop).
 """
 
-from multiverso_tpu.obs import flight, metrics, tracer
+from multiverso_tpu.obs import controller, flight, metrics, slo, timeseries, tracer
 from multiverso_tpu.obs.flight import recorder
 from multiverso_tpu.obs.tracer import event, span, tracing_enabled
 
@@ -24,6 +33,9 @@ __all__ = [
     "tracer",
     "metrics",
     "flight",
+    "timeseries",
+    "slo",
+    "controller",
     "span",
     "event",
     "tracing_enabled",

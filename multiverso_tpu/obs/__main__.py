@@ -27,6 +27,8 @@ import urllib.request
 from multiverso_tpu.obs.trace_tools import (
     load_trace,
     merge_traces,
+    request_index,
+    request_summary_lines,
     resolve_inputs,
     span_counts,
     validate_trace,
@@ -77,6 +79,60 @@ def _scrape_fleet(log_dir: str, timeout_s: float) -> list:
     return found
 
 
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)")
+
+
+def _parse_samples(text: str) -> dict:
+    """Prometheus text -> ``{metric_name: float}`` (labeled samples keep
+    the bare name, last one wins — the watch loop tracks scalars like
+    served/shed/p99, not labeled families)."""
+    out = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        try:
+            out[m.group(1)] = float(m.group(3))
+        except ValueError:
+            continue
+    return out
+
+
+def _watch_fleet(args) -> int:
+    """``scrape --watch``: the ROADMAP's "nothing scrapes/joins them"
+    residual as a daemon — one JSONL line per tick, each carrying every
+    reachable replica's numeric samples. Ctrl-C (or --count) stops it;
+    the file is append-only so a crashed watcher loses nothing."""
+    import time as _time
+
+    out_path = args.out or os.path.join(args.log_dir, "fleet-metrics.jsonl")
+    ticks = 0
+    try:
+        while True:
+            dumps = _scrape_fleet(args.log_dir, args.timeout)
+            line = {
+                "wall": _time.time(),
+                "replicas": {idx: _parse_samples(t) for idx, t in dumps},
+            }
+            with open(out_path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+            ticks += 1
+            print(
+                f"watch tick {ticks}: {len(dumps)} replica(s) -> {out_path}"
+            )
+            if args.count and ticks >= args.count:
+                break
+            _time.sleep(max(args.interval, 0.05))
+    except KeyboardInterrupt:
+        pass
+    if args.expect and ticks == 0:
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m multiverso_tpu.obs")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -90,6 +146,12 @@ def main(argv=None) -> int:
     vp.add_argument("file")
     sp = sub.add_parser("summary", help="per-rank span counts")
     sp.add_argument("file")
+    sp.add_argument("--request", default=None, metavar="TRACE_ID",
+                    help="print ONE request's parent-linked span tree "
+                    "(cross-process, from a merged trace)")
+    sp.add_argument("--list-requests", action="store_true",
+                    help="list every trace_id with its process coverage "
+                    "(pids=) and event count")
     sc = sub.add_parser(
         "scrape", help="join a serving fleet's per-replica /metrics"
     )
@@ -101,11 +163,21 @@ def main(argv=None) -> int:
                     help="per-replica HTTP timeout, seconds")
     sc.add_argument("--expect", type=int, default=0,
                     help="fail unless at least this many replicas answered")
+    sc.add_argument("--watch", action="store_true",
+                    help="scrape repeatedly, appending one JSONL line per "
+                    "tick ({wall, replicas: {idx: {metric: value}}}) to "
+                    "-o (default fleet-metrics.jsonl in the log dir)")
+    sc.add_argument("--interval", type=float, default=5.0,
+                    help="--watch scrape period, seconds")
+    sc.add_argument("--count", type=int, default=0,
+                    help="--watch: stop after this many ticks (0 = forever)")
     args = ap.parse_args(argv)
 
     if args.cmd == "scrape":
         from multiverso_tpu.obs.metrics import merge_prometheus
 
+        if args.watch:
+            return _watch_fleet(args)
         dumps = _scrape_fleet(args.log_dir, args.timeout)
         if args.expect and len(dumps) < args.expect:
             print(
@@ -158,6 +230,28 @@ def main(argv=None) -> int:
         return 1 if problems else 0
 
     # summary
+    if args.list_requests:
+        idx = request_index(doc)
+        if not idx:
+            print("no request-scoped spans (trace_id args) found")
+            return 0
+        for tid in sorted(idx):
+            evs = idx[tid]
+            pids = sorted({int(ev.get("pid", 0)) for ev in evs})
+            print(
+                f"trace={tid} pids={','.join(map(str, pids))} "
+                f"events={len(evs)}"
+            )
+        return 0
+    if args.request:
+        lines = request_summary_lines(doc, args.request)
+        if len(lines) <= 1:
+            print(f"trace {args.request} not found in this dump",
+                  file=sys.stderr)
+            return 2
+        for line in lines:
+            print(line)
+        return 0
     for (rank, name), n in sorted(span_counts(doc).items()):
         print(f"rank={rank} name={name} count={n}")
     return 0

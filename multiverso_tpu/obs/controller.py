@@ -1,0 +1,241 @@
+"""Staleness-adaptive pipeline-depth controller (pure decision logic).
+
+Closes the ROADMAP loop carried since PR 4: *observe the per-round
+pull/train/push timers, widen depth while overlap% is below target and
+the loss stays bounded*. This module holds only the decision table —
+the PS round loop owns WHEN decisions are taken (drained round
+boundaries) and HOW they are agreed pod-wide (an allgather-min in
+``_ps_depth_decide``); the controller just maps one observation to
+``widen`` / ``hold`` / ``narrow`` with a reason string.
+
+Decision table, first match wins:
+
+1. ``slo_backoff``  — an SLO rule is burning: narrow (hold at min
+   depth — never widen into a burn). Staleness is a luxury; a
+   degraded pod sheds it first.
+2. ``loss_guard``   — smoothed loss exceeds the best loss seen so far
+   by more than ``loss_guard_pct``: narrow (hold at min depth). The
+   whole premise of bounded staleness is that loss stays near the
+   synchronous trace.
+3. ``target_met``   — overlap% at or above target: hold. Depth beyond
+   "comms fully hidden" buys nothing and costs staleness.
+4. ``no_gain``      — the previous widen did not buy at least
+   ``min_gain_pct`` overlap: narrow back. Compute-bound rounds cannot
+   benefit from more in-flight pulls.
+5. ``overlap_low``  — below target, headroom available, comms time
+   non-trivial: widen.
+6. ``steady``       — otherwise hold (at max, or comms already noise).
+
+The controller is deliberately deterministic and side-effect free
+(``propose`` mutates only its own bookkeeping) so every rank computes
+the same proposal from the same pod-level inputs, and so the decision
+table unit tests need no clock, no JAX, no threads. ``state_dict`` /
+``load_state_dict`` round-trip through checkpoint meta — after a
+kill/resume the guard baseline and cooldown survive; restoring from an
+older checkpoint without controller state resets safely to defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["DepthController", "Decision"]
+
+WIDEN = "widen"
+HOLD = "hold"
+NARROW = "narrow"
+
+
+class Decision:
+    """One controller verdict: the action, the agreed-on target depth
+    BEFORE pod agreement (a proposal), and the reason that fired."""
+
+    __slots__ = ("action", "depth", "reason", "observed")
+
+    def __init__(self, action: str, depth: int, reason: str,
+                 observed: Dict[str, Any]):
+        self.action = action
+        self.depth = depth
+        self.reason = reason
+        self.observed = observed
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "action": self.action,
+            "depth": self.depth,
+            "reason": self.reason,
+            **self.observed,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"Decision({self.action}, depth={self.depth}, "
+                f"reason={self.reason})")
+
+
+class DepthController:
+    """Maps one round-boundary observation to a depth proposal.
+
+    Parameters
+    ----------
+    min_depth / max_depth : clamp for every proposal. ``min_depth``
+        defaults to 1 — depth 0 is the bit-exact synchronous contract
+        and is never entered adaptively (the sync path does not even
+        run this code).
+    overlap_target_pct : the "comms hidden" bar; at/above it we hold.
+    loss_guard_pct : narrow when smoothed loss is more than this many
+        percent above the best smoothed loss seen (the staleness guard).
+    min_gain_pct : a widen must buy at least this much overlap by the
+        next decision or it is rolled back.
+    min_comms_ms : below this much pull+push time per round the pipe
+        has nothing left to hide; don't widen into noise.
+    """
+
+    def __init__(
+        self,
+        min_depth: int = 1,
+        max_depth: int = 4,
+        overlap_target_pct: float = 60.0,
+        loss_guard_pct: float = 10.0,
+        min_gain_pct: float = 2.0,
+        min_comms_ms: float = 0.05,
+        loss_ema_alpha: float = 0.3,
+    ):
+        self.min_depth = int(min_depth)
+        self.max_depth = int(max_depth)
+        self.overlap_target_pct = float(overlap_target_pct)
+        self.loss_guard_pct = float(loss_guard_pct)
+        self.min_gain_pct = float(min_gain_pct)
+        self.min_comms_ms = float(min_comms_ms)
+        self.loss_ema_alpha = float(loss_ema_alpha)
+        # mutable bookkeeping (checkpointed via state_dict)
+        self.depth = self.min_depth
+        self.decisions = 0
+        self.widens = 0
+        self.narrows = 0
+        self._loss_ema: Optional[float] = None
+        self._best_loss_ema: Optional[float] = None
+        self._last_widen_overlap: Optional[float] = None  # overlap% at widen
+
+    # ------------------------------------------------------------ state
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "depth": self.depth,
+            "decisions": self.decisions,
+            "widens": self.widens,
+            "narrows": self.narrows,
+            "loss_ema": self._loss_ema,
+            "best_loss_ema": self._best_loss_ema,
+            "last_widen_overlap": self._last_widen_overlap,
+        }
+
+    def load_state_dict(self, state: Optional[Dict[str, Any]]) -> None:
+        """Restore from checkpoint meta; ``None``/partial state (an
+        older checkpoint) resets the affected fields to safe defaults
+        instead of raising — resume must never die on meta vintage."""
+        state = state or {}
+        self.depth = max(self.min_depth, min(
+            self.max_depth, int(state.get("depth", self.min_depth))))
+        self.decisions = int(state.get("decisions", 0))
+        self.widens = int(state.get("widens", 0))
+        self.narrows = int(state.get("narrows", 0))
+        self._loss_ema = state.get("loss_ema")
+        self._best_loss_ema = state.get("best_loss_ema")
+        self._last_widen_overlap = state.get("last_widen_overlap")
+
+    # --------------------------------------------------------- decision
+
+    def observe_loss(self, loss: float) -> None:
+        """Feed one loss sample (any cadence); keeps an EMA plus the
+        best EMA seen, the loss-guard baseline."""
+        loss = float(loss)
+        if loss != loss or loss in (float("inf"), float("-inf")):
+            return  # NaN/inf is the divergence watchdog's business
+        a = self.loss_ema_alpha
+        self._loss_ema = (loss if self._loss_ema is None
+                          else a * loss + (1 - a) * self._loss_ema)
+        if (self._best_loss_ema is None
+                or self._loss_ema < self._best_loss_ema):
+            self._best_loss_ema = self._loss_ema
+
+    def _clamp(self, d: int) -> int:
+        return max(self.min_depth, min(self.max_depth, d))
+
+    def propose(
+        self,
+        overlap_pct: float,
+        pull_ms: float = 0.0,
+        train_ms: float = 0.0,
+        push_ms: float = 0.0,
+        slo_breached: bool = False,
+    ) -> Decision:
+        """One decision from pod-level inputs. Every rank must call
+        this with identical inputs (the stats are already pod-visible
+        or allgathered) so the proposals agree; the caller still runs
+        the agreement collective as a belt-and-braces rendezvous."""
+        observed = {
+            "overlap_pct": round(float(overlap_pct), 2),
+            "pull_ms": round(float(pull_ms), 3),
+            "train_ms": round(float(train_ms), 3),
+            "push_ms": round(float(push_ms), 3),
+            "loss_ema": self._loss_ema,
+            "best_loss_ema": self._best_loss_ema,
+            "slo_breached": bool(slo_breached),
+        }
+        cur = self.depth
+        widened_last = self._last_widen_overlap is not None
+
+        # a guard firing at min depth still pins the decision to hold:
+        # widening while an SLO burns (or loss regresses) would trade
+        # more staleness into an already-degraded run
+        if slo_breached:
+            dec = Decision(
+                NARROW if cur > self.min_depth else HOLD,
+                self._clamp(cur - 1) if cur > self.min_depth else cur,
+                "slo_backoff", observed)
+        elif self._loss_regressed():
+            dec = Decision(
+                NARROW if cur > self.min_depth else HOLD,
+                self._clamp(cur - 1) if cur > self.min_depth else cur,
+                "loss_guard", observed)
+        elif overlap_pct >= self.overlap_target_pct:
+            dec = Decision(HOLD, cur, "target_met", observed)
+        elif (widened_last
+              and overlap_pct - self._last_widen_overlap < self.min_gain_pct
+              and cur > self.min_depth):
+            dec = Decision(NARROW, self._clamp(cur - 1), "no_gain", observed)
+        elif (cur < self.max_depth
+              and (pull_ms + push_ms) >= self.min_comms_ms):
+            dec = Decision(WIDEN, self._clamp(cur + 1), "overlap_low",
+                           observed)
+        else:
+            dec = Decision(HOLD, cur, "steady", observed)
+
+        # bookkeeping for the next decision
+        self.decisions += 1
+        if dec.action == WIDEN:
+            self.widens += 1
+            self._last_widen_overlap = float(overlap_pct)
+        else:
+            if dec.action == NARROW:
+                self.narrows += 1
+            self._last_widen_overlap = None
+        self.depth = dec.depth
+        return dec
+
+    def _loss_regressed(self) -> bool:
+        if self._loss_ema is None or self._best_loss_ema is None:
+            return False
+        if self._best_loss_ema <= 0.0:
+            return False  # loss scale degenerate: relative guard undefined
+        return (self._loss_ema - self._best_loss_ema) / self._best_loss_ema \
+            * 100.0 > self.loss_guard_pct
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "depth": self.depth,
+            "decisions": self.decisions,
+            "widens": self.widens,
+            "narrows": self.narrows,
+            "loss_ema": self._loss_ema,
+        }

@@ -66,6 +66,16 @@ class FlightRecorder:
         with self._lock:
             return list(self._events)
 
+    def stats(self) -> Dict[str, Any]:
+        """Ring occupancy for /metrics: how full the crash ring is and
+        how many events it has absorbed over the process lifetime."""
+        with self._lock:
+            return {
+                "flight_occupancy": len(self._events),
+                "flight_capacity": self._events.maxlen or 0,
+                "flight_recorded_events": self._seq,
+            }
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()

@@ -33,6 +33,8 @@ __all__ = [
     "registry",
     "render_prometheus",
     "merge_prometheus",
+    "register_histogram",
+    "unregister_histogram",
     "CONTENT_TYPE",
 ]
 
@@ -94,12 +96,18 @@ class MetricsRegistry:
         from multiverso_tpu.resilience.watchdog import fd_stats
         from multiverso_tpu.utils.dashboard import Dashboard
 
+        from multiverso_tpu.obs import flight as _flight
+        from multiverso_tpu.obs import tracer as _tracer
+
         fams: Dict[str, Dict[str, Any]] = {
             # always present, registered section or not: the operator's
             # scrape must see these families from the first request
             "failure_domain": fd_stats.to_dict(),
             "resilience": rstats.to_dict(),
             "core": Dashboard.core_metrics(),
+            # the observability stack watches itself: ring drop counts
+            # (is the trace lying?) and crash-ring occupancy
+            "obs": {**_tracer.ring_stats(), **_flight.recorder.stats()},
         }
         for section, snap in Dashboard.snapshots().items():
             fam = _family_of(section)
@@ -151,6 +159,76 @@ class MetricsRegistry:
 registry = MetricsRegistry()
 
 
+# ----------------------------------------------- histogram providers
+#
+# Prometheus histograms cannot ride the gauge flattener: they are one
+# logical metric spread over ``_bucket{le=...}``/``_sum``/``_count``
+# sample families. Providers register here keyed by owner (idempotent,
+# so re-registering after a Dashboard.Reset() just works) and return a
+# list of sample dicts:
+#
+#   {"name": "mv_serving_latency_seconds",
+#    "labels": {"route": "lookup:emb"},          # optional
+#    "buckets": [(le_seconds, cumulative_count), ...],  # sorted by le
+#    "sum": total_seconds, "count": n}
+#
+# ``render_prometheus`` emits them after the gauges so burn-rate math
+# and external scrapers share the real distribution, not gauge p50/p99.
+
+_hist_lock = threading.Lock()
+_hist_providers: Dict[str, Callable[[], List[Dict[str, Any]]]] = {}
+
+
+def register_histogram(key: str,
+                       provider: Callable[[], List[Dict[str, Any]]]) -> None:
+    with _hist_lock:
+        _hist_providers[key] = provider
+
+
+def unregister_histogram(key: str) -> None:
+    with _hist_lock:
+        _hist_providers.pop(key, None)
+
+
+def _label_str(labels: Dict[str, Any]) -> str:
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace('"', r"\"")
+        parts.append(f'{_sanitize(str(k))}="{v}"')
+    return ",".join(parts)
+
+
+def _render_histograms(lines: List[str], seen: set) -> None:
+    with _hist_lock:
+        providers = list(_hist_providers.items())
+    for key, provider in providers:
+        try:
+            samples = provider() or []
+        except Exception as e:  # noqa: BLE001 — one broken provider must
+            # not 500 the whole scrape
+            Log.Error("histogram provider %s failed: %s", key, e)
+            continue
+        for s in samples:
+            name = _sanitize(str(s.get("name") or ""))
+            if not name:
+                continue
+            base = dict(s.get("labels") or {})
+            if name not in seen:
+                seen.add(name)
+                lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for le, c in s.get("buckets") or []:
+                cum = c
+                lbl = _label_str({**base, "le": _fmt(float(le))})
+                lines.append(f"{name}_bucket{{{lbl}}} {int(c)}")
+            count = int(s.get("count") or cum)
+            inf_lbl = _label_str({**base, "le": "+Inf"})
+            lines.append(f"{name}_bucket{{{inf_lbl}}} {count}")
+            suffix = f"{{{_label_str(base)}}}" if base else ""
+            lines.append(f"{name}_sum{suffix} {repr(float(s.get('sum') or 0.0))}")
+            lines.append(f"{name}_count{suffix} {count}")
+
+
 def _fmt(v: float) -> str:
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
@@ -183,6 +261,7 @@ def render_prometheus(reg: Optional[MetricsRegistry] = None) -> str:
         seen.add(metric)
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {repr(obs['rates'][k])}")
+    _render_histograms(lines, seen)
     lines.append(f"mv_scrape_interval_s {repr(obs['interval_s'])}")
     return "\n".join(lines) + "\n"
 

@@ -1,0 +1,528 @@
+"""Declarative SLO rules with multi-window burn-rate evaluation.
+
+A rule names one metric from the ``observe()`` flat view (or a
+bad/total counter pair) and an objective. Evaluation follows the
+multi-window burn-rate recipe: the rule breaches only when BOTH a fast
+window (detects the current spike) and a slow window (proves it is
+sustained, not a scrape blip) burn faster than the threshold. Recovery
+is flap-suppressed: a breached rule needs ``clear_after`` consecutive
+healthy evaluations before it clears, so a metric oscillating around
+the objective cannot strobe /healthz.
+
+Verdict plumbing on a breach transition:
+
+* a ``slo_breach`` flight-recorder event (rule, burn rates, value) —
+  the crash dump shows *which objective* was burning before a breaker
+  or watchdog verdict landed;
+* ``http_health.set_degraded(rule, detail)`` — /healthz flips to
+  ``degraded`` with the rule named, while /livez stays 200 (an SLO
+  burn is a traffic signal, not a liveness signal).
+
+The clear transition mirrors both (``slo_clear`` + ``clear_degraded``).
+
+``StragglerDetector`` lives here too: it consumes the per-rank round
+timers that ``_ps_round_meta`` piggybacks on its allgather and flags a
+rank whose train/push time drifts more than ``k`` sigma above the pod
+median — the precursor signal heartbeat watchdogs cannot see because
+the slow rank is still alive and beating.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from multiverso_tpu.obs import flight
+from multiverso_tpu.obs import timeseries as ts_mod
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_double
+
+__all__ = [
+    "SLORule",
+    "RuleState",
+    "SLOEngine",
+    "StragglerDetector",
+    "PeriodicEvaluator",
+    "default_rules",
+    "engine",
+    "maybe_start_from_flags",
+]
+
+MV_DEFINE_double(
+    "slo_eval_interval_s", 0.0,
+    "arm the in-process SLO engine: scrape observe() into the "
+    "time-series ring and evaluate the burn-rate rules every this many "
+    "seconds on a daemon thread (serving replicas and the training "
+    "entry points honor it; 0 = off). Breaches emit slo_breach flight "
+    "events and flip /healthz to degraded until the rule clears",
+)
+
+_EPS = 1e-12
+
+
+@dataclass(frozen=True)
+class SLORule:
+    """One objective over one metric.
+
+    kind:
+      * ``gauge`` — window mean of an instantaneous value (p99 ms,
+        overlap %, checkpoint age);
+      * ``rate``  — delta of a monotonic counter / window span
+        (events per second, e.g. tracer drops);
+      * ``ratio`` — Δ``metric`` / Δ``total`` over the window (error
+        fraction of served requests).
+
+    comparison ``">"`` means "value above objective is bad" (latency,
+    shed rate); ``"<"`` means "value below objective is bad"
+    (availability, overlap%). Burn rate is normalised so 1.0 always
+    means "exactly at objective" and larger is worse.
+    """
+
+    name: str
+    metric: str
+    objective: float
+    kind: str = "gauge"              # gauge | rate | ratio
+    comparison: str = ">"            # ">" bad-above, "<" bad-below
+    total: Optional[str] = None      # denominator counter for ratio
+    fast_window_s: float = 30.0
+    slow_window_s: float = 300.0
+    burn_threshold: float = 1.0
+    clear_after: int = 3             # healthy evals before clearing
+    min_points: int = 2              # scrapes required per window
+    severity: str = "warn"
+
+    def _value(self, store: "ts_mod.TimeSeriesStore", window_s: float
+               ) -> Optional[float]:
+        if self.kind == "ratio":
+            if not self.total:
+                return None
+            return store.ratio_rate(self.metric, self.total, window_s)
+        w = store.window(self.metric, window_s)
+        if w.count < self.min_points:
+            return None
+        if self.kind == "rate":
+            return w.delta_rate()
+        return w.mean
+
+    def burn(self, store: "ts_mod.TimeSeriesStore", window_s: float
+             ) -> Optional[float]:
+        """Normalised burn rate over one window, or None when the
+        window has too little data to judge (counts as healthy)."""
+        value = self._value(store, window_s)
+        if value is None:
+            return None
+        if self.comparison == ">":
+            if self.objective <= _EPS:
+                return float("inf") if value > _EPS else 0.0
+            return value / self.objective
+        # "<": bad when value drops below objective
+        return self.objective / max(value, _EPS)
+
+
+@dataclass
+class RuleState:
+    breached: bool = False
+    healthy_streak: int = 0
+    breach_count: int = 0
+    clear_count: int = 0
+    last_burn_fast: Optional[float] = None
+    last_burn_slow: Optional[float] = None
+    last_value: Optional[float] = None
+
+
+class SLOEngine:
+    """Evaluates a rule set against a TimeSeriesStore.
+
+    ``health_hook(rule_name, detail_or_None)`` is called on
+    breach (detail string) and clear (None); the default hook wires
+    ``serving.http_health.set_degraded``/``clear_degraded`` lazily so
+    importing obs never drags the HTTP stack in.
+    """
+
+    def __init__(
+        self,
+        rules: Optional[Sequence[SLORule]] = None,
+        store: Optional["ts_mod.TimeSeriesStore"] = None,
+        recorder: Optional["flight.FlightRecorder"] = None,
+        health_hook: Optional[Callable[[str, Optional[str]], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._rules: List[SLORule] = list(rules or [])
+        self._store = store
+        self._recorder = recorder
+        self._health_hook = health_hook
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._states: Dict[str, RuleState] = {}
+        self._evals = 0
+
+    # --------------------------------------------------------- plumbing
+
+    def _get_store(self) -> "ts_mod.TimeSeriesStore":
+        return self._store if self._store is not None else ts_mod.store
+
+    def _get_recorder(self) -> "flight.FlightRecorder":
+        return self._recorder if self._recorder is not None else flight.recorder
+
+    def _health(self, rule_name: str, detail: Optional[str]) -> None:
+        hook = self._health_hook
+        if hook is None:
+            try:
+                from multiverso_tpu.serving import http_health
+
+                def hook(name: str, d: Optional[str]) -> None:
+                    if d is None:
+                        http_health.clear_degraded(f"slo:{name}")
+                    else:
+                        http_health.set_degraded(f"slo:{name}", d)
+            except Exception:
+                return
+        try:
+            hook(rule_name, detail)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- API
+
+    @property
+    def rules(self) -> List[SLORule]:
+        return list(self._rules)
+
+    def add_rule(self, rule: SLORule) -> None:
+        with self._lock:
+            self._rules = [r for r in self._rules if r.name != rule.name]
+            self._rules.append(rule)
+
+    def state(self, name: str) -> RuleState:
+        with self._lock:
+            return self._states.setdefault(name, RuleState())
+
+    def breached_rules(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, s in self._states.items() if s.breached)
+
+    def evaluate(self, ingest: bool = False) -> Dict[str, Any]:
+        """One evaluation pass. ``ingest=True`` scrapes the registry
+        into the store first (the common in-loop shape: one call does
+        scrape + verdicts). Returns a summary dict for logging/tests."""
+        store = self._get_store()
+        if ingest:
+            store.ingest()
+        results: Dict[str, Any] = {}
+        with self._lock:
+            self._evals += 1
+            evals = self._evals
+            rules = list(self._rules)
+        for rule in rules:
+            results[rule.name] = self._eval_rule(rule, store)
+        return {
+            "evals": evals,
+            "breached": self.breached_rules(),
+            "rules": results,
+        }
+
+    def _eval_rule(self, rule: SLORule, store: "ts_mod.TimeSeriesStore"
+                   ) -> Dict[str, Any]:
+        burn_fast = rule.burn(store, rule.fast_window_s)
+        burn_slow = rule.burn(store, rule.slow_window_s)
+        burning = (
+            burn_fast is not None
+            and burn_slow is not None
+            and burn_fast >= rule.burn_threshold
+            and burn_slow >= rule.burn_threshold
+        )
+        value = rule._value(store, rule.fast_window_s)
+        st = self.state(rule.name)
+        fired = cleared = False
+        with self._lock:
+            st.last_burn_fast = burn_fast
+            st.last_burn_slow = burn_slow
+            st.last_value = value
+            if burning:
+                st.healthy_streak = 0
+                if not st.breached:
+                    st.breached = True
+                    st.breach_count += 1
+                    fired = True
+            else:
+                if st.breached:
+                    st.healthy_streak += 1
+                    if st.healthy_streak >= rule.clear_after:
+                        st.breached = False
+                        st.healthy_streak = 0
+                        st.clear_count += 1
+                        cleared = True
+        if fired:
+            self._get_recorder().record(
+                "slo_breach",
+                rule=rule.name,
+                metric=rule.metric,
+                value=value,
+                objective=rule.objective,
+                burn_fast=burn_fast,
+                burn_slow=burn_slow,
+                severity=rule.severity,
+            )
+            self._health(
+                rule.name,
+                f"{rule.metric}={value!r} objective={rule.objective}"
+                f" burn_fast={burn_fast:.3g} burn_slow={burn_slow:.3g}",
+            )
+        if cleared:
+            self._get_recorder().record("slo_clear", rule=rule.name)
+            self._health(rule.name, None)
+        return {
+            "breached": st.breached,
+            "fired": fired,
+            "cleared": cleared,
+            "burn_fast": burn_fast,
+            "burn_slow": burn_slow,
+            "value": value,
+        }
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "evals": self._evals,
+                "rules": len(self._rules),
+                "breached": sorted(
+                    n for n, s in self._states.items() if s.breached),
+                "breaches_total": sum(
+                    s.breach_count for s in self._states.values()),
+            }
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._states.clear()
+            self._evals = 0
+
+
+def default_rules(
+    availability_objective: float = 0.01,
+    p99_ms_objective: float = 250.0,
+    shed_rate_objective: float = 0.05,
+    overlap_pct_target: float = 30.0,
+    checkpoint_age_s_objective: float = 900.0,
+    trace_drop_rate_objective: float = 1.0,
+    fast_window_s: float = 30.0,
+    slow_window_s: float = 300.0,
+) -> List[SLORule]:
+    """The stock rule set over the metric names the registry publishes.
+
+    Rules over families a process does not run (e.g. serving metrics in
+    a pure-trainer process) simply never accumulate points and stay
+    healthy — one rule set serves every role.
+    """
+    common = dict(fast_window_s=fast_window_s, slow_window_s=slow_window_s)
+    return [
+        # Error fraction of served requests (serving_replica errors /
+        # served counters are monotonic totals).
+        SLORule(
+            name="availability",
+            metric="serving_replica:errors",
+            total="serving_replica:served",
+            objective=availability_objective,
+            kind="ratio",
+            severity="page",
+            **common,
+        ),
+        SLORule(
+            name="latency_p99",
+            metric="serving_replica:p99_ms_max",
+            objective=p99_ms_objective,
+            kind="gauge",
+            **common,
+        ),
+        SLORule(
+            name="shed_rate",
+            metric="serving_replica:shed",
+            total="serving_replica:served",
+            objective=shed_rate_objective,
+            kind="ratio",
+            **common,
+        ),
+        # PS overlap%: bad when it drops BELOW target (comms no longer
+        # hidden behind compute) — the depth controller's own SLO.
+        SLORule(
+            name="ps_overlap_pct",
+            metric="ps_comms:overlap_pct",
+            objective=overlap_pct_target,
+            comparison="<",
+            kind="gauge",
+            min_points=3,
+            **common,
+        ),
+        SLORule(
+            name="checkpoint_age",
+            metric="resilience:last_checkpoint_age_s",
+            objective=checkpoint_age_s_objective,
+            kind="gauge",
+            **common,
+        ),
+        # Tracer ring drops/sec: sustained drops mean the trace is lying.
+        SLORule(
+            name="trace_drop_rate",
+            metric="obs:tracer_dropped_events",
+            objective=trace_drop_rate_objective,
+            kind="rate",
+            **common,
+        ),
+    ]
+
+
+class StragglerDetector:
+    """Flags ranks whose round timers drift above the pod median.
+
+    Fed one matrix per pipelined round: ``timers_us[rank] = train+push
+    microseconds`` (gathered by ``_ps_round_meta``'s allgather). A rank
+    is a straggler when its timer exceeds ``median + k * sigma`` (sigma
+    estimated from the median absolute deviation, robust to the
+    straggler itself) on ``confirm_rounds`` consecutive feeds. Each
+    confirmation emits one ``straggler`` flight event and notifies
+    fd_stats; re-arming requires the rank to fall back under the bar.
+    """
+
+    def __init__(
+        self,
+        k_sigma: float = 3.0,
+        confirm_rounds: int = 3,
+        min_ranks: int = 3,
+        min_spread_us: float = 1000.0,
+        recorder: Optional["flight.FlightRecorder"] = None,
+        fd_hook: Optional[Callable[[int, float, float], None]] = None,
+    ):
+        self._k = float(k_sigma)
+        self._confirm = int(confirm_rounds)
+        self._min_ranks = int(min_ranks)
+        self._min_spread_us = float(min_spread_us)
+        self._recorder = recorder
+        self._fd_hook = fd_hook
+        self._lock = threading.Lock()
+        self._over: Dict[int, int] = {}      # rank -> consecutive-over count
+        self._flagged: Dict[int, bool] = {}  # rank -> currently flagged
+        self._events = 0
+
+    def _get_recorder(self) -> "flight.FlightRecorder":
+        return self._recorder if self._recorder is not None else flight.recorder
+
+    def _notify_fd(self, rank: int, timer_us: float, median_us: float) -> None:
+        hook = self._fd_hook
+        if hook is None:
+            try:
+                from multiverso_tpu.resilience.watchdog import fd_stats
+
+                hook = lambda r, t, m: fd_stats.note_straggler(r, t, m)
+            except Exception:
+                return
+        try:
+            hook(rank, timer_us, median_us)
+        except Exception:
+            pass
+
+    @property
+    def events(self) -> int:
+        with self._lock:
+            return self._events
+
+    def flagged_ranks(self) -> List[int]:
+        with self._lock:
+            return sorted(r for r, f in self._flagged.items() if f)
+
+    def feed(self, timers_us: Sequence[float], round_idx: int = -1
+             ) -> List[int]:
+        """Consume one round's per-rank timers; returns ranks newly
+        CONFIRMED as stragglers this round (usually empty)."""
+        n = len(timers_us)
+        if n < self._min_ranks:
+            return []
+        vals = sorted(float(t) for t in timers_us)
+        median = vals[n // 2] if n % 2 else 0.5 * (vals[n // 2 - 1] + vals[n // 2])
+        # MAD-based sigma: robust against the straggler inflating the
+        # spread estimate it is judged by. 1.4826 ≈ normal consistency.
+        mad = sorted(abs(v - median) for v in vals)
+        mad_v = mad[n // 2] if n % 2 else 0.5 * (mad[n // 2 - 1] + mad[n // 2])
+        sigma = max(1.4826 * mad_v, self._min_spread_us / self._k)
+        bar = median + self._k * sigma
+        confirmed: List[int] = []
+        with self._lock:
+            for rank, t in enumerate(timers_us):
+                if float(t) > bar:
+                    self._over[rank] = self._over.get(rank, 0) + 1
+                    if (self._over[rank] >= self._confirm
+                            and not self._flagged.get(rank, False)):
+                        self._flagged[rank] = True
+                        self._events += 1
+                        confirmed.append(rank)
+                else:
+                    self._over[rank] = 0
+                    self._flagged[rank] = False
+        for rank in confirmed:
+            self._get_recorder().record(
+                "straggler",
+                rank=rank,
+                round=round_idx,
+                timer_us=float(timers_us[rank]),
+                median_us=median,
+                bar_us=bar,
+                k_sigma=self._k,
+            )
+            self._notify_fd(rank, float(timers_us[rank]), median)
+        return confirmed
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._over.clear()
+            self._flagged.clear()
+            self._events = 0
+
+
+# process-wide default engine (rules attached by the app/replica wiring)
+engine = SLOEngine()
+
+
+class PeriodicEvaluator:
+    """Daemon-thread loop: ``engine.evaluate(ingest=True)`` every
+    ``interval_s``. One per process is plenty — the engine and the
+    store are both process-wide singletons."""
+
+    def __init__(self, eng: Optional[SLOEngine] = None,
+                 interval_s: float = 5.0):
+        self._engine = eng if eng is not None else engine
+        self._interval_s = max(float(interval_s), 0.05)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PeriodicEvaluator":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="mv-slo-eval"
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self._engine.evaluate(ingest=True)
+            except Exception:  # noqa: BLE001 — a broken scrape must not
+                # kill the evaluator; the next tick may succeed
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=5)
+            self._thread = None
+
+
+def maybe_start_from_flags() -> Optional[PeriodicEvaluator]:
+    """Arm the default engine when ``-slo_eval_interval_s`` > 0; the
+    stock rules attach on first arm (explicitly-added rules win)."""
+    interval = float(GetFlag("slo_eval_interval_s"))
+    if interval <= 0.0:
+        return None
+    if not engine.rules:
+        for rule in default_rules():
+            engine.add_rule(rule)
+    return PeriodicEvaluator(engine, interval).start()

@@ -1,0 +1,168 @@
+"""Bounded in-process time series over ``MetricsRegistry.observe()``.
+
+One ``observe()`` call is a point-in-time cut; SLO burn rates, the
+straggler detector and the depth controller all need *windows* — "the
+shed rate over the last minute", "the mean overlap% since the last
+decision". This module keeps a bounded ring of scrapes per process and
+answers window queries over it:
+
+* ``ingest()`` appends one ``observe()`` collection (``flat`` numeric
+  view + wall stamp) to the ring — the same feed ``GET /metrics``
+  renders, so the SLO engine and an external scraper literally share
+  one representation;
+* ``window(key, seconds)`` aggregates a key over the trailing window
+  (count/first/last/min/max/mean);
+* ``delta_rate(key, seconds)`` is the counter view: (last - first) / dt
+  for monotonically-published totals, clamped at 0 so a process restart
+  (counter reset) reads as quiet, not as a negative burn.
+
+Injectable clock + registry keep every consumer fake-clock testable;
+capacity is bounded (oldest evicted) so a week-long replica cannot grow
+an unbounded scrape history.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["TimeSeriesStore", "WindowStats", "store"]
+
+
+@dataclass(frozen=True)
+class WindowStats:
+    """Aggregate of one key over a trailing window. ``count`` is the
+    number of scrapes that carried the key; everything else is 0-valued
+    when ``count`` is 0 (a missing family must read as quiet, never
+    throw out of an SLO evaluation)."""
+
+    count: int = 0
+    first: float = 0.0
+    last: float = 0.0
+    min: float = 0.0
+    max: float = 0.0
+    mean: float = 0.0
+    span_s: float = 0.0
+
+    def delta_rate(self) -> float:
+        """Counter view: (last - first) / span, floored at 0 (a counter
+        reset across a restart must not read as a negative rate)."""
+        if self.count < 2 or self.span_s <= 0.0:
+            return 0.0
+        return max(0.0, (self.last - self.first) / self.span_s)
+
+
+class TimeSeriesStore:
+    """Bounded ring of ``observe()`` flat views, one entry per scrape."""
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        clock: Callable[[], float] = time.monotonic,
+        registry=None,
+    ):
+        self._capacity = int(capacity)
+        self._clock = clock
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._points: deque = deque(maxlen=self._capacity)  # (t, flat)
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from multiverso_tpu.obs.metrics import registry
+
+        return registry
+
+    # ------------------------------------------------------------ write
+
+    def ingest(self, observation: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
+        """Append one scrape. ``observation`` defaults to a fresh
+        ``registry.observe()``; passing one in lets a caller that
+        already scraped (the /metrics handler, the depth controller)
+        share the collection instead of double-scraping."""
+        if observation is None:
+            observation = self._reg().observe()
+        flat = dict(observation.get("flat") or {})
+        with self._lock:
+            self._points.append((self._clock(), flat))
+        return observation
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._points.clear()
+
+    # ------------------------------------------------------------- read
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._points)
+
+    def keys(self) -> List[str]:
+        """Keys of the newest scrape (the live metric surface)."""
+        with self._lock:
+            if not self._points:
+                return []
+            return sorted(self._points[-1][1])
+
+    def latest(self, key: str) -> Optional[float]:
+        with self._lock:
+            for t, flat in reversed(self._points):
+                if key in flat:
+                    return float(flat[key])
+        return None
+
+    def series(self, key: str, window_s: Optional[float] = None
+               ) -> List[Tuple[float, float]]:
+        """``[(t, value), ...]`` oldest-first for one key, optionally
+        restricted to the trailing ``window_s`` seconds."""
+        cutoff = None if window_s is None else self._clock() - float(window_s)
+        out: List[Tuple[float, float]] = []
+        with self._lock:
+            for t, flat in self._points:
+                if cutoff is not None and t < cutoff:
+                    continue
+                if key in flat:
+                    out.append((t, float(flat[key])))
+        return out
+
+    def window(self, key: str, window_s: float) -> WindowStats:
+        pts = self.series(key, window_s)
+        if not pts:
+            return WindowStats()
+        vals = [v for _t, v in pts]
+        return WindowStats(
+            count=len(pts),
+            first=vals[0],
+            last=vals[-1],
+            min=min(vals),
+            max=max(vals),
+            mean=sum(vals) / len(vals),
+            span_s=max(0.0, pts[-1][0] - pts[0][0]),
+        )
+
+    def delta_rate(self, key: str, window_s: float) -> float:
+        return self.window(key, window_s).delta_rate()
+
+    def ratio_rate(self, bad_key: str, total_key: str, window_s: float
+                   ) -> Optional[float]:
+        """Bad-fraction of two counters over the window:
+        Δbad / Δtotal. ``None`` when the denominator did not move —
+        "no traffic" is indistinguishable from "all good" and an SLO
+        rule must not breach on it."""
+        bad = self.window(bad_key, window_s)
+        total = self.window(total_key, window_s)
+        dt = total.last - total.first
+        if total.count < 2 or dt <= 0.0:
+            return None
+        db = max(0.0, bad.last - bad.first) if bad.count >= 2 else 0.0
+        return min(1.0, db / dt)
+
+
+# process-wide default: the SLO engine, the depth controller and the
+# scrape --watch loop all read the same history
+store = TimeSeriesStore()

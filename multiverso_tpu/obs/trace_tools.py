@@ -24,6 +24,9 @@ __all__ = [
     "validate_trace",
     "span_counts",
     "resolve_inputs",
+    "request_index",
+    "request_tree",
+    "request_summary_lines",
 ]
 
 
@@ -102,6 +105,89 @@ def validate_trace(doc: Dict[str, Any]) -> List[str]:
                     f"event {i} ({ev.get('name')}) has bad dur {dur!r}"
                 )
     return problems
+
+
+# -------------------------------------------------- cross-process linker
+#
+# Request-scoped spans carry W3C-style ids in their args: ``trace_id``
+# (one per logical client request), ``span_id`` (this span), and
+# ``parent_id`` (the span one hop up — which lives in ANOTHER process
+# for the client-attempt -> serving-request edge). After ``merge_traces``
+# put every process on one timeline, these functions join the id graph
+# back into one tree per request: client.request -> client.attempt ->
+# serving.request -> serving.flush_item.
+
+
+def request_index(doc: Dict[str, Any]) -> Dict[str, List[dict]]:
+    """``trace_id -> events carrying it`` (spans and instants), each
+    sorted by ts. The merged doc's view of "which requests exist"."""
+    idx: Dict[str, List[dict]] = {}
+    for ev in doc.get("traceEvents", []):
+        args = ev.get("args") or {}
+        tid = args.get("trace_id")
+        if isinstance(tid, str) and tid:
+            idx.setdefault(tid, []).append(ev)
+    for evs in idx.values():
+        evs.sort(key=lambda e: e.get("ts", 0.0))
+    return idx
+
+
+def request_tree(doc: Dict[str, Any], trace_id: str
+                 ) -> Tuple[List[dict], List[dict]]:
+    """Link one request's events by span_id/parent_id into
+    ``(roots, orphans)`` — nodes are ``{"event", "children"}``; an
+    orphan names a parent whose span fell off a ring (or whose process
+    never dumped). Cross-process edges resolve naturally: the id graph
+    doesn't care which pid a span landed in."""
+    by_sid: Dict[str, dict] = {}
+    items: List[Tuple[dict, Any]] = []
+    for ev in request_index(doc).get(trace_id, []):
+        args = ev.get("args") or {}
+        node = {"event": ev, "children": []}
+        items.append((node, args.get("parent_id")))
+        sid = args.get("span_id")
+        if isinstance(sid, str) and sid:
+            by_sid[sid] = node
+    roots: List[dict] = []
+    orphans: List[dict] = []
+    for node, parent in items:
+        if parent and parent in by_sid:
+            by_sid[parent]["children"].append(node)
+        elif parent:
+            orphans.append(node)
+        else:
+            roots.append(node)
+    for node in by_sid.values():
+        node["children"].sort(key=lambda n: n["event"].get("ts", 0.0))
+    return roots, orphans
+
+
+def request_summary_lines(doc: Dict[str, Any], trace_id: str) -> List[str]:
+    """Human/ci-greppable rendering of one request tree: one line per
+    span, indented by depth, with pid (process) and duration."""
+    roots, orphans = request_tree(doc, trace_id)
+    lines: List[str] = [f"trace={trace_id}"]
+
+    def walk(node: dict, depth: int) -> None:
+        ev = node["event"]
+        dur = ev.get("dur")
+        dur_s = f" dur_us={dur:.1f}" if isinstance(dur, (int, float)) else ""
+        lines.append(
+            f"{'  ' * (depth + 1)}{ev.get('name')} pid={ev.get('pid')}"
+            f" ph={ev.get('ph')}{dur_s}"
+        )
+        for c in node["children"]:
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    for o in orphans:
+        ev = o["event"]
+        lines.append(
+            f"  (orphan) {ev.get('name')} pid={ev.get('pid')} "
+            f"missing_parent={(ev.get('args') or {}).get('parent_id')}"
+        )
+    return lines
 
 
 def span_counts(doc: Dict[str, Any]) -> Dict[Tuple[int, str], int]:

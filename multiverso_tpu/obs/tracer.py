@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -58,6 +59,14 @@ __all__ = [
     "dump",
     "maybe_dump_from_flags",
     "reset_for_tests",
+    "new_trace_id",
+    "new_span_id",
+    "mint_traceparent",
+    "parse_traceparent",
+    "set_trace_context",
+    "get_trace_context",
+    "clear_trace_context",
+    "ring_stats",
 ]
 
 MV_DEFINE_string(
@@ -213,6 +222,64 @@ def event(name: str, **args: Any) -> None:
         _ring().record("i", time.monotonic_ns(), name, args or None)
 
 
+# ---------------------------------------------------------- trace context
+#
+# W3C-style request context: the ServingClient mints one trace_id per
+# request and one span_id per attempt, ships them as a ``traceparent``
+# header, and the data plane parks them in a thread-local so the batcher
+# ticket (submitted synchronously on the handler thread) can capture
+# them. Spans carry trace_id/span_id/parent_id in their args; the merge
+# tool's linker joins client-side and replica-side spans into one tree.
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def mint_traceparent(trace_id: str, span_id: str) -> str:
+    """``00-<trace_id>-<span_id>-01`` (version 00, sampled flag set)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str]]:
+    """``(trace_id, parent_span_id)`` from a traceparent header, or
+    ``None`` on anything malformed — a bad header must degrade to "no
+    trace", never to a 4xx."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    trace_id, span_id = m.group(2), m.group(3)
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # the spec's all-zero ids are invalid
+    return trace_id, span_id
+
+
+def set_trace_context(trace_id: str, span_id: str) -> None:
+    """Park the active request's ids on this thread (the data-plane
+    handler thread) so synchronous downstream code — the batcher's
+    ``submit`` — can stamp its ticket without plumbing arguments
+    through every layer."""
+    _tls.trace_ctx = (trace_id, span_id)
+
+
+def get_trace_context() -> Optional[Tuple[str, str]]:
+    return getattr(_tls, "trace_ctx", None)
+
+
+def clear_trace_context() -> None:
+    _tls.trace_ctx = None
+
+
 # ----------------------------------------------------------------- anchor
 
 _anchor: Dict[str, Any] = {
@@ -298,12 +365,42 @@ def _pair_ring(ring_events: List[tuple]) -> Tuple[List[dict], int]:
 
 
 def _infer_rank() -> int:
+    # MV_TRACE_RANK wins: serving replicas and fleet clients share no
+    # jax.process_index() space, and same-host processes would all dump
+    # as rank 0 (pid collision in the merged trace) without an explicit
+    # per-process assignment from the fleet launcher.
+    env = os.environ.get("MV_TRACE_RANK")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
     try:
         import jax
 
         return int(jax.process_index())
     except Exception:  # noqa: BLE001 — tracer must work without a backend
         return 0
+
+
+def ring_stats() -> Dict[str, Any]:
+    """Occupancy/drop counters across every ring — the /metrics view of
+    "is the trace lying". Cheap: no pairing, no copies beyond the
+    registry list."""
+    with _registry_lock:
+        rings = list(_registry)
+    recorded = sum(r.idx for r in rings)
+    dropped = sum(max(0, r.idx - r.cap) for r in rings)
+    occupancy = sum(min(r.idx, r.cap) for r in rings)
+    capacity = sum(r.cap for r in rings)
+    return {
+        "tracer_rings": len(rings),
+        "tracer_recorded_events": recorded,
+        "tracer_dropped_events": dropped,
+        "tracer_ring_occupancy": occupancy,
+        "tracer_ring_capacity": capacity,
+        "tracer_enabled": tracing_enabled(),
+    }
 
 
 def dump(path: Optional[str] = None, rank: Optional[int] = None) -> Dict:

@@ -425,6 +425,8 @@ class _FailureDomainStats:
         self.quorum_commits = 0
         self.quorum_aborts = 0
         self.rank_failures = 0
+        self.stragglers = 0
+        self.last_straggler_rank: Optional[int] = None
         self.last_failure_kind: Optional[str] = None
         self._ages_fn: Optional[Callable[[], Dict[int, float]]] = None
         # alive-vs-ready (ISSUE 7): liveness is the process existing;
@@ -504,6 +506,17 @@ class _FailureDomainStats:
         recorder.record("quorum_abort")
         self._register()
 
+    def note_straggler(self, rank: int, timer_us: float = 0.0,
+                       median_us: float = 0.0) -> None:
+        """A rank confirmed drifting >k-sigma above the pod-median round
+        timer (obs.slo.StragglerDetector) — alive and beating, so the
+        heartbeat watchdog cannot see it; this counter is the precursor
+        signal an operator pages on before it becomes a rank failure."""
+        with self._lock:
+            self.stragglers += 1
+            self.last_straggler_rank = int(rank)
+        self._register()
+
     def note_rank_failure(self, kind: str) -> None:
         with self._lock:
             self.rank_failures += 1
@@ -553,6 +566,8 @@ class _FailureDomainStats:
                 "quorum_commits": self.quorum_commits,
                 "quorum_aborts": self.quorum_aborts,
                 "rank_failures": self.rank_failures,
+                "stragglers": self.stragglers,
+                "last_straggler_rank": self.last_straggler_rank,
                 "last_failure_kind": self.last_failure_kind,
                 "heartbeat_ages_s": {str(k): v for k, v in ages.items()},
             }

@@ -72,6 +72,10 @@ class Request:
     payload: Any
     future: "Future" = field(default_factory=Future)
     enqueue_t: float = field(default_factory=time.monotonic)
+    # (trace_id, server_span_id) captured from the submitting thread's
+    # trace context — how a client request's trace_id survives the hop
+    # from the handler thread onto the flusher thread's flush span
+    trace: Optional[tuple] = None
 
 
 class DynamicBatcher:
@@ -150,6 +154,8 @@ class DynamicBatcher:
             self.metrics.record_shed()
             raise Overloaded(self._retry_after())
         req = Request(route=route, payload=payload)
+        if _tracer.tracing_enabled():
+            req.trace = _tracer.get_trace_context()
         self._slots[ticket] = req
         with self._depth_lock:
             self._depth += 1
@@ -263,12 +269,23 @@ class DynamicBatcher:
             self._depth -= len(reqs)
             self.metrics.set_queue_depth(self._depth)
         payloads = [r.payload for r in reqs]
+        traced = [r for r in reqs if r.trace]
+        flush_args: Dict[str, Any] = {"route": route, "size": len(reqs)}
+        if traced:
+            # the flush serves many requests: the span lists every
+            # trace_id it carried, and each traced request gets one
+            # instant event parent-linked under its server span so the
+            # request tree reaches all the way into the batch
+            flush_args["trace_ids"] = sorted({r.trace[0] for r in traced})
         try:
             # obs: one span per micro-batch flush — the serving twin of
             # the PS round spans (fill ratio + route ride in args)
-            with _tracer.span(
-                "serving.flush", route=route, size=len(reqs)
-            ):
+            with _tracer.span("serving.flush", **flush_args):
+                for r in traced:
+                    _tracer.event(
+                        "serving.flush_item", route=route,
+                        trace_id=r.trace[0], parent_id=r.trace[1],
+                    )
                 results = self._flush_fn(route, payloads)
             CHECK(
                 len(results) == len(payloads),

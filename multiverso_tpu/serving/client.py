@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from multiverso_tpu.obs import tracer
 from multiverso_tpu.resilience.chaos import FullJitterBackoff
 from multiverso_tpu.utils.log import CHECK
 
@@ -111,11 +112,14 @@ class ServingClient:
     # ------------------------------------------------------------ transport
 
     def _post_once(self, endpoint: str, route: str, body: Dict[str, Any],
-                   timeout_s: float) -> Dict[str, Any]:
+                   timeout_s: float,
+                   traceparent: Optional[str] = None) -> Dict[str, Any]:
         data = json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            headers["traceparent"] = traceparent
         req = urllib.request.Request(
-            f"{endpoint}{route}", data=data,
-            headers={"Content-Type": "application/json"}, method="POST",
+            f"{endpoint}{route}", data=data, headers=headers, method="POST",
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
@@ -150,6 +154,21 @@ class ServingClient:
         self._bump("requests")
         body = dict(body)
         body.setdefault("tenant", self.tenant)
+        # one trace per logical request, one span per attempt; the
+        # attempt's span_id rides the traceparent header so the replica
+        # parents its server span under the attempt that reached it
+        trace_id = tracer.new_trace_id()
+        root_sid = tracer.new_span_id()
+        with tracer.span(
+            "client.request", route=route,
+            trace_id=trace_id, span_id=root_sid,
+        ):
+            return self._call_attempts(
+                route, body, trace_id, root_sid
+            )
+
+    def _call_attempts(self, route: str, body: Dict[str, Any],
+                       trace_id: str, root_sid: str) -> Dict[str, Any]:
         deadline = self._clock() + self.deadline_s
         start = self._next_start()
         last: Optional[BaseException] = None
@@ -159,8 +178,17 @@ class ServingClient:
                 break
             endpoint = self.endpoints[(start + attempt) % len(self.endpoints)]
             body["deadline_ms"] = max(remaining * 1e3, 1.0)
+            attempt_sid = tracer.new_span_id()
+            header = tracer.mint_traceparent(trace_id, attempt_sid)
             try:
-                out = self._post_once(endpoint, route, body, remaining)
+                with tracer.span(
+                    "client.attempt", route=route, endpoint=endpoint,
+                    attempt=attempt, trace_id=trace_id,
+                    span_id=attempt_sid, parent_id=root_sid,
+                ):
+                    out = self._post_once(
+                        endpoint, route, body, remaining, traceparent=header
+                    )
                 self._bump("ok")
                 return out
             except _Shed as e:
@@ -170,6 +198,10 @@ class ServingClient:
             except _EndpointDown as e:
                 last = e
                 self._bump("failovers")
+                tracer.event(
+                    "client.failover", route=route, endpoint=endpoint,
+                    attempt=attempt, trace_id=trace_id, parent_id=root_sid,
+                )
                 pause = min(
                     self._backoff.next_delay(attempt),
                     deadline - self._clock(),

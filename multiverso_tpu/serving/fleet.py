@@ -134,6 +134,11 @@ class ServingFleet:
         # race-report-rank<i>.json so co-hosted dumps never collide
         # (overrides any inherited MV_RANK — that one names the parent)
         env["MV_RANK"] = str(index)
+        # trace lane: co-hosted replicas would all dump trace-rank0.json
+        # without an explicit assignment (no jax.process_index() here).
+        # 1+index leaves lane 0 for the client/driver process; override
+        # any inherited value — that one names the parent.
+        env["MV_TRACE_RANK"] = str(1 + index)
         log_path = os.path.join(self.log_dir, f"replica-{index}.log")
         logf = open(log_path, "a")
         # own session: SIGTERM/SIGKILL reach the whole replica group

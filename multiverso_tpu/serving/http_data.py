@@ -50,6 +50,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from multiverso_tpu.obs import tracer
 from multiverso_tpu.serving import http_health
 from multiverso_tpu.serving.batcher import Overloaded
 from multiverso_tpu.serving.server import RouteUnavailable
@@ -164,6 +165,40 @@ class DataPlaneServer:
         except (TypeError, ValueError):
             return 400, {"error": "deadline_ms must be a number"}, None
 
+        # W3C trace context: the client's attempt span_id arrives in the
+        # traceparent header; our server span parents under it, and the
+        # thread-local context lets the batcher stamp the ticket (submit
+        # happens synchronously on this handler thread). A malformed
+        # header degrades to "no trace", never to a 4xx.
+        ctx = tracer.parse_traceparent(handler.headers.get("traceparent"))
+        if ctx is not None:
+            trace_id, parent_sid = ctx
+            server_sid = tracer.new_span_id()
+            tracer.set_trace_context(trace_id, server_sid)
+            try:
+                with tracer.span(
+                    "serving.request", route=route, tenant=tenant,
+                    trace_id=trace_id, span_id=server_sid,
+                    parent_id=parent_sid,
+                ):
+                    code, payload, retry_after = self._dispatch(
+                        route, body, tenant, deadline_s
+                    )
+            finally:
+                tracer.clear_trace_context()
+        else:
+            code, payload, retry_after = self._dispatch(
+                route, body, tenant, deadline_s
+            )
+        if code >= 500:
+            # availability SLO numerator: server faults, not sheds/4xx
+            self.table_server.metrics.record_error()
+        return code, payload, retry_after
+
+    def _dispatch(
+        self, route: str, body: Dict[str, Any], tenant: str,
+        deadline_s: float,
+    ) -> Tuple[int, Dict[str, Any], Optional[float]]:
         srv = self.table_server
         try:
             if route == "/v1/lookup":

@@ -47,11 +47,14 @@ from multiverso_tpu.utils.log import Log
 __all__ = [
     "HealthServer",
     "bound_ports",
+    "clear_degraded",
+    "degraded_reasons",
     "flag_port",
     "handle_health_get",
     "health_payload",
     "maybe_start_from_flags",
     "register_bound_port",
+    "set_degraded",
     "set_ready",
     "set_serving_ready",
     "readiness",
@@ -149,6 +152,32 @@ def readiness() -> Dict[str, Any]:
     with _ready_lock:
         return dict(_ready_state)
 
+
+# ----------------------------------------------------- degraded reasons
+# Keyed degraded verdicts from watchers that are not a breaker and not a
+# rank failure — today the SLO engine (`slo:<rule>` keys). While any
+# reason is set, /healthz answers "degraded" with the reasons listed;
+# /livez and /readyz are untouched (an SLO burn is a traffic signal,
+# not a liveness signal).
+
+_degraded_lock = threading.Lock()
+_degraded_reasons: Dict[str, str] = {}
+
+
+def set_degraded(key: str, detail: str = "") -> None:
+    with _degraded_lock:
+        _degraded_reasons[str(key)] = str(detail)
+
+
+def clear_degraded(key: str) -> None:
+    with _degraded_lock:
+        _degraded_reasons.pop(str(key), None)
+
+
+def degraded_reasons() -> Dict[str, str]:
+    with _degraded_lock:
+        return dict(_degraded_reasons)
+
 MV_DEFINE_int(
     "health_port", 0,
     "serve GET /healthz (TableServer.health() + resilience + "
@@ -179,9 +208,10 @@ def health_payload(server=None) -> Dict[str, Any]:
     if server is not None:
         serving = server.health()
     fd = fd_stats.to_dict()
+    reasons = degraded_reasons()
     degraded = bool(serving and serving.get("breakers_open")) or (
         fd["rank_failures"] > 0
-    )
+    ) or bool(reasons)
     ready = readiness()
     return {
         "status": "degraded" if degraded else "ok",
@@ -189,6 +219,7 @@ def health_payload(server=None) -> Dict[str, Any]:
         "ready": ready["ready"],
         "phase": ready["phase"],
         "ports": bound_ports(),  # ephemeral-port discovery (see above)
+        "degraded_reasons": reasons,
         "serving": serving,
         "resilience": rstats.to_dict(),
         "failure_domain": fd,

@@ -81,6 +81,25 @@ class LatencyHistogram:
         with self._lock:
             return self.total_s / self.count if self.count else 0.0
 
+    def buckets(self) -> List[tuple]:
+        """Cumulative ``(le_seconds, count)`` pairs for Prometheus
+        exposition, trimmed to the populated prefix (+1 empty bucket so
+        the first boundary above the data is explicit; ``+Inf`` is the
+        renderer's job). Upper edge of bucket b is _LO * RATIO^(b+1)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self.count
+        if total == 0:
+            return []
+        last = max(b for b, c in enumerate(counts) if c)
+        hi = min(last + 1, self._NBUCKETS - 1)
+        out: List[tuple] = []
+        cum = 0
+        for b in range(hi + 1):
+            cum += counts[b]
+            out.append((self._LO * (self._RATIO ** (b + 1)), cum))
+        return out
+
 
 class ServingMetrics:
     """Per-server metrics bundle; one instance per TableServer/batcher.
@@ -96,6 +115,7 @@ class ServingMetrics:
         self.route_latency: Dict[str, LatencyHistogram] = {}
         self.served = 0
         self.shed = 0
+        self.errors = 0  # 5xx responses; availability = errors/served
         self.batches = 0
         self.batch_fill_sum = 0.0  # sum of per-batch size/max_batch
         self.queue_depth = 0
@@ -133,6 +153,12 @@ class ServingMetrics:
     def record_shed(self, n: int = 1) -> None:
         with self._lock:
             self.shed += n
+
+    def record_error(self, n: int = 1) -> None:
+        """Count a server-fault response (5xx) — the numerator of the
+        availability SLO. Sheds are deliberate and counted separately."""
+        with self._lock:
+            self.errors += n
 
     def record_swap(self) -> None:
         with self._lock:
@@ -186,6 +212,7 @@ class ServingMetrics:
             snap = {
                 "served": self.served,
                 "shed": self.shed,
+                "errors": self.errors,
                 "batches": batches,
                 "batch_fill": round(fill, 4),
                 "queue_depth": self.queue_depth,
@@ -195,11 +222,17 @@ class ServingMetrics:
             routes = sorted(self.route_latency.items())
         out: Dict[str, object] = dict(snap)
         out["qps"] = round(self.qps(), 1)
+        p99_max = 0.0
         for route, hist in routes:
+            p99 = round(hist.percentile(99) * 1e3, 4)
+            p99_max = max(p99_max, p99)
             out[f"{route}_p50_ms"] = round(hist.percentile(50) * 1e3, 4)
-            out[f"{route}_p99_ms"] = round(hist.percentile(99) * 1e3, 4)
+            out[f"{route}_p99_ms"] = p99
             out[f"{route}_mean_ms"] = round(hist.mean_s * 1e3, 4)
             out[f"{route}_count"] = hist.count
+        # route-agnostic worst-case p99: the latency SLO rule's input
+        # (route names embed table names, which an SLO rule can't know)
+        out["p99_ms_max"] = p99_max
         return out
 
     def info_lines(self) -> List[str]:
@@ -223,15 +256,39 @@ class ServingMetrics:
     def _section_key(self) -> str:
         return f"serving.{self.name}.{id(self)}"
 
+    def histogram_samples(self) -> List[Dict[str, object]]:
+        """Per-route latency distributions in the obs.metrics histogram
+        provider shape — real ``_bucket/_sum/_count`` exposition instead
+        of (next to) the gauge p50/p99, so external burn-rate math and
+        the in-process SLO engine share one representation."""
+        with self._lock:
+            routes = sorted(self.route_latency.items())
+        out: List[Dict[str, object]] = []
+        for route, hist in routes:
+            if hist.count == 0:
+                continue
+            out.append({
+                "name": "mv_serving_request_latency_seconds",
+                "labels": {"server": self.name, "route": route},
+                "buckets": hist.buckets(),
+                "sum": hist.total_s,
+                "count": hist.count,
+            })
+        return out
+
     def register_dashboard(self) -> None:
         """Hook this bundle into ``Dashboard.Display()`` (and, via the
         dict-valued snapshot twin, into ``GET /metrics``). Keyed add is
         naturally idempotent — no guard flag, so re-registering after a
         ``Dashboard.Reset()`` (which wipes sections) just works."""
+        from multiverso_tpu.obs import metrics as obs_metrics
         from multiverso_tpu.utils.dashboard import Dashboard
 
         Dashboard.add_section(
             self._section_key(), self.info_lines, snapshot=self.report
+        )
+        obs_metrics.register_histogram(
+            self._section_key(), self.histogram_samples
         )
 
     def unregister_dashboard(self) -> None:
@@ -239,6 +296,8 @@ class ServingMetrics:
         ``detach()``, a failed ``start``) may call it; an ``id(self)``-
         keyed section left behind pins this bundle (and whatever owns
         it) in the process-global Dashboard forever."""
+        from multiverso_tpu.obs import metrics as obs_metrics
         from multiverso_tpu.utils.dashboard import Dashboard
 
         Dashboard.remove_section(self._section_key())
+        obs_metrics.unregister_histogram(self._section_key())

@@ -85,6 +85,7 @@ class Replica:
         self.watcher = None
         self.data_http = None
         self.admission = None
+        self.slo_eval = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -116,6 +117,11 @@ class Replica:
         self.watcher = SnapshotWatcher(
             self.server, self.root, names=self.names
         ).start()
+        # -slo_eval_interval_s: burn-rate verdicts over this replica's
+        # own scrape feed; breaches flip the /healthz this process serves
+        from multiverso_tpu.obs import slo as _slo
+
+        self.slo_eval = _slo.maybe_start_from_flags()
         self._write_endpoint_file()
         return self
 
@@ -184,6 +190,15 @@ class Replica:
         if self.admission is not None:
             self.admission.unregister_dashboard()
             self.admission = None
+        if self.slo_eval is not None:
+            self.slo_eval.stop()
+            self.slo_eval = None
+        # -trace_dir: a replica's spans (serving.request/flush and the
+        # request-linked items) dump on drain like a trainer's do at the
+        # end of training — the fleet drill's merge reads both sides
+        from multiverso_tpu.obs import tracer as _tracer
+
+        _tracer.maybe_dump_from_flags()
         Log.Info("replica drained (pid %d)", os.getpid())
 
 

@@ -28,6 +28,13 @@ mode=shard_pipelined_trace: shard_pipelined with the span tracer armed
             (-trace_dir=<shared_root>/trace; shared_root required) — the
             obs smoke merges both ranks' dumps and checks the per-rank
             round-span counts against the round count.
+mode=shard_pipelined_auto: uneven shards with -ps_pipeline_depth=auto
+            (depth starts at 1, the staleness-adaptive controller widens
+            within [1, 3] at pod-agreed round boundaries, decide cadence
+            2 rounds). WORKER_OK gains depth_final=/decisions=/widens=
+            so the adaptive-depth ci drill can gate on >=1 widen while
+            the lockstep round/lr-trace/table checks stay identical to
+            the fixed-depth smoke.
 mode=chaos_drill: the failure-domain drill (shared_root required —
             holds <root>/ck checkpoints + <root>/hb heartbeat beacons).
             Pipelined depth=1 with quorum checkpoints every 2 rounds,
@@ -130,12 +137,19 @@ def main():
     # exactly one writes it (app.save_embeddings gates on rank 0 — the
     # trained tables are identical everywhere)
     w2v_path = corpus_path + ".w2v" if mode == "same" else ""
+    auto_mode = mode == "shard_pipelined_auto"
     opt = WEOptions(
         size=16, negative=3, window=2, batch_size=128, steps_per_call=2,
-        epoch=1, sample=0, min_count=0, output_file=w2v_path, use_ps=True,
+        # auto mode trains longer so the decide cadence (every 2 rounds)
+        # yields enough boundaries for the controller to widen and settle
+        epoch=3 if auto_mode else 1,
+        sample=0, min_count=0, output_file=w2v_path, use_ps=True,
         is_pipeline=False, train_file="unused",
         use_adagrad=mode.endswith("adagrad"),
         ps_pipeline_depth=1 if "pipelined" in mode or chaos_mode else 0,
+        ps_depth_auto=auto_mode,
+        ps_pipeline_depth_max=3,
+        ps_depth_decide_rounds=2,
         ps_compress="sparse" if mode.endswith("pipelined_sparse") else "none",
         checkpoint_dir=f"{shared_root}/ck" if chaos_mode else "",
         checkpoint_every_steps=2 if chaos_mode else 0,
@@ -160,10 +174,18 @@ def main():
     mv.MV_Barrier()
     mv.MV_ShutDown()
     trace = ",".join(f"{v:.8f}" for v in we._ps_lr_trace)
+    auto_stats = ""
+    if auto_mode:
+        decs = we._ps_depth_decisions
+        widens = sum(1 for dd in decs if dd.get("action") == "widen")
+        auto_stats = (
+            f" depth_final={we._ps_depth_final} decisions={len(decs)} "
+            f"widens={widens}"
+        )
     print(
         f"WORKER_OK pid={pid} pairs={we.words_trained} "
         f"global={we._ps_global_pairs} rounds={len(we._ps_lr_trace)} "
-        f"lr_trace={trace}",
+        f"lr_trace={trace}{auto_stats}",
         flush=True,
     )
 

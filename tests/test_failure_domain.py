@@ -511,6 +511,31 @@ def test_ps_resume_rejects_mismatched_flags(tmp_path, chaos_reset):
                 checkpoint_dir=ck, checkpoint_every_steps=2)
 
 
+def test_ps_depth_auto_kill_resume_completes(tmp_path, chaos_reset):
+    """-ps_pipeline_depth=auto survives a chaos kill: the drained
+    checkpoint stages the controller state, each staged pull's recorded
+    lr source, and the gp carry; the resumed auto run adopts the
+    checkpoint's window and finishes with finite, trained embeddings.
+    Auto decisions are wall-clock shaped, so the pin is completion +
+    quality — the BITWISE kill/resume contract stays with the
+    fixed-depth legs above, which this feature must not touch."""
+    ids = _corpus()
+    d = _dict(ids)
+    ck = str(tmp_path / "ck_auto")
+    kw = dict(ps_depth_auto=True, ps_pipeline_depth=1,
+              ps_pipeline_depth_max=3, ps_depth_decide_rounds=4,
+              alpha=0.025, checkpoint_dir=ck, checkpoint_every_steps=4)
+    SetCMDFlag("chaos_kill_mode", "raise")
+    SetCMDFlag("chaos_drop_rank", "0:10")
+    with pytest.raises(chaos.ChaosInterrupt):
+        _run_ps(ids, d, **kw)
+    SetCMDFlag("chaos_drop_rank", "")
+    chaos.reset()
+    emb = _run_ps(ids, d, **kw)
+    assert np.isfinite(emb).all()
+    assert np.abs(emb).max() > 1e-3
+
+
 def test_ps_pipelined_checkpointing_never_perturbs_training(tmp_path):
     """Drained checkpoints pause the pipe but change no math: a pipelined
     run WITH checkpointing equals one without, bit for bit."""

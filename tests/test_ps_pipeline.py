@@ -237,3 +237,94 @@ def test_word_count_exact_across_limb_carry(corpus):
         assert np.abs(limbs).max() < (1 << 30)  # no limb ever overflows
     finally:
         mv.MV_ShutDown(finalize=True)
+
+
+# ===================================== -ps_pipeline_depth=auto (controller)
+
+
+def _run_ps_auto(ids, d, alpha=0.025, **kw):
+    """Auto-depth runner. Milder alpha than the fixed-depth legs: this
+    toy corpus genuinely diverges at alpha=0.1 beyond depth 2, and
+    punishing that is the controller's loss_guard's job, not this
+    harness's. Returns (loss, emb, decisions, final_depth, events)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.obs import flight
+
+    mv.MV_Init(["prog"])
+    flight.recorder.clear()  # the ring is process-global; count only ours
+    try:
+        opt = WEOptions(
+            size=16, negative=3, window=2, batch_size=512, steps_per_call=2,
+            epoch=6, sample=0, alpha=alpha, output_file="", use_ps=True,
+            is_pipeline=False, ps_depth_auto=True, ps_pipeline_depth=1, **kw,
+        )
+        we = WordEmbedding(opt, dictionary=d)
+        loss = we.train(ids=ids)
+        events = [e for e in flight.recorder.snapshot()
+                  if e.get("kind") == "depth_decision"]
+        return (loss, we.embeddings().copy(),
+                list(getattr(we, "_ps_depth_decisions", [])),
+                int(getattr(we, "_ps_depth_final", -1)), events)
+    finally:
+        mv.MV_ShutDown(finalize=True)
+
+
+def test_depth_flag_parses_int_auto_and_rejects_junk():
+    from multiverso_tpu.utils.configure import GetFlag, SetCMDFlag
+    from multiverso_tpu.utils.log import FatalError
+
+    old = GetFlag("ps_pipeline_depth")
+    try:
+        SetCMDFlag("ps_pipeline_depth", "auto")
+        o = WEOptions.from_flags()
+        assert o.ps_depth_auto and o.ps_pipeline_depth == 1
+        SetCMDFlag("ps_pipeline_depth", "2")
+        o = WEOptions.from_flags()
+        assert not o.ps_depth_auto and o.ps_pipeline_depth == 2
+        SetCMDFlag("ps_pipeline_depth", "seven")
+        with pytest.raises(FatalError):
+            WEOptions.from_flags()
+    finally:
+        SetCMDFlag("ps_pipeline_depth", old)
+
+
+def test_depth_auto_constant_window_bitwise_equals_fixed(corpus):
+    """-ps_pipeline_depth_max=1 pins the controller's clamp: auto's
+    bookkeeping (recorded lr sources, gp carry, decision collectives)
+    must produce the IDENTICAL schedule to fixed depth 1 — bitwise.
+    Any drift here means auto rewires the math, not just the window."""
+    ids, d = corpus
+    _, e_fixed, _, _ = _run_ps(ids, d, ps_pipeline_depth=1)
+    loss, e_auto, decisions, final, _ = _run_ps_auto(
+        ids, d, alpha=0.1, ps_pipeline_depth_max=1,
+        ps_depth_decide_rounds=4,
+    )
+    np.testing.assert_array_equal(e_auto, e_fixed)
+    assert np.isfinite(loss)
+    assert final == 1
+    assert decisions  # the controller ran; the clamp held the window
+
+
+def test_depth_auto_widens_and_converges(corpus):
+    """The acceptance loop: auto starts at 1, takes >=1 widen decision
+    (overlap on this box is nowhere near target), stays within
+    [1, max], finishes with finite loss under the ln2*(K+1)=2.77
+    no-signal floor, and logs every decision as a structured
+    depth_decision flight event."""
+    ids, d = corpus
+    loss, emb, decisions, final, events = _run_ps_auto(
+        ids, d, ps_pipeline_depth_max=3, ps_depth_decide_rounds=4,
+    )
+    assert np.isfinite(loss) and loss < 2.77
+    assert np.abs(emb).max() > 1e-3
+    assert decisions
+    assert any(dc["action"] == "widen" for dc in decisions)
+    assert 1 <= final <= 3
+    for dc in decisions:
+        for key in ("round", "action", "reason", "old_depth",
+                    "agreed_depth", "overlap_pct", "pull_ms", "train_ms",
+                    "push_ms"):
+            assert key in dc, (key, dc)
+        assert 1 <= dc["agreed_depth"] <= 3
+        assert abs(dc["agreed_depth"] - dc["old_depth"]) <= 1
+    assert len(events) == len(decisions)  # every decision on the record

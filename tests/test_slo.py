@@ -1,0 +1,482 @@
+"""Closed-loop observability (ISSUE 15): time-series store, SLO
+burn-rate engine, straggler detector, and the depth controller's
+decision table.
+
+Contracts pinned here:
+
+* time-series windows: bounded ring, counter-view delta rates clamped
+  at 0 across restarts, ratio rules answer None (not breach) when the
+  denominator did not move;
+* multi-window burn-rate matrix on a fake clock: a fast-window spike
+  alone does NOT fire, a sustained burn fires exactly once, recovery
+  needs ``clear_after`` consecutive healthy evals (flap suppression),
+  and the breach/clear transitions emit ``slo_breach``/``slo_clear``
+  flight events and flip the health hook;
+* a breach degrades /healthz (the real ``http_health`` wiring) while
+  the failure-domain watchdog has recorded NOTHING — the SLO verdict
+  lands before any watchdog verdict would;
+* straggler detector on fabricated timers: confirmation needs
+  ``confirm_rounds`` consecutive over-bar rounds, one event per
+  confirmation, re-arming only after falling back under the bar;
+* depth controller decision table: every reason (slo_backoff,
+  loss_guard, target_met, no_gain, overlap_low, steady) from pure
+  inputs, plus state_dict round-trip and safe restore from vintage
+  checkpoints;
+* Prometheus histogram exposition: ``_bucket``/``_sum``/``_count``
+  with a ``+Inf`` bucket; tracer ring drop counts + flight occupancy
+  ride the observe() feed.
+"""
+
+import pytest
+
+from multiverso_tpu.obs import flight, metrics, slo, tracer
+from multiverso_tpu.obs.controller import DepthController
+from multiverso_tpu.obs.timeseries import TimeSeriesStore
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+def _obs(**flat):
+    """One fabricated observe() collection for ``ingest``."""
+    return {"flat": {k: float(v) for k, v in flat.items()}}
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    return TimeSeriesStore(capacity=64, clock=clock, registry=object())
+
+
+def _engine(store, rules, health_log=None):
+    rec = flight.FlightRecorder(capacity=256)
+    hook = None
+    if health_log is not None:
+        hook = lambda name, detail: health_log.append((name, detail))
+    eng = slo.SLOEngine(
+        rules=rules, store=store, recorder=rec, health_hook=hook
+    )
+    return eng, rec
+
+
+# ================================================== time-series store
+
+
+def test_window_stats_and_bounded_ring(clock):
+    st = TimeSeriesStore(capacity=4, clock=clock, registry=object())
+    for i in range(8):
+        st.ingest(_obs(**{"a:x": i}))
+        clock.advance(1.0)
+    assert len(st) == 4  # oldest evicted
+    w = st.window("a:x", window_s=100.0)
+    assert w.count == 4
+    assert (w.first, w.last, w.min, w.max) == (4.0, 7.0, 4.0, 7.0)
+    assert w.mean == pytest.approx(5.5)
+    # trailing-window restriction sees only the recent points
+    w2 = st.window("a:x", window_s=2.5)
+    assert w2.count == 2 and w2.first == 6.0
+    # a key missing from every scrape reads as quiet, never raises
+    assert st.window("a:nope", 100.0).count == 0
+    assert st.delta_rate("a:nope", 100.0) == 0.0
+
+
+def test_delta_rate_clamped_on_counter_reset(store, clock):
+    store.ingest(_obs(**{"c:total": 100}))
+    clock.advance(10.0)
+    store.ingest(_obs(**{"c:total": 150}))
+    assert store.delta_rate("c:total", 60.0) == pytest.approx(5.0)
+    clock.advance(10.0)
+    store.ingest(_obs(**{"c:total": 3}))  # process restarted
+    assert store.delta_rate("c:total", 60.0) == 0.0  # clamped, not negative
+
+
+def test_ratio_rate_none_without_traffic(store, clock):
+    store.ingest(_obs(**{"s:err": 0, "s:ok": 100}))
+    clock.advance(5.0)
+    store.ingest(_obs(**{"s:err": 0, "s:ok": 100}))
+    # denominator flat: "no traffic" must not read as breach or health
+    assert store.ratio_rate("s:err", "s:ok", 60.0) is None
+    clock.advance(5.0)
+    store.ingest(_obs(**{"s:err": 30, "s:ok": 200}))
+    assert store.ratio_rate("s:err", "s:ok", 60.0) == pytest.approx(0.3)
+
+
+# ============================================ burn-rate matrix (fake clock)
+
+
+def _gauge_rule(**kw):
+    base = dict(
+        name="lat", metric="s:p99", objective=100.0, kind="gauge",
+        fast_window_s=30.0, slow_window_s=300.0, clear_after=3,
+        min_points=2,
+    )
+    base.update(kw)
+    return slo.SLORule(**base)
+
+
+def _feed(store, clock, value, seconds, step=10.0):
+    for _ in range(int(seconds / step)):
+        store.ingest(_obs(**{"s:p99": value}))
+        clock.advance(step)
+
+
+def test_fast_spike_alone_does_not_fire(store, clock):
+    log = []
+    eng, rec = _engine(store, [_gauge_rule()], log)
+    _feed(store, clock, 50.0, seconds=300)   # healthy history
+    _feed(store, clock, 500.0, seconds=30)   # short spike
+    out = eng.evaluate()
+    # fast window burns, slow window mean is still under objective
+    r = out["rules"]["lat"]
+    assert r["burn_fast"] > 1.0 and r["burn_slow"] < 1.0
+    assert not r["breached"] and out["breached"] == []
+    assert log == [] and rec.snapshot() == []
+
+
+def test_sustained_burn_fires_once_then_clears_after_streak(store, clock):
+    log = []
+    eng, rec = _engine(store, [_gauge_rule()], log)
+    _feed(store, clock, 500.0, seconds=300)  # sustained burn
+    out = eng.evaluate()
+    assert out["rules"]["lat"]["fired"] and out["breached"] == ["lat"]
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert kinds == ["slo_breach"]
+    assert log and log[-1][0] == "lat" and log[-1][1] is not None
+    # still burning: breached stays, but no second breach event
+    eng.evaluate()
+    assert [e["kind"] for e in rec.snapshot()] == ["slo_breach"]
+    assert eng.state("lat").breach_count == 1
+    # recover the metric; clear_after=3 healthy evals before clearing
+    _feed(store, clock, 10.0, seconds=400)
+    assert not eng.evaluate()["rules"]["lat"]["cleared"]
+    assert not eng.evaluate()["rules"]["lat"]["cleared"]
+    out = eng.evaluate()
+    assert out["rules"]["lat"]["cleared"] and out["breached"] == []
+    assert [e["kind"] for e in rec.snapshot()] == ["slo_breach", "slo_clear"]
+    assert log[-1] == ("lat", None)  # health hook cleared
+
+
+def test_flapping_metric_suppressed_by_clear_streak(store, clock):
+    eng, rec = _engine(store, [_gauge_rule(clear_after=3)], [])
+    _feed(store, clock, 500.0, seconds=300)
+    eng.evaluate()
+    # oscillate: healthy, healthy, burning again — streak resets, the
+    # rule stays breached the whole time (no strobe)
+    _feed(store, clock, 10.0, seconds=330)
+    eng.evaluate()
+    eng.evaluate()
+    _feed(store, clock, 500.0, seconds=330)
+    eng.evaluate()
+    st = eng.state("lat")
+    assert st.breached and st.clear_count == 0
+    assert [e["kind"] for e in rec.snapshot()] == ["slo_breach"]
+
+
+def test_ratio_rule_availability_and_rate_rule_drops(store, clock):
+    rules = [
+        slo.SLORule(
+            name="avail", metric="serving:err", total="serving:ok",
+            objective=0.01, kind="ratio",
+            fast_window_s=30.0, slow_window_s=300.0,
+        ),
+        slo.SLORule(
+            name="drops", metric="obs:dropped", objective=1.0,
+            kind="rate", fast_window_s=30.0, slow_window_s=300.0,
+        ),
+    ]
+    eng, rec = _engine(store, rules, [])
+    err = ok = drop = 0
+    for _ in range(31):
+        store.ingest(_obs(**{
+            "serving:err": err, "serving:ok": ok, "obs:dropped": drop,
+        }))
+        err += 10     # 10% of traffic errors — 10x the objective
+        ok += 100
+        drop += 50    # 5 drops/sec — 5x the objective
+        clock.advance(10.0)
+    out = eng.evaluate()
+    assert set(out["breached"]) == {"avail", "drops"}
+    assert out["rules"]["avail"]["value"] == pytest.approx(0.1)  # Δerr/Δok
+
+
+def test_bad_below_comparison_for_overlap(store, clock):
+    rule = slo.SLORule(
+        name="overlap", metric="ps:overlap", objective=30.0,
+        comparison="<", kind="gauge", min_points=3,
+        fast_window_s=30.0, slow_window_s=300.0,
+    )
+    eng, _rec = _engine(store, [rule], [])
+    for _ in range(31):
+        store.ingest(_obs(**{"ps:overlap": 80.0}))
+        clock.advance(10.0)
+    assert eng.evaluate()["breached"] == []  # high overlap is healthy
+    for _ in range(31):
+        store.ingest(_obs(**{"ps:overlap": 5.0}))
+        clock.advance(10.0)
+    assert eng.evaluate()["breached"] == ["overlap"]
+
+
+def test_empty_windows_count_as_healthy(store):
+    eng, rec = _engine(store, [_gauge_rule()], [])
+    out = eng.evaluate()  # zero scrapes ingested
+    r = out["rules"]["lat"]
+    assert r["burn_fast"] is None and not r["breached"]
+    assert rec.snapshot() == []
+
+
+# =================================== breach degrades /healthz (real wiring)
+
+
+def test_breach_flips_healthz_degraded_before_any_watchdog_verdict(
+    store, clock
+):
+    from multiverso_tpu.resilience.watchdog import fd_stats
+    from multiverso_tpu.serving import http_health
+
+    # fd_stats is process-global: earlier suite tests may have contained
+    # failures — "before any watchdog verdict" means no NEW verdict here
+    rank_failures0 = fd_stats.rank_failures
+    rec = flight.FlightRecorder(capacity=64)
+    # health_hook=None exercises the real lazy http_health wiring
+    eng = slo.SLOEngine(rules=[_gauge_rule()], store=store, recorder=rec)
+    _feed(store, clock, 500.0, seconds=300)
+    try:
+        eng.evaluate()
+        payload = http_health.health_payload()
+        assert payload["status"] == "degraded"
+        assert "slo:lat" in payload["degraded_reasons"]
+        # the SLO verdict is on record while the watchdog saw nothing:
+        # the burn narrative precedes any containment verdict
+        assert [e["kind"] for e in rec.snapshot()] == ["slo_breach"]
+        assert fd_stats.rank_failures == rank_failures0
+        _feed(store, clock, 10.0, seconds=400)
+        for _ in range(3):
+            eng.evaluate()
+        assert "slo:lat" not in (
+            http_health.health_payload().get("degraded_reasons") or []
+        )
+    finally:
+        http_health.clear_degraded("slo:lat")
+
+
+# ======================================================= straggler detector
+
+
+def _timers(n=8, slow_rank=None, base=1000.0, skew=10.0):
+    t = [base + 10.0 * i for i in range(n)]  # benign spread
+    if slow_rank is not None:
+        t[slow_rank] = base * skew
+    return t
+
+
+def test_straggler_needs_consecutive_confirmation():
+    rec = flight.FlightRecorder(capacity=64)
+    hits = []
+    det = slo.StragglerDetector(
+        confirm_rounds=3, recorder=rec,
+        fd_hook=lambda r, t, m: hits.append(r),
+    )
+    assert det.feed(_timers(slow_rank=5), 0) == []
+    assert det.feed(_timers(slow_rank=5), 1) == []
+    assert det.feed(_timers(slow_rank=5), 2) == [5]  # confirmed on 3rd
+    assert det.flagged_ranks() == [5] and det.events == 1 and hits == [5]
+    ev = rec.snapshot()[0]
+    assert ev["kind"] == "straggler" and ev["rank"] == 5 and ev["round"] == 2
+    assert ev["timer_us"] > ev["bar_us"] > ev["median_us"]
+    # still slow: no duplicate event while flagged
+    det.feed(_timers(slow_rank=5), 3)
+    assert det.events == 1
+
+
+def test_straggler_rearms_after_recovery():
+    det = slo.StragglerDetector(confirm_rounds=2,
+                                recorder=flight.FlightRecorder(capacity=8),
+                                fd_hook=lambda *a: None)
+    for i in range(2):
+        det.feed(_timers(slow_rank=3), i)
+    assert det.flagged_ranks() == [3]
+    det.feed(_timers(), 2)  # back under the bar: unflag + reset streak
+    assert det.flagged_ranks() == []
+    det.feed(_timers(slow_rank=3), 3)
+    assert det.flagged_ranks() == []  # needs a fresh confirmation streak
+    det.feed(_timers(slow_rank=3), 4)
+    assert det.flagged_ranks() == [3] and det.events == 2
+
+
+def test_straggler_guards_small_pods_and_benign_jitter():
+    det = slo.StragglerDetector(min_ranks=3, min_spread_us=1000.0,
+                                recorder=flight.FlightRecorder(capacity=8),
+                                fd_hook=lambda *a: None)
+    # too few ranks: a 2-rank "pod" has no median worth judging
+    for i in range(10):
+        assert det.feed([100.0, 100000.0], i) == []
+    # spread below min_spread_us: microsecond jitter is not a straggler
+    for i in range(10):
+        assert det.feed([1000.0 + j for j in range(8)], i) == []
+    assert det.events == 0
+
+
+# ================================================ controller decision table
+
+
+def _ctl(**kw):
+    base = dict(min_depth=1, max_depth=4, overlap_target_pct=60.0,
+                loss_guard_pct=10.0, min_gain_pct=2.0, min_comms_ms=0.05)
+    base.update(kw)
+    return DepthController(**base)
+
+
+def test_widen_while_overlap_low_until_max():
+    ctl = _ctl()
+    for want in (2, 3, 4):
+        d = ctl.propose(overlap_pct=10.0, pull_ms=5.0, push_ms=5.0)
+        assert (d.action, d.depth, d.reason) == ("widen", want, "overlap_low")
+        # pretend the widen paid: raise overlap past min_gain
+        ctl._last_widen_overlap = 0.0
+    d = ctl.propose(overlap_pct=10.0, pull_ms=5.0, push_ms=5.0)
+    assert (d.action, d.depth, d.reason) == ("hold", 4, "steady")  # at max
+    assert ctl.widens == 3 and ctl.decisions == 4
+
+
+def test_target_met_holds_and_no_gain_rolls_back():
+    ctl = _ctl()
+    d = ctl.propose(overlap_pct=75.0, pull_ms=5.0, push_ms=5.0)
+    assert (d.action, d.reason) == ("hold", "target_met")
+    d = ctl.propose(overlap_pct=10.0, pull_ms=5.0, push_ms=5.0)
+    assert d.action == "widen" and ctl.depth == 2
+    # next decision: overlap moved < min_gain_pct since the widen
+    d = ctl.propose(overlap_pct=10.5, pull_ms=5.0, push_ms=5.0)
+    assert (d.action, d.depth, d.reason) == ("narrow", 1, "no_gain")
+
+
+def test_slo_backoff_outranks_everything():
+    ctl = _ctl()
+    ctl.depth = 3
+    ctl.observe_loss(1000.0)  # would also trip nothing yet
+    d = ctl.propose(overlap_pct=5.0, pull_ms=50.0, push_ms=50.0,
+                    slo_breached=True)
+    assert (d.action, d.depth, d.reason) == ("narrow", 2, "slo_backoff")
+    # already at min: breach can only hold, never enter depth 0
+    ctl.depth = 1
+    d = ctl.propose(overlap_pct=5.0, pull_ms=50.0, push_ms=50.0,
+                    slo_breached=True)
+    assert d.depth == 1 and d.action != "narrow"
+
+
+def test_loss_guard_narrows_on_regression():
+    ctl = _ctl()
+    ctl.depth = 3
+    for v in (10.0, 9.0, 8.0):
+        ctl.observe_loss(v)
+    d = ctl.propose(overlap_pct=5.0, pull_ms=5.0, push_ms=5.0)
+    assert d.action == "widen"  # loss trending down: guard quiet
+    for _ in range(20):
+        ctl.observe_loss(50.0)  # EMA regresses far past 10%
+    d = ctl.propose(overlap_pct=5.0, pull_ms=5.0, push_ms=5.0)
+    assert (d.action, d.reason) == ("narrow", "loss_guard")
+
+
+def test_loss_guard_ignores_nan_and_degenerate_scale():
+    ctl = _ctl()
+    ctl.observe_loss(float("nan"))
+    ctl.observe_loss(float("inf"))
+    assert ctl._loss_ema is None  # divergence is the watchdog's business
+    ctl.observe_loss(-5.0)  # best EMA <= 0: relative guard undefined
+    ctl.observe_loss(100.0)
+    assert not ctl._loss_regressed()
+
+
+def test_no_widen_into_comms_noise():
+    ctl = _ctl(min_comms_ms=1.0)
+    d = ctl.propose(overlap_pct=5.0, pull_ms=0.1, push_ms=0.1)
+    assert (d.action, d.reason) == ("hold", "steady")
+
+
+def test_decision_to_dict_carries_observation():
+    ctl = _ctl()
+    d = ctl.propose(overlap_pct=12.345, pull_ms=1.0, train_ms=2.0,
+                    push_ms=3.0)
+    rec = d.to_dict()
+    assert rec["action"] == "widen" and rec["reason"] == "overlap_low"
+    assert rec["overlap_pct"] == pytest.approx(12.35)
+    assert rec["train_ms"] == pytest.approx(2.0)
+    assert rec["slo_breached"] is False
+
+
+def test_state_dict_roundtrip_and_vintage_restore():
+    ctl = _ctl()
+    ctl.observe_loss(5.0)
+    ctl.propose(overlap_pct=10.0, pull_ms=5.0, push_ms=5.0)
+    st = ctl.state_dict()
+    fresh = _ctl()
+    fresh.load_state_dict(st)
+    assert fresh.depth == ctl.depth == 2
+    assert fresh.widens == 1 and fresh._loss_ema == pytest.approx(5.0)
+    assert fresh._last_widen_overlap == pytest.approx(10.0)
+    # vintage checkpoint without controller state: safe defaults
+    old = _ctl()
+    old.load_state_dict(None)
+    assert old.depth == 1 and old.decisions == 0
+    # saved depth out of the configured clamp: clamped, never trusted
+    clamped = _ctl(max_depth=2)
+    clamped.load_state_dict({"depth": 9})
+    assert clamped.depth == 2
+
+
+# ======================================= exposition: histograms + occupancy
+
+
+def test_prometheus_histogram_exposition():
+    key = "test.slo.hist"
+    metrics.register_histogram(key, lambda: [{
+        "name": "mv_test_latency_seconds",
+        "labels": {"route": "/v1/lookup"},
+        "buckets": [(0.005, 3), (0.05, 7), (0.5, 9)],
+        "sum": 0.42,
+        "count": 10,
+    }])
+    try:
+        text = metrics.render_prometheus()
+    finally:
+        metrics.unregister_histogram(key)
+    assert "# TYPE mv_test_latency_seconds histogram" in text
+    assert ('mv_test_latency_seconds_bucket{le="0.005",route="/v1/lookup"} 3'
+            in text)
+    assert ('mv_test_latency_seconds_bucket{le="+Inf",route="/v1/lookup"} 10'
+            in text)
+    assert 'mv_test_latency_seconds_sum{route="/v1/lookup"} 0.42' in text
+    assert 'mv_test_latency_seconds_count{route="/v1/lookup"} 10' in text
+
+
+def test_observe_feed_carries_ring_and_flight_occupancy():
+    flat = metrics.registry.observe()["flat"]
+    assert "obs:tracer_dropped_events" in flat
+    assert any(k.startswith("obs:") and "flight" in k for k in flat), (
+        sorted(k for k in flat if k.startswith("obs:"))
+    )
+
+
+def test_default_rules_cover_the_published_names():
+    names = {r.name for r in slo.default_rules()}
+    assert names == {
+        "availability", "latency_p99", "shed_rate", "ps_overlap_pct",
+        "checkpoint_age", "trace_drop_rate",
+    }
+    # rules over families this process never runs stay healthy forever
+    eng, rec = _engine(
+        TimeSeriesStore(capacity=8, clock=FakeClock(), registry=object()),
+        slo.default_rules(), [],
+    )
+    assert eng.evaluate()["breached"] == [] and rec.snapshot() == []

@@ -1,0 +1,208 @@
+"""Cross-process request tracing (ISSUE 15): W3C traceparent minting,
+propagation over the real HTTP data plane, and request-tree linking.
+
+Contracts pinned here:
+
+* traceparent mint/parse round-trip; malformed headers and the spec's
+  all-zero ids degrade to "no trace", never to an error;
+* a ``ServingClient`` call over a real HTTP hop leaves one linked
+  chain: client.request -> client.attempt -> serving.request ->
+  serving.flush_item, all sharing one trace_id, with each child
+  naming its parent's span_id;
+* the batcher's flush span lists every trace_id it carried;
+* ``obs summary --list-requests`` and ``--request <id>`` render the
+  linked tree from a dumped trace file (the ci fleet drill greps the
+  same output across two processes);
+* thread-local trace context: set/get/clear isolation.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.obs import tracer
+from multiverso_tpu.obs.trace_tools import (
+    request_index,
+    request_summary_lines,
+    request_tree,
+)
+from multiverso_tpu.serving import DataPlaneServer, ServingClient, TableServer
+from multiverso_tpu.utils.configure import SetCMDFlag
+
+
+@pytest.fixture
+def fresh_tracer():
+    tracer.reset_for_tests()
+    yield tracer
+    tracer.reset_for_tests()
+    SetCMDFlag("trace_ring_events", 65536)
+    SetCMDFlag("trace_dir", "")
+
+
+# ------------------------------------------------------------ traceparent
+
+
+def test_traceparent_mint_parse_roundtrip():
+    tid, sid = tracer.new_trace_id(), tracer.new_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    header = tracer.mint_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    assert tracer.parse_traceparent(header) == (tid, sid)
+    # surrounding whitespace and upper-case hex are tolerated (W3C says
+    # lower-case on the wire, but parse must not 4xx a sloppy client)
+    assert tracer.parse_traceparent(f"  {header.upper()}  ") == (tid, sid)
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    "",
+    "garbage",
+    "00-short-span-01",
+    "00-" + "g" * 32 + "-" + "a" * 16 + "-01",   # non-hex trace id
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # wrong length
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",   # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+])
+def test_traceparent_malformed_degrades_to_none(bad):
+    assert tracer.parse_traceparent(bad) is None
+
+
+def test_thread_local_trace_context():
+    assert tracer.get_trace_context() is None
+    tracer.set_trace_context("t" * 32, "s" * 16)
+    assert tracer.get_trace_context() == ("t" * 32, "s" * 16)
+    tracer.clear_trace_context()
+    assert tracer.get_trace_context() is None
+
+
+# ------------------------------------------- propagation over real HTTP
+
+
+@pytest.fixture
+def served(mv_env):
+    emb = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        yield srv, dp, emb
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+def _request_events(doc):
+    """name -> [events carrying a trace_id arg]"""
+    by_name = {}
+    for ev in doc.get("traceEvents", []):
+        if (ev.get("args") or {}).get("trace_id"):
+            by_name.setdefault(ev["name"], []).append(ev)
+    return by_name
+
+
+def test_traceparent_propagates_over_http_into_one_linked_chain(
+    served, fresh_tracer
+):
+    srv, dp, emb = served
+    tracer.enable()
+    client = ServingClient([dp.url], deadline_s=10.0)
+    rows = client.lookup("emb", [1, 3])
+    assert np.allclose(rows, emb[[1, 3]])
+    tracer.disable()
+    doc = tracer.dump()
+
+    by_name = _request_events(doc)
+    for name in ("client.request", "client.attempt", "serving.request",
+                 "serving.flush_item"):
+        assert by_name.get(name), f"missing traced span {name}"
+    root = by_name["client.request"][0]["args"]
+    attempt = by_name["client.attempt"][0]["args"]
+    server = by_name["serving.request"][0]["args"]
+    item = by_name["serving.flush_item"][0]["args"]
+    tid = root["trace_id"]
+    # one trace id end to end; each hop parents under the previous
+    assert attempt["trace_id"] == server["trace_id"] == item["trace_id"] == tid
+    assert attempt["parent_id"] == root["span_id"]
+    assert server["parent_id"] == attempt["span_id"]
+    assert item["parent_id"] == server["span_id"]
+    # the flush span (no single trace_id of its own) lists what it carried
+    flushes = [ev for ev in doc["traceEvents"]
+               if ev["name"] == "serving.flush"
+               and tid in ((ev.get("args") or {}).get("trace_ids") or [])]
+    assert flushes, "flush span does not list the request's trace_id"
+
+
+def test_request_tree_links_the_chain_and_isolates_requests(
+    served, fresh_tracer
+):
+    srv, dp, emb = served
+    tracer.enable()
+    client = ServingClient([dp.url], deadline_s=10.0)
+    client.lookup("emb", [0])
+    client.lookup("emb", [5])
+    tracer.disable()
+    doc = tracer.dump()
+
+    idx = request_index(doc)
+    assert len(idx) == 2  # one trace per logical request
+    for tid in idx:
+        roots, orphans = request_tree(doc, tid)
+        assert orphans == []
+        assert len(roots) == 1 and roots[0]["event"]["name"] == "client.request"
+        attempt = roots[0]["children"][0]
+        assert attempt["event"]["name"] == "client.attempt"
+        server = attempt["children"][0]
+        assert server["event"]["name"] == "serving.request"
+        assert [c["event"]["name"] for c in server["children"]] \
+            == ["serving.flush_item"]
+        lines = request_summary_lines(doc, tid)
+        assert lines[0] == f"trace={tid}"
+        assert any("serving.request" in ln and "pid=" in ln for ln in lines)
+
+
+def test_request_tree_reports_orphans_for_dropped_parents():
+    doc = {"traceEvents": [
+        {"name": "serving.request", "ph": "X", "ts": 1.0, "dur": 5.0,
+         "pid": 1, "tid": 1,
+         "args": {"trace_id": "t1", "span_id": "s2", "parent_id": "s1"}},
+    ]}
+    roots, orphans = request_tree(doc, "t1")
+    assert roots == [] and len(orphans) == 1
+    lines = request_summary_lines(doc, "t1")
+    assert any("orphan" in ln and "missing_parent=s1" in ln for ln in lines)
+
+
+# ------------------------------------------------------------- CLI modes
+
+
+def test_summary_cli_list_requests_and_request_modes(
+    served, fresh_tracer, tmp_path
+):
+    srv, dp, emb = served
+    tracer.enable()
+    ServingClient([dp.url], deadline_s=10.0).lookup("emb", [2])
+    tracer.disable()
+    path = str(tmp_path / "trace-rank0.json")
+    tracer.dump(path)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "multiverso_tpu.obs", "summary", path,
+         "--list-requests"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("trace=")]
+    assert len(lines) == 1 and "pids=" in lines[0]
+    tid = lines[0].split()[0].split("=", 1)[1]
+
+    out = subprocess.run(
+        [sys.executable, "-m", "multiverso_tpu.obs", "summary", path,
+         "--request", tid],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert f"trace={tid}" in out.stdout
+    for name in ("client.request", "client.attempt", "serving.request"):
+        assert name in out.stdout
