@@ -78,6 +78,8 @@ echo "== serving fleet drill (2 replicas, kill one mid-load + rollout) =="
 # one fleet trace, and `obs summary --list-requests` must show >=1
 # request whose span tree crosses the client AND a replica process;
 # `obs scrape --watch` tails the live fleet into fleet-metrics.jsonl.
+# Clients speak the binary x-mv-frame wire by default; client 0 forces
+# JSON so the curl/debug path survives the same kill+rollout gates.
 FLROOT=$(mktemp -d)
 JAX_PLATFORMS=cpu python - "$FLROOT" <<'EOF'
 import json, os, signal, sys, threading, time, urllib.error, urllib.request
@@ -128,7 +130,10 @@ errors, clients = [], []
 
 
 def load(i):
-    c = ServingClient(urls, tenant=f"ci-{i}", deadline_s=30.0)
+    # binary wire is the fleet default; client 0 pins JSON so both
+    # formats ride the kill + rollout with zero unrecovered errors
+    c = ServingClient(urls, tenant=f"ci-{i}", deadline_s=30.0,
+                      wire="json" if i == 0 else "binary")
     clients.append(c)
     r = np.random.RandomState(i)
     while not stop.is_set():
@@ -294,7 +299,8 @@ assert tree.returncode == 0, tree.stderr[-500:]
 for name in ("client.request", "client.attempt", "serving.request"):
     assert name in tree.stdout, (name, tree.stdout[:1500])
 
-print(f"fleet drill OK: {requests} requests, 0 unrecovered "
+print(f"fleet drill OK: {requests} requests (binary wire default, "
+      f"client 0 JSON-forced), 0 unrecovered "
       f"({failovers} failovers), kill+heal with rollout to ckpt-2, "
       f"429 Retry-After={retry_after}s, 2-replica /metrics scrape, "
       f"{len(ticks)} watch ticks, {len(cross)} cross-process request "

@@ -201,6 +201,17 @@ MV_DEFINE_string(
     "deltas always ride sparse, never 1bit). Pack/unpack run as jitted "
     "device programs, so compression never stalls the host",
 )
+MV_DEFINE_string(
+    "ps_pull_packed", "auto",
+    "PS pull-direction packing (sparse-pull path only): auto (default — "
+    "pack pulls whenever -ps_compress != none, so both wire directions "
+    "compress together) | on (always pack) | off (always dense). Packed "
+    "pulls move (idx,val) pairs instead of dense row blocks when the "
+    "stale set is mostly zeros; lossless (bit-exact vs dense), with an "
+    "automatic dense fallback whenever the packed encoding would be "
+    "larger. Pod-wide setting: every rank must agree (the pack runs "
+    "inside the SPMD pull program)",
+)
 MV_DEFINE_bool(
     "ps_sparse_pull", True,
     "PS-mode dirty-row tracked pulls (pipelined path only): route the "
@@ -262,6 +273,7 @@ class WEOptions:
     ps_pipeline_depth_max: int = 4
     ps_depth_decide_rounds: int = 8
     ps_compress: str = "none"
+    ps_pull_packed: str = "auto"
     ps_sparse_pull: bool = True
     # float so tests/benches can request sub-MB caches; the CLI flag is
     # whole MB
@@ -824,10 +836,16 @@ class WordEmbedding:
             [(t, side) for _n, t, side in self._ps_entries()]
             if self._tier else []
         )
-        # packed pulls (pull-direction SparseFilter): engage with the
-        # push compression flag — lossless either way
-        self._ps_pull_packed = (
-            self._ps_sparse_tables and self.opt.ps_compress != "none"
+        # packed pulls (pull-direction SparseFilter): -ps_pull_packed
+        # on/off forces it; auto engages with the push compression flag —
+        # lossless either way (bit-exact vs dense, with a size-based
+        # dense fallback inside the table)
+        pp = str(self.opt.ps_pull_packed).strip().lower()
+        CHECK(pp in ("auto", "on", "off"),
+              f"-ps_pull_packed must be auto|on|off, got {pp!r}")
+        self._ps_pull_packed = self._ps_sparse_tables and (
+            pp == "on"
+            or (pp == "auto" and self.opt.ps_compress != "none")
         )
 
     def _wc_push_and_read(self, inc: int) -> int:

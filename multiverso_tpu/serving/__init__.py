@@ -16,10 +16,16 @@ package is the read path sized for that traffic:
 * ``http_health`` — stdlib HTTP surface: ``GET /healthz`` answers with
   ``TableServer.health()`` + the resilience and failure_domain sections
   as one JSON document (``-health_port`` flag);
+* ``wire``     — the binary frame codec (``application/x-mv-frame``):
+  length-prefixed little-endian header + raw f32/i32 blocks, the
+  reference's Blob/Message data plane — no floats as text;
 * ``http_data`` — the query routes over HTTP (``POST /v1/lookup``,
-  ``/v1/topk``, ``/v1/predict``): shed maps to 429 + ``Retry-After``,
-  breaker-open/warming to 503 (``-data_port`` flag);
-* ``client``   — fleet client: deadline propagation, full-jitter retry,
+  ``/v1/topk``, ``/v1/predict``) on either wire format (binary frames
+  or JSON for curl/debugging, negotiated per request): shed maps to
+  429 + ``Retry-After``, breaker-open/warming to 503 (``-data_port``
+  flag);
+* ``client``   — fleet client: binary wire + keep-alive connection
+  pool by default, deadline propagation, full-jitter retry,
   multi-endpoint failover (zero unrecovered errors through a replica
   kill is the ci.sh fleet-drill gate);
 * ``admission`` — per-tenant token buckets in front of the batcher: a
@@ -53,6 +59,11 @@ from multiverso_tpu.serving.server import (
     ServingSnapshot,
     TableServer,
 )
+from multiverso_tpu.serving.wire import (
+    MalformedFrame,
+    decode_frame,
+    encode_frame,
+)
 
 __all__ = [
     "AdmissionController",
@@ -64,8 +75,11 @@ __all__ = [
     "Request",
     "RouteUnavailable",
     "LatencyHistogram",
+    "MalformedFrame",
     "ServingMetrics",
     "ServingClient",
+    "decode_frame",
+    "encode_frame",
     "ServingSnapshot",
     "SnapshotWatcher",
     "TableServer",

@@ -76,6 +76,10 @@ class Request:
     # trace context — how a client request's trace_id survives the hop
     # from the handler thread onto the flusher thread's flush span
     trace: Optional[tuple] = None
+    # absolute monotonic deadline (None = never expires): a ticket whose
+    # client already gave up must not spend device work — the flusher
+    # fails it with TimeoutError instead of batching it
+    deadline_t: Optional[float] = None
 
 
 class DynamicBatcher:
@@ -132,13 +136,17 @@ class DynamicBatcher:
         self._thread.start()
         return self
 
-    def submit(self, route: str, payload: Any, block: bool = False) -> Future:
+    def submit(self, route: str, payload: Any, block: bool = False,
+               deadline_t: Optional[float] = None) -> Future:
         """Enqueue one request; returns its Future.
 
         ``block=False`` (online serving): a full queue sheds the request
         by raising ``Overloaded`` with a retry-after hint. ``block=True``
         (offline/bulk clients): wait for a free ticket instead —
-        backpressure propagates to the producer.
+        backpressure propagates to the producer. ``deadline_t`` (absolute
+        ``time.monotonic()``) marks the ticket expired past that point:
+        the flusher fails it with ``TimeoutError`` instead of spending
+        device work on an answer nobody is waiting for.
         """
         CHECK(not self._closed, "batcher is closed")
         if block:
@@ -153,7 +161,7 @@ class DynamicBatcher:
                 raise RuntimeError("batcher closed")
             self.metrics.record_shed()
             raise Overloaded(self._retry_after())
-        req = Request(route=route, payload=payload)
+        req = Request(route=route, payload=payload, deadline_t=deadline_t)
         if _tracer.tracing_enabled():
             req.trace = _tracer.get_trace_context()
         self._slots[ticket] = req
@@ -268,6 +276,24 @@ class DynamicBatcher:
         with self._depth_lock:
             self._depth -= len(reqs)
             self.metrics.set_queue_depth(self._depth)
+        # expired-ticket drop: a request whose client deadline already
+        # passed gets TimeoutError here (its handler answered 504 long
+        # ago) instead of riding the batch and spending device work
+        now = time.monotonic()
+        expired = [
+            r for r in reqs
+            if r.deadline_t is not None and r.deadline_t <= now
+        ]
+        if expired:
+            for r in expired:
+                _fail_future(r.future, TimeoutError(
+                    "ticket deadline expired before flush"
+                ))
+            self.metrics.record_expired(len(expired))
+            dead = {id(r) for r in expired}  # dataclass __eq__ would
+            reqs = [r for r in reqs if id(r) not in dead]  # compare arrays
+            if not reqs:
+                return
         payloads = [r.payload for r in reqs]
         traced = [r for r in reqs if r.trace]
         flush_args: Dict[str, Any] = {"route": route, "size": len(reqs)}

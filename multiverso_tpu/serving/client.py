@@ -3,6 +3,16 @@
 The data plane (``serving/http_data.py``) makes each replica an HTTP
 endpoint; this client makes N replicas *one service*. Per call it:
 
+* speaks the binary frame protocol by default (``wire="binary"`` —
+  ``serving/wire.py``: raw f32/i32 blocks, no per-element Python
+  objects; ``wire="json"`` keeps the debug path and is what curl sees);
+* reuses persistent ``http.client.HTTPConnection``s from a
+  per-endpoint keep-alive pool — a request normally costs zero TCP
+  handshakes. A pooled socket the server closed between requests
+  surfaces as ``BadStatusLine``/``ConnectionReset`` on first reuse;
+  that is *infrastructure staleness*, not a replica failure, so the
+  client retries once on a fresh connection immediately — no failover
+  charge, no backoff (``stale_retries`` in the stats instead);
 * propagates the remaining deadline (``deadline_ms`` in the body +
   socket timeout), so the whole retry tree shares one budget;
 * honours **429 + Retry-After** (tenant/queue shed) by sleeping the
@@ -19,15 +29,19 @@ endpoint; this client makes N replicas *one service*. Per call it:
 Endpoints rotate round-robin across calls so a multi-thread load
 generator spreads naturally; a failed endpoint is only skipped for the
 current call (the fleet relaunches replicas — permanent blacklisting
-would fight the supervisor's self-healing).
+would fight the supervisor's self-healing). Pool accounting rides the
+per-request stats: ``pool_handshakes`` (fresh TCP connects),
+``pool_reused`` (requests served on a kept-alive socket) and
+``stale_retries`` (reuse attempts that hit a server-closed socket).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import time
-import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -35,6 +49,7 @@ import numpy as np
 
 from multiverso_tpu.obs import tracer
 from multiverso_tpu.resilience.chaos import FullJitterBackoff
+from multiverso_tpu.serving import wire
 from multiverso_tpu.utils.log import CHECK
 
 __all__ = ["ServingClient", "Unrecovered"]
@@ -61,6 +76,30 @@ class _EndpointDown(Exception):
     """Internal: 503 / 5xx / transport error — fail over."""
 
 
+# a kept-alive socket the server closed between our requests fails like
+# THIS on first reuse — never like this on a fresh connect that already
+# completed its handshake and request send
+_STALE_SOCKET_ERRORS = (
+    http.client.BadStatusLine,
+    http.client.CannotSendRequest,
+    ConnectionResetError,
+    ConnectionAbortedError,
+    BrokenPipeError,
+)
+
+# request block key per route (one array block per request frame)
+_REQUEST_BLOCK = {
+    "/v1/lookup": "ids",
+    "/v1/topk": "queries",
+    "/v1/predict": "features",
+}
+_RESPONSE_FIELDS = {
+    "/v1/lookup": ("rows",),
+    "/v1/topk": ("ids", "scores"),
+    "/v1/predict": ("scores",),
+}
+
+
 class ServingClient:
     def __init__(
         self,
@@ -72,14 +111,20 @@ class ServingClient:
         backoff_base_s: float = 0.02,
         backoff_max_s: float = 0.5,
         seed: int = 0,
+        wire: str = "binary",
+        pool_size: int = 4,
         clock=time.monotonic,
         sleep=time.sleep,
     ):
         CHECK(len(endpoints) >= 1, "ServingClient needs >= 1 endpoint")
+        CHECK(wire in ("binary", "json"), f"wire must be binary|json, "
+              f"got {wire!r}")
         self.endpoints = [e.rstrip("/") for e in endpoints]
         self.tenant = tenant
+        self.wire = wire
         self.deadline_s = float(deadline_s)
         self.max_attempts = int(max_attempts)
+        self.pool_size = int(pool_size)
         self._backoff = FullJitterBackoff(
             base_delay_s=backoff_base_s, max_delay_s=backoff_max_s, seed=seed
         )
@@ -87,10 +132,13 @@ class ServingClient:
         self._sleep = sleep
         self._lock = threading.Lock()
         self._rr = 0
+        # endpoint -> stack of idle keep-alive connections
+        self._pool: Dict[str, List[http.client.HTTPConnection]] = {}
         self._stats = {
             "requests": 0, "ok": 0, "retries": 0, "failovers": 0,
             "shed_429": 0, "unavailable_503": 0, "deadline_504": 0,
             "unrecovered": 0,
+            "pool_handshakes": 0, "pool_reused": 0, "stale_retries": 0,
         }
 
     # ------------------------------------------------------------ stats
@@ -109,46 +157,160 @@ class ServingClient:
             self._rr = (self._rr + 1) % len(self.endpoints)
             return i
 
+    # ------------------------------------------------------------ pool
+
+    def _pool_get(
+        self, endpoint: str, timeout_s: float, fresh: bool = False
+    ) -> Tuple[http.client.HTTPConnection, bool]:
+        """An idle pooled connection for ``endpoint`` (reused=True), or
+        a new one (one TCP handshake, lazily connected by http.client).
+        ``fresh=True`` skips the pool — the stale-socket retry path."""
+        conn: Optional[http.client.HTTPConnection] = None
+        if not fresh:
+            with self._lock:
+                idle = self._pool.get(endpoint)
+                if idle:
+                    conn = idle.pop()
+        if conn is not None:
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+            self._bump("pool_reused")
+            return conn, True
+        u = urllib.parse.urlsplit(endpoint)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port or 80, timeout=timeout_s
+        )
+        self._bump("pool_handshakes")
+        return conn, False
+
+    def _pool_put(self, endpoint: str, conn: http.client.HTTPConnection,
+                  will_close: bool) -> None:
+        if will_close:
+            conn.close()
+            return
+        with self._lock:
+            idle = self._pool.setdefault(endpoint, [])
+            if len(idle) < self.pool_size:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        """Close every idle pooled connection (the client stays usable —
+        subsequent calls simply reconnect)."""
+        with self._lock:
+            pools = list(self._pool.values())
+            self._pool = {}
+        for idle in pools:
+            for conn in idle:
+                conn.close()
+
+    # ------------------------------------------------------------ encode
+
+    def _encode_request(self, route: str,
+                        body: Dict[str, Any]) -> Tuple[bytes, str]:
+        if self.wire == "binary":
+            key = _REQUEST_BLOCK[route]
+            meta = {
+                k: v for k, v in body.items()
+                if not isinstance(v, np.ndarray)
+            }
+            arr = body[key]
+            if key == "ids":
+                # id blocks ship as i32 — the server's native index
+                # dtype, and half the bytes of the validated i64 form
+                arr = np.ascontiguousarray(arr, np.int32)
+            return (
+                wire.encode_frame(wire.ROUTE_CODES[route], meta, [arr]),
+                wire.CONTENT_TYPE,
+            )
+        doc = {
+            k: (v.tolist() if isinstance(v, np.ndarray) else v)
+            for k, v in body.items()
+        }
+        return json.dumps(doc).encode(), "application/json"
+
+    @staticmethod
+    def _decode_response(route: str, ctype: str,
+                         payload: bytes) -> Dict[str, Any]:
+        if wire.CONTENT_TYPE in ctype:
+            _code, meta, blocks = wire.decode_frame(payload)
+            out: Dict[str, Any] = dict(meta)
+            for field, block in zip(_RESPONSE_FIELDS[route], blocks):
+                out[field] = block
+            return out
+        return json.loads(payload)
+
     # ------------------------------------------------------------ transport
+
+    def _exchange(self, conn: http.client.HTTPConnection, route: str,
+                  data: bytes, headers: Dict[str, str]):
+        conn.request("POST", route, body=data, headers=headers)
+        resp = conn.getresponse()
+        payload = resp.read()  # must drain before the conn can be reused
+        return resp.status, resp, payload
 
     def _post_once(self, endpoint: str, route: str, body: Dict[str, Any],
                    timeout_s: float,
                    traceparent: Optional[str] = None) -> Dict[str, Any]:
-        data = json.dumps(body).encode()
-        headers = {"Content-Type": "application/json"}
+        data, ctype = self._encode_request(route, body)
+        headers = {"Content-Type": ctype, "Accept": ctype}
         if traceparent:
             headers["traceparent"] = traceparent
-        req = urllib.request.Request(
-            f"{endpoint}{route}", data=data, headers=headers, method="POST",
-        )
+        conn, reused = self._pool_get(endpoint, timeout_s)
         try:
-            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            retry_after = float(e.headers.get("Retry-After") or 0.0)
-            payload = b""
+            status, resp, payload = self._exchange(
+                conn, route, data, headers
+            )
+        except _STALE_SOCKET_ERRORS as e:
+            conn.close()
+            if not reused:
+                # a FRESH connection failing like this is a real
+                # endpoint problem — classify as failover material
+                raise _EndpointDown(f"{endpoint}{route}: {e!r}") from None
+            # first reuse of a kept-alive socket the server closed:
+            # infrastructure staleness — one immediate fresh-connection
+            # retry, no failover charge, no backoff
+            self._bump("stale_retries")
+            conn, _ = self._pool_get(endpoint, timeout_s, fresh=True)
             try:
-                payload = e.read()
-            except OSError:
-                pass
-            if e.code == 429:
-                self._bump("shed_429")
-                raise _Shed(retry_after) from None
-            if e.code in (503, 502, 504, 500):
-                if e.code == 503:
-                    self._bump("unavailable_503")
-                if e.code == 504:
-                    self._bump("deadline_504")
-                raise _EndpointDown(
-                    f"{endpoint}{route} -> {e.code}: {payload[:200]!r}"
-                ) from None
-            # 400/404: a client bug — retrying cannot help
-            raise ValueError(
-                f"{endpoint}{route} -> {e.code}: {payload[:200]!r}"
-            ) from None
-        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                status, resp, payload = self._exchange(
+                    conn, route, data, headers
+                )
+            except (http.client.HTTPException, ConnectionError,
+                    TimeoutError, OSError) as e2:
+                conn.close()
+                raise _EndpointDown(f"{endpoint}{route}: {e2!r}") from None
+        except (http.client.HTTPException, ConnectionError, TimeoutError,
                 OSError) as e:
+            conn.close()
             raise _EndpointDown(f"{endpoint}{route}: {e!r}") from None
+
+        if status == 200:
+            self._pool_put(endpoint, conn, resp.will_close)
+            return self._decode_response(
+                route, resp.getheader("Content-Type") or "", payload
+            )
+        # non-200: error bodies are always JSON (the data plane's
+        # contract) — classify exactly as before
+        retry_after = float(resp.getheader("Retry-After") or 0.0)
+        self._pool_put(endpoint, conn, resp.will_close)
+        if status == 429:
+            self._bump("shed_429")
+            raise _Shed(retry_after)
+        if status in (503, 502, 504, 500):
+            if status == 503:
+                self._bump("unavailable_503")
+            if status == 504:
+                self._bump("deadline_504")
+            raise _EndpointDown(
+                f"{endpoint}{route} -> {status}: {payload[:200]!r}"
+            )
+        # 400/404: a client bug — retrying cannot help
+        raise ValueError(
+            f"{endpoint}{route} -> {status}: {payload[:200]!r}"
+        )
 
     def _call(self, route: str, body: Dict[str, Any]) -> Dict[str, Any]:
         self._bump("requests")
@@ -220,15 +382,15 @@ class ServingClient:
     # ------------------------------------------------------------ routes
 
     def lookup(self, table: str, ids) -> np.ndarray:
-        ids = np.asarray(ids, np.int64).reshape(-1)
-        out = self._call("/v1/lookup", {"table": table, "ids": ids.tolist()})
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        out = self._call("/v1/lookup", {"table": table, "ids": ids})
         return np.asarray(out["rows"], np.float32)
 
     def topk(self, table: str, queries, k: int = 10
              ) -> Tuple[np.ndarray, np.ndarray]:
-        q = np.asarray(queries, np.float32)
+        q = np.ascontiguousarray(np.asarray(queries, np.float32))
         out = self._call(
-            "/v1/topk", {"table": table, "queries": q.tolist(), "k": int(k)}
+            "/v1/topk", {"table": table, "queries": q, "k": int(k)}
         )
         return (
             np.asarray(out["ids"], np.int64),
@@ -236,9 +398,9 @@ class ServingClient:
         )
 
     def predict(self, table: str, X) -> np.ndarray:
-        X = np.asarray(X, np.float32)
+        X = np.ascontiguousarray(np.asarray(X, np.float32))
         out = self._call(
-            "/v1/predict", {"table": table, "features": X.tolist()}
+            "/v1/predict", {"table": table, "features": X}
         )
         return np.asarray(out["scores"], np.float32)
 

@@ -12,10 +12,32 @@ library to network service:
 * ``POST /v1/predict`` — ``{"table", "features": [[...]...]}``
   → ``{"scores"}``.
 
-Every request body may carry ``"tenant"`` (admission-control key,
-default ``"default"``) and ``"deadline_ms"`` (remaining client budget —
-the handler waits at most that long on the batcher future and answers
-504 on expiry, so a slow flush can never pin a client past its SLO).
+**Wire formats.** Two encodings share the routes, negotiated per
+request (``serving/wire.py`` holds the codec):
+
+* ``Content-Type: application/x-mv-frame`` — the binary frame protocol
+  (length-prefixed little-endian header + raw f32/i32 blocks, the
+  reference's Blob/Message data plane). The body is read with ONE
+  ``rfile.read`` and decoded zero-copy: id/query blocks are
+  ``np.frombuffer`` views handed straight to the jitted lookup, and
+  responses are encoded straight from the device-fetched f32 buffer —
+  no per-element Python objects on the hot path.
+* ``Content-Type: application/json`` — the debug/curl path, unchanged.
+
+The RESPONSE format follows ``Accept``: ``x-mv-frame`` there forces
+binary, an explicit ``json`` forces JSON, and with no preference the
+response mirrors the request's format. Error responses are ALWAYS
+JSON (an operator reading a 4xx/5xx should never face hexdumps). A
+frame that fails to decode — bad magic, truncated payload, declared
+block sizes exceeding the received Content-Length — is 400 before it
+can touch the batcher: a malformed frame is never retried and never
+poisons a co-batch.
+
+Every request (either format) may carry ``"tenant"`` (admission-control
+key, default ``"default"``) and ``"deadline_ms"`` (remaining client
+budget — the handler waits at most that long on the batcher future and
+answers 504 on expiry; the deadline also rides the ticket so the
+flusher drops it unserved once expired).
 
 **Error contract** (what ``serving/client.py`` keys on):
 
@@ -25,15 +47,17 @@ the handler waits at most that long on the batcher future and answers
 * breaker open / no snapshot yet (``RouteUnavailable``, unpublished
   server) → **503** (+ ``Retry-After`` when the breaker knows its
   cooldown) — server fault: fail over to another replica;
-* malformed JSON / validation ``CHECK`` failures  → **400** — client
-  bug: do not retry;
+* malformed JSON/frame / validation ``CHECK`` failures → **400** —
+  client bug: do not retry;
 * deadline expiry                                 → **504**.
 
 Each handler thread blocks on its own batcher future, so concurrent
 HTTP requests co-batch through the DynamicBatcher exactly like
 in-process ``*_async`` callers — the micro-batching economics survive
 the network hop. GET requests delegate to ``http_health``'s shared
-handler: one replica port serves probes and data alike.
+handler: one replica port serves probes and data alike. Every response
+carries ``X-MV-Conn`` (a per-accepted-socket id) so clients and tests
+can verify keep-alive reuse — N pooled requests, one handshake.
 
 ``-data_port`` wires it into flag-driven replicas (0 = off, -1 =
 ephemeral with the bound port registered in the health payload's
@@ -42,8 +66,10 @@ ephemeral with the bound port registered in the health payload's
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
+import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
@@ -52,6 +78,7 @@ import numpy as np
 
 from multiverso_tpu.obs import tracer
 from multiverso_tpu.serving import http_health
+from multiverso_tpu.serving import wire
 from multiverso_tpu.serving.batcher import Overloaded
 from multiverso_tpu.serving.server import RouteUnavailable
 from multiverso_tpu.utils.configure import MV_DEFINE_int, GetFlag
@@ -62,13 +89,30 @@ __all__ = ["DataPlaneServer", "maybe_start_data_plane_from_flags"]
 MV_DEFINE_int(
     "data_port", 0,
     "serve the HTTP data plane (POST /v1/lookup, /v1/topk, /v1/predict "
-    "as batched JSON; GET health routes ride along) on this port — the "
-    "replica entry point and serve-while-train layouts arm it "
-    "(0 = off; -1 = ephemeral, bound port lands in the health "
+    "as binary x-mv-frame or JSON; GET health routes ride along) on "
+    "this port — the replica entry point and serve-while-train layouts "
+    "arm it (0 = off; -1 = ephemeral, bound port lands in the health "
     "payload's 'ports' map and the replica endpoint file)",
 )
 
-_MAX_BODY_BYTES = 8 << 20  # one POST can never balloon handler memory
+MV_DEFINE_int(
+    "data_max_body_mb", 8,
+    "largest request body (MB) the data plane accepts on either wire "
+    "format — one POST can never balloon handler memory; oversized "
+    "bodies answer 400",
+)
+
+# per-accepted-socket ids: how tests/clients verify keep-alive reuse
+# (every response on one TCP connection reports the same X-MV-Conn)
+_conn_ids = itertools.count(1)
+
+# response field order per route — the binary block order is part of the
+# wire contract (requests carry exactly one block)
+_RESPONSE_FIELDS = {
+    "/v1/lookup": ("rows",),
+    "/v1/topk": ("ids", "scores"),
+    "/v1/predict": ("scores",),
+}
 
 
 def _np2d(obj: Any, dtype) -> np.ndarray:
@@ -76,6 +120,58 @@ def _np2d(obj: Any, dtype) -> np.ndarray:
     if arr.ndim != 2:
         raise ValueError(f"expected a 2-D array, got shape {arr.shape}")
     return arr
+
+
+def _parse_frame_request(route: str, raw: bytes) -> Dict[str, Any]:
+    """Decode one request frame into the dispatch dict. Zero-copy: the
+    single array block stays an ``np.frombuffer`` view over ``raw``.
+    Raises ``MalformedFrame`` (→ 400) on any structural problem,
+    including a frame route code that contradicts the URL."""
+    code, meta, blocks = wire.decode_frame(raw)
+    expect = wire.ROUTE_CODES.get(route)
+    if expect is not None and code != expect:
+        raise wire.MalformedFrame(
+            f"frame route code {code} does not match {route}"
+        )
+    if len(blocks) != 1:
+        raise wire.MalformedFrame(
+            f"request frames carry exactly 1 block, got {len(blocks)}"
+        )
+    body: Dict[str, Any] = dict(meta)
+    arr = blocks[0]
+    if route == "/v1/lookup":
+        if arr.ndim != 1 or arr.dtype not in (np.int32, np.int64):
+            raise wire.MalformedFrame(
+                f"lookup ids must be a 1-D i32/i64 block, got "
+                f"{arr.dtype} rank {arr.ndim}"
+            )
+        body["ids"] = arr
+    elif route == "/v1/topk":
+        if arr.ndim != 2 or arr.dtype != np.float32:
+            raise wire.MalformedFrame(
+                f"topk queries must be a 2-D f32 block, got "
+                f"{arr.dtype} rank {arr.ndim}"
+            )
+        body["queries"] = arr
+    elif route == "/v1/predict":
+        if arr.ndim != 2 or arr.dtype != np.float32:
+            raise wire.MalformedFrame(
+                f"predict features must be a 2-D f32 block, got "
+                f"{arr.dtype} rank {arr.ndim}"
+            )
+        body["features"] = arr
+    return body
+
+
+def _wire_block(arr: np.ndarray) -> np.ndarray:
+    """Coerce a response array onto a wire dtype (f32/i32/i64 pass
+    through; anything else lands on f32 — responses are scores/rows)."""
+    arr = np.asarray(arr)
+    if arr.dtype in (np.float32, np.int32, np.int64, np.uint8):
+        return arr
+    if np.issubdtype(arr.dtype, np.integer):
+        return arr.astype(np.int64)
+    return arr.astype(np.float32)
 
 
 class DataPlaneServer:
@@ -86,11 +182,16 @@ class DataPlaneServer:
                  *, default_deadline_s: float = 5.0):
         self.table_server = server
         self.default_deadline_s = float(default_deadline_s)
+        self.max_body_bytes = max(1, int(GetFlag("data_max_body_mb"))) << 20
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             # one connection, many requests: load generators reuse sockets
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                self._mv_conn_id = next(_conn_ids)
 
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 route = self.path.split("?", 1)[0]
@@ -101,16 +202,16 @@ class DataPlaneServer:
 
             def do_POST(self):  # noqa: N802
                 route = self.path.split("?", 1)[0]
-                code, payload, retry_after = outer._handle_post(
+                code, ctype, body, retry_after = outer._handle_post(
                     route, self
                 )
-                body = json.dumps(payload, default=str).encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 if retry_after is not None:
                     # fractional seconds: the batcher's hints are ms-scale
                     # and rounding up to 1s would overdamp clients
                     self.send_header("Retry-After", f"{retry_after:.4f}")
+                self.send_header("X-MV-Conn", str(self._mv_conn_id))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -143,19 +244,36 @@ class DataPlaneServer:
 
     def _handle_post(
         self, route: str, handler: BaseHTTPRequestHandler
-    ) -> Tuple[int, Dict[str, Any], Optional[float]]:
-        """Returns ``(status, json_payload, retry_after_s_or_None)``.
+    ) -> Tuple[int, str, bytes, Optional[float]]:
+        """Returns ``(status, content_type, body_bytes, retry_after)``.
         Never raises — every failure mode maps to a status code here so
         a handler thread cannot die mid-response."""
+        binary_req = False
         try:
             length = int(handler.headers.get("Content-Length", 0))
-            if length <= 0 or length > _MAX_BODY_BYTES:
-                return 400, {"error": f"bad Content-Length {length}"}, None
-            body = json.loads(handler.rfile.read(length))
-            if not isinstance(body, dict):
-                return 400, {"error": "request body must be a JSON object"}, None
-        except (ValueError, OSError) as e:
-            return 400, {"error": f"malformed request: {e}"}, None
+            if length <= 0 or length > self.max_body_bytes:
+                return self._json_reply(
+                    400, {"error": f"bad Content-Length {length}"}, None, 0
+                )
+            # ONE read of the whole body — the frame decoder (and
+            # json.loads) parse from this buffer; block payloads stay
+            # zero-copy views over it
+            raw = handler.rfile.read(length)
+            ctype_in = handler.headers.get("Content-Type") or ""
+            binary_req = wire.CONTENT_TYPE in ctype_in
+            if binary_req:
+                body = _parse_frame_request(route, raw)
+            else:
+                body = json.loads(raw)
+                if not isinstance(body, dict):
+                    return self._json_reply(
+                        400, {"error": "request body must be a JSON object"},
+                        None, length,
+                    )
+        except (wire.MalformedFrame, ValueError, OSError) as e:
+            return self._json_reply(
+                400, {"error": f"malformed request: {e}"}, None, 0
+            )
 
         tenant = str(body.get("tenant", "default"))
         try:
@@ -163,7 +281,9 @@ class DataPlaneServer:
                 body.get("deadline_ms", self.default_deadline_s * 1e3)
             ) * 1e-3
         except (TypeError, ValueError):
-            return 400, {"error": "deadline_ms must be a number"}, None
+            return self._json_reply(
+                400, {"error": "deadline_ms must be a number"}, None, length
+            )
 
         # W3C trace context: the client's attempt span_id arrives in the
         # traceparent header; our server span parents under it, and the
@@ -181,49 +301,83 @@ class DataPlaneServer:
                     trace_id=trace_id, span_id=server_sid,
                     parent_id=parent_sid,
                 ):
-                    code, payload, retry_after = self._dispatch(
+                    code, out, retry_after = self._dispatch(
                         route, body, tenant, deadline_s
                     )
             finally:
                 tracer.clear_trace_context()
         else:
-            code, payload, retry_after = self._dispatch(
+            code, out, retry_after = self._dispatch(
                 route, body, tenant, deadline_s
             )
         if code >= 500:
             # availability SLO numerator: server faults, not sheds/4xx
             self.table_server.metrics.record_error()
-        return code, payload, retry_after
+        if code != 200:
+            # errors are ALWAYS JSON — debuggability beats bandwidth on
+            # a path that should be cold
+            return self._json_reply(code, out, retry_after, length)
+
+        accept = handler.headers.get("Accept") or ""
+        binary_resp = wire.CONTENT_TYPE in accept or (
+            binary_req and "json" not in accept
+        )
+        fields = _RESPONSE_FIELDS[route]
+        if binary_resp:
+            blocks = [_wire_block(out[f]) for f in fields]
+            payload = wire.encode_frame(
+                wire.ROUTE_CODES[route] | wire.RESPONSE_BIT,
+                {"version": int(out["version"])},
+                blocks,
+            )
+            self.table_server.metrics.record_wire(True, length, len(payload))
+            return 200, wire.CONTENT_TYPE, payload, retry_after
+        doc = {f: np.asarray(out[f]).tolist() for f in fields}
+        doc["version"] = out["version"]
+        return self._json_reply(200, doc, retry_after, length)
+
+    def _json_reply(
+        self, code: int, doc: Dict[str, Any],
+        retry_after: Optional[float], bytes_in: int,
+    ) -> Tuple[int, str, bytes, Optional[float]]:
+        payload = json.dumps(doc, default=str).encode()
+        self.table_server.metrics.record_wire(False, bytes_in, len(payload))
+        return code, "application/json", payload, retry_after
 
     def _dispatch(
         self, route: str, body: Dict[str, Any], tenant: str,
         deadline_s: float,
     ) -> Tuple[int, Dict[str, Any], Optional[float]]:
         srv = self.table_server
+        # the ticket carries the absolute deadline too, so the flusher
+        # can drop it unserved after we have already answered 504
+        deadline_t = time.monotonic() + deadline_s
         try:
             if route == "/v1/lookup":
                 fut = srv.lookup_async(
-                    body["table"], body["ids"], tenant=tenant
+                    body["table"], body["ids"], tenant=tenant,
+                    deadline_t=deadline_t,
                 )
                 rows = fut.result(timeout=deadline_s)
-                out = {"rows": np.asarray(rows).tolist()}
+                out: Dict[str, Any] = {"rows": np.asarray(rows)}
             elif route == "/v1/topk":
                 fut = srv.topk_async(
                     body["table"], _np2d(body["queries"], np.float32),
                     k=int(body.get("k", 10)), tenant=tenant,
+                    deadline_t=deadline_t,
                 )
                 ids, scores = fut.result(timeout=deadline_s)
                 out = {
-                    "ids": np.asarray(ids).tolist(),
-                    "scores": np.asarray(scores).tolist(),
+                    "ids": np.asarray(ids),
+                    "scores": np.asarray(scores),
                 }
             elif route == "/v1/predict":
                 fut = srv.predict_async(
                     body["table"], _np2d(body["features"], np.float32),
-                    tenant=tenant,
+                    tenant=tenant, deadline_t=deadline_t,
                 )
                 scores = fut.result(timeout=deadline_s)
-                out = {"scores": np.asarray(scores).tolist()}
+                out = {"scores": np.asarray(scores)}
             else:
                 return 404, {
                     "error": "routes: /v1/lookup /v1/topk /v1/predict"

@@ -121,6 +121,13 @@ class ServingMetrics:
         self.queue_depth = 0
         self.swaps = 0
         self.publish_rejects = 0
+        self.expired = 0  # tickets dropped past their client deadline
+        # wire-format accounting (data plane): requests answered per
+        # negotiated response format + raw bytes both directions
+        self.wire_binary = 0
+        self.wire_json = 0
+        self.wire_bytes_in = 0
+        self.wire_bytes_out = 0
         self.last_swap_t: Optional[float] = None  # monotonic; health() age
         self._window_s = float(window_s)
         self._served_times: List[tuple] = []  # (t, n) per flush, pruned
@@ -172,6 +179,24 @@ class ServingMetrics:
         with self._lock:
             self.publish_rejects += 1
 
+    def record_expired(self, n: int = 1) -> None:
+        """Tickets the flusher dropped because their client deadline
+        passed before the batch closed — work the expired-ticket drop
+        saved the device."""
+        with self._lock:
+            self.expired += n
+
+    def record_wire(self, binary: bool, bytes_in: int, bytes_out: int) -> None:
+        """One data-plane exchange: the negotiated RESPONSE format and
+        the raw body bytes that crossed the socket each way."""
+        with self._lock:
+            if binary:
+                self.wire_binary += 1
+            else:
+                self.wire_json += 1
+            self.wire_bytes_in += int(bytes_in)
+            self.wire_bytes_out += int(bytes_out)
+
     def last_swap_age_s(self) -> Optional[float]:
         with self._lock:
             if self.last_swap_t is None:
@@ -218,6 +243,11 @@ class ServingMetrics:
                 "queue_depth": self.queue_depth,
                 "swaps": self.swaps,
                 "publish_rejects": self.publish_rejects,
+                "expired": self.expired,
+                "wire_binary": self.wire_binary,
+                "wire_json": self.wire_json,
+                "wire_bytes_in": self.wire_bytes_in,
+                "wire_bytes_out": self.wire_bytes_out,
             }
             routes = sorted(self.route_latency.items())
         out: Dict[str, object] = dict(snap)

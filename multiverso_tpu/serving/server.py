@@ -651,11 +651,13 @@ class TableServer:
     # as a backstop, e.g. a hot-swap shrinking the table mid-flight).
 
     def lookup_async(self, name: str, ids, block: bool = False,
-                     tenant: str = "default"):
+                     tenant: str = "default", deadline_t=None):
         """Enqueue a lookup through the dynamic batcher; returns a Future
         of the (n, D) rows. Raises ``Overloaded`` when shedding (tenant
         over admission budget, full queue, or — the ``RouteUnavailable``
-        subclass — an open breaker)."""
+        subclass — an open breaker). ``deadline_t`` (absolute monotonic)
+        lets the flusher drop the ticket unserved once the client's
+        budget has expired."""
         self._require_started()
         ids = np.asarray(ids, np.int32).reshape(-1)
         table = self._table(self.snapshot, name)
@@ -667,10 +669,12 @@ class TableServer:
         )
         self._admit(tenant, ids.size)
         self._shed_if_open(f"lookup:{name}")
-        return self._batcher.submit(f"lookup:{name}", ids, block=block)
+        return self._batcher.submit(
+            f"lookup:{name}", ids, block=block, deadline_t=deadline_t
+        )
 
     def topk_async(self, name: str, queries, k: int = 10, block: bool = False,
-                   tenant: str = "default"):
+                   tenant: str = "default", deadline_t=None):
         self._require_started()
         q = np.asarray(queries, np.float32)
         table = self._table(self.snapshot, name)
@@ -682,10 +686,12 @@ class TableServer:
         CHECK(1 <= k <= table.shape[0], f"k={k} out of range")
         self._admit(tenant, q.shape[0])
         self._shed_if_open(f"topk:{name}:{int(k)}")
-        return self._batcher.submit(f"topk:{name}:{int(k)}", q, block=block)
+        return self._batcher.submit(
+            f"topk:{name}:{int(k)}", q, block=block, deadline_t=deadline_t
+        )
 
     def predict_async(self, name: str, X, block: bool = False,
-                      tenant: str = "default"):
+                      tenant: str = "default", deadline_t=None):
         self._require_started()
         X = np.asarray(X, np.float32)
         W = self._table(self.snapshot, name)
@@ -695,7 +701,9 @@ class TableServer:
         )
         self._admit(tenant, X.shape[0])
         self._shed_if_open(f"predict:{name}")
-        return self._batcher.submit(f"predict:{name}", X, block=block)
+        return self._batcher.submit(
+            f"predict:{name}", X, block=block, deadline_t=deadline_t
+        )
 
     def _require_started(self) -> None:
         with self._lifecycle_lock:

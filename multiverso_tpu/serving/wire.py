@@ -1,0 +1,287 @@
+"""Length-prefixed binary wire format for the serving data plane.
+
+The reference Multiverso never ships a float as text: its whole data
+plane is the Blob/Message binary protocol (ref: include/multiverso/
+message.h, blob.h — a header of sizes followed by raw memory). This
+module is that protocol for our HTTP data plane: one little-endian
+frame per request/response, negotiated by ``Content-Type:
+application/x-mv-frame`` so JSON stays available for curl/debugging.
+
+Frame layout (all little-endian)::
+
+    offset  size  field
+    0       4     magic  b"MVF1"
+    4       1     version (currently 1)
+    5       1     route code (requests 1..3 = lookup/topk/predict;
+                  responses set the 0x80 bit: 0x81..0x83)
+    6       2     nblocks (u16) — number of array blocks
+    8       4     meta_nbytes (u32) — size of the meta section
+    12      ...   meta section: u16 pair count, then per pair a
+                  length-prefixed utf-8 key (u16 len + bytes) and a
+                  tagged value (u8 tag: 0 = u32-len-prefixed utf-8
+                  string, 1 = f64, 2 = i64)
+    ...     20*n  block descriptors: ``<BBH4I`` = dtype code (0 = f32,
+                  1 = i32, 2 = i64, 3 = u8), ndim (<= 4), reserved u16,
+                  dims[4] (unused dims are 1)
+    ...     ...   block payloads, each 8-byte aligned, raw C-order bytes
+
+No per-element Python objects ever materialize: ``encode_frame`` is
+``struct.pack`` headers + ``ndarray.tobytes`` payloads, and
+``decode_frame`` returns ``np.frombuffer`` views over the request body
+(zero-copy — callers hand them straight to ``jnp.asarray`` on the
+padded pow-2 bucket).
+
+Every malformed condition — bad magic/version, unknown dtype, declared
+block sizes exceeding the buffer (the Content-Length oversize check),
+truncated payloads — raises ``MalformedFrame``, which the data plane
+maps to 400: a malformed frame is a client bug, never retried and
+never allowed to reach the batcher where it could poison a co-batch.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CONTENT_TYPE",
+    "MAGIC",
+    "VERSION",
+    "ROUTE_CODES",
+    "ROUTE_NAMES",
+    "RESPONSE_BIT",
+    "MalformedFrame",
+    "encode_frame",
+    "decode_frame",
+]
+
+CONTENT_TYPE = "application/x-mv-frame"
+MAGIC = b"MVF1"
+VERSION = 1
+RESPONSE_BIT = 0x80
+
+# URL route <-> frame route code. The frame carries the code so a frame
+# POSTed to the wrong URL is rejected before dispatch.
+ROUTE_CODES: Dict[str, int] = {
+    "/v1/lookup": 1,
+    "/v1/topk": 2,
+    "/v1/predict": 3,
+}
+ROUTE_NAMES: Dict[int, str] = {v: k for k, v in ROUTE_CODES.items()}
+
+_HEADER = struct.Struct("<4sBBHI")          # magic, version, route, nblocks, meta_nbytes
+_BLOCK_DESC = struct.Struct("<BBH4I")       # dtype, ndim, reserved, dims[4]
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_F64 = struct.Struct("<d")
+_I64 = struct.Struct("<q")
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.int32): 1,
+    np.dtype(np.int64): 2,
+    np.dtype(np.uint8): 3,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+
+_MAX_NDIM = 4
+_ALIGN = 8
+
+
+class MalformedFrame(ValueError):
+    """A frame the codec refuses: client bug, mapped to 400, no retry."""
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+# ------------------------------------------------------------------ meta
+
+
+def _encode_meta(meta: Dict[str, Any]) -> bytes:
+    parts: List[bytes] = [_U16.pack(len(meta))]
+    for key, value in meta.items():
+        kb = key.encode("utf-8")
+        if len(kb) > 0xFFFF:
+            raise MalformedFrame(f"meta key too long: {key[:32]}...")
+        parts.append(_U16.pack(len(kb)))
+        parts.append(kb)
+        if isinstance(value, bool):
+            # bools ride as i64 — no dedicated tag needed
+            parts.append(b"\x02" + _I64.pack(int(value)))
+        elif isinstance(value, (int, np.integer)):
+            parts.append(b"\x02" + _I64.pack(int(value)))
+        elif isinstance(value, (float, np.floating)):
+            parts.append(b"\x01" + _F64.pack(float(value)))
+        elif isinstance(value, str):
+            vb = value.encode("utf-8")
+            parts.append(b"\x00" + _U32.pack(len(vb)) + vb)
+        else:
+            raise MalformedFrame(
+                f"meta value for {key!r} must be str/int/float, "
+                f"got {type(value).__name__}"
+            )
+    return b"".join(parts)
+
+
+def _decode_meta(buf: memoryview) -> Dict[str, Any]:
+    try:
+        (count,) = _U16.unpack_from(buf, 0)
+        off = _U16.size
+        meta: Dict[str, Any] = {}
+        for _ in range(count):
+            (klen,) = _U16.unpack_from(buf, off)
+            off += _U16.size
+            if len(buf) < off + klen:
+                raise MalformedFrame("meta key truncated")
+            key = bytes(buf[off:off + klen]).decode("utf-8")
+            off += klen
+            tag = buf[off]
+            off += 1
+            if tag == 0:
+                (vlen,) = _U32.unpack_from(buf, off)
+                off += _U32.size
+                if len(buf) < off + vlen:
+                    raise MalformedFrame("meta string truncated")
+                meta[key] = bytes(buf[off:off + vlen]).decode("utf-8")
+                off += vlen
+            elif tag == 1:
+                (meta[key],) = _F64.unpack_from(buf, off)
+                off += _F64.size
+            elif tag == 2:
+                (meta[key],) = _I64.unpack_from(buf, off)
+                off += _I64.size
+            else:
+                raise MalformedFrame(f"unknown meta value tag {tag}")
+        if off != len(buf):
+            raise MalformedFrame(
+                f"meta section has {len(buf) - off} trailing bytes"
+            )
+        return meta
+    except (struct.error, UnicodeDecodeError, IndexError) as e:
+        raise MalformedFrame(f"bad meta section: {e}") from None
+
+
+# ----------------------------------------------------------------- frame
+
+
+def encode_frame(
+    route_code: int,
+    meta: Dict[str, Any],
+    blocks: Sequence[np.ndarray],
+) -> bytes:
+    """One binary frame: header + meta + block descriptors + raw
+    payloads. ``blocks`` arrays must be one of the wire dtypes (f32,
+    i32, i64, u8) with <= 4 dims; non-contiguous inputs are copied
+    (``tobytes`` is C-order), contiguous ones are not."""
+    if not 0 <= route_code <= 0xFF:
+        raise MalformedFrame(f"route code {route_code} out of range")
+    if len(blocks) > 0xFFFF:
+        raise MalformedFrame(f"too many blocks: {len(blocks)}")
+    meta_b = _encode_meta(meta)
+    descs: List[bytes] = []
+    payloads: List[bytes] = []
+    for arr in blocks:
+        arr = np.asarray(arr)
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:
+            raise MalformedFrame(f"unsupported wire dtype {arr.dtype}")
+        if arr.ndim > _MAX_NDIM:
+            raise MalformedFrame(f"block rank {arr.ndim} > {_MAX_NDIM}")
+        dims = list(arr.shape) + [1] * (_MAX_NDIM - arr.ndim)
+        if any(d > 0xFFFFFFFF for d in dims):
+            raise MalformedFrame("block dim exceeds u32")
+        descs.append(_BLOCK_DESC.pack(code, arr.ndim, 0, *dims))
+        payloads.append(arr.tobytes())
+    out = bytearray(
+        _HEADER.pack(MAGIC, VERSION, route_code, len(blocks), len(meta_b))
+    )
+    out += meta_b
+    for d in descs:
+        out += d
+    for p in payloads:
+        pad = _align(len(out)) - len(out)
+        out += b"\x00" * pad
+        out += p
+    return bytes(out)
+
+
+def decode_frame(
+    buf: bytes, *, max_bytes: int = 0
+) -> Tuple[int, Dict[str, Any], List[np.ndarray]]:
+    """Parse one frame into ``(route_code, meta, blocks)``. Blocks are
+    read-only ``np.frombuffer`` views over ``buf`` (zero-copy). The
+    declared sizes (meta + every block payload) are checked against
+    ``len(buf)`` BEFORE any payload is touched — a frame that declares
+    more data than arrived (the Content-Length oversize case) raises
+    ``MalformedFrame``, as do trailing bytes past the last block."""
+    if max_bytes and len(buf) > max_bytes:
+        raise MalformedFrame(
+            f"frame of {len(buf)} bytes exceeds limit {max_bytes}"
+        )
+    view = memoryview(buf)
+    if len(view) < _HEADER.size:
+        raise MalformedFrame(
+            f"frame of {len(view)} bytes is shorter than the header"
+        )
+    magic, version, route_code, nblocks, meta_nbytes = _HEADER.unpack_from(
+        view, 0
+    )
+    if magic != MAGIC:
+        raise MalformedFrame(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise MalformedFrame(f"unsupported frame version {version}")
+    off = _HEADER.size
+    if len(view) < off + meta_nbytes + nblocks * _BLOCK_DESC.size:
+        raise MalformedFrame(
+            "declared meta/descriptor sizes exceed the frame"
+        )
+    meta = _decode_meta(view[off:off + meta_nbytes])
+    off += meta_nbytes
+
+    # first pass: validate EVERY declared block size against the buffer
+    # before materializing any view, so an oversized declaration fails
+    # atomically (nothing half-decoded reaches the caller)
+    shapes: List[Tuple[np.dtype, Tuple[int, ...]]] = []
+    desc_off = off
+    payload_off = off + nblocks * _BLOCK_DESC.size
+    offsets: List[int] = []
+    for _ in range(nblocks):
+        code, ndim, _reserved, *dims = _BLOCK_DESC.unpack_from(view, desc_off)
+        desc_off += _BLOCK_DESC.size
+        dtype = _CODE_DTYPES.get(code)
+        if dtype is None:
+            raise MalformedFrame(f"unknown block dtype code {code}")
+        if ndim > _MAX_NDIM:
+            raise MalformedFrame(f"block rank {ndim} > {_MAX_NDIM}")
+        shape = tuple(int(d) for d in dims[:ndim])
+        count = 1
+        for d in shape:
+            count *= d
+        nbytes = count * dtype.itemsize
+        payload_off = _align(payload_off)
+        if payload_off + nbytes > len(view):
+            raise MalformedFrame(
+                f"declared block of {nbytes} bytes exceeds the "
+                f"{len(view)}-byte frame"
+            )
+        shapes.append((dtype, shape))
+        offsets.append(payload_off)
+        payload_off += nbytes
+
+    if payload_off != len(view):
+        raise MalformedFrame(
+            f"frame has {len(view) - payload_off} trailing bytes"
+        )
+
+    blocks: List[np.ndarray] = []
+    for (dtype, shape), boff in zip(shapes, offsets):
+        count = 1
+        for d in shape:
+            count *= d
+        arr = np.frombuffer(view, dtype=dtype, count=count, offset=boff)
+        blocks.append(arr.reshape(shape))
+    return route_code, meta, blocks

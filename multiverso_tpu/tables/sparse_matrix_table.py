@@ -290,10 +290,21 @@ class SparseMatrixTable(MatrixTable):
         wire — lossless (values are exact float32 copies), bit-exact vs
         the unpacked pull, and a large cut whenever the bucket is mostly
         padding or the rows are mostly zero (freshly-initialized output/
-        g2 tables). Falls back to the dense gather when the packed form
-        would not be smaller, and on the multi-process path (where the
-        gather is one SPMD collective program; packing there is future
-        work) — ``wire_bytes`` reports whichever form moved."""
+        g2 tables). Multi-process, the pack runs inside the SAME SPMD
+        gather program on the cross-rank-agreed ``round_bucket``: a tiny
+        nnz allgather agrees the pack capacity (every rank must compile
+        the identical program), each rank's block packs into its own
+        worker-axis slice, and only its (idx, val) slice is read back
+        (``_pull_rows_packed_multi``). Falls back to the dense gather —
+        on EVERY rank, the fallback decision is computed from the
+        allgathered max — when the packed form would not be smaller.
+
+        Byte accounting is identical in single- and multi-process modes
+        so bench deltas compare: a packed pull reports ``cap * 8 + 8``
+        (the pow-2 pack CAPACITY the program is compiled for — idx i32 +
+        val f32 per slot, + the count scalar — not the live nnz), and a
+        dense pull reports ``padded_rows * row_bytes``; ``wire_bytes``
+        reports whichever form actually moved."""
         import jax
 
         option = option or GetOption()
@@ -333,10 +344,13 @@ class SparseMatrixTable(MatrixTable):
         n = stale.size
         padded = np.zeros(bucket, np.int64)
         padded[:n] = stale
-        rows = self.get_rows_local(padded)[:n]
+        if packed:
+            rows, nbytes = self._pull_rows_packed_multi(stale, bucket)
+        else:
+            rows, nbytes = self.get_rows_local(padded)[:n], bucket * row_b
         if n:
             self._up_to_date[w, stale] = True
-        return stale, rows, bucket, bucket * row_b
+        return stale, rows, bucket, nbytes
 
     def _pull_rows_packed(self, stale: np.ndarray,
                           padded_n: int) -> Tuple[np.ndarray, int]:
@@ -398,4 +412,131 @@ class SparseMatrixTable(MatrixTable):
         flat = np.zeros(padded_n * C, np.float32)
         flat[idx[:count]] = vals[:count]
         rows = flat.reshape(padded_n, C)[:n].astype(self.dtype)
+        return rows, int(idx.nbytes + vals.nbytes + 8)
+
+    def _pull_rows_packed_multi(self, stale: np.ndarray,
+                                bucket: int) -> Tuple[np.ndarray, int]:
+        """Multi-process packed stale pull: the SPMD twin of
+        ``_pull_rows_packed``. Every rank joins the same two jitted
+        programs over the cross-rank-agreed ``bucket``:
+
+        1. a count program gathers + masks each rank's block of the
+           global bucket and emits per-rank nonzero counts onto the
+           worker axis (each rank reads back only its own scalar);
+        2. one tiny host allgather of those counts fixes the pack
+           capacity — and the dense-fallback decision — identically on
+           every rank (SPMD ranks must compile the identical program);
+        3. the pack program re-gathers and ``sparse_pack_jnp``-packs
+           each rank's block into its worker-axis slice, so each rank
+           reads back only its own (idx, val) pairs — the dense-row
+           device->host wire never moves.
+
+        The reconstruction is the single-process one (scatter the pairs
+        into a zeroed flat bucket): lossless, bit-exact vs the dense
+        SPMD gather. Returns ``(rows[:n], wire_bytes)`` with the same
+        ``cap * 8 + 8`` accounting as the single-process pack."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+
+        from multiverso_tpu.parallel import mesh as mesh_lib
+        from multiverso_tpu.parallel import multihost
+        from multiverso_tpu.tables.base import bucket_from_extent
+        from multiverso_tpu.utils import next_pow2
+        from multiverso_tpu.utils import quantization as q
+
+        n = int(stale.size)
+        C = self.num_col
+        row_b = C * self.dtype.itemsize
+        nproc = jax.process_count()
+        lw = max(1, self.num_workers // nproc)
+        padded = np.zeros(bucket, np.int64)
+        padded[:n] = stale
+        _, ids_g = self._local_rows_prep(padded)
+        # per-rank valid count as a worker-axis operand: rank r's block
+        # mask reads nv[r * lw] inside the program — no host branch on a
+        # per-rank value ever shapes the (identical) compiled program
+        nv_g = multihost.host_local_to_global(
+            self.mesh, P(mesh_lib.WORKER_AXIS),
+            np.full(lw, n, np.int32),
+        )
+        access = self.updater.access
+        ws1 = mesh_lib.worker_sharding(self.mesh, 1)
+
+        def _rank_flats(storage, ids_d, nv_d):
+            rows = jnp.take(access(storage), ids_d, axis=0)
+            flats = []
+            for r in range(nproc):
+                blk = rows[r * bucket:(r + 1) * bucket]
+                valid = (
+                    jnp.arange(bucket, dtype=jnp.int32) < nv_d[r * lw]
+                ).astype(blk.dtype)
+                flats.append((blk * valid[:, None]).reshape(-1))
+            return flats
+
+        count_key = ("stale_countL", bucket)
+        count_fn = self._compiled.get(count_key)
+        if count_fn is None:
+            def runc(storage, ids_d, nv_d):
+                return jnp.concatenate([
+                    jnp.broadcast_to(
+                        jnp.count_nonzero(f).astype(jnp.int32), (lw,)
+                    )
+                    for f in _rank_flats(storage, ids_d, nv_d)
+                ])
+
+            count_fn = jax.jit(runc, out_shardings=ws1)
+            self._compiled[count_key] = count_fn
+        counts_g = count_fn(self.storage, ids_g, nv_g)
+        nnz_own = int(np.asarray(
+            multihost.global_to_host_local(
+                counts_g, P(mesh_lib.WORKER_AXIS)
+            )
+        )[0])
+        # rank-agreed capacity AND fallback decision from the allgathered
+        # max — every rank takes the same branch and compiles the same
+        # program (pow-2 sizing keyed like the single-process pack, then
+        # rounded onto the worker extent for the output sharding)
+        nnz_max = int(np.asarray(multihost_utils.process_allgather(
+            np.asarray([nnz_own], np.int64)
+        )).max())
+        cap = bucket_from_extent(
+            max(8, next_pow2(max(nnz_max, 1))), lw
+        )
+        if cap * 8 + 8 >= bucket * row_b:
+            return self.get_rows_local(padded)[:n], bucket * row_b
+        pack_key = ("stale_packL", bucket, cap)
+        pack_fn = self._compiled.get(pack_key)
+        if pack_fn is None:
+            def runp(storage, ids_d, nv_d):
+                counts, idxs, vals = [], [], []
+                for f in _rank_flats(storage, ids_d, nv_d):
+                    c_r, i_r, v_r = q.sparse_pack_jnp(f, cap)
+                    counts.append(jnp.broadcast_to(c_r, (lw,)))
+                    idxs.append(i_r)
+                    vals.append(v_r)
+                return (
+                    jnp.concatenate(counts),
+                    jnp.concatenate(idxs),
+                    jnp.concatenate(vals),
+                )
+
+            pack_fn = jax.jit(runp, out_shardings=(ws1, ws1, ws1))
+            self._compiled[pack_key] = pack_fn
+        counts_g, idx_g, vals_g = pack_fn(self.storage, ids_g, nv_g)
+        count = int(np.asarray(
+            multihost.global_to_host_local(
+                counts_g, P(mesh_lib.WORKER_AXIS)
+            )
+        )[0])
+        idx = np.asarray(
+            multihost.global_to_host_local(idx_g, P(mesh_lib.WORKER_AXIS))
+        )
+        vals = np.asarray(
+            multihost.global_to_host_local(vals_g, P(mesh_lib.WORKER_AXIS))
+        )
+        flat = np.zeros(bucket * C, np.float32)
+        flat[idx[:count]] = vals[:count]
+        rows = flat.reshape(bucket, C)[:n].astype(self.dtype)
         return rows, int(idx.nbytes + vals.nbytes + 8)

@@ -23,7 +23,14 @@ mode=shard_pipelined: uneven shards through the PIPELINED PS path
             dirty-row tracked sparse pulls) — the cross-process leg of
             the reference's -is_pipeline Communicator.
 mode=shard_pipelined_sparse: same plus -ps_compress=sparse (packed delta
-            pushes unpacked inside the SPMD scatter program).
+            pushes unpacked inside the SPMD scatter program; with
+            -ps_pull_packed=auto this also engages the packed SPMD pull).
+mode=shard_pipelined_packed: shard_pipelined with -ps_pull_packed=on and
+            -ps_compress=none — isolates the pull-direction packing (the
+            bit-exactness pin diffs this against plain shard_pipelined).
+            WORKER_OK gains pull_wire=/pull_dense= cumulative byte
+            counters so the driver (and the bench 2-proc leg) can assert
+            packed pulls moved fewer bytes than dense.
 mode=shard_pipelined_trace: shard_pipelined with the span tracer armed
             (-trace_dir=<shared_root>/trace; shared_root required) — the
             obs smoke merges both ranks' dumps and checks the per-rank
@@ -151,6 +158,7 @@ def main():
         ps_pipeline_depth_max=3,
         ps_depth_decide_rounds=2,
         ps_compress="sparse" if mode.endswith("pipelined_sparse") else "none",
+        ps_pull_packed="on" if mode.endswith("pipelined_packed") else "auto",
         checkpoint_dir=f"{shared_root}/ck" if chaos_mode else "",
         checkpoint_every_steps=2 if chaos_mode else 0,
     )
@@ -182,10 +190,17 @@ def main():
             f" depth_final={we._ps_depth_final} decisions={len(decs)} "
             f"widens={widens}"
         )
+    pull_stats = ""
+    if "pipelined" in mode:
+        st = we._ps_stats
+        pull_stats = (
+            f" pull_wire={st.pull_bytes_wire} "
+            f"pull_dense={st.pull_rows_dense * opt.size * 4}"
+        )
     print(
         f"WORKER_OK pid={pid} pairs={we.words_trained} "
         f"global={we._ps_global_pairs} rounds={len(we._ps_lr_trace)} "
-        f"lr_trace={trace}{auto_stats}",
+        f"lr_trace={trace}{auto_stats}{pull_stats}",
         flush=True,
     )
 

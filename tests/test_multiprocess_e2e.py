@@ -208,6 +208,11 @@ print("GOLDEN_OK")
     # routes packed delta pushes through the in-program unpack scatter
     (2, "shard_pipelined"),
     (2, "shard_pipelined_sparse"),
+    # pull-direction packing isolated (-ps_pull_packed=on, compress
+    # none): the pack runs inside the SPMD pull program on a
+    # rank-agreed pow-2 capacity, so the collective sequence must stay
+    # lockstep and the moved bytes must undercut the dense pull
+    (2, "shard_pipelined_packed"),
 ])
 def test_ps_wordembedding_sharded_corpus(tmp_path, nproc, mode):
     """Unequal corpus shards: block counts differ per rank, so the tail
@@ -240,6 +245,41 @@ def test_ps_wordembedding_sharded_corpus(tmp_path, nproc, mode):
     pairs = [int(re.search(r" pairs=(\d+)", o).group(1)) for o in logs]
     finals = [int(re.search(r"global=(\d+)", o).group(1)) for o in logs]
     assert all(f == sum(pairs) for f in finals), (finals, pairs)
+    if mode == "shard_pipelined_packed":
+        # packed pulls ship (idx,val) pairs on a pod-agreed pow-2
+        # capacity — on this mostly-stale-sparse workload they must move
+        # strictly fewer bytes than the dense row blocks
+        for o in logs:
+            wire = int(re.search(r"pull_wire=(\d+)", o).group(1))
+            dense = int(re.search(r"pull_dense=(\d+)", o).group(1))
+            assert 0 < wire < dense, (wire, dense)
+
+
+@pytest.mark.slow
+def test_ps_packed_pull_bit_exact_vs_dense(tmp_path):
+    """ISSUE 16 pin: the packed SPMD pull is lossless — a 2-process
+    pipelined run with -ps_pull_packed=on must land on BIT-IDENTICAL
+    final embeddings vs the same run pulling dense rows (same blocks,
+    same reduction order; the pack/unpack only re-encodes the moved
+    values, it never rounds them)."""
+    import numpy as np
+
+    corpus_path, _ = _ps_corpus(tmp_path)
+    embs = {}
+    for mode in ("shard_pipelined", "shard_pipelined_packed"):
+        outs = [tmp_path / f"emb_{mode}_{i}.npy" for i in range(2)]
+        _run_cluster(
+            "multiprocess_ps_worker.py",
+            lambda i: [corpus_path, outs[i], mode],
+            nproc=2,
+            timeout=300,
+        )
+        embs[mode] = np.load(outs[0])
+    np.testing.assert_allclose(
+        embs["shard_pipelined"], embs["shard_pipelined_packed"],
+        rtol=0, atol=0,
+    )
+    assert np.abs(embs["shard_pipelined"]).max() > 1e-3
 
 
 def _ftrl_rank_file(tmp_path, rank: int):

@@ -1,0 +1,353 @@
+"""Binary wire protocol: codec round-trips, malformed-frame rejection,
+format negotiation, and the keep-alive connection pool.
+
+serving/wire.py is the reference's Blob/Message data plane over HTTP —
+no floats as text. These tests pin (a) the codec itself (lossless for
+every wire dtype including NaN/inf payloads, atomic rejection of every
+malformed shape), (b) the per-request format negotiation matrix
+(Content-Type in, Accept out, errors always JSON), and (c) the fleet
+client's pooled keep-alive transport: N requests, one TCP handshake,
+with a server-closed socket retried as infrastructure staleness rather
+than charged as a replica failover.
+"""
+
+import http.client
+import json
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.serving import (
+    DataPlaneServer,
+    MalformedFrame,
+    ServingClient,
+    TableServer,
+    decode_frame,
+    encode_frame,
+)
+from multiverso_tpu.serving import wire
+
+
+# ---------------------------------------------------------------- codec
+
+
+def _roundtrip(route_code, meta, blocks):
+    code, m, out = decode_frame(encode_frame(route_code, meta, blocks))
+    assert code == route_code
+    assert m == meta
+    assert len(out) == len(blocks)
+    for a, b in zip(blocks, out):
+        a = np.asarray(a)
+        assert a.dtype == b.dtype and a.shape == b.shape
+        # bit-level equality: NaN payloads and -0.0 must survive
+        assert a.tobytes() == b.tobytes()
+    return out
+
+
+def test_wire_roundtrip_every_dtype():
+    _roundtrip(1, {"table": "emb"}, [np.arange(7, dtype=np.int32)])
+    _roundtrip(1, {}, [np.arange(5, dtype=np.int64)])
+    _roundtrip(2, {"k": 3}, [np.random.RandomState(0)
+                             .randn(4, 8).astype(np.float32)])
+    _roundtrip(3, {}, [np.frombuffer(b"\x00\x01\xff", np.uint8)])
+
+
+def test_wire_roundtrip_empty_and_large_batches():
+    # empty batch: a (0,) ids block and a (0, 4) query block are legal
+    _roundtrip(1, {"table": "emb"}, [np.zeros(0, np.int32)])
+    _roundtrip(2, {"table": "emb", "k": 1},
+               [np.zeros((0, 4), np.float32)])
+    # large batch: past any header/alignment edge effects
+    big = np.random.RandomState(1).randn(2048, 64).astype(np.float32)
+    _roundtrip(2, {"table": "emb"}, [big])
+
+
+def test_wire_roundtrip_nan_inf_bit_exact():
+    vals = np.array(
+        [np.nan, np.inf, -np.inf, -0.0, 1e-45, 3.4e38], np.float32
+    ).reshape(2, 3)
+    (out,) = _roundtrip(3, {}, [vals])
+    assert np.isnan(out[0, 0]) and np.isposinf(out[0, 1])
+
+
+def test_wire_roundtrip_meta_types_and_multiblock():
+    meta = {"table": "emb", "k": 10, "deadline_ms": 12.5,
+            "tenant": "t-1", "flag": True}
+    ids = np.arange(3, dtype=np.int64)
+    scores = np.ones((3, 2), np.float32)
+    code, m, blocks = decode_frame(
+        encode_frame(0x82, meta, [ids, scores])
+    )
+    assert code == 0x82
+    assert m["table"] == "emb" and m["k"] == 10
+    assert m["deadline_ms"] == 12.5 and m["flag"] == 1  # bool rides i64
+    assert blocks[0].dtype == np.int64 and blocks[1].dtype == np.float32
+
+
+def test_wire_rejects_truncated_and_oversized_frames():
+    frame = encode_frame(1, {"table": "emb"},
+                         [np.arange(16, dtype=np.int32)])
+    # truncation anywhere must fail atomically, never return partial data
+    for cut in (0, 3, wire._HEADER.size - 1, len(frame) // 2,
+                len(frame) - 1):
+        with pytest.raises(MalformedFrame):
+            decode_frame(frame[:cut])
+    # oversized: declared block sizes exceeding the received body (the
+    # Content-Length lie) — grow a dim in the descriptor without payload
+    hdr = wire._HEADER.size
+    (meta_len,) = wire._U32.unpack_from(frame, hdr - 4)
+    desc_off = hdr + meta_len
+    bad = bytearray(frame)
+    wire._BLOCK_DESC.pack_into(bad, desc_off, 1, 1, 0, 1 << 20, 1, 1, 1)
+    with pytest.raises(MalformedFrame):
+        decode_frame(bytes(bad))
+    # trailing garbage past the last block is equally malformed
+    with pytest.raises(MalformedFrame):
+        decode_frame(frame + b"\x00" * 8)
+
+
+def test_wire_rejects_bad_magic_version_dtype_and_limit():
+    frame = encode_frame(1, {}, [np.arange(4, dtype=np.int32)])
+    with pytest.raises(MalformedFrame):
+        decode_frame(b"XXXX" + frame[4:])
+    with pytest.raises(MalformedFrame):
+        decode_frame(frame[:4] + b"\x7f" + frame[5:])  # version 127
+    bad = bytearray(frame)
+    hdr = wire._HEADER.size
+    (meta_len,) = wire._U32.unpack_from(frame, hdr - 4)
+    bad[hdr + meta_len] = 0xEE  # unknown dtype code
+    with pytest.raises(MalformedFrame):
+        decode_frame(bytes(bad))
+    with pytest.raises(MalformedFrame):
+        decode_frame(frame, max_bytes=len(frame) - 1)
+    with pytest.raises(MalformedFrame):
+        encode_frame(1, {"bad": object()}, [])  # unencodable meta
+
+
+def test_wire_decode_is_zero_copy():
+    ids = np.arange(32, dtype=np.int32)
+    frame = encode_frame(1, {}, [ids])
+    _, _, (out,) = decode_frame(frame)
+    assert not out.flags.writeable  # a view over the request bytes
+    assert np.array_equal(out, ids)
+
+
+# ---------------------------------------------------- negotiation matrix
+
+
+@pytest.fixture
+def served(mv_env):
+    emb = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        yield srv, dp, emb
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+def _raw_post(url, route, data, headers):
+    u = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    try:
+        conn.request("POST", route, body=data, headers=headers)
+        resp = conn.getresponse()
+        payload = resp.read()
+        return resp.status, resp.getheader("Content-Type") or "", payload
+    finally:
+        conn.close()
+
+
+def _lookup_frame(ids):
+    return encode_frame(
+        wire.ROUTE_CODES["/v1/lookup"], {"table": "emb"},
+        [np.asarray(ids, np.int32)],
+    )
+
+
+def test_http_negotiation_matrix(served):
+    _, dp, emb = served
+    frame = _lookup_frame([0, 5])
+    jdoc = json.dumps({"table": "emb", "ids": [0, 5]}).encode()
+    FR, JS = wire.CONTENT_TYPE, "application/json"
+    cases = [
+        (frame, FR, None, FR),   # binary in -> binary out (mirror)
+        (frame, FR, FR, FR),     # binary in, binary Accept
+        (frame, FR, "*/*", FR),  # no JSON preference: keep binary
+        (frame, FR, JS, JS),     # explicit Accept json wins (debug tap)
+        (jdoc, JS, None, JS),    # JSON in -> JSON out (curl unchanged)
+        (jdoc, JS, FR, FR),      # JSON request may ask binary back
+        (jdoc, JS, "*/*", JS),
+    ]
+    for data, ctype, accept, want in cases:
+        headers = {"Content-Type": ctype}
+        if accept:
+            headers["Accept"] = accept
+        status, ct_out, payload = _raw_post(
+            dp.url, "/v1/lookup", data, headers
+        )
+        assert status == 200, (accept, payload[:200])
+        assert want in ct_out, (ctype, accept, ct_out)
+        if want == FR:
+            code, meta, (rows,) = decode_frame(payload)
+            assert code == wire.ROUTE_CODES["/v1/lookup"] | wire.RESPONSE_BIT
+            assert meta["version"] == 1
+        else:
+            rows = np.asarray(json.loads(payload)["rows"], np.float32)
+        assert np.array_equal(np.asarray(rows, np.float32), emb[[0, 5]])
+
+
+def test_http_binary_request_errors_are_json(served):
+    _, dp, _ = served
+    # out-of-range ids: validation failure on a binary request with a
+    # binary Accept must STILL answer a JSON error body (operator
+    # debuggability beats bandwidth on the cold path)
+    status, ctype, payload = _raw_post(
+        dp.url, "/v1/lookup", _lookup_frame([999]),
+        {"Content-Type": wire.CONTENT_TYPE, "Accept": wire.CONTENT_TYPE},
+    )
+    assert status == 400
+    assert "json" in ctype
+    assert "error" in json.loads(payload)
+
+
+def test_http_malformed_frame_is_400_and_connection_survives(served):
+    _, dp, emb = served
+    frame = _lookup_frame([0, 1])
+    u = urllib.parse.urlsplit(dp.url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    try:
+        hdr = {"Content-Type": wire.CONTENT_TYPE}
+        for bad in (
+            frame[: len(frame) - 2],            # truncated payload
+            b"XXXX" + frame[4:],                # bad magic
+            encode_frame(                       # route code vs URL clash
+                wire.ROUTE_CODES["/v1/topk"], {"table": "emb"},
+                [np.asarray([0], np.int32)],
+            ),
+            encode_frame(                       # wrong block dtype/rank
+                wire.ROUTE_CODES["/v1/lookup"], {"table": "emb"},
+                [np.ones((2, 2), np.float32)],
+            ),
+        ):
+            conn.request("POST", "/v1/lookup", body=bad, headers=hdr)
+            resp = conn.getresponse()
+            payload = resp.read()
+            assert resp.status == 400, payload[:200]
+            assert "json" in (resp.getheader("Content-Type") or "")
+        # the SAME connection then serves a well-formed frame: malformed
+        # input never poisons the handler thread or a co-batch
+        conn.request("POST", "/v1/lookup", body=frame, headers=hdr)
+        resp = conn.getresponse()
+        assert resp.status == 200
+        _, _, (rows,) = decode_frame(resp.read())
+        assert np.array_equal(rows, emb[[0, 1]])
+    finally:
+        conn.close()
+
+
+def test_http_oversized_body_is_400(mv_env):
+    from multiverso_tpu.utils.configure import SetCMDFlag
+
+    SetCMDFlag("data_max_body_mb", "1")
+    emb = np.eye(8, dtype=np.float32)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        assert dp.max_body_bytes == 1 << 20
+        big = json.dumps(
+            {"table": "emb", "ids": [0], "pad": "x" * (1 << 20)}
+        ).encode()
+        status, ctype, payload = _raw_post(
+            dp.url, "/v1/lookup", big, {"Content-Type": "application/json"}
+        )
+        assert status == 400
+        assert "Content-Length" in json.loads(payload)["error"]
+    finally:
+        SetCMDFlag("data_max_body_mb", "8")
+        dp.stop()
+        srv.stop()
+
+
+# ------------------------------------------------------------------ pool
+
+
+def test_client_binary_routes_match_json_routes(served):
+    _, dp, emb = served
+    cb = ServingClient([dp.url], deadline_s=10.0, wire="binary")
+    cj = ServingClient([dp.url], deadline_s=10.0, wire="json")
+    ids = [0, 3, 9]
+    assert np.array_equal(cb.lookup("emb", ids), cj.lookup("emb", ids))
+    ib, sb = cb.topk("emb", emb[[3]], k=2)
+    ij, sj = cj.topk("emb", emb[[3]], k=2)
+    assert np.array_equal(ib, ij) and np.allclose(sb, sj)
+    X = np.ones((2, 4), np.float32)
+    assert np.allclose(cb.predict("emb", X), cj.predict("emb", X))
+    cb.close()
+    cj.close()
+
+
+def test_client_pools_connections_one_handshake(served):
+    _, dp, emb = served
+    c = ServingClient([dp.url], deadline_s=10.0)
+    for _ in range(6):
+        assert np.array_equal(c.lookup("emb", [1, 2]), emb[[1, 2]])
+    s = c.stats()
+    assert s["ok"] == 6
+    assert s["pool_handshakes"] == 1, s      # one TCP connect total
+    assert s["pool_reused"] == 5, s
+    assert s["stale_retries"] == 0 and s["failovers"] == 0
+    c.close()
+
+
+def test_keep_alive_conn_id_stable_across_requests(served):
+    _, dp, _ = served
+    frame = _lookup_frame([0])
+    u = urllib.parse.urlsplit(dp.url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    try:
+        seen = set()
+        for _ in range(3):
+            conn.request("POST", "/v1/lookup", body=frame,
+                         headers={"Content-Type": wire.CONTENT_TYPE})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            seen.add(resp.getheader("X-MV-Conn"))
+        # one accepted socket == one conn id: keep-alive actually held
+        assert len(seen) == 1 and None not in seen, seen
+    finally:
+        conn.close()
+
+
+class _DeadConn:
+    """A pooled socket the server closed between requests: first reuse
+    fails with BadStatusLine, exactly like http.client reports it."""
+
+    sock = None
+    timeout = 0.0
+
+    def request(self, *a, **k):
+        raise http.client.BadStatusLine("")
+
+    def close(self):
+        pass
+
+
+def test_client_stale_pooled_socket_retries_without_failover(served):
+    _, dp, emb = served
+    c = ServingClient([dp.url], deadline_s=10.0)
+    assert np.array_equal(c.lookup("emb", [4]), emb[[4]])  # pools one conn
+    # replace the idle pooled connection with a server-closed one
+    with c._lock:
+        (ep,) = list(c._pool)
+        c._pool[ep] = [_DeadConn()]
+    assert np.array_equal(c.lookup("emb", [5]), emb[[5]])
+    s = c.stats()
+    assert s["ok"] == 2 and s["stale_retries"] == 1, s
+    # staleness is infrastructure, not a replica failure: no failover
+    # charge, no backoff-retry charge
+    assert s["failovers"] == 0 and s["retries"] == 0, s
+    c.close()
