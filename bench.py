@@ -2104,8 +2104,9 @@ mv.MV_ShutDown()
         def noisy():
             # 512-row lookups in a tight loop: thousands of rows/s
             # sustained, far over each replica's 500 rows/s tenant
-            # budget (admission is per replica, so the effective
-            # budget is replicas x qps)
+            # budget (budget gossip is off in this leg, so admission
+            # is per replica and the effective budget is
+            # replicas x qps; -budget_sync_interval_s closes that)
             c = ServingClient(urls, tenant="noisy", deadline_s=30.0)
             cls.append(c)
             r = np.random.RandomState(99)
@@ -2216,6 +2217,287 @@ mv.MV_ShutDown()
             / max(out["fleet_wire_json_qps"], 1e-9), 2
         )
     finally:
+        fleet.stop()
+    return out
+
+
+def _bench_fleet_controlplane(root):
+    """Serving control-plane leg (ISSUE 17): the hot-row cache and the
+    fleet autoscaler under realistic traffic shapes.
+
+    Cache phase: zipf-hot lookup traffic (a=1.6 over a 512-query pool —
+    the head queries repeat, the tail churns) against one replica with
+    ``-serve_cache_entries`` vs an identical uncached replica.
+    ``fleet_cache_hit_rate_pct`` is scraped from the replica's own
+    ``mv_serving_cache_hits/misses``; ``fleet_cache_qps_x`` is the
+    cached/uncached closed-loop qps ratio. A mid-load rollout between
+    two CONSTANT-fill checkpoints (all-1.0 -> all-2.0) is the
+    stale-version oracle: every response must be wholly one version and
+    versions must be monotonic per client — a cache key that survived
+    the version bump would serve 1.0 after 2.0 and fail the leg.
+
+    Autoscale phase: a 1-replica fleet with the autoscaler armed on the
+    shed-ratio burn rule; a noisy tenant's 512-row flood drives the
+    shed storm. ``fleet_autoscale_scaleup_s`` is flood-start -> 3 READY
+    replicas; ``fleet_autoscale_qps_gain_x`` is closed-loop lookup qps
+    at 3 replicas / the same load at 1 (measured before the flood and
+    after it stops, so admission shed never pollutes either window).
+    MV_BENCH_FLEET=0 skips."""
+    import os
+    import re as _re
+    import subprocess
+    import sys as _s
+    import threading
+    import urllib.request
+
+    if os.environ.get("MV_BENCH_FLEET", "1") == "0":
+        return {}
+    from multiverso_tpu.serving.autoscale import (
+        FleetAutoscaler,
+        FleetController,
+        fleet_rules,
+    )
+    from multiverso_tpu.serving.client import ServingClient
+    from multiverso_tpu.serving.fleet import ServingFleet, endpoint_metrics_url
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # constant-fill writer: every row of ckpt-<step> equals <fill>, so a
+    # response's value identifies its snapshot version exactly
+    ck_code = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[4])
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.io.checkpoint import save_tables
+step, fill, root = int(sys.argv[1]), float(sys.argv[2]), sys.argv[3]
+mv.MV_Init()
+t = mv.MV_CreateTable(MatrixTableOption(num_row=4096, num_col=64))
+t.add(np.full((4096, 64), fill, np.float32))
+t.wait()
+save_tables(os.path.join(root, f"ckpt-{step}"), step=step)
+mv.MV_ShutDown()
+"""
+
+    def commit_ckpt(step, fill):
+        r = subprocess.run(
+            [_s.executable, "-c", ck_code, str(step), str(fill), root, repo],
+            capture_output=True, text=True, timeout=300,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"controlplane ckpt-{step} writer failed: {r.stderr[-800:]}"
+            )
+
+    commit_ckpt(1, 1.0)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    out = {}
+
+    # ---------------------------------------------------------- cache
+    # one fixed pool of hot queries: cache keys are the exact id-array
+    # bytes, so repeated QUERIES (not just repeated ids) are what hits.
+    # 256-row queries under 4 concurrent clients make the saved batcher
+    # queue + device gather visible over the HTTP round-trip floor.
+    rng = np.random.RandomState(17)
+    pool = [rng.randint(0, 4096, size=256) for _ in range(512)]
+    ranks = np.minimum(rng.zipf(1.6, size=1500), 512) - 1
+
+    def zipf_run(urls, tag, nthreads=4, seconds=14.0, measure_s=6.0):
+        # the oracle covers the WHOLE run, but qps counts only the last
+        # measure_s: the cached run's ckpt-2 writer subprocess (jax
+        # init) competes for cores mid-window, and the post-rollout
+        # cold cache refills — both settle before the tail window
+        errs, counts = [], []
+        rolled = threading.Event()
+        stop_at = time.perf_counter() + seconds
+        measure_from = stop_at - measure_s
+
+        def go(i):
+            c = ServingClient(urls, tenant=f"zipf-{tag}-{i}",
+                              deadline_s=30.0)
+            c.lookup("emb", pool[0])  # warm the jit before timing
+            r = np.random.RandomState(i)
+            seen2 = False
+            n = 0
+            try:
+                while time.perf_counter() < stop_at:
+                    k = int(ranks[r.randint(0, len(ranks))])
+                    rows = np.asarray(
+                        c.lookup("emb", pool[k]), np.float32)
+                    # stale-version oracle: wholly ONE version, and
+                    # never backwards within a client's sequence
+                    v1 = np.allclose(rows, 1.0)
+                    v2 = np.allclose(rows, 2.0)
+                    if not (v1 or v2):
+                        errs.append(f"torn response: {rows[0][:2]}")
+                        return
+                    if v1 and seen2:
+                        errs.append(
+                            "stale ckpt-1 rows served after ckpt-2 — "
+                            "version-keyed cache invalidation is broken")
+                        return
+                    if v2:
+                        seen2 = True
+                        rolled.set()
+                    if time.perf_counter() >= measure_from:
+                        n += 1
+            finally:
+                counts.append(n)
+                c.close()
+
+        ths = [threading.Thread(target=go, args=(i,))
+               for i in range(nthreads)]
+        for th in ths:
+            th.start()
+        if tag == "cached":
+            commit_ckpt(2, 2.0)  # rollout lands mid-traffic
+        for th in ths:
+            th.join(timeout=300)
+        if errs:
+            raise RuntimeError(errs[0])
+        if tag == "cached" and not rolled.is_set():
+            raise RuntimeError("rollout never reached a client")
+        return sum(counts) / measure_s
+
+    cached_qps = uncached_qps = None
+    hits = misses = 0
+    for tag, extra in (
+        ("cached", ["-serve_cache_entries=4096"]),
+        ("uncached", []),
+    ):
+        fleet = ServingFleet(
+            1, root, log_dir=os.path.join(root, f"cp_{tag}"),
+            extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25"] + extra,
+            env=env,
+        ).start()
+        try:
+            if not fleet.wait_ready(timeout_s=120):
+                raise RuntimeError(f"{tag} replica never became ready")
+            qps = zipf_run(fleet.endpoints(), tag)
+            if tag == "cached":
+                cached_qps = qps
+                murl = endpoint_metrics_url(fleet.endpoint(0))
+                text = urllib.request.urlopen(murl, timeout=5).read().decode()
+                for name, val in _re.findall(
+                    r"^(mv_serving_cache_\w+?)(?:\{[^}]*\})?\s+([0-9.eE+-]+)\s*$",
+                    text, _re.M,
+                ):
+                    if name == "mv_serving_cache_hits":
+                        hits = float(val)
+                    elif name == "mv_serving_cache_misses":
+                        misses = float(val)
+            else:
+                uncached_qps = qps
+        finally:
+            fleet.stop()
+    out["fleet_cache_hit_rate_pct"] = round(
+        100.0 * hits / max(hits + misses, 1.0), 1
+    )
+    out["fleet_cache_qps_x"] = round(cached_qps / max(uncached_qps, 1e-9), 2)
+
+    # ------------------------------------------------------ autoscale
+    fleet = ServingFleet(
+        1, root, log_dir=os.path.join(root, "cp_autoscale"),
+        extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25",
+                    "-admission_tenant_qps=2000"],
+        env=env,
+    ).start()
+    auto = None
+    try:
+        if not fleet.wait_ready(timeout_s=120):
+            raise RuntimeError("autoscale seed replica never became ready")
+
+        def closed_loop_qps(seconds=4.0, nthreads=3):
+            # per-thread tenants + 4-row lookups keep admission (2000
+            # rows/s) far from binding; round-robin failover spreads
+            # onto every live replica
+            done = []
+            stop_at = time.perf_counter() + seconds
+
+            def run(i):
+                c = ServingClient(
+                    endpoint_source=fleet.endpoints_dir(), refresh_s=0.5,
+                    tenant=f"cp-{i}", deadline_s=30.0)
+                r = np.random.RandomState(i)
+                n = 0
+                while time.perf_counter() < stop_at:
+                    c.lookup("emb", r.randint(0, 4096, size=4))
+                    n += 1
+                done.append(n)
+                c.close()
+
+            ths = [threading.Thread(target=run, args=(i,))
+                   for i in range(nthreads)]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join(timeout=120)
+            return sum(done) / seconds
+
+        ServingClient(fleet.endpoints(), deadline_s=30.0).lookup(
+            "emb", np.arange(4))  # warm before the 1-replica window
+        qps1 = closed_loop_qps()
+
+        auto = FleetAutoscaler(
+            fleet,
+            FleetController(min_replicas=1, max_replicas=3,
+                            cooldown_decisions=3, idle_decisions=4,
+                            idle_qps_per_replica=0.0),  # never drain:
+            # the 3-replica window below must measure a stable fleet
+            rules=fleet_rules(p99_ms_objective=1e9,
+                              shed_rate_objective=0.05,
+                              fast_window_s=3.0, slow_window_s=8.0),
+            interval_s=0.5,
+        ).start()
+
+        flood_on = threading.Event()
+        flood_on.set()
+
+        def flood():
+            body = json.dumps({
+                "table": "emb", "ids": list(range(512)), "tenant": "noisy",
+            }).encode()
+            while flood_on.is_set():
+                urls = fleet.endpoints()
+                if not urls:
+                    time.sleep(0.05)
+                    continue
+                req = urllib.request.Request(
+                    urls[0] + "/v1/lookup", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                try:
+                    urllib.request.urlopen(req, timeout=10).read()
+                except Exception:  # noqa: BLE001 — 429 shed is the point
+                    pass
+                time.sleep(0.01)
+
+        fth = threading.Thread(target=flood, daemon=True)
+        t0 = time.perf_counter()
+        fth.start()
+        scaleup_s = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if (len(fleet.active_indices()) >= 3
+                    and fleet.ready_count() >= 3):
+                scaleup_s = time.perf_counter() - t0
+                break
+            time.sleep(0.2)
+        flood_on.clear()
+        fth.join(timeout=30)
+        if scaleup_s is None:
+            raise RuntimeError(
+                f"burn never scaled to 3 replicas: {auto.stats()}")
+        time.sleep(1.0)  # let the shed storm drain out of the batchers
+        qps3 = closed_loop_qps()
+        out["fleet_autoscale_scaleup_s"] = round(scaleup_s, 1)
+        out["fleet_autoscale_qps_gain_x"] = round(qps3 / max(qps1, 1e-9), 2)
+    finally:
+        if auto is not None:
+            auto.stop()
         fleet.stop()
     return out
 
@@ -2419,6 +2701,17 @@ def main():
     try:
         import tempfile
 
+        with tempfile.TemporaryDirectory(prefix="mv_bench_cp_") as d:
+            cp_leg = leg(
+                "fleet_controlplane", lambda: _bench_fleet_controlplane(d)
+            )
+    except Exception as e:
+        print(f"# leg fleet_controlplane FAILED: {e}", file=_sys.stderr,
+              flush=True)
+        cp_leg = {"fleet_controlplane_error": str(e)[:200]}
+    try:
+        import tempfile
+
         with tempfile.TemporaryDirectory(prefix="mv_bench_ps2p_") as d:
             ps2p_leg = leg(
                 "ps_comms_2proc", lambda: _bench_ps_comms_cluster(d)
@@ -2468,6 +2761,7 @@ def main():
     out.update(ring)
     out.update(serving)
     out.update(fleet_leg)
+    out.update(cp_leg)
     out.update(ps2p_leg)
     out.update(resilience)
     out.update(e2e)

@@ -42,6 +42,15 @@ def main() -> int:
     ap.add_argument("--restart-window-s", type=float, default=600.0)
     ap.add_argument("--ready-timeout-s", type=float, default=120.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="arm the fleet autoscaler: burn-rate SLO "
+                         "verdicts over the merged fleet /metrics add "
+                         "replicas into a sustained latency/shed burn "
+                         "and drain idle ones (--replicas is the "
+                         "starting size)")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--autoscale-interval-s", type=float, default=2.0)
     args = ap.parse_args(argv)
 
     from multiverso_tpu.serving.fleet import ServingFleet
@@ -52,6 +61,7 @@ def main() -> int:
         max_restarts=args.max_restarts,
         restart_window_s=args.restart_window_s, seed=args.seed,
     ).start()
+    autoscaler = None
     try:
         if fleet.wait_ready(timeout_s=args.ready_timeout_s):
             for url in fleet.endpoints():
@@ -63,11 +73,31 @@ def main() -> int:
                 "checkpoint under the root yet?)", flush=True,
             )
         fleet.watch()
+        if args.autoscale:
+            from multiverso_tpu.serving.autoscale import (
+                FleetAutoscaler,
+                FleetController,
+            )
+
+            autoscaler = FleetAutoscaler(
+                fleet,
+                FleetController(
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas,
+                ),
+                interval_s=args.autoscale_interval_s,
+            ).start()
+            print(
+                f"autoscaler armed: {args.min_replicas}.."
+                f"{args.max_replicas} replicas", flush=True,
+            )
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         print("draining fleet...", flush=True)
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         fleet.stop()
     return 0
 

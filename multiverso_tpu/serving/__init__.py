@@ -31,11 +31,23 @@ package is the read path sized for that traffic:
 * ``admission`` — per-tenant token buckets in front of the batcher: a
   noisy tenant sheds against its own budget, not the fleet's;
 * ``rollout``  — per-replica snapshot version-watch: poll
-  ``latest_valid``, publish new checkpoints through the validation
-  gate, keep serving N-1 on a bad rollout;
+  ``latest_valid`` (full-jittered so a fleet never scans in lockstep),
+  publish new checkpoints through the validation gate, keep serving
+  N-1 on a bad rollout;
 * ``replica`` / ``fleet`` — the deployable unit (data plane + health +
   watcher + graceful drain) and the N-replica self-healing launcher
-  behind ``deploy/serving_fleet.py``.
+  behind ``deploy/serving_fleet.py``, dynamically sizable via
+  ``scale_to``;
+* ``rowcache`` — version-keyed hot-row result cache in front of the
+  batcher: zipf-hot lookups answer without a device dispatch, and a
+  snapshot rollout invalidates everything in one version bump;
+* ``autoscale`` — fleet control loop: burn-rate SLO verdicts over the
+  merged fleet ``/metrics`` scrape add replicas into a sustained
+  latency/shed burn and drain idle ones gracefully;
+* ``budget`` — fleet-wide admission: replicas gossip per-tenant
+  admitted rows through the /metrics scrape and shrink their local
+  buckets to their share, so a tenant's budget stops multiplying with
+  replica count.
 
 Degradation (resilience subsystem): ``publish`` validates staged weights
 and rejects poisoned tables with ``PublishRejected`` (previous snapshot
@@ -47,12 +59,19 @@ on TPU the same jitted programs shard the score matmuls over the mesh.
 """
 
 from multiverso_tpu.serving.admission import AdmissionController, TokenBucket
+from multiverso_tpu.serving.autoscale import (
+    FleetAutoscaler,
+    FleetController,
+    ScaleDecision,
+)
 from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded, Request
+from multiverso_tpu.serving.budget import FleetBudgetSync
 from multiverso_tpu.serving.client import ServingClient, Unrecovered
 from multiverso_tpu.serving.http_data import DataPlaneServer
 from multiverso_tpu.serving.http_health import HealthServer, health_payload
 from multiverso_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from multiverso_tpu.serving.rollout import SnapshotWatcher
+from multiverso_tpu.serving.rowcache import HotRowCache
 from multiverso_tpu.serving.server import (
     PublishRejected,
     RouteUnavailable,
@@ -69,7 +88,12 @@ __all__ = [
     "AdmissionController",
     "DataPlaneServer",
     "DynamicBatcher",
+    "FleetAutoscaler",
+    "FleetBudgetSync",
+    "FleetController",
     "HealthServer",
+    "HotRowCache",
+    "ScaleDecision",
     "Overloaded",
     "PublishRejected",
     "Request",

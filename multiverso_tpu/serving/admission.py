@@ -101,6 +101,19 @@ class TokenBucket:
             self._refill(self._clock())
             return self._tokens
 
+    def reconfigure(self, rate: float, burst: float) -> None:
+        """Change rate/burst in place, settling the balance at the OLD
+        rate first. The balance clamps to the new burst but debt is
+        kept — a fleet correction must neither grant a fresh full burst
+        nor forgive what the tenant already spent."""
+        CHECK(rate > 0.0, "token bucket rate must be > 0")
+        CHECK(burst > 0.0, "token bucket burst must be > 0")
+        with self._lock:
+            self._refill(self._clock())
+            self.rate = float(rate)
+            self.burst = float(burst)
+            self._tokens = min(self._tokens, self.burst)
+
 
 class AdmissionController:
     """Per-tenant token buckets with lazy creation and shared defaults.
@@ -130,7 +143,13 @@ class AdmissionController:
         self._lock = OrderedLock(f"admission.{name}._lock")
         self._buckets: Dict[str, TokenBucket] = {}
         self._budgets: Dict[str, Tuple[float, float]] = {}
+        # fleet-debt correction (serving/budget.py): this replica's
+        # share of the tenant's fleet-wide demand, in (0, 1]. Effective
+        # bucket = configured budget x correction, so the FLEET admits
+        # ~one budget instead of replicas x budget
+        self._corrections: Dict[str, float] = {}
         self._admitted: Dict[str, int] = {}
+        self._admitted_rows: Dict[str, float] = {}
         self._shed: Dict[str, int] = {}
         # per-tenant saturation latch: one flight event per episode, not
         # one per shed (a saturating tenant sheds thousands of times)
@@ -153,9 +172,32 @@ class AdmissionController:
             qps, burst = self._budgets.get(
                 tenant, (self.default_qps, self.default_burst)
             )
-            b = TokenBucket(qps, burst, clock=self._clock)
+            c = self._corrections.get(tenant, 1.0)
+            b = TokenBucket(qps * c, burst * c, clock=self._clock)
             self._buckets[tenant] = b
         return b
+
+    def set_fleet_correction(self, tenant: str, factor: float) -> None:
+        """Scale ``tenant``'s effective budget by ``factor`` in (0, 1]
+        — the fleet-wide admission term gossiped by
+        ``serving/budget.py``. With R replicas splitting a tenant's
+        traffic, each replica's bucket refills at share x qps, so the
+        fleet-wide admitted rate converges to ~one configured budget.
+        Applied in place (``TokenBucket.reconfigure``): the bucket
+        keeps its balance/debt — no burst reset on every gossip round."""
+        factor = min(max(float(factor), 1e-6), 1.0)
+        with self._lock:
+            self._corrections[tenant] = factor
+            b = self._buckets.get(tenant)
+            if b is not None:
+                qps, burst = self._budgets.get(
+                    tenant, (self.default_qps, self.default_burst)
+                )
+                b.reconfigure(qps * factor, burst * factor)
+
+    def fleet_corrections(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._corrections)
 
     # ------------------------------------------------------------ admit
 
@@ -165,6 +207,12 @@ class AdmissionController:
             ok, retry_after = self._bucket(tenant).try_take(cost)
             if ok:
                 self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+                # admitted ROWS is the gossip currency of the fleet
+                # budget sync (budgets are row-denominated, requests
+                # are not)
+                self._admitted_rows[tenant] = (
+                    self._admitted_rows.get(tenant, 0.0) + float(cost)
+                )
                 self._shedding[tenant] = False
                 return True, 0.0
             self._shed[tenant] = self._shed.get(tenant, 0) + 1
@@ -197,7 +245,9 @@ class AdmissionController:
                 "tenants": {
                     t: {
                         "admitted": self._admitted.get(t, 0),
+                        "admitted_rows": self._admitted_rows.get(t, 0.0),
                         "shed": self._shed.get(t, 0),
+                        "correction": self._corrections.get(t, 1.0),
                     }
                     for t in tenants
                 },

@@ -1,0 +1,496 @@
+"""Serving fleet autoscaler: burn-driven replica-count control.
+
+The serving twin of ``-ps_pipeline_depth=auto``: where the depth
+controller (obs/controller.py) widens the training pipeline while
+overlap% is low and the loss stays bounded, ``FleetController`` adds
+serving replicas while a latency/shed SLO *burns* and drains them when
+the fleet goes idle. Same shape on purpose — a deterministic,
+side-effect-free decision table with bookkeeping, so the unit tests
+need no clock, no processes and no HTTP.
+
+The closed loop (``FleetAutoscaler``):
+
+1. **scrape** every active replica's ``GET /metrics`` (endpoint files
+   are the discovery channel, as everywhere else) and join the dumps
+   with ``merge_prometheus`` — the same text-level merge the
+   ``obs scrape`` CLI uses;
+2. **aggregate** fleet-level signals from the merged exposition:
+   summed served/shed counters and a *windowed* fleet p99 computed
+   from latency-histogram bucket deltas (lifetime-percentile gauges
+   are sticky — a burst an hour ago must not pin capacity forever;
+   bucket deltas decay to "no signal" the moment traffic stops);
+3. **judge** with ``obs/slo.py`` burn-rate rules over a private
+   ``TimeSeriesStore`` — multi-window (fast spike + slow sustained)
+   plus ``clear_after`` flap suppression, for free;
+4. **act** through ``ServingFleet.scale_to``: growth spawns replicas,
+   shrink drains them gracefully (endpoint file gone -> SIGTERM ->
+   replica-side batcher flush), and every transition writes a
+   ``scale_up``/``scale_down`` fleet.log + flight event.
+
+Decision table (``FleetController.propose``), first match wins:
+
+1. ``cooldown``      — within ``cooldown_decisions`` of the last scale
+   action: hold (hysteresis — let the last action land and the burn
+   windows refresh before judging again).
+2. ``at_max``        — burning but already at ``max_replicas``: hold.
+3. ``warming``       — burning while a spawned replica is still not
+   ready: hold (capacity is already on the way; stacking more just
+   overshoots the burn).
+4. ``burn_scale_up`` — a burn rule breached: add ONE replica.
+5. ``idle_drain``    — fleet qps under ``idle_qps_per_replica`` x
+   replicas for ``idle_decisions`` consecutive evaluations, above
+   ``min_replicas``: remove ONE replica.
+6. ``at_min`` / ``steady`` — hold.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from multiverso_tpu.obs.metrics import merge_prometheus
+from multiverso_tpu.obs.slo import SLOEngine, SLORule
+from multiverso_tpu.obs.timeseries import TimeSeriesStore
+from multiverso_tpu.serving.fleet import endpoint_metrics_url
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = [
+    "ADD",
+    "HOLD",
+    "REMOVE",
+    "FleetAutoscaler",
+    "FleetController",
+    "ScaleDecision",
+    "fleet_rules",
+]
+
+ADD = "add"
+HOLD = "hold"
+REMOVE = "remove"
+
+# one merged-exposition sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$"
+)
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+class ScaleDecision:
+    """One controller verdict: the action, the proposed replica count
+    and the reason that fired."""
+
+    __slots__ = ("action", "replicas", "reason", "observed")
+
+    def __init__(self, action: str, replicas: int, reason: str,
+                 observed: Dict[str, Any]):
+        self.action = action
+        self.replicas = replicas
+        self.reason = reason
+        self.observed = observed
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "action": self.action,
+            "replicas": self.replicas,
+            "reason": self.reason,
+            **self.observed,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"ScaleDecision({self.action}, replicas={self.replicas}, "
+                f"reason={self.reason})")
+
+
+def fleet_rules(
+    p99_ms_objective: float = 250.0,
+    shed_rate_objective: float = 0.05,
+    fast_window_s: float = 15.0,
+    slow_window_s: float = 60.0,
+) -> List[SLORule]:
+    """Burn rules over the FLEET-aggregated feed the autoscaler ingests
+    (``fleet:*`` keys). ``fleet:p99_ms`` is already windowed (bucket
+    deltas), so it simply vanishes when traffic stops — no-signal
+    windows count as healthy, which is what lets the idle drain fire."""
+    common = dict(fast_window_s=fast_window_s, slow_window_s=slow_window_s)
+    return [
+        SLORule(
+            name="fleet_latency_p99", metric="fleet:p99_ms",
+            objective=p99_ms_objective, kind="gauge", **common,
+        ),
+        SLORule(
+            name="fleet_shed_rate", metric="fleet:shed",
+            total="fleet:requests", objective=shed_rate_objective,
+            kind="ratio", **common,
+        ),
+    ]
+
+
+class FleetController:
+    """Maps one fleet observation to a replica-count proposal (the
+    decision table in the module docstring). Deterministic and
+    side-effect free beyond its own bookkeeping; ``state_dict`` /
+    ``load_state_dict`` survive a supervisor restart."""
+
+    def __init__(
+        self,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        cooldown_decisions: int = 4,
+        idle_decisions: int = 4,
+        idle_qps_per_replica: float = 1.0,
+    ):
+        CHECK(1 <= min_replicas <= max_replicas,
+              "need 1 <= min_replicas <= max_replicas")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.cooldown_decisions = int(cooldown_decisions)
+        self.idle_decisions = int(idle_decisions)
+        self.idle_qps_per_replica = float(idle_qps_per_replica)
+        # mutable bookkeeping
+        self.decisions = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._cooldown = 0
+        self._idle_streak = 0
+
+    # ------------------------------------------------------------ state
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "decisions": self.decisions,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "cooldown": self._cooldown,
+            "idle_streak": self._idle_streak,
+        }
+
+    def load_state_dict(self, state: Optional[Dict[str, Any]]) -> None:
+        """Partial/None state resets the missing fields — a restarted
+        supervisor must never die on bookkeeping vintage."""
+        state = state or {}
+        self.decisions = int(state.get("decisions", 0))
+        self.scale_ups = int(state.get("scale_ups", 0))
+        self.scale_downs = int(state.get("scale_downs", 0))
+        self._cooldown = max(0, int(state.get("cooldown", 0)))
+        self._idle_streak = max(0, int(state.get("idle_streak", 0)))
+
+    # --------------------------------------------------------- decision
+
+    def propose(
+        self,
+        replicas: int,
+        ready: int,
+        qps: float,
+        burning: Sequence[str] = (),
+    ) -> ScaleDecision:
+        """One decision from fleet-level inputs: ``replicas`` = active
+        slot count, ``ready`` = how many answer /readyz, ``qps`` =
+        fleet admitted-rows rate, ``burning`` = breached burn-rule
+        names (from the SLO engine)."""
+        burning = sorted(burning)
+        cur = int(replicas)
+        observed = {
+            "replicas": cur,
+            "ready": int(ready),
+            "qps": round(float(qps), 2),
+            "burning": list(burning),
+            "cooldown": self._cooldown,
+            "idle_streak": self._idle_streak,
+        }
+        idle_now = (not burning
+                    and qps < self.idle_qps_per_replica * max(cur, 1))
+
+        if self._cooldown > 0:
+            dec = ScaleDecision(HOLD, cur, "cooldown", observed)
+        elif burning and cur >= self.max_replicas:
+            dec = ScaleDecision(HOLD, cur, "at_max", observed)
+        elif burning and ready < cur:
+            dec = ScaleDecision(HOLD, cur, "warming", observed)
+        elif burning:
+            dec = ScaleDecision(
+                ADD, min(cur + 1, self.max_replicas),
+                "burn_scale_up:" + ",".join(burning), observed,
+            )
+        elif (idle_now and cur > self.min_replicas
+              and self._idle_streak + 1 >= self.idle_decisions):
+            dec = ScaleDecision(
+                REMOVE, max(cur - 1, self.min_replicas), "idle_drain",
+                observed,
+            )
+        elif cur <= self.min_replicas and idle_now:
+            dec = ScaleDecision(HOLD, cur, "at_min", observed)
+        else:
+            dec = ScaleDecision(HOLD, cur, "steady", observed)
+
+        # bookkeeping for the next decision
+        self.decisions += 1
+        self._idle_streak = self._idle_streak + 1 if idle_now else 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+        if dec.action == ADD:
+            self.scale_ups += 1
+            self._cooldown = self.cooldown_decisions
+        elif dec.action == REMOVE:
+            self.scale_downs += 1
+            self._cooldown = self.cooldown_decisions
+            self._idle_streak = 0
+        return dec
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "decisions": self.decisions,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "cooldown": self._cooldown,
+            "idle_streak": self._idle_streak,
+        }
+
+
+class FleetAutoscaler:
+    """The closed loop: scrape -> aggregate -> burn verdicts -> scale.
+
+    ``tick_once()`` runs one full pass inline (deterministic for tests
+    — inject ``fetch`` and ``clock``); ``start()`` runs it on a joined
+    daemon thread every ``interval_s``."""
+
+    def __init__(
+        self,
+        fleet,
+        controller: Optional[FleetController] = None,
+        *,
+        rules: Optional[Sequence[SLORule]] = None,
+        interval_s: float = 2.0,
+        scrape_timeout_s: float = 2.0,
+        qps_window_s: float = 10.0,
+        p99_window_s: float = 10.0,
+        fetch: Optional[Callable[[str], str]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.fleet = fleet
+        self.controller = controller or FleetController()
+        self.interval_s = float(interval_s)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self.qps_window_s = float(qps_window_s)
+        self.p99_window_s = float(p99_window_s)
+        self._fetch = fetch or self._http_fetch
+        self._clock = clock
+        self._store = TimeSeriesStore(capacity=512, clock=clock)
+        # private engine over the private store; no health hook — a
+        # fleet burn is a scaling signal, not this process's /healthz
+        self._engine = SLOEngine(
+            list(rules) if rules is not None else fleet_rules(),
+            store=self._store,
+            health_hook=lambda *_a: None,
+            clock=clock,
+        )
+        # ring of cumulative fleet counters for windowed-p99 math:
+        # (t, requests_total, {le_seconds: cum_count}, hist_count)
+        self._cum: deque = deque(maxlen=512)
+        # cross-thread stats (autoscale thread writes, Dashboard/stop
+        # read) — mvlint R9
+        self._state_lock = threading.Lock()
+        self._ticks = 0
+        self._scrape_errors = 0
+        self._last_decision: Optional[ScaleDecision] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dash_key: Optional[str] = None
+
+    # ------------------------------------------------------------ scrape
+
+    def _http_fetch(self, url: str) -> str:
+        with urllib.request.urlopen(
+            url, timeout=self.scrape_timeout_s
+        ) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    def _collect(self) -> Tuple[List[int], Dict[str, float]]:
+        """One fleet scrape: merged exposition -> aggregated flat view.
+        Returns ``(active_indices, flat)``."""
+        active = self.fleet.active_indices()
+        dumps: List[Tuple[str, str]] = []
+        for i in active:
+            doc = self.fleet.endpoint(i)
+            url = endpoint_metrics_url(doc) if doc else None
+            if not url:
+                continue
+            try:
+                dumps.append((str(i), self._fetch(url)))
+            except Exception:  # noqa: BLE001 — a booting/draining replica
+                # without a live /metrics is normal mid-scale
+                with self._state_lock:
+                    self._scrape_errors += 1
+        merged = merge_prometheus(dumps)
+        return active, self._aggregate(merged, len(dumps))
+
+    def _aggregate(self, merged: str, scraped: int) -> Dict[str, float]:
+        served = shed = cache_hits = 0.0
+        buckets: Dict[float, float] = {}
+        hist_count = 0.0
+        for line in merged.splitlines():
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                continue
+            name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+            try:
+                value = float(raw)
+            except ValueError:
+                continue
+            if name == "mv_serving_replica_served":
+                served += value
+            elif name == "mv_serving_replica_shed":
+                shed += value
+            elif name == "mv_serving_cache_hits":
+                cache_hits += value
+            elif name == "mv_serving_request_latency_seconds_bucket":
+                le = _LE_RE.search(labels)
+                if le is None or le.group(1) == "+Inf":
+                    continue
+                try:
+                    edge = float(le.group(1))
+                except ValueError:
+                    continue
+                buckets[edge] = buckets.get(edge, 0.0) + value
+            elif name == "mv_serving_request_latency_seconds_count":
+                hist_count += value
+        now = self._clock()
+        requests = served + shed + cache_hits
+        flat: Dict[str, float] = {
+            "fleet:served": served,
+            "fleet:shed": shed,
+            "fleet:cache_hits": cache_hits,
+            "fleet:requests": requests,
+            "fleet:scraped": float(scraped),
+        }
+        p99 = self._windowed_p99_ms(now, buckets, hist_count)
+        self._cum.append((now, buckets, hist_count))
+        if p99 is not None:
+            flat["fleet:p99_ms"] = p99
+        return flat
+
+    def _windowed_p99_ms(self, now: float, buckets: Dict[float, float],
+                         hist_count: float) -> Optional[float]:
+        """Fleet p99 over the trailing window, from cumulative-bucket
+        deltas: baseline = the oldest ring entry inside the window.
+        ``None`` (no signal) when the window saw no requests — a quiet
+        fleet has no latency, not a good one."""
+        base: Optional[Tuple[float, Dict[float, float], float]] = None
+        cutoff = now - self.p99_window_s
+        for entry in self._cum:
+            if entry[0] >= cutoff:
+                base = entry
+                break
+        if base is None:
+            return None
+        d_count = hist_count - base[2]
+        if d_count <= 0.0:
+            return None
+        target = 0.99 * d_count
+        cum = 0.0
+        for le in sorted(set(buckets) | set(base[1])):
+            delta = max(
+                0.0, buckets.get(le, 0.0) - base[1].get(le, 0.0)
+            )
+            cum = max(cum, delta)
+            if cum >= target:
+                return le * 1e3
+        # the p99 sits above the last finite bucket edge
+        edges = sorted(buckets)
+        return edges[-1] * 1e3 if edges else None
+
+    # ------------------------------------------------------------ loop
+
+    def tick_once(self) -> ScaleDecision:
+        """One full control pass: scrape, ingest, evaluate burn rules,
+        propose, act. Never raises out of scrape trouble — a missing
+        replica reads as quiet."""
+        active, flat = self._collect()
+        self._store.ingest({"flat": flat})
+        summary = self._engine.evaluate()
+        burning = [
+            name for name, r in summary["rules"].items() if r["breached"]
+        ]
+        qps = self._store.window(
+            "fleet:requests", self.qps_window_s
+        ).delta_rate()
+        ready = self.fleet.ready_count()
+        dec = self.controller.propose(
+            replicas=len(active), ready=ready, qps=qps, burning=burning,
+        )
+        if dec.action in (ADD, REMOVE):
+            Log.Info(
+                "fleet autoscale: %s -> %d replicas (%s, qps=%.1f)",
+                dec.action, dec.replicas, dec.reason, qps,
+            )
+            try:
+                self.fleet.scale_to(dec.replicas, reason=dec.reason)
+            except Exception as e:  # noqa: BLE001 — a failed spawn must
+                # not kill the control loop; next tick re-judges
+                Log.Error("fleet autoscale: scale_to failed: %r", e)
+        with self._state_lock:
+            self._ticks += 1
+            self._last_decision = dec
+        return dec
+
+    def start(self) -> "FleetAutoscaler":
+        CHECK(self._thread is None, "fleet autoscaler already started")
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.tick_once()
+                except Exception as e:  # noqa: BLE001 — the control loop
+                    # never dies; a dead autoscaler pins the fleet size
+                    Log.Error("fleet autoscale survived error: %r", e)
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="mv-fleet-autoscale"
+        )
+        self._thread.start()
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        self._dash_key = f"serving.autoscale.{id(self)}"
+        Dashboard.add_section(self._dash_key, self._lines,
+                              snapshot=self.stats)
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=timeout_s)
+            self._thread = None
+        if self._dash_key is not None:
+            from multiverso_tpu.utils.dashboard import Dashboard
+
+            Dashboard.remove_section(self._dash_key)
+            self._dash_key = None
+
+    # ------------------------------------------------------------ obs
+
+    def stats(self) -> Dict[str, Any]:
+        with self._state_lock:
+            last = self._last_decision
+            return {
+                "ticks": self._ticks,
+                "scrape_errors": self._scrape_errors,
+                "replicas": len(self.fleet.active_indices()),
+                "controller": self.controller.to_dict(),
+                "last": last.to_dict() if last is not None else {},
+            }
+
+    def _lines(self) -> List[str]:
+        s = self.stats()
+        last = s["last"] or {}
+        return [
+            f"[Autoscale] replicas={s['replicas']} ticks={s['ticks']} "
+            f"ups={s['controller']['scale_ups']} "
+            f"downs={s['controller']['scale_downs']} "
+            f"last={last.get('action', '-')}:{last.get('reason', '-')}"
+        ]

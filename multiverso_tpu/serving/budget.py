@@ -1,0 +1,332 @@
+"""Fleet-wide admission: gossip per-tenant debt through /metrics.
+
+PR 10's admission control is per-replica, and its DEPLOY.md caveat was
+honest about the hole: with R replicas round-robining a tenant's
+traffic, the tenant enjoys R independent token buckets — its effective
+fleet-wide budget silently multiplies with every scale-up, which is
+exactly backwards for an autoscaled fleet (the noisier the tenant, the
+more capacity the autoscaler adds, the more budget the tenant gets).
+
+``FleetBudgetSync`` closes the loop WITHOUT a coordination service by
+reusing plumbing the fleet already has:
+
+* every replica already exposes per-tenant admitted work on ``GET
+  /metrics`` (``mv_serving_admission_tenants_<t>_admitted_rows`` — the
+  admission Dashboard snapshot flattened by obs/metrics.py);
+* every replica already advertises itself via an endpoint file in the
+  fleet's ``endpoints/`` dir (the same discovery channel the serving
+  client and the autoscaler scrape).
+
+Each replica periodically scrapes its PEERS' metrics, computes its own
+share of each tenant's fleet-wide admitted-rows *delta* over the gossip
+interval, and scales its local bucket to ``budget x share``
+(``AdmissionController.set_fleet_correction``). Summed over replicas the
+shares are ~1, so the fleet admits ~one configured budget regardless of
+replica count. The estimator is:
+
+* **delta-based** — lifetime counters would freeze shares at historic
+  ratios; deltas track where the tenant's traffic goes NOW (a replica
+  that joins mid-flood converges within a couple of rounds);
+* **floored** at ``min_share`` — a replica that saw none of a tenant's
+  traffic this round keeps a sliver of budget, so routing noise can't
+  zero a bucket and strand the tenant;
+* **fail-open** — no peers (single-replica fleet, scrape failures all
+  round) resets corrections to 1.0: plain per-replica admission, never
+  tighter than configured.
+
+Convergence, not precision: each round uses a slightly stale view of
+the peers, so the fleet-wide admitted rate lands within a small factor
+of one budget (the acceptance bound is 1.5x at 3 replicas) rather than
+exactly on it. That is the point — one noisy tenant no longer scales
+its own budget by adding replicas.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from multiverso_tpu.analysis.guards import OrderedLock
+from multiverso_tpu.serving.fleet import endpoint_metrics_url
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_double
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["FleetBudgetSync", "maybe_start_from_flags"]
+
+MV_DEFINE_double(
+    "budget_sync_interval_s", 0.0,
+    "serving replicas: gossip period for fleet-wide admission — each "
+    "replica scrapes its peers' /metrics for per-tenant admitted rows "
+    "and shrinks its local token buckets to its share of the fleet "
+    "demand, so a tenant's budget stops multiplying with replica count "
+    "(0 = off: per-replica admission only)",
+)
+
+# the gossip currency on a peer's exposition:
+#   mv_serving_admission_tenants_<tenant>_admitted_rows{...} 123.0
+# The (?=[\s{]) lookahead pins the metric name at the suffix, so the
+# derived `..._admitted_rows_rate_per_s` family can never match.
+_ROWS_RE = re.compile(
+    r"^mv_serving_admission_tenants_(.+)_admitted_rows"
+    r"(?:\{[^}]*\})?\s+([0-9.eE+-]+)\s*$"
+)
+
+# mirror of obs.metrics._sanitize — tenant names round-trip through the
+# metric pipeline, so matching our own stats() keys against a peer's
+# exposition must apply the same mangling
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_safe(name: str) -> str:
+    return _SANITIZE_RE.sub("_", name)
+
+
+class FleetBudgetSync:
+    """Peer-scrape loop feeding ``set_fleet_correction`` on the local
+    ``AdmissionController``. ``sync_once()`` runs one round inline
+    (inject ``fetch``/``clock`` in tests); ``start()`` runs it on a
+    joined daemon thread."""
+
+    def __init__(
+        self,
+        admission,
+        endpoint_dir: str,
+        *,
+        self_file: str,
+        interval_s: float = 1.0,
+        scrape_timeout_s: float = 1.0,
+        min_share: float = 0.05,
+        clock: Callable[[], float] = time.monotonic,
+        fetch: Optional[Callable[[str], str]] = None,
+    ):
+        CHECK(admission is not None, "budget sync needs an admission "
+              "controller")
+        CHECK(interval_s > 0.0, "budget sync interval must be > 0")
+        CHECK(0.0 < min_share <= 1.0, "min_share must be in (0, 1]")
+        self.admission = admission
+        self.endpoint_dir = endpoint_dir
+        self.self_file = os.path.basename(self_file)
+        self.interval_s = float(interval_s)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self.min_share = float(min_share)
+        self._clock = clock
+        self._fetch = fetch or self._http_fetch
+        # OrderedLock (mvlint R2/R9): sync thread writes, Dashboard reads
+        self._lock = OrderedLock("serving.budget._lock")
+        # previous cumulative admitted-rows per (source, sanitized
+        # tenant); source "" = this replica
+        self._prev: Dict[Tuple[str, str], float] = {}
+        self._rounds = 0
+        self._peer_errors = 0
+        self._peers_seen = 0
+        self._corrections: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registered_key: Optional[str] = None
+
+    # ------------------------------------------------------------ scrape
+
+    def _http_fetch(self, url: str) -> str:
+        with urllib.request.urlopen(
+            url, timeout=self.scrape_timeout_s
+        ) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    def _peer_urls(self) -> List[str]:
+        urls: List[str] = []
+        pattern = os.path.join(self.endpoint_dir, "replica-*.json")
+        for path in sorted(glob.glob(pattern)):
+            if os.path.basename(path) == self.self_file:
+                continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue  # torn write / vanishing file mid-drain
+            url = endpoint_metrics_url(doc)
+            if url:
+                urls.append(url)
+        return urls
+
+    @staticmethod
+    def _parse_rows(text: str) -> Dict[str, float]:
+        rows: Dict[str, float] = {}
+        for line in text.splitlines():
+            m = _ROWS_RE.match(line)
+            if m is None:
+                continue
+            try:
+                rows[m.group(1)] = float(m.group(2))
+            except ValueError:
+                continue
+        return rows
+
+    def _own_rows(self) -> Dict[str, Dict[str, float]]:
+        """``{sanitized tenant: {"raw": ..., "rows": ...}}`` from the
+        local controller — sanitized to match the peers' exposition."""
+        out: Dict[str, Dict[str, float]] = {}
+        for tenant, st in self.admission.stats()["tenants"].items():
+            out[_metric_safe(tenant)] = {
+                "raw": tenant, "rows": float(st["admitted_rows"]),
+            }
+        return out
+
+    # ------------------------------------------------------------ round
+
+    def sync_once(self) -> Dict[str, float]:
+        """One gossip round; returns the corrections applied (empty on
+        the baseline round / a peerless fleet)."""
+        own = self._own_rows()
+        # the controller's live view, not our record of what we set:
+        # fail-open must also undo corrections that predate this sync
+        # (a restart, a direct set_fleet_correction)
+        tightened = {
+            t: c for t, c in self.admission.fleet_corrections().items()
+            if c < 1.0
+        }
+        peer_rows: List[Dict[str, float]] = []
+        errors = 0
+        urls = self._peer_urls()
+        for url in urls:
+            try:
+                peer_rows.append(self._parse_rows(self._fetch(url)))
+            except Exception:  # noqa: BLE001 — peer draining/booting
+                errors += 1
+
+        applied: Dict[str, float] = {}
+        with self._lock:
+            self._rounds += 1
+            self._peer_errors += errors
+            self._peers_seen = len(peer_rows)
+            if not peer_rows:
+                # fail-open: single replica (or all peers unreachable)
+                # means plain per-replica admission
+                for t in tightened:
+                    applied[t] = 1.0
+                self._corrections = {}
+                self._prev = {
+                    ("", t): v["rows"] for t, v in own.items()
+                }
+            else:
+                # per-tenant fleet delta over this round
+                deltas: Dict[str, Dict[str, float]] = {}
+                prev_next: Dict[Tuple[str, str], float] = {}
+
+                def _account(source: str, tenant: str, cur: float):
+                    prev = self._prev.get((source, tenant))
+                    prev_next[(source, tenant)] = cur
+                    if prev is None:
+                        return  # baseline for this source/tenant
+                    deltas.setdefault(tenant, {})[source] = max(
+                        0.0, cur - prev
+                    )
+
+                for t, v in own.items():
+                    _account("", t, v["rows"])
+                for i, rows in enumerate(peer_rows):
+                    src = urls[i] if i < len(urls) else str(i)
+                    for t, cur in rows.items():
+                        _account(src, t, cur)
+                self._prev = prev_next
+
+                for t, v in own.items():
+                    per_source = deltas.get(t, {})
+                    fleet_delta = sum(per_source.values())
+                    if fleet_delta <= 0.0:
+                        continue  # quiet round: keep prior correction
+                    share = per_source.get("", 0.0) / fleet_delta
+                    corr = min(max(share, self.min_share), 1.0)
+                    self._corrections[t] = corr
+                    applied[t] = corr
+
+        for t, corr in applied.items():
+            raw = own.get(t, {}).get("raw", t)
+            self.admission.set_fleet_correction(raw, corr)
+        return applied
+
+    # ------------------------------------------------------------ loop
+
+    def start(self) -> "FleetBudgetSync":
+        CHECK(self._thread is None, "budget sync already started")
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.sync_once()
+                except Exception as e:  # noqa: BLE001 — gossip is
+                    # best-effort; a bad round keeps prior corrections
+                    Log.Error("budget sync survived error: %r", e)
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="mv-budget-sync"
+        )
+        self._thread.start()
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        self._registered_key = f"serving.budget.{id(self)}"
+        Dashboard.add_section(self._registered_key, self._lines,
+                              snapshot=self.stats)
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=timeout_s)
+            self._thread = None
+        if self._registered_key is not None:
+            from multiverso_tpu.utils.dashboard import Dashboard
+
+            Dashboard.remove_section(self._registered_key)
+            self._registered_key = None
+
+    # ------------------------------------------------------------ obs
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "rounds": self._rounds,
+                "peers": self._peers_seen,
+                "peer_errors": self._peer_errors,
+                "corrections": dict(self._corrections),
+            }
+
+    def _lines(self) -> List[str]:
+        s = self.stats()
+        corr = s["corrections"]
+        tight = min(corr.values()) if corr else 1.0
+        return [
+            f"[BudgetSync] rounds={s['rounds']} peers={s['peers']} "
+            f"errors={s['peer_errors']} tenants={len(corr)} "
+            f"min_share={tight:.2f}"
+        ]
+
+
+def maybe_start_from_flags(admission) -> Optional[FleetBudgetSync]:
+    """Arm fleet budget gossip when the replica runs flag-driven with
+    ``-budget_sync_interval_s > 0`` AND was launched by a fleet (the
+    ``MV_ENDPOINT_FILE`` env var names its endpoint file — its
+    directory IS the peer discovery channel)."""
+    if admission is None:
+        return None
+    interval = float(GetFlag("budget_sync_interval_s"))
+    if interval <= 0.0:
+        return None
+    from multiverso_tpu.serving.replica import ENDPOINT_FILE_ENV
+
+    marker = os.environ.get(ENDPOINT_FILE_ENV)
+    if not marker:
+        return None
+    sync = FleetBudgetSync(
+        admission, os.path.dirname(marker),
+        self_file=marker, interval_s=interval,
+    )
+    return sync.start()

@@ -33,17 +33,31 @@ would fight the supervisor's self-healing). Pool accounting rides the
 per-request stats: ``pool_handshakes`` (fresh TCP connects),
 ``pool_reused`` (requests served on a kept-alive socket) and
 ``stale_retries`` (reuse attempts that hit a server-closed socket).
+
+**Endpoint refresh** (autoscaled fleets): pass ``endpoint_source`` — a
+fleet ``endpoints/`` directory or a callable returning URLs — and the
+endpoint list becomes dynamic. Failure-driven: when one call finds
+EVERY known endpoint down, the list is re-read once before giving up;
+endpoints that vanished from the source were *drained replicas*, not
+outages, and count as ``stale_endpoints`` instead of anything
+alarming. Success-driven: ``refresh_s > 0`` re-reads the source
+periodically on the request path, so a scaled-UP fleet starts
+receiving this client's traffic without waiting for a failure (failure
+-driven refresh alone never fires on a healthy fleet). The list never
+swaps to empty — an unreadable source keeps the last known endpoints.
 """
 
 from __future__ import annotations
 
+import glob
 import http.client
 import json
+import os
 import threading
 import time
 import urllib.parse
 import urllib.request
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -100,10 +114,27 @@ _RESPONSE_FIELDS = {
 }
 
 
+def _read_endpoint_dir(path: str) -> List[str]:
+    """Data-plane URLs from a fleet ``endpoints/`` directory — the same
+    ``replica-*.json`` files the launcher writes and the autoscaler
+    scrapes. Torn/vanishing files (a replica mid-drain) are skipped."""
+    urls: List[str] = []
+    for p in sorted(glob.glob(os.path.join(path, "replica-*.json"))):
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        url = doc.get("url")
+        if url:
+            urls.append(str(url))
+    return urls
+
+
 class ServingClient:
     def __init__(
         self,
-        endpoints: Sequence[str],
+        endpoints: Sequence[str] = (),
         *,
         tenant: str = "default",
         deadline_s: float = 5.0,
@@ -113,13 +144,25 @@ class ServingClient:
         seed: int = 0,
         wire: str = "binary",
         pool_size: int = 4,
+        endpoint_source: Optional[
+            Union[str, Callable[[], Sequence[str]]]
+        ] = None,
+        refresh_s: float = 0.0,
         clock=time.monotonic,
         sleep=time.sleep,
     ):
-        CHECK(len(endpoints) >= 1, "ServingClient needs >= 1 endpoint")
         CHECK(wire in ("binary", "json"), f"wire must be binary|json, "
               f"got {wire!r}")
+        self._endpoint_source = endpoint_source
+        self.refresh_s = float(refresh_s)
         self.endpoints = [e.rstrip("/") for e in endpoints]
+        if not self.endpoints and endpoint_source is not None:
+            self.endpoints = [
+                e.rstrip("/") for e in self._resolve_source()
+            ]
+        CHECK(len(self.endpoints) >= 1,
+              "ServingClient needs >= 1 endpoint (or a source that "
+              "yields one)")
         self.tenant = tenant
         self.wire = wire
         self.deadline_s = float(deadline_s)
@@ -132,6 +175,9 @@ class ServingClient:
         self._sleep = sleep
         self._lock = threading.Lock()
         self._rr = 0
+        self._next_refresh_t = (
+            clock() + self.refresh_s if self.refresh_s > 0 else None
+        )
         # endpoint -> stack of idle keep-alive connections
         self._pool: Dict[str, List[http.client.HTTPConnection]] = {}
         self._stats = {
@@ -139,6 +185,7 @@ class ServingClient:
             "shed_429": 0, "unavailable_503": 0, "deadline_504": 0,
             "unrecovered": 0,
             "pool_handshakes": 0, "pool_reused": 0, "stale_retries": 0,
+            "endpoint_refreshes": 0, "stale_endpoints": 0,
         }
 
     # ------------------------------------------------------------ stats
@@ -156,6 +203,56 @@ class ServingClient:
             i = self._rr
             self._rr = (self._rr + 1) % len(self.endpoints)
             return i
+
+    # ------------------------------------------------------------ refresh
+
+    def _resolve_source(self) -> List[str]:
+        src = self._endpoint_source
+        if callable(src):
+            return list(src())
+        return _read_endpoint_dir(str(src))
+
+    def _endpoints_snapshot(self) -> List[str]:
+        with self._lock:
+            return list(self.endpoints)
+
+    def refresh_endpoints(self) -> List[str]:
+        """Re-read the endpoint source and swap the live list. The swap
+        never empties the list (an unreadable source keeps the last
+        known endpoints); pooled connections to vanished endpoints are
+        closed. Returns the list now in effect."""
+        if self._endpoint_source is None:
+            return self._endpoints_snapshot()
+        try:
+            new = [e.rstrip("/") for e in self._resolve_source()]
+        except Exception:  # noqa: BLE001 — source unreadable mid-scale
+            new = []
+        if not new:
+            return self._endpoints_snapshot()
+        with self._lock:
+            vanished = [e for e in self.endpoints if e not in new]
+            self.endpoints = new
+            self._rr %= len(new)
+            self._stats["endpoint_refreshes"] += 1
+            dead_pools = [self._pool.pop(e, []) for e in vanished]
+        for idle in dead_pools:
+            for conn in idle:
+                conn.close()
+        return list(new)
+
+    def _maybe_periodic_refresh(self) -> None:
+        # refresh_s is immutable after __init__ — a lock-free fast path
+        # for clients that never asked for periodic refresh
+        if self.refresh_s <= 0.0 or self._endpoint_source is None:
+            return
+        now = self._clock()
+        with self._lock:
+            due = (self._next_refresh_t is not None
+                   and now >= self._next_refresh_t)
+            if due:
+                self._next_refresh_t = now + self.refresh_s
+        if due:
+            self.refresh_endpoints()
 
     # ------------------------------------------------------------ pool
 
@@ -314,6 +411,7 @@ class ServingClient:
 
     def _call(self, route: str, body: Dict[str, Any]) -> Dict[str, Any]:
         self._bump("requests")
+        self._maybe_periodic_refresh()
         body = dict(body)
         body.setdefault("tenant", self.tenant)
         # one trace per logical request, one span per attempt; the
@@ -332,13 +430,16 @@ class ServingClient:
     def _call_attempts(self, route: str, body: Dict[str, Any],
                        trace_id: str, root_sid: str) -> Dict[str, Any]:
         deadline = self._clock() + self.deadline_s
-        start = self._next_start()
+        eps = self._endpoints_snapshot()
+        start = self._next_start() % len(eps)
         last: Optional[BaseException] = None
+        tried_down: set = set()
+        refreshed = False
         for attempt in range(self.max_attempts):
             remaining = deadline - self._clock()
             if remaining <= 0.0:
                 break
-            endpoint = self.endpoints[(start + attempt) % len(self.endpoints)]
+            endpoint = eps[(start + attempt) % len(eps)]
             body["deadline_ms"] = max(remaining * 1e3, 1.0)
             attempt_sid = tracer.new_span_id()
             header = tracer.mint_traceparent(trace_id, attempt_sid)
@@ -364,6 +465,23 @@ class ServingClient:
                     "client.failover", route=route, endpoint=endpoint,
                     attempt=attempt, trace_id=trace_id, parent_id=root_sid,
                 )
+                tried_down.add(endpoint)
+                if (not refreshed
+                        and self._endpoint_source is not None
+                        and len(tried_down) >= len(eps)):
+                    # every KNOWN endpoint failed — the list itself may
+                    # be stale (a scale-down drained those replicas).
+                    # Re-read the source once before burning the rest
+                    # of the attempt budget
+                    refreshed = True
+                    new = self.refresh_endpoints()
+                    gone = [d for d in tried_down if d not in new]
+                    if gone:
+                        # drained replicas, not outages
+                        self._bump("stale_endpoints", len(gone))
+                    if new != eps:
+                        eps = new
+                        tried_down.clear()
                 pause = min(
                     self._backoff.next_delay(attempt),
                     deadline - self._clock(),

@@ -20,6 +20,12 @@ orchestrator would do, which keeps the drill honest.
 ``stop()`` is a graceful drain: SIGTERM (the replica flips unready,
 drains the batcher, exits 0), escalating to SIGKILL only after
 ``exit_grace_s``.
+
+The fleet is dynamically sizable: ``scale_to(n)`` appends-and-spawns
+new slots or drains the newest active replicas one by one (endpoint
+file removed first, then the same SIGTERM drain contract), which is
+what the autoscaler (``serving/autoscale.py``) drives — scale events
+land in fleet.log.jsonl and the flight recorder.
 """
 
 from __future__ import annotations
@@ -38,9 +44,22 @@ from multiverso_tpu.analysis.guards import OrderedLock
 from multiverso_tpu.resilience.supervisor import RestartBudget
 from multiverso_tpu.utils.log import CHECK, Log
 
-__all__ = ["ServingFleet"]
+__all__ = ["ServingFleet", "endpoint_metrics_url"]
 
 _REPLICA_MODULE = "multiverso_tpu.serving.replica"
+
+
+def endpoint_metrics_url(doc: Dict[str, Any]) -> Optional[str]:
+    """``GET /metrics`` URL for one endpoint-file document. Prefers the
+    health port (the metrics endpoint rides the health server); falls
+    back to the data-plane URL."""
+    ports = doc.get("ports") or {}
+    host = doc.get("host") or "127.0.0.1"
+    if ports.get("health"):
+        return f"http://{host}:{ports['health']}/metrics"
+    if doc.get("url"):
+        return f"{doc['url']}/metrics"
+    return None
 
 
 class ServingFleet:
@@ -84,6 +103,13 @@ class ServingFleet:
         self._procs: List[Optional[subprocess.Popen]] = [None] * self.n
         # replica slots the budget gave up on: stay down, fleet degrades
         self._abandoned: List[bool] = [False] * self.n
+        # slots deliberately drained by scale_to(): the healer must not
+        # relaunch their exit (distinct from abandoned = crashed out of
+        # budget). Slots are never reused — scale-ups append new ones.
+        self._retired: List[bool] = [False] * self.n
+        # serialises concurrent scale_to() callers (autoscaler thread
+        # vs. an operator CLI); slot lists only ever APPEND under it
+        self._scale_lock = OrderedLock("fleet._scale_lock")
         self.restarts = 0
         # watch thread increments, stop() reads after a bounded join
         # that can time out — counter needs the lock (mvlint R9)
@@ -167,13 +193,34 @@ class ServingFleet:
             return None
 
     def endpoints(self) -> List[str]:
-        """Data-plane URLs of replicas that have come up (order-stable)."""
+        """Data-plane URLs of replicas that have come up (order-stable,
+        drained slots excluded)."""
         urls = []
         for i in range(self.n):
+            if self._retired[i]:
+                continue
             doc = self.endpoint(i)
             if doc and doc.get("url"):
                 urls.append(doc["url"])
         return urls
+
+    def endpoints_dir(self) -> str:
+        """The discovery directory clients can re-read
+        (``ServingClient(endpoint_source=...)``) to pick up autoscaled
+        replicas without a restart."""
+        return os.path.join(self.log_dir, "endpoints")
+
+    def active_indices(self) -> List[int]:
+        """Slots that are supposed to be serving (not crashed out of
+        budget, not deliberately drained)."""
+        return [
+            i for i in range(self.n)
+            if not self._abandoned[i] and not self._retired[i]
+        ]
+
+    def ready_count(self) -> int:
+        """Active replicas answering ``/readyz`` 200 right now."""
+        return sum(1 for i in self.active_indices() if self._ready(i))
 
     def _ready(self, index: int, timeout_s: float = 1.0) -> bool:
         doc = self.endpoint(index)
@@ -194,7 +241,8 @@ class ServingFleet:
         while self._clock() < deadline:
             self.poll_once()
             if all(
-                self._abandoned[i] or self._ready(i) for i in range(self.n)
+                self._abandoned[i] or self._retired[i] or self._ready(i)
+                for i in range(self.n)
             ):
                 return True
             self._sleep(self.poll_s)
@@ -205,7 +253,10 @@ class ServingFleet:
         return p.pid if p is not None and p.poll() is None else None
 
     def alive(self) -> int:
-        return sum(1 for i in range(self.n) if self.pid(i) is not None)
+        return sum(
+            1 for i in range(self.n)
+            if not self._retired[i] and self.pid(i) is not None
+        )
 
     # ------------------------------------------------------------ healing
 
@@ -215,7 +266,7 @@ class ServingFleet:
         backoff delay."""
         for i in range(self.n):
             p = self._procs[i]
-            if p is None or self._abandoned[i]:
+            if p is None or self._abandoned[i] or self._retired[i]:
                 continue
             rc = p.poll()
             if rc is None:
@@ -263,6 +314,84 @@ class ServingFleet:
         )
         self._watch_thread.start()
         return self
+
+    # ------------------------------------------------------------ scaling
+
+    def scale_to(self, target: int, reason: str = "manual") -> List[int]:
+        """Grow or shrink the ACTIVE replica set to ``target``.
+
+        Growth appends fresh slots and spawns them (slot indexes are
+        never reused, so log/endpoint/trace lanes stay unambiguous).
+        Shrink drains the highest-index active replicas gracefully —
+        endpoint file removed first (discovery stops advertising), then
+        SIGTERM (the replica flips unready, flushes its batcher, exits
+        0), SIGKILL only after ``exit_grace_s`` — so a scale-down never
+        drops an in-flight request. Emits a ``scale_up``/``scale_down``
+        fleet.log + flight event; returns the slot indexes touched."""
+        CHECK(target >= 1, "fleet cannot scale below 1 replica")
+        with self._scale_lock:
+            active = self.active_indices()
+            if target == len(active):
+                return []
+            touched: List[int] = []
+            if target > len(active):
+                for _ in range(target - len(active)):
+                    i = self.n
+                    self._procs.append(None)
+                    self._abandoned.append(False)
+                    self._retired.append(False)
+                    self.n = len(self._procs)
+                    self._spawn(i)
+                    touched.append(i)
+                self._event(
+                    "scale_up", reason=reason, replicas=target,
+                    spawned=touched,
+                )
+            else:
+                # drain the newest replicas first: the oldest have the
+                # warmest jit caches and connection pools
+                for i in reversed(active):
+                    if len(active) - len(touched) <= target:
+                        break
+                    self._drain_slot(i)
+                    touched.append(i)
+                self._event(
+                    "scale_down", reason=reason, replicas=target,
+                    drained=touched,
+                )
+            return touched
+
+    def _drain_slot(self, index: int) -> None:
+        """Gracefully retire ONE replica: stop advertising -> mark the
+        slot retired (the healer must not relaunch the exit) -> SIGTERM
+        (replica-side drain: unready, batcher flush, exit 0) -> SIGKILL
+        only after ``exit_grace_s``."""
+        self._retired[index] = True  # before SIGTERM: poll_once skips it
+        try:
+            os.remove(self.endpoint_file(index))
+        except OSError:
+            pass
+        p = self._procs[index]
+        if p is None or p.poll() is not None:
+            return
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        deadline = self._clock() + self.exit_grace_s
+        while p.poll() is None and self._clock() < deadline:
+            self._sleep(0.05)
+        if p.poll() is None:
+            self._event("replica_kill", replica=index, pid=p.pid)
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        self._event("replica_drain", replica=index, rc=p.poll())
 
     # ------------------------------------------------------------ shutdown
 

@@ -86,6 +86,8 @@ class Replica:
         self.data_http = None
         self.admission = None
         self.slo_eval = None
+        self.rowcache = None
+        self.budget_sync = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -102,11 +104,19 @@ class Replica:
         self.admission = controller_from_flags()
         if self.admission is not None:
             self.admission.register_dashboard()
+        # -serve_cache_entries: version-keyed hot-row cache in front of
+        # the batcher; rollouts invalidate it atomically via the
+        # snapshot version bump
+        from multiverso_tpu.serving.rowcache import cache_from_flags
+
+        self.rowcache = cache_from_flags()
+        if self.rowcache is not None:
+            self.rowcache.register_dashboard()
         # no training runtime in a replica: register_runtime=False keeps
         # the server off the (non-started) runtime's attach list
         self.server = TableServer(
             register_runtime=False, name="replica",
-            admission=self.admission,
+            admission=self.admission, rowcache=self.rowcache,
         ).start()  # also arms -health_port
         self.data_http = maybe_start_data_plane_from_flags(self.server)
         if self.data_http is None:
@@ -123,6 +133,11 @@ class Replica:
 
         self.slo_eval = _slo.maybe_start_from_flags()
         self._write_endpoint_file()
+        # -budget_sync_interval_s: fleet-wide admission gossip — after
+        # the endpoint file exists, so peers can discover us too
+        from multiverso_tpu.serving import budget as _budget
+
+        self.budget_sync = _budget.maybe_start_from_flags(self.admission)
         return self
 
     def _write_endpoint_file(self) -> None:
@@ -171,6 +186,9 @@ class Replica:
         from multiverso_tpu.serving import http_health
 
         http_health.set_ready(False, phase="draining")
+        if self.budget_sync is not None:
+            self.budget_sync.stop()
+            self.budget_sync = None
         if self.watcher is not None:
             self.watcher.stop()
             self.watcher = None
@@ -190,6 +208,9 @@ class Replica:
         if self.admission is not None:
             self.admission.unregister_dashboard()
             self.admission = None
+        if self.rowcache is not None:
+            self.rowcache.unregister_dashboard()
+            self.rowcache = None
         if self.slo_eval is not None:
             self.slo_eval.stop()
             self.slo_eval = None

@@ -28,6 +28,7 @@ event. ``check_now()`` runs one poll inline for deterministic tests;
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -39,9 +40,11 @@ __all__ = ["SnapshotWatcher"]
 
 MV_DEFINE_double(
     "serve_poll_s", 2.0,
-    "serving replicas: seconds between latest_valid() polls of "
-    "-serve_checkpoint_dir — the snapshot-rollout cadence (lower = "
-    "fresher weights, more directory scans)",
+    "serving replicas: upper bound on the wait between latest_valid() "
+    "polls of -serve_checkpoint_dir — the snapshot-rollout cadence. "
+    "Waits are full-jittered over [0, serve_poll_s) so a fleet's "
+    "replicas never scan (or roll out) in lockstep (lower = fresher "
+    "weights, more directory scans)",
 )
 
 
@@ -57,12 +60,25 @@ class SnapshotWatcher:
         names: Optional[Sequence[str]] = None,
         poll_s: Optional[float] = None,
         allow_reshape: bool = True,
+        jitter: bool = True,
+        seed: Optional[int] = None,
     ):
         self.server = server
         self.root = str(root)
         self.names = list(names) if names is not None else None
         self.poll_s = float(
             GetFlag("serve_poll_s") if poll_s is None else poll_s
+        )
+        # full-jitter over [0, poll_s): a fleet of replicas started
+        # together would otherwise scan AND publish in lockstep — one
+        # synchronized readdir+load burst per rollout across the whole
+        # fleet. Jitter desynchronizes them while keeping the worst-case
+        # staleness bound at poll_s; the mean poll rate doubles, which
+        # a readdir can afford. PID-seeded: co-hosted replicas must not
+        # share a stream
+        self.jitter = bool(jitter)
+        self._rng = random.Random(
+            os.getpid() if seed is None else seed
         )
         # reshape allowed by default: a rollback to a pre-resize version
         # (or an elastic re-shard changing padded physical rows) is a
@@ -191,6 +207,10 @@ class SnapshotWatcher:
             Dashboard.remove_section(self._dash_key)
             self._dash_key = None
 
+    def _next_wait_s(self) -> float:
+        return (self._rng.uniform(0.0, self.poll_s) if self.jitter
+                else self.poll_s)
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
@@ -198,7 +218,7 @@ class SnapshotWatcher:
             except Exception as e:  # noqa: BLE001 — the watch NEVER dies:
                 # a dead watcher pins the replica on stale weights forever
                 Log.Error("snapshot watch survived internal error: %r", e)
-            self._stop.wait(self.poll_s)
+            self._stop.wait(self._next_wait_s())
 
     # ------------------------------------------------------------ obs
 

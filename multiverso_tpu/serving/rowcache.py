@@ -1,0 +1,224 @@
+"""Version-keyed hot-row result cache in front of the batcher.
+
+Serving traffic is zipf-shaped — a million users hammer the same few
+thousand hot rows — so recomputing every lookup through the batcher
+wastes device dispatches on answers that cannot change between snapshot
+rollouts. ``HotRowCache`` is a bounded LRU keyed
+``(snapshot_version, route, request_key)``:
+
+* the **snapshot version is part of the key**, so a rollout invalidates
+  the entire cache with its one version bump — no per-entry sweeps, no
+  TTLs, and a stale-version hit is *structurally* impossible (an entry
+  keyed v can only be returned to a request that read snapshot v);
+* the ``request_key`` is the canonical bytes of the query payload
+  (dtype + shape + raw buffer), so two requests hit iff the server
+  would compute identical answers from the same snapshot;
+* ``predict`` routes **bypass** the cache entirely: float feature
+  matrices are non-canonical keys (two features 1e-7 apart are
+  different bytes), so entries would never be re-hit — they would only
+  evict useful rows;
+* capacity is bounded by entries AND approximate value bytes (a few
+  huge batch results must not displace the whole hot set silently).
+
+The cache sits in ``TableServer.{lookup,topk}_async`` *after* admission
+(a cached answer still charges the tenant's token bucket — a hot-key
+replay must not mint unlimited free qps) and *before* the breaker/
+batcher, so a hit costs no ticket, no batch slot and no device work.
+Fill happens on future completion, and only when the serving version is
+still the one the request read — monotonic versions make that check
+sound (see ``TableServer._cache_fill``). Cached values are shared
+across callers; treat results as read-only (the HTTP data plane only
+serializes them).
+
+Hit/miss/evict counters land in a Dashboard section (snapshot twin →
+``mv_serving_cache_*`` on ``GET /metrics``) so the bench's zipf leg and
+the fleet dashboard read the hit rate straight off the scrape.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.analysis.guards import OrderedLock
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_int
+from multiverso_tpu.utils.log import CHECK
+
+__all__ = ["HotRowCache", "cache_from_flags"]
+
+MV_DEFINE_int(
+    "serve_cache_entries", 0,
+    "serving replicas: entry capacity of the version-keyed hot-row "
+    "result cache in front of the batcher — zipf-hot lookup/topk "
+    "requests answer from the cache (admission still charges them) and "
+    "a snapshot rollout invalidates everything in one version bump; "
+    "predict routes always bypass (0 = cache off)",
+)
+
+
+class HotRowCache:
+    """Bounded LRU of query results, keyed by snapshot version."""
+
+    def __init__(self, capacity: int, *, max_bytes: int = 256 << 20,
+                 name: str = "cache"):
+        CHECK(capacity >= 1, "hot-row cache capacity must be >= 1")
+        CHECK(max_bytes >= 1, "hot-row cache max_bytes must be >= 1")
+        self.capacity = int(capacity)
+        self.max_bytes = int(max_bytes)
+        self.name = name
+        # OrderedLock (mvlint R2): every data-plane handler thread and
+        # the batcher's fill callback funnel through here
+        self._lock = OrderedLock("serving.rowcache._lock")
+        self._data: "OrderedDict[Tuple[int, str, bytes], Any]" = OrderedDict()
+        self._bytes = 0
+        self._version = 0  # newest snapshot version seen (generation)
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._stale_puts = 0
+        self._bypass = 0
+        self._invalidations = 0
+        self._registered_key: Optional[str] = None
+
+    # ------------------------------------------------------------ keys
+
+    @staticmethod
+    def cacheable(route: str) -> bool:
+        """``lookup:*`` / ``topk:*`` cache; ``predict:*`` bypasses —
+        float feature matrices are non-canonical keys that would never
+        re-hit."""
+        return not route.startswith("predict")
+
+    @staticmethod
+    def request_key(payload: np.ndarray) -> bytes:
+        """Canonical bytes of one query payload. dtype + shape prefix:
+        a (2,4) f32 and a (4,2) f32 share a buffer but are different
+        requests."""
+        arr = np.ascontiguousarray(payload)
+        return f"{arr.dtype.str}:{arr.shape}:".encode() + arr.tobytes()
+
+    # ------------------------------------------------------------ data
+
+    @staticmethod
+    def _nbytes(value: Any) -> int:
+        if isinstance(value, np.ndarray):
+            return int(value.nbytes)
+        if isinstance(value, (tuple, list)):
+            return sum(HotRowCache._nbytes(v) for v in value)
+        return 64  # scalar/opaque: nominal
+
+    def _advance(self, version: int) -> None:
+        # caller holds self._lock. One version bump swaps the whole
+        # generation out in O(1) — the atomic invalidation contract
+        if version > self._version:
+            if self._data:
+                self._invalidations += 1
+            self._data = OrderedDict()
+            self._bytes = 0
+            self._version = int(version)
+
+    def get(self, version: int, route: str, key: bytes) -> Optional[Any]:
+        """The cached result for ``(version, route, key)`` or ``None``.
+        ``version`` must be the version of the snapshot the caller
+        read — a hit is exactly what that snapshot would compute."""
+        if not self.cacheable(route):
+            with self._lock:
+                self._bypass += 1
+            return None
+        with self._lock:
+            self._advance(version)
+            k = (int(version), route, key)
+            v = self._data.get(k)
+            if v is None:
+                self._misses += 1
+                return None
+            self._data.move_to_end(k)
+            self._hits += 1
+            return v
+
+    def put(self, version: int, route: str, key: bytes, value: Any) -> bool:
+        """Insert one computed result. A result whose version is older
+        than the newest generation seen is dropped (``stale_puts``) —
+        it was computed against an already-replaced snapshot and must
+        never become servable."""
+        if not self.cacheable(route):
+            return False
+        with self._lock:
+            self._advance(version)
+            if int(version) < self._version:
+                self._stale_puts += 1
+                return False
+            k = (int(version), route, key)
+            old = self._data.pop(k, None)
+            if old is not None:
+                self._bytes -= self._nbytes(old)
+            self._data[k] = value
+            self._bytes += self._nbytes(value)
+            while self._data and (
+                    len(self._data) > self.capacity
+                    or self._bytes > self.max_bytes):
+                _k, ev = self._data.popitem(last=False)
+                self._bytes -= self._nbytes(ev)
+                self._evictions += 1
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    # ------------------------------------------------------------ obs
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "capacity": self.capacity,
+                "entries": len(self._data),
+                "bytes": self._bytes,
+                "version": self._version,
+                "hits": self._hits,
+                "misses": self._misses,
+                "hit_rate_pct": (
+                    100.0 * self._hits / total if total else 0.0
+                ),
+                "evictions": self._evictions,
+                "stale_puts": self._stale_puts,
+                "bypass": self._bypass,
+                "invalidations": self._invalidations,
+            }
+
+    def _lines(self) -> List[str]:
+        s = self.stats()
+        return [
+            f"[RowCache:{self.name}] v{s['version']} "
+            f"entries={s['entries']}/{s['capacity']} "
+            f"hit_rate={s['hit_rate_pct']:.1f}% evict={s['evictions']} "
+            f"invalidations={s['invalidations']}"
+        ]
+
+    def register_dashboard(self) -> None:
+        from multiverso_tpu.utils.dashboard import Dashboard
+
+        # family flattens to serving_cache (numeric id dropped) —
+        # mv_serving_cache_hits etc. on /metrics
+        self._registered_key = f"serving.cache.{id(self)}"
+        Dashboard.add_section(
+            self._registered_key, self._lines, snapshot=self.stats
+        )
+
+    def unregister_dashboard(self) -> None:
+        if self._registered_key is not None:
+            from multiverso_tpu.utils.dashboard import Dashboard
+
+            Dashboard.remove_section(self._registered_key)
+            self._registered_key = None
+
+
+def cache_from_flags(name: str = "cache") -> Optional[HotRowCache]:
+    """Build a cache from ``-serve_cache_entries`` (None when off)."""
+    entries = int(GetFlag("serve_cache_entries"))
+    if entries <= 0:
+        return None
+    return HotRowCache(entries, name=name)

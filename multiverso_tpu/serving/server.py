@@ -133,6 +133,7 @@ class TableServer:
         breaker_clock=None,
         topk_impl: str = "auto",
         admission=None,
+        rowcache=None,
     ):
         CHECK(topk_impl in ("replicated", "sharded", "auto"),
               f"topk_impl must be replicated|sharded|auto, got {topk_impl!r}")
@@ -151,6 +152,11 @@ class TableServer:
         # *_async front door charges each request's row count against its
         # tenant's token bucket BEFORE it can cost a ticket
         self.admission = admission
+        # optional version-keyed result cache (serving/rowcache.py):
+        # consulted after admission (a hot-key replay still pays its
+        # tenant budget), before the breaker/batcher — a hit costs no
+        # ticket and no device dispatch; predict routes bypass
+        self.rowcache = rowcache
         if mesh is None:
             from multiverso_tpu.runtime import runtime
 
@@ -660,7 +666,8 @@ class TableServer:
         budget has expired."""
         self._require_started()
         ids = np.asarray(ids, np.int32).reshape(-1)
-        table = self._table(self.snapshot, name)
+        snap = self.snapshot
+        table = self._table(snap, name)
         CHECK(ids.size >= 1, "empty lookup request")
         CHECK(
             int(ids.min()) >= 0 and int(ids.max()) < table.shape[0],
@@ -668,16 +675,23 @@ class TableServer:
             f"({table.shape[0]} rows)",
         )
         self._admit(tenant, ids.size)
-        self._shed_if_open(f"lookup:{name}")
-        return self._batcher.submit(
-            f"lookup:{name}", ids, block=block, deadline_t=deadline_t
+        route = f"lookup:{name}"
+        hit, ckey = self._cache_get(route, snap.version, ids)
+        if hit is not None:
+            return hit
+        self._shed_if_open(route)
+        fut = self._batcher.submit(
+            route, ids, block=block, deadline_t=deadline_t
         )
+        self._cache_fill(route, ckey, snap.version, fut)
+        return fut
 
     def topk_async(self, name: str, queries, k: int = 10, block: bool = False,
                    tenant: str = "default", deadline_t=None):
         self._require_started()
         q = np.asarray(queries, np.float32)
-        table = self._table(self.snapshot, name)
+        snap = self.snapshot
+        table = self._table(snap, name)
         CHECK(
             q.ndim == 2 and q.shape[0] >= 1 and q.shape[1] == table.shape[1],
             f"queries shape {q.shape} does not match table {name!r} dim "
@@ -685,10 +699,16 @@ class TableServer:
         )
         CHECK(1 <= k <= table.shape[0], f"k={k} out of range")
         self._admit(tenant, q.shape[0])
-        self._shed_if_open(f"topk:{name}:{int(k)}")
-        return self._batcher.submit(
-            f"topk:{name}:{int(k)}", q, block=block, deadline_t=deadline_t
+        route = f"topk:{name}:{int(k)}"
+        hit, ckey = self._cache_get(route, snap.version, q)
+        if hit is not None:
+            return hit
+        self._shed_if_open(route)
+        fut = self._batcher.submit(
+            route, q, block=block, deadline_t=deadline_t
         )
+        self._cache_fill(route, ckey, snap.version, fut)
+        return fut
 
     def predict_async(self, name: str, X, block: bool = False,
                       tenant: str = "default", deadline_t=None):
@@ -721,6 +741,50 @@ class TableServer:
             if not ok:
                 self.metrics.record_shed()
                 raise Overloaded(retry_after)
+
+    # ------------------------------------------------------------ rowcache
+
+    def _cache_get(self, route: str, version: int, payload: np.ndarray):
+        """Consult the hot-row cache; returns ``(resolved_future, key)``
+        on a hit, ``(None, key)`` on a miss, ``(None, None)`` when the
+        cache is off or the route bypasses. ``version`` must be the
+        version of the snapshot the caller validated against — a hit
+        keyed v is exactly what that snapshot computes."""
+        if self.rowcache is None or not self.rowcache.cacheable(route):
+            return None, None
+        ckey = self.rowcache.request_key(payload)
+        value = self.rowcache.get(version, route, ckey)
+        if value is None:
+            return None, ckey
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        fut.set_result(value)
+        return fut, ckey
+
+    def _cache_fill(self, route: str, ckey, version: int, fut) -> None:
+        """Arm the cache fill on future completion. The entry is stored
+        only when the serving version is STILL ``version`` at fill time:
+        versions are monotonic, so the flush's pinned snapshot w obeys
+        version <= w <= current — current == version forces w == version,
+        i.e. the cached bytes are exactly the keyed snapshot's answer.
+        A publish racing the fill simply skips the insert (conservative,
+        never stale)."""
+        if self.rowcache is None or ckey is None:
+            return
+
+        def _done(f) -> None:
+            try:
+                if f.cancelled() or f.exception() is not None:
+                    return
+                cur = self._snapshot
+                if cur is not None and cur.version == version:
+                    self.rowcache.put(version, route, ckey, f.result())
+            except Exception:  # noqa: BLE001 — a fill failure must never
+                # propagate into the batcher's result-delivery path
+                pass
+
+        fut.add_done_callback(_done)
 
     # ------------------------------------------------------------ degradation
 

@@ -424,6 +424,172 @@ def test_fleet_end_to_end_kill_and_heal(mv_env, ckpt_table, tmp_path):
     assert fleet.alive() == 0
 
 
+def test_fleet_scale_to_bookkeeping(tmp_path, monkeypatch):
+    """Slot accounting without processes: scale_to appends-and-spawns on
+    the way up, retires newest-first on the way down, never reuses a
+    slot index, and logs scale events."""
+    from multiverso_tpu.serving.fleet import ServingFleet
+    from multiverso_tpu.utils.log import FatalError
+
+    fleet = ServingFleet(
+        1, str(tmp_path / "ck"), log_dir=str(tmp_path / "fleet")
+    )
+    spawned = []
+    monkeypatch.setattr(fleet, "_spawn", lambda i: spawned.append(i))
+
+    assert fleet.scale_to(3, reason="burn") == [1, 2]
+    assert spawned == [1, 2]
+    assert fleet.n == 3 and fleet.active_indices() == [0, 1, 2]
+    assert fleet.scale_to(3) == []  # already there: no-op, no event
+
+    # a fake endpoint file for the replica about to drain: the drain
+    # must stop advertising it
+    ep2 = fleet.endpoint_file(2)
+    with open(ep2, "w") as f:
+        json.dump({"url": "http://127.0.0.1:1"}, f)
+    assert fleet.scale_to(1, reason="idle") == [2, 1]  # newest first
+    assert fleet.active_indices() == [0]
+    assert not os.path.exists(ep2)
+    assert fleet.endpoints() == []  # retired slots never advertised
+    assert fleet.ready_count() == 0
+
+    # slot indexes are never reused: growth appends slot 3, not 1/2
+    assert fleet.scale_to(2, reason="burn") == [3]
+    assert fleet.n == 4 and fleet.active_indices() == [0, 3]
+
+    with pytest.raises(FatalError):
+        fleet.scale_to(0)  # a fleet never scales below 1
+
+    events = [
+        json.loads(line)["event"]
+        for line in open(
+            os.path.join(str(tmp_path / "fleet"), "fleet.log.jsonl")
+        )
+    ]
+    assert events.count("scale_up") == 2
+    assert events.count("scale_down") == 1
+
+
+def test_fleet_poll_skips_retired_slots(tmp_path, monkeypatch):
+    """The healer must not relaunch a deliberately drained replica —
+    retired is not abandoned."""
+    from multiverso_tpu.serving.fleet import ServingFleet
+
+    fleet = ServingFleet(
+        2, str(tmp_path / "ck"), log_dir=str(tmp_path / "fleet")
+    )
+    spawned = []
+    monkeypatch.setattr(fleet, "_spawn", lambda i: spawned.append(i))
+
+    class DeadProc:
+        pid = 99999
+
+        def poll(self):
+            return 0  # exited
+
+    fleet._procs[1] = DeadProc()
+    fleet._retired[1] = True
+    fleet.poll_once()
+    assert spawned == []  # no relaunch of the drained slot
+    assert not fleet._abandoned[1]
+
+
+def test_watcher_poll_jitter_bounds():
+    """Full-jitter waits stay in [0, poll_s) and actually vary; with
+    jitter off the wait is exactly poll_s."""
+    w = SnapshotWatcher(None, "/nonexistent", poll_s=2.0, seed=7)
+    waits = [w._next_wait_s() for _ in range(300)]
+    assert all(0.0 <= x < 2.0 for x in waits)
+    assert len({round(x, 6) for x in waits}) > 100  # not degenerate
+    assert 0.7 < float(np.mean(waits)) < 1.3  # uniform mean ~ poll_s/2
+    fixed = SnapshotWatcher(None, "/nonexistent", poll_s=2.0,
+                            jitter=False)
+    assert fixed._next_wait_s() == 2.0
+
+
+# ========================================================= client refresh
+
+
+def test_client_reads_endpoint_dir_and_refreshes(tmp_path):
+    from multiverso_tpu.serving.client import ServingClient
+
+    d = str(tmp_path / "endpoints")
+    os.makedirs(d)
+    with open(os.path.join(d, "replica-0.json"), "w") as f:
+        json.dump({"url": "http://127.0.0.1:1001"}, f)
+    client = ServingClient(endpoint_source=d)
+    assert client.endpoints == ["http://127.0.0.1:1001"]
+    # a scale-up lands a new endpoint file; refresh picks it up
+    with open(os.path.join(d, "replica-1.json"), "w") as f:
+        json.dump({"url": "http://127.0.0.1:1002"}, f)
+    assert client.refresh_endpoints() == [
+        "http://127.0.0.1:1001", "http://127.0.0.1:1002"
+    ]
+    assert client.stats()["endpoint_refreshes"] == 1
+    # an empty/unreadable source never empties the live list
+    for name in os.listdir(d):
+        os.remove(os.path.join(d, name))
+    assert len(client.refresh_endpoints()) == 2
+
+
+def test_client_exhausted_endpoints_trigger_refresh_and_stale_stat():
+    """When every known endpoint is down, the client re-reads the source
+    once: endpoints that vanished were drained replicas and count as
+    stale_endpoints, and the call recovers on the refreshed list with
+    zero unrecovered errors."""
+    from multiverso_tpu.serving import client as client_mod
+
+    live = {"urls": ["http://old:1"]}
+    c = client_mod.ServingClient(
+        endpoint_source=lambda: list(live["urls"]),
+        deadline_s=5.0, max_attempts=4, backoff_base_s=0.0,
+        backoff_max_s=0.0, sleep=lambda s: None,
+    )
+    calls = []
+
+    def fake_post(endpoint, route, body, timeout_s, traceparent=None):
+        calls.append(endpoint)
+        if "new" not in endpoint:
+            raise client_mod._EndpointDown(f"{endpoint}: down")
+        return {"rows": [[1.0, 2.0]]}
+
+    c._post_once = fake_post
+    live["urls"] = ["http://new:2"]  # the fleet has moved on
+    rows = c.lookup("emb", [0])
+    np.testing.assert_array_equal(
+        rows, np.asarray([[1.0, 2.0]], np.float32)
+    )
+    s = c.stats()
+    assert s["ok"] == 1 and s["unrecovered"] == 0
+    assert s["endpoint_refreshes"] == 1
+    assert s["stale_endpoints"] == 1  # http://old:1 vanished = drained
+    assert calls[-1] == "http://new:2"
+
+
+def test_client_periodic_refresh_on_success_path():
+    """refresh_s re-reads the source even when nothing fails — a scaled
+    -UP fleet starts receiving traffic without waiting for an error."""
+    from multiverso_tpu.serving import client as client_mod
+
+    clk = FakeClock()
+    live = {"urls": ["http://a:1"]}
+    c = client_mod.ServingClient(
+        endpoint_source=lambda: list(live["urls"]),
+        refresh_s=10.0, clock=clk, sleep=lambda s: None,
+    )
+    c._post_once = (
+        lambda endpoint, route, body, timeout_s, traceparent=None:
+        {"rows": [[0.0]]}
+    )
+    c.lookup("emb", [0])
+    assert c.endpoints == ["http://a:1"]  # not due yet
+    live["urls"] = ["http://a:1", "http://b:2"]
+    clk.advance(11.0)
+    c.lookup("emb", [0])
+    assert c.endpoints == ["http://a:1", "http://b:2"]
+    assert c.stats()["endpoint_refreshes"] == 1
+
+
 @pytest.mark.slow
 def test_fleet_gives_up_after_budget(mv_env, tmp_path):
     """A replica that cannot start (bad flags) must exhaust the restart
