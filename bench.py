@@ -2502,6 +2502,135 @@ mv.MV_ShutDown()
     return out
 
 
+def _bench_netchaos():
+    """Network-chaos leg (ISSUE 18): what the partition-tolerant data
+    plane buys, measured against real injected faults.
+
+    In-process: two ``TableServer``+``DataPlaneServer`` replicas, each
+    behind a ``NetChaosProxy``. Three phases:
+
+    * passthrough — identical closed-loop lookups direct vs through a
+      clean proxy; ``netchaos_proxy_overhead_pct`` is the p50 penalty
+      (target: <= 10%, the proxy must be cheap enough to leave in
+      every drill);
+    * tail — replica A's proxy delays every response 150 ms; the same
+      load through a hedged client (generous budget, 10 ms trigger so
+      the comparison isolates the mechanism) vs a hedge-disabled one.
+      ``netchaos_hedged_p99_ms`` / ``netchaos_unhedged_p99_ms``
+      (target: hedged <= 1/3 of unhedged — rotation alone leaves half
+      the requests eating the tail);
+    * partition — replica B's proxy blackholes mid-load;
+      ``netchaos_failover_p99_ms`` is per-request latency through the
+      eject-and-failover window, ``netchaos_partition_unrecovered``
+      must stay 0.
+
+    MV_BENCH_NETCHAOS=0 skips; MV_BENCH_ASSERTS=1 gates the targets.
+    """
+    import os
+
+    if os.environ.get("MV_BENCH_NETCHAOS", "1") == "0":
+        return {}
+    from multiverso_tpu.resilience.netchaos import NetChaosProxy
+    from multiverso_tpu.serving.client import ServingClient
+    from multiverso_tpu.serving.http_data import DataPlaneServer
+    from multiverso_tpu.serving.server import TableServer
+
+    emb = (np.random.RandomState(0).randn(4096, 64) * 0.1).astype(
+        np.float32
+    )
+    rng = np.random.RandomState(7)
+    out = {}
+    srv_a = TableServer({"emb": emb}, register_runtime=False,
+                        name="nc-a").start()
+    srv_b = TableServer({"emb": emb}, register_runtime=False,
+                        name="nc-b").start()
+    dp_a = DataPlaneServer(srv_a, port=0)
+    dp_b = DataPlaneServer(srv_b, port=0)
+    px_a = NetChaosProxy("127.0.0.1", dp_a.port, seed=1, name="bench-a")
+    px_b = NetChaosProxy("127.0.0.1", dp_b.port, seed=2, name="bench-b")
+
+    def run(client, n, size=8):
+        lats = []
+        for _ in range(n):
+            ids = rng.randint(0, 4096, size=size)
+            t0 = time.perf_counter()
+            client.lookup("emb", ids)
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        return lats
+
+    def pct(lats, q):
+        return lats[min(int(len(lats) * q), len(lats) - 1)] * 1e3
+
+    try:
+        # phase 1: proxy passthrough overhead (single endpoint, clean)
+        direct = ServingClient([dp_a.url], deadline_s=30.0, hedge=False)
+        proxied = ServingClient([px_a.url], deadline_s=30.0, hedge=False)
+        run(direct, 20)   # warm jit + pools
+        run(proxied, 20)
+        d = run(direct, 200)
+        p = run(proxied, 200)
+        direct_p50, proxied_p50 = pct(d, 0.5), pct(p, 0.5)
+        out["netchaos_direct_p50_ms"] = round(direct_p50, 3)
+        out["netchaos_proxied_p50_ms"] = round(proxied_p50, 3)
+        out["netchaos_proxy_overhead_pct"] = round(
+            100.0 * (proxied_p50 - direct_p50) / direct_p50, 1
+        )
+        direct.close()
+        proxied.close()
+
+        # phase 2: 150 ms tail on replica A — hedged vs unhedged.
+        # The unhedged client round-robins onto the slow replica for
+        # half its requests; the hedged one escapes at the 10 ms
+        # trigger. Budget is generous on purpose: the phase measures
+        # the mechanism's ceiling, the drill measures the 10% budget.
+        px_a.set_faults(latency_ms=150.0)
+        unhedged = ServingClient([px_a.url, px_b.url], deadline_s=30.0,
+                                 hedge=False, eject=False)
+        hedged = ServingClient([px_a.url, px_b.url], deadline_s=30.0,
+                               hedge_min_delay_s=0.010,
+                               hedge_budget_pct=100.0, eject=False)
+        u = run(unhedged, 60)
+        h = run(hedged, 60)
+        out["netchaos_unhedged_p99_ms"] = round(pct(u, 0.99), 1)
+        out["netchaos_hedged_p99_ms"] = round(pct(h, 0.99), 1)
+        out["netchaos_hedge_wins"] = hedged.stats()["hedge_wins"]
+        unhedged.close()
+        hedged.close()
+        px_a.clear_faults()
+
+        # phase 3: blackhole replica B mid-rotation — per-request
+        # latency THROUGH the eject/failover window (read timeout +
+        # one failover, then ejection routes everything to A)
+        px_b.set_faults(blackhole="both")
+        fo = ServingClient([px_a.url, px_b.url], deadline_s=30.0,
+                           max_attempts=6, backoff_base_s=0.01,
+                           backoff_max_s=0.05, read_timeout_s=0.3,
+                           hedge=False, eject_min_samples=2,
+                           eject_cooldown_s=30.0)
+        f = run(fo, 40)
+        out["netchaos_failover_p99_ms"] = round(pct(f, 0.99), 1)
+        out["netchaos_failover_p50_ms"] = round(pct(f, 0.5), 2)
+        out["netchaos_partition_unrecovered"] = fo.stats()["unrecovered"]
+        out["netchaos_partition_ejections"] = fo.stats()["ejections"]
+        fo.close()
+        px_b.clear_faults()
+    finally:
+        px_a.stop()
+        px_b.stop()
+        dp_a.stop()
+        dp_b.stop()
+        srv_a.stop()
+        srv_b.stop()
+
+    if os.environ.get("MV_BENCH_ASSERTS") == "1":
+        assert out["netchaos_proxy_overhead_pct"] <= 10.0, out
+        assert (out["netchaos_hedged_p99_ms"]
+                <= out["netchaos_unhedged_p99_ms"] / 3.0), out
+        assert out["netchaos_partition_unrecovered"] == 0, out
+    return out
+
+
 def _probe_backend(timeout_s: int = 180):
     """The bench host's TPU rides a shared tunnel that can wedge so hard
     even jax.devices() blocks forever in a fresh process (observed
@@ -2710,6 +2839,11 @@ def main():
               flush=True)
         cp_leg = {"fleet_controlplane_error": str(e)[:200]}
     try:
+        nc_leg = leg("netchaos", _bench_netchaos)
+    except Exception as e:
+        print(f"# leg netchaos FAILED: {e}", file=_sys.stderr, flush=True)
+        nc_leg = {"netchaos_error": str(e)[:200]}
+    try:
         import tempfile
 
         with tempfile.TemporaryDirectory(prefix="mv_bench_ps2p_") as d:
@@ -2762,6 +2896,7 @@ def main():
     out.update(serving)
     out.update(fleet_leg)
     out.update(cp_leg)
+    out.update(nc_leg)
     out.update(ps2p_leg)
     out.update(resilience)
     out.update(e2e)

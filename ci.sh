@@ -481,6 +481,171 @@ print(f"autoscale drill OK: shed burn scaled 1->3 "
 EOF
 rm -rf "$ASROOT"
 
+echo "== serving netchaos drill (tail latency -> hedge, partition -> eject/recover, slow-loris -> 408) =="
+# the partition-tolerant data plane against REAL injected network
+# faults: a 2-replica fleet serves through per-replica NetChaosProxy
+# instances. Phase 1 (scenario-driven) puts a 150 ms latency tail on
+# replica 0 — budget-capped hedged reads must win against it
+# (hedge_wins > 0). Phase 2 blackholes replica 1 for ~5 s — the client
+# must eject it and fail EVERYTHING over to replica 0 with zero
+# unrecovered errors, then half-open-probe it back after the heal
+# (eject -> probe -> recover on fleet.log.jsonl via event_hook). A raw
+# slow-loris probe against a replica's -data_read_timeout_s deadline
+# must get 408 + Connection: close without disturbing paced traffic.
+NCROOT=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$NCROOT" <<'EOF'
+import json, os, socket, sys, threading, time
+import numpy as np
+
+sys.path.insert(0, ".")
+import multiverso_tpu as mv
+from multiverso_tpu.io.checkpoint import save_tables
+from multiverso_tpu.resilience.netchaos import NetChaosProxy, Scenario
+from multiverso_tpu.serving.client import ServingClient
+from multiverso_tpu.serving.fleet import ServingFleet
+from multiverso_tpu.tables import MatrixTableOption
+
+root = sys.argv[1]
+
+mv.MV_Init(["prog"])
+try:
+    t = mv.MV_CreateTable(MatrixTableOption(num_row=64, num_col=8))
+    t.add(np.full((64, 8), 1.0, np.float32))
+    t.wait()
+    save_tables(os.path.join(root, "ckpt-1"), step=1)
+finally:
+    mv.MV_ShutDown(finalize=True)
+
+fleet = ServingFleet(
+    2, root, log_dir=os.path.join(root, "fleet"),
+    extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25",
+                "-data_read_timeout_s=1.0"],
+    backoff_base_s=0.1, backoff_max_s=0.5,
+).start()
+assert fleet.wait_ready(timeout_s=120), "replicas never became ready"
+urls = fleet.endpoints()
+assert len(urls) == 2, urls
+
+
+def hostport(url):
+    h = url.split("//", 1)[1]
+    host, port = h.rsplit(":", 1)
+    return host, int(port)
+
+# per-replica chaos proxies; proxy 0 runs the scenario (150 ms tail for
+# its first 6 s of uptime), proxy 1 is driver-controlled (partition)
+tail = Scenario.from_doc({"phases": [
+    {"start_s": 0.0, "end_s": 6.0, "faults": {"latency_ms": 150.0}},
+]})
+h0, p0 = hostport(urls[0])
+h1, p1 = hostport(urls[1])
+px0 = NetChaosProxy(h0, p0, seed=1, name="nc-0", scenario=tail)
+px1 = NetChaosProxy(h1, p1, seed=2, name="nc-1")
+
+c = ServingClient(
+    [px0.url, px1.url], deadline_s=15.0, max_attempts=8,
+    backoff_base_s=0.01, backoff_max_s=0.1,
+    connect_timeout_s=2.0, read_timeout_s=0.5,
+    hedge_min_delay_s=0.05, hedge_budget_pct=10.0,
+    eject_min_samples=2, eject_cooldown_s=1.0,
+    event_hook=fleet.event,
+)
+
+errors = []
+
+
+def drive(n, pause=0.02):
+    for i in range(n):
+        rows = np.asarray(c.lookup("emb", [i % 64, (i + 7) % 64]),
+                          np.float32)
+        if not np.allclose(rows, 1.0):
+            errors.append(f"wrong rows: {rows[0][:2]}")
+        time.sleep(pause)
+
+
+# phase 1: ~4 s of load under the scenario's 150 ms tail on replica 0
+drive(120, pause=0.02)
+s1 = dict(c.stats())
+assert s1["unrecovered"] == 0, s1
+assert s1["hedge_wins"] > 0, f"hedging never won under the tail: {s1}"
+
+# phase 2: partition replica 1 under load. While hedge budget remains
+# every blackholed-primary request is SAVED by its hedge (and the
+# cancelled primary is deliberately not scored as a failure), so the
+# eject signal starts when the budget cap forces unhedged attempts —
+# drive until that happens, with zero unrecovered errors throughout
+px1.set_faults(blackhole="both")
+t0 = time.monotonic()
+while (time.monotonic() - t0 < 60.0
+       and c.stats()["ejections"] == 0):
+    drive(5, pause=0.02)
+s2 = dict(c.stats())
+assert s2["unrecovered"] == 0, s2
+assert s2["ejections"] >= 1, f"partitioned replica never ejected: {s2}"
+assert time.monotonic() - t0 >= 2.0 or s2["ejections"], s2
+
+# heal: the half-open probe must bring replica 1 back into rotation
+px1.clear_faults()
+deadline = time.monotonic() + 30
+while (time.monotonic() < deadline
+       and c.stats()["eject_recoveries"] == 0):
+    drive(5, pause=0.05)
+s3 = dict(c.stats())
+assert s3["eject_recoveries"] >= 1, f"ejected replica never recovered: {s3}"
+assert s3["unrecovered"] == 0, s3
+
+# slow-loris probe straight at replica 0's data port (bypassing the
+# proxy): full headers, stalled body -> the -data_read_timeout_s
+# deadline must answer 408 + Connection: close, not hold the slot
+sl = socket.create_connection((h0, p0), timeout=10)
+sl.settimeout(10)
+sl.sendall(b"POST /v1/lookup HTTP/1.1\r\nHost: t\r\n"
+           b"Content-Type: application/json\r\n"
+           b"Content-Length: 64\r\n\r\n{\"ta")
+resp = b""
+try:
+    while b"\r\n\r\n" not in resp:
+        chunk = sl.recv(4096)
+        if not chunk:
+            break
+        resp += chunk
+finally:
+    sl.close()
+head = resp.decode("latin-1", "replace")
+assert " 408 " in head.splitlines()[0], head[:200]
+assert "connection: close" in head.lower(), head[:400]
+
+# paced traffic is untouched by the slow-loris connection
+drive(10, pause=0.01)
+final = dict(c.stats())
+c.close()
+px0.stop()
+px1.stop()
+
+# the eject -> probe -> recover cycle is on the fleet audit log next
+# to the replica lifecycle it reacted to
+with open(os.path.join(root, "fleet", "fleet.log.jsonl")) as f:
+    kinds = [json.loads(ln).get("event") for ln in f if ln.strip()]
+for needed in ("outlier_eject", "outlier_probe", "outlier_recover"):
+    assert needed in kinds, (needed, kinds)
+
+fleet.stop()
+assert fleet.alive() == 0
+assert not errors, errors[:3]
+assert final["unrecovered"] == 0, final
+stats0, stats1 = px0.stats(), px1.stats()
+print(f"netchaos drill OK: {final['requests']} requests, 0 unrecovered "
+      f"({final['failovers']} failovers), {final['hedges']} hedges / "
+      f"{final['hedge_wins']} wins under the 150ms tail, partition "
+      f"ejected+recovered ({final['ejections']} eject / "
+      f"{final['eject_probes']} probe / {final['eject_recoveries']} "
+      f"recover), slow-loris 408, proxy bytes c2s/s2c "
+      f"{stats0['bytes_c2s'] + stats1['bytes_c2s']}/"
+      f"{stats0['bytes_s2c'] + stats1['bytes_s2c']}, "
+      f"{stats1['blackholed_conns']} blackholed conns")
+EOF
+rm -rf "$NCROOT"
+
 echo "== crash-recovery smoke (chaos kill -> elastic resume) =="
 # fault-tolerance end to end with a REAL process death: the WordEmbedding
 # CLI is chaos-killed (os._exit 137) mid-run with crash-consistent

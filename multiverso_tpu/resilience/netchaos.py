@@ -1,0 +1,643 @@
+"""Network chaos proxy: deterministic TCP fault injection for serving.
+
+Process-level chaos (``resilience/chaos.py`` — kills, route errors,
+torn checkpoints) never touches the *wire*: until this module, the
+binary data plane and its keep-alive pool had only ever seen a loopback
+that delivers every byte instantly and in order. Real networks deliver
+tail latency, partitions, half-open connections and corrupted segments
+— the gray failures that kill p99 at scale. ``NetChaosProxy`` is a
+stdlib-threaded TCP proxy that fronts any replica ``-data_port`` and
+injects exactly those faults, deterministically (seeded xorshift32 —
+the same PRNG family as ``chaos.FullJitterBackoff``), so ci drills and
+tests can script a partition the way they script a kill.
+
+Fault schedule (a ``FaultSpec``; every field independent, all off by
+default). The direction mapping is fixed so one small flag surface
+stays unambiguous:
+
+* ``latency_ms`` + ``jitter_ms`` — added delay per forwarded chunk on
+  the **server→client** direction (a slow replica: the request arrives,
+  the response straggles). Jitter is uniform in ``[0, jitter_ms)``,
+  drawn from the per-connection PRNG.
+* ``bandwidth_kbps`` — throttle on the server→client direction
+  (chunked pacing sleep after each forward).
+* ``reset_after_bytes`` — once the connection has forwarded this many
+  bytes (both directions combined), both sockets are closed with
+  ``SO_LINGER 0``: the peer sees a hard RST mid-stream, not a FIN.
+* ``blackhole`` — ``"c2s"`` / ``"s2c"`` / ``"both"``: bytes in the
+  blackholed direction are read and silently dropped (a partition: the
+  TCP connection stays up, data never arrives). A connection *accepted*
+  during a ``"both"`` blackhole is never connected upstream at all —
+  the client's connect succeeds (the kernel completed the handshake)
+  and then nothing ever answers, which is exactly what a partitioned
+  endpoint looks like behind a balancer.
+* ``corrupt_offset`` + ``corrupt_mode`` — at byte N of the
+  **client→server** stream either flip one bit (``"bitflip"``) or stop
+  forwarding and close (``"truncate"``): a corrupted / truncated
+  request frame that the server must answer 400 and survive.
+* ``stall_s`` — accept-then-stall: hold the accepted socket this long
+  before connecting upstream (the slow-loris shape, server side).
+
+**Scheduling.** Faults come from three layers, strongest first: a
+runtime override (``set_faults`` / ``clear_faults`` — what tests and
+drills flip mid-traffic), the active phase of a JSON scenario, and the
+proxy-wide default spec. A scenario is::
+
+    {"phases": [
+      {"start_s": 0,  "end_s": 10, "faults": {"latency_ms": 150}},
+      {"start_s": 10, "end_s": 15, "faults": {"blackhole": "both"}}
+    ]}
+
+with phase times measured from proxy start on the injectable clock
+(tests flip phases with a fake clock, zero sleeps). ``ci.sh``'s
+netchaos drill scripts its tail-latency + partition scenario this way.
+
+**Flags** (the CLI entry point — ``python -m
+multiverso_tpu.resilience.netchaos -netchaos_upstream=host:port``):
+``-netchaos_listen_port``, ``-netchaos_seed``, ``-netchaos_scenario``
+(JSON file) and one flag per ``FaultSpec`` field for scenario-less use.
+
+Everything is stdlib sockets + threads: no asyncio, no dependencies,
+deterministic byte accounting (``stats()``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_double,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    GetFlag,
+    ParseCMDFlags,
+)
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = [
+    "FaultSpec",
+    "Scenario",
+    "NetChaosProxy",
+    "proxy_from_flags",
+    "main",
+]
+
+MV_DEFINE_string(
+    "netchaos_upstream", "",
+    "netchaos proxy: host:port of the replica data plane to front — the "
+    "proxy forwards every accepted connection there with the armed "
+    "faults injected (required by the CLI entry point)",
+)
+MV_DEFINE_int(
+    "netchaos_listen_port", 0,
+    "netchaos proxy: listen port clients connect to (0 = ephemeral; the "
+    "bound port is logged and returned by proxy_from_flags)",
+)
+MV_DEFINE_int(
+    "netchaos_seed", 0,
+    "netchaos proxy: seed for the per-connection xorshift32 PRNG — the "
+    "same seed + scenario + traffic replays the same jitter draws",
+)
+MV_DEFINE_string(
+    "netchaos_scenario", "",
+    "netchaos proxy: JSON scenario file of timed fault phases "
+    "({'phases': [{'start_s', 'end_s', 'faults': {...}}]}, clocked from "
+    "proxy start) — how ci.sh scripts a tail-latency window followed by "
+    "a partition (empty = the per-fault flags below apply always)",
+)
+MV_DEFINE_double(
+    "netchaos_latency_ms", 0.0,
+    "netchaos proxy: added delay per forwarded chunk, server->client "
+    "(a slow replica; 0 = off)",
+)
+MV_DEFINE_double(
+    "netchaos_jitter_ms", 0.0,
+    "netchaos proxy: uniform extra delay in [0, jitter_ms) on top of "
+    "-netchaos_latency_ms, drawn from the seeded per-connection PRNG",
+)
+MV_DEFINE_double(
+    "netchaos_bandwidth_kbps", 0.0,
+    "netchaos proxy: throttle the server->client direction to this "
+    "many kilobytes/second (0 = unthrottled)",
+)
+MV_DEFINE_int(
+    "netchaos_reset_after_bytes", -1,
+    "netchaos proxy: hard-RST both sides of a connection (SO_LINGER 0) "
+    "once it has forwarded this many bytes in total (-1 = off) — the "
+    "connection-reset-at-byte-N fault",
+)
+MV_DEFINE_string(
+    "netchaos_blackhole", "",
+    "netchaos proxy: partition direction — c2s (requests vanish), s2c "
+    "(responses vanish) or both (connections accepted during the fault "
+    "never reach the upstream at all); empty = off",
+)
+MV_DEFINE_int(
+    "netchaos_corrupt_offset", -1,
+    "netchaos proxy: byte offset in the client->server stream where "
+    "-netchaos_corrupt_mode strikes (-1 = off) — the corrupted-frame "
+    "fault the 400 contract is drilled against",
+)
+MV_DEFINE_string(
+    "netchaos_corrupt_mode", "bitflip",
+    "netchaos proxy: what happens at -netchaos_corrupt_offset — "
+    "bitflip (one bit of that byte inverts) or truncate (the stream "
+    "stops there and the connection closes)",
+)
+MV_DEFINE_double(
+    "netchaos_stall_s", 0.0,
+    "netchaos proxy: accept-then-stall — hold every accepted socket "
+    "this long before connecting upstream (slow-loris shape; 0 = off)",
+)
+
+_CHUNK = 16384
+_BLACKHOLE_POLL_S = 0.05
+_FAULT_FIELDS = (
+    "latency_ms", "jitter_ms", "bandwidth_kbps", "reset_after_bytes",
+    "blackhole", "corrupt_offset", "corrupt_mode", "stall_s",
+)
+
+
+class FaultSpec:
+    """One connection-fault schedule; every field independent."""
+
+    __slots__ = _FAULT_FIELDS
+
+    def __init__(self, latency_ms: float = 0.0, jitter_ms: float = 0.0,
+                 bandwidth_kbps: float = 0.0, reset_after_bytes: int = -1,
+                 blackhole: str = "", corrupt_offset: int = -1,
+                 corrupt_mode: str = "bitflip", stall_s: float = 0.0):
+        CHECK(blackhole in ("", "c2s", "s2c", "both"),
+              f"blackhole must be ''|c2s|s2c|both, got {blackhole!r}")
+        CHECK(corrupt_mode in ("bitflip", "truncate"),
+              f"corrupt_mode must be bitflip|truncate, got {corrupt_mode!r}")
+        self.latency_ms = float(latency_ms)
+        self.jitter_ms = float(jitter_ms)
+        self.bandwidth_kbps = float(bandwidth_kbps)
+        self.reset_after_bytes = int(reset_after_bytes)
+        self.blackhole = str(blackhole)
+        self.corrupt_offset = int(corrupt_offset)
+        self.corrupt_mode = str(corrupt_mode)
+        self.stall_s = float(stall_s)
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, Any]) -> "FaultSpec":
+        unknown = set(doc) - set(_FAULT_FIELDS)
+        CHECK(not unknown, f"unknown fault fields: {sorted(unknown)}")
+        return cls(**doc)
+
+    def clean(self) -> bool:
+        return (self.latency_ms <= 0.0 and self.jitter_ms <= 0.0
+                and self.bandwidth_kbps <= 0.0
+                and self.reset_after_bytes < 0 and not self.blackhole
+                and self.corrupt_offset < 0 and self.stall_s <= 0.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {f: getattr(self, f) for f in _FAULT_FIELDS}
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        on = {f: v for f, v in self.to_dict().items()
+              if v not in (0.0, -1, "", "bitflip")}
+        return f"FaultSpec({on or 'clean'})"
+
+
+class Scenario:
+    """Timed fault phases, evaluated against the proxy's uptime."""
+
+    def __init__(self, phases: List[Tuple[float, float, FaultSpec]]):
+        self.phases = list(phases)
+
+    @classmethod
+    def from_doc(cls, doc: Any) -> "Scenario":
+        phases_doc = doc.get("phases", []) if isinstance(doc, dict) else doc
+        phases = []
+        for p in phases_doc:
+            phases.append((
+                float(p.get("start_s", 0.0)),
+                float(p.get("end_s", float("inf"))),
+                FaultSpec.from_dict(dict(p.get("faults", {}))),
+            ))
+        return cls(phases)
+
+    @classmethod
+    def load(cls, path: str) -> "Scenario":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_doc(json.load(f))
+
+    def active(self, uptime_s: float) -> Optional[FaultSpec]:
+        """The last phase covering ``uptime_s`` (later phases win), or
+        ``None`` when no phase is active."""
+        hit = None
+        for start, end, spec in self.phases:
+            if start <= uptime_s < end:
+                hit = spec
+        return hit
+
+
+class _XorShift32:
+    """The chaos module's deterministic PRNG, one instance per
+    connection: seed + connection index fully determine every jitter
+    draw, so a replayed drill replays its delays."""
+
+    def __init__(self, seed: int):
+        self._state = (int(seed) & 0xFFFFFFFF) or 0x9E3779B9
+        # one rng is shared by a connection's two pump threads; the
+        # state advance must be atomic or draws can repeat/corrupt
+        self._mu = threading.Lock()
+
+    def uniform(self) -> float:
+        with self._mu:
+            x = self._state
+            x ^= (x << 13) & 0xFFFFFFFF
+            x ^= x >> 17
+            x ^= (x << 5) & 0xFFFFFFFF
+            self._state = x
+        return x / 4294967296.0
+
+
+def _no_nagle(sock: socket.socket) -> None:
+    """Disable Nagle so the proxy's extra hop is transparent: forwarded
+    request/response frames are small, and Nagle + delayed ACK would
+    tax every one of them with a ~40ms stall the real path never pays."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
+
+
+def _hard_reset(sock: Optional[socket.socket]) -> None:
+    """Close with SO_LINGER 0 — the peer sees RST, not FIN."""
+    if sock is None:
+        return
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER,
+            struct.pack("ii", 1, 0),
+        )
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class NetChaosProxy:
+    """Fault-injecting TCP proxy in front of one upstream endpoint.
+
+    ``port=0`` binds ephemeral (read ``.port`` / ``.url`` back).
+    ``clock`` paces the scenario phases only — byte forwarding always
+    uses real sockets. Use as a context manager or call ``stop()``."""
+
+    def __init__(self, upstream_host: str, upstream_port: int, *,
+                 host: str = "127.0.0.1", port: int = 0, seed: int = 0,
+                 scenario: Optional[Scenario] = None,
+                 faults: Optional[FaultSpec] = None,
+                 name: str = "netchaos",
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.upstream = (upstream_host, int(upstream_port))
+        self.name = name
+        self.seed = int(seed)
+        self.scenario = scenario
+        self._default = faults or FaultSpec()
+        self._override: Optional[FaultSpec] = None
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._stats = {
+            "connections": 0, "active": 0, "bytes_c2s": 0, "bytes_s2c": 0,
+            "resets": 0, "corrupted": 0, "truncated": 0,
+            "blackholed_bytes": 0, "blackholed_conns": 0,
+            "stalled_conns": 0, "upstream_errors": 0,
+        }
+        self._stopping = threading.Event()
+        self._conns: List[Tuple[socket.socket, Optional[socket.socket]]] = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        self.host = host
+        self.port = int(self._listener.getsockname()[1])
+        self._t0 = self._clock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"mv-{name}-accept",
+        )
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------ control
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def set_faults(self, spec: Optional[FaultSpec] = None,
+                   **fields: Any) -> FaultSpec:
+        """Arm a runtime fault override (wins over the scenario and the
+        default spec). Pass a ``FaultSpec`` or keyword fields."""
+        if spec is None:
+            spec = FaultSpec(**fields)
+        with self._lock:
+            self._override = spec
+        return spec
+
+    def clear_faults(self) -> None:
+        with self._lock:
+            self._override = None
+
+    def current_faults(self) -> FaultSpec:
+        """The spec in effect right now: override > scenario phase >
+        proxy default."""
+        with self._lock:
+            if self._override is not None:
+                return self._override
+        if self.scenario is not None:
+            hit = self.scenario.active(self._clock() - self._t0)
+            if hit is not None:
+                return hit
+        return self._default
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+            self._conns = []
+        for c, s in conns:
+            for sock in (c, s):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+        self._accept_thread.join(timeout=5)
+
+    def __enter__(self) -> "NetChaosProxy":
+        return self
+
+    def __exit__(self, *_exc: Any) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ accept
+
+    def _accept_loop(self) -> None:
+        idx = 0
+        while not self._stopping.is_set():
+            try:
+                client, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed: shutting down
+            idx += 1
+            self._bump("connections")
+            rng = _XorShift32(self.seed ^ (idx * 0x9E3779B1))
+            t = threading.Thread(
+                target=self._serve_conn, args=(client, rng), daemon=True,
+                name=f"mv-{self.name}-conn{idx}",
+            )
+            t.start()
+
+    def _serve_conn(self, client: socket.socket, rng: _XorShift32) -> None:
+        self._bump("active")
+        server: Optional[socket.socket] = None
+        try:
+            # a transparent proxy must not ADD latency the wire didn't
+            # order: with Nagle on, the store-and-forward hop turns each
+            # small HTTP frame into a ~40ms delayed-ACK stall
+            _no_nagle(client)
+            spec = self.current_faults()
+            if spec.stall_s > 0.0:
+                self._bump("stalled_conns")
+                self._sleep(spec.stall_s)
+            # accepted mid-partition: never connect upstream — sit on
+            # the socket discarding anything the client sends until the
+            # fault clears or the client gives up (what a partitioned
+            # endpoint looks like: connect succeeds, nothing answers)
+            if spec.blackhole == "both":
+                self._bump("blackholed_conns")
+                if not self._hold_blackholed(client):
+                    return
+            try:
+                server = socket.create_connection(self.upstream, timeout=10)
+                _no_nagle(server)
+            except OSError:
+                self._bump("upstream_errors")
+                _hard_reset(client)
+                return
+            with self._lock:
+                self._conns.append((client, server))
+            # shared per-connection byte budget for reset_after_bytes
+            shared = {"fwd": 0, "reset": False}
+            lock = threading.Lock()
+            t = threading.Thread(
+                target=self._pump, args=(
+                    client, server, "c2s", rng, shared, lock
+                ), daemon=True, name=f"mv-{self.name}-c2s",
+            )
+            t.start()
+            self._pump(server, client, "s2c", rng, shared, lock)
+            t.join(timeout=5)
+        finally:
+            for sock in (client, server):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            self._bump("active", -1)
+
+    def _hold_blackholed(self, client: socket.socket) -> bool:
+        """Park a connection accepted during a full partition. Returns
+        True when the fault cleared with the client still there (the
+        connection then proceeds upstream), False when the client hung
+        up or the proxy is stopping."""
+        while not self._stopping.is_set():
+            spec = self.current_faults()
+            if spec.blackhole != "both":
+                return True
+            try:
+                r, _w, _x = select.select([client], [], [],
+                                          _BLACKHOLE_POLL_S)
+            except (OSError, ValueError):
+                return False
+            if r:
+                # re-check before consuming: bytes that arrived AFTER
+                # the fault cleared belong to the healed connection (a
+                # real network would retransmit them) — leave them in
+                # the kernel buffer for the pump to forward
+                if self.current_faults().blackhole != "both":
+                    return True
+                try:
+                    data = client.recv(_CHUNK)
+                except OSError:
+                    return False
+                if not data:
+                    return False  # client gave up
+                self._bump("blackholed_bytes", len(data))
+        return False
+
+    # ------------------------------------------------------------ pump
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              direction: str, rng: _XorShift32,
+              shared: Dict[str, Any], lock: threading.Lock) -> None:
+        """Forward ``src`` -> ``dst`` applying the live fault spec per
+        chunk. ``direction`` is ``"c2s"`` (requests: corruption point)
+        or ``"s2c"`` (responses: latency/throttle point)."""
+        seen = 0  # bytes read from src on this direction
+        try:
+            while not self._stopping.is_set():
+                try:
+                    data = src.recv(_CHUNK)
+                except OSError:
+                    break
+                if not data:
+                    # half-close: propagate the FIN so the peer's read
+                    # completes instead of hanging until its timeout
+                    try:
+                        dst.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    break
+                spec = self.current_faults()
+                if spec.blackhole in (direction, "both"):
+                    self._bump("blackholed_bytes", len(data))
+                    continue
+                if spec.corrupt_offset >= 0 and direction == "c2s":
+                    data, stop = self._corrupt(data, seen, spec)
+                    if stop:
+                        seen += len(data)
+                        if data:
+                            try:
+                                dst.sendall(data)
+                            except OSError:
+                                pass
+                        self._bump("truncated")
+                        with lock:
+                            shared["reset"] = True
+                        _hard_reset(src)
+                        _hard_reset(dst)
+                        break
+                seen += len(data)
+                if direction == "s2c":
+                    delay = spec.latency_ms * 1e-3
+                    if spec.jitter_ms > 0.0:
+                        delay += rng.uniform() * spec.jitter_ms * 1e-3
+                    if delay > 0.0:
+                        self._sleep(delay)
+                    if spec.bandwidth_kbps > 0.0:
+                        self._sleep(
+                            len(data) / (spec.bandwidth_kbps * 1024.0)
+                        )
+                try:
+                    dst.sendall(data)
+                except OSError:
+                    break
+                self._bump(f"bytes_{direction}", len(data))
+                if spec.reset_after_bytes >= 0:
+                    with lock:
+                        shared["fwd"] += len(data)
+                        fire = (not shared["reset"]
+                                and shared["fwd"] >= spec.reset_after_bytes)
+                        if fire:
+                            shared["reset"] = True
+                    if fire:
+                        self._bump("resets")
+                        _hard_reset(src)
+                        _hard_reset(dst)
+                        break
+        finally:
+            pass
+
+    def _corrupt(self, data: bytes, seen: int,
+                 spec: FaultSpec) -> Tuple[bytes, bool]:
+        """Apply the corrupt-at-offset fault to one chunk whose first
+        byte sits at stream offset ``seen``. Returns ``(data, stop)``:
+        ``stop`` means truncate-here (forward the prefix, then RST)."""
+        off = spec.corrupt_offset
+        if off < seen or off >= seen + len(data):
+            return data, False
+        i = off - seen
+        if spec.corrupt_mode == "truncate":
+            return data[:i], True
+        self._bump("corrupted")
+        flipped = bytes([data[i] ^ 0x10])
+        return data[:i] + flipped + data[i + 1:], False
+
+
+# ---------------------------------------------------------------- flags
+
+
+def _faults_from_flags() -> FaultSpec:
+    return FaultSpec(
+        latency_ms=float(GetFlag("netchaos_latency_ms")),
+        jitter_ms=float(GetFlag("netchaos_jitter_ms")),
+        bandwidth_kbps=float(GetFlag("netchaos_bandwidth_kbps")),
+        reset_after_bytes=int(GetFlag("netchaos_reset_after_bytes")),
+        blackhole=str(GetFlag("netchaos_blackhole")),
+        corrupt_offset=int(GetFlag("netchaos_corrupt_offset")),
+        corrupt_mode=str(GetFlag("netchaos_corrupt_mode")),
+        stall_s=float(GetFlag("netchaos_stall_s")),
+    )
+
+
+def proxy_from_flags() -> NetChaosProxy:
+    """Build the proxy the ``-netchaos_*`` flags describe (the CLI
+    entry point and flag-driven drills)."""
+    upstream = str(GetFlag("netchaos_upstream"))
+    CHECK(":" in upstream,
+          "-netchaos_upstream must be host:port (the replica data port "
+          "the proxy fronts)")
+    host, _, port_s = upstream.rpartition(":")
+    scenario_path = str(GetFlag("netchaos_scenario"))
+    scenario = Scenario.load(scenario_path) if scenario_path else None
+    return NetChaosProxy(
+        host, int(port_s),
+        port=int(GetFlag("netchaos_listen_port")),
+        seed=int(GetFlag("netchaos_seed")),
+        scenario=scenario,
+        faults=_faults_from_flags(),
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    leftover = ParseCMDFlags(list(sys.argv if argv is None else argv))
+    if len(leftover) > 1:
+        Log.Error("netchaos: unrecognised argv %s", leftover[1:])
+        return 2
+    proxy = proxy_from_flags()
+    Log.Info(
+        "netchaos: %s -> %s:%d (pid %d)",
+        proxy.url, proxy.upstream[0], proxy.upstream[1], os.getpid(),
+    )
+    stop = threading.Event()
+    import signal
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,251 @@
+"""Per-endpoint outlier ejection: take gray-failing replicas out of
+client rotation.
+
+The route ``CircuitBreaker`` protects a *server* from its own failing
+routes; this is the client-side twin. A replica that is alive but
+useless — resetting connections, timing out, or answering 30x slower
+than its peers (the classic gray failure a /healthz probe never sees)
+— keeps absorbing a share of every client's attempts and drags fleet
+p99 with it. ``OutlierEjector`` scores each endpoint with EWMAs of its
+error rate and its success latency (relative to the MEDIAN of all
+endpoints' latency EWMAs — a shared mean would be polluted by the
+outlier's own samples, which at EWMA weight alpha put a floor of
+``alpha * L`` under the baseline and make a constant-latency outlier
+mathematically un-ejectable) and ejects an outlier from rotation;
+after ``cooldown_s`` it
+half-opens and admits exactly one probe — success recovers the
+endpoint, failure re-ejects it for another cooldown. The state machine
+and the ``peek``/``allow``/``record`` calling convention deliberately
+mirror ``resilience/breaker.py`` so both sides of the contract read the
+same way (DEPLOY.md's runbook spells out the split: breakers shed a
+*route*, ejection skips an *endpoint*).
+
+The ejector never decides fail-closed on its own: a caller whose every
+endpoint is ejected is expected to fail open (``ServingClient`` uses
+the full list again — permanently blacklisting the whole fleet would
+fight the supervisor's self-healing, exactly like the client's
+no-permanent-blacklist rule for single failures).
+
+Deterministic by construction: state moves only on ``peek``/``allow``/
+``record`` calls, the clock is injectable, there are no background
+threads. Transitions land in the flight recorder
+(``outlier_eject`` / ``outlier_probe`` / ``outlier_recover``) and on an
+optional ``on_transition`` callback — the fleet drill routes that into
+``fleet.log.jsonl`` so one file shows the eject→probe→recover cycle
+next to the replica kills.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["OutlierEjector"]
+
+
+class _EndpointScore:
+    __slots__ = ("err", "lat", "n", "state", "since")
+
+    def __init__(self) -> None:
+        self.err = 0.0        # EWMA of the failure indicator (0/1)
+        self.lat = 0.0        # EWMA of success latency (seconds)
+        self.n = 0            # outcomes observed since last recovery
+        self.state = "ok"     # ok | ejected | probing
+        self.since = 0.0      # clock() of the last ejection
+
+
+class OutlierEjector:
+    """EWMA error-rate + latency-outlier ejection with half-open
+    probing.
+
+    * ``record(key, ok, latency_s)`` — one attempt outcome. Trips the
+      ejection when, after ``min_samples`` outcomes, the error EWMA
+      crosses ``error_threshold`` OR the endpoint's success-latency
+      EWMA exceeds ``latency_factor``× the median of the per-endpoint
+      latency EWMAs (with an absolute ``min_latency_s`` floor so
+      loopback noise can never eject; with a single endpoint the
+      median IS its own EWMA, so latency ejection never fires — there
+      is no peer to be an outlier against).
+    * ``peek(key)`` — non-mutating admission check (rotation filter).
+    * ``allow(key)`` — like ``peek`` but claims the single half-open
+      probe slot when the cooldown has elapsed; the caller that got
+      ``True`` on a recovering endpoint MUST follow with ``record``.
+    """
+
+    def __init__(
+        self,
+        *,
+        error_threshold: float = 0.5,
+        latency_factor: float = 3.0,
+        min_latency_s: float = 0.010,
+        min_samples: int = 5,
+        cooldown_s: float = 5.0,
+        alpha: float = 0.3,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "client",
+        on_transition: Optional[Callable[..., None]] = None,
+    ):
+        assert 0.0 < alpha <= 1.0 and min_samples >= 1
+        self.error_threshold = float(error_threshold)
+        self.latency_factor = float(latency_factor)
+        self.min_latency_s = float(min_latency_s)
+        self.min_samples = int(min_samples)
+        self.cooldown_s = float(cooldown_s)
+        self.alpha = float(alpha)
+        self.name = name
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._scores: Dict[str, _EndpointScore] = {}
+
+    # ------------------------------------------------------------ events
+
+    def _note(self, kind: str, endpoint: str, **fields: Any) -> None:
+        """Flight-recorder breadcrumb + optional callback, OUTSIDE the
+        lock (mirrors ``CircuitBreaker._note_transition``)."""
+        from multiverso_tpu.obs.flight import recorder
+
+        recorder.record(kind, ejector=self.name, endpoint=endpoint,
+                        **fields)
+        if self._on_transition is not None:
+            try:
+                self._on_transition(kind, endpoint=endpoint, **fields)
+            except Exception:  # noqa: BLE001 — an observer must never
+                pass           # break the data path
+
+    # ------------------------------------------------------------ score
+
+    def _score(self, key: str) -> _EndpointScore:
+        s = self._scores.get(key)
+        if s is None:
+            s = _EndpointScore()
+            self._scores[key] = s
+        return s
+
+    def _baseline_lat(self) -> float:
+        """Median of the per-endpoint success-latency EWMAs (caller
+        holds the lock). Robust by construction: one gray endpoint
+        cannot drag the baseline it is judged against."""
+        lats = sorted(s.lat for s in self._scores.values() if s.lat > 0.0)
+        if not lats:
+            return 0.0
+        mid = len(lats) // 2
+        if len(lats) % 2:
+            return lats[mid]
+        return 0.5 * (lats[mid - 1] + lats[mid])
+
+    def record(self, key: str, ok: bool, latency_s: float = 0.0) -> None:
+        """One attempt outcome for ``key``; drives ejection and probe
+        resolution."""
+        now = self._clock()
+        note = None
+        with self._lock:
+            s = self._score(key)
+            if s.state == "probing":
+                # this outcome IS the probe verdict
+                if ok:
+                    s.state = "ok"
+                    s.err = 0.0
+                    s.n = 0
+                    note = ("outlier_recover", {})
+                else:
+                    s.state = "ejected"
+                    s.since = now
+                    note = ("outlier_eject", {"probe_failed": True})
+            else:
+                a = self.alpha
+                s.err = a * (0.0 if ok else 1.0) + (1.0 - a) * s.err
+                if ok and latency_s > 0.0:
+                    s.lat = (a * latency_s + (1.0 - a) * s.lat
+                             if s.lat > 0.0 else latency_s)
+                s.n += 1
+                if s.state == "ok" and s.n >= self.min_samples:
+                    baseline = self._baseline_lat()
+                    lat_floor = max(
+                        self.min_latency_s,
+                        self.latency_factor * baseline,
+                    )
+                    slow = (self.latency_factor > 0.0
+                            and baseline > 0.0
+                            and s.lat > lat_floor)
+                    if s.err >= self.error_threshold or slow:
+                        s.state = "ejected"
+                        s.since = now
+                        note = ("outlier_eject", {
+                            "err_ewma": round(s.err, 4),
+                            "lat_ewma_ms": round(s.lat * 1e3, 3),
+                            "fleet_lat_ms": round(baseline * 1e3, 3),
+                            "slow": bool(slow),
+                        })
+        if note is not None:
+            self._note(note[0], key, **note[1])
+
+    # ------------------------------------------------------------ admit
+
+    def peek(self, key: str) -> bool:
+        """Non-mutating: is ``key`` currently in rotation? An ejected
+        endpoint past its cooldown reads as admissible (a probe
+        candidate); a probe already in flight does not."""
+        now = self._clock()
+        with self._lock:
+            s = self._scores.get(key)
+            if s is None or s.state == "ok":
+                return True
+            if s.state == "probing":
+                return False
+            return now - s.since >= self.cooldown_s
+
+    def allow(self, key: str) -> bool:
+        """Admission that claims the half-open probe slot: an ejected
+        endpoint past cooldown transitions to ``probing`` and admits
+        exactly this caller; everyone else sees False until the probe's
+        ``record`` resolves it."""
+        now = self._clock()
+        note = None
+        with self._lock:
+            s = self._scores.get(key)
+            if s is None or s.state == "ok":
+                return True
+            if s.state == "probing":
+                out = False
+            elif now - s.since >= self.cooldown_s:
+                s.state = "probing"
+                note = ("outlier_probe", {})
+                out = True
+            else:
+                out = False
+        if note is not None:
+            self._note(note[0], key, **note[1])
+        return out
+
+    # ------------------------------------------------------------ read
+
+    def state(self, key: str) -> str:
+        with self._lock:
+            s = self._scores.get(key)
+            return s.state if s is not None else "ok"
+
+    def ejected(self) -> List[str]:
+        with self._lock:
+            return sorted(
+                k for k, s in self._scores.items() if s.state != "ok"
+            )
+
+    def forget(self, key: str) -> None:
+        """Drop an endpoint's score entirely (it vanished from the
+        endpoint source — a drained replica, not an outage)."""
+        with self._lock:
+            self._scores.pop(key, None)
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                k: {
+                    "state": s.state,
+                    "err_ewma": round(s.err, 4),
+                    "lat_ewma_ms": round(s.lat * 1e3, 3),
+                    "samples": s.n,
+                }
+                for k, s in sorted(self._scores.items())
+            }

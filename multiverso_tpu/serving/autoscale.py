@@ -108,13 +108,20 @@ class ScaleDecision:
 def fleet_rules(
     p99_ms_objective: float = 250.0,
     shed_rate_objective: float = 0.05,
+    queue_depth_objective: float = 64.0,
     fast_window_s: float = 15.0,
     slow_window_s: float = 60.0,
 ) -> List[SLORule]:
     """Burn rules over the FLEET-aggregated feed the autoscaler ingests
     (``fleet:*`` keys). ``fleet:p99_ms`` is already windowed (bucket
     deltas), so it simply vanishes when traffic stops — no-signal
-    windows count as healthy, which is what lets the idle drain fire."""
+    windows count as healthy, which is what lets the idle drain fire.
+
+    ``fleet_queue_depth`` watches the MEAN live batcher queue depth per
+    replica: a fleet saturated enough to queue (but not yet shedding or
+    blowing p99 — the queue absorbs the burst first) scales up BEFORE
+    the user-visible SLOs burn. Gauge semantics: the window mean of the
+    scraped depth against the objective."""
     common = dict(fast_window_s=fast_window_s, slow_window_s=slow_window_s)
     return [
         SLORule(
@@ -125,6 +132,10 @@ def fleet_rules(
             name="fleet_shed_rate", metric="fleet:shed",
             total="fleet:requests", objective=shed_rate_objective,
             kind="ratio", **common,
+        ),
+        SLORule(
+            name="fleet_queue_depth", metric="fleet:queue_depth_mean",
+            objective=queue_depth_objective, kind="gauge", **common,
         ),
     ]
 
@@ -329,6 +340,8 @@ class FleetAutoscaler:
 
     def _aggregate(self, merged: str, scraped: int) -> Dict[str, float]:
         served = shed = cache_hits = 0.0
+        queue_depth = 0.0
+        queue_samples = 0
         buckets: Dict[float, float] = {}
         hist_count = 0.0
         for line in merged.splitlines():
@@ -346,6 +359,11 @@ class FleetAutoscaler:
                 shed += value
             elif name == "mv_serving_cache_hits":
                 cache_hits += value
+            elif name == "mv_serving_replica_queue_depth":
+                # live batcher queue gauge, one sample per replica —
+                # the saturation early-warning for fleet_queue_depth
+                queue_depth += value
+                queue_samples += 1
             elif name == "mv_serving_request_latency_seconds_bucket":
                 le = _LE_RE.search(labels)
                 if le is None or le.group(1) == "+Inf":
@@ -366,6 +384,9 @@ class FleetAutoscaler:
             "fleet:requests": requests,
             "fleet:scraped": float(scraped),
         }
+        if queue_samples > 0:
+            flat["fleet:queue_depth"] = queue_depth
+            flat["fleet:queue_depth_mean"] = queue_depth / queue_samples
         p99 = self._windowed_p99_ms(now, buckets, hist_count)
         self._cum.append((now, buckets, hist_count))
         if p99 is not None:

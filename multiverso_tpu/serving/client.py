@@ -34,6 +34,29 @@ per-request stats: ``pool_handshakes`` (fresh TCP connects),
 ``pool_reused`` (requests served on a kept-alive socket) and
 ``stale_retries`` (reuse attempts that hit a server-closed socket).
 
+**Partition tolerance** (the netchaos drill's contract):
+
+* the pool splits **connect vs read** timeouts: a fresh connection is
+  attempted under ``connect_timeout_s`` and the response is awaited
+  under ``read_timeout_s`` (each capped by the remaining deadline), so
+  a blackholed endpoint fails over in about a connect timeout instead
+  of burning the whole budget on one dead socket;
+* **outlier ejection**: every attempt outcome feeds a per-endpoint
+  ``resilience.outlier.OutlierEjector`` (EWMA error rate + latency
+  score). An ejected endpoint leaves the rotation; after its cooldown
+  a single half-open probe decides recovery — the client-side twin of
+  the server's route ``CircuitBreaker``. The client always fails open:
+  with every endpoint ejected the full list is used again;
+* **hedged reads**: idempotent routes (lookup/topk — never predict)
+  may fire ONE backup attempt at a second endpoint once the first has
+  been in flight for an adaptive delay (~p95 of recent successes,
+  clamped to ``[hedge_min_delay_s, hedge_max_delay_s]``). First answer
+  wins; the loser's socket is closed (no pool slot leaks, no double
+  charge to the ejector). Hedges are budget-capped at
+  ``hedge_budget_pct`` of requests so a fleet-wide brownout cannot
+  double its own load. ``hedges`` / ``hedge_wins`` land in the stats —
+  the drill's gate is ``hedge_wins > 0`` under an injected 150 ms tail.
+
 **Endpoint refresh** (autoscaled fleets): pass ``endpoint_source`` — a
 fleet ``endpoints/`` directory or a callable returning URLs — and the
 endpoint list becomes dynamic. Failure-driven: when one call finds
@@ -53,6 +76,7 @@ import glob
 import http.client
 import json
 import os
+import socket
 import threading
 import time
 import urllib.parse
@@ -63,6 +87,7 @@ import numpy as np
 
 from multiverso_tpu.obs import tracer
 from multiverso_tpu.resilience.chaos import FullJitterBackoff
+from multiverso_tpu.resilience.outlier import OutlierEjector
 from multiverso_tpu.serving import wire
 from multiverso_tpu.utils.log import CHECK
 
@@ -92,14 +117,24 @@ class _EndpointDown(Exception):
 
 # a kept-alive socket the server closed between our requests fails like
 # THIS on first reuse — never like this on a fresh connect that already
-# completed its handshake and request send
+# completed its handshake and request send. IncompleteRead is the
+# mid-BODY shape of the same staleness: the server (or a dying proxy)
+# closed a reused socket after the status line but before the body
+# finished — retryable once on a fresh connection, exactly like the
+# handshake case
 _STALE_SOCKET_ERRORS = (
     http.client.BadStatusLine,
     http.client.CannotSendRequest,
+    http.client.IncompleteRead,
     ConnectionResetError,
     ConnectionAbortedError,
     BrokenPipeError,
 )
+
+# routes a hedge may duplicate: reads are idempotent, predict is kept
+# single-shot (same answer, but a duplicate still bills the tenant and
+# burns device work on the biggest payloads)
+_HEDGE_ROUTES = ("/v1/lookup", "/v1/topk")
 
 # request block key per route (one array block per request frame)
 _REQUEST_BLOCK = {
@@ -148,6 +183,18 @@ class ServingClient:
             Union[str, Callable[[], Sequence[str]]]
         ] = None,
         refresh_s: float = 0.0,
+        connect_timeout_s: float = 1.0,
+        read_timeout_s: float = 0.0,
+        hedge: bool = True,
+        hedge_budget_pct: float = 10.0,
+        hedge_min_delay_s: float = 0.05,
+        hedge_max_delay_s: float = 1.0,
+        eject: bool = True,
+        eject_threshold: float = 0.5,
+        eject_cooldown_s: float = 5.0,
+        eject_min_samples: int = 5,
+        eject_latency_factor: float = 3.0,
+        event_hook: Optional[Callable[..., None]] = None,
         clock=time.monotonic,
         sleep=time.sleep,
     ):
@@ -168,6 +215,28 @@ class ServingClient:
         self.deadline_s = float(deadline_s)
         self.max_attempts = int(max_attempts)
         self.pool_size = int(pool_size)
+        # connect-vs-read timeout split (0 = no cap: remaining deadline)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.read_timeout_s = float(read_timeout_s)
+        # hedged reads (idempotent routes only; budget-capped)
+        self.hedge = bool(hedge)
+        self.hedge_budget_pct = float(hedge_budget_pct)
+        self.hedge_min_delay_s = float(hedge_min_delay_s)
+        self.hedge_max_delay_s = float(hedge_max_delay_s)
+        self._event_hook = event_hook
+        self._ejector: Optional[OutlierEjector] = (
+            OutlierEjector(
+                error_threshold=eject_threshold,
+                cooldown_s=eject_cooldown_s,
+                min_samples=eject_min_samples,
+                latency_factor=eject_latency_factor,
+                clock=clock,
+                name=f"client.{tenant}",
+                on_transition=self._on_eject_transition,
+            ) if eject else None
+        )
+        # recent success latencies (seconds) — the adaptive hedge delay
+        self._lat_window: List[float] = []
         self._backoff = FullJitterBackoff(
             base_delay_s=backoff_base_s, max_delay_s=backoff_max_s, seed=seed
         )
@@ -175,6 +244,8 @@ class ServingClient:
         self._sleep = sleep
         self._lock = threading.Lock()
         self._rr = 0
+        # in-flight hedge legs: pruned on each launch, joined in close()
+        self._hedge_threads: List[threading.Thread] = []
         self._next_refresh_t = (
             clock() + self.refresh_s if self.refresh_s > 0 else None
         )
@@ -186,7 +257,25 @@ class ServingClient:
             "unrecovered": 0,
             "pool_handshakes": 0, "pool_reused": 0, "stale_retries": 0,
             "endpoint_refreshes": 0, "stale_endpoints": 0,
+            "hedges": 0, "hedge_wins": 0,
+            "ejections": 0, "eject_probes": 0, "eject_recoveries": 0,
         }
+
+    def _on_eject_transition(self, kind: str, **fields: Any) -> None:
+        """Ejector transition -> stats counter + the optional operator
+        event hook (the fleet drill routes this into fleet.log.jsonl)."""
+        key = {
+            "outlier_eject": "ejections",
+            "outlier_probe": "eject_probes",
+            "outlier_recover": "eject_recoveries",
+        }.get(kind)
+        if key is not None:
+            self._bump(key)
+        if self._event_hook is not None:
+            try:
+                self._event_hook(kind, **fields)
+            except Exception:  # noqa: BLE001 — observers never break
+                pass           # the request path
 
     # ------------------------------------------------------------ stats
 
@@ -238,6 +327,11 @@ class ServingClient:
         for idle in dead_pools:
             for conn in idle:
                 conn.close()
+        if self._ejector is not None:
+            for e in vanished:
+                # drained replicas, not outages — drop their scores so a
+                # reused address starts clean
+                self._ejector.forget(e)
         return list(new)
 
     def _maybe_periodic_refresh(self) -> None:
@@ -257,11 +351,16 @@ class ServingClient:
     # ------------------------------------------------------------ pool
 
     def _pool_get(
-        self, endpoint: str, timeout_s: float, fresh: bool = False
+        self, endpoint: str, timeout_s: float, fresh: bool = False,
+        read_timeout_s: Optional[float] = None,
     ) -> Tuple[http.client.HTTPConnection, bool]:
         """An idle pooled connection for ``endpoint`` (reused=True), or
         a new one (one TCP handshake, lazily connected by http.client).
-        ``fresh=True`` skips the pool — the stale-socket retry path."""
+        ``fresh=True`` skips the pool — the stale-socket retry path.
+        ``timeout_s`` governs the connect (+ request send); a pooled
+        connection — already connected — goes straight to the read
+        timeout (``read_timeout_s``, defaulting to ``timeout_s``)."""
+        read_t = timeout_s if read_timeout_s is None else read_timeout_s
         conn: Optional[http.client.HTTPConnection] = None
         if not fresh:
             with self._lock:
@@ -269,9 +368,9 @@ class ServingClient:
                 if idle:
                     conn = idle.pop()
         if conn is not None:
-            conn.timeout = timeout_s
+            conn.timeout = read_t
             if conn.sock is not None:
-                conn.sock.settimeout(timeout_s)
+                conn.sock.settimeout(read_t)
             self._bump("pool_reused")
             return conn, True
         u = urllib.parse.urlsplit(endpoint)
@@ -299,9 +398,15 @@ class ServingClient:
         with self._lock:
             pools = list(self._pool.values())
             self._pool = {}
+            hedges = self._hedge_threads
+            self._hedge_threads = []
         for idle in pools:
             for conn in idle:
                 conn.close()
+        for t in hedges:
+            # cancelled legs die as soon as their aborted read fails;
+            # a bounded join is cleanup, not a latency tax
+            t.join(timeout=1.0)
 
     # ------------------------------------------------------------ encode
 
@@ -342,38 +447,82 @@ class ServingClient:
     # ------------------------------------------------------------ transport
 
     def _exchange(self, conn: http.client.HTTPConnection, route: str,
-                  data: bytes, headers: Dict[str, str]):
+                  data: bytes, headers: Dict[str, str],
+                  read_timeout_s: Optional[float] = None):
+        if conn.sock is None:
+            # connect eagerly (same exception surface as the lazy
+            # connect inside request()) so TCP_NODELAY is on before the
+            # first byte — small frames must not sit behind Nagle
+            conn.connect()
+            try:
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass
         conn.request("POST", route, body=data, headers=headers)
+        if read_timeout_s is not None and conn.sock is not None:
+            # connect + send ran under the connect timeout; the wait
+            # for the response runs under the (usually longer) read
+            # timeout — a blackholed endpoint fails in connect_timeout,
+            # a slow one in read_timeout, never the whole deadline
+            conn.sock.settimeout(read_timeout_s)
         resp = conn.getresponse()
         payload = resp.read()  # must drain before the conn can be reused
         return resp.status, resp, payload
 
     def _post_once(self, endpoint: str, route: str, body: Dict[str, Any],
                    timeout_s: float,
-                   traceparent: Optional[str] = None) -> Dict[str, Any]:
+                   traceparent: Optional[str] = None,
+                   box: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        # split the attempt budget: connect (+ send) under the connect
+        # cap, response wait under the read cap, both bounded by the
+        # remaining deadline. 0 = uncapped (the remaining deadline).
+        connect_t = (min(self.connect_timeout_s, timeout_s)
+                     if self.connect_timeout_s > 0 else timeout_s)
+        read_t = (min(self.read_timeout_s, timeout_s)
+                  if self.read_timeout_s > 0 else timeout_s)
         data, ctype = self._encode_request(route, body)
         headers = {"Content-Type": ctype, "Accept": ctype}
         if traceparent:
             headers["traceparent"] = traceparent
-        conn, reused = self._pool_get(endpoint, timeout_s)
+        conn, reused = self._pool_get(
+            endpoint, connect_t, read_timeout_s=read_t
+        )
+        if box is not None:
+            # the hedging loser-cancel hook: whoever holds the box can
+            # close this conn to abort the attempt from outside
+            box["conn"] = conn
         try:
             status, resp, payload = self._exchange(
-                conn, route, data, headers
+                conn, route, data, headers, read_timeout_s=read_t
             )
         except _STALE_SOCKET_ERRORS as e:
             conn.close()
+            if box is not None and box.get("cancelled"):
+                # the hedge race was decided elsewhere — do NOT re-fire
+                # on a fresh connection
+                raise _EndpointDown(
+                    f"{endpoint}{route}: hedge cancelled"
+                ) from None
             if not reused:
                 # a FRESH connection failing like this is a real
                 # endpoint problem — classify as failover material
                 raise _EndpointDown(f"{endpoint}{route}: {e!r}") from None
-            # first reuse of a kept-alive socket the server closed:
-            # infrastructure staleness — one immediate fresh-connection
-            # retry, no failover charge, no backoff
+            # first reuse of a kept-alive socket the server closed —
+            # whether at the handshake (BadStatusLine) or mid-body
+            # (IncompleteRead / reset): infrastructure staleness — one
+            # immediate fresh-connection retry, no failover charge, no
+            # backoff
             self._bump("stale_retries")
-            conn, _ = self._pool_get(endpoint, timeout_s, fresh=True)
+            conn, _ = self._pool_get(
+                endpoint, connect_t, fresh=True, read_timeout_s=read_t
+            )
+            if box is not None:
+                box["conn"] = conn
             try:
                 status, resp, payload = self._exchange(
-                    conn, route, data, headers
+                    conn, route, data, headers, read_timeout_s=read_t
                 )
             except (http.client.HTTPException, ConnectionError,
                     TimeoutError, OSError) as e2:
@@ -427,6 +576,173 @@ class ServingClient:
                 route, body, trace_id, root_sid
             )
 
+    # ---------------------------------------------------------- ejection
+
+    def _record_endpoint(self, endpoint: str, ok: bool,
+                         latency_s: float) -> None:
+        """Feed one attempt outcome to the outlier ejector and (on
+        success) the adaptive hedge-delay window."""
+        if self._ejector is not None:
+            self._ejector.record(endpoint, ok, latency_s)
+        if ok:
+            with self._lock:
+                self._lat_window.append(latency_s)
+                if len(self._lat_window) > 128:
+                    del self._lat_window[:64]
+
+    def _alive_endpoints(self, eps: List[str]) -> List[str]:
+        """Rotation after ejection — always fail-open: with everything
+        ejected the full list is used (blacklisting the whole fleet
+        would fight the supervisor's self-healing)."""
+        if self._ejector is None:
+            return eps
+        alive = [e for e in eps if self._ejector.peek(e)]
+        return alive or eps
+
+    # ---------------------------------------------------------- hedging
+
+    def _hedge_delay(self, remaining_s: float) -> float:
+        """Adaptive hedge trigger: ~p95 of recent success latencies,
+        clamped to [hedge_min_delay_s, hedge_max_delay_s] and to half
+        the remaining budget (a hedge that can't finish is just load)."""
+        with self._lock:
+            window = sorted(self._lat_window)
+        p95 = window[int(len(window) * 0.95)] if len(window) >= 8 else 0.0
+        delay = min(max(p95, self.hedge_min_delay_s),
+                    self.hedge_max_delay_s)
+        return min(delay, remaining_s / 2.0)
+
+    def _hedge_budget_ok(self) -> bool:
+        with self._lock:
+            return (self._stats["hedges"]
+                    < 1 + self._stats["requests"]
+                    * self.hedge_budget_pct / 100.0)
+
+    @staticmethod
+    def _abort_conn(box: Dict[str, Any]) -> None:
+        """Wake the losing leg's blocked read NOW. ``close()`` alone
+        never interrupts a thread inside ``getresponse()`` — the
+        response reader holds its own reference to the socket, so the
+        loser would block for its full latency and the hedge would
+        only ever help against *failed* primaries, not slow ones.
+        ``shutdown()`` tears the stream down under the reader: the
+        blocked read fails immediately with ``RemoteDisconnected``,
+        which the cancelled-box guard in ``_post_once`` classifies as
+        a cancelled hedge, not an endpoint failure."""
+        conn = box.get("conn")
+        if conn is None:
+            return
+        sock = getattr(conn, "sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _post_hedged(self, route: str, body: Dict[str, Any],
+                     primary: str, secondary: str, timeout_s: float,
+                     trace_id: str, root_sid: str,
+                     attempt: int) -> Dict[str, Any]:
+        """One attempt with a budget-capped backup: the primary runs on
+        this thread; a helper thread fires the same request at
+        ``secondary`` once the primary has been in flight for the
+        adaptive delay. First answer wins and the loser's socket is
+        closed. Raises exactly like ``_post_once`` when both lose."""
+        delay = self._hedge_delay(timeout_s)
+        primary_box: Dict[str, Any] = {}
+        hedge_box: Dict[str, Any] = {}
+        cancel = threading.Event()   # primary resolved: unfired hedge skips
+        done = threading.Event()     # hedge thread fully resolved
+        state: Dict[str, Any] = {"fired": False}
+
+        def hedge_run() -> None:
+            try:
+                if cancel.wait(delay) or not self._hedge_budget_ok():
+                    return
+                state["fired"] = True
+                self._bump("hedges")
+                sid = tracer.new_span_id()
+                hdr = tracer.mint_traceparent(trace_id, sid)
+                t0 = self._clock()
+                try:
+                    with tracer.span(
+                        "client.attempt", route=route, endpoint=secondary,
+                        attempt=attempt, hedge=True, trace_id=trace_id,
+                        span_id=sid, parent_id=root_sid,
+                    ):
+                        r = self._post_once(
+                            secondary, route, body, timeout_s,
+                            traceparent=hdr, box=hedge_box,
+                        )
+                    self._record_endpoint(
+                        secondary, True, self._clock() - t0
+                    )
+                    state["value"] = r
+                    # first-wins: abort the still-blocked primary
+                    primary_box["cancelled"] = True
+                    self._abort_conn(primary_box)
+                except BaseException as e:  # noqa: BLE001 — collected,
+                    # classified by the caller
+                    state["exc"] = e
+                    if (not hedge_box.get("cancelled")
+                            and isinstance(e, _EndpointDown)):
+                        self._record_endpoint(
+                            secondary, False, self._clock() - t0
+                        )
+            finally:
+                done.set()
+
+        th = threading.Thread(target=hedge_run, daemon=True,
+                              name="mv-client-hedge")
+        with self._lock:
+            # a finished leg drops out on the next launch; whatever is
+            # still in flight at close() gets joined there — the winner
+            # path must NOT join inline (that would re-serialize the
+            # loser's remaining connect/read onto the fast path)
+            self._hedge_threads = [
+                t for t in self._hedge_threads if t.is_alive()
+            ] + [th]
+        th.start()
+        sid = tracer.new_span_id()
+        hdr = tracer.mint_traceparent(trace_id, sid)
+        t0 = self._clock()
+        try:
+            with tracer.span(
+                "client.attempt", route=route, endpoint=primary,
+                attempt=attempt, trace_id=trace_id,
+                span_id=sid, parent_id=root_sid,
+            ):
+                out = self._post_once(
+                    primary, route, body, timeout_s,
+                    traceparent=hdr, box=primary_box,
+                )
+            cancel.set()
+            self._record_endpoint(primary, True, self._clock() - t0)
+            if state["fired"]:
+                # primary won: abort the in-flight hedge
+                hedge_box["cancelled"] = True
+                self._abort_conn(hedge_box)
+            return out
+        except BaseException as pe:
+            cancel.set()
+            if state["fired"]:
+                # a hedge is (or was) in flight — its answer can still
+                # save this attempt
+                done.wait(timeout_s + 5.0)
+                if "value" in state:
+                    self._bump("hedge_wins")
+                    return state["value"]
+            if (not primary_box.get("cancelled")
+                    and isinstance(pe, _EndpointDown)):
+                self._record_endpoint(primary, False, self._clock() - t0)
+            raise
+
+    # ---------------------------------------------------------- attempts
+
     def _call_attempts(self, route: str, body: Dict[str, Any],
                        trace_id: str, root_sid: str) -> Dict[str, Any]:
         deadline = self._clock() + self.deadline_s
@@ -439,26 +755,60 @@ class ServingClient:
             remaining = deadline - self._clock()
             if remaining <= 0.0:
                 break
-            endpoint = eps[(start + attempt) % len(eps)]
+            alive = self._alive_endpoints(eps)
+            endpoint = alive[(start + attempt) % len(alive)]
+            if self._ejector is not None \
+                    and not self._ejector.allow(endpoint):
+                # someone else holds this endpoint's half-open probe:
+                # step around it when there is anywhere else to go
+                others = [e for e in alive if e != endpoint]
+                if others:
+                    endpoint = others[(start + attempt) % len(others)]
+            hedge_ep: Optional[str] = None
+            if (self.hedge and route in _HEDGE_ROUTES
+                    and len(alive) > 1 and self._hedge_budget_ok()):
+                cand = alive[(start + attempt + 1) % len(alive)]
+                if cand != endpoint:
+                    hedge_ep = cand
             body["deadline_ms"] = max(remaining * 1e3, 1.0)
-            attempt_sid = tracer.new_span_id()
-            header = tracer.mint_traceparent(trace_id, attempt_sid)
             try:
-                with tracer.span(
-                    "client.attempt", route=route, endpoint=endpoint,
-                    attempt=attempt, trace_id=trace_id,
-                    span_id=attempt_sid, parent_id=root_sid,
-                ):
-                    out = self._post_once(
-                        endpoint, route, body, remaining, traceparent=header
+                if hedge_ep is not None:
+                    out = self._post_hedged(
+                        route, body, endpoint, hedge_ep, remaining,
+                        trace_id, root_sid, attempt,
+                    )
+                else:
+                    attempt_sid = tracer.new_span_id()
+                    header = tracer.mint_traceparent(trace_id, attempt_sid)
+                    t0 = self._clock()
+                    with tracer.span(
+                        "client.attempt", route=route, endpoint=endpoint,
+                        attempt=attempt, trace_id=trace_id,
+                        span_id=attempt_sid, parent_id=root_sid,
+                    ):
+                        out = self._post_once(
+                            endpoint, route, body, remaining,
+                            traceparent=header,
+                        )
+                    self._record_endpoint(
+                        endpoint, True, self._clock() - t0
                     )
                 self._bump("ok")
                 return out
             except _Shed as e:
-                # server's own hint wins; never sleep past the deadline
+                # server's own hint wins; never sleep past the deadline.
+                # A shedding endpoint answered — that's an ALIVE signal
+                # for the ejector (load, not gray failure)
+                self._record_endpoint(endpoint, True, 0.0)
                 last = e
                 pause = min(e.retry_after_s, deadline - self._clock())
             except _EndpointDown as e:
+                if hedge_ep is None:
+                    # hedged attempts record their own outcomes inside
+                    # _post_hedged (per-leg latencies differ)
+                    self._record_endpoint(
+                        endpoint, False, self._clock() - t0
+                    )
                 last = e
                 self._bump("failovers")
                 tracer.event(

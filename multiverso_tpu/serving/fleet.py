@@ -133,6 +133,14 @@ class ServingFleet:
 
         recorder.record(f"fleet_{kind}", **fields)
 
+    def event(self, kind: str, **fields: Any) -> None:
+        """Public append to ``fleet.log.jsonl`` for events OBSERVED
+        about the fleet rather than performed by it — e.g. a
+        ``ServingClient``'s outlier eject/probe/recover transitions
+        (wire ``event_hook=fleet.event``), so one log shows the client-
+        side failover next to the replica lifecycle it reacted to."""
+        self._event(kind, **fields)
+
     # ------------------------------------------------------------ spawn
 
     def endpoint_file(self, index: int) -> str:

@@ -68,6 +68,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import socket
 import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
@@ -81,7 +82,9 @@ from multiverso_tpu.serving import http_health
 from multiverso_tpu.serving import wire
 from multiverso_tpu.serving.batcher import Overloaded
 from multiverso_tpu.serving.server import RouteUnavailable
-from multiverso_tpu.utils.configure import MV_DEFINE_int, GetFlag
+from multiverso_tpu.utils.configure import (
+    MV_DEFINE_double, MV_DEFINE_int, GetFlag,
+)
 from multiverso_tpu.utils.log import FatalError, Log
 
 __all__ = ["DataPlaneServer", "maybe_start_data_plane_from_flags"]
@@ -102,9 +105,41 @@ MV_DEFINE_int(
     "bodies answer 400",
 )
 
+MV_DEFINE_double(
+    "data_read_timeout_s", 20.0,
+    "deadline (s) for reading one request's header + body off the "
+    "socket — a slow-loris client that trickles a declared body gets "
+    "408 + Connection: close instead of pinning a handler thread "
+    "(0 = no deadline)",
+)
+
+MV_DEFINE_double(
+    "data_idle_timeout_s", 120.0,
+    "keep-alive idle deadline (s): a pooled connection with no request "
+    "in flight for this long is reaped server-side (0 = never reap)",
+)
+
+MV_DEFINE_int(
+    "data_max_conns", 0,
+    "cap on concurrently-open data-plane connections; accepts past the "
+    "cap get a raw 503 + close before any parsing so a connection "
+    "flood cannot exhaust handler threads (0 = uncapped)",
+)
+
 # per-accepted-socket ids: how tests/clients verify keep-alive reuse
 # (every response on one TCP connection reports the same X-MV-Conn)
 _conn_ids = itertools.count(1)
+
+
+class _BodyDeadline(Exception):
+    """The request body did not arrive within the read deadline — the
+    slow-loris signature. Maps to 408 + Connection: close (the stream
+    position is unknown, so the socket cannot be reused)."""
+
+
+class _BodyTruncated(Exception):
+    """The client closed (or reset) mid-body: the declared
+    Content-Length never arrived. 400 best-effort, then close."""
 
 # response field order per route — the binary block order is part of the
 # wire contract (requests carry exactly one block)
@@ -179,19 +214,78 @@ class DataPlaneServer:
     stdlib server. ``port=0`` binds ephemeral (read ``.port`` back)."""
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
-                 *, default_deadline_s: float = 5.0):
+                 *, default_deadline_s: float = 5.0,
+                 read_timeout_s: Optional[float] = None,
+                 idle_timeout_s: Optional[float] = None,
+                 max_conns: Optional[int] = None):
         self.table_server = server
         self.default_deadline_s = float(default_deadline_s)
         self.max_body_bytes = max(1, int(GetFlag("data_max_body_mb"))) << 20
+        self.read_timeout_s = float(
+            GetFlag("data_read_timeout_s") if read_timeout_s is None
+            else read_timeout_s
+        )
+        self.idle_timeout_s = float(
+            GetFlag("data_idle_timeout_s") if idle_timeout_s is None
+            else idle_timeout_s
+        )
+        self.max_conns = int(
+            GetFlag("data_max_conns") if max_conns is None else max_conns
+        )
+        self._conn_lock = threading.Lock()
+        self._conns_open = 0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             # one connection, many requests: load generators reuse sockets
             protocol_version = "HTTP/1.1"
+            # lookup responses are small writes on keep-alive sockets;
+            # with Nagle on, each stalls behind the peer's delayed ACK
+            # (~40ms) — dwarfing the actual serving latency
+            disable_nagle_algorithm = True
 
             def setup(self):
                 super().setup()
                 self._mv_conn_id = next(_conn_ids)
+                self._mv_force_close = False
+
+            def handle(self):
+                # per-connection loop with a slot guard: a connection
+                # flood is answered with a raw 503 before any parsing
+                # can tie up this thread
+                if not outer._conn_acquire():
+                    outer._reject_conn(self)
+                    return
+                try:
+                    super().handle()
+                finally:
+                    outer._conn_release()
+
+            def handle_one_request(self):
+                # idle reap: between requests the socket waits under the
+                # idle deadline. peek() blocks for the first byte (or
+                # EOF) without consuming it, so the reap is observable —
+                # stdlib's own timeout catch inside handle_one_request
+                # would swallow it silently.
+                if outer.idle_timeout_s > 0:
+                    try:
+                        self.connection.settimeout(outer.idle_timeout_s)
+                        first = self.rfile.peek(1)
+                    except (socket.timeout, OSError):
+                        outer.table_server.metrics.record_conn_reaped()
+                        self.close_connection = True
+                        return
+                    if not first:  # clean client FIN
+                        self.close_connection = True
+                        return
+                # the request itself (header lines) runs under the read
+                # deadline; a stalled header read is caught by stdlib
+                # and closes the connection
+                if outer.read_timeout_s > 0:
+                    self.connection.settimeout(outer.read_timeout_s)
+                else:
+                    self.connection.settimeout(None)
+                super().handle_one_request()
 
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 route = self.path.split("?", 1)[0]
@@ -205,16 +299,31 @@ class DataPlaneServer:
                 code, ctype, body, retry_after = outer._handle_post(
                     route, self
                 )
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                if retry_after is not None:
-                    # fractional seconds: the batcher's hints are ms-scale
-                    # and rounding up to 1s would overdamp clients
-                    self.send_header("Retry-After", f"{retry_after:.4f}")
-                self.send_header("X-MV-Conn", str(self._mv_conn_id))
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    if retry_after is not None:
+                        # fractional seconds: the batcher's hints are
+                        # ms-scale and rounding up to 1s would overdamp
+                        # clients
+                        self.send_header(
+                            "Retry-After", f"{retry_after:.4f}"
+                        )
+                    self.send_header("X-MV-Conn", str(self._mv_conn_id))
+                    self.send_header("Content-Length", str(len(body)))
+                    if self._mv_force_close:
+                        # the body read died mid-stream — the socket's
+                        # position is unknown, it must not serve
+                        # another request
+                        self.send_header("Connection", "close")
+                        self.close_connection = True
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (ConnectionError, socket.timeout, OSError):
+                    # best-effort answer to a client that reset or
+                    # vanished mid-write: just drop the connection —
+                    # never a handler-thread traceback
+                    self.close_connection = True
 
             def log_message(self, *args):  # traffic must not spam stdout
                 pass
@@ -240,6 +349,85 @@ class DataPlaneServer:
         self._httpd.server_close()
         self._thread.join(timeout=5)
 
+    # ------------------------------------------------------------ conns
+
+    def _conn_acquire(self) -> bool:
+        if self.max_conns <= 0:
+            return True
+        with self._conn_lock:
+            if self._conns_open >= self.max_conns:
+                return False
+            self._conns_open += 1
+            return True
+
+    def _conn_release(self) -> None:
+        if self.max_conns <= 0:
+            return
+        with self._conn_lock:
+            self._conns_open -= 1
+
+    def _reject_conn(self, handler: BaseHTTPRequestHandler) -> None:
+        """Raw 503 + close for a connection past the cap — written
+        before any request parsing, so a flood can never occupy a
+        handler thread for longer than one send."""
+        self.table_server.metrics.record_conn_rejected()
+        try:
+            handler.wfile.write(
+                b"HTTP/1.1 503 Service Unavailable\r\n"
+                b"Content-Length: 0\r\n"
+                b"Retry-After: 1\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+        except OSError:
+            pass
+
+    def _read_body(self, handler: BaseHTTPRequestHandler,
+                   length: int) -> bytes:
+        """Read exactly ``length`` body bytes under the read deadline.
+
+        ``rfile.read(length)`` would block per-recv with no overall
+        bound — a slow-loris trickling one byte per (almost-) timeout
+        could hold the thread for length × timeout. This loop enforces
+        ONE deadline across the whole body: expiry raises
+        ``_BodyDeadline`` (→ 408), a client FIN/reset mid-body raises
+        ``_BodyTruncated`` (→ 400), both with Connection: close.
+        """
+        if self.read_timeout_s <= 0:
+            buf0 = handler.rfile.read(length)
+            if len(buf0) < length:
+                raise _BodyTruncated(
+                    f"body ended at {len(buf0)}/{length} bytes"
+                )
+            return buf0
+        deadline = time.monotonic() + self.read_timeout_s
+        buf = bytearray()
+        while len(buf) < length:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.0:
+                raise _BodyDeadline(
+                    f"read {len(buf)}/{length} bytes in "
+                    f"{self.read_timeout_s:.1f}s"
+                )
+            handler.connection.settimeout(remaining)
+            try:
+                chunk = handler.rfile.read1(length - len(buf))
+            except socket.timeout:
+                raise _BodyDeadline(
+                    f"read {len(buf)}/{length} bytes in "
+                    f"{self.read_timeout_s:.1f}s"
+                ) from None
+            except (ConnectionError, OSError) as e:
+                raise _BodyTruncated(
+                    f"connection lost at {len(buf)}/{length} bytes: "
+                    f"{e!r}"
+                ) from None
+            if not chunk:
+                raise _BodyTruncated(
+                    f"body ended at {len(buf)}/{length} bytes"
+                )
+            buf += chunk
+        return bytes(buf)
+
     # ------------------------------------------------------------ dispatch
 
     def _handle_post(
@@ -255,10 +443,11 @@ class DataPlaneServer:
                 return self._json_reply(
                     400, {"error": f"bad Content-Length {length}"}, None, 0
                 )
-            # ONE read of the whole body — the frame decoder (and
-            # json.loads) parse from this buffer; block payloads stay
-            # zero-copy views over it
-            raw = handler.rfile.read(length)
+            # ONE buffer for the whole body — the frame decoder (and
+            # json.loads) parse from it; block payloads stay zero-copy
+            # views over it. The read itself runs under the slow-loris
+            # deadline in _read_body.
+            raw = self._read_body(handler, length)
             ctype_in = handler.headers.get("Content-Type") or ""
             binary_req = wire.CONTENT_TYPE in ctype_in
             if binary_req:
@@ -270,6 +459,18 @@ class DataPlaneServer:
                         400, {"error": "request body must be a JSON object"},
                         None, length,
                     )
+        except _BodyDeadline as e:
+            handler._mv_force_close = True
+            self.table_server.metrics.record_slow_loris()
+            return self._json_reply(
+                408, {"error": f"request body timed out: {e}",
+                      "reason": "slow_client"}, None, 0,
+            )
+        except _BodyTruncated as e:
+            handler._mv_force_close = True
+            return self._json_reply(
+                400, {"error": f"truncated request: {e}"}, None, 0
+            )
         except (wire.MalformedFrame, ValueError, OSError) as e:
             return self._json_reply(
                 400, {"error": f"malformed request: {e}"}, None, 0
@@ -325,15 +526,20 @@ class DataPlaneServer:
         fields = _RESPONSE_FIELDS[route]
         if binary_resp:
             blocks = [_wire_block(out[f]) for f in fields]
+            meta: Dict[str, Any] = {"version": int(out["version"])}
+            if out.get("stale"):
+                meta["stale"] = True  # rides the meta as i64 1 (truthy)
             payload = wire.encode_frame(
                 wire.ROUTE_CODES[route] | wire.RESPONSE_BIT,
-                {"version": int(out["version"])},
+                meta,
                 blocks,
             )
             self.table_server.metrics.record_wire(True, length, len(payload))
             return 200, wire.CONTENT_TYPE, payload, retry_after
         doc = {f: np.asarray(out[f]).tolist() for f in fields}
         doc["version"] = out["version"]
+        if out.get("stale"):
+            doc["stale"] = True
         return self._json_reply(200, doc, retry_after, length)
 
     def _json_reply(
@@ -419,7 +625,14 @@ class DataPlaneServer:
             # thread must answer, not die with the socket open
             Log.Error("data plane %s failed: %r", route, e)
             return 500, {"error": repr(e)}, None
-        out["version"] = int(srv.health()["version"])  # informational
+        if getattr(fut, "mv_stale", False):
+            # serve-stale degraded mode: the answer came from the
+            # retained previous cache generation — the client MUST see
+            # the staleness and the generation it was computed against
+            out["stale"] = True
+            out["version"] = int(fut.mv_stale_version)
+        else:
+            out["version"] = int(srv.health()["version"])  # informational
         return 200, out, None
 
 

@@ -128,6 +128,13 @@ class ServingMetrics:
         self.wire_json = 0
         self.wire_bytes_in = 0
         self.wire_bytes_out = 0
+        # connection hygiene (data plane): slow clients answered 408,
+        # idle keep-alive sockets reaped, accepts rejected at the
+        # max-connections guard, lookups served stale from the row cache
+        self.slow_loris_408 = 0
+        self.conns_reaped = 0
+        self.conns_rejected = 0
+        self.stale_serves = 0
         self.last_swap_t: Optional[float] = None  # monotonic; health() age
         self._window_s = float(window_s)
         self._served_times: List[tuple] = []  # (t, n) per flush, pruned
@@ -197,6 +204,28 @@ class ServingMetrics:
             self.wire_bytes_in += int(bytes_in)
             self.wire_bytes_out += int(bytes_out)
 
+    def record_slow_loris(self) -> None:
+        """A client held the body open past the read deadline: 408."""
+        with self._lock:
+            self.slow_loris_408 += 1
+
+    def record_conn_reaped(self) -> None:
+        """An idle keep-alive socket hit the idle deadline and was
+        closed server-side."""
+        with self._lock:
+            self.conns_reaped += 1
+
+    def record_conn_rejected(self) -> None:
+        """An accept bounced off the max-connections guard (raw 503)."""
+        with self._lock:
+            self.conns_rejected += 1
+
+    def record_stale_serve(self, n: int = 1) -> None:
+        """A lookup answered from the retained previous cache generation
+        because the live path was unavailable (serve-stale mode)."""
+        with self._lock:
+            self.stale_serves += n
+
     def last_swap_age_s(self) -> Optional[float]:
         with self._lock:
             if self.last_swap_t is None:
@@ -248,6 +277,10 @@ class ServingMetrics:
                 "wire_json": self.wire_json,
                 "wire_bytes_in": self.wire_bytes_in,
                 "wire_bytes_out": self.wire_bytes_out,
+                "slow_loris_408": self.slow_loris_408,
+                "conns_reaped": self.conns_reaped,
+                "conns_rejected": self.conns_rejected,
+                "stale_serves": self.stale_serves,
             }
             routes = sorted(self.route_latency.items())
         out: Dict[str, object] = dict(snap)

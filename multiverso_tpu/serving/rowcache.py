@@ -43,7 +43,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from multiverso_tpu.analysis.guards import OrderedLock
-from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_int
+from multiverso_tpu.utils.configure import (
+    GetFlag, MV_DEFINE_bool, MV_DEFINE_int,
+)
 from multiverso_tpu.utils.log import CHECK
 
 __all__ = ["HotRowCache", "cache_from_flags"]
@@ -57,27 +59,45 @@ MV_DEFINE_int(
     "predict routes always bypass (0 = cache off)",
 )
 
+MV_DEFINE_bool(
+    "serve_cache_stale_ok", False,
+    "degraded serve-stale mode: when the live path is unavailable "
+    "(breaker open / route down), lookups may answer from the RETAINED "
+    "PREVIOUS cache generation, flagged stale=true with the stale "
+    "snapshot version, instead of a hard 503 — opt-in because stale "
+    "rows are wrong-by-definition after a rollout",
+)
+
 
 class HotRowCache:
     """Bounded LRU of query results, keyed by snapshot version."""
 
     def __init__(self, capacity: int, *, max_bytes: int = 256 << 20,
-                 name: str = "cache"):
+                 name: str = "cache", retain_stale: bool = False):
         CHECK(capacity >= 1, "hot-row cache capacity must be >= 1")
         CHECK(max_bytes >= 1, "hot-row cache max_bytes must be >= 1")
         self.capacity = int(capacity)
         self.max_bytes = int(max_bytes)
         self.name = name
+        self.retain_stale = bool(retain_stale)
         # OrderedLock (mvlint R2): every data-plane handler thread and
         # the batcher's fill callback funnel through here
         self._lock = OrderedLock("serving.rowcache._lock")
         self._data: "OrderedDict[Tuple[int, str, bytes], Any]" = OrderedDict()
         self._bytes = 0
         self._version = 0  # newest snapshot version seen (generation)
+        # serve-stale degraded mode: the generation replaced by the last
+        # version bump, kept (bounded by the same capacity it lived
+        # under) so an outage can answer last-known-good instead of 503
+        self._stale_data: "OrderedDict[Tuple[int, str, bytes], Any]" = (
+            OrderedDict()
+        )
+        self._stale_version: Optional[int] = None
         self._hits = 0
         self._misses = 0
         self._evictions = 0
         self._stale_puts = 0
+        self._stale_hits = 0
         self._bypass = 0
         self._invalidations = 0
         self._registered_key: Optional[str] = None
@@ -111,13 +131,37 @@ class HotRowCache:
 
     def _advance(self, version: int) -> None:
         # caller holds self._lock. One version bump swaps the whole
-        # generation out in O(1) — the atomic invalidation contract
+        # generation out in O(1) — the atomic invalidation contract.
+        # With retain_stale the replaced generation survives (read-only,
+        # never re-hit by get()) as the serve-stale fallback.
         if version > self._version:
             if self._data:
                 self._invalidations += 1
+            if self.retain_stale and self._data:
+                self._stale_data = self._data
+                self._stale_version = self._version
             self._data = OrderedDict()
             self._bytes = 0
             self._version = int(version)
+
+    def get_stale(self, route: str,
+                  key: bytes) -> Optional[Tuple[int, Any]]:
+        """Degraded-mode read: the last-known value for ``(route, key)``
+        from the RETAINED PREVIOUS generation, as ``(version, value)``
+        — or ``None``. Only the serve-stale fallback calls this (the
+        normal ``get`` can never return a stale generation); callers
+        MUST surface the staleness to the client (``stale=true``)."""
+        if not self.retain_stale or not self.cacheable(route):
+            return None
+        with self._lock:
+            ver = self._stale_version
+            if ver is None:
+                return None
+            v = self._stale_data.get((ver, route, key))
+            if v is None:
+                return None
+            self._stale_hits += 1
+            return int(ver), v
 
     def get(self, version: int, route: str, key: bytes) -> Optional[Any]:
         """The cached result for ``(version, route, key)`` or ``None``.
@@ -185,6 +229,8 @@ class HotRowCache:
                 ),
                 "evictions": self._evictions,
                 "stale_puts": self._stale_puts,
+                "stale_hits": self._stale_hits,
+                "stale_entries": len(self._stale_data),
                 "bypass": self._bypass,
                 "invalidations": self._invalidations,
             }
@@ -217,8 +263,13 @@ class HotRowCache:
 
 
 def cache_from_flags(name: str = "cache") -> Optional[HotRowCache]:
-    """Build a cache from ``-serve_cache_entries`` (None when off)."""
+    """Build a cache from ``-serve_cache_entries`` (None when off);
+    ``-serve_cache_stale_ok`` arms the serve-stale retained
+    generation."""
     entries = int(GetFlag("serve_cache_entries"))
     if entries <= 0:
         return None
-    return HotRowCache(entries, name=name)
+    return HotRowCache(
+        entries, name=name,
+        retain_stale=bool(GetFlag("serve_cache_stale_ok")),
+    )

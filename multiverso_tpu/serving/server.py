@@ -679,7 +679,13 @@ class TableServer:
         hit, ckey = self._cache_get(route, snap.version, ids)
         if hit is not None:
             return hit
-        self._shed_if_open(route)
+        try:
+            self._shed_if_open(route)
+        except RouteUnavailable:
+            stale = self._stale_fallback(route, ckey)
+            if stale is not None:
+                return stale
+            raise
         fut = self._batcher.submit(
             route, ids, block=block, deadline_t=deadline_t
         )
@@ -703,7 +709,13 @@ class TableServer:
         hit, ckey = self._cache_get(route, snap.version, q)
         if hit is not None:
             return hit
-        self._shed_if_open(route)
+        try:
+            self._shed_if_open(route)
+        except RouteUnavailable:
+            stale = self._stale_fallback(route, ckey)
+            if stale is not None:
+                return stale
+            raise
         fut = self._batcher.submit(
             route, q, block=block, deadline_t=deadline_t
         )
@@ -785,6 +797,31 @@ class TableServer:
                 pass
 
         fut.add_done_callback(_done)
+
+    def _stale_fallback(self, route: str, ckey):
+        """Serve-stale degraded mode (opt-in ``-serve_cache_stale_ok``,
+        armed via the rowcache's ``retain_stale``): when the live path
+        is unavailable (breaker open), answer from the RETAINED PREVIOUS
+        cache generation instead of 503. Returns a resolved Future
+        tagged ``mv_stale``/``mv_stale_version`` (the data plane
+        surfaces both to the client as ``stale=true``) or ``None`` when
+        there is nothing stale to serve — the 503 then proceeds.
+        Wrong-by-definition after a rollout, which is why it is opt-in;
+        availability > freshness is a per-deployment call."""
+        if self.rowcache is None or ckey is None:
+            return None
+        got = self.rowcache.get_stale(route, ckey)
+        if got is None:
+            return None
+        version, value = got
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        fut.set_result(value)
+        fut.mv_stale = True
+        fut.mv_stale_version = int(version)
+        self.metrics.record_stale_serve()
+        return fut
 
     # ------------------------------------------------------------ degradation
 

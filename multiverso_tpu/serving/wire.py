@@ -55,6 +55,7 @@ __all__ = [
     "MalformedFrame",
     "encode_frame",
     "decode_frame",
+    "frame_sections",
 ]
 
 CONTENT_TYPE = "application/x-mv-frame"
@@ -285,3 +286,26 @@ def decode_frame(
         arr = np.frombuffer(view, dtype=dtype, count=count, offset=boff)
         blocks.append(arr.reshape(shape))
     return route_code, meta, blocks
+
+
+def frame_sections(buf: bytes) -> Dict[str, Tuple[int, int]]:
+    """Byte spans ``{section: (start, end)}`` of a WELL-FORMED frame:
+    ``header``, ``meta``, ``descs``, ``payload``. The netchaos wire-fuzz
+    tests use this to aim corruption at each structural region in turn
+    (a flip in the magic must fail differently from one in a payload)
+    rather than guessing offsets. Raises ``MalformedFrame`` on a buffer
+    too short to carry its declared sections."""
+    view = memoryview(buf)
+    if len(view) < _HEADER.size:
+        raise MalformedFrame("frame shorter than the header")
+    _magic, _ver, _route, nblocks, meta_nbytes = _HEADER.unpack_from(view, 0)
+    meta_end = _HEADER.size + meta_nbytes
+    descs_end = meta_end + nblocks * _BLOCK_DESC.size
+    if len(view) < descs_end:
+        raise MalformedFrame("declared sections exceed the frame")
+    return {
+        "header": (0, _HEADER.size),
+        "meta": (_HEADER.size, meta_end),
+        "descs": (meta_end, descs_end),
+        "payload": (descs_end, len(view)),
+    }

@@ -280,7 +280,7 @@ def test_ps_wordembedding_sharded_corpus(tmp_path, nproc, mode):
 
 
 @pytest.mark.slow
-def test_ps_packed_pull_bit_exact_vs_dense(tmp_path):
+def test_ps_packed_pull_bit_exact_vs_dense(tmp_path, monkeypatch):
     """ISSUE 16 pin: the packed SPMD pull is lossless — a 2-process
     pipelined run with -ps_pull_packed=on must land on BIT-IDENTICAL
     final embeddings vs the same run pulling dense rows (same blocks,
@@ -288,17 +288,47 @@ def test_ps_packed_pull_bit_exact_vs_dense(tmp_path):
     values, it never rounds them)."""
     import numpy as np
 
+    # an atol=0 comparison of two SEPARATE runs needs each run to be
+    # bit-deterministic, and XLA CPU's threaded Eigen reductions are
+    # load-dependent (the same fork the WE golden-retry bounds; under
+    # full-suite load two identical dense runs were observed ~2e-3
+    # apart) — single-thread them for the workers of this test only
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        "--xla_force_host_platform_device_count=2 "
+        "--xla_cpu_multi_thread_eigen=false",
+    )
     corpus_path, _ = _ps_corpus(tmp_path)
-    embs = {}
-    for mode in ("shard_pipelined", "shard_pipelined_packed"):
-        outs = [tmp_path / f"emb_{mode}_{i}.npy" for i in range(2)]
-        _run_cluster(
-            "multiprocess_ps_worker.py",
-            lambda i: [corpus_path, outs[i], mode],
-            nproc=2,
-            timeout=300,
+
+    def run_both():
+        embs = {}
+        for mode in ("shard_pipelined", "shard_pipelined_packed"):
+            outs = [tmp_path / f"emb_{mode}_{i}.npy" for i in range(2)]
+            _run_cluster(
+                "multiprocess_ps_worker.py",
+                lambda i: [corpus_path, outs[i], mode],
+                nproc=2,
+                timeout=300,
+            )
+            embs[mode] = np.load(outs[0])
+        return embs
+
+    embs = run_both()
+    if np.abs(
+        embs["shard_pipelined"] - embs["shard_pipelined_packed"]
+    ).max() != 0.0:
+        # Under heavy host contention either 2-process run can land on a
+        # discrete alternate trajectory (the same load-induced fork the
+        # golden-retry above bounds for the WE test) — then the two runs
+        # are comparing DIFFERENT trajectories, not pack fidelity. One
+        # bounded relaunch of both; a reproducible mismatch still fails.
+        print(
+            "[packed retry] dense-vs-packed runs diverged by "
+            f"{np.abs(embs['shard_pipelined'] - embs['shard_pipelined_packed']).max():.2e}"
+            ", relaunching both clusters once",
+            file=sys.stderr,
         )
-        embs[mode] = np.load(outs[0])
+        embs = run_both()
     np.testing.assert_allclose(
         embs["shard_pipelined"], embs["shard_pipelined_packed"],
         rtol=0, atol=0,

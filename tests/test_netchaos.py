@@ -1,0 +1,877 @@
+"""Network chaos proxy + partition-tolerant data plane.
+
+resilience/netchaos.py gives the serving stack its first real-network
+adversary: seeded, scriptable TCP fault injection (tail latency,
+resets, partitions, corrupted frames, slow-loris). These tests pin
+both sides of that contract:
+
+* the proxy itself — deterministic fault application, byte accounting,
+  scenario phasing under a fake clock;
+* the data plane surviving it — wire fuzz through the proxy never
+  poisons a co-batch (400/clean close, then clean traffic answers
+  correctly), slow-loris bodies get 408 + Connection: close, idle
+  keep-alive sockets are reaped, a connection flood bounces off the
+  max-conns guard;
+* the client surviving it — hedged reads win against a stalled
+  primary, outlier ejection takes a gray-failing endpoint out of
+  rotation and half-open-probes it back, a mid-body reset on a reused
+  socket is a stale retry (not an unrecovered error), and a full
+  partition of one replica ends with zero unrecovered errors plus an
+  eject -> probe -> recover cycle.
+"""
+
+import http.client
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.resilience.netchaos import (
+    FaultSpec,
+    NetChaosProxy,
+    Scenario,
+    _XorShift32,
+)
+from multiverso_tpu.resilience.outlier import OutlierEjector
+from multiverso_tpu.serving import (
+    DataPlaneServer,
+    ServingClient,
+    TableServer,
+)
+from multiverso_tpu.serving import wire
+from multiverso_tpu.serving.rowcache import HotRowCache
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------- helpers
+
+
+class _EchoServer:
+    """Minimal TCP upstream: echoes every byte back, records what it
+    received per connection."""
+
+    def __init__(self):
+        self.received = []  # one bytearray per accepted connection
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            buf = bytearray()
+            self.received.append(buf)
+            threading.Thread(
+                target=self._serve, args=(conn, buf), daemon=True
+            ).start()
+
+    def _serve(self, conn, buf):
+        try:
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    break
+                buf += data
+                conn.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _connect(port, timeout=5.0):
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    s.settimeout(timeout)
+    return s
+
+
+def _lookup_frame(ids, table="emb"):
+    return wire.encode_frame(
+        wire.ROUTE_CODES["/v1/lookup"], {"table": table},
+        [np.asarray(ids, np.int32)],
+    )
+
+
+def _raw_request(frame, route="/v1/lookup"):
+    """Raw HTTP/1.1 POST bytes for a binary frame; returns
+    ``(request_bytes, header_len)`` so corruption offsets can target
+    exact frame bytes behind the headers."""
+    head = (
+        f"POST {route} HTTP/1.1\r\n"
+        f"Host: t\r\n"
+        f"Content-Type: {wire.CONTENT_TYPE}\r\n"
+        f"Accept: application/json\r\n"
+        f"Content-Length: {len(frame)}\r\n\r\n"
+    ).encode()
+    return head + frame, len(head)
+
+
+def _read_response(sock):
+    """Read one HTTP response off a raw socket; returns
+    ``(status_code, header_text, body_bytes)`` or ``(None, "", b"")``
+    on reset/timeout."""
+    try:
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(65536)
+            if not chunk:
+                return None, "", b""
+            buf += chunk
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        header_text = head.decode("latin-1")
+        status = int(header_text.split()[1])
+        length = 0
+        for line in header_text.split("\r\n")[1:]:
+            if line.lower().startswith("content-length:"):
+                length = int(line.split(":", 1)[1])
+        while len(rest) < length:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            rest += chunk
+        return status, header_text, rest
+    except (OSError, ValueError):
+        return None, "", b""
+
+
+@pytest.fixture
+def served(mv_env):
+    emb = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        yield srv, dp, emb
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+# ------------------------------------------------------------ FaultSpec
+
+
+def test_faultspec_validates_and_roundtrips():
+    spec = FaultSpec(latency_ms=150.0, blackhole="s2c")
+    assert not spec.clean()
+    assert FaultSpec.from_dict(spec.to_dict()).to_dict() == spec.to_dict()
+    assert FaultSpec().clean()
+    with pytest.raises(Exception):
+        FaultSpec(blackhole="sideways")
+    with pytest.raises(Exception):
+        FaultSpec(corrupt_mode="scramble")
+    with pytest.raises(Exception):
+        FaultSpec.from_dict({"no_such_fault": 1})
+
+
+def test_xorshift_deterministic_per_seed():
+    a = [_XorShift32(7).uniform() for _ in range(5)]
+    b = [_XorShift32(7).uniform() for _ in range(5)]
+    c = [_XorShift32(8).uniform() for _ in range(5)]
+    assert a == b and a != c
+    assert all(0.0 <= x < 1.0 for x in a)
+
+
+def test_scenario_phases_fake_clock():
+    scenario = Scenario.from_doc({"phases": [
+        {"start_s": 0, "end_s": 10, "faults": {"latency_ms": 150}},
+        {"start_s": 10, "end_s": 15, "faults": {"blackhole": "both"}},
+        # overlapping later phase wins inside [12, 15)
+        {"start_s": 12, "end_s": 15, "faults": {"stall_s": 1.0}},
+    ]})
+    assert scenario.active(0.0).latency_ms == 150.0
+    assert scenario.active(9.99).latency_ms == 150.0
+    assert scenario.active(10.0).blackhole == "both"
+    assert scenario.active(12.5).stall_s == 1.0
+    assert scenario.active(15.0) is None
+
+    clk = FakeClock()
+    echo = _EchoServer()
+    proxy = NetChaosProxy("127.0.0.1", echo.port, scenario=scenario,
+                          clock=clk, sleep=lambda s: None)
+    try:
+        assert proxy.current_faults().latency_ms == 150.0
+        clk.advance(11.0)
+        assert proxy.current_faults().blackhole == "both"
+        # runtime override wins over the scenario
+        proxy.set_faults(reset_after_bytes=1)
+        assert proxy.current_faults().reset_after_bytes == 1
+        proxy.clear_faults()
+        assert proxy.current_faults().blackhole == "both"
+        clk.advance(10.0)
+        assert proxy.current_faults().clean()
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+# ---------------------------------------------------------------- proxy
+
+
+def test_proxy_passthrough_and_byte_accounting():
+    echo = _EchoServer()
+    proxy = NetChaosProxy("127.0.0.1", echo.port)
+    try:
+        s = _connect(proxy.port)
+        s.sendall(b"hello chaos")
+        out = s.recv(1024)
+        assert out == b"hello chaos"
+        s.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            st = proxy.stats()
+            if st["bytes_c2s"] >= 11 and st["bytes_s2c"] >= 11:
+                break
+            time.sleep(0.01)
+        st = proxy.stats()
+        assert st["connections"] == 1
+        assert st["bytes_c2s"] == 11 and st["bytes_s2c"] == 11
+        assert st["resets"] == 0 and st["corrupted"] == 0
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+def test_proxy_injects_latency_s2c():
+    echo = _EchoServer()
+    proxy = NetChaosProxy("127.0.0.1", echo.port,
+                          faults=FaultSpec(latency_ms=120.0))
+    try:
+        s = _connect(proxy.port)
+        t0 = time.monotonic()
+        s.sendall(b"ping")
+        assert s.recv(1024) == b"ping"
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.10, elapsed  # the injected tail
+        s.close()
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+def test_proxy_reset_after_bytes_is_hard_rst():
+    echo = _EchoServer()
+    proxy = NetChaosProxy("127.0.0.1", echo.port,
+                          faults=FaultSpec(reset_after_bytes=4))
+    try:
+        s = _connect(proxy.port)
+        s.sendall(b"abcdefgh")  # crosses the 4-byte budget
+        # the peer sees the connection die (reset or EOF), not a reply
+        with pytest.raises((ConnectionError, OSError)):
+            got = b""
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                chunk = s.recv(1024)
+                if not chunk:
+                    raise ConnectionResetError("closed")
+                got += chunk
+        s.close()
+        assert proxy.stats()["resets"] >= 1
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+def test_proxy_corrupt_bitflip_hits_exact_offset():
+    echo = _EchoServer()
+    proxy = NetChaosProxy(
+        "127.0.0.1", echo.port,
+        faults=FaultSpec(corrupt_offset=2, corrupt_mode="bitflip"),
+    )
+    try:
+        s = _connect(proxy.port)
+        s.sendall(b"\x00\x00\x00\x00\x00")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and (
+                not echo.received or len(echo.received[0]) < 5):
+            time.sleep(0.01)
+        assert bytes(echo.received[0]) == b"\x00\x00\x10\x00\x00"
+        assert proxy.stats()["corrupted"] == 1
+        s.close()
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+def test_proxy_truncate_forwards_prefix_then_resets():
+    echo = _EchoServer()
+    proxy = NetChaosProxy(
+        "127.0.0.1", echo.port,
+        faults=FaultSpec(corrupt_offset=3, corrupt_mode="truncate"),
+    )
+    try:
+        s = _connect(proxy.port)
+        s.sendall(b"abcdef")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and proxy.stats()["truncated"] == 0:
+            time.sleep(0.01)
+        assert proxy.stats()["truncated"] == 1
+        # nothing past the truncation point ever reaches upstream (the
+        # prefix itself can be flushed by the RST racing the reader)
+        got = bytes(echo.received[0]) if echo.received else b""
+        assert b"abc".startswith(got) or got == b"abc", got
+        assert b"d" not in got
+        s.close()
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+def test_proxy_blackhole_both_never_reaches_upstream():
+    echo = _EchoServer()
+    proxy = NetChaosProxy("127.0.0.1", echo.port,
+                          faults=FaultSpec(blackhole="both"))
+    try:
+        s = _connect(proxy.port, timeout=0.5)
+        s.sendall(b"anyone there?")  # connect succeeded; nothing answers
+        with pytest.raises((socket.timeout, OSError)):
+            s.recv(1024)
+        s.close()
+        st = proxy.stats()
+        assert st["blackholed_conns"] == 1
+        assert not echo.received  # the upstream never saw a connection
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+def test_proxy_blackhole_clears_and_connection_proceeds():
+    echo = _EchoServer()
+    proxy = NetChaosProxy("127.0.0.1", echo.port)
+    try:
+        proxy.set_faults(blackhole="both")
+        s = _connect(proxy.port, timeout=5.0)
+        time.sleep(0.15)  # parked in the blackhole hold
+        proxy.clear_faults()  # heal: the held connection proceeds
+        s.sendall(b"after heal")
+        assert s.recv(1024) == b"after heal"
+        s.close()
+    finally:
+        proxy.stop()
+        echo.stop()
+
+
+# ------------------------------------------------- wire fuzz (satellite)
+
+
+def test_wire_fuzz_corruption_never_poisons_cobatch(served):
+    """Bit-flips aimed at every structural region of a valid frame,
+    injected on the wire by the proxy: the server must ANSWER every
+    time (an HTTP status, never a hang or a dead handler), a flip in
+    the frame header must be the 400 contract, and clean traffic
+    through the same server must keep answering exact rows."""
+    srv, dp, emb = served
+    proxy = NetChaosProxy("127.0.0.1", dp.port, seed=3)
+    direct = ServingClient([dp.url], deadline_s=10.0)
+    try:
+        frame = _lookup_frame([1, 2, 3])
+        req, header_len = _raw_request(frame)
+        sections = wire.frame_sections(frame)
+        statuses = {}
+        for name, (lo, hi) in sections.items():
+            assert hi > lo, name
+            off = header_len + lo + (hi - lo) // 2
+            if name == "header":
+                off = header_len  # flip the magic itself
+            proxy.set_faults(corrupt_offset=off, corrupt_mode="bitflip")
+            s = _connect(proxy.port)
+            s.sendall(req)
+            status, _head, _body = _read_response(s)
+            s.close()
+            statuses[name] = status
+            # co-batch oracle: the very next clean lookup is exact
+            assert np.array_equal(
+                direct.lookup("emb", [7, 9]), emb[[7, 9]]
+            ), f"clean traffic broken after {name} corruption"
+        # every corrupted request got an ANSWER...
+        assert all(st is not None for st in statuses.values()), statuses
+        # ...and a corrupted frame header is structurally malformed: 400
+        assert statuses["header"] == 400, statuses
+        assert proxy.stats()["corrupted"] == len(sections)
+        assert direct.stats()["unrecovered"] == 0
+    finally:
+        direct.close()
+        proxy.stop()
+
+
+def test_wire_truncate_midframe_closes_cleanly(served):
+    """A frame truncated mid-body by the proxy (stream stops, RST):
+    the server's body read fails fast — no hung flusher thread — and
+    the co-batch / subsequent clean traffic is untouched."""
+    srv, dp, emb = served
+    proxy = NetChaosProxy("127.0.0.1", dp.port, seed=4)
+    direct = ServingClient([dp.url], deadline_s=10.0)
+    try:
+        frame = _lookup_frame(list(range(8)))
+        req, header_len = _raw_request(frame)
+        proxy.set_faults(
+            corrupt_offset=header_len + len(frame) // 2,
+            corrupt_mode="truncate",
+        )
+        s = _connect(proxy.port)
+        try:
+            s.sendall(req)
+        except OSError:
+            pass  # the RST can land while we are still sending
+        status, _h, _b = _read_response(s)
+        s.close()
+        assert status is None or status in (400, 408)
+        assert proxy.stats()["truncated"] == 1
+        assert np.array_equal(direct.lookup("emb", [3]), emb[[3]])
+        assert direct.stats()["unrecovered"] == 0
+    finally:
+        direct.close()
+        proxy.stop()
+
+
+# --------------------------------------------------- slow-loris defense
+
+
+def test_slow_loris_body_gets_408_and_close(mv_env):
+    emb = np.eye(8, dtype=np.float32)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0, read_timeout_s=0.3)
+    try:
+        s = _connect(dp.port)
+        head = (
+            "POST /v1/lookup HTTP/1.1\r\nHost: t\r\n"
+            "Content-Type: application/json\r\n"
+            "Content-Length: 64\r\n\r\n"
+        ).encode()
+        s.sendall(head + b'{"ta')  # ...and then never finish the body
+        t0 = time.monotonic()
+        status, header_text, _body = _read_response(s)
+        assert status == 408, (status, header_text)
+        assert "connection: close" in header_text.lower()
+        assert time.monotonic() - t0 < 5.0  # bounded by the deadline
+        s.close()
+        assert srv.metrics.report()["slow_loris_408"] == 1
+        # paced traffic on a FRESH connection is untouched
+        c = ServingClient([dp.url], deadline_s=10.0)
+        assert np.array_equal(c.lookup("emb", [2]), emb[[2]])
+        c.close()
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+def test_idle_keepalive_connection_reaped(mv_env):
+    emb = np.eye(8, dtype=np.float32)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0, idle_timeout_s=0.3)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", dp.port, timeout=5)
+        conn.request("POST", "/v1/lookup",
+                     body=b'{"table": "emb", "ids": [1]}',
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().read()  # request 1 served, conn idle
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and srv.metrics.report()["conns_reaped"] == 0):
+            time.sleep(0.05)
+        assert srv.metrics.report()["conns_reaped"] == 1
+        conn.close()
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+def test_max_conns_guard_rejects_flood(mv_env):
+    emb = np.eye(8, dtype=np.float32)
+    srv = TableServer({"emb": emb}, register_runtime=False).start()
+    dp = DataPlaneServer(srv, port=0, max_conns=1)
+    try:
+        first = _connect(dp.port)
+        first.sendall(b"")  # hold the only slot (keep-alive, no request)
+        time.sleep(0.1)  # let the handler thread claim it
+        second = _connect(dp.port)
+        status, header_text, _b = _read_response(second)
+        assert status == 503, (status, header_text)
+        assert "connection: close" in header_text.lower()
+        second.close()
+        assert srv.metrics.report()["conns_rejected"] == 1
+        first.close()
+        # slot released: new connections serve again
+        deadline = time.monotonic() + 5
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            c = ServingClient([dp.url], deadline_s=2.0, max_attempts=2)
+            try:
+                ok = np.array_equal(c.lookup("emb", [1]), emb[[1]])
+            except Exception:
+                time.sleep(0.05)
+            finally:
+                c.close()
+        assert ok
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+# -------------------------------------------------------- hedged reads
+
+
+def test_hedged_read_saves_stalled_primary():
+    """Primary endpoint stalls past the hedge delay and then dies; the
+    hedge fires at the adaptive delay, answers from the secondary, and
+    the request succeeds — hedge_wins counts it."""
+    from multiverso_tpu.serving import client as client_mod
+
+    calls = []
+    c = ServingClient(
+        ["http://p:1", "http://h:2"], deadline_s=5.0, max_attempts=2,
+        hedge_min_delay_s=0.05, eject=False,
+    )
+
+    def fake_post(endpoint, route, body, timeout_s, traceparent=None,
+                  box=None):
+        calls.append(endpoint)
+        if ":1" in endpoint:
+            time.sleep(0.4)  # blackholed primary: read times out
+            raise client_mod._EndpointDown(f"{endpoint}: read timeout")
+        return {"rows": [[9.0, 9.0]]}
+
+    c._post_once = fake_post
+    # pin rotation so the first attempt's primary is the stalled one
+    c._rr = 0
+    rows = c.lookup("emb", [0])
+    np.testing.assert_array_equal(rows, np.asarray([[9.0, 9.0]], np.float32))
+    s = c.stats()
+    assert s["ok"] == 1 and s["unrecovered"] == 0
+    assert s["hedges"] == 1 and s["hedge_wins"] == 1, s
+    assert set(calls) == {"http://p:1", "http://h:2"}
+    c.close()
+
+
+def test_hedge_budget_caps_extra_load():
+    """hedge_budget_pct=0 allows at most one hedge ever; the default
+    10% stays proportional. Primaries are slow-but-successful, so every
+    request COULD hedge — the budget is what stops it."""
+    from multiverso_tpu.serving import client as client_mod
+
+    def make(budget):
+        c = client_mod.ServingClient(
+            ["http://p:1", "http://h:2"], deadline_s=5.0,
+            max_attempts=1, hedge_min_delay_s=0.01,
+            hedge_budget_pct=budget, eject=False,
+        )
+
+        def fake_post(endpoint, route, body, timeout_s, traceparent=None,
+                      box=None):
+            if ":1" in endpoint:
+                time.sleep(0.1)  # slower than the hedge delay
+            return {"rows": [[1.0]]}
+
+        c._post_once = fake_post
+        return c
+
+    capped = make(0.0)
+    for _ in range(4):
+        capped._rr = 0
+        capped.lookup("emb", [0])
+    assert capped.stats()["hedges"] <= 1, capped.stats()
+    capped.close()
+
+    open_budget = make(400.0)
+    for _ in range(4):
+        open_budget._rr = 0
+        open_budget.lookup("emb", [0])
+    assert open_budget.stats()["hedges"] == 4, open_budget.stats()
+    open_budget.close()
+
+
+# ----------------------------------------------------- outlier ejection
+
+
+def test_ejector_error_rate_ejects_then_probe_recovers():
+    clk = FakeClock()
+    events = []
+    ej = OutlierEjector(
+        error_threshold=0.5, min_samples=3, cooldown_s=5.0, clock=clk,
+        on_transition=lambda kind, **f: events.append(kind),
+    )
+    for _ in range(3):
+        ej.record("http://a:1", False)
+    assert ej.state("http://a:1") == "ejected"
+    assert ej.ejected() == ["http://a:1"]
+    assert not ej.peek("http://a:1")
+    assert not ej.allow("http://a:1")  # cooldown not elapsed
+    clk.advance(5.1)
+    assert ej.peek("http://a:1")  # probe candidate
+    assert ej.allow("http://a:1")  # claims the single probe slot
+    assert ej.state("http://a:1") == "probing"
+    assert not ej.allow("http://a:1")  # second caller: slot taken
+    ej.record("http://a:1", True, 0.01)  # probe verdict: healthy
+    assert ej.state("http://a:1") == "ok"
+    assert ej.peek("http://a:1")
+    assert events == ["outlier_eject", "outlier_probe", "outlier_recover"]
+
+
+def test_ejector_failed_probe_re_ejects():
+    clk = FakeClock()
+    ej = OutlierEjector(error_threshold=0.5, min_samples=2,
+                        cooldown_s=1.0, clock=clk)
+    ej.record("e", False)
+    ej.record("e", False)
+    clk.advance(1.5)
+    assert ej.allow("e")
+    ej.record("e", False)  # probe fails
+    assert ej.state("e") == "ejected"
+    assert not ej.allow("e")  # fresh cooldown
+
+
+def test_ejector_latency_outlier_gray_failure():
+    """An endpoint that ANSWERS but 30x slower than the fleet — the
+    /healthz-invisible gray failure — is ejected on latency alone."""
+    clk = FakeClock()
+    ej = OutlierEjector(min_samples=5, latency_factor=3.0, clock=clk)
+    for _ in range(8):
+        ej.record("fast1", True, 0.010)
+        ej.record("fast2", True, 0.012)
+        ej.record("slow", True, 0.350)
+    assert ej.state("slow") == "ejected"
+    assert ej.state("fast1") == "ok" and ej.state("fast2") == "ok"
+    assert ej.stats()["slow"]["state"] == "ejected"
+
+
+def test_client_ejects_failing_endpoint_and_fails_over():
+    from multiverso_tpu.serving import client as client_mod
+
+    c = client_mod.ServingClient(
+        ["http://bad:1", "http://good:2"], deadline_s=5.0,
+        max_attempts=4, backoff_base_s=0.0, backoff_max_s=0.0,
+        sleep=lambda s: None, hedge=False,
+        eject_min_samples=2, eject_threshold=0.5,
+    )
+    calls = []
+
+    def fake_post(endpoint, route, body, timeout_s, traceparent=None,
+                  box=None):
+        calls.append(endpoint)
+        if "bad" in endpoint:
+            raise client_mod._EndpointDown(f"{endpoint}: down")
+        return {"rows": [[1.0]]}
+
+    c._post_once = fake_post
+    for _ in range(8):
+        c.lookup("emb", [0])
+    s = c.stats()
+    assert s["ok"] == 8 and s["unrecovered"] == 0
+    assert s["ejections"] >= 1, s
+    assert c._ejector.state("http://bad:1") == "ejected"
+    # after ejection the bad endpoint stops receiving attempts
+    tail = calls[-6:]
+    assert all("good" in e for e in tail), calls
+    c.close()
+
+
+# ------------------------------------- mid-body reset on a reused socket
+
+
+class _MidBodyResetConn:
+    """A reused keep-alive socket that dies MID-BODY: request() works,
+    the response read raises IncompleteRead — what http.client raises
+    when the peer resets after sending a partial body."""
+
+    class _Sock:
+        def settimeout(self, t):
+            pass
+
+    sock = _Sock()  # "already connected" — skips the eager connect
+    timeout = 0.0
+
+    def request(self, *a, **k):
+        pass
+
+    def getresponse(self):
+        raise http.client.IncompleteRead(b"partial-body")
+
+    def close(self):
+        pass
+
+
+def test_client_mid_body_reset_on_reused_socket_is_stale_retry(served):
+    """ISSUE satellite: a connection reset mid-body on a REUSED socket
+    must classify as retryable-on-fresh-connection (like the handshake
+    BadStatusLine case), not surface as an unrecovered error."""
+    _, dp, emb = served
+    c = ServingClient([dp.url], deadline_s=10.0)
+    assert np.array_equal(c.lookup("emb", [4]), emb[[4]])  # pools a conn
+    with c._lock:
+        (ep,) = list(c._pool)
+        c._pool[ep] = [_MidBodyResetConn()]
+    assert np.array_equal(c.lookup("emb", [5]), emb[[5]])
+    s = c.stats()
+    assert s["ok"] == 2 and s["stale_retries"] == 1, s
+    assert s["failovers"] == 0 and s["unrecovered"] == 0, s
+    c.close()
+
+
+# --------------------------------------------------- serve-stale (cache)
+
+
+def test_rowcache_retains_previous_generation_for_stale_serves():
+    cache = HotRowCache(8, retain_stale=True)
+    key = HotRowCache.request_key(np.asarray([1, 2], np.int64))
+    cache.put(1, "lookup:emb", key, "v1-rows")
+    assert cache.get(1, "lookup:emb", key) == "v1-rows"
+    # rollout to v2: the v1 generation becomes the stale fallback
+    assert cache.get(2, "lookup:emb", key) is None
+    assert cache.get_stale("lookup:emb", key) == (1, "v1-rows")
+    assert cache.stats()["stale_hits"] == 1
+    assert cache.stats()["stale_entries"] == 1
+    # without retain_stale the old generation is simply gone
+    plain = HotRowCache(8)
+    plain.put(1, "lookup:emb", key, "v1-rows")
+    plain.get(2, "lookup:emb", key)
+    assert plain.get_stale("lookup:emb", key) is None
+
+
+def test_server_serves_stale_when_route_unavailable(mv_env):
+    """Breaker open + serve-stale armed: a lookup that would 503
+    answers the retained previous generation flagged mv_stale."""
+    from multiverso_tpu.serving.server import RouteUnavailable
+
+    emb = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    srv = TableServer(
+        {"emb": emb}, register_runtime=False,
+        rowcache=HotRowCache(32, retain_stale=True),
+    ).start()
+    try:
+        fut = srv.lookup_async("emb", [3, 5], block=True)
+        np.testing.assert_allclose(fut.result(timeout=10), emb[[3, 5]])
+        # rollout: version bumps, the v1 cache entries become stale gen
+        srv.publish({"emb": emb * 2.0})
+        # force the route's breaker open
+        br = srv._breaker("lookup:emb")
+        for _ in range(br.threshold):
+            br.record_failure()
+        stale = srv.lookup_async("emb", [3, 5])
+        assert getattr(stale, "mv_stale", False)
+        assert stale.mv_stale_version == 1
+        np.testing.assert_allclose(stale.result(timeout=10), emb[[3, 5]])
+        assert srv.metrics.report()["stale_serves"] == 1
+        # an id set never cached has nothing stale: still 503
+        with pytest.raises(RouteUnavailable):
+            srv.lookup_async("emb", [14, 15])
+    finally:
+        srv.stop()
+
+
+def test_stale_flag_rides_both_wire_formats(mv_env):
+    emb = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    srv = TableServer(
+        {"emb": emb}, register_runtime=False,
+        rowcache=HotRowCache(32, retain_stale=True),
+    ).start()
+    dp = DataPlaneServer(srv, port=0)
+    try:
+        c_json = ServingClient([dp.url], deadline_s=10.0, wire="json")
+        c_bin = ServingClient([dp.url], deadline_s=10.0, wire="binary")
+        # warm the cache for both wire paths (same canonical key)
+        assert np.array_equal(c_json.lookup("emb", [2]), emb[[2]])
+        srv.publish({"emb": emb + 1.0})
+        br = srv._breaker("lookup:emb")
+        for _ in range(br.threshold):
+            br.record_failure()
+        out_json = c_json._call("/v1/lookup",
+                                {"table": "emb",
+                                 "ids": np.asarray([2], np.int64)})
+        assert out_json.get("stale") is True and out_json["version"] == 1
+        out_bin = c_bin._call("/v1/lookup",
+                              {"table": "emb",
+                               "ids": np.asarray([2], np.int64)})
+        assert bool(out_bin.get("stale")) and out_bin["version"] == 1
+        c_json.close()
+        c_bin.close()
+    finally:
+        dp.stop()
+        srv.stop()
+
+
+# ------------------------------------ partition + recovery (fleet-level)
+
+
+def test_partition_eject_failover_and_probe_recovery(mv_env):
+    """The ISSUE's partition drill at test scale: two in-process
+    replicas, each behind its own chaos proxy. Partition replica B
+    (full blackhole), drive traffic — the client must eject B and fail
+    everything over to A with ZERO unrecovered errors; heal B — the
+    half-open probe must bring it back into rotation."""
+    emb = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    srv_a = TableServer({"emb": emb}, register_runtime=False,
+                        name="ra").start()
+    srv_b = TableServer({"emb": emb}, register_runtime=False,
+                        name="rb").start()
+    dp_a = DataPlaneServer(srv_a, port=0)
+    dp_b = DataPlaneServer(srv_b, port=0)
+    px_a = NetChaosProxy("127.0.0.1", dp_a.port, seed=1, name="nc-a")
+    px_b = NetChaosProxy("127.0.0.1", dp_b.port, seed=2, name="nc-b")
+    events = []
+    c = ServingClient(
+        [px_a.url, px_b.url], deadline_s=6.0, max_attempts=6,
+        backoff_base_s=0.0, backoff_max_s=0.01,
+        connect_timeout_s=0.5, read_timeout_s=0.4,
+        eject_min_samples=2, eject_cooldown_s=0.5,
+        event_hook=lambda kind, **f: events.append(kind),
+    )
+    try:
+        for i in range(4):  # warm both endpoints + the pool
+            assert np.array_equal(c.lookup("emb", [i]), emb[[i]])
+
+        px_b.set_faults(blackhole="both")  # partition replica B
+        for i in range(12):
+            assert np.array_equal(
+                c.lookup("emb", [i % 16]), emb[[i % 16]]
+            )
+        s = c.stats()
+        assert s["unrecovered"] == 0, s
+        assert s["ejections"] >= 1, s
+        assert c._ejector.state(px_b.url.rstrip("/")) == "ejected"
+
+        px_b.clear_faults()  # heal the partition
+        deadline = time.monotonic() + 20
+        while (time.monotonic() < deadline
+               and c.stats()["eject_recoveries"] == 0):
+            c.lookup("emb", [1])
+            time.sleep(0.05)
+        s = c.stats()
+        assert s["eject_recoveries"] >= 1, s
+        assert s["unrecovered"] == 0, s
+        assert c._ejector.state(px_b.url.rstrip("/")) == "ok"
+        assert "outlier_eject" in events and "outlier_recover" in events
+    finally:
+        c.close()
+        px_a.stop()
+        px_b.stop()
+        dp_a.stop()
+        dp_b.stop()
+        srv_a.stop()
+        srv_b.stop()

@@ -439,3 +439,69 @@ def test_budget_sync_ignores_rate_derivative_metrics(tmp_path):
     )
     rows = FleetBudgetSync._parse_rows(text)
     assert rows == {"a": 100.0, "b": 7.0}
+
+
+# ------------------------------------------------------ queue-depth SLO
+
+
+def test_aggregate_queue_depth_mean_across_replicas():
+    """The fleet scrape merges each replica's live batcher queue gauge
+    into a per-replica MEAN — the saturation early-warning that fires
+    before latency or shed SLOs burn."""
+    a = FleetAutoscaler(
+        FakeFleet(2), FleetController(),
+        clock=FakeClock(), fetch=lambda u: "",
+    )
+    merged = (
+        "mv_serving_replica_served 100\n"
+        "mv_serving_replica_queue_depth 10\n"
+        "mv_serving_replica_served 100\n"
+        "mv_serving_replica_queue_depth 30\n"
+    )
+    flat = a._aggregate(merged, 2)
+    assert flat["fleet:queue_depth"] == 40.0
+    assert flat["fleet:queue_depth_mean"] == 20.0
+    # no queue samples -> no key (absent gauge reads healthy, so an
+    # old replica build without the gauge can never trip the rule)
+    flat = a._aggregate("mv_serving_replica_served 5\n", 1)
+    assert "fleet:queue_depth_mean" not in flat
+
+
+def test_fleet_rules_include_queue_depth_gauge():
+    from multiverso_tpu.serving.autoscale import fleet_rules
+
+    rules = {r.name: r for r in fleet_rules(queue_depth_objective=32.0)}
+    assert "fleet_queue_depth" in rules
+    rule = rules["fleet_queue_depth"]
+    assert rule.metric == "fleet:queue_depth_mean"
+    assert rule.objective == 32.0
+    assert rule.kind == "gauge"
+
+
+def test_autoscaler_scales_up_on_sustained_queue_depth():
+    """Saturation that queues but does not (yet) shed or blow p99:
+    only the queue-depth rule sees it, and it must ADD."""
+    clock = FakeClock()
+    fleet = FakeFleet(1)
+    served = [0.0]
+
+    def fetch(url):
+        served[0] += 50.0
+        return (
+            f"mv_serving_replica_served {served[0]}\n"
+            "mv_serving_replica_queue_depth 500\n"
+        )
+
+    a = FleetAutoscaler(
+        fleet, FleetController(max_replicas=2, cooldown_decisions=2),
+        clock=clock, fetch=fetch,
+    )
+    for _ in range(30):
+        clock.advance(2.0)
+        a.tick_once()
+    assert fleet.n == 2
+    assert fleet.scaled, "queue-depth burn never scaled"
+    target, reason = fleet.scaled[0]
+    assert target == 2
+    assert reason.startswith("burn_scale_up")
+    assert "fleet_queue_depth" in reason

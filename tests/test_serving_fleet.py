@@ -547,7 +547,8 @@ def test_client_exhausted_endpoints_trigger_refresh_and_stale_stat():
     )
     calls = []
 
-    def fake_post(endpoint, route, body, timeout_s, traceparent=None):
+    def fake_post(endpoint, route, body, timeout_s, traceparent=None,
+                  box=None):
         calls.append(endpoint)
         if "new" not in endpoint:
             raise client_mod._EndpointDown(f"{endpoint}: down")
@@ -578,7 +579,7 @@ def test_client_periodic_refresh_on_success_path():
         refresh_s=10.0, clock=clk, sleep=lambda s: None,
     )
     c._post_once = (
-        lambda endpoint, route, body, timeout_s, traceparent=None:
+        lambda endpoint, route, body, timeout_s, traceparent=None, box=None:
         {"rows": [[0.0]]}
     )
     c.lookup("emb", [0])

@@ -326,7 +326,11 @@ class _DeadConn:
     """A pooled socket the server closed between requests: first reuse
     fails with BadStatusLine, exactly like http.client reports it."""
 
-    sock = None
+    class _Sock:
+        def settimeout(self, t):
+            pass
+
+    sock = _Sock()  # "already connected" — skips the eager connect
     timeout = 0.0
 
     def request(self, *a, **k):
