@@ -2631,6 +2631,199 @@ def _bench_netchaos():
     return out
 
 
+def _bench_multihost(root):
+    """Multi-host serving leg (ISSUE 20): what the host-agent placement
+    layer and the L7 front balancer cost and buy.
+
+    2 ``serving.hostagent`` processes (each its own process group = one
+    simulated host) under a ``HostedFleet`` placing 2 replicas spread
+    across them. Three phases:
+
+    * direct — closed-loop lookups straight at the replica endpoints
+      (the pre-balancer client path); ``balancer_direct_qps`` anchors
+      the overhead ratio;
+    * balancer — the SAME load through the one-address front door;
+      ``balancer_qps`` / ``balancer_p99_ms``, and
+      ``balancer_overhead_pct`` is the qps cost of the extra hop
+      (target: <= 15% — the balancer forwards frames, it does not
+      decode them);
+    * host loss — SIGKILL host 1's whole process group (agent AND its
+      replica) under trickle load through the balancer;
+      ``hostloss_mttr_ms`` is kill -> the re-placed replica READY on
+      the survivor, and ``hostloss_unrecovered`` must stay 0.
+
+    Replicas run on CPU (the parent owns the TPU). MV_BENCH_MULTIHOST=0
+    skips; MV_BENCH_ASSERTS=1 gates the targets.
+    """
+    import os
+    import signal as _signal
+    import subprocess
+    import sys as _s
+
+    if os.environ.get("MV_BENCH_MULTIHOST", "1") == "0":
+        return {}
+    from multiverso_tpu.serving.balancer import Balancer
+    from multiverso_tpu.serving.client import (
+        BalancerEndpoints,
+        ServingClient,
+    )
+    from multiverso_tpu.serving.hostagent import read_agents_dir
+    from multiverso_tpu.serving.placement import HostedFleet
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ck_code = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[2])
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.io.checkpoint import save_tables
+root = sys.argv[1]
+mv.MV_Init()
+t = mv.MV_CreateTable(MatrixTableOption(num_row=4096, num_col=64))
+t.add(np.random.RandomState(1).randn(4096, 64).astype(np.float32) * 0.1)
+t.wait()
+save_tables(os.path.join(root, "ckpt-1"), step=1)
+mv.MV_ShutDown()
+"""
+    r = subprocess.run(
+        [_s.executable, "-c", ck_code, root, repo],
+        capture_output=True, text=True, timeout=300,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"multihost leg ckpt writer failed: {r.stderr[-800:]}"
+        )
+
+    agents_dir = os.path.join(root, "agents")
+    os.makedirs(agents_dir, exist_ok=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    agents = []
+    for i in range(2):
+        logf = open(os.path.join(root, f"agent{i}.log"), "a")
+        agents.append(subprocess.Popen(
+            [_s.executable, "-m", "multiverso_tpu.serving.hostagent",
+             f"-agent_dir={agents_dir}", f"-agent_name=host{i}",
+             "-agent_capacity=2", "-agent_port=-1",
+             "-agent_heartbeat_s=0.25"],
+            stdout=logf, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True,
+        ))
+        logf.close()
+    deadline = time.monotonic() + 30
+    while (len(read_agents_dir(agents_dir)) < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.1)
+
+    rng = np.random.RandomState(7)
+    out = {}
+
+    def run(client, n, size=8):
+        lats = []
+        for _ in range(n):
+            ids = rng.randint(0, 4096, size=size)
+            t0 = time.perf_counter()
+            client.lookup("emb", ids)
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        return lats
+
+    fleet = HostedFleet(
+        2, root, agents_dir=agents_dir,
+        log_dir=os.path.join(root, "fleet"),
+        extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25"],
+        replica_env=env, heartbeat_timeout_s=2.0,
+        backoff_base_s=0.1, backoff_max_s=0.5,
+    ).start()
+    bal = None
+    try:
+        if not fleet.wait_ready(timeout_s=120):
+            raise RuntimeError("hosted replicas never became ready")
+        fleet.watch()
+
+        # phase 1: direct at the replica endpoints (no front door)
+        direct = ServingClient(fleet.endpoints(), deadline_s=30.0,
+                               hedge=False)
+        run(direct, 20)  # warm jit + pools
+        t0 = time.perf_counter()
+        d = run(direct, 300)
+        direct_wall = time.perf_counter() - t0
+        direct.close()
+        direct_qps = len(d) / direct_wall
+
+        # phase 2: the same load through the balancer's ONE address
+        bal = Balancer(endpoints_dir=fleet.endpoints_dir(),
+                       agents_dir=agents_dir, probe_s=0.25).start()
+        fronted = ServingClient([bal.url], deadline_s=30.0, hedge=False)
+        run(fronted, 20)
+        t0 = time.perf_counter()
+        b = run(fronted, 300)
+        bal_wall = time.perf_counter() - t0
+        fronted.close()
+        bal_qps = len(b) / bal_wall
+        out["balancer_direct_qps"] = round(direct_qps, 1)
+        out["balancer_qps"] = round(bal_qps, 1)
+        out["balancer_p99_ms"] = round(
+            b[min(int(len(b) * 0.99), len(b) - 1)] * 1e3, 2
+        )
+        out["balancer_overhead_pct"] = round(
+            100.0 * (direct_qps - bal_qps) / direct_qps, 1
+        )
+
+        # phase 3: SIGKILL host 1's whole group under trickle load;
+        # MTTR = kill -> the re-placed replica READY on the survivor
+        c = ServingClient(
+            [bal.url], deadline_s=30.0,
+            endpoint_source=BalancerEndpoints(
+                bal.url, fallback=fleet.endpoints_dir()),
+        )
+        run(c, 10)
+        os.killpg(agents[1].pid, _signal.SIGKILL)
+        t_kill = time.monotonic()
+        mttr_ms = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            run(c, 5)
+            if fleet.ready_count() >= 2:
+                mttr_ms = (time.monotonic() - t_kill) * 1e3
+                break
+            time.sleep(0.1)
+        run(c, 20)  # the healed pool serves through the same address
+        out["hostloss_mttr_ms"] = (
+            None if mttr_ms is None else round(mttr_ms, 1)
+        )
+        out["hostloss_unrecovered"] = c.stats()["unrecovered"]
+        out["hostloss_balancer_retries"] = bal.stats()["retries"]
+        c.close()
+    finally:
+        if bal is not None:
+            bal.stop()
+        fleet.stop()
+        for p in agents:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, _signal.SIGTERM)
+                except (ProcessLookupError, OSError):
+                    pass
+        for p in agents:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, _signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass
+
+    if os.environ.get("MV_BENCH_ASSERTS") == "1":
+        assert out["balancer_overhead_pct"] <= 15.0, out
+        assert out["hostloss_mttr_ms"] is not None, out
+        assert out["hostloss_unrecovered"] == 0, out
+    return out
+
+
 def _probe_backend(timeout_s: int = 180):
     """The bench host's TPU rides a shared tunnel that can wedge so hard
     even jax.devices() blocks forever in a fresh process (observed
@@ -2846,6 +3039,14 @@ def main():
     try:
         import tempfile
 
+        with tempfile.TemporaryDirectory(prefix="mv_bench_mh_") as d:
+            mh_leg = leg("multihost", lambda: _bench_multihost(d))
+    except Exception as e:
+        print(f"# leg multihost FAILED: {e}", file=_sys.stderr, flush=True)
+        mh_leg = {"multihost_error": str(e)[:200]}
+    try:
+        import tempfile
+
         with tempfile.TemporaryDirectory(prefix="mv_bench_ps2p_") as d:
             ps2p_leg = leg(
                 "ps_comms_2proc", lambda: _bench_ps_comms_cluster(d)
@@ -2897,6 +3098,7 @@ def main():
     out.update(fleet_leg)
     out.update(cp_leg)
     out.update(nc_leg)
+    out.update(mh_leg)
     out.update(ps2p_leg)
     out.update(resilience)
     out.update(e2e)

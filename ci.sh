@@ -646,6 +646,145 @@ print(f"netchaos drill OK: {final['requests']} requests, 0 unrecovered "
 EOF
 rm -rf "$NCROOT"
 
+echo "== multi-host serving drill (2 host agents + balancer, SIGKILL a whole host mid-load) =="
+# host-loss tolerance end to end with REAL processes: 2 serving.hostagent
+# processes (each its own process group = one simulated host) register in
+# a shared agents dir; a HostedFleet places 2 replicas across them
+# (spread anti-affinity) and the L7 Balancer fronts everything with ONE
+# address fed by the agent registry + mirrored endpoint files. Under
+# trickle load through the balancer, agent 1's WHOLE group is
+# SIGKILLed — agent and its replica die together, a host loss, not a
+# replica crash. Gates: the fleet detects the loss (heartbeat
+# staleness or refused control API), re-places the replica on agent 0
+# under the restart budget, the client sees ZERO unrecovered errors
+# through the kill, and agent_lost/replica_lost/replica_place land on
+# fleet.log.jsonl.
+MHROOT=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$MHROOT" <<'EOF'
+import json, os, signal, subprocess, sys, time
+import numpy as np
+
+sys.path.insert(0, ".")
+import multiverso_tpu as mv
+from multiverso_tpu.io.checkpoint import save_tables
+from multiverso_tpu.serving.balancer import Balancer
+from multiverso_tpu.serving.client import BalancerEndpoints, ServingClient
+from multiverso_tpu.serving.hostagent import read_agents_dir
+from multiverso_tpu.serving.placement import HostedFleet
+from multiverso_tpu.tables import MatrixTableOption
+
+root = sys.argv[1]
+
+mv.MV_Init(["prog"])
+try:
+    t = mv.MV_CreateTable(MatrixTableOption(num_row=64, num_col=8))
+    t.add(np.full((64, 8), 1.0, np.float32))
+    t.wait()
+    save_tables(os.path.join(root, "ckpt-1"), step=1)
+finally:
+    mv.MV_ShutDown(finalize=True)
+
+agents_dir = os.path.join(root, "agents")
+os.makedirs(agents_dir)
+env = dict(os.environ)
+env["JAX_PLATFORMS"] = "cpu"
+agents = []
+for i in range(2):
+    logf = open(os.path.join(root, f"agent{i}.log"), "a")
+    agents.append(subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.serving.hostagent",
+         f"-agent_dir={agents_dir}", f"-agent_name=host{i}",
+         "-agent_capacity=2", "-agent_port=-1",
+         "-agent_heartbeat_s=0.25"],
+        stdout=logf, stderr=subprocess.STDOUT, env=env,
+        start_new_session=True,
+    ))
+    logf.close()
+deadline = time.monotonic() + 30
+while len(read_agents_dir(agents_dir)) < 2 and time.monotonic() < deadline:
+    time.sleep(0.1)
+assert len(read_agents_dir(agents_dir)) == 2, "agents never registered"
+
+fleet = HostedFleet(
+    2, root, agents_dir=agents_dir, log_dir=os.path.join(root, "fleet"),
+    extra_argv=["-serve_tables=emb", "-serve_poll_s=0.25"],
+    replica_env={"JAX_PLATFORMS": "cpu"},
+    heartbeat_timeout_s=2.0, backoff_base_s=0.1, backoff_max_s=0.5,
+).start()
+assert fleet.wait_ready(timeout_s=120), "replicas never became ready"
+hosts = {fleet._slots[0].agent, fleet._slots[1].agent}
+assert hosts == {"host0", "host1"}, f"spread violated: {hosts}"
+fleet.watch()
+
+bal = Balancer(endpoints_dir=fleet.endpoints_dir(),
+               agents_dir=agents_dir, probe_s=0.25).start()
+c = ServingClient(
+    [bal.url], deadline_s=15.0,
+    endpoint_source=BalancerEndpoints(
+        bal.url, fallback=fleet.endpoints_dir()),
+)
+
+errors = []
+
+
+def drive(n, pause=0.02):
+    for i in range(n):
+        rows = np.asarray(c.lookup("emb", [i % 64, (i + 7) % 64]),
+                          np.float32)
+        if not np.allclose(rows, 1.0):
+            errors.append(f"wrong rows: {rows[0][:2]}")
+        time.sleep(pause)
+
+
+drive(50)  # warm traffic through the ONE address
+
+# host loss: SIGKILL agent 1's whole process group mid-load (agent AND
+# its replica die together — no graceful anything)
+os.killpg(agents[1].pid, signal.SIGKILL)
+t_kill = time.monotonic()
+drive(150, pause=0.02)  # load stays on straight through the loss
+
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline and fleet.ready_count() < 2:
+    time.sleep(0.2)
+mttr_s = time.monotonic() - t_kill
+assert fleet.ready_count() == 2, "lost replica never re-placed"
+assert fleet._slots[0].agent == "host0" and fleet._slots[1].agent == "host0", \
+    "re-placement must land on the surviving host"
+drive(30, pause=0.01)  # and the re-placed replica serves via balancer
+
+final = dict(c.stats())
+c.close()
+bal_stats = bal.stats()
+bal.stop()
+fleet.stop()
+for p in agents:
+    if p.poll() is None:
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+        except (ProcessLookupError, OSError):
+            pass
+for p in agents:
+    try:
+        p.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        os.killpg(p.pid, signal.SIGKILL)
+
+assert not errors, errors[:3]
+assert final["unrecovered"] == 0, final
+with open(os.path.join(root, "fleet", "fleet.log.jsonl")) as f:
+    kinds = [json.loads(ln).get("event") for ln in f if ln.strip()]
+for needed in ("agent_seen", "replica_place", "agent_lost",
+               "replica_lost", "replica_relaunch"):
+    assert needed in kinds, (needed, kinds)
+print(f"multi-host drill OK: {final['requests']} requests through "
+      f"{bal_stats['requests']}-request balancer, 0 unrecovered, host1 "
+      f"SIGKILLed and its replica re-placed on host0 in {mttr_s:.1f}s "
+      f"({bal_stats['retries']} balancer retries, "
+      f"{bal_stats['drains']} drains)")
+EOF
+rm -rf "$MHROOT"
+
 echo "== crash-recovery smoke (chaos kill -> elastic resume) =="
 # fault-tolerance end to end with a REAL process death: the WordEmbedding
 # CLI is chaos-killed (os._exit 137) mid-run with crash-consistent

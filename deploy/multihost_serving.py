@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Launch a multi-host serving pod: host agents + placed fleet + L7
+balancer, one command.
+
+    python deploy/multihost_serving.py \
+        --hosts 2 --replicas 2 --capacity 2 \
+        --checkpoint-dir /ckpts/we --log-dir /tmp/mh \
+        -- -serve_tables=emb_in,emb_out
+
+On one machine this SIMULATES a pod: each ``--hosts`` becomes a
+``serving.hostagent`` process (its own process group — SIGKILL the
+group and you have lost a "host", replicas and all). On a real pod you
+run ``python -m multiverso_tpu.serving.hostagent`` on every host
+against a shared ``--log-dir/agents`` registry instead and skip
+``--hosts``  (``--hosts 0``). Either way the placement layer
+(``HostedFleet``) spreads replicas across the agents (``--policy
+binpack`` to fill hosts in turn), re-places them on survivors when a
+host dies, and the balancer gives clients ONE address that follows
+every re-placement. Everything after ``--`` is passed to every replica
+verbatim. Events land in ``<log-dir>/fleet.log.jsonl``; see DEPLOY.md
+"Multi-host serving".
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    replica_argv = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, replica_argv = argv[:split], argv[split + 1:]
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hosts", type=int, default=2,
+                    help="simulated hosts = local agent processes to "
+                         "launch (0 = agents already running elsewhere "
+                         "against the same registry)")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--capacity", type=int, default=2,
+                    help="per-host replica capacity (-agent_capacity)")
+    ap.add_argument("--policy", choices=("spread", "binpack"),
+                    default="spread")
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--log-dir", required=True)
+    ap.add_argument("--agents-dir", default="",
+                    help="agent registry dir (default <log-dir>/agents)")
+    ap.add_argument("--balancer", action="store_true",
+                    help="start the L7 front balancer and print its one "
+                         "address (fed by the agent registry + the "
+                         "fleet's endpoints dir)")
+    ap.add_argument("--max-restarts", type=int, default=5)
+    ap.add_argument("--restart-window-s", type=float, default=600.0)
+    ap.add_argument("--heartbeat-timeout-s", type=float, default=3.0)
+    ap.add_argument("--ready-timeout-s", type=float, default=120.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--autoscale", action="store_true")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--autoscale-interval-s", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    from multiverso_tpu.serving.hostagent import read_agents_dir
+    from multiverso_tpu.serving.placement import HostedFleet
+
+    agents_dir = args.agents_dir or os.path.join(args.log_dir, "agents")
+    os.makedirs(agents_dir, exist_ok=True)
+    agent_procs = []
+    for i in range(args.hosts):
+        log_path = os.path.join(args.log_dir, f"agent-host{i}.log")
+        os.makedirs(args.log_dir, exist_ok=True)
+        logf = open(log_path, "a")
+        # own session per agent: killing ITS group is a whole-host loss
+        # (the agent spawns replicas into its own group)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "multiverso_tpu.serving.hostagent",
+             f"-agent_dir={agents_dir}", f"-agent_name=host{i}",
+             f"-agent_capacity={args.capacity}", "-agent_port=-1"],
+            stdout=logf, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        logf.close()
+        agent_procs.append(p)
+        print(f"agent host{i}: pid {p.pid} (log {log_path})", flush=True)
+    deadline = time.monotonic() + 30
+    while (len(read_agents_dir(agents_dir)) < args.hosts
+           and time.monotonic() < deadline):
+        time.sleep(0.2)
+
+    fleet = HostedFleet(
+        args.replicas, args.checkpoint_dir,
+        agents_dir=agents_dir, log_dir=args.log_dir,
+        extra_argv=replica_argv, policy=args.policy,
+        max_restarts=args.max_restarts,
+        restart_window_s=args.restart_window_s,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        seed=args.seed,
+    ).start()
+    balancer = None
+    autoscaler = None
+    try:
+        if fleet.wait_ready(timeout_s=args.ready_timeout_s):
+            for i in fleet.active_indices():
+                doc = fleet.endpoint(i) or {}
+                print(
+                    f"replica {i} ready: {doc.get('url')} "
+                    f"(host {json.dumps(fleet._slots[i].agent)})",
+                    flush=True,
+                )
+        else:
+            print(
+                "WARNING: not all replicas ready within "
+                f"{args.ready_timeout_s:.0f}s (valid checkpoint under "
+                "the root? agents up?)", flush=True,
+            )
+        fleet.watch()
+        if args.balancer:
+            from multiverso_tpu.serving.balancer import Balancer
+
+            balancer = Balancer(
+                port=0 if os.environ.get("MV_BALANCER_PORT") is None
+                else int(os.environ["MV_BALANCER_PORT"]),
+                endpoints_dir=fleet.endpoints_dir(),
+                agents_dir=agents_dir,
+            ).start()
+            print(f"balancer: {balancer.url}  <- the one address",
+                  flush=True)
+        if args.autoscale:
+            from multiverso_tpu.serving.autoscale import (
+                FleetAutoscaler,
+                FleetController,
+            )
+
+            autoscaler = FleetAutoscaler(
+                fleet,
+                FleetController(
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas,
+                ),
+                interval_s=args.autoscale_interval_s,
+            ).start()
+            print(
+                f"autoscaler armed: {args.min_replicas}.."
+                f"{args.max_replicas} replicas "
+                "(holds with at_capacity when hosts are full)",
+                flush=True,
+            )
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining pod...", flush=True)
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        if balancer is not None:
+            balancer.stop()
+        fleet.stop()
+        for p in agent_procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+        for p in agent_procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,13 @@ until Ctrl-C (graceful drain: replicas flip unready, finish in-flight
 requests, exit). Endpoint files (JSON with bound ports) land under
 ``<log-dir>/endpoints/``; supervision events in
 ``<log-dir>/fleet.log.jsonl``. See DEPLOY.md "Serving fleet".
+
+This launcher runs every replica on the local machine. To spread the
+fleet over several hosts (per-host agents, spread/binpack placement,
+an L7 front balancer, whole-host loss tolerance), use
+``deploy/multihost_serving.py`` instead — it exposes the same
+post-``--`` replica-flag convention and DEPLOY.md "Multi-host serving"
+documents the operational differences.
 """
 
 import argparse

@@ -47,7 +47,18 @@ package is the read path sized for that traffic:
 * ``budget`` — fleet-wide admission: replicas gossip per-tenant
   admitted rows through the /metrics scrape and shrink their local
   buckets to their share, so a tenant's budget stops multiplying with
-  replica count.
+  replica count;
+* ``hostagent`` — per-host control process (stdlib HTTP spawn/stop/
+  list API, registry heartbeat, per-host capacity): the host-level
+  unit the multi-host fleet places replicas through;
+* ``placement`` — ``HostedFleet``: the multi-host twin of the fleet —
+  spread/binpack placement across agents, host-death detection
+  (heartbeat loss or refused control connection) and re-placement on
+  survivors under the same restart budget;
+* ``balancer`` — L7 front door: health-checked backend pool from the
+  agent registry + endpoint files, power-of-two-choices on in-flight,
+  binary-frame passthrough, retry-once-on-connect-failure — clients
+  and plain curl need ONE address.
 
 Degradation (resilience subsystem): ``publish`` validates staged weights
 and rejects poisoned tables with ``PublishRejected`` (previous snapshot
@@ -65,9 +76,20 @@ from multiverso_tpu.serving.autoscale import (
     ScaleDecision,
 )
 from multiverso_tpu.serving.batcher import DynamicBatcher, Overloaded, Request
+from multiverso_tpu.serving.balancer import Balancer
 from multiverso_tpu.serving.budget import FleetBudgetSync
-from multiverso_tpu.serving.client import ServingClient, Unrecovered
+from multiverso_tpu.serving.client import (
+    BalancerEndpoints,
+    ServingClient,
+    Unrecovered,
+)
+from multiverso_tpu.serving.hostagent import (
+    AgentClient,
+    HostAgent,
+    read_agents_dir,
+)
 from multiverso_tpu.serving.http_data import DataPlaneServer
+from multiverso_tpu.serving.placement import HostedFleet, choose_host
 from multiverso_tpu.serving.http_health import HealthServer, health_payload
 from multiverso_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from multiverso_tpu.serving.rollout import SnapshotWatcher
@@ -86,7 +108,14 @@ from multiverso_tpu.serving.wire import (
 
 __all__ = [
     "AdmissionController",
+    "AgentClient",
+    "Balancer",
+    "BalancerEndpoints",
     "DataPlaneServer",
+    "HostAgent",
+    "HostedFleet",
+    "choose_host",
+    "read_agents_dir",
     "DynamicBatcher",
     "FleetAutoscaler",
     "FleetBudgetSync",

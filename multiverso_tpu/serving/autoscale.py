@@ -36,11 +36,19 @@ Decision table (``FleetController.propose``), first match wins:
 3. ``warming``       — burning while a spawned replica is still not
    ready: hold (capacity is already on the way; stacking more just
    overshoots the burn).
-4. ``burn_scale_up`` — a burn rule breached: add ONE replica.
-5. ``idle_drain``    — fleet qps under ``idle_qps_per_replica`` x
+4. ``at_capacity``   — burning but the fleet reports no placement
+   headroom (``can_place()`` False: every host agent full or dead):
+   hold with a structured decision instead of crash-looping the
+   launch path; capacity returning un-wedges the next tick.
+5. ``burn_scale_up`` — a burn rule breached: add ONE replica.
+6. ``idle_drain``    — fleet qps under ``idle_qps_per_replica`` x
    replicas for ``idle_decisions`` consecutive evaluations, above
    ``min_replicas``: remove ONE replica.
-6. ``at_min`` / ``steady`` — hold.
+7. ``at_min`` / ``steady`` — hold.
+
+Multi-host: the same loop drives a ``HostedFleet``
+(``serving/placement.py``) untouched — the fleet surface is duck-typed
+and ``scale_to`` places through host agents instead of forking.
 """
 
 from __future__ import annotations
@@ -197,11 +205,14 @@ class FleetController:
         ready: int,
         qps: float,
         burning: Sequence[str] = (),
+        placeable: bool = True,
     ) -> ScaleDecision:
         """One decision from fleet-level inputs: ``replicas`` = active
         slot count, ``ready`` = how many answer /readyz, ``qps`` =
         fleet admitted-rows rate, ``burning`` = breached burn-rule
-        names (from the SLO engine)."""
+        names (from the SLO engine), ``placeable`` = whether the fleet
+        can actually launch one more replica (``fleet.can_place()`` —
+        False when every host agent is full or dead)."""
         burning = sorted(burning)
         cur = int(replicas)
         observed = {
@@ -211,6 +222,7 @@ class FleetController:
             "burning": list(burning),
             "cooldown": self._cooldown,
             "idle_streak": self._idle_streak,
+            "placeable": bool(placeable),
         }
         idle_now = (not burning
                     and qps < self.idle_qps_per_replica * max(cur, 1))
@@ -221,6 +233,12 @@ class FleetController:
             dec = ScaleDecision(HOLD, cur, "at_max", observed)
         elif burning and ready < cur:
             dec = ScaleDecision(HOLD, cur, "warming", observed)
+        elif burning and not placeable:
+            # the burn WOULD scale up, but no host has room: hold with
+            # a structured decision instead of crash-looping the launch
+            # path — capacity returning (or an operator adding a host)
+            # un-wedges the very next tick
+            dec = ScaleDecision(HOLD, cur, "at_capacity", observed)
         elif burning:
             dec = ScaleDecision(
                 ADD, min(cur + 1, self.max_replicas),
@@ -439,9 +457,29 @@ class FleetAutoscaler:
             "fleet:requests", self.qps_window_s
         ).delta_rate()
         ready = self.fleet.ready_count()
+        # multi-host fleets report placement headroom; local fleets
+        # (and bare test doubles) can always fork one more
+        try:
+            placeable = bool(getattr(self.fleet, "can_place",
+                                     lambda: True)())
+        except Exception:  # noqa: BLE001 — a registry hiccup must not
+            placeable = True  # wedge the control loop on HOLD forever
         dec = self.controller.propose(
             replicas=len(active), ready=ready, qps=qps, burning=burning,
+            placeable=placeable,
         )
+        with self._state_lock:
+            prev = self._last_decision
+        if (dec.reason == "at_capacity"
+                and (prev is None or prev.reason != "at_capacity")):
+            # one structured fleet.log event per at-capacity episode,
+            # not one per tick — the hold itself repeats silently
+            ev = getattr(self.fleet, "event", None)
+            if ev is not None:
+                try:
+                    ev("autoscale_at_capacity", **dec.observed)
+                except Exception:  # noqa: BLE001 — observers never
+                    pass           # break the control loop
         if dec.action in (ADD, REMOVE):
             Log.Info(
                 "fleet autoscale: %s -> %d replicas (%s, qps=%.1f)",

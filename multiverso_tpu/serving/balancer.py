@@ -1,0 +1,650 @@
+"""L7 front balancer: ONE address in front of the whole serving fleet.
+
+Until now "load balancing" lived inside ``ServingClient`` (round-robin
++ failover) — fine for our own SDK, useless for plain curl or any
+client that cannot re-read endpoint files. ``python -m
+multiverso_tpu.serving.balancer`` is a real front door, stdlib only:
+
+* **Backend pool** fed by the same discovery channels the fleet
+  already writes: an ``endpoints/`` dir of ``replica-*.json`` files
+  and/or the agent registry (each live agent is asked over its control
+  API which replicas it runs). The pool refreshes on a background
+  prober thread, so autoscaled/re-placed replicas join and drained
+  ones leave with no balancer restart.
+* **Health-checked**: the prober hits every backend's ``/readyz``
+  each ``-balancer_probe_s``; a replica that flips unready (draining,
+  rolling out a bad snapshot, warming) is drained from the pick set
+  immediately — the replica-side drain grace in ``Replica.drain``
+  exists exactly so this prober wins the race.
+* **Power-of-two-choices** on live in-flight counts: two random ready
+  backends, route to the one with fewer requests in flight — near-
+  least-loaded balance without a global scan per request.
+* **Binary passthrough**: the request body (JSON or the MVF1 binary
+  frame) is relayed verbatim — the balancer never decodes a frame on
+  the hot path; headers are forwarded minus hop-by-hop ones, and the
+  response streams back with ``X-MV-Backend`` appended for debugging.
+* **Retry-once-on-connect-failure**: a refused/reset connection
+  *before any response bytes* is retried on a DIFFERENT backend (the
+  request was provably not processed); the failing backend is marked
+  down until the prober clears it. Anything after first response
+  bytes is the client's retry decision, never ours.
+* **Own surface**: ``/readyz`` (200 while >= 1 ready backend),
+  ``/livez``, ``/healthz``, ``/metrics`` (Prometheus text:
+  requests/retries/per-backend in-flight), and
+  ``GET /balancer/v1/backends`` (JSON pool dump — the client's
+  graceful-degradation probe reads it, and so can an operator).
+
+The balancer holds no request state, so running two of them behind a
+DNS name needs nothing new — each keeps its own pool view.
+"""
+
+from __future__ import annotations
+
+import glob
+import http.client
+import json
+import os
+import random
+import signal
+import socket
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from multiverso_tpu.analysis.guards import OrderedLock
+from multiverso_tpu.serving.http_health import flag_port
+from multiverso_tpu.utils.configure import (
+    GetFlag,
+    MV_DEFINE_double,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    ParseCMDFlags,
+)
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["Balancer", "main"]
+
+# hop-by-hop headers are the proxy's own business, never forwarded
+_HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "te", "trailers", "transfer-encoding",
+    "upgrade", "host", "content-length",
+}
+# response headers worth relaying to the client
+_RESP_HEADERS = ("Content-Type", "Retry-After", "X-MV-Conn")
+
+MV_DEFINE_int(
+    "balancer_port", 0,
+    "L7 front balancer: listen port for the one fleet-wide address "
+    "(0 = off, -1 = ephemeral; deploy/multihost_serving.py prints the "
+    "bound address) — serves /v1/* passthrough plus its own /readyz "
+    "/metrics /balancer/v1/backends",
+)
+MV_DEFINE_string(
+    "balancer_endpoints_dir", "",
+    "L7 front balancer: fleet endpoints/ directory to watch for "
+    "replica-*.json backend files (the same files ServingFleet and "
+    "the placement layer write; empty = agents-dir discovery only)",
+)
+MV_DEFINE_string(
+    "balancer_agents_dir", "",
+    "L7 front balancer: host-agent registry directory — every live "
+    "agent is asked over its control API which replicas it runs, so "
+    "backends follow re-placements across hosts (empty = endpoints-"
+    "dir discovery only)",
+)
+MV_DEFINE_double(
+    "balancer_probe_s", 0.5,
+    "L7 front balancer: backend /readyz probe + pool refresh "
+    "interval — a backend whose /readyz flips is drained from the "
+    "pick set within one interval (lower = faster drain, more probe "
+    "traffic)",
+)
+
+
+class _Backend:
+    """One routable replica. ``ready`` is the prober's verdict;
+    ``inflight`` is live request concurrency (the P2C signal)."""
+
+    def __init__(self, url: str):
+        self.url = url
+        self.ready = False
+        self.probed = False   # first probe pending — never pick blind
+        self.inflight = 0
+        self.requests = 0
+        self.failures = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "url": self.url, "ready": self.ready,
+            "inflight": self.inflight, "requests": self.requests,
+            "failures": self.failures,
+        }
+
+
+class Balancer:
+    """Threaded stdlib L7 proxy over the fleet's data plane."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        *,
+        endpoints_dir: Optional[str] = None,
+        agents_dir: Optional[str] = None,
+        backends: Optional[List[str]] = None,
+        probe_s: float = 0.5,
+        probe_timeout_s: float = 1.0,
+        forward_timeout_s: float = 30.0,
+        max_body_bytes: int = 64 << 20,
+        pool_size: int = 8,
+        seed: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        CHECK(
+            endpoints_dir or agents_dir or backends,
+            "balancer needs at least one backend source "
+            "(endpoints_dir, agents_dir or a static list)",
+        )
+        self.host = host
+        self.endpoints_dir = endpoints_dir
+        self.agents_dir = agents_dir
+        self.static_backends = [
+            b.rstrip("/") for b in (backends or [])
+        ]
+        self.probe_s = float(probe_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.pool_size = int(pool_size)
+        self._rng = random.Random(seed)
+        # handler threads (pick/forward) + prober thread share the pool
+        # and counters — one lock (mvlint R9); held only for state
+        # flips, never across network I/O
+        self._lock = OrderedLock("balancer._lock")
+        self._backends: Dict[str, _Backend] = {}
+        # url -> stack of idle keep-alive upstream connections
+        self._conns: Dict[str, List[http.client.HTTPConnection]] = {}
+        self._stats = {
+            "requests": 0, "ok": 0, "retries": 0, "no_backend": 0,
+            "upstream_errors": 0, "probes": 0, "drains": 0,
+        }
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self.port = 0
+        self._requested_port = int(port)
+
+    # --------------------------------------------------------- lifecycle
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "Balancer":
+        CHECK(self._httpd is None, "balancer already started")
+        self.refresh_backends()
+        self.probe_once()  # first pick set before the first request
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # keep-alive toward clients: the client pool reuses us
+            protocol_version = "HTTP/1.1"
+            # small frames both ways: never trade latency for
+            # coalescing. This is a HANDLER-class attribute
+            # (StreamRequestHandler.setup reads it) — setting it on
+            # the server object silently does nothing and costs a
+            # Nagle+delayed-ACK stall per response.
+            disable_nagle_algorithm = True
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                outer._handle_get(self)
+
+            def do_POST(self):  # noqa: N802
+                outer._handle_post(self)
+
+            def log_message(self, *args):  # hot path off stdout
+                pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="mv-balancer",
+        )
+        self._http_thread.start()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name="mv-balancer-probe",
+        )
+        self._probe_thread.start()
+        Log.Info("balancer serving %s (%d backends)",
+                 self.url, len(self._backends))
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        pt = self._probe_thread
+        if pt is not None:
+            pt.join(timeout=self.probe_s * 4 + 5.0)
+            self._probe_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        th = self._http_thread
+        if th is not None:
+            th.join(timeout=5)
+            self._http_thread = None
+        with self._lock:
+            pools = list(self._conns.values())
+            self._conns = {}
+        for pool in pools:
+            for conn in pool:
+                conn.close()
+        Log.Info("balancer stopped")
+
+    # --------------------------------------------------------- discovery
+
+    def _discover(self) -> List[str]:
+        urls: List[str] = list(self.static_backends)
+        if self.endpoints_dir:
+            for p in sorted(glob.glob(
+                os.path.join(self.endpoints_dir, "replica-*.json")
+            )):
+                try:
+                    with open(p, "r", encoding="utf-8") as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if doc.get("url"):
+                    urls.append(str(doc["url"]).rstrip("/"))
+        if self.agents_dir:
+            from multiverso_tpu.serving.hostagent import (
+                AgentClient,
+                AgentUnreachable,
+                read_agents_dir,
+            )
+
+            for info in read_agents_dir(self.agents_dir):
+                try:
+                    reps = AgentClient(
+                        info.url, timeout_s=self.probe_timeout_s
+                    ).replicas()
+                except AgentUnreachable:
+                    continue  # dead host: its replicas are gone too
+                for r in reps:
+                    ep = r.get("endpoint") or {}
+                    if r.get("alive") and ep.get("url"):
+                        urls.append(str(ep["url"]).rstrip("/"))
+        seen: List[str] = []
+        for u in urls:
+            if u not in seen:
+                seen.append(u)
+        return seen
+
+    def refresh_backends(self) -> None:
+        """Reconcile the pool against discovery: new URLs join (picked
+        only after their first successful probe), vanished URLs leave
+        and their idle upstream connections close."""
+        urls = self._discover()
+        with self._lock:
+            for u in urls:
+                if u not in self._backends:
+                    self._backends[u] = _Backend(u)
+            gone = [u for u in self._backends if u not in urls]
+            dead_pools = []
+            for u in gone:
+                self._backends.pop(u)
+                dead_pools.append(self._conns.pop(u, []))
+        for pool in dead_pools:
+            for conn in pool:
+                conn.close()
+
+    # ------------------------------------------------------------ probing
+
+    def probe_once(self) -> None:
+        """One health sweep: every backend's ``/readyz`` answers the
+        ready bit; a flip to unready is a drain (counted)."""
+        with self._lock:
+            targets = list(self._backends.values())
+        for b in targets:
+            ok = self._probe(b.url)
+            with self._lock:
+                self._stats["probes"] += 1
+                if b.probed and b.ready and not ok:
+                    self._stats["drains"] += 1
+                b.probed = True
+                b.ready = ok
+
+    def _probe(self, url: str) -> bool:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"{url}/readyz", timeout=self.probe_timeout_s
+            ) as resp:
+                return resp.status == 200
+        except Exception:  # noqa: BLE001 — any probe failure = drain
+            return False
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.probe_s)
+            if self._stop.is_set():
+                break
+            try:
+                self.refresh_backends()
+                self.probe_once()
+            except Exception as e:  # noqa: BLE001 — a prober death would
+                # freeze the pick set on a stale pool
+                Log.Error("balancer probe survived error: %r", e)
+
+    # -------------------------------------------------------------- pick
+
+    def _pick(self, exclude: Tuple[str, ...] = ()) -> Optional[_Backend]:
+        """Power-of-two-choices: two random ready backends, the one
+        with fewer in-flight requests wins."""
+        with self._lock:
+            ready = [
+                b for b in self._backends.values()
+                if b.ready and b.url not in exclude
+            ]
+            if not ready:
+                return None
+            if len(ready) == 1:
+                return ready[0]
+            a, b = self._rng.sample(ready, 2)
+            return a if a.inflight <= b.inflight else b
+
+    # ------------------------------------------------------------ proxy
+
+    def _conn_get(self, url: str) -> Tuple[http.client.HTTPConnection, bool]:
+        with self._lock:
+            pool = self._conns.setdefault(url, [])
+            if pool:
+                return pool.pop(), True
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        return http.client.HTTPConnection(
+            parts.hostname or "127.0.0.1", parts.port or 80,
+            timeout=self.forward_timeout_s,
+        ), False
+
+    def _conn_put(self, url: str, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            pool = self._conns.setdefault(url, [])
+            if url in self._backends and len(pool) < self.pool_size:
+                pool.append(conn)
+                return
+        conn.close()
+
+    def _forward(
+        self, backend: _Backend, path: str, body: bytes,
+        headers: Dict[str, str],
+    ) -> Tuple[int, List[Tuple[str, str]], bytes]:
+        """Relay one request. Raises ``ConnectionError`` only when the
+        request provably never reached the backend (safe to retry
+        elsewhere); a stale pooled socket is retried once on a fresh
+        connection to the SAME backend first."""
+        for fresh_retry in (False, True):
+            conn, reused = self._conn_get(backend.url)
+            if fresh_retry and reused:
+                # want a provably-fresh socket for the stale retry
+                conn.close()
+                conn, reused = self._conn_get(backend.url)
+                while reused:
+                    conn.close()
+                    conn, reused = self._conn_get(backend.url)
+            try:
+                if conn.sock is None:
+                    # connect eagerly so TCP_NODELAY is on before the
+                    # first byte — small frames must not sit behind
+                    # Nagle (same idiom as the client pool)
+                    conn.connect()
+                    try:
+                        conn.sock.setsockopt(
+                            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                        )
+                    except OSError:
+                        pass
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if reused:
+                    continue  # stale keep-alive socket, not a verdict
+                raise ConnectionError(str(e)) from e
+            out_headers = [
+                (k, resp.headers[k]) for k in _RESP_HEADERS
+                if resp.headers.get(k)
+            ]
+            if resp.will_close:
+                conn.close()
+            else:
+                self._conn_put(backend.url, conn)
+            return resp.status, out_headers, data
+        raise ConnectionError("stale-socket retries exhausted")
+
+    def _handle_post(self, handler: BaseHTTPRequestHandler) -> None:
+        path = handler.path.split("?", 1)[0]
+        if not path.startswith("/v1/"):
+            _send_json(handler, 404, {"error": "unknown_route"})
+            return
+        try:
+            n = int(handler.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            _send_json(handler, 400, {"error": "bad_content_length"})
+            return
+        if n > self.max_body_bytes:
+            _send_json(handler, 413, {"error": "body_too_large"})
+            return
+        try:
+            body = handler.rfile.read(n) if n else b""
+        except OSError:
+            return  # client went away mid-body
+        fwd = {
+            k: v for k, v in handler.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        fwd["Content-Length"] = str(len(body))
+        with self._lock:
+            self._stats["requests"] += 1
+        tried: Tuple[str, ...] = ()
+        for attempt in range(2):
+            b = self._pick(exclude=tried)
+            if b is None:
+                with self._lock:
+                    self._stats["no_backend"] += 1
+                _send_json(
+                    handler, 503,
+                    {"error": "no_backends", "tried": list(tried)},
+                    extra=[("Retry-After", "1")],
+                )
+                return
+            with self._lock:
+                b.inflight += 1
+                b.requests += 1
+            try:
+                status, rhdrs, data = self._forward(b, path, body, fwd)
+            except ConnectionError:
+                # provably unprocessed: the backend never answered.
+                # Mark it down (the prober re-admits it) and retry ONCE
+                # on a different backend.
+                with self._lock:
+                    b.inflight -= 1
+                    b.failures += 1
+                    b.ready = False
+                    self._stats["upstream_errors"] += 1
+                    if attempt == 0:
+                        self._stats["retries"] += 1
+                tried = tried + (b.url,)
+                continue
+            with self._lock:
+                b.inflight -= 1
+                if status < 500:
+                    self._stats["ok"] += 1
+            try:
+                handler.send_response(status)
+                for k, v in rhdrs:
+                    handler.send_header(k, v)
+                handler.send_header("X-MV-Backend", b.url)
+                handler.send_header("Content-Length", str(len(data)))
+                handler.end_headers()
+                handler.wfile.write(data)
+            except OSError:
+                pass  # client went away; upstream already answered
+            return
+        _send_json(
+            handler, 503,
+            {"error": "upstream_unavailable", "tried": list(tried)},
+            extra=[("Retry-After", "1")],
+        )
+
+    # ------------------------------------------------------ own surface
+
+    def _handle_get(self, handler: BaseHTTPRequestHandler) -> None:
+        route = handler.path.split("?", 1)[0]
+        if route == "/livez":
+            _send_json(handler, 200, {"alive": True})
+        elif route == "/readyz":
+            snap = self.backends()
+            ready = sum(1 for b in snap if b["ready"])
+            _send_json(
+                handler, 200 if ready >= 1 else 503,
+                {"ready": ready >= 1, "backends_ready": ready,
+                 "backends": len(snap)},
+            )
+        elif route == "/healthz":
+            _send_json(handler, 200, {
+                "role": "balancer", "stats": self.stats(),
+                "backends": self.backends(),
+            })
+        elif route == "/metrics":
+            body = self._render_metrics().encode()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+        elif route == "/balancer/v1/backends":
+            _send_json(handler, 200, {"backends": self.backends()})
+        else:
+            _send_json(handler, 404, {"error": "unknown_route"})
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def backends(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [b.to_dict() for b in self._backends.values()]
+
+    def _render_metrics(self) -> str:
+        s = self.stats()
+        snap = self.backends()
+        lines = [
+            "# TYPE mv_balancer_requests_total counter",
+            f"mv_balancer_requests_total {s['requests']}",
+            f"mv_balancer_ok_total {s['ok']}",
+            f"mv_balancer_retries_total {s['retries']}",
+            f"mv_balancer_no_backend_total {s['no_backend']}",
+            f"mv_balancer_upstream_errors_total {s['upstream_errors']}",
+            f"mv_balancer_drains_total {s['drains']}",
+            "# TYPE mv_balancer_backends gauge",
+            f"mv_balancer_backends {len(snap)}",
+            "mv_balancer_backends_ready "
+            f"{sum(1 for b in snap if b['ready'])}",
+        ]
+        for b in snap:
+            lbl = f'{{backend="{b["url"]}"}}'
+            lines.append(f"mv_balancer_backend_inflight{lbl} "
+                         f"{b['inflight']}")
+            lines.append(f"mv_balancer_backend_requests_total{lbl} "
+                         f"{b['requests']}")
+        return "\n".join(lines) + "\n"
+
+
+def _send_json(handler: BaseHTTPRequestHandler, code: int,
+               doc: Dict[str, Any],
+               extra: Optional[List[Tuple[str, str]]] = None) -> None:
+    body = json.dumps(doc, default=str).encode()
+    try:
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        for k, v in extra or []:
+            handler.send_header(k, v)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except OSError:
+        pass
+
+
+def balancer_from_flags() -> Optional[Balancer]:
+    port = flag_port(int(GetFlag("balancer_port")))
+    if port is None:
+        return None
+    eps = str(GetFlag("balancer_endpoints_dir")) or None
+    agents = str(GetFlag("balancer_agents_dir")) or None
+    if not eps and not agents:
+        Log.Fatal(
+            "balancer needs -balancer_endpoints_dir and/or "
+            "-balancer_agents_dir to discover backends"
+        )
+    return Balancer(
+        port,
+        endpoints_dir=eps,
+        agents_dir=agents,
+        probe_s=float(GetFlag("balancer_probe_s")),
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    leftover = ParseCMDFlags(list(sys.argv if argv is None else argv))
+    if len(leftover) > 1:
+        Log.Error("balancer: unrecognised argv %s", leftover[1:])
+        return 2
+    bal = balancer_from_flags()
+    if bal is None:
+        Log.Error("-balancer_port=0: nothing to do "
+                  "(use -balancer_port=-1 for ephemeral)")
+        return 2
+    bal.start()
+    # same discovery idiom as replicas: launchers read the bound port
+    # back from the endpoint file
+    marker = os.environ.get("MV_ENDPOINT_FILE")
+    if marker:
+        doc = {
+            "pid": os.getpid(), "host": bal.host,
+            "ports": {"balancer": bal.port}, "url": bal.url,
+            "role": "balancer",
+        }
+        try:
+            d = os.path.dirname(marker)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{marker}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(doc))
+            os.replace(tmp, marker)
+        except OSError as e:
+            Log.Error("balancer endpoint file not written: %s", e)
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    bal.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,7 +91,7 @@ from multiverso_tpu.resilience.outlier import OutlierEjector
 from multiverso_tpu.serving import wire
 from multiverso_tpu.utils.log import CHECK
 
-__all__ = ["ServingClient", "Unrecovered"]
+__all__ = ["BalancerEndpoints", "ServingClient", "Unrecovered"]
 
 
 class Unrecovered(RuntimeError):
@@ -164,6 +164,58 @@ def _read_endpoint_dir(path: str) -> List[str]:
         if url:
             urls.append(str(url))
     return urls
+
+
+class BalancerEndpoints:
+    """``endpoint_source`` for a fleet fronted by an L7 balancer
+    (``serving/balancer.py``): ONE address while the balancer is
+    healthy, degrading gracefully to direct replica endpoints when it
+    is not.
+
+    Each refresh probes the balancer's ``/readyz``: 200 means "route
+    everything through the front door" and the source yields exactly
+    ``[balancer_url]``; anything else (refused connection — balancer
+    process died — or 503 because ITS backend pool is empty) falls
+    back to ``fallback``: an ``endpoints/`` dir path or a callable,
+    the same shapes ``endpoint_source`` already accepts. Because the
+    degrade rides the client's existing refresh machinery, a balancer
+    death mid-call looks like any stale endpoint set: every known
+    endpoint down -> one forced refresh -> direct endpoints -> the
+    attempt budget finishes the call, and the vanished balancer URL is
+    counted in ``stale_endpoints`` like any drained replica. Replicas
+    moving hosts never disturb the client at all while the balancer is
+    up — the front address is the only endpoint it knows."""
+
+    def __init__(
+        self,
+        balancer_url: str,
+        fallback: Optional[Union[str, Callable[[], Sequence[str]]]] = None,
+        *,
+        probe_timeout_s: float = 0.75,
+    ):
+        self.balancer_url = balancer_url.rstrip("/")
+        self._fallback = fallback
+        self.probe_timeout_s = float(probe_timeout_s)
+
+    def _balancer_ready(self) -> bool:
+        try:
+            with urllib.request.urlopen(
+                f"{self.balancer_url}/readyz",
+                timeout=self.probe_timeout_s,
+            ) as resp:
+                return resp.status == 200
+        except Exception:  # noqa: BLE001 — any probe failure = degrade
+            return False
+
+    def __call__(self) -> List[str]:
+        if self._balancer_ready():
+            return [self.balancer_url]
+        fb = self._fallback
+        if fb is None:
+            return []
+        if callable(fb):
+            return list(fb())
+        return _read_endpoint_dir(str(fb))
 
 
 class ServingClient:

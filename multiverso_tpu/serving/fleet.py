@@ -260,6 +260,12 @@ class ServingFleet:
         p = self._procs[index]
         return p.pid if p is not None and p.poll() is None else None
 
+    def can_place(self) -> bool:
+        """Placement headroom (autoscaler ``at_capacity`` input): a
+        local fleet forks on this host, so there is always room for
+        one more — only the multi-host ``HostedFleet`` can run out."""
+        return True
+
     def alive(self) -> int:
         return sum(
             1 for i in range(self.n)

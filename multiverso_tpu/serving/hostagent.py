@@ -1,0 +1,616 @@
+"""Per-host serving agent: the host-level unit of the multi-host fleet.
+
+``ServingFleet`` forks replicas locally, which caps the read path at
+one machine. ``python -m multiverso_tpu.serving.hostagent`` promotes a
+host into a *placement target*: a tiny jax-free control process that
+
+* serves a stdlib HTTP **control API** (``POST /agent/v1/spawn``,
+  ``POST /agent/v1/stop``, ``GET /agent/v1/replicas``,
+  ``GET /agent/v1/health``) through which the placement layer
+  (``serving/placement.py``) launches and drains
+  ``serving.replica`` processes on THIS host;
+* advertises itself in a shared **agents dir** (``agent-<name>.json``,
+  atomic tmp+rename like endpoint files) and rewrites that file every
+  ``-agent_heartbeat_s`` with a monotonically increasing ``seq`` — the
+  fleet judges host death by a stale seq on ITS OWN clock (the same
+  observer-side discipline as ``resilience/watchdog.py``) or by a
+  refused control connection, whichever fires first;
+* enforces a per-host **capacity** (``-agent_capacity``): a spawn over
+  capacity is refused with 409 ``at_capacity`` — the authoritative
+  check, whatever the placement layer believes.
+
+Replicas are spawned in the agent's OWN process group
+(``start_new_session=False``): a SIGKILL of the agent's group is a
+whole-host loss — exactly the failure the host-loss drill injects —
+while individual replicas are still drained gracefully via a direct
+SIGTERM to their pid. Each replica's ``$MV_ENDPOINT_FILE`` lands in
+the agent's private workdir; the endpoint document travels back to the
+fleet through ``GET /agent/v1/replicas`` (the fleet mirrors it into
+its endpoints dir), so nothing but the agents dir needs to be a shared
+filesystem.
+
+Importable pieces: ``HostAgent`` (in-process, injectable
+``command_builder`` so tests spawn stub sleepers instead of jax
+replicas), ``AgentClient`` (the control-API client the fleet and the
+balancer use) and ``read_agents_dir`` (registry scan).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from multiverso_tpu.analysis.guards import OrderedLock
+from multiverso_tpu.serving.http_health import flag_port
+from multiverso_tpu.utils.configure import (
+    GetFlag,
+    MV_DEFINE_double,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    ParseCMDFlags,
+)
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = [
+    "AgentClient",
+    "AgentInfo",
+    "AgentUnreachable",
+    "HostAgent",
+    "main",
+    "read_agents_dir",
+]
+
+_REPLICA_MODULE = "multiverso_tpu.serving.replica"
+
+MV_DEFINE_string(
+    "agent_dir", "",
+    "host agents: shared registry directory — every agent advertises "
+    "itself there as agent-<name>.json (heartbeat seq + control URL) "
+    "and the fleet placement layer / balancer discover hosts by "
+    "scanning it (required by multiverso_tpu.serving.hostagent)",
+)
+MV_DEFINE_int(
+    "agent_port", -1,
+    "host agents: control-API port (0 = off is invalid for an agent, "
+    "-1 = ephemeral — the bound port is advertised through the agent "
+    "registry file, so fixed ports are never needed)",
+)
+MV_DEFINE_int(
+    "agent_capacity", 4,
+    "host agents: max serving replicas this host will run at once — a "
+    "spawn over capacity is refused with 409 at_capacity and the "
+    "placement layer re-places elsewhere (or the autoscaler holds)",
+)
+MV_DEFINE_double(
+    "agent_heartbeat_s", 1.0,
+    "host agents: registry heartbeat rewrite interval — the fleet "
+    "declares a host lost when the advertised seq stops advancing for "
+    "its heartbeat timeout (observer clock), so lower = faster "
+    "host-loss detection, more registry writes",
+)
+MV_DEFINE_string(
+    "agent_name", "",
+    "host agents: registry name (empty = <hostname>-<pid>); drills "
+    "name their simulated hosts host0/host1/... so fleet.log.jsonl "
+    "placement events read like a real topology",
+)
+
+
+class AgentUnreachable(RuntimeError):
+    """Control API did not answer (refused / reset / timed out) — the
+    placement layer treats this exactly like a lost heartbeat."""
+
+
+@dataclass
+class AgentInfo:
+    """One registry entry (``agent-<name>.json``)."""
+
+    name: str
+    url: str
+    host: str
+    pid: int
+    capacity: int
+    seq: int
+    wall: float
+
+    @classmethod
+    def from_doc(cls, doc: Dict[str, Any]) -> "AgentInfo":
+        return cls(
+            name=str(doc.get("name", "")),
+            url=str(doc.get("url", "")).rstrip("/"),
+            host=str(doc.get("host", "")),
+            pid=int(doc.get("pid", 0)),
+            capacity=int(doc.get("capacity", 0)),
+            seq=int(doc.get("seq", 0)),
+            wall=float(doc.get("wall", 0.0)),
+        )
+
+
+def read_agents_dir(path: str) -> List[AgentInfo]:
+    """Scan a registry dir for ``agent-*.json``. Torn/vanishing files
+    (an agent mid-heartbeat or mid-removal) are skipped — the next scan
+    sees the settled state."""
+    import glob
+
+    out: List[AgentInfo] = []
+    for p in sorted(glob.glob(os.path.join(path, "agent-*.json"))):
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        info = AgentInfo.from_doc(doc)
+        if info.name and info.url:
+            out.append(info)
+    return out
+
+
+class AgentClient:
+    """Thin client for one agent's control API. Control traffic is
+    cold-path (a few calls per placement decision), so every call uses
+    a fresh connection — no pool to go stale across an agent restart."""
+
+    def __init__(self, url: str, timeout_s: float = 2.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def _call(self, method: str, route: str,
+              payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(self.url)
+        conn = http.client.HTTPConnection(
+            parts.hostname or "127.0.0.1", parts.port or 80,
+            timeout=self.timeout_s,
+        )
+        body = json.dumps(payload).encode() if payload is not None else None
+        try:
+            conn.request(
+                method, route, body=body,
+                headers={"Content-Type": "application/json"}
+                if body is not None else {},
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise AgentUnreachable(f"{self.url}{route}: {e!r}") from e
+        finally:
+            conn.close()
+        try:
+            doc = json.loads(raw) if raw else {}
+        except ValueError:
+            doc = {"error": raw.decode("utf-8", "replace")}
+        if resp.status >= 300:
+            doc.setdefault("error", f"http_{resp.status}")
+            doc["status"] = resp.status
+            return doc
+        doc["status"] = resp.status
+        return doc
+
+    def health(self) -> Dict[str, Any]:
+        return self._call("GET", "/agent/v1/health")
+
+    def replicas(self) -> List[Dict[str, Any]]:
+        return list(self._call("GET", "/agent/v1/replicas")["replicas"])
+
+    def spawn(self, slot: int, checkpoint_root: str,
+              extra_argv: Sequence[str] = (),
+              env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        """Ask the agent to launch one replica for fleet slot ``slot``.
+        Returns the response doc; ``doc["status"] == 409`` means the
+        host is at capacity (authoritative — re-place elsewhere)."""
+        return self._call("POST", "/agent/v1/spawn", {
+            "slot": int(slot),
+            "checkpoint_root": str(checkpoint_root),
+            "extra_argv": list(extra_argv),
+            "env": dict(env or {}),
+        })
+
+    def stop_replica(self, slot: int,
+                     grace_s: float = 10.0) -> Dict[str, Any]:
+        return self._call("POST", "/agent/v1/stop", {
+            "slot": int(slot), "grace_s": float(grace_s),
+        })
+
+
+class _Managed:
+    """One replica this agent launched (slot is the FLEET slot index —
+    globally unique, never reused, keys the endpoint/log/trace lanes)."""
+
+    def __init__(self, slot: int, proc: subprocess.Popen,
+                 endpoint_file: str, log_path: str):
+        self.slot = slot
+        self.proc = proc
+        self.endpoint_file = endpoint_file
+        self.log_path = log_path
+
+    def report(self) -> Dict[str, Any]:
+        rc = self.proc.poll()
+        doc: Dict[str, Any] = {
+            "slot": self.slot,
+            "pid": self.proc.pid,
+            "alive": rc is None,
+            "rc": rc,
+            "log": self.log_path,
+            "endpoint": None,
+        }
+        try:
+            with open(self.endpoint_file, "r", encoding="utf-8") as f:
+                doc["endpoint"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+        return doc
+
+
+class HostAgent:
+    """The per-host control process. ``start()`` binds the control API
+    and begins heartbeating into ``agents_dir``; ``stop()`` drains every
+    replica it launched, removes its registry entry and joins all
+    threads (mvlint R4)."""
+
+    def __init__(
+        self,
+        agents_dir: str,
+        *,
+        name: Optional[str] = None,
+        capacity: int = 4,
+        port: int = 0,
+        heartbeat_s: float = 1.0,
+        workdir: Optional[str] = None,
+        python: str = sys.executable,
+        command_builder: Optional[
+            Callable[[Dict[str, Any]], List[str]]
+        ] = None,
+        exit_grace_s: float = 10.0,
+        env: Optional[Dict[str, str]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        CHECK(capacity >= 1, "agent capacity must be >= 1")
+        self.agents_dir = str(agents_dir)
+        self.name = name or f"{socket.gethostname()}-{os.getpid()}"
+        self.capacity = int(capacity)
+        self.heartbeat_s = float(heartbeat_s)
+        self.workdir = workdir or os.path.join(
+            self.agents_dir, f"{self.name}.work"
+        )
+        self.python = python
+        self.exit_grace_s = float(exit_grace_s)
+        self._env = dict(env) if env is not None else dict(os.environ)
+        self._clock = clock
+        self._sleep = sleep
+        self._command_builder = command_builder or self._replica_command
+        # handler threads (spawn/stop/list) + heartbeat thread + stop()
+        # all touch the replica table and seq — one lock (mvlint R9)
+        self._lock = OrderedLock("hostagent._lock")
+        self._replicas: Dict[int, _Managed] = {}
+        self._seq = 0
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self.host = "127.0.0.1"
+        self.port = 0
+        self._requested_port = int(port)
+        os.makedirs(self.agents_dir, exist_ok=True)
+        os.makedirs(self.workdir, exist_ok=True)
+
+    # --------------------------------------------------------- lifecycle
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def registry_file(self) -> str:
+        return os.path.join(self.agents_dir, f"agent-{self.name}.json")
+
+    def start(self) -> "HostAgent":
+        CHECK(self._httpd is None, "agent already started")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            # handler-class attribute (StreamRequestHandler.setup):
+            # control responses are small JSON — no Nagle stalls for
+            # the fleet's per-poll replica listing
+            disable_nagle_algorithm = True
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                route = self.path.split("?", 1)[0]
+                if route == "/agent/v1/health":
+                    _respond(self, 200, outer._health_doc())
+                elif route == "/agent/v1/replicas":
+                    _respond(self, 200,
+                             {"replicas": outer._replica_reports()})
+                else:
+                    _respond(self, 404, {"error": "unknown_route"})
+
+            def do_POST(self):  # noqa: N802
+                route = self.path.split("?", 1)[0]
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    spec = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, OSError):
+                    _respond(self, 400, {"error": "bad_json"})
+                    return
+                if route == "/agent/v1/spawn":
+                    code, doc = outer._api_spawn(spec)
+                elif route == "/agent/v1/stop":
+                    code, doc = outer._api_stop(spec)
+                else:
+                    code, doc = 404, {"error": "unknown_route"}
+                _respond(self, code, doc)
+
+            def log_message(self, *args):  # control chatter off stdout
+                pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"mv-agent-{self.name}",
+        )
+        self._http_thread.start()
+        self._write_registry()  # advertise before the first heartbeat
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"mv-agent-hb-{self.name}",
+        )
+        self._hb_thread.start()
+        Log.Info("host agent %s serving %s (capacity %d)",
+                 self.name, self.url, self.capacity)
+        return self
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.heartbeat_s)
+            if self._stop.is_set():
+                break
+            self._write_registry()
+
+    def _write_registry(self) -> None:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        doc = {
+            "name": self.name,
+            "url": self.url,
+            "host": self.host,
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "seq": seq,
+            "wall": time.time(),
+        }
+        path = self.registry_file()
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(doc))
+            os.replace(tmp, path)
+        except OSError as e:
+            Log.Error("agent %s registry write failed: %s", self.name, e)
+
+    # --------------------------------------------------------- API verbs
+
+    def _health_doc(self) -> Dict[str, Any]:
+        with self._lock:
+            running = sum(
+                1 for m in self._replicas.values()
+                if m.proc.poll() is None
+            )
+            seq = self._seq
+        return {
+            "name": self.name, "host": self.host, "pid": os.getpid(),
+            "capacity": self.capacity, "running": running, "seq": seq,
+        }
+
+    def _replica_reports(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            managed = list(self._replicas.values())
+        return [m.report() for m in managed]
+
+    def running_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for m in self._replicas.values()
+                if m.proc.poll() is None
+            )
+
+    def _replica_command(self, spec: Dict[str, Any]) -> List[str]:
+        """Default command: one ``serving.replica`` on ephemeral ports
+        (the endpoint file reports what the kernel picked)."""
+        root = str(spec.get("checkpoint_root", ""))
+        CHECK(bool(root), "spawn spec needs checkpoint_root")
+        return [
+            self.python, "-m", _REPLICA_MODULE,
+            f"-serve_checkpoint_dir={root}",
+            "-data_port=-1",
+            "-health_port=-1",
+            *[str(a) for a in spec.get("extra_argv", [])],
+        ]
+
+    def _api_spawn(self, spec: Dict[str, Any]) -> Any:
+        try:
+            slot = int(spec["slot"])
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "spawn spec needs an integer slot"}
+        try:
+            argv = self._command_builder(spec)
+        except Exception as e:  # noqa: BLE001 — a bad spec must answer
+            return 400, {"error": f"bad_spec: {e}"}  # 400, not 500
+        ep = os.path.join(self.workdir, f"replica-{slot}.json")
+        log_path = os.path.join(self.workdir, f"replica-{slot}.log")
+        env = dict(self._env)
+        env.update({str(k): str(v)
+                    for k, v in dict(spec.get("env") or {}).items()})
+        env["MV_ENDPOINT_FILE"] = ep
+        env.pop("MV_READY_FILE", None)  # readiness is probed over HTTP
+        # same lane discipline as ServingFleet._spawn: the fleet slot
+        # keys race-report dumps; 1+slot keeps trace lane 0 for drivers
+        env["MV_RANK"] = str(slot)
+        env["MV_TRACE_RANK"] = str(1 + slot)
+        with self._lock:
+            live = sum(
+                1 for m in self._replicas.values()
+                if m.proc.poll() is None
+            )
+            if live >= self.capacity:
+                return 409, {
+                    "error": "at_capacity",
+                    "capacity": self.capacity, "running": live,
+                }
+            prev = self._replicas.get(slot)
+            if prev is not None and prev.proc.poll() is None:
+                return 409, {"error": "slot_busy", "slot": slot}
+            try:
+                os.remove(ep)  # a stale doc must not advertise old ports
+            except OSError:
+                pass
+            try:
+                logf = open(log_path, "a")
+                # NO new session: replicas fate-share the agent's process
+                # group, so a SIGKILL of the group is a whole-host loss
+                proc = subprocess.Popen(
+                    argv, stdout=logf, stderr=subprocess.STDOUT, env=env,
+                    start_new_session=False,
+                )
+                logf.close()
+            except OSError as e:
+                return 500, {"error": f"spawn_failed: {e}"}
+            self._replicas[slot] = _Managed(slot, proc, ep, log_path)
+        Log.Info("agent %s spawned slot %d pid %d",
+                 self.name, slot, proc.pid)
+        return 200, {"slot": slot, "pid": proc.pid, "log": log_path}
+
+    def _api_stop(self, spec: Dict[str, Any]) -> Any:
+        try:
+            slot = int(spec["slot"])
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "stop spec needs an integer slot"}
+        grace_s = float(spec.get("grace_s", self.exit_grace_s))
+        with self._lock:
+            m = self._replicas.get(slot)
+        if m is None:
+            return 404, {"error": "unknown_slot", "slot": slot}
+        rc = self._drain(m, grace_s)
+        with self._lock:
+            self._replicas.pop(slot, None)
+        return 200, {"slot": slot, "rc": rc}
+
+    def _drain(self, m: _Managed, grace_s: float) -> Optional[int]:
+        """Replica-side graceful drain: endpoint file removed first
+        (discovery stops advertising), direct SIGTERM to the replica
+        pid (same process group as the agent — killpg would be
+        suicide), SIGKILL after the grace."""
+        try:
+            os.remove(m.endpoint_file)
+        except OSError:
+            pass
+        if m.proc.poll() is not None:
+            return m.proc.poll()
+        try:
+            os.kill(m.proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        deadline = self._clock() + grace_s
+        while m.proc.poll() is None and self._clock() < deadline:
+            self._sleep(0.05)
+        if m.proc.poll() is None:
+            try:
+                os.kill(m.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+            try:
+                m.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+        return m.proc.poll()
+
+    # ---------------------------------------------------------- shutdown
+
+    def stop(self) -> None:
+        """Graceful host drain: every managed replica SIGTERM->SIGKILL,
+        registry entry removed (peers see a clean deregistration, not a
+        heartbeat timeout), control server and threads joined."""
+        self._stop.set()
+        hb = self._hb_thread
+        if hb is not None:
+            hb.join(timeout=self.heartbeat_s * 4 + 5.0)
+            self._hb_thread = None
+        with self._lock:
+            managed = list(self._replicas.values())
+            self._replicas = {}
+        for m in managed:
+            self._drain(m, self.exit_grace_s)
+        try:
+            os.remove(self.registry_file())
+        except OSError:
+            pass
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        th = self._http_thread
+        if th is not None:
+            th.join(timeout=5)
+            self._http_thread = None
+        Log.Info("host agent %s stopped", self.name)
+
+
+def _respond(handler: BaseHTTPRequestHandler, code: int,
+             doc: Dict[str, Any]) -> None:
+    body = json.dumps(doc, default=str).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def agent_from_flags() -> HostAgent:
+    agents_dir = str(GetFlag("agent_dir"))
+    if not agents_dir:
+        Log.Fatal("-agent_dir is required for a host agent")
+    port = flag_port(int(GetFlag("agent_port")))
+    if port is None:
+        Log.Fatal("-agent_port=0 disables the control API — an agent "
+                  "without one cannot place replicas "
+                  "(use -agent_port=-1 for ephemeral)")
+    return HostAgent(
+        agents_dir,
+        name=str(GetFlag("agent_name")) or None,
+        capacity=int(GetFlag("agent_capacity")),
+        port=port,
+        heartbeat_s=float(GetFlag("agent_heartbeat_s")),
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    leftover = ParseCMDFlags(list(sys.argv if argv is None else argv))
+    if len(leftover) > 1:
+        Log.Error("hostagent: unrecognised argv %s", leftover[1:])
+        return 2
+    agent = agent_from_flags().start()
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    agent.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

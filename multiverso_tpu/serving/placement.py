@@ -1,0 +1,641 @@
+"""Placement layer: the multi-host twin of ``ServingFleet``.
+
+``HostedFleet`` keeps the exact duck-typed surface the autoscaler,
+drills and clients already speak (``active_indices`` / ``ready_count``
+/ ``endpoint`` / ``endpoints_dir`` / ``scale_to`` / ``poll_once`` /
+``watch`` / ``stop`` / ``event``), but instead of forking replicas it
+**places** them through per-host agents (``serving/hostagent.py``)
+discovered from a shared agents dir:
+
+* **Placement policy** — ``spread`` (default): anti-affinity, the
+  least-loaded host wins, so one host loss takes the fewest replicas
+  with it; ``binpack``: the fullest host that still has room wins, so
+  idle hosts can be returned to the pool. Pure function
+  (``choose_host``) over (capacity, load) snapshots — unit-testable
+  without a single process.
+* **Host-death detection** — an agent is lost when its registry
+  heartbeat ``seq`` stops advancing for ``heartbeat_timeout_s`` on the
+  FLEET's monotonic clock (never the agent's — same observer-side
+  discipline as ``resilience/watchdog.py``) OR when its control API
+  refuses the connection, whichever fires first. Every replica on a
+  lost host is marked lost and **re-placed on the survivors** under
+  the same ``RestartBudget`` machinery the local fleet uses.
+* **Discovery mirror** — agents report each replica's endpoint
+  document over the control API; the fleet mirrors the docs into its
+  own ``endpoints/`` dir (atomic tmp+rename), so ``ServingClient``'s
+  ``endpoint_source``, the balancer's dir feed and the autoscaler
+  scrape keep working unchanged whether replicas are local or remote.
+* **Capacity back-pressure** — ``can_place()`` tells the autoscaler
+  whether ANY live host has room; an un-placeable slot parks as
+  ``pending`` (retried each poll, no budget burn) instead of
+  crash-looping, and the controller holds with an ``at_capacity``
+  decision.
+
+Every placement/host event (``agent_seen`` / ``agent_lost`` /
+``replica_place`` / ``replica_lost`` / ``placement_pending`` / ...)
+lands in ``fleet.log.jsonl`` + the flight recorder, exactly like the
+local fleet's lifecycle events — one log tells the whole story.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from multiverso_tpu.analysis.guards import OrderedLock
+from multiverso_tpu.resilience.supervisor import RestartBudget
+from multiverso_tpu.serving.hostagent import (
+    AgentClient,
+    AgentInfo,
+    AgentUnreachable,
+    read_agents_dir,
+)
+from multiverso_tpu.utils.log import CHECK, Log
+
+__all__ = ["HostedFleet", "choose_host"]
+
+POLICIES = ("spread", "binpack")
+
+
+def choose_host(
+    capacity: Dict[str, int],
+    load: Dict[str, int],
+    policy: str = "spread",
+) -> Optional[str]:
+    """Pick a host for one replica from ``{name: capacity}`` and
+    ``{name: current load}`` snapshots. ``spread`` minimises the blast
+    radius of a host loss (least-loaded wins); ``binpack`` fills hosts
+    in turn (fullest-with-room wins). Ties break on name so the choice
+    is deterministic. ``None`` = every host is full (at capacity)."""
+    CHECK(policy in POLICIES, f"unknown placement policy {policy!r}")
+    fits = [
+        name for name, cap in capacity.items()
+        if load.get(name, 0) < cap
+    ]
+    if not fits:
+        return None
+    if policy == "spread":
+        return min(fits, key=lambda n: (load.get(n, 0), n))
+    return min(fits, key=lambda n: (-load.get(n, 0), n))
+
+
+class _Slot:
+    """One fleet slot (index is global and never reused). ``agent`` is
+    the host currently responsible for it; ``pending`` means the slot
+    wants a replica but no host had room at last attempt."""
+
+    def __init__(self) -> None:
+        self.agent: Optional[str] = None
+        self.pid: Optional[int] = None
+        self.abandoned = False
+        self.retired = False
+        self.pending = True
+
+
+class _AgentWatch:
+    """Observer-side heartbeat bookkeeping for one agent."""
+
+    def __init__(self, info: AgentInfo, now: float) -> None:
+        self.info = info
+        self.last_seq = info.seq
+        self.last_change = now  # fleet monotonic at last NEW seq
+        self.lost = False
+
+
+class HostedFleet:
+    """Place/supervise N serving replicas across host agents."""
+
+    def __init__(
+        self,
+        replicas: int,
+        checkpoint_root: str,
+        *,
+        agents_dir: str,
+        log_dir: str,
+        extra_argv: Sequence[str] = (),
+        policy: str = "spread",
+        max_restarts: int = 5,
+        restart_window_s: float = 600.0,
+        backoff_base_s: float = 0.25,
+        backoff_max_s: float = 5.0,
+        seed: int = 0,
+        poll_s: float = 0.25,
+        exit_grace_s: float = 10.0,
+        heartbeat_timeout_s: float = 3.0,
+        control_timeout_s: float = 2.0,
+        replica_env: Optional[Dict[str, str]] = None,
+        client_factory: Optional[Callable[[str], Any]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        CHECK(replicas >= 1, "fleet needs >= 1 replica")
+        CHECK(policy in POLICIES, f"unknown placement policy {policy!r}")
+        self.n = int(replicas)
+        self.root = str(checkpoint_root)
+        self.agents_dir = str(agents_dir)
+        self.log_dir = str(log_dir)
+        self.extra_argv = list(extra_argv)
+        self.policy = policy
+        self.poll_s = float(poll_s)
+        self.exit_grace_s = float(exit_grace_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.control_timeout_s = float(control_timeout_s)
+        self.replica_env = dict(replica_env or {})
+        self._client_factory = client_factory or (
+            lambda url: AgentClient(url, timeout_s=self.control_timeout_s)
+        )
+        self._clock = clock
+        self._sleep = sleep
+        self._budget = RestartBudget(
+            max_restarts=max_restarts, window_s=restart_window_s,
+            base_delay_s=backoff_base_s, max_delay_s=backoff_max_s,
+            seed=seed, clock=clock,
+        )
+        self._slots: List[_Slot] = [_Slot() for _ in range(self.n)]
+        self._watch: Dict[str, _AgentWatch] = {}
+        # endpoint-doc mirror cache: slot -> last JSON written, so an
+        # unchanged doc costs no filesystem write per poll
+        self._mirrored: Dict[int, str] = {}
+        # serialises scale_to() callers (autoscaler thread vs operator
+        # CLI) — slot list only ever APPENDS under it (same discipline
+        # as ServingFleet)
+        self._scale_lock = OrderedLock("hostedfleet._scale_lock")
+        self.restarts = 0
+        # watch thread increments, stop() reads after a bounded join
+        self._restart_lock = OrderedLock("hostedfleet._restart_lock")
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        os.makedirs(self.log_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.log_dir, "endpoints"), exist_ok=True)
+
+    # ------------------------------------------------------------ events
+
+    def _event(self, kind: str, **fields: Any) -> None:
+        rec = {"wall": time.time(), "event": kind, **fields}
+        try:
+            with open(os.path.join(self.log_dir, "fleet.log.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:
+            Log.Error("fleet event log write failed: %s", e)
+        from multiverso_tpu.obs import recorder
+
+        recorder.record(f"fleet_{kind}", **fields)
+
+    def event(self, kind: str, **fields: Any) -> None:
+        """Public append to ``fleet.log.jsonl`` for OBSERVED events
+        (client-side failover hooks etc.) — same contract as
+        ``ServingFleet.event``."""
+        self._event(kind, **fields)
+
+    # ------------------------------------------------------------ agents
+
+    def _scan_agents(self) -> List[str]:
+        """Registry scan + heartbeat judgement. Returns the live agent
+        names; transitions (new agent, lost agent) are evented and a
+        lost agent's slots are marked for re-placement."""
+        now = self._clock()
+        seen: Dict[str, AgentInfo] = {
+            info.name: info for info in read_agents_dir(self.agents_dir)
+        }
+        for name, info in seen.items():
+            w = self._watch.get(name)
+            if w is None:
+                self._watch[name] = _AgentWatch(info, now)
+                self._event(
+                    "agent_seen", agent=name, url=info.url,
+                    capacity=info.capacity,
+                )
+                continue
+            w.info = info
+            fresh = info.seq != w.last_seq
+            if fresh:
+                w.last_seq = info.seq
+                w.last_change = now
+            if w.lost and fresh:
+                # a host came back (agent restarted): a NEW heartbeat
+                # seq makes it placeable again. A not-yet-stale file is
+                # not enough — a SIGKILLed agent's last write would flap
+                # the host recovered->lost each poll until staleness.
+                w.lost = False
+                self._event("agent_recovered", agent=name, url=info.url)
+        live: List[str] = []
+        for name, w in self._watch.items():
+            if w.lost:
+                continue
+            gone = name not in seen
+            stale = now - w.last_change > self.heartbeat_timeout_s
+            if gone or stale:
+                self._mark_agent_lost(
+                    name, "deregistered" if gone else "heartbeat_stale"
+                )
+                continue
+            live.append(name)
+        return live
+
+    def _mark_agent_lost(self, name: str, reason: str) -> None:
+        w = self._watch.get(name)
+        if w is None or w.lost:
+            return
+        w.lost = True
+        lost_slots = [
+            i for i, s in enumerate(self._slots)
+            if s.agent == name and not s.retired and not s.abandoned
+        ]
+        self._event(
+            "agent_lost", agent=name, reason=reason,
+            replicas_lost=lost_slots,
+        )
+        Log.Error(
+            "fleet: host agent %s lost (%s) — re-placing replicas %s",
+            name, reason, lost_slots,
+        )
+        for i in lost_slots:
+            s = self._slots[i]
+            self._event(
+                "replica_lost", replica=i, agent=name, pid=s.pid,
+            )
+            s.agent = None
+            s.pid = None
+            self._unmirror(i)
+            if self._stop.is_set():
+                continue
+            # a host loss is N restarts against the SAME budget the
+            # local fleet uses — a flapping host cannot respawn forever
+            if self._budget.exhausted():
+                s.abandoned = True
+                self._event(
+                    "replica_give_up", replica=i,
+                    restarts_in_window=self._budget.used(),
+                )
+                continue
+            delay = self._budget.spend()
+            with self._restart_lock:
+                self.restarts += 1
+            self._event(
+                "replica_relaunch", replica=i, agent=name,
+                backoff_s=round(delay, 3),
+            )
+            self._sleep(delay)
+            s.pending = True  # placed by the pending pass this poll
+
+    def _live_capacity(self) -> Dict[str, int]:
+        return {
+            name: w.info.capacity
+            for name, w in self._watch.items() if not w.lost
+        }
+
+    def _load(self) -> Dict[str, int]:
+        """Our view of slots-per-agent (placed, not retired/abandoned)."""
+        load: Dict[str, int] = {}
+        for s in self._slots:
+            if s.agent and not s.retired and not s.abandoned:
+                load[s.agent] = load.get(s.agent, 0) + 1
+        return load
+
+    def agents(self) -> List[str]:
+        """Live agent names (post last poll)."""
+        return [n for n, w in self._watch.items() if not w.lost]
+
+    def can_place(self) -> bool:
+        """Whether ANY live host has room for one more replica — the
+        autoscaler's ``at_capacity`` input."""
+        return choose_host(
+            self._live_capacity(), self._load(), self.policy
+        ) is not None
+
+    # --------------------------------------------------------- placement
+
+    def _try_place(self, index: int) -> bool:
+        """One placement attempt for slot ``index``. False = no host
+        had room (slot stays pending — no budget burn; capacity may
+        return next poll)."""
+        s = self._slots[index]
+        name = choose_host(self._live_capacity(), self._load(), self.policy)
+        if name is None:
+            if not s.pending:
+                s.pending = True
+            return False
+        w = self._watch[name]
+        client = self._client_factory(w.info.url)
+        try:
+            doc = client.spawn(
+                index, self.root,
+                extra_argv=self.extra_argv, env=self.replica_env,
+            )
+        except AgentUnreachable as e:
+            # the host died between the scan and the spawn: judge it now
+            # so the retry (next poll) sees an honest live set
+            self._mark_agent_lost(name, f"unreachable: {e}")
+            return False
+        if doc.get("status") == 409:
+            # the agent's own capacity check is authoritative — our load
+            # view was stale (another fleet, or a replica we lost track
+            # of). Count it full locally and try the next-best host.
+            self._event(
+                "placement_refused", replica=index, agent=name,
+                error=doc.get("error"),
+            )
+            return False
+        if doc.get("status", 0) >= 300:
+            self._event(
+                "placement_error", replica=index, agent=name,
+                error=doc.get("error"),
+            )
+            return False
+        s.agent = name
+        s.pid = int(doc.get("pid", 0)) or None
+        s.pending = False
+        self._event(
+            "replica_place", replica=index, agent=name, pid=s.pid,
+            policy=self.policy,
+        )
+        return True
+
+    def start(self) -> "HostedFleet":
+        """Scan the registry and place every slot. Slots that cannot be
+        placed yet (agents still booting, or at capacity) park as
+        pending and are retried by ``poll_once``/``watch``."""
+        self._scan_agents()
+        placed = 0
+        for i in range(self.n):
+            if self._try_place(i):
+                placed += 1
+        if placed < self.n:
+            self._event(
+                "placement_pending", requested=self.n, placed=placed,
+            )
+        return self
+
+    # --------------------------------------------------------- discovery
+
+    def endpoint_file(self, index: int) -> str:
+        return os.path.join(
+            self.log_dir, "endpoints", f"replica-{index}.json"
+        )
+
+    def _mirror(self, index: int, doc: Dict[str, Any]) -> None:
+        blob = json.dumps(doc)
+        if self._mirrored.get(index) == blob:
+            return
+        path = self.endpoint_file(index)
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+            self._mirrored[index] = blob
+        except OSError as e:
+            Log.Error("endpoint mirror %s failed: %s", path, e)
+
+    def _unmirror(self, index: int) -> None:
+        self._mirrored.pop(index, None)
+        try:
+            os.remove(self.endpoint_file(index))
+        except OSError:
+            pass
+
+    def endpoint(self, index: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.endpoint_file(index)) as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def endpoints(self) -> List[str]:
+        urls = []
+        for i in range(self.n):
+            if self._slots[i].retired:
+                continue
+            doc = self.endpoint(i)
+            if doc and doc.get("url"):
+                urls.append(doc["url"])
+        return urls
+
+    def endpoints_dir(self) -> str:
+        return os.path.join(self.log_dir, "endpoints")
+
+    def active_indices(self) -> List[int]:
+        return [
+            i for i, s in enumerate(self._slots)
+            if not s.abandoned and not s.retired
+        ]
+
+    def pid(self, index: int) -> Optional[int]:
+        s = self._slots[index]
+        return s.pid if s.agent is not None else None
+
+    def alive(self) -> int:
+        return sum(
+            1 for s in self._slots
+            if not s.retired and not s.abandoned and s.agent is not None
+        )
+
+    def ready_count(self) -> int:
+        return sum(1 for i in self.active_indices() if self._ready(i))
+
+    def _ready(self, index: int, timeout_s: float = 1.0) -> bool:
+        import urllib.request
+
+        doc = self.endpoint(index)
+        if not doc:
+            return False
+        try:
+            with urllib.request.urlopen(
+                f"{doc['url']}/readyz", timeout=timeout_s
+            ) as resp:
+                return resp.status == 200
+        except Exception:  # noqa: BLE001 — any probe failure = not ready
+            return False
+
+    def wait_ready(self, timeout_s: float = 60.0) -> bool:
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
+            self.poll_once()
+            if all(
+                s.abandoned or s.retired or
+                (s.agent is not None and self._ready(i))
+                for i, s in enumerate(self._slots)
+            ):
+                return True
+            self._sleep(self.poll_s)
+        return False
+
+    # ----------------------------------------------------------- healing
+
+    def poll_once(self) -> None:
+        """One supervision pass: judge agents, reconcile each live
+        agent's replica reports against our slots (mirroring endpoint
+        docs), heal replica deaths under the budget and retry pending
+        placements. Deterministic for tests — no sleeping beyond the
+        spent backoff delay."""
+        live = self._scan_agents()
+        reports: Dict[str, Dict[int, Dict[str, Any]]] = {}
+        for name in live:
+            w = self._watch[name]
+            client = self._client_factory(w.info.url)
+            try:
+                reports[name] = {
+                    int(r["slot"]): r for r in client.replicas()
+                }
+            except (AgentUnreachable, KeyError, TypeError, ValueError) as e:
+                self._mark_agent_lost(name, f"unreachable: {e}")
+        for i, s in enumerate(self._slots):
+            if s.retired or s.abandoned or s.agent is None:
+                continue
+            w = self._watch.get(s.agent)
+            if w is None or w.lost:
+                continue  # _mark_agent_lost already queued re-placement
+            rep = reports.get(s.agent, {}).get(i)
+            if rep is None:
+                # the agent no longer knows the slot (agent restarted
+                # fresh under the same name): treat as an exit
+                self._on_replica_exit(i, rc=None)
+                continue
+            if rep.get("alive"):
+                s.pid = rep.get("pid", s.pid)
+                ep = rep.get("endpoint")
+                if ep:
+                    self._mirror(i, ep)
+            else:
+                self._on_replica_exit(i, rc=rep.get("rc"))
+        # pending slots: placement retries are free (capacity may have
+        # returned); budget was charged when the loss was healed
+        for i, s in enumerate(self._slots):
+            if s.pending and not s.retired and not s.abandoned:
+                self._try_place(i)
+
+    def _on_replica_exit(self, index: int, rc: Optional[int]) -> None:
+        s = self._slots[index]
+        self._event(
+            "replica_exit", replica=index, agent=s.agent, rc=rc,
+        )
+        self._unmirror(index)
+        s.agent = None
+        s.pid = None
+        if self._stop.is_set():
+            return  # shutdown in progress: exits are expected
+        if self._budget.exhausted():
+            s.abandoned = True
+            self._event(
+                "replica_give_up", replica=index,
+                restarts_in_window=self._budget.used(),
+            )
+            Log.Error(
+                "fleet: restart budget exhausted, replica %d stays down "
+                "(fleet degrades to %d)", index, self.alive(),
+            )
+            return
+        delay = self._budget.spend()
+        with self._restart_lock:
+            self.restarts += 1
+        self._event(
+            "replica_relaunch", replica=index, rc=rc,
+            backoff_s=round(delay, 3),
+        )
+        self._sleep(delay)
+        self._try_place(index)
+
+    def watch(self) -> "HostedFleet":
+        CHECK(self._watch_thread is None, "fleet watch already running")
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — the healer never
+                    # dies; a dead watch turns one host loss into an
+                    # outage
+                    Log.Error("hosted fleet watch survived error: %r", e)
+                self._stop.wait(self.poll_s)
+
+        self._watch_thread = threading.Thread(
+            target=run, daemon=True, name="mv-hostedfleet-watch"
+        )
+        self._watch_thread.start()
+        return self
+
+    # ----------------------------------------------------------- scaling
+
+    def scale_to(self, target: int, reason: str = "manual") -> List[int]:
+        """Same contract as ``ServingFleet.scale_to``: growth appends
+        fresh slots (placed through the policy; an un-placeable slot
+        parks pending), shrink drains the newest active replicas
+        through their agents."""
+        CHECK(target >= 1, "fleet cannot scale below 1 replica")
+        with self._scale_lock:
+            active = self.active_indices()
+            if target == len(active):
+                return []
+            touched: List[int] = []
+            if target > len(active):
+                for _ in range(target - len(active)):
+                    i = self.n
+                    self._slots.append(_Slot())
+                    self.n = len(self._slots)
+                    self._try_place(i)
+                    touched.append(i)
+                self._event(
+                    "scale_up", reason=reason, replicas=target,
+                    spawned=touched,
+                )
+            else:
+                for i in reversed(active):
+                    if len(active) - len(touched) <= target:
+                        break
+                    self._drain_slot(i)
+                    touched.append(i)
+                self._event(
+                    "scale_down", reason=reason, replicas=target,
+                    drained=touched,
+                )
+            return touched
+
+    def _drain_slot(self, index: int) -> None:
+        s = self._slots[index]
+        s.retired = True  # before the stop: poll_once skips it
+        self._unmirror(index)
+        if s.agent is None:
+            return
+        w = self._watch.get(s.agent)
+        if w is not None and not w.lost:
+            client = self._client_factory(w.info.url)
+            try:
+                client.stop_replica(index, grace_s=self.exit_grace_s)
+            except AgentUnreachable:
+                pass  # host gone anyway — nothing left to drain
+        self._event("replica_drain", replica=index, agent=s.agent)
+        s.agent = None
+        s.pid = None
+
+    # ---------------------------------------------------------- shutdown
+
+    def stop(self) -> None:
+        """Drain every placed replica through its agent; agents
+        themselves belong to their launcher and stay up."""
+        self._stop.set()
+        th = self._watch_thread
+        if th is not None:
+            th.join(timeout=self.poll_s * 8 + 5.0)
+            self._watch_thread = None
+        for i, s in enumerate(self._slots):
+            if s.retired or s.agent is None:
+                continue
+            w = self._watch.get(s.agent)
+            if w is None or w.lost:
+                continue
+            client = self._client_factory(w.info.url)
+            try:
+                client.stop_replica(i, grace_s=self.exit_grace_s)
+            except AgentUnreachable:
+                pass
+            self._unmirror(i)
+        with self._restart_lock:
+            restarts = self.restarts
+        self._event(
+            "stopped", restarts=restarts,
+            abandoned=sum(1 for s in self._slots if s.abandoned),
+        )

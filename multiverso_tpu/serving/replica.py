@@ -36,6 +36,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from multiverso_tpu.utils.configure import (
+    MV_DEFINE_bool,
     MV_DEFINE_double,
     MV_DEFINE_string,
     GetFlag,
@@ -59,6 +60,14 @@ MV_DEFINE_string(
     "serving replicas: comma-separated serving names for the "
     "checkpoint's tables in table-id order (empty = serve as "
     "table_<id>)",
+)
+MV_DEFINE_bool(
+    "serve_require_root", True,
+    "serving replicas: fail fast at start when -serve_checkpoint_dir "
+    "is not a listable directory, with one structured error naming "
+    "host+path — a bad shared-dir mount on a remotely-placed replica "
+    "must die loudly, not sit never-ready (false = wait for the root "
+    "to appear, the pre-multi-host behaviour)",
 )
 MV_DEFINE_double(
     "serve_max_seconds", 0.0,
@@ -97,10 +106,18 @@ class Replica:
         from multiverso_tpu.serving.http_data import (
             maybe_start_data_plane_from_flags,
         )
-        from multiverso_tpu.serving.rollout import SnapshotWatcher
+        from multiverso_tpu.serving.rollout import (
+            SnapshotWatcher,
+            check_root_reachable,
+        )
         from multiverso_tpu.serving.server import TableServer
 
         http_health.set_ready(False, phase="starting")
+        if bool(GetFlag("serve_require_root")):
+            # a remotely-placed replica with a bad checkpoint mount
+            # must fail here, loudly, naming host+path — not sit
+            # never-ready behind an eternal /readyz 503
+            check_root_reachable(self.root)
         self.admission = controller_from_flags()
         if self.admission is not None:
             self.admission.register_dashboard()

@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from multiverso_tpu.utils.configure import MV_DEFINE_double, GetFlag
 from multiverso_tpu.utils.log import Log
 
-__all__ = ["SnapshotWatcher"]
+__all__ = ["SnapshotWatcher", "check_root_reachable"]
 
 MV_DEFINE_double(
     "serve_poll_s", 2.0,
@@ -46,6 +46,35 @@ MV_DEFINE_double(
     "replicas never scan (or roll out) in lockstep (lower = fresher "
     "weights, more directory scans)",
 )
+
+
+def check_root_reachable(root: str) -> None:
+    """CHECK that a checkpoint root is a listable directory, with one
+    structured error naming HOST and PATH when it is not.
+
+    A remotely-placed replica reaches its checkpoints over a shared
+    mount; a bad mount used to surface as a silent never-ready replica
+    (``check_now`` logs a scan error each poll and keeps waiting,
+    which is correct for a root that EXISTS but is momentarily
+    unreadable — and actively misleading for one that was never
+    mounted). The placement layer needs the replica to die loudly so
+    the exit (and the host+path in its log) shows up in
+    ``fleet.log.jsonl`` instead of an eternal 503 on ``/readyz``."""
+    import socket
+
+    host = socket.gethostname()
+    try:
+        if not os.path.isdir(root):
+            raise FileNotFoundError("not a directory")
+        os.listdir(root)
+    except OSError as e:
+        Log.Fatal(
+            "serving: checkpoint root unreachable host=%s path=%s "
+            "error=%r — a replica placed on this host cannot load "
+            "weights; check the shared checkpoint mount (or start the "
+            "replica with -serve_require_root=false to wait for the "
+            "root to appear)", host, root, e,
+        )
 
 
 class SnapshotWatcher:
